@@ -1,0 +1,31 @@
+"""pertgnn_tpu — a TPU-native framework for microservice latency prediction.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+handasontam/PERT-GNN-KDD23 (mounted read-only at /root/reference): predicting
+end-to-end latency of microservice requests (Alibaba 2021 cluster trace) with a
+graph-transformer over per-entry mixtures of call-graph topologies
+(span graphs and activity-on-node PERT DAGs).
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+- ``ingest/``   — L0-L2: raw span CSV cleaning, entry detection, filters,
+                  runtime-pattern factorization, resource feature table.
+                  Pure pandas/numpy, host-side.
+- ``graphs/``   — trace → span-graph and PERT-graph construction (numpy).
+- ``batching/`` — offline mixture collation into flat arrays + fixed-shape
+                  packed batches (jraph-style) with validity masks.
+- ``ops/``      — XLA segment ops (segment softmax, masked pooling) and the
+                  Pallas fused edge-attention kernel.
+- ``models/``   — flax modules: graph-transformer layers, masked BatchNorm,
+                  the PertGNN regression model.
+- ``train/``    — jit'd optax train loop, pinball loss, masked metrics,
+                  orbax checkpointing.
+- ``parallel/`` — device mesh, shard_map data parallelism, tensor-parallel
+                  sharding rules, edge-sharded segment ops for giant graphs.
+- ``native/``   — C++ fast paths for host-side hot loops (ctypes bindings,
+                  numpy fallback).
+- ``utils/``    — profiling, logging.
+- ``cli/``      — preprocess / train entry points.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+from pertgnn_tpu.batching.mixture import Mixture, build_mixtures
+from pertgnn_tpu.batching.pack import (
+    PackedBatch,
+    BatchBudget,
+    derive_budget,
+    pack_examples,
+)
+from pertgnn_tpu.batching.featurize import ResourceLookup
+from pertgnn_tpu.batching.dataset import Dataset, build_dataset, split_indices

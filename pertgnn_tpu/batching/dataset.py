@@ -1,0 +1,143 @@
+"""Dataset assembly: subsample, positional split, epoch iteration.
+
+Matches the reference's split semantics exactly: take the FIRST
+`max_traces` traces in tr2data insertion order (pert_gnn.py:297-299), then a
+POSITIONAL 60/20/20 split (pert_gnn.py:196-210) — not random, not
+chronological; order is grouped-by-entry-then-trace (SURVEY.md §2.1). Train
+batches are shuffled per epoch (DataLoader shuffle=True for train only,
+pert_gnn.py:201-209).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from pertgnn_tpu.config import Config
+from pertgnn_tpu.batching.featurize import ResourceLookup
+from pertgnn_tpu.batching.mixture import Mixture, build_mixtures
+from pertgnn_tpu.batching.pack import (
+    BatchBudget, PackedBatch, derive_budget, pack_examples)
+from pertgnn_tpu.graphs.construct import build_runtime_graphs
+from pertgnn_tpu.ingest.assemble import TraceTable, assemble
+from pertgnn_tpu.ingest.preprocess import PreprocessResult
+
+
+def split_indices(n: int, fractions: Sequence[float]) -> list[np.ndarray]:
+    """Positional split: [0, f0*n), [f0*n, (f0+f1)*n), ... (pert_gnn.py:198-200)."""
+    bounds = np.cumsum([0.0] + list(fractions))
+    edges = [int(n * b) for b in bounds[:-1]] + [n]
+    # final edge takes any rounding remainder, like the reference's trailing
+    # slice data_list[int(0.8*n):]
+    return [np.arange(edges[i], edges[i + 1]) for i in range(len(fractions))]
+
+
+@dataclasses.dataclass
+class Split:
+    entry_ids: np.ndarray
+    ts_buckets: np.ndarray
+    ys: np.ndarray
+
+    def __len__(self):
+        return len(self.ys)
+
+
+@dataclasses.dataclass
+class Dataset:
+    mixtures: dict[int, Mixture]
+    lookup: ResourceLookup
+    budget: BatchBudget
+    splits: dict[str, Split]           # train / valid / test
+    num_ms: int                        # embedding vocab sizes
+    num_entries: int
+    num_interfaces: int
+    num_rpctypes: int
+    node_feature_dim: int
+    config: Config
+
+    def batches(self, split: str, shuffle: bool = False,
+                seed: int = 0) -> Iterator[PackedBatch]:
+        s = self.splits[split]
+        order = np.arange(len(s))
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(order)
+        yield from pack_examples(
+            self.mixtures, s.entry_ids[order], s.ts_buckets[order],
+            s.ys[order], self.budget, self.lookup,
+            node_depth_in_x=self.config.model.use_node_depth)
+
+    def num_batches(self, split: str) -> int:
+        """Batch count for the UNSHUFFLED order, computed by simulating the
+        greedy packer on sizes only (no feature gathers / allocations).
+
+        Greedy packing is order-dependent, so a shuffled epoch may produce a
+        different count — step loops must iterate `batches()` rather than
+        range(num_batches())."""
+        s = self.splits[split]
+        g = n = e = count = 0
+        for entry in s.entry_ids:
+            m = self.mixtures[int(entry)]
+            if (g + 1 > self.budget.max_graphs
+                    or n + m.num_nodes > self.budget.max_nodes
+                    or e + m.num_edges > self.budget.max_edges):
+                count += 1
+                g = n = e = 0
+            g += 1
+            n += m.num_nodes
+            e += m.num_edges
+        return count + (1 if g else 0)
+
+
+def build_dataset(pre: PreprocessResult, cfg: Config,
+                  table: TraceTable | None = None) -> Dataset:
+    """L2 artifacts -> ready-to-train dataset (all host work, vectorized)."""
+    if table is None:
+        table = assemble(pre, cfg.ingest)
+    graphs = build_runtime_graphs(pre, table, cfg.graph_type)
+    mixtures = build_mixtures(graphs, table.entry2runtimes)
+    lookup = ResourceLookup(
+        pre.resources,
+        missing_indicator_is_one=cfg.model.missing_indicator_is_one)
+
+    meta = table.meta.iloc[:cfg.data.max_traces]
+    if len(meta) == 0:
+        raise ValueError(
+            "no traces survived preprocessing — check the ingest filters "
+            f"(min_traces_per_entry={cfg.ingest.min_traces_per_entry}, "
+            f"min_resource_coverage={cfg.ingest.min_resource_coverage}) "
+            f"against the input; stats: {pre.stats}")
+    entry_ids = meta["entry_id"].to_numpy(np.int64)
+    ts_buckets = meta["ts_bucket"].to_numpy(np.int64)
+    ys = meta["y"].to_numpy(np.float32)
+
+    budget = derive_budget(mixtures, entry_ids, cfg.data.batch_size)
+    if cfg.data.max_nodes_per_batch is not None:
+        budget = dataclasses.replace(budget,
+                                     max_nodes=cfg.data.max_nodes_per_batch)
+    if cfg.data.max_edges_per_batch is not None:
+        budget = dataclasses.replace(budget,
+                                     max_edges=cfg.data.max_edges_per_batch)
+
+    parts = split_indices(len(meta), cfg.data.split)
+    names = ("train", "valid", "test")
+    splits = {name: Split(entry_ids[idx], ts_buckets[idx], ys[idx])
+              for name, idx in zip(names, parts)}
+
+    # embedding sizes from data maxima (reference derives them by scanning
+    # the data list, pert_gnn.py:306-328)
+    num_ifaces = 1 + max((int(m.edge_iface.max()) if m.num_edges else 0
+                          for m in mixtures.values()), default=0)
+    num_rpctypes = 1 + max((int(m.edge_rpctype.max()) if m.num_edges else 0
+                            for m in mixtures.values()), default=0)
+    num_ms = 1 + max(int(m.ms_id.max()) for m in mixtures.values())
+    num_entries = 1 + int(max(mixtures.keys()))
+    node_feature_dim = lookup.num_features + (
+        1 if cfg.model.use_node_depth else 0)
+
+    return Dataset(
+        mixtures=mixtures, lookup=lookup, budget=budget, splits=splits,
+        num_ms=num_ms, num_entries=num_entries, num_interfaces=num_ifaces,
+        num_rpctypes=num_rpctypes, node_feature_dim=node_feature_dim,
+        config=cfg)

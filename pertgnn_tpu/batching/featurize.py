@@ -1,0 +1,66 @@
+"""Vectorized node featurization from the resource table.
+
+Replaces the reference's train-time `get_x`
+(/root/reference/pert_gnn.py:40-67): node features are the 8 aggregate
+resource-usage values for (trace 30 s bucket, node's microservice), plus a
+missing indicator. The reference memoizes a per-(timestamp, ms-tuple) Python
+loop with lru_cache; here the lookup is one hashed gather over the whole
+batch's (bucket, ms) key vector.
+
+Indicator convention (PARITY.md): the live reference convention is
+1 = missing (pert_gnn.py:50, 62-66); the reverse (preprocess-time, dead)
+convention 1 = present (misc.py:153) is available via
+`missing_indicator_is_one=False`.
+
+Robustness divergence: the reference would KeyError on a microservice that
+has resource rows but not at the trace's exact bucket (pert_gnn.py:59 uses
+exact .loc); here any (bucket, ms) pair absent from the table is treated as
+missing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from pertgnn_tpu.ingest.schema import NUM_RESOURCE_FEATURES
+
+
+class ResourceLookup:
+    """Hashed (timestamp_bucket, msname) -> feature-row gather."""
+
+    def __init__(self, resource_df: pd.DataFrame,
+                 missing_indicator_is_one: bool = True):
+        feat_cols = [c for c in resource_df.columns
+                     if c not in ("timestamp", "msname")]
+        if len(feat_cols) != NUM_RESOURCE_FEATURES:
+            raise ValueError(
+                f"expected {NUM_RESOURCE_FEATURES} feature columns, got "
+                f"{feat_cols}")
+        self._values = resource_df[feat_cols].to_numpy(dtype=np.float32)
+        ts = resource_df["timestamp"].to_numpy(dtype=np.int64)
+        ms = resource_df["msname"].to_numpy(dtype=np.int64)
+        self._index = pd.Index(self._key(ts, ms))
+        self.missing_indicator_is_one = missing_indicator_is_one
+        self.num_features = NUM_RESOURCE_FEATURES + 1
+
+    @staticmethod
+    def _key(ts: np.ndarray, ms: np.ndarray) -> np.ndarray:
+        return ts.astype(np.int64) * np.int64(1 << 22) + ms.astype(np.int64)
+
+    def __call__(self, ts_bucket: np.ndarray, ms_id: np.ndarray) -> np.ndarray:
+        """Features for parallel arrays of buckets and microservice ids.
+
+        Returns (len(ms_id), 9) float32: 8 resource features (0 where
+        missing) + indicator column.
+        """
+        keys = self._key(np.asarray(ts_bucket), np.asarray(ms_id))
+        locs = self._index.get_indexer(keys)
+        present = locs >= 0
+        x = np.zeros((len(keys), NUM_RESOURCE_FEATURES + 1), dtype=np.float32)
+        x[present, :-1] = self._values[locs[present]]
+        if self.missing_indicator_is_one:
+            x[~present, -1] = 1.0
+        else:
+            x[present, -1] = 1.0
+        return x

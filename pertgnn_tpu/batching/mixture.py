@@ -1,0 +1,71 @@
+"""Per-entry topology-mixture collation, done ONCE offline.
+
+The reference rebuilds each trace's mixture graph lazily with a stack of
+lru_caches (/root/reference/pert_gnn.py:70-173) and re-derives per-node
+pattern probabilities on the host INSIDE the train loop for every batch of
+every epoch (pert_gnn.py:220-230). Both collapse into this module: for each
+entry, the graphs of all its runtime patterns are concatenated
+block-diagonally once — edge indices offset by the node-count cumsum
+(pert_gnn.py:107-119), per-node pattern probability and pattern size repeated
+per node (pert_gnn.py:85-94, 122-131) — into flat numpy arrays that batching
+then slices with zero per-trace Python work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from pertgnn_tpu.graphs.construct import GraphSpec
+
+
+@dataclasses.dataclass
+class Mixture:
+    """All runtime patterns of one entry, block-diagonally concatenated."""
+
+    entry_id: int
+    senders: np.ndarray        # (E,) int32
+    receivers: np.ndarray      # (E,) int32
+    edge_iface: np.ndarray     # (E,) int32
+    edge_rpctype: np.ndarray   # (E,) int32
+    ms_id: np.ndarray          # (N,) int32
+    node_depth: np.ndarray     # (N,) float32
+    pattern_prob: np.ndarray   # (N,) float32 — this node's pattern's weight
+    pattern_size: np.ndarray   # (N,) float32 — this node's pattern's #nodes
+    num_nodes: int
+    num_edges: int
+
+
+def build_mixtures(
+    runtime_graphs: dict[int, GraphSpec],
+    entry2runtimes: dict[int, tuple[np.ndarray, np.ndarray]],
+) -> dict[int, Mixture]:
+    out: dict[int, Mixture] = {}
+    for entry_id, (rt_ids, probs) in entry2runtimes.items():
+        graphs = [runtime_graphs[int(rt)] for rt in rt_ids]
+        sizes = np.array([g.num_nodes for g in graphs], dtype=np.int64)
+        offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+        senders = np.concatenate(
+            [g.senders + off for g, off in zip(graphs, offsets)])
+        receivers = np.concatenate(
+            [g.receivers + off for g, off in zip(graphs, offsets)])
+        edge_attr = np.concatenate([g.edge_attr[:, :2] for g in graphs])
+        ms_id = np.concatenate([g.ms_id for g in graphs])
+        node_depth = np.concatenate([g.node_depth for g in graphs])
+        pattern_prob = np.repeat(probs.astype(np.float32), sizes)
+        pattern_size = np.repeat(sizes.astype(np.float32), sizes)
+        out[int(entry_id)] = Mixture(
+            entry_id=int(entry_id),
+            senders=senders.astype(np.int32),
+            receivers=receivers.astype(np.int32),
+            edge_iface=edge_attr[:, 0].astype(np.int32),
+            edge_rpctype=edge_attr[:, 1].astype(np.int32),
+            ms_id=ms_id.astype(np.int32),
+            node_depth=node_depth.astype(np.float32),
+            pattern_prob=pattern_prob,
+            pattern_size=pattern_size,
+            num_nodes=int(sizes.sum()),
+            num_edges=len(senders),
+        )
+    return out

@@ -1,0 +1,162 @@
+"""Fixed-shape packed graph batches.
+
+The TPU replacement for PyG's dynamic ragged batching
+(/root/reference/pert_gnn.py:196-210): every batch has ONE static shape
+(max_graphs, max_nodes, max_edges), so the jit'd train step compiles exactly
+once. Graphs (= traces: one entry-mixture each) are packed greedily until a
+budget would overflow; the remainder is padding, tracked by node/edge/graph
+masks that the model and loss respect exactly (padding must be unobservable —
+enforced by the padding-invariance tests).
+
+Layout follows the jraph GraphsTuple idea (flat node/edge arrays + per-node
+graph ids) re-derived for this workload: per-node pattern_prob/pattern_size
+carry the reference's mixture weighting (pert_gnn.py:85-94, 122-131), and the
+last graph slot is reserved as the pad graph that all pad nodes point to, so
+segment pooling needs no special cases.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, NamedTuple, Sequence
+
+import numpy as np
+
+from pertgnn_tpu.batching.featurize import ResourceLookup
+from pertgnn_tpu.batching.mixture import Mixture
+
+
+class PackedBatch(NamedTuple):
+    """One fixed-shape batch. All arrays are host numpy until device put."""
+
+    x: np.ndarray              # (N, F) float32 node features
+    ms_id: np.ndarray          # (N,) int32
+    node_depth: np.ndarray     # (N,) float32
+    node_graph: np.ndarray     # (N,) int32 — graph slot per node
+    node_mask: np.ndarray      # (N,) bool
+    pattern_prob: np.ndarray   # (N,) float32
+    pattern_size: np.ndarray   # (N,) float32 (pad nodes: 1, avoids 0-div)
+    senders: np.ndarray        # (E,) int32 (pad edges: 0, masked)
+    receivers: np.ndarray      # (E,) int32
+    edge_iface: np.ndarray     # (E,) int32
+    edge_rpctype: np.ndarray   # (E,) int32
+    edge_mask: np.ndarray      # (E,) bool
+    entry_id: np.ndarray       # (G,) int32
+    y: np.ndarray              # (G,) float32
+    graph_mask: np.ndarray     # (G,) bool
+
+    @property
+    def num_graphs(self) -> int:
+        return len(self.entry_id)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchBudget:
+    max_graphs: int   # real graph slots (one extra pad slot is added)
+    max_nodes: int
+    max_edges: int
+
+
+def _round_up(v: int, m: int = 128) -> int:
+    return ((v + m - 1) // m) * m
+
+
+def derive_budget(mixtures: dict[int, Mixture], entry_ids: np.ndarray,
+                  batch_size: int) -> BatchBudget:
+    """Budget sized so an average batch fits `batch_size` graphs.
+
+    Node/edge budgets are mean-mixture-size * batch_size with 30% head-room
+    (but never below the single largest mixture), rounded up to multiples of
+    128 for TPU lane alignment.
+    """
+    sizes_n = np.array([mixtures[int(e)].num_nodes for e in entry_ids])
+    sizes_e = np.array([mixtures[int(e)].num_edges for e in entry_ids])
+    max_nodes = _round_up(max(int(sizes_n.mean() * batch_size * 1.3),
+                              int(sizes_n.max()) + 1))
+    max_edges = _round_up(max(int(sizes_e.mean() * batch_size * 1.3),
+                              int(sizes_e.max()) + 1))
+    return BatchBudget(max_graphs=batch_size, max_nodes=max_nodes,
+                       max_edges=max_edges)
+
+
+def pack_examples(
+    mixtures: dict[int, Mixture],
+    entry_ids: np.ndarray,
+    ts_buckets: np.ndarray,
+    ys: np.ndarray,
+    budget: BatchBudget,
+    lookup: ResourceLookup,
+    node_depth_in_x: bool = False,
+) -> Iterator[PackedBatch]:
+    """Greedily pack examples (in the given order) into fixed-shape batches.
+
+    Every example must fit a budget alone; an example larger than the budget
+    raises (size your budget with `derive_budget`).
+    """
+    G = budget.max_graphs + 1  # +1: reserved pad graph slot
+    n_feat = lookup.num_features + (1 if node_depth_in_x else 0)
+
+    def new_batch():
+        return dict(
+            x=np.zeros((budget.max_nodes, n_feat), dtype=np.float32),
+            ms_id=np.zeros(budget.max_nodes, dtype=np.int32),
+            node_depth=np.zeros(budget.max_nodes, dtype=np.float32),
+            node_graph=np.full(budget.max_nodes, G - 1, dtype=np.int32),
+            node_mask=np.zeros(budget.max_nodes, dtype=bool),
+            pattern_prob=np.zeros(budget.max_nodes, dtype=np.float32),
+            pattern_size=np.ones(budget.max_nodes, dtype=np.float32),
+            senders=np.zeros(budget.max_edges, dtype=np.int32),
+            receivers=np.zeros(budget.max_edges, dtype=np.int32),
+            edge_iface=np.zeros(budget.max_edges, dtype=np.int32),
+            edge_rpctype=np.zeros(budget.max_edges, dtype=np.int32),
+            edge_mask=np.zeros(budget.max_edges, dtype=bool),
+            entry_id=np.zeros(G, dtype=np.int32),
+            y=np.zeros(G, dtype=np.float32),
+            graph_mask=np.zeros(G, dtype=bool),
+        )
+
+    buf = new_batch()
+    g = n = e = 0
+
+    def flush():
+        nonlocal buf, g, n, e
+        batch = PackedBatch(**buf)
+        buf = new_batch()
+        g = n = e = 0
+        return batch
+
+    for entry, bucket, y in zip(entry_ids, ts_buckets, ys):
+        mix = mixtures[int(entry)]
+        if mix.num_nodes > budget.max_nodes or mix.num_edges > budget.max_edges:
+            raise ValueError(
+                f"entry {entry} mixture ({mix.num_nodes} nodes, "
+                f"{mix.num_edges} edges) exceeds budget {budget}")
+        if (g + 1 > budget.max_graphs or n + mix.num_nodes > budget.max_nodes
+                or e + mix.num_edges > budget.max_edges):
+            yield flush()
+        ns = slice(n, n + mix.num_nodes)
+        es = slice(e, e + mix.num_edges)
+        feats = lookup(np.full(mix.num_nodes, bucket, dtype=np.int64),
+                       mix.ms_id.astype(np.int64))
+        if node_depth_in_x:
+            feats = np.concatenate([feats, mix.node_depth[:, None]], axis=1)
+        buf["x"][ns] = feats
+        buf["ms_id"][ns] = mix.ms_id
+        buf["node_depth"][ns] = mix.node_depth
+        buf["node_graph"][ns] = g
+        buf["node_mask"][ns] = True
+        buf["pattern_prob"][ns] = mix.pattern_prob
+        buf["pattern_size"][ns] = mix.pattern_size
+        buf["senders"][es] = mix.senders + n
+        buf["receivers"][es] = mix.receivers + n
+        buf["edge_iface"][es] = mix.edge_iface
+        buf["edge_rpctype"][es] = mix.edge_rpctype
+        buf["edge_mask"][es] = True
+        buf["entry_id"][g] = entry
+        buf["y"][g] = y
+        buf["graph_mask"][g] = True
+        g += 1
+        n += mix.num_nodes
+        e += mix.num_edges
+    if g:
+        yield flush()

@@ -1,0 +1,158 @@
+"""Configuration for the full pipeline.
+
+Every magic number that lives inline in the reference is surfaced here as a
+named field (SURVEY.md §5.6 inventory):
+
+- 30 000 ms trace start-time bucket      (/root/reference/preprocess.py:39)
+- 0.6 resource-coverage threshold        (/root/reference/preprocess.py:170)
+- 100 min traces per entry               (/root/reference/preprocess.py:180,246)
+- 100 000 trace subsample                (/root/reference/pert_gnn.py:299)
+- 60/20/20 positional split              (/root/reference/pert_gnn.py:198-200)
+- "(?)" entry tie-break token            (/root/reference/preprocess.py:121)
+- resource agg set [max,min,mean,median] (/root/reference/preprocess.py:238)
+- training defaults (hidden 32, lr 3e-4, tau 0.5, batch 170, 100 epochs,
+  num_layers 1, dropout 0)               (/root/reference/pert_gnn.py:15-33)
+
+Deliberate divergences from the reference are opt-in flags documented on each
+field and in PARITY.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """L0-L2 preprocessing knobs."""
+
+    # Trace start-time bucket (ms) keying resource lookups
+    # (reference: preprocess.py:39 `// 30000 * 30000`).
+    ts_bucket_ms: int = 30_000
+    # Keep traces where >= this fraction of participating microservices have
+    # resource features (reference: preprocess.py:170).
+    min_resource_coverage: float = 0.6
+    # Keep traces whose entry endpoint occurs in MORE than this many traces
+    # (strict >, reference: preprocess.py:185 `> min_occurence`).
+    min_traces_per_entry: int = 100
+    # Entry-row tie-break: among multiple candidates prefer um == this token
+    # (reference: preprocess.py:121). Raw-string domain; factorized away later.
+    entry_tiebreak_um: str = "(?)"
+    # Aggregations applied to per-(timestamp, msname) resource usage columns
+    # (reference: preprocess.py:238). 2 columns x 4 aggs = 8 numeric features.
+    resource_aggs: Sequence[str] = ("max", "min", "mean", "median")
+    # rpctype string that identifies candidate entry rows
+    # (reference: preprocess.py:113 `group.rpctype == "http"`).
+    entry_rpctype: str = "http"
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """L3 dataset assembly / batching knobs."""
+
+    # Subsample of traces used for training (reference: pert_gnn.py:299).
+    max_traces: int = 100_000
+    # Positional split fractions (reference: pert_gnn.py:198-200).
+    split: Sequence[float] = (0.6, 0.2, 0.2)
+    # Graphs per packed batch (reference batch_size: pert_gnn.py:31).
+    batch_size: int = 170
+    # Packed-batch budgets. `None` -> derived from the dataset (max mixture
+    # size * batch_size head-room, rounded up to multiples of 128 for TPU
+    # lane alignment). These give every batch ONE static shape -> one compile.
+    max_nodes_per_batch: int | None = None
+    max_edges_per_batch: int | None = None
+    # Shuffle seed for the train split.
+    shuffle_seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Model hyper-parameters (reference: pert_gnn.py:15-33, model.py:10-68)."""
+
+    hidden_channels: int = 32
+    # NOTE: reference `--num_layers L` builds max(2, L) conv layers
+    # (model.py:24-52; default L=1 still builds 2 convs). We keep that exact
+    # arithmetic so configs transfer: num_conv_layers = max(2, num_layers).
+    num_layers: int = 1
+    # Attention heads. Reference hard-codes 1 (model.py:29); >1 generalizes it
+    # (BASELINE config 4 uses 8).
+    num_heads: int = 1
+    dropout: float = 0.0
+    # --- capability switches for paths the reference computes but never uses
+    # (SURVEY.md §2.3 "declared-but-dead"); all default to reference-live
+    # behavior.
+    # Feed normalized node depth as an extra input feature (reference stores
+    # node_depth in every Data, pert_gnn.py:168, but the model never sees it).
+    use_node_depth: bool = False
+    # Clamp the global prediction to be non-negative (reference comment
+    # model.py:113, unimplemented).
+    nonnegative_pred: bool = False
+    # Weight of the per-node local head in the loss (reference computes
+    # local_pred but never trains on it, pert_gnn.py:245).
+    local_loss_weight: float = 0.0
+    # Missing-feature indicator convention. The reference has TWO conventions:
+    # train-time get_x uses 1=missing (pert_gnn.py:50,62-66) — that is what
+    # the model actually sees; preprocess-time uses 1=present (misc.py:153) —
+    # dead output. True = the live get_x convention.
+    missing_indicator_is_one: bool = True
+    # Use the Pallas fused edge-attention kernel for the conv hot op.
+    use_pallas_attention: bool = False
+    # Parameter/activation dtype for the MXU. Params stay f32; activations in
+    # bf16 when True.
+    bf16_activations: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Optimizer / loop knobs (reference: pert_gnn.py:15-33, 343)."""
+
+    lr: float = 3e-4
+    # Pinball-loss quantile level (reference: pert_gnn.py:24-28).
+    tau: float = 0.5
+    epochs: int = 100
+    # Steps between metric log lines.
+    log_every: int = 50
+    # Orbax checkpoint cadence (steps); 0 disables.
+    checkpoint_every: int = 500
+    checkpoint_dir: str = "checkpoints"
+    # Keep at most this many checkpoints.
+    checkpoint_keep: int = 3
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Mesh / sharding layout.
+
+    The reference is single-device (pert_gnn.py:36-37); distribution here is
+    first-class: a (data, model) mesh, batch sharded over `data` with psum
+    gradient all-reduce over ICI, hidden dims optionally sharded over `model`.
+    """
+
+    data_axis: str = "data"
+    model_axis: str = "model"
+    # -1 = all available devices on the data axis.
+    data_parallel: int = -1
+    model_parallel: int = 1
+    # Shard edges of one giant graph across `data` for the 5k-node stress
+    # path (BASELINE config 5).
+    shard_edges: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    ingest: IngestConfig = IngestConfig()
+    data: DataConfig = DataConfig()
+    model: ModelConfig = ModelConfig()
+    train: TrainConfig = TrainConfig()
+    parallel: ParallelConfig = ParallelConfig()
+    # span | pert (reference: pert_gnn.py:32).
+    graph_type: str = "span"
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def default_config() -> Config:
+    return Config()

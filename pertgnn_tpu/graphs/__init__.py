@@ -1,0 +1,9 @@
+from pertgnn_tpu.graphs.construct import (
+    GraphSpec,
+    sanitize_edges,
+    find_root,
+    build_span_graph,
+    build_pert_graph,
+    build_runtime_graphs,
+    min_depth_from_root,
+)

@@ -1,0 +1,272 @@
+"""Trace → graph construction (host-side, numpy).
+
+Rebuilds the semantics of the reference's `GraphConstruct`
+(/root/reference/misc.py:72-370) without torch:
+
+- edge sanitizing: the exact order-sensitive sequence of
+  self-loop removal → rpcid dedup (keep first) → drop edges into root →
+  (um, dm) dedup (keep last) → unordered-pair dedup (keep first), a
+  cycle-breaking heuristic (misc.py:87-105);
+- root detection: um of the row with maximal |rt| AND minimal timestamp,
+  evaluated on the UNsanitized trace (misc.py:74, 138-142);
+- span graph: nodes = microservices compacted via sorted unique
+  (misc.py:196-198), edge features [interface, rpctype] (misc.py:177-181);
+- PERT graph: activity-on-node expansion — a caller with k outgoing calls
+  becomes a chain of 2k+1 stage nodes joined by intra-ms edges with attr
+  [0, 0, 1, 1] (misc.py:240-250); pure callees get one node (misc.py:251-257);
+  per caller, call/return events sorted by time emit inter-ms edges
+  (call: stages[um][i] → stages[dm][0], attr [iface, rpctype, 1, 0];
+  return: stages[dm][-1] → stages[um][i+1], attr [iface, rpctype, 0, 0])
+  (misc.py:272-302);
+- node depth: min depth from the root, unreachable → 0, normalized by the
+  max (misc.py:52-69, 144-175) — computed with an ITERATIVE BFS rather than
+  the reference's recursive DFS, which would overflow the Python stack on
+  the 5k-node synthetic DAG stress config.
+
+Node-numbering notes (graph-isomorphic, features follow the ids, so these
+choices are unobservable to the model): the PERT caller order follows the
+reference's `value_counts()` (count-descending, first-appearance tie-break,
+misc.py:240); leaf callees are emitted in sorted order where the reference
+iterates a Python set (misc.py:251-254).
+
+Depth-dtype divergence (documented in PARITY.md): the reference stores the
+normalized min-depth as torch.long, truncating every value except the deepest
+node's 1.0 to 0 (misc.py:173, 215, 368); since the released model never
+consumes node_depth, we keep the float value so the `use_node_depth`
+capability option receives real information.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+import numpy as np
+import pandas as pd
+
+from pertgnn_tpu.ingest.assemble import TraceTable
+from pertgnn_tpu.ingest.preprocess import PreprocessResult
+
+
+@dataclasses.dataclass
+class GraphSpec:
+    """One runtime pattern's structure, as flat numpy arrays.
+
+    Node features are NOT stored — like the reference (preprocess.py:333-340
+    persists only structure), features are attached at batch-build time from
+    the resource table, because they depend on the trace's time bucket.
+    """
+
+    senders: np.ndarray     # (E,) int32 — edge source node
+    receivers: np.ndarray   # (E,) int32 — edge destination node
+    edge_attr: np.ndarray   # (E, 2) span / (E, 4) pert int32:
+                            # [interface, rpctype(, call_ind, same_ms_ind)]
+    ms_id: np.ndarray       # (N,) int32 — microservice id per node
+    node_depth: np.ndarray  # (N,) float32 — normalized min depth from root
+    num_nodes: int
+
+    @property
+    def num_edges(self) -> int:
+        return len(self.senders)
+
+
+def find_root(trace_df: pd.DataFrame):
+    """Root microservice: um of the row with max |rt| and min timestamp
+    (/root/reference/misc.py:138-142)."""
+    abs_rt = trace_df["rt"].abs()
+    mask = (abs_rt == abs_rt.max()) & (
+        trace_df["timestamp"] == trace_df["timestamp"].min())
+    return trace_df.loc[mask, "um"].iloc[0]
+
+
+def sanitize_edges(trace_df: pd.DataFrame, root) -> pd.DataFrame:
+    """The reference's `drop_wrong_edges` sequence (misc.py:87-105)."""
+    df = trace_df[trace_df["um"] != trace_df["dm"]]
+    df = df.drop_duplicates(subset="rpcid", keep="first")
+    df = df[df["dm"] != root]
+    df = df.drop_duplicates(subset=["um", "dm"], keep="last")
+    # unordered-pair dedup: keeps the first of any (a, b)/(b, a) pair
+    a = df["um"].to_numpy()
+    b = df["dm"].to_numpy()
+    lo = np.minimum(a, b)
+    hi = np.maximum(a, b)
+    pair = pd.DataFrame({"lo": lo, "hi": hi}, index=df.index)
+    keep = ~pair.duplicated(subset=["lo", "hi"], keep="first")
+    return df[keep.values]
+
+
+def min_depth_from_root(num_nodes: int, senders: np.ndarray,
+                        receivers: np.ndarray, root: int) -> np.ndarray:
+    """Iterative BFS min-depth; unreachable nodes get 0
+    (reference: inf → 0, misc.py:160)."""
+    adj: list[list[int]] = [[] for _ in range(num_nodes)]
+    for s, r in zip(senders.tolist(), receivers.tolist()):
+        adj[s].append(r)
+    depth = np.full(num_nodes, -1, dtype=np.int64)
+    depth[root] = 0
+    q = deque([root])
+    while q:
+        v = q.popleft()
+        for w in adj[v]:
+            if depth[w] < 0:
+                depth[w] = depth[v] + 1
+                q.append(w)
+    depth[depth < 0] = 0
+    return depth
+
+
+def _normalized_depth(depth: np.ndarray) -> np.ndarray:
+    denom = depth.max() if depth.max() > 0 else 1
+    return (depth / denom).astype(np.float32)
+
+
+def build_span_graph(trace_df: pd.DataFrame) -> GraphSpec:
+    """Span graph: one node per microservice (misc.py:190-219)."""
+    root = find_root(trace_df)
+    df = sanitize_edges(trace_df, root)
+    um = df["um"].to_numpy(dtype=np.int64)
+    dm = df["dm"].to_numpy(dtype=np.int64)
+    edge_nodes = np.stack([um, dm])
+    # sorted unique compaction, same as torch.unique(return_inverse=True)
+    # (misc.py:196-198)
+    unique_ms, inverse = np.unique(edge_nodes, return_inverse=True)
+    edge_index = inverse.reshape(edge_nodes.shape)
+    num_nodes = len(unique_ms)
+    # The sanitizer can drop every row mentioning the root (e.g. a duplicate
+    # rpcid on the entry row); the reference crashes with KeyError there
+    # (misc.py:204) — we degrade to all-zero depths instead (PARITY.md).
+    root_pos = int(np.searchsorted(unique_ms, root))
+    if root_pos < num_nodes and unique_ms[root_pos] == root:
+        depth = min_depth_from_root(num_nodes, edge_index[0], edge_index[1],
+                                    root_pos)
+    else:
+        depth = np.zeros(num_nodes, dtype=np.int64)
+    edge_attr = df[["interface", "rpctype"]].to_numpy(dtype=np.int32)
+    return GraphSpec(
+        senders=edge_index[0].astype(np.int32),
+        receivers=edge_index[1].astype(np.int32),
+        edge_attr=edge_attr,
+        ms_id=unique_ms.astype(np.int32),
+        node_depth=_normalized_depth(depth),
+        num_nodes=num_nodes,
+    )
+
+
+def _caller_order(um: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Unique callers ordered like pandas `value_counts()`: count-descending
+    with first-appearance tie-break (misc.py:240). Returns (callers, counts)."""
+    first_order, counts_in_order = [], []
+    seen: dict[int, int] = {}
+    for v in um.tolist():
+        if v in seen:
+            seen[v] += 1
+        else:
+            seen[v] = 1
+            first_order.append(v)
+    counts = np.array([seen[v] for v in first_order], dtype=np.int64)
+    order = np.argsort(-counts, kind="stable")
+    callers = np.array(first_order, dtype=np.int64)[order]
+    return callers, counts[order]
+
+
+def build_pert_graph(trace_df: pd.DataFrame) -> GraphSpec:
+    """Activity-on-node PERT DAG (misc.py:221-370)."""
+    root = find_root(trace_df)
+    df = sanitize_edges(trace_df, root)
+
+    um = df["um"].to_numpy(dtype=np.int64)
+    callers, counts = _caller_order(um)
+
+    stages: dict[int, np.ndarray] = {}
+    ms_id: list[int] = []
+    senders: list[int] = []
+    receivers: list[int] = []
+    edge_attr: list[list[int]] = []
+    num_nodes = 0
+    for ms, k in zip(callers.tolist(), counts.tolist()):
+        n_stages = 2 * k + 1
+        stages[ms] = np.arange(n_stages) + num_nodes
+        for prev, cur in zip(stages[ms], stages[ms][1:]):
+            senders.append(int(prev))
+            receivers.append(int(cur))
+            edge_attr.append([0, 0, 1, 1])
+        num_nodes += n_stages
+        ms_id.extend([ms] * n_stages)
+    leaves = sorted(set(df["dm"].tolist()) - set(df["um"].tolist()))
+    for leaf in leaves:
+        stages[leaf] = np.array([num_nodes])
+        ms_id.append(leaf)
+        num_nodes += 1
+
+    # per-caller call/return events ordered by time (misc.py:272-302);
+    # groupby("um") iterates callers in sorted order with rows in original
+    # (timestamp) order, and Python's stable sort keeps ties in emission
+    # order (start before end for the same row)
+    for caller, group in df.groupby("um", sort=True):
+        events = []
+        for _, row in group.iterrows():
+            events.append((row["timestamp"], 0, row["dm"],
+                           int(row["interface"]), int(row["rpctype"])))
+            events.append((row["endTimestamp"], 1, row["dm"], 0, 0))
+        events.sort(key=lambda t: t[0])
+        for i, (_, is_end, dm, iface, rpctype) in enumerate(events):
+            if is_end:
+                senders.append(int(stages[dm][-1]))
+                receivers.append(int(stages[caller][i + 1]))
+                edge_attr.append([iface, rpctype, 0, 0])
+            else:
+                senders.append(int(stages[caller][i]))
+                receivers.append(int(stages[dm][0]))
+                edge_attr.append([iface, rpctype, 1, 0])
+
+    senders_a = np.array(senders, dtype=np.int32)
+    receivers_a = np.array(receivers, dtype=np.int32)
+    if root in stages:
+        depth = min_depth_from_root(num_nodes, senders_a, receivers_a,
+                                    int(stages[root][0]))
+    else:
+        # root sanitized away entirely; reference would KeyError (misc.py:311)
+        depth = np.zeros(num_nodes, dtype=np.int64)
+    return GraphSpec(
+        senders=senders_a,
+        receivers=receivers_a,
+        edge_attr=np.array(edge_attr, dtype=np.int32).reshape(-1, 4),
+        ms_id=np.array(ms_id, dtype=np.int32),
+        node_depth=_normalized_depth(depth),
+        num_nodes=num_nodes,
+    )
+
+
+def build_runtime_graphs(pre: PreprocessResult, table: TraceTable,
+                         graph_type: str = "span",
+                         use_native: bool | None = None,
+                         ) -> dict[int, GraphSpec]:
+    """One GraphSpec per runtime pattern, built from its representative trace
+    (the reference builds each pattern's graph on first sight,
+    preprocess.py:317-318, 343-344).
+
+    `use_native`: force the C++ fast path on/off; None = auto (use it when
+    the shared library is available).
+    """
+    if graph_type not in ("span", "pert"):
+        raise ValueError(f"graph_type must be span|pert, got {graph_type!r}")
+    if use_native is None or use_native:
+        try:
+            from pertgnn_tpu.native import bindings as native
+            if native.available():
+                return native.build_runtime_graphs(pre, table, graph_type)
+            if use_native:
+                raise RuntimeError("native library not available")
+        except ImportError:
+            if use_native:
+                raise
+    build = build_span_graph if graph_type == "span" else build_pert_graph
+    # only representative traces are consumed — filter before the groupby
+    # split so we never materialize per-trace frames for the other ~100k
+    reps = set(table.runtime2trace.values())
+    rep_spans = pre.spans[pre.spans["traceid"].isin(reps)]
+    spans_by_trace = {tid: grp for tid, grp in rep_spans.groupby("traceid")}
+    out: dict[int, GraphSpec] = {}
+    for runtime_id, traceid in table.runtime2trace.items():
+        out[runtime_id] = build(spans_by_trace[traceid])
+    return out

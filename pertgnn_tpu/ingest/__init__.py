@@ -1,0 +1,11 @@
+from pertgnn_tpu.ingest.schema import SPAN_COLUMNS, RESOURCE_COLUMNS
+from pertgnn_tpu.ingest import synthetic
+from pertgnn_tpu.ingest.preprocess import (
+    preprocess,
+    PreprocessResult,
+    detect_entries,
+    filter_by_resource_coverage,
+    filter_by_entry_occurrence,
+    build_resource_table,
+    factorize_columns,
+)

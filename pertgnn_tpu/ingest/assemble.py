@@ -1,0 +1,87 @@
+"""L2: dataset assembly — runtime-pattern identity, labels, mixture weights.
+
+Re-implements the first half of the reference's `main()`
+(/root/reference/preprocess.py:269-316): each trace is represented as the
+string of its `um_dm_interface` tokens in row (timestamp) order; identical
+strings share a `runtime_id` (preprocess.py:280-293); the label is the
+trace-maximal |rt| (preprocess.py:290-292); `entry2runtimes` holds, per entry,
+the empirical probability of each runtime pattern (preprocess.py:310-316,
+371-375).
+
+The reference materializes these inside a per-(entry, trace) Python loop; here
+everything is a vectorized pandas pass, and only ONE representative trace per
+runtime pattern is handed to graph construction (matching the reference's
+"first sight of runtime_id" behavior, preprocess.py:317-318: groupby iterates
+entries and traces in sorted order, so first sight = minimal traceid).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pandas as pd
+
+from pertgnn_tpu.config import IngestConfig
+from pertgnn_tpu.ingest.preprocess import PreprocessResult
+
+
+@dataclasses.dataclass
+class TraceTable:
+    """Per-trace metadata + mixture weights, host-side."""
+
+    # columns: traceid, entry_id, runtime_id, ts_bucket, y — row order is the
+    # reference's tr2data insertion order (sorted by entry, then trace;
+    # preprocess.py:295-309), which the 60/20/20 positional split depends on
+    # (pert_gnn.py:198-200).
+    meta: pd.DataFrame
+    # entry_id -> (runtime_ids ordered by first appearance, probs)
+    entry2runtimes: dict[int, tuple[np.ndarray, np.ndarray]]
+    # runtime_id -> representative traceid (builds the pattern's graph)
+    runtime2trace: dict[int, int]
+
+
+def assemble(pre: PreprocessResult,
+             cfg: IngestConfig = IngestConfig()) -> TraceTable:
+    df = pre.spans
+
+    token = (df["um"].astype(str) + "_" + df["dm"].astype(str)
+             + "_" + df["interface"].astype(str))
+    corpus = token.groupby(df["traceid"]).agg(" ".join)  # sorted by traceid
+    runtime_id, _ = pd.factorize(corpus)
+    tr2runtime = pd.Series(runtime_id, index=corpus.index)
+
+    abs_rt = df["rt"].abs()
+    tr2delay = abs_rt.groupby(df["traceid"]).max()
+    tr2bucket = (df.groupby("traceid")["timestamp"].min()
+                 // cfg.ts_bucket_ms * cfg.ts_bucket_ms)
+    tr2entry = df.groupby("traceid")["entryid"].first()
+
+    meta = pd.DataFrame({
+        "traceid": corpus.index,
+        "entry_id": tr2entry.loc[corpus.index].values,
+        "runtime_id": tr2runtime.values,
+        "ts_bucket": tr2bucket.loc[corpus.index].values,
+        "y": tr2delay.loc[corpus.index].values.astype(np.float64),
+    })
+    # reference iteration order: sorted by entry, then by trace within entry
+    meta = meta.sort_values(["entry_id", "traceid"],
+                            kind="stable").reset_index(drop=True)
+
+    # mixture weights per entry, runtime order = first appearance in the
+    # sorted-trace iteration (matches dict-insertion order in the reference,
+    # preprocess.py:310-316)
+    entry2runtimes: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+    for entry_id, grp in meta.groupby("entry_id", sort=True):
+        rts = grp["runtime_id"]
+        first_order = rts.drop_duplicates().values
+        counts = rts.value_counts()
+        probs = np.array([counts[rt] for rt in first_order], dtype=np.float64)
+        probs /= probs.sum()
+        entry2runtimes[int(entry_id)] = (first_order.astype(np.int64), probs)
+
+    runtime2trace = meta.groupby("runtime_id")["traceid"].min().to_dict()
+    runtime2trace = {int(k): int(v) for k, v in runtime2trace.items()}
+
+    return TraceTable(meta=meta, entry2runtimes=entry2runtimes,
+                      runtime2trace=runtime2trace)

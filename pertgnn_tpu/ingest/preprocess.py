@@ -1,0 +1,204 @@
+"""L0-L2: raw span cleaning, entry detection, filters, factorization.
+
+Re-implements the behavior of the reference's `get_df`
+(/root/reference/preprocess.py:191-266) with the same pipeline order and
+filter semantics, but vectorized end-to-end: the reference's per-trace Python
+`for` loop over `df.groupby("traceid")` (preprocess.py:110-137) — its single
+largest preprocessing hot spot — becomes groupby-transform masks.
+
+Pipeline order (must match the reference exactly, because factorization codes
+depend on it):
+
+1. concat shards, drop duplicates, sort by timestamp   (preprocess.py:203-213)
+2. factorize traceid, then interface                   (preprocess.py:216-217)
+3. entry detection + entryid assignment + trace filter (preprocess.py:218)
+4. factorize entryid, rpcid, rpctype                   (preprocess.py:219-221)
+5. resource table: concat, groupby (ts, ms), 4 aggs    (preprocess.py:227-242)
+6. resource-coverage filter (>= 0.6)                   (preprocess.py:245)
+7. entry-occurrence filter (> 100)                     (preprocess.py:246)
+8. shared ms2int over um ∪ dm ∪ msname                 (preprocess.py:248-254)
+9. endTimestamp = timestamp + |rt|                     (preprocess.py:263)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Sequence
+
+import numpy as np
+import pandas as pd
+
+from pertgnn_tpu.config import IngestConfig
+from pertgnn_tpu.ingest.schema import RESOURCE_COLUMNS
+
+log = logging.getLogger(__name__)
+
+
+def factorize_columns(df: pd.DataFrame, cols: Sequence[str]):
+    """Jointly map the values of `cols` to dense ints starting at 0.
+
+    Equivalent of the reference's `map_consecutive_ids`
+    (/root/reference/preprocess.py:80-96): values are stacked across the
+    columns and factorized together, so the same value in different columns
+    gets the same code. Returns (df, uniques) with codes ordered by first
+    appearance (pandas factorize semantics).
+    """
+    stacked = df[list(cols)].stack()
+    codes, uniques = stacked.factorize()
+    recoded = pd.Series(codes, index=stacked.index).unstack()
+    out = df.copy()
+    for c in cols:
+        out[c] = recoded[c]
+    return out, uniques
+
+
+def detect_entries(df: pd.DataFrame, cfg: IngestConfig = IngestConfig()):
+    """Find each trace's entry row and drop traces without exactly one.
+
+    Semantics of /root/reference/preprocess.py:99-149, vectorized:
+    a candidate row has rpctype == "http", the trace-minimal timestamp and
+    the trace-maximal |rt| (preprocess.py:111-115). Traces with multiple
+    candidates fall back to candidates with um == "(?)" (preprocess.py:121);
+    anything other than exactly one surviving candidate drops the trace.
+    The entry id is the string `dm + "_" + interface` (preprocess.py:135).
+
+    Returns (filtered df with an `entryid` column, stats dict).
+    """
+    g = df.groupby("traceid")
+    is_cand = (
+        (df["rpctype"] == cfg.entry_rpctype)
+        & (df["timestamp"] == g["timestamp"].transform("min"))
+        & (df["rt"].abs() == df["rt"].abs().groupby(df["traceid"]).transform("max"))
+    )
+    cand = df[is_cand]
+    n_cand = cand.groupby("traceid").size()
+    all_traces = df["traceid"].unique()
+
+    # exactly one candidate -> take it
+    unique_traces = n_cand[n_cand == 1].index
+    # multiple candidates -> keep only um == "(?)" rows, need exactly one
+    multi_traces = n_cand[n_cand > 1].index
+    tiebreak = cand[cand["traceid"].isin(multi_traces)
+                    & (cand["um"] == cfg.entry_tiebreak_um)]
+    n_tie = tiebreak.groupby("traceid").size()
+    tie_ok = n_tie[n_tie == 1].index
+
+    keep_first = cand[cand["traceid"].isin(unique_traces)]
+    keep_tie = tiebreak[tiebreak["traceid"].isin(tie_ok)]
+    entries = pd.concat([keep_first, keep_tie])
+
+    entry_str = entries["dm"].astype(str) + "_" + entries["interface"].astype(str)
+    tr2entry = pd.Series(entry_str.values, index=entries["traceid"].values)
+
+    out = df[df["traceid"].isin(tr2entry.index)].copy()
+    out["entryid"] = out["traceid"].map(tr2entry)
+    stats = {
+        "num_traces": len(all_traces),
+        "num_without_entry": int(len(all_traces) - len(n_cand)),
+        "num_ambiguous_entry": int(len(multi_traces) - len(tie_ok)),
+        "num_kept": int(tr2entry.size),
+    }
+    log.info("entry detection: %s", stats)
+    return out, stats
+
+
+def build_resource_table(resources: pd.DataFrame,
+                         cfg: IngestConfig = IngestConfig()) -> pd.DataFrame:
+    """(timestamp, msname) -> 8 aggregate usage features.
+
+    Reference: /root/reference/preprocess.py:227-242 — groupby
+    (timestamp, msname) over [cpu, mem] with aggs [max, min, mean, median],
+    columns flattened to `<col>_<agg>`.
+    """
+    r = resources.loc[:, list(RESOURCE_COLUMNS)]
+    agg = r.groupby(["timestamp", "msname"]).agg(list(cfg.resource_aggs))
+    agg.columns = ["_".join(c) for c in agg.columns]
+    return agg.reset_index()
+
+
+def filter_by_resource_coverage(df: pd.DataFrame, resource_df: pd.DataFrame,
+                                cfg: IngestConfig = IngestConfig()):
+    """Keep traces where >= `min_resource_coverage` of the distinct
+    microservices (union of um and dm) appear in the resource table.
+
+    Reference: /root/reference/preprocess.py:155-177 (threshold 0.6,
+    comparison is `>=`, preprocess.py:170).
+    """
+    ms_with_res = set(resource_df["msname"].values)
+    long = pd.concat([
+        df[["traceid", "um"]].rename(columns={"um": "ms"}),
+        df[["traceid", "dm"]].rename(columns={"dm": "ms"}),
+    ]).drop_duplicates()
+    long["covered"] = long["ms"].isin(ms_with_res)
+    coverage = long.groupby("traceid")["covered"].mean()
+    keep = coverage[coverage >= cfg.min_resource_coverage].index
+    return df[df["traceid"].isin(keep)]
+
+
+def filter_by_entry_occurrence(df: pd.DataFrame,
+                               cfg: IngestConfig = IngestConfig()):
+    """Keep traces whose entry occurs in strictly more than
+    `min_traces_per_entry` traces (/root/reference/preprocess.py:180-188)."""
+    occ = df.groupby("entryid")["traceid"].nunique()
+    keep = occ[occ > cfg.min_traces_per_entry].index
+    return df[df["entryid"].isin(keep)]
+
+
+@dataclasses.dataclass
+class PreprocessResult:
+    spans: pd.DataFrame        # factorized columns + endTimestamp
+    resources: pd.DataFrame    # msname (int), timestamp, 8 feature columns
+    # factorization vocabularies (code -> original value)
+    traceid_vocab: np.ndarray
+    interface_vocab: np.ndarray
+    entryid_vocab: np.ndarray
+    rpctype_vocab: np.ndarray
+    ms_vocab: np.ndarray
+    stats: dict
+
+
+def preprocess(spans: pd.DataFrame, resources: pd.DataFrame,
+               cfg: IngestConfig = IngestConfig()) -> PreprocessResult:
+    """Full L0→L2 pipeline on in-memory raw-domain frames."""
+    df = spans.drop_duplicates()
+    df = df.sort_values(by=["timestamp"], kind="stable")
+
+    df, traceid_vocab = factorize_columns(df, ["traceid"])
+    df, interface_vocab = factorize_columns(df, ["interface"])
+    df, entry_stats = detect_entries(df, cfg)
+    df, entryid_vocab = factorize_columns(df, ["entryid"])
+    df, _ = factorize_columns(df, ["rpcid"])
+    df, rpctype_vocab = factorize_columns(df, ["rpctype"])
+
+    resource_df = build_resource_table(resources, cfg)
+    df = filter_by_resource_coverage(df, resource_df, cfg)
+    df = filter_by_entry_occurrence(df, cfg)
+
+    # shared microservice vocabulary over um ∪ dm ∪ msname
+    # (/root/reference/preprocess.py:248-254). The reference builds it from a
+    # Python set — i.e. unordered; we sort for determinism, which only
+    # permutes opaque ids.
+    ms_vocab = np.sort(np.array(list(
+        set(df["um"].values) | set(df["dm"].values)
+        | set(resource_df["msname"].values))))
+    ms2int = {ms: i for i, ms in enumerate(ms_vocab)}
+    df["um"] = df["um"].map(ms2int)
+    df["dm"] = df["dm"].map(ms2int)
+    resource_df["msname"] = resource_df["msname"].map(ms2int).astype(np.int64)
+
+    df["endTimestamp"] = df["timestamp"] + df["rt"].abs()
+
+    stats = dict(entry_stats)
+    stats["num_traces_final"] = int(df["traceid"].nunique())
+    stats["num_entries_final"] = int(df["entryid"].nunique())
+    return PreprocessResult(
+        spans=df.reset_index(drop=True),
+        resources=resource_df,
+        traceid_vocab=np.asarray(traceid_vocab),
+        interface_vocab=np.asarray(interface_vocab),
+        entryid_vocab=np.asarray(entryid_vocab),
+        rpctype_vocab=np.asarray(rpctype_vocab),
+        ms_vocab=ms_vocab,
+        stats=stats,
+    )

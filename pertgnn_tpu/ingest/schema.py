@@ -1,0 +1,44 @@
+"""Canonical schemas for raw trace data.
+
+Span rows mirror the Alibaba-2021 MSCallGraph CSV columns the reference
+consumes (/root/reference/preprocess.py:296-298 shows the post-processed
+frame; raw columns before factorization are the same names in string domain):
+
+- traceid   : request id shared by all spans of one distributed request
+- timestamp : call start time (ms)
+- rpcid     : hierarchical call id, unique per span within a trace
+- um        : upstream (calling) microservice name
+- rpctype   : rpc kind ("http", "rpc", "mc", "db", "mq", ...)
+- dm        : downstream (called) microservice name
+- interface : called interface/endpoint id
+- rt        : response time (ms); may be negative in the raw trace — the
+              reference takes abs() everywhere (preprocess.py:114, 263, 291)
+
+Resource rows mirror MSResource (/root/reference/preprocess.py:228-233):
+
+- timestamp, msname, instance_cpu_usage, instance_memory_usage
+"""
+
+SPAN_COLUMNS = (
+    "traceid",
+    "timestamp",
+    "rpcid",
+    "um",
+    "rpctype",
+    "dm",
+    "interface",
+    "rt",
+)
+
+RESOURCE_COLUMNS = (
+    "timestamp",
+    "msname",
+    "instance_cpu_usage",
+    "instance_memory_usage",
+)
+
+# Number of numeric node features: 2 usage columns x 4 aggregations
+# (reference: preprocess.py:237-240), plus one missing-indicator column
+# appended at featurization time (pert_gnn.py:44-52).
+NUM_RESOURCE_FEATURES = 8
+NUM_NODE_FEATURES = NUM_RESOURCE_FEATURES + 1

@@ -1,0 +1,203 @@
+"""Synthetic microservice trace generator.
+
+Produces raw-domain span and resource DataFrames with the same statistical
+shape as the Alibaba-2021 MSCallGraph/MSResource CSVs the reference consumes
+(/root/reference/preprocess.py:203-236), so the FULL ingest path — entry
+detection, filters, factorization, runtime-pattern dedup, graph construction —
+is exercised without the 200 GB download (BASELINE configs 1 and 5).
+
+Generated structure:
+
+- A pool of named microservices.
+- E entry endpoints; each entry owns K call-graph topologies ("runtime
+  patterns") sampled as random trees, with a fixed categorical probability
+  over patterns.
+- Each trace instantiates one pattern: an entry span (um="(?)",
+  rpctype="http", maximal |rt|, minimal timestamp — matching the detection
+  heuristic at /root/reference/preprocess.py:111-123) plus one span per edge.
+  Per-pattern timestamp offsets are fixed so every trace of a pattern yields
+  the same `um_dm_interface` corpus string and therefore the same runtime id
+  after factorization (/root/reference/preprocess.py:280-293).
+- A resource table sampled for every (30 s bucket, microservice) pair that
+  traces touch, minus a configurable fraction of microservices left without
+  resources to exercise the missing-feature path and the coverage filter.
+- Trace latency y = entry |rt| is generated as
+  base(pattern) + beta * cpu(bucket) + noise, so models have real signal to
+  fit (used by the loss-decreases e2e test).
+
+Everything is deterministic given `seed`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pandas as pd
+
+from pertgnn_tpu.ingest.schema import SPAN_COLUMNS, RESOURCE_COLUMNS
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticSpec:
+    num_microservices: int = 40
+    num_entries: int = 4
+    patterns_per_entry: int = 3
+    # Nodes per pattern tree drawn uniformly from this range (inclusive).
+    pattern_size_range: tuple[int, int] = (3, 8)
+    traces_per_entry: int = 60
+    num_interfaces: int = 12
+    # Fraction of microservices with NO resource rows at all.
+    missing_resource_frac: float = 0.15
+    # Probability a non-entry span's raw rt is negated (the raw trace contains
+    # negative rt; the reference abs()es everywhere).
+    negative_rt_prob: float = 0.1
+    # Wall-clock span of trace start times (ms).
+    time_span_ms: int = 10 * 60 * 1000
+    ts_bucket_ms: int = 30_000
+    seed: int = 0
+
+
+_RPC_TYPES = ("rpc", "db", "mc", "mq")
+
+
+def _random_tree(rng: np.random.Generator, n_nodes: int, ms_pool: np.ndarray,
+                 root_ms: str, num_interfaces: int):
+    """A random call tree: list of (um, dm, interface, rpctype, depth).
+
+    Node microservices are sampled without replacement so a pattern has no
+    self-loops and no duplicate (um, dm) pairs by construction (the messy
+    cases — self-loops, duplicate rpcids, cycles — are covered by the
+    hand-built golden tests, not the generator).
+    """
+    others = rng.choice(ms_pool[ms_pool != root_ms], size=n_nodes - 1,
+                        replace=False)
+    nodes = [root_ms] + list(others)
+    edges = []
+    for i in range(1, n_nodes):
+        parent = rng.integers(0, i)  # guarantees a DAG (tree)
+        depth = 1
+        p = parent
+        while p != 0:
+            # recompute depth by walking up
+            p = edges[p - 1][5]
+            depth += 1
+        iface = f"if_{rng.integers(0, num_interfaces)}"
+        rpctype = _RPC_TYPES[rng.integers(0, len(_RPC_TYPES))]
+        edges.append((nodes[parent], nodes[i], iface, rpctype, depth, parent))
+    return [(um, dm, iface, t, d) for um, dm, iface, t, d, _ in edges]
+
+
+@dataclasses.dataclass
+class SyntheticData:
+    spans: pd.DataFrame
+    resources: pd.DataFrame
+    spec: SyntheticSpec
+    # ground-truth pattern index per trace, for debugging/tests
+    trace_pattern: dict[str, tuple[int, int]]
+
+
+def generate(spec: SyntheticSpec = SyntheticSpec()) -> SyntheticData:
+    rng = np.random.default_rng(spec.seed)
+    ms_pool = np.array([f"ms_{i}" for i in range(spec.num_microservices)])
+
+    # --- entries and their patterns -------------------------------------
+    entry_ms = rng.choice(ms_pool, size=spec.num_entries, replace=False)
+    entries = []
+    for e in range(spec.num_entries):
+        patterns = []
+        for _ in range(spec.patterns_per_entry):
+            n = int(rng.integers(spec.pattern_size_range[0],
+                                 spec.pattern_size_range[1] + 1))
+            tree = _random_tree(rng, n, ms_pool, entry_ms[e],
+                                spec.num_interfaces)
+            # Fixed per-pattern start offsets (ms) for each span; defines a
+            # stable within-trace ordering => stable corpus string.
+            offsets = np.sort(rng.integers(1, 500, size=len(tree)))
+            base_latency = float(rng.uniform(50, 400)) * n
+            patterns.append({"tree": tree, "offsets": offsets,
+                             "base_latency": base_latency})
+        probs = rng.dirichlet(np.ones(spec.patterns_per_entry) * 2.0)
+        entries.append({"ms": entry_ms[e], "interface": f"if_entry_{e}",
+                        "patterns": patterns, "probs": probs})
+
+    # --- resource table -------------------------------------------------
+    n_missing = int(spec.missing_resource_frac * spec.num_microservices)
+    ms_without_resources = set(
+        rng.choice(ms_pool, size=n_missing, replace=False).tolist())
+    buckets = np.arange(0, spec.time_span_ms + spec.ts_bucket_ms,
+                        spec.ts_bucket_ms)
+    res_rows = []
+    # Per-ms base load + per-bucket sinusoidal drift; 3 samples per
+    # (bucket, ms) so the max/min/mean/median aggregations differ.
+    ms_base_cpu = {ms: rng.uniform(0.1, 0.8) for ms in ms_pool}
+    for ms in ms_pool:
+        if ms in ms_without_resources:
+            continue
+        phase = rng.uniform(0, 2 * np.pi)
+        for b in buckets:
+            drift = 0.15 * np.sin(2 * np.pi * b / spec.time_span_ms + phase)
+            cpu = np.clip(ms_base_cpu[ms] + drift
+                          + rng.normal(0, 0.02, size=3), 0, 1)
+            mem = np.clip(0.3 + 0.5 * cpu + rng.normal(0, 0.02, size=3), 0, 1)
+            for c, m in zip(cpu, mem):
+                res_rows.append((int(b), ms, float(c), float(m)))
+    resources = pd.DataFrame(res_rows, columns=list(RESOURCE_COLUMNS))
+
+    # --- traces ---------------------------------------------------------
+    span_rows = []
+    trace_pattern: dict[str, tuple[int, int]] = {}
+    trace_counter = 0
+    for e_idx, entry in enumerate(entries):
+        choices = rng.choice(len(entry["patterns"]),
+                             size=spec.traces_per_entry, p=entry["probs"])
+        for p_idx in choices:
+            pat = entry["patterns"][p_idx]
+            traceid = f"tr_{trace_counter:06d}"
+            trace_counter += 1
+            trace_pattern[traceid] = (e_idx, int(p_idx))
+            t0 = int(rng.integers(0, spec.time_span_ms))
+            bucket = t0 // spec.ts_bucket_ms * spec.ts_bucket_ms
+            # latency signal: pattern base + cpu load of the entry ms
+            cpu = ms_base_cpu[entry["ms"]]
+            y = pat["base_latency"] * (1.0 + 0.6 * cpu) \
+                + float(rng.normal(0, 5.0))
+            y = max(y, 10.0)
+            # entry span: um="(?)", dm=entry ms, http, min timestamp, max |rt|
+            span_rows.append((traceid, t0, "0", "(?)", "http", entry["ms"],
+                              entry["interface"], y))
+            for k, ((um, dm, iface, rtype, depth), off) in enumerate(
+                    zip(pat["tree"], pat["offsets"])):
+                # child rt strictly below the entry's so the entry keeps
+                # max |rt|; deeper calls are shorter
+                rt = y * float(rng.uniform(0.2, 0.8)) / (depth + 1)
+                if rng.random() < spec.negative_rt_prob:
+                    rt = -rt
+                span_rows.append((traceid, t0 + int(off), f"0.{k + 1}",
+                                  um, rtype, dm, iface, rt))
+    spans = pd.DataFrame(span_rows, columns=list(SPAN_COLUMNS))
+    # Raw feeds arrive time-sorted (the reference sorts by timestamp,
+    # preprocess.py:213); do the same here.
+    spans = spans.sort_values(by=["timestamp"], kind="stable")
+    spans = spans.reset_index(drop=True)
+    return SyntheticData(spans=spans, resources=resources, spec=spec,
+                         trace_pattern=trace_pattern)
+
+
+def write_csvs(data: SyntheticData, out_dir: str, shards: int = 2) -> None:
+    """Write spans/resources as sharded CSVs shaped like the raw dataset
+    layout (data/MSCallGraph/*.csv, data/MSResource/*.csv)."""
+    import os
+
+    cg_dir = os.path.join(out_dir, "MSCallGraph")
+    rs_dir = os.path.join(out_dir, "MSResource")
+    os.makedirs(cg_dir, exist_ok=True)
+    os.makedirs(rs_dir, exist_ok=True)
+    for i, part in enumerate(np.array_split(np.arange(len(data.spans)),
+                                            shards)):
+        data.spans.iloc[part].to_csv(
+            os.path.join(cg_dir, f"MSCallGraph_{i}.csv"))
+    for i, part in enumerate(np.array_split(np.arange(len(data.resources)),
+                                            shards)):
+        data.resources.iloc[part].to_csv(
+            os.path.join(rs_dir, f"MSResource_{i}.csv"), index=False)

@@ -1,0 +1,42 @@
+"""Test harness: force an 8-fake-device CPU platform BEFORE jax import.
+
+Multi-chip sharding logic is tested on a virtual CPU mesh
+(SURVEY.md §4 "Distributed"); the real-TPU path is exercised by bench.py and
+the driver's dryrun.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+from pertgnn_tpu.config import Config, IngestConfig, DataConfig
+from pertgnn_tpu.ingest import synthetic
+
+
+@pytest.fixture(scope="session")
+def synth():
+    """A small synthetic dataset shared across the session."""
+    return synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=30, num_entries=3, patterns_per_entry=3,
+        traces_per_entry=40, seed=7))
+
+
+@pytest.fixture(scope="session")
+def small_config():
+    return Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=200, batch_size=16),
+    )
+
+
+@pytest.fixture(scope="session")
+def preprocessed(synth, small_config):
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    return preprocess(synth.spans, synth.resources, small_config.ingest)

@@ -1,0 +1,123 @@
+"""Batching-layer tests: mixtures, featurization, fixed-shape packing."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pertgnn_tpu.batching.dataset import build_dataset, split_indices
+from pertgnn_tpu.batching.featurize import ResourceLookup
+from pertgnn_tpu.batching.mixture import build_mixtures
+from pertgnn_tpu.graphs.construct import build_runtime_graphs
+from pertgnn_tpu.ingest.assemble import assemble
+
+
+def test_split_indices_positional():
+    parts = split_indices(10, (0.6, 0.2, 0.2))
+    assert [len(p) for p in parts] == [6, 2, 2]
+    assert parts[0][0] == 0 and parts[2][-1] == 9
+    # rounding remainder goes to the last split (reference trailing slice)
+    parts = split_indices(11, (0.6, 0.2, 0.2))
+    assert [len(p) for p in parts] == [6, 2, 3]
+
+
+def test_resource_lookup_conventions():
+    res = pd.DataFrame({
+        "timestamp": [0, 0], "msname": [1, 2],
+        **{f"f{i}": [float(i), float(i) * 10] for i in range(8)},
+    })
+    res.columns = ["timestamp", "msname"] + [f"f{i}" for i in range(8)]
+    lk = ResourceLookup(res, missing_indicator_is_one=True)
+    x = lk(np.array([0, 0, 30000]), np.array([1, 3, 1]))
+    assert x.shape == (3, 9)
+    assert x[0, -1] == 0.0 and x[0, 0] == 0.0 and x[0, 7] == 7.0
+    assert x[1, -1] == 1.0 and (x[1, :-1] == 0).all()   # ms missing
+    assert x[2, -1] == 1.0                              # bucket missing
+    lk2 = ResourceLookup(res, missing_indicator_is_one=False)
+    x2 = lk2(np.array([0, 0]), np.array([1, 3]))
+    assert x2[0, -1] == 1.0 and x2[1, -1] == 0.0
+
+
+class TestMixtures:
+    @pytest.fixture(scope="class")
+    def mixtures(self, preprocessed):
+        table = assemble(preprocessed)
+        graphs = build_runtime_graphs(preprocessed, table, "span")
+        return build_mixtures(graphs, table.entry2runtimes), table, graphs
+
+    def test_block_diag_layout(self, mixtures):
+        mixes, table, graphs = mixtures
+        for entry, (rt_ids, probs) in table.entry2runtimes.items():
+            m = mixes[entry]
+            assert m.num_nodes == sum(graphs[int(r)].num_nodes for r in rt_ids)
+            assert m.num_edges == sum(graphs[int(r)].num_edges for r in rt_ids)
+            # edges stay within their pattern's node block
+            sizes = np.array([graphs[int(r)].num_nodes for r in rt_ids])
+            bounds = np.concatenate([[0], np.cumsum(sizes)])
+            blk_s = np.searchsorted(bounds, m.senders, side="right") - 1
+            blk_r = np.searchsorted(bounds, m.receivers, side="right") - 1
+            assert (blk_s == blk_r).all()
+
+    def test_per_node_prob_weighting_sums_to_one(self, mixtures):
+        """sum over nodes of prob/size == sum over patterns of prob == 1 —
+        the invariant behind the model's prob-weighted pooling
+        (/root/reference/model.py:106-107)."""
+        mixes, _, _ = mixtures
+        for m in mixes.values():
+            total = (m.pattern_prob / m.pattern_size).sum()
+            assert total == pytest.approx(1.0, rel=1e-5)
+
+
+class TestPacking:
+    @pytest.fixture(scope="class")
+    def ds(self, preprocessed, small_config):
+        return build_dataset(preprocessed, small_config)
+
+    def test_fixed_shapes(self, ds):
+        shapes = set()
+        for b in ds.batches("train"):
+            shapes.add(tuple(np.shape(v) for v in b))
+        assert len(shapes) == 1  # one static shape -> one compile
+
+    def test_masks_consistent(self, ds):
+        for b in ds.batches("train"):
+            n_valid = int(b.node_mask.sum())
+            e_valid = int(b.edge_mask.sum())
+            g_valid = int(b.graph_mask.sum())
+            assert g_valid > 0
+            # pad nodes map to the reserved pad graph slot
+            assert (b.node_graph[~b.node_mask] == b.num_graphs - 1).all()
+            assert not b.graph_mask[-1]  # pad slot never a real graph
+            # valid edges point at valid nodes
+            assert b.node_mask[b.senders[b.edge_mask]].all()
+            assert b.node_mask[b.receivers[b.edge_mask]].all()
+            # per valid graph, mixture weights sum to 1
+            w = np.zeros(b.num_graphs)
+            np.add.at(w, b.node_graph[b.node_mask],
+                      (b.pattern_prob / b.pattern_size)[b.node_mask])
+            np.testing.assert_allclose(w[b.graph_mask], 1.0, rtol=1e-4)
+
+    def test_features_match_lookup(self, ds):
+        b = next(ds.batches("valid"))
+        # recompute one graph's features directly
+        g0_nodes = (b.node_graph == 0) & b.node_mask
+        entry = int(b.entry_id[0])
+        mix = ds.mixtures[entry]
+        np.testing.assert_array_equal(b.ms_id[g0_nodes], mix.ms_id)
+
+    def test_epoch_covers_all_examples(self, ds):
+        total = sum(int(b.graph_mask.sum()) for b in ds.batches("train"))
+        assert total == len(ds.splits["train"])
+
+    def test_shuffle_changes_order_not_content(self, ds):
+        a = [b.y[b.graph_mask] for b in ds.batches("train", shuffle=True,
+                                                   seed=1)]
+        c = [b.y[b.graph_mask] for b in ds.batches("train")]
+        sa = np.sort(np.concatenate(a))
+        sc = np.sort(np.concatenate(c))
+        np.testing.assert_allclose(sa, sc)
+
+
+def test_num_batches_matches_iteration(preprocessed, small_config):
+    ds = build_dataset(preprocessed, small_config)
+    for split in ("train", "valid", "test"):
+        assert ds.num_batches(split) == sum(1 for _ in ds.batches(split))

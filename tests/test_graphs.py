@@ -1,0 +1,198 @@
+"""Graph-construction golden tests.
+
+Hand-derived expectations pin the reference's semantics: sanitizer order
+(misc.py:87-105), span compaction (misc.py:190-219), and the PERT 2k+1 stage
+expansion + event-ordered edges (misc.py:221-302). The hand expansion for the
+golden trace is worked through in comments.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pertgnn_tpu.graphs.construct import (
+    build_pert_graph,
+    build_span_graph,
+    find_root,
+    min_depth_from_root,
+    sanitize_edges,
+)
+
+
+def _trace(rows):
+    df = pd.DataFrame(
+        rows, columns=["timestamp", "rpcid", "um", "rpctype", "dm",
+                       "interface", "rt"])
+    df["endTimestamp"] = df["timestamp"] + df["rt"].abs()
+    return df
+
+
+@pytest.fixture
+def golden():
+    # root=100 calls 1; 1 calls 2 and 3; 3 calls 4. One negative rt.
+    return _trace([
+        (0, 0, 100, 0, 1, 5, 100),
+        (1, 1, 1, 1, 2, 6, 50),
+        (2, 2, 1, 1, 3, 7, -30),
+        (3, 3, 3, 2, 4, 8, 10),
+    ])
+
+
+class TestSanitizer:
+    def test_self_loop_removed(self):
+        df = _trace([(0, 0, 9, 0, 1, 0, 100), (1, 1, 1, 0, 1, 0, 10)])
+        out = sanitize_edges(df, find_root(df))
+        assert len(out) == 1
+
+    def test_duplicate_rpcid_keeps_first(self):
+        df = _trace([(0, 0, 9, 0, 1, 0, 100), (1, 7, 1, 0, 2, 1, 10),
+                     (2, 7, 1, 0, 3, 2, 10)])
+        out = sanitize_edges(df, find_root(df))
+        assert set(out["dm"]) == {1, 2}
+
+    def test_edge_into_root_removed(self):
+        df = _trace([(0, 0, 9, 0, 1, 0, 100), (1, 1, 1, 0, 9, 1, 10)])
+        out = sanitize_edges(df, find_root(df))
+        assert (out["dm"] != 9).all()
+
+    def test_umdm_dedup_keeps_last(self):
+        df = _trace([(0, 0, 9, 0, 1, 0, 100), (1, 1, 1, 0, 2, 5, 10),
+                     (2, 2, 1, 0, 2, 6, 20)])
+        out = sanitize_edges(df, find_root(df))
+        dup = out[(out.um == 1) & (out.dm == 2)]
+        assert len(dup) == 1
+        assert dup["interface"].iloc[0] == 6  # keep="last" (misc.py:97)
+
+    def test_reverse_pair_keeps_first(self):
+        df = _trace([(0, 0, 9, 0, 1, 0, 100), (1, 1, 1, 0, 2, 5, 10),
+                     (2, 2, 2, 0, 1, 6, 20)])
+        out = sanitize_edges(df, find_root(df))
+        pair = out[(out.um.isin([1, 2])) & (out.dm.isin([1, 2]))]
+        assert len(pair) == 1
+        assert pair["um"].iloc[0] == 1  # first of the unordered pair kept
+
+
+def test_root_detection_uses_abs_rt(golden):
+    assert find_root(golden) == 100
+    # negative but largest-|rt| row wins
+    df = _trace([(0, 0, 7, 0, 1, 0, -500), (1, 1, 1, 0, 2, 1, 100)])
+    assert find_root(df) == 7
+
+
+def test_min_depth_bfs_handles_unreachable_and_deep():
+    # chain 0->1->2, node 3 unreachable -> depth 0 (reference: inf -> 0)
+    d = min_depth_from_root(4, np.array([0, 1]), np.array([1, 2]), 0)
+    assert d.tolist() == [0, 1, 2, 0]
+    # 10k-node chain must not blow the stack (reference's recursive DFS would)
+    n = 10_000
+    d = min_depth_from_root(n, np.arange(n - 1), np.arange(1, n), 0)
+    assert d[-1] == n - 1
+
+
+class TestSpanGolden:
+    def test_structure(self, golden):
+        g = build_span_graph(golden)
+        # unique ms sorted: [1,2,3,4,100] -> 1:0 2:1 3:2 4:3 100:4
+        assert g.ms_id.tolist() == [1, 2, 3, 4, 100]
+        assert g.senders.tolist() == [4, 0, 0, 2]
+        assert g.receivers.tolist() == [0, 1, 2, 3]
+        assert g.edge_attr[:, 0].tolist() == [5, 6, 7, 8]   # interface
+        assert g.edge_attr[:, 1].tolist() == [0, 1, 1, 2]   # rpctype
+        # depths from root(100): 100=0, 1=1, 2=2, 3=2, 4=3, normalized by 3
+        np.testing.assert_allclose(
+            g.node_depth, np.array([1, 2, 2, 3, 0]) / 3.0, rtol=1e-6)
+
+
+class TestPertGolden:
+    def test_structure(self, golden):
+        g = build_pert_graph(golden)
+        # caller order by count desc, first-appearance ties:
+        # um counts: 100->1, 1->2, 3->1  =>  [1(x2), 100, 3]
+        # stages: 1 -> [0..4], 100 -> [5,6,7], 3 -> [8,9,10]
+        # leaves {2,4} -> 2->11, 4->12
+        assert g.num_nodes == 13
+        assert g.ms_id.tolist() == [1] * 5 + [100] * 3 + [3] * 3 + [2, 4]
+
+        edges = set(zip(g.senders.tolist(), g.receivers.tolist()))
+        # intra-ms chains
+        for chain in ([0, 1, 2, 3, 4], [5, 6, 7], [8, 9, 10]):
+            for a, b in zip(chain, chain[1:]):
+                assert (a, b) in edges
+        # caller 1 events sorted by time:
+        # (1,start,2) (2,start,3) (32,end,3) (51,end,2)
+        assert (0, 11) in edges    # 1 calls 2 at slot 0
+        assert (1, 8) in edges     # 1 calls 3 at slot 1
+        assert (10, 3) in edges    # 3 returns into slot 3
+        assert (11, 4) in edges    # 2 returns into slot 4
+        # caller 3: call 4 then return
+        assert (8, 12) in edges
+        assert (12, 10) in edges
+        # caller 100: call 1 (event i=0), return (event i=1 -> slot 2)
+        assert (5, 0) in edges
+        assert (4, 7) in edges
+
+        # edge attrs: intra-ms edges are [0,0,1,1]
+        attr = {(s, r): a for s, r, a in
+                zip(g.senders.tolist(), g.receivers.tolist(),
+                    g.edge_attr.tolist())}
+        assert attr[(0, 1)] == [0, 0, 1, 1]
+        assert attr[(0, 11)] == [6, 1, 1, 0]   # call edge carries iface/type
+        assert attr[(11, 4)] == [0, 0, 0, 0]   # return edge zeroed features
+        # total edges: intra 4+2+2=8, inter 2 per span * 4 spans = 8
+        assert g.num_edges == 16
+
+    def test_depth_root_is_first_stage_of_root(self, golden):
+        g = build_pert_graph(golden)
+        # root nid = stages[100][0] = 5 -> depth 0 -> normalized 0
+        assert g.node_depth[5] == 0.0
+        assert g.node_depth.max() == 1.0
+
+
+def test_span_pert_consistency_on_synthetic(preprocessed):
+    """Every runtime pattern builds valid span and PERT graphs."""
+    from pertgnn_tpu.graphs.construct import build_runtime_graphs
+    from pertgnn_tpu.ingest.assemble import assemble
+
+    table = assemble(preprocessed)
+    spans = build_runtime_graphs(preprocessed, table, "span")
+    perts = build_runtime_graphs(preprocessed, table, "pert")
+    assert set(spans) == set(perts) == set(table.runtime2trace)
+    for rid, g in spans.items():
+        assert g.senders.max(initial=-1) < g.num_nodes
+        assert g.receivers.max(initial=-1) < g.num_nodes
+        p = perts[rid]
+        # PERT expansion is strictly larger than the span graph
+        assert p.num_nodes >= g.num_nodes
+        # PERT graphs are DAGs: BFS from root reaches nodes with finite depth;
+        # verify acyclicity via topological sort
+        indeg = np.zeros(p.num_nodes, dtype=int)
+        np.add.at(indeg, p.receivers, 1)
+        adj = [[] for _ in range(p.num_nodes)]
+        for s, r in zip(p.senders, p.receivers):
+            adj[s].append(r)
+        stack = [i for i in range(p.num_nodes) if indeg[i] == 0]
+        seen = 0
+        while stack:
+            v = stack.pop()
+            seen += 1
+            for w in adj[v]:
+                indeg[w] -= 1
+                if indeg[w] == 0:
+                    stack.append(w)
+        assert seen == p.num_nodes, f"cycle in PERT graph {rid}"
+
+
+def test_root_sanitized_away_degrades_gracefully():
+    """Duplicate rpcid on the entry row can drop every row mentioning the
+    root; the reference KeyErrors (misc.py:204/311) — we emit zero depths."""
+    # root row (max |rt|, min ts) shares an rpcid with an earlier row, so
+    # rpcid dedup (keep="first") drops it and the root vanishes from the graph
+    df = _trace([
+        (0, 5, 1, 1, 2, 6, 50),
+        (0, 5, 100, 0, 1, 5, 100),   # max |rt| & min ts -> root=100, dropped
+        (1, 6, 1, 1, 3, 7, 20),
+    ])
+    g = build_span_graph(df)
+    assert (g.node_depth == 0).all()
+    p = build_pert_graph(df)
+    assert (p.node_depth == 0).all()

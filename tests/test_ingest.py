@@ -1,0 +1,180 @@
+"""Ingest-layer tests: entry detection, filters, factorization, assembly.
+
+Oracle: hand-built micro-frames pinning the reference's order-sensitive
+pandas behaviors (/root/reference/preprocess.py:99-188), plus ground-truth
+pattern labels from the synthetic generator.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pertgnn_tpu.config import IngestConfig
+from pertgnn_tpu.ingest.assemble import assemble
+from pertgnn_tpu.ingest.preprocess import (
+    build_resource_table,
+    detect_entries,
+    factorize_columns,
+    filter_by_entry_occurrence,
+    filter_by_resource_coverage,
+    preprocess,
+)
+
+
+def _spans(rows):
+    return pd.DataFrame(
+        rows,
+        columns=["traceid", "timestamp", "rpcid", "um", "rpctype", "dm",
+                 "interface", "rt"],
+    )
+
+
+class TestDetectEntries:
+    def test_single_candidate(self):
+        df = _spans([
+            ("t1", 0, "0", "(?)", "http", "A", "if1", 100),
+            ("t1", 1, "0.1", "A", "rpc", "B", "if2", 50),
+        ])
+        out, stats = detect_entries(df)
+        assert set(out["traceid"]) == {"t1"}
+        assert (out["entryid"] == "A_if1").all()
+
+    def test_no_candidate_dropped(self):
+        # no http row at all
+        df = _spans([
+            ("t1", 0, "0", "(?)", "rpc", "A", "if1", 100),
+        ])
+        out, stats = detect_entries(df)
+        assert len(out) == 0
+        assert stats["num_without_entry"] == 1
+
+    def test_http_not_at_min_timestamp_dropped(self):
+        df = _spans([
+            ("t1", 0, "0", "(?)", "rpc", "A", "if1", 100),
+            ("t1", 5, "0.1", "A", "http", "B", "if2", 50),
+        ])
+        out, _ = detect_entries(df)
+        assert len(out) == 0
+
+    def test_tiebreak_on_um(self):
+        # two candidates at min ts and max |rt|; only one has um == "(?)"
+        df = _spans([
+            ("t1", 0, "0", "(?)", "http", "A", "if1", 100),
+            ("t1", 0, "0x", "Z", "http", "A", "if9", -100),
+            ("t1", 1, "0.1", "A", "rpc", "B", "if2", 50),
+        ])
+        out, _ = detect_entries(df)
+        assert set(out["traceid"]) == {"t1"}
+        assert (out["entryid"] == "A_if1").all()
+
+    def test_ambiguous_dropped(self):
+        df = _spans([
+            ("t1", 0, "0", "(?)", "http", "A", "if1", 100),
+            ("t1", 0, "0x", "(?)", "http", "B", "if9", -100),
+        ])
+        out, stats = detect_entries(df)
+        assert len(out) == 0
+        assert stats["num_ambiguous_entry"] == 1
+
+    def test_negative_rt_counts_as_max(self):
+        # |rt| semantics: -200 beats 100 (preprocess.py:114)
+        df = _spans([
+            ("t1", 0, "0", "(?)", "http", "A", "if1", -200),
+            ("t1", 0, "0b", "A", "http", "B", "if2", 100),
+            ("t1", 1, "0.1", "A", "rpc", "B", "if2", 50),
+        ])
+        out, _ = detect_entries(df)
+        assert (out["entryid"] == "A_if1").all()
+
+
+class TestFilters:
+    def test_resource_coverage(self):
+        df = _spans([
+            # t1: ms {X, A, B} — 2/3 covered >= 0.6 -> keep
+            ("t1", 0, "0", "X", "http", "A", "if1", 10),
+            ("t1", 1, "1", "A", "rpc", "B", "if2", 5),
+            # t2: ms {X, C} — 0/2 covered -> drop
+            ("t2", 0, "0", "X", "http", "C", "if1", 10),
+        ])
+        res = pd.DataFrame({"msname": ["A", "B"]})
+        out = filter_by_resource_coverage(df, res)
+        assert set(out["traceid"]) == {"t1"}
+
+    def test_entry_occurrence_strictly_greater(self):
+        rows = []
+        for i in range(5):
+            rows.append((f"t{i}", 0, "0", "(?)", "http", "A", "if1", 10))
+        rows.append(("u0", 0, "0", "(?)", "http", "B", "if2", 10))
+        df = _spans(rows)
+        df["entryid"] = np.where(df["dm"] == "A", "A_if1", "B_if2")
+        out = filter_by_entry_occurrence(df, IngestConfig(min_traces_per_entry=4))
+        assert set(out["entryid"]) == {"A_if1"}  # 5 > 4; 1 <= 4 dropped
+        out2 = filter_by_entry_occurrence(df, IngestConfig(min_traces_per_entry=5))
+        assert len(out2) == 0  # strict >
+
+
+def test_factorize_matches_pandas_semantics():
+    df = pd.DataFrame({"a": ["x", "y", "x", "z"]})
+    out, uniques = factorize_columns(df, ["a"])
+    assert out["a"].tolist() == [0, 1, 0, 2]
+    assert list(uniques) == ["x", "y", "z"]
+
+
+def test_resource_table_eight_features():
+    res = pd.DataFrame({
+        "timestamp": [0, 0, 0, 30_000],
+        "msname": ["A", "A", "B", "A"],
+        "instance_cpu_usage": [0.1, 0.3, 0.5, 0.7],
+        "instance_memory_usage": [0.2, 0.4, 0.6, 0.8],
+    })
+    table = build_resource_table(res)
+    feat_cols = [c for c in table.columns if c not in ("timestamp", "msname")]
+    assert len(feat_cols) == 8
+    row = table[(table.timestamp == 0) & (table.msname == "A")].iloc[0]
+    assert row["instance_cpu_usage_max"] == pytest.approx(0.3)
+    assert row["instance_cpu_usage_min"] == pytest.approx(0.1)
+    assert row["instance_cpu_usage_mean"] == pytest.approx(0.2)
+    assert row["instance_memory_usage_median"] == pytest.approx(0.3)
+
+
+class TestEndToEnd:
+    def test_preprocess_synthetic(self, synth, preprocessed):
+        pre = preprocessed
+        # all factorized columns dense ints from 0
+        for col in ("traceid", "um", "dm", "interface", "rpcid", "rpctype",
+                    "entryid"):
+            vals = pre.spans[col].to_numpy()
+            assert np.issubdtype(np.asarray(vals).dtype, np.integer), col
+        assert pre.stats["num_traces_final"] > 0
+        assert (pre.spans["endTimestamp"]
+                >= pre.spans["timestamp"]).all()
+
+    def test_runtime_ids_match_ground_truth(self, synth, preprocessed):
+        """Traces generated from the same pattern must share a runtime id."""
+        table = assemble(preprocessed)
+        tr_vocab = preprocessed.traceid_vocab
+        meta = table.meta.set_index("traceid")
+        seen = {}
+        for tr_code, row in meta.iterrows():
+            raw = tr_vocab[tr_code]
+            truth = synth.trace_pattern[raw]
+            rid = row["runtime_id"]
+            if truth in seen:
+                assert seen[truth] == rid, f"pattern {truth} split ids"
+            else:
+                seen[truth] = rid
+
+    def test_labels_are_entry_latency(self, synth, preprocessed):
+        table = assemble(preprocessed)
+        tr_vocab = preprocessed.traceid_vocab
+        raw_spans = synth.spans
+        for _, row in table.meta.head(20).iterrows():
+            raw_id = tr_vocab[int(row["traceid"])]
+            expect = raw_spans[raw_spans.traceid == raw_id]["rt"].abs().max()
+            assert row["y"] == pytest.approx(expect)
+
+    def test_mixture_probs_sum_to_one(self, preprocessed):
+        table = assemble(preprocessed)
+        for entry, (rts, probs) in table.entry2runtimes.items():
+            assert probs.sum() == pytest.approx(1.0)
+            assert len(rts) == len(set(rts.tolist()))
