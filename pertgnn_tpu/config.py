@@ -79,6 +79,9 @@ class ModelConfig:
     # (BASELINE config 4 uses 8).
     num_heads: int = 1
     dropout: float = 0.0
+    # Dropout on attention weights inside the conv (PyG TransformerConv's
+    # `dropout` arg; the reference leaves it 0, model.py:26-31).
+    attn_dropout: float = 0.0
     # --- capability switches for paths the reference computes but never uses
     # (SURVEY.md §2.3 "declared-but-dead"); all default to reference-live
     # behavior.
@@ -110,6 +113,11 @@ class TrainConfig:
     lr: float = 3e-4
     # Pinball-loss quantile level (reference: pert_gnn.py:24-28).
     tau: float = 0.5
+    # Labels are divided by this inside the loss (the head learns in scaled
+    # space); metrics are always reported in raw label units. The reference
+    # regresses raw millisecond latencies (pert_gnn.py:245), which is a big
+    # part of why it needs 100 epochs — 1.0 keeps that behavior.
+    label_scale: float = 1.0
     epochs: int = 100
     # Steps between metric log lines.
     log_every: int = 50

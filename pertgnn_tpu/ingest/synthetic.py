@@ -22,8 +22,13 @@ Generated structure:
   traces touch, minus a configurable fraction of microservices left without
   resources to exercise the missing-feature path and the coverage filter.
 - Trace latency y = entry |rt| is generated as
-  base(pattern) + beta * cpu(bucket) + noise, so models have real signal to
-  fit (used by the loss-decreases e2e test).
+  entry_base * pattern_multiplier * (1 + 0.8 * cpu(entry_ms, bucket)) + noise,
+  where cpu() is the same sinusoidal-drift signal written into the resource
+  table — so the resource features carry real, learnable signal (the
+  loss-decreases e2e test depends on this). The per-pattern multiplier
+  (±15%) is deliberately small: the model observes only the entry's mixture,
+  never the trace's actual pattern, so within-entry pattern variance is an
+  irreducible noise floor.
 
 Everything is deterministic given `seed`.
 """
@@ -114,30 +119,42 @@ def generate(spec: SyntheticSpec = SyntheticSpec()) -> SyntheticData:
             # Fixed per-pattern start offsets (ms) for each span; defines a
             # stable within-trace ordering => stable corpus string.
             offsets = np.sort(rng.integers(1, 500, size=len(tree)))
-            base_latency = float(rng.uniform(50, 400)) * n
+            # small fixed per-pattern multiplier: within-entry variance the
+            # model cannot resolve (it sees only the mixture) stays bounded
             patterns.append({"tree": tree, "offsets": offsets,
-                             "base_latency": base_latency})
+                             "latency_mult": float(rng.uniform(0.85, 1.15))})
         probs = rng.dirichlet(np.ones(spec.patterns_per_entry) * 2.0)
         entries.append({"ms": entry_ms[e], "interface": f"if_entry_{e}",
-                        "patterns": patterns, "probs": probs})
+                        "patterns": patterns, "probs": probs,
+                        "base_latency": float(rng.uniform(300, 2000))})
 
     # --- resource table -------------------------------------------------
+    # entry microservices always keep resources: the label's cpu term must
+    # stay observable or the e2e signal tests degrade to noise
     n_missing = int(spec.missing_resource_frac * spec.num_microservices)
+    non_entry = ms_pool[~np.isin(ms_pool, entry_ms)]
     ms_without_resources = set(
-        rng.choice(ms_pool, size=n_missing, replace=False).tolist())
+        rng.choice(non_entry, size=min(n_missing, len(non_entry)),
+                   replace=False).tolist())
     buckets = np.arange(0, spec.time_span_ms + spec.ts_bucket_ms,
                         spec.ts_bucket_ms)
     res_rows = []
     # Per-ms base load + per-bucket sinusoidal drift; 3 samples per
-    # (bucket, ms) so the max/min/mean/median aggregations differ.
+    # (bucket, ms) so the max/min/mean/median aggregations differ. The SAME
+    # cpu_at() drives the labels below, so resource features carry real,
+    # learnable signal (the loss-decreases e2e test depends on this).
     ms_base_cpu = {ms: rng.uniform(0.1, 0.8) for ms in ms_pool}
+    ms_phase = {ms: rng.uniform(0, 2 * np.pi) for ms in ms_pool}
+
+    def cpu_at(ms: str, b: int) -> float:
+        return float(ms_base_cpu[ms] + 0.15 * np.sin(
+            2 * np.pi * b / spec.time_span_ms + ms_phase[ms]))
+
     for ms in ms_pool:
         if ms in ms_without_resources:
             continue
-        phase = rng.uniform(0, 2 * np.pi)
         for b in buckets:
-            drift = 0.15 * np.sin(2 * np.pi * b / spec.time_span_ms + phase)
-            cpu = np.clip(ms_base_cpu[ms] + drift
+            cpu = np.clip(cpu_at(ms, int(b))
                           + rng.normal(0, 0.02, size=3), 0, 1)
             mem = np.clip(0.3 + 0.5 * cpu + rng.normal(0, 0.02, size=3), 0, 1)
             for c, m in zip(cpu, mem):
@@ -158,10 +175,11 @@ def generate(spec: SyntheticSpec = SyntheticSpec()) -> SyntheticData:
             trace_pattern[traceid] = (e_idx, int(p_idx))
             t0 = int(rng.integers(0, spec.time_span_ms))
             bucket = t0 // spec.ts_bucket_ms * spec.ts_bucket_ms
-            # latency signal: pattern base + cpu load of the entry ms
-            cpu = ms_base_cpu[entry["ms"]]
-            y = pat["base_latency"] * (1.0 + 0.6 * cpu) \
-                + float(rng.normal(0, 5.0))
+            # latency signal: entry base * pattern multiplier, scaled by the
+            # OBSERVABLE time-varying cpu load of the entry microservice
+            cpu = cpu_at(entry["ms"], bucket)
+            y = (entry["base_latency"] * pat["latency_mult"]
+                 * (1.0 + 0.8 * cpu) + float(rng.normal(0, 5.0)))
             y = max(y, 10.0)
             # entry span: um="(?)", dm=entry ms, http, min timestamp, max |rt|
             span_rows.append((traceid, t0, "0", "(?)", "http", entry["ms"],

@@ -1,0 +1,2 @@
+from pertgnn_tpu.models.layers import GraphTransformerLayer, MaskedBatchNorm
+from pertgnn_tpu.models.pert_model import PertGNN, make_model

@@ -1,0 +1,100 @@
+"""The PERT-GNN latency-regression model (flax.linen).
+
+Architecture parity with the reference's `SAGEDeterministic`
+(/root/reference/model.py:10-114 — the name is vestigial; it is a graph
+transformer, SURVEY.md §2.3):
+
+- inputs: numeric node features ++ summed categorical (microservice)
+  embeddings (model.py:87-90); edge features = interface-embedding ++
+  rpctype-embedding (model.py:91-97);
+- `max(2, num_layers)` conv layers with `max(1, num_layers-1)` BatchNorms —
+  the reference's exact (and quirky) stack arithmetic (model.py:24-52):
+  every conv but the last is followed by BN → ReLU → dropout (model.py:99-103),
+  the last conv is bare (model.py:104);
+- per-node local head (model.py:53, 105) — computed and returned; its loss
+  weight is a config option (the reference never trains on it,
+  pert_gnn.py:245);
+- global head: prob-weighted mixture pooling, concat entry embedding,
+  2-layer MLP → scalar (model.py:106-112); optional non-negativity clamp
+  (the unimplemented comment at model.py:113).
+
+TPU-first details: all GEMMs via flax Dense on the MXU (optionally bf16
+activations), attention via masked segment ops, BatchNorm masked for
+padding, everything shape-static under jit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pertgnn_tpu.config import ModelConfig
+from pertgnn_tpu.models.layers import GraphTransformerLayer, MaskedBatchNorm
+from pertgnn_tpu.ops.segment import segment_mean_by_graph
+
+
+class PertGNN(nn.Module):
+    cfg: ModelConfig
+    num_ms: int
+    num_entries: int
+    num_interfaces: int
+    num_rpctypes: int
+
+    @nn.compact
+    def __call__(self, batch, *, training: bool = False):
+        cfg = self.cfg
+        hidden = cfg.hidden_channels
+        dtype = jnp.bfloat16 if cfg.bf16_activations else jnp.float32
+        num_graphs = batch.entry_id.shape[0]
+
+        embed = lambda n, num: nn.Embed(
+            num, hidden, name=n, dtype=dtype,
+            embedding_init=nn.initializers.normal(1.0))
+        ms_emb = embed("ms_embed", self.num_ms)(batch.ms_id)
+        x = jnp.concatenate([batch.x.astype(dtype), ms_emb], axis=1)
+        edge_embeds = jnp.concatenate([
+            embed("interface_embed", self.num_interfaces)(batch.edge_iface),
+            embed("rpctype_embed", self.num_rpctypes)(batch.edge_rpctype),
+        ], axis=1)
+
+        conv_kwargs = dict(out_channels=hidden, heads=cfg.num_heads,
+                           dtype=dtype, attn_dropout=cfg.attn_dropout,
+                           use_pallas=cfg.use_pallas_attention)
+        num_convs = max(2, cfg.num_layers)
+        for i in range(num_convs - 1):
+            x = GraphTransformerLayer(name=f"conv_{i}", **conv_kwargs)(
+                x, edge_embeds, batch.senders, batch.receivers,
+                batch.edge_mask, training=training)
+            x = MaskedBatchNorm(name=f"bn_{i}", dtype=dtype)(
+                x, batch.node_mask, training=training)
+            x = nn.relu(x)
+            if cfg.dropout > 0.0:
+                x = nn.Dropout(rate=cfg.dropout,
+                               deterministic=not training)(x)
+        x = GraphTransformerLayer(name=f"conv_{num_convs - 1}",
+                                  **conv_kwargs)(
+            x, edge_embeds, batch.senders, batch.receivers,
+            batch.edge_mask, training=training)
+
+        local_pred = nn.Dense(1, name="local_head", dtype=dtype)(x)[:, 0]
+
+        # mixture pooling: zero pad nodes explicitly so they cannot leak
+        weights = jnp.where(batch.node_mask,
+                            batch.pattern_prob / batch.pattern_size, 0.0)
+        pooled = segment_mean_by_graph(x, batch.node_graph,
+                                       weights.astype(dtype), num_graphs)
+        entry_emb = embed("entry_embed", self.num_entries)(batch.entry_id)
+        g = jnp.concatenate([pooled, entry_emb], axis=1)
+        g = nn.relu(nn.Dense(hidden, name="global_head1", dtype=dtype)(g))
+        global_pred = nn.Dense(1, name="global_head2", dtype=dtype)(g)[:, 0]
+        if cfg.nonnegative_pred:
+            # softplus, not relu: a relu clamp kills the gradient whenever
+            # the raw prediction is negative (dead at init)
+            global_pred = nn.softplus(global_pred)
+        return global_pred.astype(jnp.float32), local_pred.astype(jnp.float32)
+
+
+def make_model(cfg: ModelConfig, num_ms: int, num_entries: int,
+               num_interfaces: int, num_rpctypes: int) -> PertGNN:
+    return PertGNN(cfg=cfg, num_ms=num_ms, num_entries=num_entries,
+                   num_interfaces=num_interfaces, num_rpctypes=num_rpctypes)

@@ -1,0 +1,16 @@
+"""Pallas fused edge-attention kernel (extension point).
+
+The default conv hot path is gather → score → segment softmax → segment sum
+(pertgnn_tpu/models/layers.py), which XLA already fuses well; this module
+will hold the hand-fused Pallas TPU kernel that does the whole edge pass in
+one HBM round-trip (dense-degree formulation: receiver-sorted incidence
+padded to the batch max in-degree, node-blocked in VMEM).
+"""
+
+from __future__ import annotations
+
+
+def edge_attention(q_e, k_e, v_e, senders, receivers, edge_mask, num_nodes):
+    raise NotImplementedError(
+        "the Pallas fused edge-attention kernel is not implemented yet; "
+        "run with ModelConfig(use_pallas_attention=False)")

@@ -1,0 +1,68 @@
+"""XLA segment ops — the TPU replacement for PyG's CUDA scatter kernels.
+
+The reference's hot device ops are the scatter/segment kernels behind PyG
+message passing and pooling (/root/reference/model.py:100-107): per-edge
+gather → per-destination softmax → scatter-add, and `global_add_pool`. On
+TPU these become `jax.ops.segment_sum` / `segment_max`, which XLA lowers to
+sorted-segment reductions that fuse with the surrounding elementwise work
+(SURVEY.md §2.2).
+
+All ops here are padding-aware: masked lanes cannot influence real outputs
+(enforced by tests/test_model.py padding-invariance tests).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_sum(data: jax.Array, segment_ids: jax.Array,
+                num_segments: int) -> jax.Array:
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_max(data: jax.Array, segment_ids: jax.Array,
+                num_segments: int) -> jax.Array:
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+
+
+def segment_softmax(scores: jax.Array, segment_ids: jax.Array,
+                    num_segments: int,
+                    mask: jax.Array | None = None) -> jax.Array:
+    """Numerically-stable softmax over segments (e.g. per-destination-node
+    over incoming edges) — the core of TransformerConv attention
+    (/root/reference/model.py:100-104; PyG `softmax(alpha, index)`).
+
+    `scores`: (E,) or (E, H). `segment_ids`: (E,) destination ids.
+    `mask`: (E,) bool; masked lanes get zero weight. Segments with no valid
+    lanes produce zeros (an isolated node receives no messages — matching
+    PyG, where a destination with no incoming edges just never appears in
+    the scatter).
+    """
+    neg = jnp.asarray(-jnp.inf, dtype=scores.dtype)
+    if mask is not None:
+        m = mask if scores.ndim == 1 else mask[:, None]
+        scores = jnp.where(m, scores, neg)
+    seg_max = segment_max(scores, segment_ids, num_segments)
+    # empty segments have -inf max; clamp so the gather below stays finite
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    shifted = scores - seg_max[segment_ids]
+    expd = jnp.exp(shifted)
+    if mask is not None:
+        m = mask if scores.ndim == 1 else mask[:, None]
+        expd = jnp.where(m, expd, 0.0)
+    denom = segment_sum(expd, segment_ids, num_segments)
+    denom = jnp.where(denom > 0, denom, 1.0)
+    return expd / denom[segment_ids]
+
+
+def segment_mean_by_graph(node_values: jax.Array, node_graph: jax.Array,
+                          weights: jax.Array, num_graphs: int) -> jax.Array:
+    """Probability-weighted pooling: sum over nodes of value * weight per
+    graph. With weight = pattern_prob / pattern_size this reproduces the
+    reference's `x * pattern_probs / pattern_num_nodes` + `global_add_pool`
+    (/root/reference/model.py:106-107) = the probability-weighted expected
+    mean node embedding over the entry's topology mixture (SURVEY.md §2.3)."""
+    weighted = node_values * weights[:, None]
+    return segment_sum(weighted, node_graph, num_graphs)

@@ -1,0 +1,9 @@
+from pertgnn_tpu.train.metrics import quantile_loss, masked_metric_sums
+from pertgnn_tpu.train.loop import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_eval_step,
+    fit,
+    evaluate,
+)

@@ -1,0 +1,195 @@
+"""jit'd training loop: one compiled step, optax Adam, masked metrics.
+
+Replaces the reference's per-batch Python driver
+(/root/reference/pert_gnn.py:213-294): forward + loss + backward + Adam land
+in ONE jit'd function per (train/eval) — everything the reference did on the
+host per batch (probability rebuilds, metric float() syncs) is gone: mixture
+probs travel inside the packed batch, and metrics leave the device as summed
+scalars once per log interval.
+
+The loss is the pinball loss of the global head over valid graphs
+(pert_gnn.py:245); the per-node local head gets an optional auxiliary pinball
+term (weight `local_loss_weight`) against its graph's label — the reference
+computes local_pred but never trains on it (SURVEY.md §2.3), so 0 keeps
+parity.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from pertgnn_tpu.batching.dataset import Dataset
+from pertgnn_tpu.batching.pack import PackedBatch
+from pertgnn_tpu.config import Config
+from pertgnn_tpu.models.pert_model import PertGNN, make_model
+from pertgnn_tpu.train.metrics import masked_metric_sums, quantile_loss
+
+log = logging.getLogger(__name__)
+
+
+@struct.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def create_train_state(model: PertGNN, tx: optax.GradientTransformation,
+                       sample: PackedBatch, seed: int = 0) -> TrainState:
+    variables = model.init(jax.random.PRNGKey(seed),
+                           jax.tree.map(jnp.asarray, sample), training=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(params=params, batch_stats=batch_stats,
+                      opt_state=tx.init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def _loss_fn(model: PertGNN, cfg: Config, params, batch_stats, batch,
+             dropout_rng):
+    variables = {"params": params, "batch_stats": batch_stats}
+    rngs = {"dropout": dropout_rng} if cfg.model.dropout > 0 else {}
+    (global_pred, local_pred), updates = model.apply(
+        variables, batch, training=True, mutable=["batch_stats"], rngs=rngs)
+    scale = cfg.train.label_scale
+    y_scaled = batch.y / scale
+    loss = quantile_loss(y_scaled, global_pred, cfg.train.tau,
+                         mask=batch.graph_mask)
+    if cfg.model.local_loss_weight > 0:
+        y_per_node = y_scaled[batch.node_graph]
+        loss = loss + cfg.model.local_loss_weight * quantile_loss(
+            y_per_node, local_pred, cfg.train.tau, mask=batch.node_mask)
+    metrics = masked_metric_sums(batch.y, global_pred * scale, cfg.train.tau,
+                                 batch.graph_mask)
+    return loss, (updates["batch_stats"], metrics)
+
+
+def make_train_step(model: PertGNN, cfg: Config,
+                    tx: optax.GradientTransformation) -> Callable:
+    def step(state: TrainState, batch: PackedBatch):
+        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed),
+                                 state.step)
+        grad_fn = jax.value_and_grad(
+            lambda p: _loss_fn(model, cfg, p, state.batch_stats, batch, rng),
+            has_aux=True)
+        (_, (new_stats, metrics)), grads = grad_fn(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return state.replace(params=new_params, batch_stats=new_stats,
+                             opt_state=new_opt, step=state.step + 1), metrics
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def make_eval_step(model: PertGNN, cfg: Config) -> Callable:
+    def step(state: TrainState, batch: PackedBatch):
+        (global_pred, _) = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch, training=False)
+        return masked_metric_sums(batch.y,
+                                  global_pred * cfg.train.label_scale,
+                                  cfg.train.tau, batch.graph_mask)
+
+    return jax.jit(step)
+
+
+def _device_iter(batches: Iterator[PackedBatch]) -> Iterator[PackedBatch]:
+    """Single-step prefetch: device-put the next batch while the current one
+    computes (the reference's `data.to(device)` is a blocking copy per batch,
+    pert_gnn.py:231)."""
+    pending = None
+    for b in batches:
+        nxt = jax.tree.map(jnp.asarray, b)
+        if pending is not None:
+            yield pending
+        pending = nxt
+    if pending is not None:
+        yield pending
+
+
+def evaluate(eval_step: Callable, state: TrainState,
+             batches: Iterator[PackedBatch]) -> dict[str, float]:
+    sums = None
+    for batch in _device_iter(batches):
+        m = eval_step(state, batch)
+        sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+    if sums is None:
+        return {"mae": float("nan"), "mape": float("nan"),
+                "qloss": float("nan"), "count": 0.0}
+    sums = jax.tree.map(float, sums)
+    n = max(sums["count"], 1.0)
+    return {"mae": sums["mae_sum"] / n, "mape": sums["mape_sum"] / n,
+            "qloss": sums["qloss_sum"] / n, "count": sums["count"]}
+
+
+def fit(dataset: Dataset, cfg: Config,
+        epochs: int | None = None,
+        checkpoint_manager=None,
+        profile_hook: Callable[[int, dict], None] | None = None,
+        ) -> tuple[TrainState, list[dict]]:
+    """Epoch driver: train on `train`, evaluate `valid`+`test` per epoch
+    (pert_gnn.py:344-350). Returns (final state, per-epoch history)."""
+    model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
+                       dataset.num_interfaces, dataset.num_rpctypes)
+    tx = optax.adam(cfg.train.lr)
+    sample = next(dataset.batches("train"))
+    state = create_train_state(model, tx, sample, cfg.train.seed)
+    train_step = make_train_step(model, cfg, tx)
+    eval_step = make_eval_step(model, cfg)
+
+    start_epoch = 0
+    if checkpoint_manager is not None:
+        state, start_epoch = checkpoint_manager.maybe_restore(state)
+
+    history: list[dict] = []
+    epochs = cfg.train.epochs if epochs is None else epochs
+    for epoch in range(start_epoch, epochs):
+        t0 = time.perf_counter()
+        sums = None
+        n_batches = 0
+        for batch in _device_iter(
+                dataset.batches("train", shuffle=True,
+                                seed=cfg.data.shuffle_seed + epoch)):
+            state, m = train_step(state, batch)
+            sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+            n_batches += 1
+        sums = jax.tree.map(float, sums)
+        n = max(sums["count"], 1.0)
+        train_time = time.perf_counter() - t0
+
+        valid = evaluate(eval_step, state, dataset.batches("valid"))
+        test = evaluate(eval_step, state, dataset.batches("test"))
+        row = {
+            "epoch": epoch,
+            "train_qloss": sums["qloss_sum"] / n,
+            "train_mae": sums["mae_sum"] / n,
+            "train_mape": sums["mape_sum"] / n,
+            "valid_mae": valid["mae"], "valid_mape": valid["mape"],
+            "valid_qloss": valid["qloss"],
+            "test_mae": test["mae"], "test_mape": test["mape"],
+            "test_qloss": test["qloss"],
+            "train_time_s": train_time,
+            "graphs_per_s": sums["count"] / max(train_time, 1e-9),
+        }
+        history.append(row)
+        log.info(
+            "epoch %d: train qloss %.4f mae %.4f | valid mae %.4f mape %.4f "
+            "| test mae %.4f mape %.4f qloss %.4f | %.1f graphs/s",
+            epoch, row["train_qloss"], row["train_mae"], row["valid_mae"],
+            row["valid_mape"], row["test_mae"], row["test_mape"],
+            row["test_qloss"], row["graphs_per_s"])
+        if profile_hook is not None:
+            profile_hook(epoch, row)
+        if checkpoint_manager is not None:
+            checkpoint_manager.save(epoch, state, row)
+    if checkpoint_manager is not None:
+        checkpoint_manager.wait()
+    return state, history

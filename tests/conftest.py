@@ -7,11 +7,18 @@ the driver's dryrun.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Override unconditionally: the live session presets JAX_PLATFORMS=axon (the
+# one-chip TPU tunnel) and the axon plugin wins over the env var — the config
+# update below is what actually forces CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

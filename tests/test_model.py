@@ -1,0 +1,260 @@
+"""Model numerics tests.
+
+Oracles:
+- a dense numpy re-implementation of TransformerConv attention (explicit
+  per-destination softmax loops) checks GraphTransformerLayer;
+- torch.nn.BatchNorm1d (CPU) checks MaskedBatchNorm on the valid rows;
+- padding invariance: enlarging the pad region of a batch must not change
+  any real output (SURVEY.md §4 "Numerics").
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pertgnn_tpu.batching.pack import PackedBatch
+from pertgnn_tpu.config import ModelConfig
+from pertgnn_tpu.models.layers import GraphTransformerLayer, MaskedBatchNorm
+from pertgnn_tpu.models.pert_model import make_model
+
+
+def numpy_transformer_conv(params, x, edge_feat, senders, receivers, heads):
+    """Dense oracle for PyG TransformerConv semantics (model.py:99-104)."""
+    def lin(name, v):
+        p = params[name]
+        out = v @ np.asarray(p["kernel"])
+        if "bias" in p:
+            out = out + np.asarray(p["bias"])
+        return out
+
+    N = x.shape[0]
+    HC = params["query"]["kernel"].shape[1]
+    C = HC // heads
+    q = lin("query", x).reshape(N, heads, C)
+    k = lin("key", x).reshape(N, heads, C)
+    v = lin("value", x).reshape(N, heads, C)
+    e = lin("edge", edge_feat).reshape(len(senders), heads, C)
+    out = np.zeros((N, heads, C))
+    for i in range(N):
+        inc = [j for j, r in enumerate(receivers) if r == i]
+        if not inc:
+            continue
+        for h in range(heads):
+            scores = np.array([
+                np.dot(q[i, h], k[senders[j], h] + e[j, h]) / np.sqrt(C)
+                for j in inc])
+            a = np.exp(scores - scores.max())
+            a = a / a.sum()
+            out[i, h] = sum(
+                a[t] * (v[senders[j], h] + e[j, h])
+                for t, j in enumerate(inc))
+    return out.reshape(N, HC) + lin("skip", x)
+
+
+@pytest.mark.parametrize("heads", [1, 4])
+def test_layer_matches_dense_oracle(heads):
+    rng = np.random.default_rng(0)
+    N, E, F, FE, H = 7, 12, 5, 6, heads
+    x = rng.normal(size=(N, F)).astype(np.float32)
+    ef = rng.normal(size=(E, FE)).astype(np.float32)
+    senders = rng.integers(0, N, E)
+    receivers = rng.integers(0, N - 1, E)  # node N-1 has no incoming edges
+    mask = np.ones(E, dtype=bool)
+
+    layer = GraphTransformerLayer(out_channels=8, heads=H)
+    params = layer.init(jax.random.PRNGKey(0), x, ef,
+                        jnp.array(senders), jnp.array(receivers),
+                        jnp.array(mask))
+    got = layer.apply(params, x, ef, jnp.array(senders),
+                      jnp.array(receivers), jnp.array(mask))
+    want = numpy_transformer_conv(
+        jax.tree.map(np.asarray, params["params"]), x, ef, senders,
+        receivers, H)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+def test_isolated_node_gets_skip_only():
+    """A destination with no incoming edges = skip projection only (PyG:
+    never appears in the scatter)."""
+    x = np.ones((3, 4), dtype=np.float32)
+    ef = np.ones((1, 4), dtype=np.float32)
+    senders, receivers = jnp.array([0]), jnp.array([1])
+    mask = jnp.array([True])
+    layer = GraphTransformerLayer(out_channels=4)
+    params = layer.init(jax.random.PRNGKey(1), x, ef, senders, receivers, mask)
+    out = layer.apply(params, x, ef, senders, receivers, mask)
+    p = jax.tree.map(np.asarray, params["params"])
+    skip = x @ p["skip"]["kernel"] + p["skip"]["bias"]
+    np.testing.assert_allclose(np.asarray(out)[2], skip[2], rtol=1e-5)
+
+
+class TestMaskedBatchNorm:
+    def test_matches_torch_on_valid_rows(self):
+        import torch
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(2.0, 3.0, size=(10, 6)).astype(np.float32)
+        mask = np.array([True] * 7 + [False] * 3)
+
+        bn = MaskedBatchNorm()
+        vars_ = bn.init(jax.random.PRNGKey(0), x, jnp.array(mask),
+                        training=True)
+        out, updates = bn.apply(vars_, x, jnp.array(mask), training=True,
+                                mutable=["batch_stats"])
+
+        tbn = torch.nn.BatchNorm1d(6)
+        tout = tbn(torch.tensor(x[:7])).detach().numpy()
+        np.testing.assert_allclose(np.asarray(out)[:7], tout, rtol=1e-3,
+                                   atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(updates["batch_stats"]["mean"]),
+            tbn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(updates["batch_stats"]["var"]),
+            tbn.running_var.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_eval_uses_running_stats(self):
+        x = np.ones((4, 2), dtype=np.float32)
+        mask = jnp.ones(4, dtype=bool)
+        bn = MaskedBatchNorm()
+        vars_ = bn.init(jax.random.PRNGKey(0), x, mask, training=True)
+        out = bn.apply(vars_, x, mask, training=False)
+        # fresh stats: mean 0, var 1 -> output ~ x
+        np.testing.assert_allclose(np.asarray(out), x, rtol=1e-4)
+
+
+def _tiny_batch(num_graphs=3, n=20, e=24, f=9, pad_nodes=0, pad_edges=0,
+                seed=0):
+    """A hand-rolled PackedBatch with `pad_*` extra padding lanes."""
+    rng = np.random.default_rng(seed)
+    G = num_graphs + 1
+    N, E = n + pad_nodes, e + pad_edges
+    node_graph = np.full(N, G - 1, dtype=np.int32)
+    node_graph[:n] = np.sort(rng.integers(0, num_graphs, n))
+    node_mask = np.zeros(N, dtype=bool)
+    node_mask[:n] = True
+    senders = np.zeros(E, dtype=np.int32)
+    receivers = np.zeros(E, dtype=np.int32)
+    # real edges stay within a graph
+    for j in range(e):
+        g = rng.integers(0, num_graphs)
+        nodes = np.where((node_graph == g) & node_mask)[0]
+        senders[j], receivers[j] = rng.choice(nodes, 2)
+    edge_mask = np.zeros(E, dtype=bool)
+    edge_mask[:e] = True
+    pattern_size = np.ones(N, dtype=np.float32)
+    counts = np.bincount(node_graph[:n], minlength=G)
+    pattern_size[:n] = counts[node_graph[:n]]
+    return PackedBatch(
+        x=np.where(node_mask[:, None], rng.normal(size=(N, f)), 0.0
+                   ).astype(np.float32),
+        ms_id=np.where(node_mask, rng.integers(0, 5, N), 0).astype(np.int32),
+        node_depth=np.zeros(N, dtype=np.float32),
+        node_graph=node_graph,
+        node_mask=node_mask,
+        pattern_prob=np.where(node_mask, 1.0, 0.0).astype(np.float32),
+        pattern_size=pattern_size,
+        senders=senders,
+        receivers=receivers,
+        edge_iface=np.where(edge_mask, rng.integers(0, 4, E), 0
+                            ).astype(np.int32),
+        edge_rpctype=np.where(edge_mask, rng.integers(0, 3, E), 0
+                              ).astype(np.int32),
+        edge_mask=edge_mask,
+        entry_id=np.arange(G, dtype=np.int32) % 4,
+        y=rng.uniform(1, 10, G).astype(np.float32),
+        graph_mask=np.array([True] * num_graphs + [False]),
+    )
+
+
+def _pad_batch(b: PackedBatch, extra_nodes: int, extra_edges: int,
+               extra_graphs: int = 0) -> PackedBatch:
+    """Append padding lanes to an existing batch."""
+    G = b.num_graphs + extra_graphs
+
+    def pad(a, k, fill=0):
+        return np.concatenate([a, np.full((k,) + a.shape[1:], fill,
+                                          dtype=a.dtype)])
+
+    return PackedBatch(
+        x=pad(b.x, extra_nodes),
+        ms_id=pad(b.ms_id, extra_nodes),
+        node_depth=pad(b.node_depth, extra_nodes),
+        node_graph=np.concatenate([
+            np.where(b.node_mask, b.node_graph, G - 1),
+            np.full(extra_nodes, G - 1, dtype=np.int32)]),
+        node_mask=pad(b.node_mask, extra_nodes),
+        pattern_prob=pad(b.pattern_prob, extra_nodes),
+        pattern_size=pad(b.pattern_size, extra_nodes, 1),
+        senders=pad(b.senders, extra_edges),
+        receivers=pad(b.receivers, extra_edges),
+        edge_iface=pad(b.edge_iface, extra_edges),
+        edge_rpctype=pad(b.edge_rpctype, extra_edges),
+        edge_mask=pad(b.edge_mask, extra_edges),
+        entry_id=pad(b.entry_id, extra_graphs),
+        y=pad(b.y, extra_graphs),
+        graph_mask=pad(b.graph_mask, extra_graphs),
+    )
+
+
+class TestPaddingInvariance:
+    @pytest.mark.parametrize("training", [False, True])
+    def test_model_output_unchanged_by_padding(self, training):
+        cfg = ModelConfig(hidden_channels=16, num_layers=3)
+        model = make_model(cfg, num_ms=5, num_entries=4, num_interfaces=4,
+                           num_rpctypes=3)
+        b = _tiny_batch()
+        big = _pad_batch(b, extra_nodes=33, extra_edges=17, extra_graphs=2)
+        jb = jax.tree.map(jnp.asarray, b)
+        jbig = jax.tree.map(jnp.asarray, big)
+        vars_ = model.init(jax.random.PRNGKey(0), jb, training=False)
+
+        kwargs = dict(training=training)
+        if training:
+            kwargs["mutable"] = ["batch_stats"]
+        out_small = model.apply(vars_, jb, **kwargs)
+        out_big = model.apply(vars_, jbig, **kwargs)
+        gp_s, lp_s = out_small[0] if training else out_small
+        gp_b, lp_b = out_big[0] if training else out_big
+
+        n_real_graphs = int(b.graph_mask.sum())
+        np.testing.assert_allclose(
+            np.asarray(gp_b)[:n_real_graphs],
+            np.asarray(gp_s)[:n_real_graphs], rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(lp_b)[b.node_mask.nonzero()[0]],
+            np.asarray(lp_s)[b.node_mask.nonzero()[0]], rtol=2e-4, atol=1e-5)
+        if training:
+            # running stats must also be padding-invariant
+            s_small = out_small[1]["batch_stats"]
+            s_big = out_big[1]["batch_stats"]
+            jax.tree.map(
+                lambda a, c: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(c), rtol=2e-4, atol=1e-5),
+                s_small, s_big)
+
+
+def test_model_reference_stack_arithmetic():
+    """num_layers=1 still builds 2 convs + 1 bn (model.py:24-52)."""
+    cfg = ModelConfig(hidden_channels=8, num_layers=1)
+    model = make_model(cfg, 5, 4, 4, 3)
+    b = jax.tree.map(jnp.asarray, _tiny_batch())
+    vars_ = model.init(jax.random.PRNGKey(0), b, training=False)
+    names = set(vars_["params"].keys())
+    assert {"conv_0", "conv_1"} <= names
+    assert "conv_2" not in names
+    assert "bn_0" in names and "bn_1" not in names
+    gp, lp = model.apply(vars_, b, training=False)
+    assert gp.shape == (b.entry_id.shape[0],)
+    assert lp.shape == (b.x.shape[0],)
+    assert np.isfinite(np.asarray(gp)).all()
+
+
+def test_nonnegative_option():
+    cfg = ModelConfig(hidden_channels=8, nonnegative_pred=True)
+    model = make_model(cfg, 5, 4, 4, 3)
+    b = jax.tree.map(jnp.asarray, _tiny_batch(seed=5))
+    vars_ = model.init(jax.random.PRNGKey(2), b, training=False)
+    gp, _ = model.apply(vars_, b, training=False)
+    assert (np.asarray(gp) >= 0).all()

@@ -1,0 +1,81 @@
+"""End-to-end training tests on synthetic data (SURVEY.md §4 Integration).
+
+The synthetic generator builds real signal into the labels
+(entry_base * pattern_mult * (1 + 0.8*cpu(entry_ms, bucket)) + noise), so a
+working model must reduce the loss substantially within a few epochs.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import Config, DataConfig, IngestConfig, ModelConfig, TrainConfig
+from pertgnn_tpu.train.loop import fit, evaluate, make_eval_step
+
+
+@pytest.fixture(scope="module", params=["span", "pert"])
+def trained(request, preprocessed):
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=200, batch_size=16),
+        model=ModelConfig(hidden_channels=16, num_layers=2),
+        train=TrainConfig(lr=1e-2, epochs=15, label_scale=1000.0),
+        graph_type=request.param,
+    )
+    ds = build_dataset(preprocessed, cfg)
+    state, history = fit(ds, cfg)
+    return ds, cfg, state, history
+
+
+class TestTraining:
+    def test_loss_decreases(self, trained):
+        _, _, _, history = trained
+        first, last = history[0], history[-1]
+        assert last["train_qloss"] < 0.5 * first["train_qloss"], (
+            f"train qloss {first['train_qloss']} -> {last['train_qloss']}")
+
+    def test_metrics_finite(self, trained):
+        _, _, _, history = trained
+        for row in history:
+            for k, v in row.items():
+                assert np.isfinite(v), (k, v)
+
+    def test_eval_counts_match_split_sizes(self, trained):
+        ds, cfg, state, _ = trained
+        from pertgnn_tpu.models.pert_model import make_model
+        model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                           ds.num_interfaces, ds.num_rpctypes)
+        es = make_eval_step(model, cfg)
+        for split in ("valid", "test"):
+            m = evaluate(es, state, ds.batches(split))
+            assert m["count"] == len(ds.splits[split])
+
+    def test_predictions_track_labels(self, trained):
+        """The model must FIT seen data well (train MAPE).
+
+        Generalization to the test split is structurally weak here by design:
+        the reference's positional entry-grouped split (pert_gnn.py:196-210)
+        puts mostly-unseen entries in the tail splits, and with 3 synthetic
+        entries that is degenerate — unseen entry embeddings are random."""
+        _, _, _, history = trained
+        train_mape = history[-1]["train_mape"]
+        assert train_mape < 0.3, f"train MAPE {train_mape}"
+
+
+def test_eval_deterministic(preprocessed):
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=120, batch_size=8),
+        model=ModelConfig(hidden_channels=8),
+        train=TrainConfig(epochs=1),
+    )
+    ds = build_dataset(preprocessed, cfg)
+    state, _ = fit(ds, cfg)
+    from pertgnn_tpu.models.pert_model import make_model
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    es = make_eval_step(model, cfg)
+    a = evaluate(es, state, ds.batches("valid"))
+    b = evaluate(es, state, ds.batches("valid"))
+    assert a == b
