@@ -1,0 +1,192 @@
+"""Benchmark: call-graphs/sec/chip on the flagship training step.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "graphs/s", "vs_baseline": N}
+
+The baseline is MEASURED here, not looked up (the reference publishes no
+numbers — BASELINE.md): a faithful torch-CPU re-implementation of the
+reference's training step (PyG TransformerConv semantics via torch scatter
+ops, BatchNorm1d, Adam, pinball loss) runs on the same packed batches on this
+host — i.e. what the reference stack would do on the available non-TPU
+hardware. vs_baseline = our graphs/s divided by torch's graphs/s.
+
+Configuration mirrors the reference defaults (hidden 32, batch 170,
+pert graphs; pert_gnn.py:15-33) on a synthetic workload sized to keep the
+bench under a few minutes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def build_workload():
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import Config, DataConfig, IngestConfig, ModelConfig, TrainConfig
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(max_traces=100_000, batch_size=170),
+        model=ModelConfig(hidden_channels=32, num_layers=3),
+        train=TrainConfig(lr=3e-4, label_scale=1000.0),
+        graph_type="pert",
+    )
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=60, num_entries=8, patterns_per_entry=4,
+        traces_per_entry=400, seed=42))
+    pre = preprocess(data.spans, data.resources, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+    return ds, cfg
+
+
+def bench_jax(ds, cfg, steps: int = 200) -> float:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import create_train_state, make_train_step
+
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    tx = optax.adam(cfg.train.lr)
+    host_batches = list(ds.batches("train"))[:8]
+    counts = [int(b.graph_mask.sum()) for b in host_batches]
+    batches = [jax.tree.map(jnp.asarray, b) for b in host_batches]
+    state = create_train_state(model, tx, batches[0], cfg.train.seed)
+    step = make_train_step(model, cfg, tx)
+
+    state, m = step(state, batches[0])  # compile
+    jax.block_until_ready(m["qloss_sum"])
+
+    graphs = 0
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = step(state, batches[i % len(batches)])
+        graphs += counts[i % len(batches)]
+    jax.block_until_ready(m["qloss_sum"])  # single sync at the end
+    dt = time.perf_counter() - t0
+    return graphs / dt
+
+
+def bench_torch_baseline(ds, cfg, steps: int = 6) -> float:
+    """The reference's computation in torch on CPU, same batches."""
+    import torch
+
+    hidden = cfg.model.hidden_channels
+    heads = 1
+    batches = list(ds.batches("train"))[:4]
+    f_in = batches[0].x.shape[1]
+
+    class Conv(torch.nn.Module):
+        def __init__(self, in_ch):
+            super().__init__()
+            self.q = torch.nn.Linear(in_ch, hidden)
+            self.k = torch.nn.Linear(in_ch, hidden)
+            self.v = torch.nn.Linear(in_ch, hidden)
+            self.e = torch.nn.Linear(2 * hidden, hidden, bias=False)
+            self.skip = torch.nn.Linear(in_ch, hidden)
+
+        def forward(self, x, ee, snd, rcv):
+            n = x.shape[0]
+            q = self.q(x)[rcv]
+            ke = self.k(x)[snd] + self.e(ee)
+            ve = self.v(x)[snd] + self.e(ee)
+            s = (q * ke).sum(-1) / np.sqrt(hidden)
+            smax = torch.full((n,), -torch.inf).scatter_reduce(
+                0, rcv, s, reduce="amax")
+            ex = torch.exp(s - smax.clamp_min(0.0)[rcv])
+            den = torch.zeros(n).index_add(0, rcv, ex)
+            alpha = ex / den.clamp_min(1e-16)[rcv]
+            out = torch.zeros(n, hidden).index_add(0, rcv,
+                                                   ve * alpha[:, None])
+            return out + self.skip(x)
+
+    class Model(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.ms = torch.nn.Embedding(ds.num_ms, hidden)
+            self.iface = torch.nn.Embedding(ds.num_interfaces, hidden)
+            self.rpc = torch.nn.Embedding(ds.num_rpctypes, hidden)
+            self.entry = torch.nn.Embedding(ds.num_entries, hidden)
+            n_convs = max(2, cfg.model.num_layers)
+            chans = [f_in + hidden] + [hidden] * (n_convs - 1)
+            self.convs = torch.nn.ModuleList(Conv(c) for c in chans)
+            self.bns = torch.nn.ModuleList(
+                torch.nn.BatchNorm1d(hidden) for _ in range(n_convs - 1))
+            self.g1 = torch.nn.Linear(2 * hidden, hidden)
+            self.g2 = torch.nn.Linear(hidden, 1)
+
+        def forward(self, b):
+            x = torch.cat([b["x"], self.ms(b["ms_id"])], 1)
+            ee = torch.cat([self.iface(b["edge_iface"]),
+                            self.rpc(b["edge_rpctype"])], 1)
+            for i, conv in enumerate(self.convs[:-1]):
+                x = torch.relu(self.bns[i](
+                    conv(x, ee, b["senders"], b["receivers"])))
+            x = self.convs[-1](x, ee, b["senders"], b["receivers"])
+            w = (b["pattern_prob"] / b["pattern_size"])[:, None]
+            g = b["node_graph"]
+            pooled = torch.zeros(b["entry_id"].shape[0],
+                                 hidden).index_add(0, g, x * w)
+            gp = self.g2(torch.relu(self.g1(
+                torch.cat([pooled, self.entry(b["entry_id"])], 1))))
+            return gp[:, 0]
+
+    def to_torch(b):
+        d = {}
+        for f in b._fields:
+            a = np.asarray(getattr(b, f))
+            if a.dtype == np.int32:
+                d[f] = torch.tensor(a, dtype=torch.long)
+            elif a.dtype == np.bool_:
+                d[f] = torch.tensor(a)
+            else:
+                d[f] = torch.tensor(a, dtype=torch.float32)
+        return d
+
+    tbatches = [to_torch(b) for b in batches]
+    model = Model()
+    opt = torch.optim.Adam(model.parameters(), lr=cfg.train.lr)
+    tau = cfg.train.tau
+
+    def one_step(b):
+        opt.zero_grad()
+        pred = model(b)
+        e = b["y"] / cfg.train.label_scale - pred
+        mask = b["graph_mask"].float()
+        loss = (torch.maximum(tau * e, (tau - 1) * e)
+                * mask).sum() / mask.sum()
+        loss.backward()
+        opt.step()
+        return float(mask.sum())
+
+    one_step(tbatches[0])  # warm-up
+    graphs = 0
+    t0 = time.perf_counter()
+    for i in range(steps):
+        graphs += one_step(tbatches[i % len(tbatches)])
+    dt = time.perf_counter() - t0
+    return graphs / dt
+
+
+def main():
+    ds, cfg = build_workload()
+    ours = bench_jax(ds, cfg)
+    baseline = bench_torch_baseline(ds, cfg)
+    print(json.dumps({
+        "metric": "pert_train_call_graphs_per_sec_per_chip",
+        "value": round(ours, 1),
+        "unit": "graphs/s",
+        "vs_baseline": round(ours / baseline, 2),
+        "baseline_torch_cpu_graphs_per_s": round(baseline, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

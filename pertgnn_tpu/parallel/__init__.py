@@ -1,0 +1,14 @@
+from pertgnn_tpu.parallel.mesh import (
+    make_mesh,
+    batch_shardings,
+    param_shardings,
+    state_shardings,
+)
+from pertgnn_tpu.parallel.data_parallel import (
+    stack_batches,
+    shard_batch,
+    make_sharded_train_step,
+    make_sharded_eval_step,
+    grouped_batches,
+)
+from pertgnn_tpu.parallel.graph_shard import sharded_edge_attention

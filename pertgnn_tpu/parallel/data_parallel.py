@@ -1,0 +1,118 @@
+"""Data-parallel training over a device mesh.
+
+D per-device packed batches are concatenated into ONE global batch (graph /
+node / edge ids offset so segments stay disjoint) whose arrays are sharded on
+their leading dimension over the `data` axis. The train step is the same
+single jitted program as single-chip training — the loss mean, metric sums,
+and BatchNorm statistics aggregate over the global batch, so the SPMD
+partitioner inserts the psum/all-reduce collectives over ICI itself. This
+replaces what a GPU scale-out of the reference would have done with
+DDP/NCCL (SURVEY.md §5.8; BASELINE config 3).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pertgnn_tpu.batching.pack import PackedBatch
+from pertgnn_tpu.config import Config
+from pertgnn_tpu.models.pert_model import PertGNN
+from pertgnn_tpu.parallel.mesh import batch_shardings, state_shardings
+from pertgnn_tpu.train import loop as train_loop
+
+
+def stack_batches(batches: Sequence[PackedBatch]) -> PackedBatch:
+    """Concatenate equal-shape packed batches into one global batch.
+
+    Node ids in senders/receivers and graph ids in node_graph are offset per
+    shard; pad nodes keep pointing at their shard's pad graph slot, so
+    per-shard padding stays inert in the global program.
+    """
+    n = batches[0].x.shape[0]
+    g = batches[0].num_graphs
+    for b in batches:
+        if b.x.shape[0] != n or b.num_graphs != g:
+            raise ValueError("stack_batches requires equal-shape batches")
+    out = {}
+    for field in PackedBatch._fields:
+        parts = []
+        for d, b in enumerate(batches):
+            a = getattr(b, field)
+            if field in ("senders", "receivers"):
+                a = a + d * n
+            elif field == "node_graph":
+                a = a + d * g
+            parts.append(a)
+        out[field] = np.concatenate(parts)
+    return PackedBatch(**out)
+
+
+def grouped_batches(batches: Iterator[PackedBatch],
+                    num_shards: int) -> Iterator[PackedBatch]:
+    """Group a batch stream into global batches of `num_shards` shards.
+
+    The tail is completed by repeating the last batch with its masks zeroed
+    (pure padding), so every global batch has identical shape.
+    """
+    group: list[PackedBatch] = []
+    for b in batches:
+        group.append(b)
+        if len(group) == num_shards:
+            yield stack_batches(group)
+            group = []
+    if group:
+        last = group[-1]
+        pad = last._replace(
+            node_mask=np.zeros_like(last.node_mask),
+            edge_mask=np.zeros_like(last.edge_mask),
+            graph_mask=np.zeros_like(last.graph_mask),
+        )
+        while len(group) < num_shards:
+            group.append(pad)
+        yield stack_batches(group)
+
+
+def shard_batch(batch: PackedBatch, mesh,
+                shardings: PackedBatch | None = None) -> PackedBatch:
+    """Place a host batch directly into its mesh sharding (no device-0 hop).
+
+    Pass `shardings=batch_shardings(mesh)` precomputed when calling per step.
+    """
+    if shardings is None:
+        shardings = batch_shardings(mesh)
+    return jax.tree.map(
+        jax.device_put, batch, shardings,
+        is_leaf=lambda x: isinstance(x, np.ndarray))
+
+
+def make_sharded_train_step(model: PertGNN, cfg: Config,
+                            tx: optax.GradientTransformation, mesh,
+                            state) -> Callable:
+    """The single-chip train step (train/loop.py `train_step_fn` — one source
+    of truth) jitted with mesh shardings.
+
+    Returns (step_fn, sharded_state): state placed according to the
+    tensor-parallel rule, batch expected sharded over `data`.
+    """
+    st_sh = state_shardings(state, mesh)
+    b_sh = batch_shardings(mesh)
+    # copy before placement: device_put may alias the caller's buffers, and
+    # the donated step would otherwise delete the caller's state arrays
+    state = jax.device_put(jax.tree.map(jnp.copy, state), st_sh)
+    jitted = jax.jit(train_loop.train_step_fn(model, cfg, tx),
+                     in_shardings=(st_sh, b_sh),
+                     out_shardings=(st_sh, None), donate_argnums=0)
+    return jitted, state
+
+
+def make_sharded_eval_step(model: PertGNN, cfg: Config, mesh,
+                           state) -> Callable:
+    st_sh = state_shardings(state, mesh)
+    b_sh = batch_shardings(mesh)
+    return jax.jit(train_loop.eval_step_fn(model, cfg),
+                   in_shardings=(st_sh, b_sh), out_shardings=None)

@@ -1,0 +1,59 @@
+"""Edge-sharded attention for giant graphs (the "sequence parallelism" of
+this domain).
+
+There is no token-sequence axis in a graph regressor (SURVEY.md §5.7); the
+scaling axis is graph size. For one giant DAG (BASELINE config 5: 5k-node
+synthetic microservice graphs) whose edge set exceeds a single chip's
+appetite, the edge set is sharded across the `data` axis with nodes
+replicated: each device scores its edge shard, and the per-destination
+softmax is completed with a pmax (running max) + psum (denominator,
+numerator) over ICI — a ring-attention-style exact decomposition of softmax
+aggregation, expressed with XLA collectives under shard_map.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pertgnn_tpu.ops.segment import segment_max, segment_sum
+from pertgnn_tpu.parallel.mesh import DATA_AXIS
+
+
+def sharded_edge_attention(q, k, v, e, senders, receivers, edge_mask,
+                           mesh: Mesh, axis: str = DATA_AXIS):
+    """Exact TransformerConv attention with the edge set sharded over `axis`.
+
+    q, k, v: (N, H, C) node-level projections, replicated.
+    e: (E, H, C) edge-feature projections; senders/receivers/edge_mask: (E,).
+    Edge arrays must have E divisible by the axis size. Returns (N, H*C),
+    replicated (matches the unsharded layer bit-for-bit up to reduction
+    order).
+    """
+    num_nodes, H, C = q.shape
+
+    def local(q, k, v, e, snd, rcv, msk):
+        k_e = k[snd] + e
+        v_e = v[snd] + e
+        scores = (q[rcv] * k_e).sum(-1) / jnp.sqrt(
+            jnp.asarray(C, q.dtype))                     # (E_loc, H)
+        neg = jnp.asarray(-jnp.inf, scores.dtype)
+        scores = jnp.where(msk[:, None], scores, neg)
+        m = segment_max(scores, rcv, num_nodes)          # (N, H) local max
+        m = jax.lax.pmax(m, axis)                        # global max
+        m = jnp.where(jnp.isfinite(m), m, 0.0)
+        ex = jnp.where(msk[:, None], jnp.exp(scores - m[rcv]), 0.0)
+        den = jax.lax.psum(segment_sum(ex, rcv, num_nodes), axis)
+        num = jax.lax.psum(
+            segment_sum((v_e * ex[..., None]).reshape(ex.shape[0], -1),
+                        rcv, num_nodes), axis)           # (N, H*C)
+        den = jnp.where(den > 0, den, 1.0)
+        return (num.reshape(num_nodes, H, C)
+                / den[..., None]).reshape(num_nodes, H * C)
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=P(),
+    )(q, k, v, e, senders, receivers, edge_mask)

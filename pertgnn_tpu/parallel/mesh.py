@@ -1,0 +1,95 @@
+"""Device mesh and sharding layout.
+
+The reference is single-device by construction (pert_gnn.py:36-37; no
+torch.distributed anywhere — SURVEY.md §5.8). Distribution here is designed
+the XLA way ("How to Scale Your Model" recipe): pick a mesh, annotate input
+and parameter shardings, and let the SPMD partitioner insert the collectives
+(psum over ICI for gradient/segment reductions) — NOT hand-written NCCL-style
+point-to-point.
+
+Axes:
+- ``data``  — data parallelism: the packed batch's node/edge/graph arrays are
+  sharded on their leading dimension. Because the loss and BatchNorm
+  statistics aggregate over the global batch inside ONE jitted program, XLA
+  emits the gradient all-reduce automatically.
+- ``model`` — tensor parallelism: hidden dimensions of Dense kernels and
+  embedding tables are sharded; activations follow (data, model).
+
+Pipeline and expert axes are deliberately absent: the model has no
+sequential stage structure deep enough to pipeline (max(2, L) small convs)
+and no MoE — the analogous long-context axis for GNNs is GRAPH size, served
+by edge sharding in `graph_shard.py` (SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pertgnn_tpu.batching.pack import PackedBatch
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(data: int = -1, model: int = 1,
+              devices: list | None = None) -> Mesh:
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if data == -1:
+        if n % model:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    need = data * model
+    if need > n:
+        raise ValueError(f"mesh {data}x{model} needs {need} devices, have {n}")
+    arr = np.array(devices[:need]).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_shardings(mesh: Mesh) -> PackedBatch:
+    """Leading-dim `data` sharding for every array in a packed batch."""
+    s = NamedSharding(mesh, P(DATA_AXIS))
+    return PackedBatch(*([s] * len(PackedBatch._fields)))
+
+
+def _param_spec(path: tuple, leaf) -> P:
+    """Tensor-parallel rule per parameter.
+
+    - Dense kernels (in, out): shard `out` over `model` — except the scalar
+      output heads, which are replicated;
+    - Dense biases (out,): follow their kernel;
+    - Embedding tables (vocab, features): shard `features` over `model`;
+    - BatchNorm scale/bias/stats (features,): follow the hidden sharding.
+    """
+    names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+    joined = "/".join(str(n) for n in names)
+    if "local_head" in joined or "global_head2" in joined:
+        return P()
+    if leaf.ndim == 2:
+        return P(None, MODEL_AXIS)
+    if leaf.ndim == 1:
+        return P(MODEL_AXIS)
+    return P()
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _param_spec(path, leaf)),
+        params)
+
+
+def state_shardings(state: Any, mesh: Mesh) -> Any:
+    """Shardings for a full TrainState: params/opt_state follow the TP rule
+    (optax states mirror the param tree), batch_stats follow features,
+    scalars replicate."""
+
+    def spec(path, leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, _param_spec(path, leaf))
+
+    return jax.tree_util.tree_map_with_path(spec, state)

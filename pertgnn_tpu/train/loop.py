@@ -72,8 +72,12 @@ def _loss_fn(model: PertGNN, cfg: Config, params, batch_stats, batch,
     return loss, (updates["batch_stats"], metrics)
 
 
-def make_train_step(model: PertGNN, cfg: Config,
-                    tx: optax.GradientTransformation) -> Callable:
+def train_step_fn(model: PertGNN, cfg: Config,
+                  tx: optax.GradientTransformation) -> Callable:
+    """The UNJITTED train step — the single source of truth for both the
+    single-chip path (jitted here) and the mesh-sharded path
+    (parallel/data_parallel.py jits it with shardings)."""
+
     def step(state: TrainState, batch: PackedBatch):
         rng = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed),
                                  state.step)
@@ -86,10 +90,10 @@ def make_train_step(model: PertGNN, cfg: Config,
         return state.replace(params=new_params, batch_stats=new_stats,
                              opt_state=new_opt, step=state.step + 1), metrics
 
-    return jax.jit(step, donate_argnums=0)
+    return step
 
 
-def make_eval_step(model: PertGNN, cfg: Config) -> Callable:
+def eval_step_fn(model: PertGNN, cfg: Config) -> Callable:
     def step(state: TrainState, batch: PackedBatch):
         (global_pred, _) = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
@@ -98,7 +102,16 @@ def make_eval_step(model: PertGNN, cfg: Config) -> Callable:
                                   global_pred * cfg.train.label_scale,
                                   cfg.train.tau, batch.graph_mask)
 
-    return jax.jit(step)
+    return step
+
+
+def make_train_step(model: PertGNN, cfg: Config,
+                    tx: optax.GradientTransformation) -> Callable:
+    return jax.jit(train_step_fn(model, cfg, tx), donate_argnums=0)
+
+
+def make_eval_step(model: PertGNN, cfg: Config) -> Callable:
+    return jax.jit(eval_step_fn(model, cfg))
 
 
 def _device_iter(batches: Iterator[PackedBatch]) -> Iterator[PackedBatch]:
@@ -117,8 +130,15 @@ def _device_iter(batches: Iterator[PackedBatch]) -> Iterator[PackedBatch]:
 
 def evaluate(eval_step: Callable, state: TrainState,
              batches: Iterator[PackedBatch]) -> dict[str, float]:
+    """Aggregate metrics over host batches (device-put with prefetch)."""
+    return _evaluate_stream(eval_step, state, _device_iter(batches))
+
+
+def _evaluate_stream(eval_step: Callable, state: TrainState,
+                     device_batches: Iterator[PackedBatch]
+                     ) -> dict[str, float]:
     sums = None
-    for batch in _device_iter(batches):
+    for batch in device_batches:
         m = eval_step(state, batch)
         sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
     if sums is None:
@@ -134,16 +154,44 @@ def fit(dataset: Dataset, cfg: Config,
         epochs: int | None = None,
         checkpoint_manager=None,
         profile_hook: Callable[[int, dict], None] | None = None,
+        mesh=None,
         ) -> tuple[TrainState, list[dict]]:
     """Epoch driver: train on `train`, evaluate `valid`+`test` per epoch
-    (pert_gnn.py:344-350). Returns (final state, per-epoch history)."""
+    (pert_gnn.py:344-350). Returns (final state, per-epoch history).
+
+    With `mesh` (jax.sharding.Mesh with a `data` axis), per-step batches are
+    grouped into global batches sharded over the mesh and the step runs
+    SPMD (BASELINE config 3)."""
     model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
                        dataset.num_interfaces, dataset.num_rpctypes)
     tx = optax.adam(cfg.train.lr)
     sample = next(dataset.batches("train"))
-    state = create_train_state(model, tx, sample, cfg.train.seed)
-    train_step = make_train_step(model, cfg, tx)
-    eval_step = make_eval_step(model, cfg)
+    if mesh is not None:
+        from pertgnn_tpu.parallel.data_parallel import (
+            grouped_batches, make_sharded_eval_step, make_sharded_train_step,
+            shard_batch, stack_batches)
+        n_shards = mesh.shape["data"]
+        init_sample = stack_batches([sample] * n_shards)
+        state = create_train_state(model, tx, init_sample, cfg.train.seed)
+        train_step, state = make_sharded_train_step(model, cfg, tx, mesh,
+                                                    state)
+        eval_step = make_sharded_eval_step(model, cfg, mesh, state)
+
+        from pertgnn_tpu.parallel.mesh import batch_shardings
+        b_sh = batch_shardings(mesh)
+
+        def batch_stream(split, shuffle=False, seed=0):
+            return (shard_batch(g, mesh, b_sh) for g in grouped_batches(
+                dataset.batches(split, shuffle=shuffle, seed=seed),
+                n_shards))
+    else:
+        state = create_train_state(model, tx, sample, cfg.train.seed)
+        train_step = make_train_step(model, cfg, tx)
+        eval_step = make_eval_step(model, cfg)
+
+        def batch_stream(split, shuffle=False, seed=0):
+            return _device_iter(dataset.batches(split, shuffle=shuffle,
+                                                seed=seed))
 
     start_epoch = 0
     if checkpoint_manager is not None:
@@ -155,9 +203,8 @@ def fit(dataset: Dataset, cfg: Config,
         t0 = time.perf_counter()
         sums = None
         n_batches = 0
-        for batch in _device_iter(
-                dataset.batches("train", shuffle=True,
-                                seed=cfg.data.shuffle_seed + epoch)):
+        for batch in batch_stream("train", shuffle=True,
+                                  seed=cfg.data.shuffle_seed + epoch):
             state, m = train_step(state, batch)
             sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
             n_batches += 1
@@ -165,8 +212,8 @@ def fit(dataset: Dataset, cfg: Config,
         n = max(sums["count"], 1.0)
         train_time = time.perf_counter() - t0
 
-        valid = evaluate(eval_step, state, dataset.batches("valid"))
-        test = evaluate(eval_step, state, dataset.batches("test"))
+        valid = _evaluate_stream(eval_step, state, batch_stream("valid"))
+        test = _evaluate_stream(eval_step, state, batch_stream("test"))
         row = {
             "epoch": epoch,
             "train_qloss": sums["qloss_sum"] / n,
