@@ -1,0 +1,202 @@
+// Native data-path kernels for pertgnn_tpu (host-side hot loops).
+//
+// The reference's offline dataset build spends its time in per-trace Python
+// loops (iterrows + per-row sorts in misc.py:221-302; README quotes 10+ hours
+// for the full trace). This library implements the PERT stage-expansion and
+// min-depth BFS over plain columnar arrays, called from Python via ctypes
+// (pertgnn_tpu/native/bindings.py). Semantics mirror
+// pertgnn_tpu/graphs/construct.py::build_pert_graph exactly (parity-tested).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Event {
+  double time;
+  int32_t order;  // emission order; stable tie-break like Python's sort
+  bool is_end;
+  int64_t dm;
+  int64_t iface;
+  int64_t rpctype;
+};
+
+}  // namespace
+
+extern "C" {
+
+// PERT activity-on-node expansion for ONE sanitized trace.
+//
+// Inputs: n sanitized span rows (um, dm, interface, rpctype, timestamp,
+// endTimestamp) and the trace's root microservice id.
+// Output buffers are caller-allocated with capacities:
+//   senders/receivers:    4*n
+//   edge_attr:            4*n * 4   (iface, rpctype, call_ind, same_ms_ind)
+//   ms_id, node_depth:    4*n + 1
+// Returns 0 on success; fills out_num_nodes / out_num_edges.
+int pert_build(const int64_t* um, const int64_t* dm, const int64_t* iface,
+               const int64_t* rpctype, const double* ts, const double* end_ts,
+               int64_t n, int64_t root, int32_t* senders, int32_t* receivers,
+               int32_t* edge_attr, int32_t* ms_id, float* node_depth,
+               int64_t* out_num_nodes, int64_t* out_num_edges) {
+  // --- caller order: count-descending, first-appearance tie-break
+  //     (pandas value_counts semantics; construct.py::_caller_order)
+  std::vector<int64_t> first_order;
+  std::unordered_map<int64_t, int64_t> counts;
+  first_order.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = counts.find(um[i]);
+    if (it == counts.end()) {
+      counts.emplace(um[i], 1);
+      first_order.push_back(um[i]);
+    } else {
+      ++it->second;
+    }
+  }
+  std::vector<int64_t> order(first_order.size());
+  for (size_t i = 0; i < order.size(); ++i) order[i] = (int64_t)i;
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return counts[first_order[a]] > counts[first_order[b]];
+  });
+
+  // --- stage nodes: caller with k calls -> chain of 2k+1 nodes
+  //     (misc.py:240-250 semantics)
+  std::unordered_map<int64_t, std::pair<int64_t, int64_t>> stages;  // ms -> [first, count]
+  int64_t num_nodes = 0;
+  int64_t num_edges = 0;
+  auto push_edge = [&](int64_t s, int64_t r, int64_t a0, int64_t a1,
+                       int64_t a2, int64_t a3) {
+    senders[num_edges] = (int32_t)s;
+    receivers[num_edges] = (int32_t)r;
+    edge_attr[num_edges * 4 + 0] = (int32_t)a0;
+    edge_attr[num_edges * 4 + 1] = (int32_t)a1;
+    edge_attr[num_edges * 4 + 2] = (int32_t)a2;
+    edge_attr[num_edges * 4 + 3] = (int32_t)a3;
+    ++num_edges;
+  };
+  for (int64_t oi : order) {
+    int64_t ms = first_order[oi];
+    int64_t k = counts[ms];
+    int64_t n_stages = 2 * k + 1;
+    stages[ms] = {num_nodes, n_stages};
+    for (int64_t s = 0; s + 1 < n_stages; ++s)
+      push_edge(num_nodes + s, num_nodes + s + 1, 0, 0, 1, 1);
+    for (int64_t s = 0; s < n_stages; ++s) ms_id[num_nodes + s] = (int32_t)ms;
+    num_nodes += n_stages;
+  }
+  // leaf callees (in sorted order; construct.py uses sorted(set diff))
+  std::vector<int64_t> leaves;
+  for (int64_t i = 0; i < n; ++i)
+    if (!counts.count(dm[i])) leaves.push_back(dm[i]);
+  std::sort(leaves.begin(), leaves.end());
+  leaves.erase(std::unique(leaves.begin(), leaves.end()), leaves.end());
+  for (int64_t leaf : leaves) {
+    stages[leaf] = {num_nodes, 1};
+    ms_id[num_nodes] = (int32_t)leaf;
+    ++num_nodes;
+  }
+
+  // --- per-caller call/return events sorted by time (misc.py:272-302);
+  //     callers iterated in SORTED id order (pandas groupby), rows in
+  //     original order, stable sort keeps equal-time emission order
+  std::vector<int64_t> sorted_callers = first_order;
+  std::sort(sorted_callers.begin(), sorted_callers.end());
+  std::vector<Event> events;
+  for (int64_t caller : sorted_callers) {
+    events.clear();
+    int32_t emit = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      if (um[i] != caller) continue;
+      events.push_back({ts[i], emit++, false, dm[i], iface[i], rpctype[i]});
+      events.push_back({end_ts[i], emit++, true, dm[i], 0, 0});
+    }
+    std::stable_sort(events.begin(), events.end(),
+                     [](const Event& a, const Event& b) {
+                       return a.time < b.time;
+                     });
+    auto cs = stages[caller];
+    for (size_t i = 0; i < events.size(); ++i) {
+      const Event& ev = events[i];
+      auto ds = stages[ev.dm];
+      if (ev.is_end) {
+        // return: last stage of callee -> caller stage i+1
+        push_edge(ds.first + ds.second - 1, cs.first + (int64_t)i + 1,
+                  ev.iface, ev.rpctype, 0, 0);
+      } else {
+        // call: caller stage i -> first stage of callee
+        push_edge(cs.first + (int64_t)i, ds.first, ev.iface, ev.rpctype, 1,
+                  0);
+      }
+    }
+  }
+
+  // --- min-depth BFS from the root's first stage; unreachable -> 0;
+  //     normalized by max depth (construct.py::min_depth_from_root)
+  std::vector<std::vector<int32_t>> adj(num_nodes);
+  for (int64_t e = 0; e < num_edges; ++e)
+    adj[senders[e]].push_back(receivers[e]);
+  std::vector<int64_t> depth(num_nodes, -1);
+  auto rs = stages.find(root);
+  if (rs != stages.end()) {
+    std::queue<int32_t> q;
+    depth[rs->second.first] = 0;
+    q.push((int32_t)rs->second.first);
+    while (!q.empty()) {
+      int32_t v = q.front();
+      q.pop();
+      for (int32_t w : adj[v])
+        if (depth[w] < 0) {
+          depth[w] = depth[v] + 1;
+          q.push(w);
+        }
+    }
+  }
+  int64_t maxd = 0;
+  for (int64_t i = 0; i < num_nodes; ++i)
+    if (depth[i] > maxd) maxd = depth[i];
+  double denom = maxd > 0 ? (double)maxd : 1.0;
+  for (int64_t i = 0; i < num_nodes; ++i)
+    node_depth[i] = depth[i] < 0 ? 0.0f : (float)((double)depth[i] / denom);
+
+  *out_num_nodes = num_nodes;
+  *out_num_edges = num_edges;
+  return 0;
+}
+
+// Batched variant: rows for many traces concatenated, trace t owning rows
+// [row_offsets[t], row_offsets[t+1]). Node/edge outputs are packed back to
+// back; out_node_offsets/out_edge_offsets (length n_traces+1) locate them.
+// Buffer capacities: edges 4*total_rows, nodes 4*total_rows + n_traces.
+int pert_build_batch(const int64_t* um, const int64_t* dm,
+                     const int64_t* iface, const int64_t* rpctype,
+                     const double* ts, const double* end_ts,
+                     const int64_t* row_offsets, const int64_t* roots,
+                     int64_t n_traces, int32_t* senders, int32_t* receivers,
+                     int32_t* edge_attr, int32_t* ms_id, float* node_depth,
+                     int64_t* out_node_offsets, int64_t* out_edge_offsets) {
+  int64_t node_base = 0, edge_base = 0;
+  out_node_offsets[0] = 0;
+  out_edge_offsets[0] = 0;
+  for (int64_t t = 0; t < n_traces; ++t) {
+    int64_t lo = row_offsets[t], hi = row_offsets[t + 1];
+    int64_t nn = 0, ne = 0;
+    int rc = pert_build(um + lo, dm + lo, iface + lo, rpctype + lo, ts + lo,
+                        end_ts + lo, hi - lo, roots[t], senders + edge_base,
+                        receivers + edge_base, edge_attr + edge_base * 4,
+                        ms_id + node_base, node_depth + node_base, &nn, &ne);
+    if (rc != 0) return rc;
+    node_base += nn;
+    edge_base += ne;
+    out_node_offsets[t + 1] = node_base;
+    out_edge_offsets[t + 1] = edge_base;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,96 @@
+"""Shared CLI plumbing: flags → Config.
+
+Flag names track the reference's argparse block (pert_gnn.py:15-33) so
+configs transfer verbatim; the three flags the reference declares but never
+uses (`--log_steps`, `--use_sage`, `--runs` — SURVEY.md §5.6) are dropped.
+New capability flags are grouped after the parity flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from pertgnn_tpu.config import (Config, DataConfig, IngestConfig, ModelConfig,
+                                ParallelConfig, TrainConfig)
+
+
+def add_model_train_flags(p: argparse.ArgumentParser) -> None:
+    # parity flags (reference defaults, pert_gnn.py:15-33)
+    p.add_argument("--num_layers", type=int, default=1)
+    p.add_argument("--hidden_channels", type=int, default=32)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--tau", type=float, default=0.5,
+                   help="pinball-loss quantile level in (0, 1)")
+    p.add_argument("--epochs", type=int, default=100)
+    p.add_argument("--batch_size", type=int, default=170)
+    p.add_argument("--graph_type", choices=("span", "pert"), default="span")
+    p.add_argument("--max_traces", type=int, default=100_000)
+    # capability flags
+    p.add_argument("--num_heads", type=int, default=1)
+    p.add_argument("--label_scale", type=float, default=1.0)
+    p.add_argument("--use_node_depth", action="store_true")
+    p.add_argument("--nonnegative_pred", action="store_true")
+    p.add_argument("--local_loss_weight", type=float, default=0.0)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--data_parallel", type=int, default=1,
+                   help="mesh data axis size (1 = single device)")
+    p.add_argument("--model_parallel", type=int, default=1)
+    p.add_argument("--checkpoint_dir", default="")
+    p.add_argument("--checkpoint_keep", type=int, default=3)
+    p.add_argument("--profile_dir", default="",
+                   help="write a jax.profiler trace of epoch 2 here")
+    p.add_argument("--seed", type=int, default=0)
+
+
+def add_ingest_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--min_traces_per_entry", type=int, default=100)
+    p.add_argument("--min_resource_coverage", type=float, default=0.6)
+    p.add_argument("--synthetic", action="store_true",
+                   help="use the synthetic generator instead of raw CSVs")
+    p.add_argument("--synthetic_entries", type=int, default=8)
+    p.add_argument("--synthetic_traces_per_entry", type=int, default=300)
+    p.add_argument("--data_dir", default="data",
+                   help="raw dataset root (MSCallGraph/ + MSResource/)")
+    p.add_argument("--artifact_dir", default="processed",
+                   help="idempotent L0-L2 artifact cache directory")
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    return Config(
+        ingest=IngestConfig(
+            min_traces_per_entry=args.min_traces_per_entry,
+            min_resource_coverage=args.min_resource_coverage),
+        data=DataConfig(max_traces=args.max_traces,
+                        batch_size=args.batch_size),
+        model=ModelConfig(
+            hidden_channels=args.hidden_channels,
+            num_layers=args.num_layers,
+            num_heads=args.num_heads,
+            dropout=args.dropout,
+            use_node_depth=args.use_node_depth,
+            nonnegative_pred=args.nonnegative_pred,
+            local_loss_weight=args.local_loss_weight,
+            bf16_activations=args.bf16),
+        train=TrainConfig(
+            lr=args.lr, tau=args.tau, epochs=args.epochs,
+            label_scale=args.label_scale, seed=args.seed,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_keep=args.checkpoint_keep),
+        parallel=ParallelConfig(data_parallel=args.data_parallel,
+                                model_parallel=args.model_parallel),
+        graph_type=args.graph_type,
+    )
+
+
+def get_frames(args: argparse.Namespace):
+    """(spans, resources) raw frames per the flags."""
+    if args.synthetic:
+        from pertgnn_tpu.ingest import synthetic
+        data = synthetic.generate(synthetic.SyntheticSpec(
+            num_entries=args.synthetic_entries,
+            traces_per_entry=args.synthetic_traces_per_entry,
+            seed=getattr(args, "seed", 0)))
+        return data.spans, data.resources
+    from pertgnn_tpu.ingest.io import load_raw_csvs
+    return load_raw_csvs(args.data_dir)

@@ -1,0 +1,39 @@
+"""Offline preprocessing CLI (the reference's `python preprocess.py`).
+
+    python -m pertgnn_tpu.cli.preprocess_main --data_dir data --artifact_dir processed
+    python -m pertgnn_tpu.cli.preprocess_main --synthetic --min_traces_per_entry 10
+
+Idempotent: a complete artifact cache is reused (reference idiom,
+preprocess.py:192-199).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from pertgnn_tpu.cli.common import add_ingest_flags, get_frames
+from pertgnn_tpu.config import IngestConfig
+from pertgnn_tpu.ingest.io import artifacts_present, preprocess_cached
+from pertgnn_tpu.utils.logging import setup_logging
+
+
+def main(argv=None) -> None:
+    setup_logging()
+    p = argparse.ArgumentParser(description=__doc__)
+    add_ingest_flags(p)
+    args = p.parse_args(argv)
+    cfg = IngestConfig(min_traces_per_entry=args.min_traces_per_entry,
+                       min_resource_coverage=args.min_resource_coverage)
+    if artifacts_present(args.artifact_dir):
+        print(f"artifact cache complete at {args.artifact_dir}; nothing to do")
+        return
+    spans, resources = get_frames(args)
+    pre, table = preprocess_cached(args.artifact_dir, spans, resources,
+                                   cfg=cfg)
+    print(f"preprocessed: {pre.stats}")
+    print(f"traces: {len(table.meta)}, entries: {len(table.entry2runtimes)}, "
+          f"runtime patterns: {len(table.runtime2trace)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""Training CLI (the reference's `python pert_gnn.py`).
+
+    python -m pertgnn_tpu.cli.train_main --artifact_dir processed --graph_type pert
+    python -m pertgnn_tpu.cli.train_main --synthetic --min_traces_per_entry 10 \
+        --epochs 5 --label_scale 1000
+    python -m pertgnn_tpu.cli.train_main ... --data_parallel 8   # mesh run
+
+Prints the reference's per-epoch line (train/valid/test MAE/MAPE/q-loss,
+pert_gnn.py:348-350) plus throughput; checkpoints via orbax when
+--checkpoint_dir is set.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
+                                    config_from_args, get_frames)
+from pertgnn_tpu.ingest.io import artifacts_present, load_artifacts, preprocess_cached
+from pertgnn_tpu.train.loop import fit
+from pertgnn_tpu.utils.logging import setup_logging
+
+
+def main(argv=None) -> None:
+    setup_logging()
+    p = argparse.ArgumentParser(description=__doc__)
+    add_ingest_flags(p)
+    add_model_train_flags(p)
+    args = p.parse_args(argv)
+    print(args)
+    cfg = config_from_args(args)
+
+    if artifacts_present(args.artifact_dir):
+        pre, table = load_artifacts(args.artifact_dir)
+    else:
+        spans, resources = get_frames(args)
+        pre, table = preprocess_cached(args.artifact_dir, spans, resources,
+                                       cfg=cfg.ingest)
+    dataset = build_dataset(pre, cfg, table)
+
+    mesh = None
+    if args.data_parallel > 1 or args.model_parallel > 1:
+        from pertgnn_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(data=args.data_parallel, model=args.model_parallel)
+
+    ckpt = None
+    if args.checkpoint_dir:
+        from pertgnn_tpu.train.checkpoint import CheckpointManager
+        ckpt = CheckpointManager(args.checkpoint_dir,
+                                 keep=args.checkpoint_keep)
+    hook = None
+    if args.profile_dir:
+        from pertgnn_tpu.utils.profiling import profile_epochs
+        hook = profile_epochs(args.profile_dir)
+
+    state, history = fit(dataset, cfg, checkpoint_manager=ckpt,
+                         profile_hook=hook, mesh=mesh)
+    for row in history:
+        print(f"Epoch: {row['epoch']}, Train: {row['train_qloss']:.4f}, "
+              f"Test mae: {row['test_mae']:.4f}, "
+              f"Train mape: {row['train_mape']:.4f}, "
+              f"Test mape: {row['test_mape']:.4f}, "
+              f"Test q loss: {row['test_qloss']:.4f}, "
+              f"{row['graphs_per_s']:.0f} graphs/s")
+
+
+if __name__ == "__main__":
+    main()

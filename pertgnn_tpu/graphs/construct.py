@@ -95,6 +95,36 @@ def sanitize_edges(trace_df: pd.DataFrame, root) -> pd.DataFrame:
     return df[keep.values]
 
 
+def find_roots_vectorized(spans: pd.DataFrame) -> pd.Series:
+    """Per-trace root (find_root semantics) for all traces in one pass."""
+    abs_rt = spans["rt"].abs()
+    g = spans.groupby("traceid")
+    is_cand = (abs_rt == abs_rt.groupby(spans["traceid"]).transform("max")) \
+        & (spans["timestamp"] == g["timestamp"].transform("min"))
+    cand = spans[is_cand]
+    return cand.groupby("traceid")["um"].first()
+
+
+def sanitize_traces(spans: pd.DataFrame) -> tuple[pd.DataFrame, pd.Series]:
+    """`sanitize_edges` for MANY traces in vectorized passes.
+
+    Returns (sanitized rows for all traces, per-trace root). Exact parity
+    with the per-trace function (tested), each stage evaluated on the
+    survivors of the previous one, as in the reference's sequential
+    drop_wrong_edges (misc.py:87-105).
+    """
+    roots = find_roots_vectorized(spans)
+    df = spans[spans["um"] != spans["dm"]]
+    df = df[~df.duplicated(subset=["traceid", "rpcid"], keep="first")]
+    df = df[df["dm"] != df["traceid"].map(roots)]
+    df = df[~df.duplicated(subset=["traceid", "um", "dm"], keep="last")]
+    lo = np.minimum(df["um"].to_numpy(), df["dm"].to_numpy())
+    hi = np.maximum(df["um"].to_numpy(), df["dm"].to_numpy())
+    pair = pd.DataFrame({"t": df["traceid"].to_numpy(), "lo": lo, "hi": hi})
+    df = df[~pair.duplicated(keep="first").to_numpy()]
+    return df, roots
+
+
 def min_depth_from_root(num_nodes: int, senders: np.ndarray,
                         receivers: np.ndarray, root: int) -> np.ndarray:
     """Iterative BFS min-depth; unreachable nodes get 0
@@ -120,10 +150,12 @@ def _normalized_depth(depth: np.ndarray) -> np.ndarray:
     return (depth / denom).astype(np.float32)
 
 
-def build_span_graph(trace_df: pd.DataFrame) -> GraphSpec:
+def build_span_graph(trace_df: pd.DataFrame, *, sanitized: pd.DataFrame
+                     | None = None, root=None) -> GraphSpec:
     """Span graph: one node per microservice (misc.py:190-219)."""
-    root = find_root(trace_df)
-    df = sanitize_edges(trace_df, root)
+    if root is None:
+        root = find_root(trace_df)
+    df = sanitize_edges(trace_df, root) if sanitized is None else sanitized
     um = df["um"].to_numpy(dtype=np.int64)
     dm = df["dm"].to_numpy(dtype=np.int64)
     edge_nodes = np.stack([um, dm])
@@ -169,10 +201,12 @@ def _caller_order(um: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return callers, counts[order]
 
 
-def build_pert_graph(trace_df: pd.DataFrame) -> GraphSpec:
+def build_pert_graph(trace_df: pd.DataFrame, *, sanitized: pd.DataFrame
+                     | None = None, root=None) -> GraphSpec:
     """Activity-on-node PERT DAG (misc.py:221-370)."""
-    root = find_root(trace_df)
-    df = sanitize_edges(trace_df, root)
+    if root is None:
+        root = find_root(trace_df)
+    df = sanitize_edges(trace_df, root) if sanitized is None else sanitized
 
     um = df["um"].to_numpy(dtype=np.int64)
     callers, counts = _caller_order(um)
@@ -257,16 +291,20 @@ def build_runtime_graphs(pre: PreprocessResult, table: TraceTable,
                 return native.build_runtime_graphs(pre, table, graph_type)
             if use_native:
                 raise RuntimeError("native library not available")
-        except ImportError:
+        except (ImportError, OSError, RuntimeError):
             if use_native:
-                raise
+                raise  # explicitly requested: surface the real error
     build = build_span_graph if graph_type == "span" else build_pert_graph
     # only representative traces are consumed — filter before the groupby
-    # split so we never materialize per-trace frames for the other ~100k
+    # split so we never materialize per-trace frames for the other ~100k;
+    # sanitize all of them in one vectorized pass
     reps = set(table.runtime2trace.values())
     rep_spans = pre.spans[pre.spans["traceid"].isin(reps)]
-    spans_by_trace = {tid: grp for tid, grp in rep_spans.groupby("traceid")}
+    sanitized, roots = sanitize_traces(rep_spans)
+    by_trace = {tid: grp for tid, grp in sanitized.groupby("traceid")}
+    empty = sanitized.iloc[:0]
     out: dict[int, GraphSpec] = {}
     for runtime_id, traceid in table.runtime2trace.items():
-        out[runtime_id] = build(spans_by_trace[traceid])
+        out[runtime_id] = build(None, sanitized=by_trace.get(traceid, empty),
+                                root=roots[traceid])
     return out

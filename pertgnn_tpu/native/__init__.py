@@ -1,0 +1,1 @@
+from pertgnn_tpu.native import bindings

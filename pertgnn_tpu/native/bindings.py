@@ -1,0 +1,146 @@
+"""ctypes bindings for the native data-path library (native/pert_native.cpp).
+
+The shared library is built on demand with `make -C native` (g++ is in the
+image; pybind11 is not, hence ctypes over a C ABI). Everything degrades
+gracefully: `available()` is False when the toolchain or library is missing
+and callers fall back to the pure-numpy path in graphs/construct.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpertnative.so")
+_lib = None
+_build_attempted = False
+
+
+def _ensure_built() -> bool:
+    global _build_attempted
+    if os.path.isfile(_LIB_PATH):
+        return True
+    if _build_attempted:
+        return False
+    _build_attempted = True
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True, timeout=120)
+        return os.path.isfile(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError) as e:
+        log.warning("native library build failed (%s); using numpy path", e)
+        return False
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _ensure_built():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:  # stale/corrupt/wrong-arch .so
+        log.warning("native library load failed (%s); using numpy path", e)
+        return None
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    lib.pert_build_batch.argtypes = [
+        i64p, i64p, i64p, i64p, f64p, f64p,   # rows
+        i64p, i64p, ctypes.c_int64,           # offsets, roots, n_traces
+        i32p, i32p, i32p, i32p, f32p,         # outputs
+        i64p, i64p,                           # node/edge offsets
+    ]
+    lib.pert_build_batch.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_runtime_graphs(pre, table, graph_type: str):
+    """Native-accelerated drop-in for construct.build_runtime_graphs.
+
+    Sanitization stays in pandas (vectorized already); the per-trace PERT
+    expansion — the reference's Python-loop hot spot — runs in C++ over all
+    representative traces in one call. Span graphs use the numpy path (its
+    work is a vectorized np.unique; nothing to win).
+    """
+    from pertgnn_tpu.graphs import construct as C
+
+    if graph_type != "pert":
+        return C.build_runtime_graphs(pre, table, graph_type,
+                                      use_native=False)
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+
+    reps = set(table.runtime2trace.values())
+    rep_spans = pre.spans[pre.spans["traceid"].isin(reps)]
+    sanitized, tr_roots = C.sanitize_traces(rep_spans)
+    # order rows by the runtime2trace iteration order, one block per trace
+    runtime_ids = list(table.runtime2trace.keys())
+    trace_order = [table.runtime2trace[r] for r in runtime_ids]
+    pos = {t: i for i, t in enumerate(trace_order)}
+    order_key = sanitized["traceid"].map(pos).to_numpy()
+    perm = np.argsort(order_key, kind="stable")
+    s = sanitized.iloc[perm]
+    sizes = np.bincount(order_key, minlength=len(trace_order)).astype(np.int64)
+    row_offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    total = int(sizes.sum())
+
+    def col(name, dtype):
+        return np.ascontiguousarray(s[name].to_numpy(), dtype)
+
+    um = col("um", np.int64)
+    dm = col("dm", np.int64)
+    iface = col("interface", np.int64)
+    rpctype = col("rpctype", np.int64)
+    ts = col("timestamp", np.float64)
+    end_ts = col("endTimestamp", np.float64)
+    roots_a = np.asarray([tr_roots[t] for t in trace_order], dtype=np.int64)
+
+    cap_e = 4 * total
+    cap_n = 4 * total + len(trace_order)
+    senders = np.empty(cap_e, np.int32)
+    receivers = np.empty(cap_e, np.int32)
+    edge_attr = np.empty(cap_e * 4, np.int32)
+    ms_id = np.empty(cap_n, np.int32)
+    node_depth = np.empty(cap_n, np.float32)
+    node_off = np.empty(len(trace_order) + 1, np.int64)
+    edge_off = np.empty(len(trace_order) + 1, np.int64)
+
+    rc = lib.pert_build_batch(
+        um, dm, iface, rpctype, ts, end_ts, row_offsets, roots_a,
+        len(trace_order), senders, receivers, edge_attr, ms_id, node_depth,
+        node_off, edge_off)
+    if rc != 0:
+        raise RuntimeError(f"pert_build_batch failed with {rc}")
+
+    out = {}
+    for i, runtime_id in enumerate(runtime_ids):
+        nlo, nhi = int(node_off[i]), int(node_off[i + 1])
+        elo, ehi = int(edge_off[i]), int(edge_off[i + 1])
+        # edges within a trace are local; offsets already per-trace (each
+        # pert_build call numbers nodes from 0)
+        out[runtime_id] = C.GraphSpec(
+            senders=senders[elo:ehi].copy(),
+            receivers=receivers[elo:ehi].copy(),
+            edge_attr=edge_attr[elo * 4:ehi * 4].reshape(-1, 4).copy(),
+            ms_id=ms_id[nlo:nhi].copy(),
+            node_depth=node_depth[nlo:nhi].copy(),
+            num_nodes=nhi - nlo,
+        )
+    return out

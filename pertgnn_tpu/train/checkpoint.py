@@ -1,0 +1,70 @@
+"""Orbax checkpointing: restartable training the reference lacks.
+
+The reference checkpoints DATA only (idempotent artifact caches,
+preprocess.py:23-29, 192-199; SURVEY.md §5.4) and loses all training progress
+on a crash — no state_dict save anywhere. Here the full TrainState (params,
+batch_stats, optimizer state, step) plus the epoch counter is saved
+asynchronously every epoch and restored on restart.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from pertgnn_tpu.train.loop import TrainState
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Thin orbax wrapper keyed by epoch."""
+
+    def __init__(self, directory: str, keep: int = 3, every: int = 1):
+        self.every = max(1, every)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=keep, enable_async_checkpointing=True)
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory), options=options)
+
+    def save(self, epoch: int, state: TrainState, metrics: dict | None = None
+             ) -> None:
+        if (epoch + 1) % self.every:
+            return
+        self._mgr.save(
+            epoch,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(jax.device_get(state)),
+                metrics=ocp.args.JsonSave(metrics or {}),
+            ),
+        )
+
+    def maybe_restore(self, state: TrainState) -> tuple[TrainState, int]:
+        """Restore the latest checkpoint if present.
+
+        Returns (state, start_epoch): start_epoch is one past the saved
+        epoch, 0 when nothing is saved.
+        """
+        latest = self._mgr.latest_step()
+        if latest is None:
+            return state, 0
+        target = jax.device_get(state)
+        restored = self._mgr.restore(
+            latest,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(target)),
+        )
+        log.info("restored checkpoint at epoch %d", latest)
+        new_state = jax.tree.map(np.asarray, restored["state"])
+        return new_state, latest + 1
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

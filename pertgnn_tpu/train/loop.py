@@ -237,6 +237,8 @@ def fit(dataset: Dataset, cfg: Config,
             profile_hook(epoch, row)
         if checkpoint_manager is not None:
             checkpoint_manager.save(epoch, state, row)
+    if profile_hook is not None and hasattr(profile_hook, "close"):
+        profile_hook.close()
     if checkpoint_manager is not None:
         checkpoint_manager.wait()
     return state, history

@@ -1,0 +1,2 @@
+from pertgnn_tpu.utils.profiling import StepTimer, profile_epochs
+from pertgnn_tpu.utils.logging import setup_logging

@@ -1,0 +1,20 @@
+"""Structured logging setup (the reference prints; SURVEY.md §5.5)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def setup_logging(level: int = logging.INFO) -> None:
+    root = logging.getLogger("pertgnn_tpu")
+    if root.handlers:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(name)s %(levelname)s %(message)s",
+        datefmt="%H:%M:%S"))
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False  # avoid double lines when the root logger has
+    # a handler (absl installs one)

@@ -1,0 +1,70 @@
+"""Profiling hooks: jax.profiler traces + step timing.
+
+The reference's only instrumentation is tqdm bars (SURVEY.md §5.1). Here:
+- `StepTimer` — wall-clock EMA per step with one-line summaries;
+- `profile_epochs` — a `fit(profile_hook=...)` hook that captures a
+  jax.profiler trace (viewable in TensorBoard/Perfetto) for chosen epochs.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Sequence
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+class StepTimer:
+    def __init__(self, alpha: float = 0.1):
+        self.alpha = alpha
+        self.ema = None
+        self.count = 0
+        self._t = None
+
+    def __enter__(self):
+        self._t = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t
+        self.ema = dt if self.ema is None else (
+            (1 - self.alpha) * self.ema + self.alpha * dt)
+        self.count += 1
+        return False
+
+    def summary(self) -> str:
+        if self.ema is None:
+            return "no steps timed"
+        return f"{self.count} steps, ema {self.ema * 1e3:.2f} ms/step"
+
+
+def profile_epochs(log_dir: str, epochs: Sequence[int] = (1,)
+                   ) -> Callable[[int, dict], None]:
+    """Hook for `fit(profile_hook=...)`: trace the NEXT epoch after each
+    epoch in `epochs` completes (epoch 0 compiles, so default traces
+    epoch 2's steps by starting after epoch 1)."""
+    state = {"active": False}
+
+    def hook(epoch: int, row: dict) -> None:
+        if state["active"]:
+            jax.profiler.stop_trace()
+            state["active"] = False
+            log.info("profiler trace for epoch %d written to %s", epoch,
+                     log_dir)
+        if epoch in epochs:
+            jax.profiler.start_trace(log_dir)
+            state["active"] = True
+
+    def close() -> None:
+        """Flush an open trace if training ended mid-capture (fit calls
+        this after the epoch loop)."""
+        if state["active"]:
+            jax.profiler.stop_trace()
+            state["active"] = False
+            log.info("profiler trace (final epoch) written to %s", log_dir)
+
+    hook.close = close
+    return hook

@@ -1,0 +1,135 @@
+"""Aux subsystems: artifact cache round-trip, orbax checkpoint/resume, CLIs."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import Config, DataConfig, IngestConfig, ModelConfig, TrainConfig
+from pertgnn_tpu.ingest.assemble import assemble
+from pertgnn_tpu.ingest.io import (artifacts_present, load_artifacts,
+                                   preprocess_cached, save_artifacts)
+
+
+@pytest.fixture
+def cfg():
+    return Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=150, batch_size=8),
+        model=ModelConfig(hidden_channels=8),
+        train=TrainConfig(lr=1e-3, epochs=2, label_scale=1000.0),
+    )
+
+
+class TestArtifactCache:
+    def test_round_trip(self, preprocessed, tmp_path, cfg):
+        table = assemble(preprocessed)
+        save_artifacts(str(tmp_path), preprocessed, table)
+        assert artifacts_present(str(tmp_path))
+        pre2, table2 = load_artifacts(str(tmp_path))
+        pd.testing.assert_frame_equal(
+            preprocessed.spans.reset_index(drop=True),
+            pre2.spans.reset_index(drop=True))
+        pd.testing.assert_frame_equal(table.meta, table2.meta)
+        assert table2.runtime2trace == table.runtime2trace
+        for k, (r, p) in table.entry2runtimes.items():
+            r2, p2 = table2.entry2runtimes[k]
+            np.testing.assert_array_equal(r, r2)
+            np.testing.assert_allclose(p, p2)
+        # and the loaded artifacts build an identical dataset
+        ds1 = build_dataset(preprocessed, cfg, table)
+        ds2 = build_dataset(pre2, cfg, table2)
+        b1 = next(ds1.batches("train"))
+        b2 = next(ds2.batches("train"))
+        for f in b1._fields:
+            np.testing.assert_array_equal(getattr(b1, f), getattr(b2, f), f)
+
+    def test_cache_hit_skips_compute(self, synth, tmp_path, cfg):
+        pre1, t1 = preprocess_cached(str(tmp_path), synth.spans,
+                                     synth.resources, cfg=cfg.ingest)
+        # poison the inputs: a cache hit must not recompute
+        pre2, t2 = preprocess_cached(str(tmp_path), None, None,
+                                     cfg=cfg.ingest)
+        pd.testing.assert_frame_equal(t1.meta, t2.meta)
+
+
+class TestCheckpoint:
+    def test_save_restore_resume(self, preprocessed, tmp_path, cfg):
+        from pertgnn_tpu.train.checkpoint import CheckpointManager
+        from pertgnn_tpu.train.loop import fit
+
+        ds = build_dataset(preprocessed, cfg)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+        state1, hist1 = fit(ds, cfg, epochs=2, checkpoint_manager=mgr)
+        mgr.close()
+
+        # resume: a fresh manager restores epoch 1 and runs only epoch 2
+        mgr2 = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+        state2, hist2 = fit(ds, cfg, epochs=3, checkpoint_manager=mgr2)
+        mgr2.close()
+        assert [h["epoch"] for h in hist2] == [2]
+        assert int(state2.step) > int(state1.step)
+
+    def test_restore_preserves_params(self, preprocessed, tmp_path, cfg):
+        import jax
+
+        from pertgnn_tpu.train.checkpoint import CheckpointManager
+        from pertgnn_tpu.train.loop import fit
+
+        ds = build_dataset(preprocessed, cfg)
+        mgr = CheckpointManager(str(tmp_path / "c2"), keep=1)
+        state, _ = fit(ds, cfg, epochs=1, checkpoint_manager=mgr)
+        mgr.wait()
+        restored, start = mgr.maybe_restore(state)
+        assert start == 1
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            jax.device_get(state.params), restored.params)
+        mgr.close()
+
+
+class TestCLI:
+    def test_preprocess_then_train(self, tmp_path, capsys):
+        from pertgnn_tpu.cli import preprocess_main, train_main
+
+        art = str(tmp_path / "processed")
+        preprocess_main.main([
+            "--synthetic", "--min_traces_per_entry", "10",
+            "--synthetic_entries", "3", "--synthetic_traces_per_entry", "30",
+            "--artifact_dir", art])
+        out = capsys.readouterr().out
+        assert "runtime patterns" in out
+        # second run: cache hit
+        preprocess_main.main(["--artifact_dir", art])
+        assert "nothing to do" in capsys.readouterr().out
+
+        train_main.main([
+            "--synthetic", "--min_traces_per_entry", "10",
+            "--artifact_dir", art, "--epochs", "2", "--batch_size", "8",
+            "--hidden_channels", "8", "--label_scale", "1000",
+            "--graph_type", "pert"])
+        out = capsys.readouterr().out
+        assert "Epoch: 1" in out
+        assert "graphs/s" in out
+
+    def test_train_cli_with_mesh_and_checkpoint(self, tmp_path, capsys):
+        import jax
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4 fake devices")
+        from pertgnn_tpu.cli import train_main
+
+        train_main.main([
+            "--synthetic", "--min_traces_per_entry", "10",
+            "--synthetic_entries", "3", "--synthetic_traces_per_entry", "30",
+            "--artifact_dir", str(tmp_path / "p2"),
+            "--epochs", "1", "--batch_size", "8", "--hidden_channels", "8",
+            "--data_parallel", "2", "--model_parallel", "2",
+            "--checkpoint_dir", str(tmp_path / "ck")])
+        out = capsys.readouterr().out
+        assert "Epoch: 0" in out
+        assert os.path.isdir(str(tmp_path / "ck"))

@@ -196,3 +196,59 @@ def test_root_sanitized_away_degrades_gracefully():
     assert (g.node_depth == 0).all()
     p = build_pert_graph(df)
     assert (p.node_depth == 0).all()
+
+
+def test_sanitize_traces_matches_per_trace(preprocessed):
+    """Vectorized multi-trace sanitization == per-trace sanitize_edges."""
+    from pertgnn_tpu.graphs.construct import sanitize_traces
+    import pandas as pd
+
+    spans = preprocessed.spans
+    sanitized, roots = sanitize_traces(spans)
+    for tid, grp in list(spans.groupby("traceid"))[:30]:
+        root = find_root(grp)
+        assert roots[tid] == root
+        want = sanitize_edges(grp, root)
+        got = sanitized[sanitized["traceid"] == tid]
+        pd.testing.assert_frame_equal(got, want)
+
+
+class TestNativeParity:
+    def test_native_pert_matches_numpy(self, preprocessed):
+        from pertgnn_tpu.graphs.construct import build_runtime_graphs
+        from pertgnn_tpu.ingest.assemble import assemble
+        from pertgnn_tpu.native import bindings
+
+        if not bindings.available():
+            pytest.skip("native library unavailable")
+        table = assemble(preprocessed)
+        py = build_runtime_graphs(preprocessed, table, "pert",
+                                  use_native=False)
+        nat = bindings.build_runtime_graphs(preprocessed, table, "pert")
+        assert set(py) == set(nat)
+        for rid in py:
+            a, b = py[rid], nat[rid]
+            assert a.num_nodes == b.num_nodes
+            np.testing.assert_array_equal(a.senders, b.senders)
+            np.testing.assert_array_equal(a.receivers, b.receivers)
+            np.testing.assert_array_equal(a.edge_attr, b.edge_attr)
+            np.testing.assert_array_equal(a.ms_id, b.ms_id)
+            np.testing.assert_allclose(a.node_depth, b.node_depth, rtol=1e-6)
+
+    def test_auto_path_falls_back_when_native_broken(self, preprocessed,
+                                                     monkeypatch):
+        """use_native=None must fall back to numpy when the loader fails;
+        use_native=True must surface the error."""
+        from pertgnn_tpu.graphs.construct import build_runtime_graphs
+        from pertgnn_tpu.ingest.assemble import assemble
+        from pertgnn_tpu.native import bindings
+
+        def boom():
+            raise OSError("corrupt .so")
+
+        monkeypatch.setattr(bindings, "available", boom)
+        table = assemble(preprocessed)
+        auto = build_runtime_graphs(preprocessed, table, "pert")  # no raise
+        assert len(auto) == len(table.runtime2trace)
+        with pytest.raises(OSError):
+            build_runtime_graphs(preprocessed, table, "pert", use_native=True)
