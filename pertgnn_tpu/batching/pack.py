@@ -27,7 +27,12 @@ from pertgnn_tpu.batching.mixture import Mixture
 
 
 class PackedBatch(NamedTuple):
-    """One fixed-shape batch. All arrays are host numpy until device put."""
+    """One fixed-shape batch. All arrays are host numpy until device put.
+
+    Invariant: edge arrays are receiver-sorted with masked (pad) edges at
+    the tail (established in `pack_examples.flush`). Segment aggregation is
+    order-free so the XLA path doesn't care, but the fused Pallas kernel's
+    block-skipping relies on it (ops/pallas_attention.py assume_sorted)."""
 
     x: np.ndarray              # (N, F) float32 node features
     ms_id: np.ndarray          # (N,) int32
@@ -59,6 +64,23 @@ class BatchBudget:
 
 def _round_up(v: int, m: int = 128) -> int:
     return ((v + m - 1) // m) * m
+
+
+EDGE_FIELDS = ("senders", "receivers", "edge_iface", "edge_rpctype",
+               "edge_mask")
+
+
+def receiver_sort_edges(arrays: dict, sentinel: int) -> dict:
+    """Reorder all per-edge arrays by receiver, masked (pad) edges last —
+    the PackedBatch edge-order invariant. `sentinel` is the sort key for
+    masked edges (any value > the largest real node id). Shared by
+    pack_examples.flush and parallel.data_parallel.stack_batches so the
+    edge-field list can't drift between them."""
+    key = np.where(arrays["edge_mask"], arrays["receivers"], sentinel)
+    order = np.argsort(key, kind="stable")
+    for field in EDGE_FIELDS:
+        arrays[field] = arrays[field][order]
+    return arrays
 
 
 def derive_budget(mixtures: dict[int, Mixture], entry_ids: np.ndarray,
@@ -120,7 +142,11 @@ def pack_examples(
 
     def flush():
         nonlocal buf, g, n, e
-        batch = PackedBatch(**buf)
+        # Receiver-sort the edge arrays (pad edges to the tail). Segment
+        # aggregation is order-free, so this changes nothing for the XLA
+        # path, and it lets the fused Pallas kernel skip its in-jit sort
+        # (ops/pallas_attention.py assume_sorted).
+        batch = PackedBatch(**receiver_sort_edges(buf, budget.max_nodes))
         buf = new_batch()
         g = n = e = 0
         return batch

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from pertgnn_tpu.ops.segment import segment_softmax, segment_sum
+from pertgnn_tpu.ops.segment import segment_edge_attention
 
 
 class GraphTransformerLayer(nn.Module):
@@ -56,25 +56,24 @@ class GraphTransformerLayer(nn.Module):
         v = dense("value", True)(x)
         e = dense("edge", False)(edge_embeds)
 
-        q_e = q[receivers].reshape(-1, H, C)
         k_e = k[senders].reshape(-1, H, C) + e.reshape(-1, H, C)
         v_e = v[senders].reshape(-1, H, C) + e.reshape(-1, H, C)
 
         num_nodes = x.shape[0]
         if self.use_pallas and not (self.attn_dropout > 0.0 and training):
             from pertgnn_tpu.ops.pallas_attention import edge_attention
-            out = edge_attention(q_e, k_e, v_e, senders, receivers,
-                                 edge_mask, num_nodes)
+            out = edge_attention(q.reshape(-1, H, C), k_e, v_e, receivers,
+                                 edge_mask, num_nodes,
+                                 assume_sorted=True).astype(self.dtype)
         else:
-            scores = (q_e * k_e).sum(-1) / jnp.sqrt(
-                jnp.asarray(C, self.dtype))
-            alpha = segment_softmax(scores, receivers, num_nodes,
-                                    mask=edge_mask)
+            alpha_fn = None
             if self.attn_dropout > 0.0 and training:
-                alpha = nn.Dropout(rate=self.attn_dropout,
-                                   deterministic=False)(alpha)
-            msg = v_e * alpha[..., None]
-            out = segment_sum(msg.reshape(-1, H * C), receivers, num_nodes)
+                drop = nn.Dropout(rate=self.attn_dropout,
+                                  deterministic=False)
+                alpha_fn = lambda a: drop(a)
+            out = segment_edge_attention(
+                q.reshape(-1, H, C), k_e, v_e, receivers, edge_mask,
+                num_nodes, alpha_fn=alpha_fn)
         out = out + dense("skip", True)(x)
         return out
 

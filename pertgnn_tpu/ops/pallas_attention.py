@@ -1,16 +1,275 @@
-"""Pallas fused edge-attention kernel (extension point).
+"""Pallas fused edge-attention kernel (TPU).
 
-The default conv hot path is gather → score → segment softmax → segment sum
-(pertgnn_tpu/models/layers.py), which XLA already fuses well; this module
-will hold the hand-fused Pallas TPU kernel that does the whole edge pass in
-one HBM round-trip (dense-degree formulation: receiver-sorted incidence
-padded to the batch max in-degree, node-blocked in VMEM).
+The conv hot op is per-edge attention: score each edge against its
+destination node, softmax over each destination's incoming edges, and
+aggregate messages (the PyG `TransformerConv` inner loop the reference runs
+on CUDA scatter kernels, /root/reference/model.py:100-104). The default XLA
+path (pertgnn_tpu/models/layers.py) expresses it as gather → segment-softmax
+→ segment-sum, which materializes per-edge q/k/v intermediates in HBM
+between fusions.
+
+This kernel does the whole pass in one HBM round-trip, gather-free, shaped
+for the MXU:
+
+- edges are sorted by destination (receiver) — legal because segment
+  aggregation is order-free — and padded/masked edges are given receiver id
+  N so they sort to the tail and can never match a real node row;
+- the grid tiles (node blocks × edge blocks); for each tile the scores are a
+  dense `q_block @ k_edge_blockᵀ` matmul (MXU) masked by the incidence
+  `receiver[e] == node_id[n]` built from iota — the gather/scatter of the
+  segment formulation becomes a masked dense matmul, the standard TPU trick
+  for irregular access;
+- per-destination softmax runs as FlashAttention-style online accumulation
+  (running max / denominator / numerator in VMEM scratch) so nothing but
+  the final (BN, H*C) output block leaves the chip;
+- receiver-sorted order makes the incidence block-diagonal-ish: per node
+  block, `searchsorted` bounds (prefetched scalars) skip edge blocks that
+  cannot overlap, so work is O(E/N) blocks per node block, not O(E).
+
+Backward: `jax.custom_vjp` whose bwd recomputes through the XLA segment-op
+reference path (differentiable, numerically identical up to reduction
+order) — fused forward, recomputed backward, no saved per-edge softmax.
+
+Nodes with no (valid) incoming edges produce zeros, matching
+`segment_softmax` (an absent destination never appears in the scatter).
+
+When to use (measured on one TPU chip, f32): the kernel wins when
+destination in-degree is high enough that a (block_n × block_e) tile is
+densely populated — ~2.1x at N=512/E=1024/C=32 and ~1.5x at N=1k/E=4k —
+and loses on the sparse packed-batch regime of the flagship model
+(avg degree ~1.3, hidden 32: ~0.6x vs XLA's sorted-segment scatter, which
+is why `ModelConfig.use_pallas_attention` defaults to False). It is the
+right tool for the 5k-node giant-DAG stress shapes and wide-hidden
+variants, not for the default benchmark config.
 """
 
 from __future__ import annotations
 
+import functools
 
-def edge_attention(q_e, k_e, v_e, senders, receivers, edge_mask, num_nodes):
-    raise NotImplementedError(
-        "the Pallas fused edge-attention kernel is not implemented yet; "
-        "run with ModelConfig(use_pallas_attention=False)")
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pertgnn_tpu.ops.segment import segment_edge_attention
+
+_NEG = -1e30
+
+
+def _attention_kernel(it_ref, jdx_ref, valid_ref, q_ref, k_ref, v_ref,
+                      rcv_ref, out_ref, m_ref, l_ref, acc_ref, *, heads: int,
+                      head_dim: int, block_n: int, block_e: int):
+    t = pl.program_id(0)
+    i = it_ref[t]
+
+    # first step of a new node block → reset the online-softmax state
+    @pl.when((t == 0) | (i != it_ref[jnp.maximum(t - 1, 0)]))
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(valid_ref[t] == 1)
+    def _block():
+        rcv = rcv_ref[0, :]  # (BE,)
+        node_ids = i * block_n + jax.lax.broadcasted_iota(
+            jnp.int32, (block_n, block_e), 0)
+        incidence = node_ids == rcv[None, :]  # (BN, BE)
+        scale = 1.0 / float(np.sqrt(head_dim))
+        for h in range(heads):
+            sl = slice(h * head_dim, (h + 1) * head_dim)
+            qh = q_ref[:, sl]  # (BN, C)
+            kh = k_ref[:, sl]  # (BE, C)
+            vh = v_ref[:, sl]
+            scores = jax.lax.dot_general(
+                qh, kh, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST) * scale  # (BN, BE)
+            scores = jnp.where(incidence, scores, _NEG)
+            m_prev = m_ref[:, h:h + 1]                         # (BN, 1)
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(scores, axis=1, keepdims=True))
+            # explicit re-mask: when a row has no incidence yet,
+            # scores - m_new = 0 and exp would leak 1s
+            p = jnp.where(incidence, jnp.exp(scores - m_new), 0.0)
+            corr = jnp.exp(m_prev - m_new)                     # (BN, 1)
+            l_ref[:, h:h + 1] = (l_ref[:, h:h + 1] * corr
+                                 + jnp.sum(p, axis=1, keepdims=True))
+            acc_ref[:, sl] = acc_ref[:, sl] * corr + jnp.dot(
+                p, vh, preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+            m_ref[:, h:h + 1] = m_new
+
+    # last step of this node block (sentinel it[-1] = -1 closes the final
+    # block) → normalize and emit
+    @pl.when(it_ref[t + 1] != i)
+    def _finalize():
+        l = l_ref[:]  # (BN, H)
+        denom = jnp.where(l > 0, l, 1.0)
+        inv = (1.0 / denom)
+        # broadcast per-head inverse denominator across its head_dim lanes
+        out = acc_ref[:]
+        for h in range(heads):
+            sl = slice(h * head_dim, (h + 1) * head_dim)
+            out_ref[:, sl] = (out[:, sl] * inv[:, h:h + 1]).astype(
+                out_ref.dtype)
+
+
+def _round_up(v: int, m: int) -> int:
+    return ((v + m - 1) // m) * m
+
+
+def _pallas_forward(q, k_e, v_e, receivers, edge_mask, num_nodes: int,
+                    block_n: int, block_e: int, interpret: bool,
+                    assume_sorted: bool):
+    """q: (N, H, C); k_e, v_e: (E, H, C); returns (N, H*C) float32."""
+    n, heads, head_dim = q.shape
+    e = k_e.shape[0]
+    hd = heads * head_dim
+
+    # masked edges → receiver id `num_nodes`: they sort to the tail and can
+    # never equal a real node row in the incidence test
+    rcv_eff = jnp.where(edge_mask, receivers, num_nodes).astype(jnp.int32)
+    if assume_sorted:
+        # the batch layer already receiver-sorted the edges (pack.flush)
+        rcv_sorted = rcv_eff
+        k_s = k_e.reshape(e, hd).astype(jnp.float32)
+        v_s = v_e.reshape(e, hd).astype(jnp.float32)
+    else:
+        order = jnp.argsort(rcv_eff, stable=True)
+        rcv_sorted = rcv_eff[order]
+        k_s = k_e.reshape(e, hd)[order].astype(jnp.float32)
+        v_s = v_e.reshape(e, hd)[order].astype(jnp.float32)
+
+    n_pad = _round_up(max(n, block_n), block_n)
+    e_pad = _round_up(max(e, block_e), block_e)
+    q2 = jnp.zeros((n_pad, hd), jnp.float32).at[:n].set(
+        q.reshape(n, hd).astype(jnp.float32))
+    k_s = jnp.zeros((e_pad, hd), jnp.float32).at[:e].set(k_s)
+    v_s = jnp.zeros((e_pad, hd), jnp.float32).at[:e].set(v_s)
+    # pad edges also use receiver id num_nodes (matches nothing)
+    rcv_row = jnp.full((1, e_pad), num_nodes, jnp.int32).at[0, :e].set(
+        rcv_sorted)
+
+    num_node_blocks = n_pad // block_n
+    num_edge_blocks = e_pad // block_e
+    # per node block, the edge-block range that can contain its receivers
+    starts = jnp.arange(num_node_blocks, dtype=jnp.int32) * block_n
+    lo = (jnp.searchsorted(rcv_sorted, starts, side="left")
+          // block_e).astype(jnp.int32)
+    hi_edge = jnp.searchsorted(rcv_sorted, starts + block_n, side="left")
+    hi = ((hi_edge + block_e - 1) // block_e).astype(jnp.int32)
+    spans = jnp.maximum(hi - lo, 0)
+
+    # Flatten (node block, covered edge blocks) into ONE 1-D grid of active
+    # steps — a node block with span s gets max(s, 1) consecutive steps (the
+    # span-0 step still inits+finalizes its zero output). Total steps are
+    # statically bounded: sum(spans) <= num_edge_blocks + num_node_blocks
+    # (an edge block is covered once, +1 for each boundary/empty row), so
+    # the grid is T = nNB + nEB with tail steps deduplicated (same block
+    # indices → no DMA) and masked off via `valid`.
+    steps = jnp.maximum(spans, 1)
+    cum = jnp.cumsum(steps)
+    total = cum[-1]
+    t_max = num_node_blocks + num_edge_blocks
+    t_arr = jnp.arange(t_max, dtype=jnp.int32)
+    in_range = t_arr < total
+    it = jnp.searchsorted(cum, t_arr, side="right").astype(jnp.int32)
+    it = jnp.where(in_range, jnp.minimum(it, num_node_blocks - 1),
+                   num_node_blocks - 1)
+    jt = t_arr - (cum - steps)[it]                    # position within row
+    jdx = jnp.clip(lo[it] + jnp.minimum(jt, jnp.maximum(spans[it] - 1, 0)),
+                   0, num_edge_blocks - 1).astype(jnp.int32)
+    valid = (in_range & (spans[it] > 0)
+             & (jt < spans[it])).astype(jnp.int32)
+    it_seq = jnp.concatenate(
+        [it, jnp.full((1,), -1, jnp.int32)])          # sentinel closes last
+
+    kernel = functools.partial(
+        _attention_kernel, heads=heads, head_dim=head_dim, block_n=block_n,
+        block_e=block_e)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(t_max,),
+        in_specs=[
+            pl.BlockSpec((block_n, hd), lambda t, it, jdx, v: (it[t], 0)),
+            pl.BlockSpec((block_e, hd), lambda t, it, jdx, v: (jdx[t], 0)),
+            pl.BlockSpec((block_e, hd), lambda t, it, jdx, v: (jdx[t], 0)),
+            pl.BlockSpec((1, block_e), lambda t, it, jdx, v: (0, jdx[t])),
+        ],
+        out_specs=pl.BlockSpec((block_n, hd),
+                               lambda t, it, jdx, v: (it[t], 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_n, heads), jnp.float32),  # running max
+            pltpu.VMEM((block_n, heads), jnp.float32),  # running denom
+            pltpu.VMEM((block_n, hd), jnp.float32),     # running numerator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_pad, hd), jnp.float32),
+        interpret=interpret,
+    )(it_seq, jdx, valid, q2, k_s, v_s, rcv_row)
+    return out[:n]
+
+
+def _reference(q, k_e, v_e, receivers, edge_mask, num_nodes: int):
+    """Float32 view of the segment path, used for the fused bwd recompute."""
+    return segment_edge_attention(q, k_e, v_e, receivers, edge_mask,
+                                  num_nodes).astype(jnp.float32)
+
+
+def edge_attention(q, k_e, v_e, receivers, edge_mask, num_nodes: int,
+                   *, block_n: int = 128, block_e: int = 128,
+                   interpret: bool | None = None,
+                   assume_sorted: bool = False):
+    """Fused edge attention: q (N, H, C); k_e, v_e (E, H, C) edge-level
+    (already source-gathered + edge-projected); receivers (E,) int;
+    edge_mask (E,) bool. Returns (N, H*C) float32.
+
+    `assume_sorted=True` skips the in-jit receiver sort; only pass it for
+    batches whose edges are already receiver-sorted with masked edges at
+    the tail (guaranteed by batching/pack.py).
+
+    Differentiable w.r.t. q/k_e/v_e; backward recomputes via the segment-op
+    path (no per-edge softmax residuals saved).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    @jax.custom_vjp
+    def _fused(q, k_e, v_e):
+        if not assume_sorted:
+            return _pallas_forward(q, k_e, v_e, receivers, edge_mask,
+                                   num_nodes, block_n, block_e, interpret,
+                                   assume_sorted=False)
+        # Guard the PackedBatch invariant: the kernel's block-skipping
+        # ranges silently drop edges on unsorted input, so verify
+        # monotonicity on-device (O(E)) and fall back to the segment path
+        # for violating batches — slow but never wrong.
+        rcv_eff = jnp.where(edge_mask, receivers, num_nodes)
+        is_sorted = jnp.all(jnp.diff(rcv_eff) >= 0)
+        return jax.lax.cond(
+            is_sorted,
+            lambda q, k, v: _pallas_forward(
+                q, k, v, receivers, edge_mask, num_nodes, block_n, block_e,
+                interpret, assume_sorted=True),
+            lambda q, k, v: _reference(q, k, v, receivers, edge_mask,
+                                       num_nodes),
+            q, k_e, v_e)
+
+    def _fwd(q, k_e, v_e):
+        return _fused(q, k_e, v_e), (q, k_e, v_e)
+
+    def _bwd(res, g):
+        q, k_e, v_e = res
+        _, vjp = jax.vjp(
+            lambda q, k, v: _reference(q, k, v, receivers, edge_mask,
+                                       num_nodes), q, k_e, v_e)
+        return vjp(g)
+
+    _fused.defvjp(_fwd, _bwd)
+    return _fused(q, k_e, v_e)

@@ -57,6 +57,31 @@ def segment_softmax(scores: jax.Array, segment_ids: jax.Array,
     return expd / denom[segment_ids]
 
 
+def segment_edge_attention(q: jax.Array, k_e: jax.Array, v_e: jax.Array,
+                           receivers: jax.Array, edge_mask: jax.Array,
+                           num_nodes: int, alpha_fn=None) -> jax.Array:
+    """The XLA segment-op formulation of edge attention — the single source
+    of truth for the op's math (PyG TransformerConv semantics,
+    /root/reference/model.py:100-104). Used by GraphTransformerLayer's
+    default path AND as the recompute target of the fused Pallas kernel's
+    backward (ops/pallas_attention.py), so the two can never drift apart.
+
+    q: (N, H, C); k_e, v_e: (E, H, C) edge-level (source-gathered +
+    edge-projected); returns (N, H*C). `alpha_fn` optionally transforms the
+    (E, H) attention weights after the softmax (the layer passes attention
+    dropout through it)."""
+    n, heads, head_dim = q.shape
+    q_e = q[receivers]
+    scores = (q_e * k_e).sum(-1) / jnp.sqrt(
+        jnp.asarray(head_dim, q.dtype))
+    alpha = segment_softmax(scores, receivers, num_nodes, mask=edge_mask)
+    if alpha_fn is not None:
+        alpha = alpha_fn(alpha)
+    msg = v_e * alpha[..., None]
+    return segment_sum(msg.reshape(-1, heads * head_dim), receivers,
+                       num_nodes)
+
+
 def segment_mean_by_graph(node_values: jax.Array, node_graph: jax.Array,
                           weights: jax.Array, num_graphs: int) -> jax.Array:
     """Probability-weighted pooling: sum over nodes of value * weight per

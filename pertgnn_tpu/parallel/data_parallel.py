@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from pertgnn_tpu.batching.pack import PackedBatch
+from pertgnn_tpu.batching.pack import PackedBatch, receiver_sort_edges
 from pertgnn_tpu.config import Config
 from pertgnn_tpu.models.pert_model import PertGNN
 from pertgnn_tpu.parallel.mesh import batch_shardings, state_shardings
@@ -49,7 +49,11 @@ def stack_batches(batches: Sequence[PackedBatch]) -> PackedBatch:
                 a = a + d * g
             parts.append(a)
         out[field] = np.concatenate(parts)
-    return PackedBatch(**out)
+    # Restore the PackedBatch receiver-sorted invariant (pack.py): the
+    # concatenation interleaves each shard's pad-edge tail between shards'
+    # sorted runs, which would silently break the Pallas kernel's
+    # searchsorted block-skipping on the global edge array.
+    return PackedBatch(**receiver_sort_edges(out, n * len(batches)))
 
 
 def grouped_batches(batches: Iterator[PackedBatch],

@@ -141,6 +141,9 @@ def _tiny_batch(num_graphs=3, n=20, e=24, f=9, pad_nodes=0, pad_edges=0,
         g = rng.integers(0, num_graphs)
         nodes = np.where((node_graph == g) & node_mask)[0]
         senders[j], receivers[j] = rng.choice(nodes, 2)
+    # honor the PackedBatch contract: real edges receiver-sorted, pads tail
+    order = np.argsort(receivers[:e], kind="stable")
+    senders[:e], receivers[:e] = senders[:e][order], receivers[:e][order]
     edge_mask = np.zeros(E, dtype=bool)
     edge_mask[:e] = True
     pattern_size = np.ones(N, dtype=np.float32)
