@@ -33,7 +33,7 @@ def build_workload():
         ingest=IngestConfig(min_traces_per_entry=5),
         data=DataConfig(max_traces=100_000, batch_size=170),
         model=ModelConfig(hidden_channels=32, num_layers=3),
-        train=TrainConfig(lr=3e-4, label_scale=1000.0),
+        train=TrainConfig(lr=3e-4, label_scale=1000.0, scan_chunk=8),
         graph_type="pert",
     )
     data = synthetic.generate(synthetic.SyntheticSpec(
@@ -50,28 +50,29 @@ def bench_jax(ds, cfg, steps: int = 200) -> float:
     import optax
 
     from pertgnn_tpu.models.pert_model import make_model
-    from pertgnn_tpu.train.loop import create_train_state, make_train_step
+    from pertgnn_tpu.train.loop import (create_train_state, make_train_chunk,
+                                        _chunk_iter)
 
     model = make_model(cfg.model, ds.num_ms, ds.num_entries,
                        ds.num_interfaces, ds.num_rpctypes)
     tx = optax.adam(cfg.train.lr)
-    host_batches = list(ds.batches("train"))[:8]
-    counts = [int(b.graph_mask.sum()) for b in host_batches]
-    batches = [jax.tree.map(jnp.asarray, b) for b in host_batches]
-    state = create_train_state(model, tx, batches[0], cfg.train.seed)
-    step = make_train_step(model, cfg, tx)
+    host_batches = list(ds.batches("train"))[:cfg.train.scan_chunk]
+    graphs_per_chunk = sum(int(b.graph_mask.sum()) for b in host_batches)
+    chunk_batch = next(_chunk_iter(iter(host_batches), cfg.train.scan_chunk))
+    b0 = jax.tree.map(lambda a: jnp.asarray(a[0]), chunk_batch)
+    state = create_train_state(model, tx, b0, cfg.train.seed)
+    chunk = make_train_chunk(model, cfg, tx)
 
-    state, m = step(state, batches[0])  # compile
+    state, m = chunk(state, chunk_batch)  # compile
     jax.block_until_ready(m["qloss_sum"])
 
-    graphs = 0
+    n_chunks = max(1, steps // cfg.train.scan_chunk)
     t0 = time.perf_counter()
-    for i in range(steps):
-        state, m = step(state, batches[i % len(batches)])
-        graphs += counts[i % len(batches)]
+    for _ in range(n_chunks):
+        state, m = chunk(state, chunk_batch)
     jax.block_until_ready(m["qloss_sum"])  # single sync at the end
     dt = time.perf_counter() - t0
-    return graphs / dt
+    return n_chunks * graphs_per_chunk / dt
 
 
 def bench_torch_baseline(ds, cfg, steps: int = 6) -> float:

@@ -70,12 +70,8 @@ def grouped_batches(batches: Iterator[PackedBatch],
             yield stack_batches(group)
             group = []
     if group:
-        last = group[-1]
-        pad = last._replace(
-            node_mask=np.zeros_like(last.node_mask),
-            edge_mask=np.zeros_like(last.edge_mask),
-            graph_mask=np.zeros_like(last.graph_mask),
-        )
+        from pertgnn_tpu.train.loop import _zero_masked
+        pad = _zero_masked(group[-1])
         while len(group) < num_shards:
             group.append(pad)
         yield stack_batches(group)

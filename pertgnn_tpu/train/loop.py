@@ -114,6 +114,96 @@ def make_eval_step(model: PertGNN, cfg: Config) -> Callable:
     return jax.jit(eval_step_fn(model, cfg))
 
 
+_METRIC_KEYS = ("mae_sum", "mape_sum", "qloss_sum", "count")
+
+
+def make_train_chunk(model: PertGNN, cfg: Config,
+                     tx: optax.GradientTransformation) -> Callable:
+    """ONE dispatched program running `scan_chunk` train steps via lax.scan
+    over a leading-stacked PackedBatch. Per-step dispatch latency dominates
+    this workload (TrainConfig.scan_chunk); fusing K steps amortizes it K x.
+
+    Pure-padding batches (all graph_mask False — the tail filler) skip the
+    optimizer update under lax.cond so the step counter and Adam moments
+    advance exactly once per REAL batch, as in the per-step path."""
+    step = train_step_fn(model, cfg, tx)
+
+    def chunk(state: TrainState, batches: PackedBatch):
+        def body(s, b):
+            def run(s):
+                return step(s, b)
+
+            def skip(s):
+                return s, {k: jnp.zeros((), jnp.float32)
+                           for k in _METRIC_KEYS}
+
+            return jax.lax.cond(jnp.any(b.graph_mask), run, skip, s)
+
+        state, ms = jax.lax.scan(body, state, batches)
+        return state, jax.tree.map(lambda a: a.sum(0), ms)
+
+    return jax.jit(chunk, donate_argnums=0)
+
+
+def make_eval_chunk(model: PertGNN, cfg: Config) -> Callable:
+    """Scan-fused eval over a leading-stacked PackedBatch → metric sums."""
+    step = eval_step_fn(model, cfg)
+
+    def chunk(state: TrainState, batches: PackedBatch):
+        def body(_, b):
+            # skip the forward for zero-masked tail fillers
+            m = jax.lax.cond(
+                jnp.any(b.graph_mask),
+                lambda: step(state, b),
+                lambda: {k: jnp.zeros((), jnp.float32)
+                         for k in _METRIC_KEYS})
+            return None, m
+
+        _, ms = jax.lax.scan(body, None, batches)
+        return jax.tree.map(lambda a: a.sum(0), ms)
+
+    return jax.jit(chunk)
+
+
+def _zero_masked(b: PackedBatch) -> PackedBatch:
+    """A pure-padding clone: identical shapes, every mask False."""
+    import numpy as np
+    return b._replace(node_mask=np.zeros_like(b.node_mask),
+                      edge_mask=np.zeros_like(b.edge_mask),
+                      graph_mask=np.zeros_like(b.graph_mask))
+
+
+def _chunk_iter(batches: Iterator[PackedBatch],
+                chunk_size: int) -> Iterator[PackedBatch]:
+    """Group host batches into leading-stacked chunks (tail zero-padded),
+    device-put one chunk ahead so H2D overlaps compute."""
+    import numpy as np
+
+    def stack(group):
+        if len(group) < chunk_size:
+            group = group + [_zero_masked(group[-1])] * (chunk_size
+                                                         - len(group))
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *group)
+        return jax.tree.map(jnp.asarray, stacked)
+
+    pending, group = None, []
+    for b in batches:
+        group.append(b)
+        if len(group) == chunk_size:
+            nxt = stack(group)
+            group = []
+            if pending is not None:
+                yield pending
+            pending = nxt
+    if group:
+        nxt = stack(group)
+        if pending is not None:
+            yield pending
+        pending = nxt
+    if pending is not None:
+        yield pending
+
+
 def _device_iter(batches: Iterator[PackedBatch]) -> Iterator[PackedBatch]:
     """Single-step prefetch: device-put the next batch while the current one
     computes (the reference's `data.to(device)` is a blocking copy per batch,
@@ -184,6 +274,16 @@ def fit(dataset: Dataset, cfg: Config,
             return (shard_batch(g, mesh, b_sh) for g in grouped_batches(
                 dataset.batches(split, shuffle=shuffle, seed=seed),
                 n_shards))
+    elif cfg.train.scan_chunk > 1:
+        # scan-fused stepping: one dispatch per `scan_chunk` steps
+        state = create_train_state(model, tx, sample, cfg.train.seed)
+        train_step = make_train_chunk(model, cfg, tx)
+        eval_step = make_eval_chunk(model, cfg)
+
+        def batch_stream(split, shuffle=False, seed=0):
+            return _chunk_iter(dataset.batches(split, shuffle=shuffle,
+                                               seed=seed),
+                               cfg.train.scan_chunk)
     else:
         state = create_train_state(model, tx, sample, cfg.train.seed)
         train_step = make_train_step(model, cfg, tx)
