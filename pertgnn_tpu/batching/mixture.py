@@ -29,6 +29,7 @@ class Mixture:
     receivers: np.ndarray      # (E,) int32
     edge_iface: np.ndarray     # (E,) int32
     edge_rpctype: np.ndarray   # (E,) int32
+    edge_duration: np.ndarray  # (E,) float32 — span |rt| ms (0 for pert)
     ms_id: np.ndarray          # (N,) int32
     node_depth: np.ndarray     # (N,) float32
     pattern_prob: np.ndarray   # (N,) float32 — this node's pattern's weight
@@ -51,6 +52,9 @@ def build_mixtures(
         receivers = np.concatenate(
             [g.receivers + off for g, off in zip(graphs, offsets)])
         edge_attr = np.concatenate([g.edge_attr[:, :2] for g in graphs])
+        edge_duration = np.concatenate(
+            [g.edge_durations if g.edge_durations is not None
+             else np.zeros(g.num_edges, np.float32) for g in graphs])
         ms_id = np.concatenate([g.ms_id for g in graphs])
         node_depth = np.concatenate([g.node_depth for g in graphs])
         pattern_prob = np.repeat(probs.astype(np.float32), sizes)
@@ -61,6 +65,7 @@ def build_mixtures(
             receivers=receivers.astype(np.int32),
             edge_iface=edge_attr[:, 0].astype(np.int32),
             edge_rpctype=edge_attr[:, 1].astype(np.int32),
+            edge_duration=edge_duration.astype(np.float32),
             ms_id=ms_id.astype(np.int32),
             node_depth=node_depth.astype(np.float32),
             pattern_prob=pattern_prob,

@@ -45,6 +45,7 @@ class PackedBatch(NamedTuple):
     receivers: np.ndarray      # (E,) int32
     edge_iface: np.ndarray     # (E,) int32
     edge_rpctype: np.ndarray   # (E,) int32
+    edge_duration: np.ndarray  # (E,) float32 — span |rt| ms (0 for pert/pad)
     edge_mask: np.ndarray      # (E,) bool
     entry_id: np.ndarray       # (G,) int32
     y: np.ndarray              # (G,) float32
@@ -67,7 +68,7 @@ def _round_up(v: int, m: int = 128) -> int:
 
 
 EDGE_FIELDS = ("senders", "receivers", "edge_iface", "edge_rpctype",
-               "edge_mask")
+               "edge_duration", "edge_mask")
 
 
 def receiver_sort_edges(arrays: dict, sentinel: int) -> dict:
@@ -81,6 +82,15 @@ def receiver_sort_edges(arrays: dict, sentinel: int) -> dict:
     for field in EDGE_FIELDS:
         arrays[field] = arrays[field][order]
     return arrays
+
+
+def zero_masked(b: PackedBatch) -> PackedBatch:
+    """A pure-padding clone of `b`: identical shapes, every mask False.
+    Used as inert tail filler by the scan-chunked train loop and the
+    data-parallel global-batch grouper."""
+    return b._replace(node_mask=np.zeros_like(b.node_mask),
+                      edge_mask=np.zeros_like(b.edge_mask),
+                      graph_mask=np.zeros_like(b.graph_mask))
 
 
 def derive_budget(mixtures: dict[int, Mixture], entry_ids: np.ndarray,
@@ -131,6 +141,7 @@ def pack_examples(
             receivers=np.zeros(budget.max_edges, dtype=np.int32),
             edge_iface=np.zeros(budget.max_edges, dtype=np.int32),
             edge_rpctype=np.zeros(budget.max_edges, dtype=np.int32),
+            edge_duration=np.zeros(budget.max_edges, dtype=np.float32),
             edge_mask=np.zeros(budget.max_edges, dtype=bool),
             entry_id=np.zeros(G, dtype=np.int32),
             y=np.zeros(G, dtype=np.float32),
@@ -177,6 +188,7 @@ def pack_examples(
         buf["receivers"][es] = mix.receivers + n
         buf["edge_iface"][es] = mix.edge_iface
         buf["edge_rpctype"][es] = mix.edge_rpctype
+        buf["edge_duration"][es] = mix.edge_duration
         buf["edge_mask"][es] = True
         buf["entry_id"][g] = entry
         buf["y"][g] = y

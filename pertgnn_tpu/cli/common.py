@@ -30,6 +30,7 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--num_heads", type=int, default=1)
     p.add_argument("--label_scale", type=float, default=1.0)
     p.add_argument("--use_node_depth", action="store_true")
+    p.add_argument("--use_edge_durations", action="store_true")
     p.add_argument("--nonnegative_pred", action="store_true")
     p.add_argument("--local_loss_weight", type=float, default=0.0)
     p.add_argument("--bf16", action="store_true")
@@ -41,6 +42,9 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile_dir", default="",
                    help="write a jax.profiler trace of epoch 2 here")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--scan_chunk", type=int, default=16,
+                   help="train/eval steps fused into one dispatched "
+                        "lax.scan program; 1 disables scan fusion")
 
 
 def add_ingest_flags(p: argparse.ArgumentParser) -> None:
@@ -69,12 +73,14 @@ def config_from_args(args: argparse.Namespace) -> Config:
             num_heads=args.num_heads,
             dropout=args.dropout,
             use_node_depth=args.use_node_depth,
+            use_edge_durations=args.use_edge_durations,
             nonnegative_pred=args.nonnegative_pred,
             local_loss_weight=args.local_loss_weight,
             bf16_activations=args.bf16),
         train=TrainConfig(
             lr=args.lr, tau=args.tau, epochs=args.epochs,
             label_scale=args.label_scale, seed=args.seed,
+            scan_chunk=args.scan_chunk,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_keep=args.checkpoint_keep),
         parallel=ParallelConfig(data_parallel=args.data_parallel,

@@ -101,6 +101,13 @@ class ModelConfig:
     missing_indicator_is_one: bool = True
     # Use the Pallas fused edge-attention kernel for the conv hot op.
     use_pallas_attention: bool = False
+    # Feed span edge durations |rt| (log1p-compressed) as an extra edge
+    # feature. The reference computes these but never persists or uses them
+    # (misc.py:183-186 vs preprocess.py:333-340) — exposed here as the
+    # capability option SURVEY.md §2.3 calls for. No-op for pert graphs
+    # (durations are zero there; the reference's PERT duration code is
+    # commented out, misc.py:259-269).
+    use_edge_durations: bool = False
     # Parameter/activation dtype for the MXU. Params stay f32; activations in
     # bf16 when True.
     bf16_activations: bool = False

@@ -64,6 +64,14 @@ class GraphSpec:
     ms_id: np.ndarray       # (N,) int32 — microservice id per node
     node_depth: np.ndarray  # (N,) float32 — normalized min depth from root
     num_nodes: int
+    # (E,) float32 per-edge span duration |rt| in ms, or None (= zeros).
+    # The reference computes these for span graphs but never persists them
+    # (misc.py:183-186 vs preprocess.py:333-340 — dead output); here they
+    # are carried through and exposed to the model behind
+    # ModelConfig.use_edge_durations (SURVEY.md §2.3 "declared-but-dead").
+    # PERT graphs get None: the reference's PERT duration machinery is
+    # commented out in full (misc.py:259-269, 321-361).
+    edge_durations: np.ndarray | None = None
 
     @property
     def num_edges(self) -> int:
@@ -181,6 +189,8 @@ def build_span_graph(trace_df: pd.DataFrame, *, sanitized: pd.DataFrame
         ms_id=unique_ms.astype(np.int32),
         node_depth=_normalized_depth(depth),
         num_nodes=num_nodes,
+        edge_durations=df["rt"].abs().to_numpy(
+            dtype=np.float32),  # misc.py:183-186
     )
 
 

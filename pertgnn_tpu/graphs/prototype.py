@@ -1,0 +1,70 @@
+"""Cluster-prototype graphs (the reference's legacy kmeans pipeline).
+
+The reference ships an abandoned clustering pipeline
+(/root/reference/get_data_list.py, orphaned helpers misc.py:23-49) that
+represented each trace *cluster* by a prototype DAG: the union of the
+cluster's span edges, weighted by how often each (um, dm) edge occurs
+across the cluster's traces (`get_dag_prototype_from_trace_cluster`,
+misc.py:23-45 — only the "graph_union" merge method was ever implemented;
+"graph_dtw" exits). Its inputs (`cluster2graph.pt`, `tr2data.joblib`) are
+produced by no current code (SURVEY.md §2.1 "Dead legacy script"), so the
+live pipeline never calls it — but it is a real capability of the codebase,
+re-provided here in clean numpy for anyone migrating a clustering-based
+workflow.
+
+`merge_label_spaces` mirrors `update_max_kmeans_label` (misc.py:48-49): the
+running offset used to keep per-entry kmeans label spaces disjoint when
+clusters from several entries land in one table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pandas as pd
+
+
+@dataclasses.dataclass(frozen=True)
+class PrototypeGraph:
+    """Weighted union DAG of a trace cluster's span edges."""
+
+    senders: np.ndarray      # (E,) int64 — um of each distinct edge
+    receivers: np.ndarray    # (E,) int64 — dm
+    edge_weight: np.ndarray  # (E,) float32 — occurrence count over cluster
+
+    @property
+    def num_edges(self) -> int:
+        return len(self.senders)
+
+
+def dag_prototype_from_cluster(cluster_spans: pd.DataFrame,
+                               merge_method: str = "graph_union",
+                               ) -> PrototypeGraph:
+    """Prototype DAG for one cluster of traces (misc.py:23-45 semantics).
+
+    `cluster_spans`: span rows of every trace in the cluster (needs `um`,
+    `dm` columns). Distinct (um, dm) edges are weighted by their occurrence
+    count, ordered count-descending with first-appearance tie-break — the
+    reference's `value_counts()` ordering.
+    """
+    if merge_method != "graph_union":
+        # the reference sys.exit()s on anything else (misc.py:39-43)
+        raise ValueError(
+            f"merge method {merge_method!r} is not supported "
+            "(only 'graph_union'; the reference's 'graph_dtw' was never "
+            "implemented)")
+    counts = cluster_spans[["um", "dm"]].value_counts()
+    edges = counts.index.to_frame(index=False)
+    return PrototypeGraph(
+        senders=edges["um"].to_numpy(dtype=np.int64),
+        receivers=edges["dm"].to_numpy(dtype=np.int64),
+        edge_weight=counts.to_numpy(dtype=np.float32),
+    )
+
+
+def merge_label_spaces(kmeans_labels: np.ndarray,
+                       max_label_so_far: int) -> int:
+    """Next label offset after appending a cluster table whose labels are
+    `kmeans_labels` (misc.py:48-49)."""
+    return int(np.max(kmeans_labels)) + max_label_so_far + 1

@@ -52,10 +52,14 @@ class PertGNN(nn.Module):
             embedding_init=nn.initializers.normal(1.0))
         ms_emb = embed("ms_embed", self.num_ms)(batch.ms_id)
         x = jnp.concatenate([batch.x.astype(dtype), ms_emb], axis=1)
-        edge_embeds = jnp.concatenate([
+        edge_parts = [
             embed("interface_embed", self.num_interfaces)(batch.edge_iface),
             embed("rpctype_embed", self.num_rpctypes)(batch.edge_rpctype),
-        ], axis=1)
+        ]
+        if cfg.use_edge_durations:
+            edge_parts.append(
+                jnp.log1p(batch.edge_duration).astype(dtype)[:, None])
+        edge_embeds = jnp.concatenate(edge_parts, axis=1)
 
         conv_kwargs = dict(out_channels=hidden, heads=cfg.num_heads,
                            dtype=dtype, attn_dropout=cfg.attn_dropout,

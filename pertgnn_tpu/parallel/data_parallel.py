@@ -19,7 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from pertgnn_tpu.batching.pack import PackedBatch, receiver_sort_edges
+from pertgnn_tpu.batching.pack import (PackedBatch, receiver_sort_edges,
+                                        zero_masked)
 from pertgnn_tpu.config import Config
 from pertgnn_tpu.models.pert_model import PertGNN
 from pertgnn_tpu.parallel.mesh import batch_shardings, state_shardings
@@ -70,8 +71,7 @@ def grouped_batches(batches: Iterator[PackedBatch],
             yield stack_batches(group)
             group = []
     if group:
-        from pertgnn_tpu.train.loop import _zero_masked
-        pad = _zero_masked(group[-1])
+        pad = zero_masked(group[-1])
         while len(group) < num_shards:
             group.append(pad)
         yield stack_batches(group)

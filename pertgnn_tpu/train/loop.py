@@ -26,7 +26,7 @@ import optax
 from flax import struct
 
 from pertgnn_tpu.batching.dataset import Dataset
-from pertgnn_tpu.batching.pack import PackedBatch
+from pertgnn_tpu.batching.pack import PackedBatch, zero_masked
 from pertgnn_tpu.config import Config
 from pertgnn_tpu.models.pert_model import PertGNN, make_model
 from pertgnn_tpu.train.metrics import masked_metric_sums, quantile_loss
@@ -165,43 +165,27 @@ def make_eval_chunk(model: PertGNN, cfg: Config) -> Callable:
     return jax.jit(chunk)
 
 
-def _zero_masked(b: PackedBatch) -> PackedBatch:
-    """A pure-padding clone: identical shapes, every mask False."""
+def _host_chunks(batches: Iterator[PackedBatch],
+                 chunk_size: int) -> Iterator[PackedBatch]:
+    """Leading-stack host batches into chunks of `chunk_size` (tail padded
+    with inert zero-mask clones)."""
     import numpy as np
-    return b._replace(node_mask=np.zeros_like(b.node_mask),
-                      edge_mask=np.zeros_like(b.edge_mask),
-                      graph_mask=np.zeros_like(b.graph_mask))
+
+    group: list[PackedBatch] = []
+    for b in batches:
+        group.append(b)
+        if len(group) == chunk_size:
+            yield jax.tree.map(lambda *xs: np.stack(xs), *group)
+            group = []
+    if group:
+        group += [zero_masked(group[-1])] * (chunk_size - len(group))
+        yield jax.tree.map(lambda *xs: np.stack(xs), *group)
 
 
 def _chunk_iter(batches: Iterator[PackedBatch],
                 chunk_size: int) -> Iterator[PackedBatch]:
-    """Group host batches into leading-stacked chunks (tail zero-padded),
-    device-put one chunk ahead so H2D overlaps compute."""
-    import numpy as np
-
-    def stack(group):
-        if len(group) < chunk_size:
-            group = group + [_zero_masked(group[-1])] * (chunk_size
-                                                         - len(group))
-        stacked = jax.tree.map(lambda *xs: np.stack(xs), *group)
-        return jax.tree.map(jnp.asarray, stacked)
-
-    pending, group = None, []
-    for b in batches:
-        group.append(b)
-        if len(group) == chunk_size:
-            nxt = stack(group)
-            group = []
-            if pending is not None:
-                yield pending
-            pending = nxt
-    if group:
-        nxt = stack(group)
-        if pending is not None:
-            yield pending
-        pending = nxt
-    if pending is not None:
-        yield pending
+    """Host chunking composed with the existing one-ahead device prefetch."""
+    return _device_iter(_host_chunks(batches, chunk_size))
 
 
 def _device_iter(batches: Iterator[PackedBatch]) -> Iterator[PackedBatch]:

@@ -252,3 +252,60 @@ class TestNativeParity:
         assert len(auto) == len(table.runtime2trace)
         with pytest.raises(OSError):
             build_runtime_graphs(preprocessed, table, "pert", use_native=True)
+
+
+class TestPrototype:
+    """Legacy cluster-prototype capability (misc.py:23-49 semantics)."""
+
+    def test_graph_union_weights_and_order(self):
+        import pandas as pd
+        from pertgnn_tpu.graphs.prototype import dag_prototype_from_cluster
+        spans = pd.DataFrame({
+            "um": [1, 1, 2, 1, 3, 2],
+            "dm": [2, 2, 4, 3, 5, 4],
+        })
+        proto = dag_prototype_from_cluster(spans)
+        got = {(int(s), int(r)): float(w) for s, r, w in
+               zip(proto.senders, proto.receivers, proto.edge_weight)}
+        assert got == {(1, 2): 2.0, (2, 4): 2.0, (1, 3): 1.0, (3, 5): 1.0}
+        # count-descending ordering (value_counts semantics)
+        assert list(proto.edge_weight) == sorted(proto.edge_weight,
+                                                 reverse=True)
+
+    def test_unsupported_merge_method_raises(self):
+        import pandas as pd
+        import pytest
+        from pertgnn_tpu.graphs.prototype import dag_prototype_from_cluster
+        with pytest.raises(ValueError):
+            dag_prototype_from_cluster(
+                pd.DataFrame({"um": [1], "dm": [2]}),
+                merge_method="graph_dtw")
+
+    def test_merge_label_spaces(self):
+        import numpy as np
+        from pertgnn_tpu.graphs.prototype import merge_label_spaces
+        assert merge_label_spaces(np.array([0, 2, 1]), 4) == 7
+
+
+def test_span_edge_durations_carried():
+    """Span builder persists |rt| per kept edge (the reference computes but
+    drops these, misc.py:183-186); pert graphs carry None -> zeros."""
+    import numpy as np
+    import pandas as pd
+    from pertgnn_tpu.graphs.construct import build_pert_graph, build_span_graph
+    df = pd.DataFrame({
+        "traceid": [0, 0, 0],
+        "rpcid": [0, 1, 2],
+        "um": [10, 10, 11],
+        "dm": [11, 12, 13],
+        "interface": [0, 1, 2],
+        "rpctype": [0, 0, 0],
+        "timestamp": [0.0, 1.0, 2.0],
+        "rt": [100.0, -40.0, 30.0],
+    })
+    df["endTimestamp"] = df["timestamp"] + df["rt"].abs()
+    span = build_span_graph(df)
+    assert span.edge_durations is not None
+    assert sorted(span.edge_durations.tolist()) == [30.0, 40.0, 100.0]
+    pert = build_pert_graph(df)
+    assert pert.edge_durations is None

@@ -164,6 +164,8 @@ def _tiny_batch(num_graphs=3, n=20, e=24, f=9, pad_nodes=0, pad_edges=0,
                             ).astype(np.int32),
         edge_rpctype=np.where(edge_mask, rng.integers(0, 3, E), 0
                               ).astype(np.int32),
+        edge_duration=np.where(edge_mask, rng.exponential(50.0, E),
+                               0.0).astype(np.float32),
         edge_mask=edge_mask,
         entry_id=np.arange(G, dtype=np.int32) % 4,
         y=rng.uniform(1, 10, G).astype(np.float32),
@@ -194,6 +196,7 @@ def _pad_batch(b: PackedBatch, extra_nodes: int, extra_edges: int,
         receivers=pad(b.receivers, extra_edges),
         edge_iface=pad(b.edge_iface, extra_edges),
         edge_rpctype=pad(b.edge_rpctype, extra_edges),
+        edge_duration=pad(b.edge_duration, extra_edges),
         edge_mask=pad(b.edge_mask, extra_edges),
         entry_id=pad(b.entry_id, extra_graphs),
         y=pad(b.y, extra_graphs),
@@ -261,3 +264,35 @@ def test_nonnegative_option():
     vars_ = model.init(jax.random.PRNGKey(2), b, training=False)
     gp, _ = model.apply(vars_, b, training=False)
     assert (np.asarray(gp) >= 0).all()
+
+
+def test_edge_durations_option():
+    """use_edge_durations feeds |rt| (log1p) as an extra edge feature —
+    output must change vs. the flag off, and padding stays invisible."""
+    b = jax.tree.map(jnp.asarray, _tiny_batch())
+    outs = {}
+    for flag in (False, True):
+        cfg = ModelConfig(hidden_channels=16, num_layers=2,
+                          use_edge_durations=flag)
+        model = make_model(cfg, num_ms=5, num_entries=4, num_interfaces=4,
+                           num_rpctypes=3)
+        vars_ = model.init(jax.random.PRNGKey(0), b, training=False)
+        outs[flag] = model.apply(vars_, b, training=False)[0]
+    assert not np.allclose(np.asarray(outs[False]), np.asarray(outs[True]))
+
+    cfg = ModelConfig(hidden_channels=16, num_layers=2,
+                      use_edge_durations=True)
+    model = make_model(cfg, num_ms=5, num_entries=4, num_interfaces=4,
+                       num_rpctypes=3)
+    small = _tiny_batch()
+    big = _pad_batch(small, extra_nodes=9, extra_edges=11)
+    vars_ = model.init(jax.random.PRNGKey(0),
+                       jax.tree.map(jnp.asarray, small), training=False)
+    gp_s = model.apply(vars_, jax.tree.map(jnp.asarray, small),
+                       training=False)[0]
+    gp_b = model.apply(vars_, jax.tree.map(jnp.asarray, big),
+                       training=False)[0]
+    n_real = int(small.graph_mask.sum())
+    np.testing.assert_allclose(np.asarray(gp_b)[:n_real],
+                               np.asarray(gp_s)[:n_real],
+                               rtol=2e-4, atol=1e-5)
