@@ -1,0 +1,258 @@
+"""Benchmark suite: the five BASELINE.json configs.
+
+    python benchmarks/run.py --config smoke_cpu|flagship_chip|dp8|deep_wide|giant_dag
+    python benchmarks/run.py --all [--out results.jsonl]
+
+Each config prints one JSON line (same shape as bench.py). The driver's
+headline bench stays bench.py; this suite covers the full BASELINE matrix:
+
+1. smoke_cpu      — "1-CSV subset CPU smoke test": tiny synthetic corpus
+                    through CSV round-trip + full pipeline + short training;
+                    reports final test MAE and graphs/s.
+2. flagship_chip  — paper-default hparams (hidden 32, batch 170, pert) on
+                    the available chip; training throughput (= bench.py).
+3. dp8            — data-parallel over an 8-device mesh (virtual CPU devices
+                    when only one real chip is visible), global batch x8;
+                    reports global graphs/s and per-device efficiency.
+4. deep_wide      — 8 layers, 256 hidden, 8 heads (compute stress);
+                    training throughput on the chip.
+5. giant_dag      — single ~5k-node PERT DAGs per batch (padding/segment-op
+                    stress); throughput for segment vs fused-Pallas
+                    attention paths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _dataset(spec_kwargs, cfg):
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+
+    data = synthetic.generate(synthetic.SyntheticSpec(**spec_kwargs))
+    pre = preprocess(data.spans, data.resources, cfg.ingest)
+    return build_dataset(pre, cfg)
+
+
+def _flagship_cfg(**model_overrides):
+    from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                    ModelConfig, TrainConfig)
+    model_kwargs = dict(hidden_channels=32, num_layers=3)
+    model_kwargs.update(model_overrides)
+    return Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(max_traces=100_000, batch_size=170),
+        model=ModelConfig(**model_kwargs),
+        train=TrainConfig(lr=3e-4, label_scale=1000.0, scan_chunk=8),
+        graph_type="pert",
+    )
+
+
+def _train_throughput(ds, cfg, steps: int = 160) -> float:
+    """graphs/s of the scan-fused train step on this backend."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import (_chunk_iter, create_train_state,
+                                        make_train_chunk)
+
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    tx = optax.adam(cfg.train.lr)
+    host = list(itertools.islice(ds.batches("train"),
+                                 cfg.train.scan_chunk))
+    graphs_per_chunk = sum(int(b.graph_mask.sum()) for b in host)
+    chunk_batch = next(_chunk_iter(iter(host), cfg.train.scan_chunk))
+    b0 = jax.tree.map(lambda a: jnp.asarray(a[0]), chunk_batch)
+    state = create_train_state(model, tx, b0, cfg.train.seed)
+    chunk = make_train_chunk(model, cfg, tx)
+    state, m = chunk(state, chunk_batch)
+    jax.block_until_ready(m["qloss_sum"])
+    n_chunks = max(1, steps // cfg.train.scan_chunk)
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        state, m = chunk(state, chunk_batch)
+    jax.block_until_ready(m["qloss_sum"])
+    return n_chunks * graphs_per_chunk / (time.perf_counter() - t0)
+
+
+def smoke_cpu() -> dict:
+    """Config 1: CSV round-trip + full pipeline + short training (any
+    backend; the driver's config names a CPU host)."""
+    import tempfile
+
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.io import load_raw_csvs
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.train.loop import fit
+
+    cfg = _flagship_cfg()
+    cfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, batch_size=32),
+        train=dataclasses.replace(cfg.train, epochs=5, scan_chunk=4))
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_entries=4, traces_per_entry=60, seed=3))
+    with tempfile.TemporaryDirectory() as d:
+        synthetic.write_csvs(data, d, shards=3)      # "1-CSV subset" shape
+        spans, resources = load_raw_csvs(d)
+    pre = preprocess(spans, resources, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+    t0 = time.perf_counter()
+    _, history = fit(ds, cfg)
+    dt = time.perf_counter() - t0
+    last = history[-1]
+    return {"metric": "smoke_test_mae", "value": round(last["test_mae"], 3),
+            "unit": "ms", "graphs_per_s": round(last["graphs_per_s"], 1),
+            "epochs": len(history), "wall_s": round(dt, 1),
+            "converged": bool(last["train_qloss"]
+                              < history[0]["train_qloss"])}
+
+
+def flagship_chip() -> dict:
+    cfg = _flagship_cfg()
+    ds = _dataset(dict(num_microservices=60, num_entries=8,
+                       patterns_per_entry=4, traces_per_entry=400, seed=42),
+                  cfg)
+    gps = _train_throughput(ds, cfg)
+    return {"metric": "flagship_train_graphs_per_s", "value": round(gps, 1),
+            "unit": "graphs/s", "config": "hidden32 L3 batch170 pert"}
+
+
+def dp8() -> dict:
+    """Config 3: 8-way data parallelism, global batch x8."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        raise SystemExit(
+            "dp8 needs 8 devices; run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "JAX_PLATFORMS=cpu for the virtual-mesh variant")
+    import jax.numpy as jnp
+    import optax
+
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.parallel.data_parallel import (
+        make_sharded_train_step, shard_batch, stack_batches)
+    from pertgnn_tpu.parallel.mesh import batch_shardings, make_mesh
+    from pertgnn_tpu.train.loop import create_train_state
+
+    cfg = _flagship_cfg()
+    cfg = cfg.replace(data=dataclasses.replace(cfg.data, batch_size=24))
+    ds = _dataset(dict(num_microservices=60, num_entries=8,
+                       patterns_per_entry=4, traces_per_entry=200, seed=42),
+                  cfg)
+    mesh = make_mesh(data=8, model=1, devices=jax.devices()[:8])
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    tx = optax.adam(cfg.train.lr)
+    host = list(ds.batches("train"))
+    glob = stack_batches((host * 8)[:8])   # 8 shards, repeat if few
+    graphs = int(glob.graph_mask.sum())
+    state = create_train_state(model, tx, glob, cfg.train.seed)
+    step, sh_state = make_sharded_train_step(model, cfg, tx, mesh, state)
+    b_sh = batch_shardings(mesh)
+    sharded = shard_batch(glob, mesh, b_sh)
+    sh_state, m = step(sh_state, sharded)
+    jax.block_until_ready(m["qloss_sum"])
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sh_state, m = step(sh_state, sharded)
+    jax.block_until_ready(m["qloss_sum"])
+    gps = iters * graphs / (time.perf_counter() - t0)
+    return {"metric": "dp8_global_train_graphs_per_s",
+            "value": round(gps, 1), "unit": "graphs/s",
+            "devices": 8, "backend": jax.default_backend()}
+
+
+def deep_wide() -> dict:
+    """Config 4: 8 layers, 256 hidden, 8 heads."""
+    cfg = _flagship_cfg(hidden_channels=256, num_layers=8, num_heads=8)
+    cfg = cfg.replace(
+        data=dataclasses.replace(cfg.data, batch_size=64),
+        train=dataclasses.replace(cfg.train, scan_chunk=4))
+    ds = _dataset(dict(num_microservices=60, num_entries=8,
+                       patterns_per_entry=4, traces_per_entry=200, seed=42),
+                  cfg)
+    gps = _train_throughput(ds, cfg, steps=40)
+    return {"metric": "deep_wide_train_graphs_per_s",
+            "value": round(gps, 1), "unit": "graphs/s",
+            "config": "hidden256 L8 H8 batch64 pert"}
+
+
+def giant_dag() -> dict:
+    """Config 5: ~5k-node PERT DAGs, one graph per batch; segment vs Pallas
+    attention paths."""
+    cfg = _flagship_cfg()
+    cfg = cfg.replace(data=dataclasses.replace(cfg.data, batch_size=1),
+                      train=dataclasses.replace(cfg.train, scan_chunk=2))
+    ds = _dataset(dict(num_microservices=1600, num_entries=2,
+                       patterns_per_entry=1,
+                       pattern_size_range=(1200, 1500),  # pert expands ~4x
+                       traces_per_entry=30, seed=7), cfg)
+    sample = next(ds.batches("train"))
+    nodes, edges = sample.x.shape[0], sample.senders.shape[0]
+    out = {"metric": "giant_dag_train_graphs_per_s", "unit": "graphs/s",
+           "padded_nodes": nodes, "padded_edges": edges}
+    gps = _train_throughput(ds, cfg, steps=16)
+    out["value"] = round(gps, 2)
+    cfg_p = cfg.replace(model=dataclasses.replace(
+        cfg.model, use_pallas_attention=True))
+    out["pallas_graphs_per_s"] = round(_train_throughput(ds, cfg_p,
+                                                         steps=16), 2)
+    return out
+
+
+CONFIGS = {
+    "smoke_cpu": smoke_cpu,
+    "flagship_chip": flagship_chip,
+    "dp8": dp8,
+    "deep_wide": deep_wide,
+    "giant_dag": giant_dag,
+}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", choices=sorted(CONFIGS))
+    p.add_argument("--all", action="store_true")
+    p.add_argument("--out", default="",
+                   help="also write the JSON rows to this file (jsonl)")
+    args = p.parse_args(argv)
+    names = sorted(CONFIGS) if args.all else [args.config]
+    if names == [None]:
+        p.error("pass --config NAME or --all")
+    rows = []
+    for name in names:
+        try:
+            row = CONFIGS[name]()
+            row["config_name"] = name
+        except SystemExit as e:
+            row = {"config_name": name, "skipped": str(e)}
+        except Exception as e:  # one failing config must not kill the suite
+            row = {"config_name": name,
+                   "failed": f"{type(e).__name__}: {e}"}
+        rows.append(row)
+        print(json.dumps(row))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write("".join(json.dumps(r) + "\n" for r in rows))
+
+
+if __name__ == "__main__":
+    main()
