@@ -1,10 +1,12 @@
 """Benchmark suite: the five BASELINE.json configs.
 
-    python benchmarks/run.py --config smoke_cpu|flagship_chip|dp8|deep_wide|giant_dag
+    python benchmarks/run.py --config smoke_cpu|flagship_chip|dp8|\
+        deep_wide|giant_dag|ingest_pipeline
     python benchmarks/run.py --all [--out results.jsonl]
 
 Each config prints one JSON line (same shape as bench.py). The driver's
-headline bench stays bench.py; this suite covers the full BASELINE matrix:
+headline bench stays bench.py; this suite covers the full BASELINE matrix
+plus a host data-path config:
 
 1. smoke_cpu      — "1-CSV subset CPU smoke test": tiny synthetic corpus
                     through CSV round-trip + full pipeline + short training;
@@ -19,6 +21,8 @@ headline bench stays bench.py; this suite covers the full BASELINE matrix:
 5. giant_dag      — single ~5k-node PERT DAGs per batch (padding/segment-op
                     stress); throughput for segment vs fused-Pallas
                     attention paths.
++  ingest_pipeline — host data path raw spans -> packed batches, traces/s
+                    (the reference's "10+ hour" offline build).
 """
 
 from __future__ import annotations
@@ -218,7 +222,42 @@ def giant_dag() -> dict:
     return out
 
 
+def ingest_pipeline() -> dict:
+    """Host data-path throughput: raw spans -> preprocess -> graphs ->
+    mixtures -> packed batches. The reference's equivalent (offline
+    data-list build) takes "10+ hours" for a 100k-trace subsample
+    (README.md:12, pert_gnn.py:176-188) ~= 2.8 traces/s of per-trace
+    Python loops; this measures the vectorized + native replacement."""
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.native import bindings
+
+    cfg = _flagship_cfg()
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=120, num_entries=24, patterns_per_entry=5,
+        traces_per_entry=800, seed=11))
+    n_traces = int(data.spans["traceid"].nunique())
+    t0 = time.perf_counter()
+    pre = preprocess(data.spans, data.resources, cfg.ingest)
+    t_pre = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ds = build_dataset(pre, cfg)
+    n_batches = sum(1 for split in ("train", "valid", "test")
+                    for _ in ds.batches(split))
+    t_build = time.perf_counter() - t0
+    total = t_pre + t_build
+    return {"metric": "ingest_traces_per_s",
+            "value": round(n_traces / total, 1), "unit": "traces/s",
+            "raw_traces": n_traces, "preprocess_s": round(t_pre, 2),
+            "dataset_build_s": round(t_build, 2),
+            "native_available": bindings.available(),
+            "packed_batches": n_batches,
+            "vs_reference_estimate": round((n_traces / total) / 2.8, 1)}
+
+
 CONFIGS = {
+    "ingest_pipeline": ingest_pipeline,
     "smoke_cpu": smoke_cpu,
     "flagship_chip": flagship_chip,
     "dp8": dp8,
