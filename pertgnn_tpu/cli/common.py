@@ -9,9 +9,25 @@ New capability flags are grouped after the parity flags.
 from __future__ import annotations
 
 import argparse
+import os
 
 from pertgnn_tpu.config import (Config, DataConfig, IngestConfig, ModelConfig,
                                 ParallelConfig, TrainConfig)
+
+
+def apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS even when a device plugin (e.g. the axon TPU
+    tunnel) takes precedence over the env var — needed for virtual-device
+    mesh runs (`JAX_PLATFORMS=cpu` +
+    `--xla_force_host_platform_device_count=N`). No-op once a backend is
+    initialized."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want:
+        import jax
+        try:
+            jax.config.update("jax_platforms", want)
+        except RuntimeError:
+            pass
 
 
 def add_model_train_flags(p: argparse.ArgumentParser) -> None:

@@ -16,6 +16,7 @@ import argparse
 
 from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
+                                    apply_platform_env,
                                     config_from_args, get_frames)
 from pertgnn_tpu.ingest.io import artifacts_present, load_artifacts, preprocess_cached
 from pertgnn_tpu.train.loop import fit
@@ -24,6 +25,7 @@ from pertgnn_tpu.utils.logging import setup_logging
 
 def main(argv=None) -> None:
     setup_logging()
+    apply_platform_env()
     p = argparse.ArgumentParser(description=__doc__)
     add_ingest_flags(p)
     add_model_train_flags(p)

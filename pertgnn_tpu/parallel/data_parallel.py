@@ -23,7 +23,9 @@ from pertgnn_tpu.batching.pack import (PackedBatch, receiver_sort_edges,
                                         zero_masked)
 from pertgnn_tpu.config import Config
 from pertgnn_tpu.models.pert_model import PertGNN
-from pertgnn_tpu.parallel.mesh import batch_shardings, state_shardings
+from pertgnn_tpu.parallel.mesh import (batch_shardings,
+                                       chunk_batch_shardings,
+                                       state_shardings)
 from pertgnn_tpu.train import loop as train_loop
 
 
@@ -116,3 +118,30 @@ def make_sharded_eval_step(model: PertGNN, cfg: Config, mesh,
     b_sh = batch_shardings(mesh)
     return jax.jit(train_loop.eval_step_fn(model, cfg),
                    in_shardings=(st_sh, b_sh), out_shardings=None)
+
+
+def make_sharded_train_chunk(model: PertGNN, cfg: Config,
+                             tx: optax.GradientTransformation, mesh,
+                             state) -> Callable:
+    """Scan-fused sharded stepping: `scan_chunk` global-batch steps in ONE
+    dispatched SPMD program (loop.train_chunk_fn jitted with mesh
+    shardings). The chunk's leading axis is the scan dim; each slice is a
+    global batch sharded over `data`. Same dispatch-amortization win as the
+    single-chip path — one launch per K steps instead of K.
+
+    Returns (chunk_fn, sharded_state)."""
+    st_sh = state_shardings(state, mesh)
+    cb_sh = chunk_batch_shardings(mesh)
+    state = jax.device_put(jax.tree.map(jnp.copy, state), st_sh)
+    jitted = jax.jit(train_loop.train_chunk_fn(model, cfg, tx),
+                     in_shardings=(st_sh, cb_sh),
+                     out_shardings=(st_sh, None), donate_argnums=0)
+    return jitted, state
+
+
+def make_sharded_eval_chunk(model: PertGNN, cfg: Config, mesh,
+                            state) -> Callable:
+    st_sh = state_shardings(state, mesh)
+    cb_sh = chunk_batch_shardings(mesh)
+    return jax.jit(train_loop.eval_chunk_fn(model, cfg),
+                   in_shardings=(st_sh, cb_sh), out_shardings=None)

@@ -56,6 +56,14 @@ def batch_shardings(mesh: Mesh) -> PackedBatch:
     return PackedBatch(*([s] * len(PackedBatch._fields)))
 
 
+def chunk_batch_shardings(mesh: Mesh) -> PackedBatch:
+    """Shardings for a leading-STACKED packed batch (scan chunk of global
+    batches): dim 0 is the scan axis (replicated), dim 1 is sharded over
+    `data`."""
+    s = NamedSharding(mesh, P(None, DATA_AXIS))
+    return PackedBatch(*([s] * len(PackedBatch._fields)))
+
+
 def _param_spec(path: tuple, leaf) -> P:
     """Tensor-parallel rule per parameter.
 

@@ -117,11 +117,13 @@ def make_eval_step(model: PertGNN, cfg: Config) -> Callable:
 _METRIC_KEYS = ("mae_sum", "mape_sum", "qloss_sum", "count")
 
 
-def make_train_chunk(model: PertGNN, cfg: Config,
-                     tx: optax.GradientTransformation) -> Callable:
-    """ONE dispatched program running `scan_chunk` train steps via lax.scan
+def train_chunk_fn(model: PertGNN, cfg: Config,
+                   tx: optax.GradientTransformation) -> Callable:
+    """UNJITTED scan-fused chunk: `scan_chunk` train steps in one program
     over a leading-stacked PackedBatch. Per-step dispatch latency dominates
     this workload (TrainConfig.scan_chunk); fusing K steps amortizes it K x.
+    Jitted plain here (make_train_chunk) and with mesh shardings by
+    parallel/data_parallel.make_sharded_train_chunk.
 
     Pure-padding batches (all graph_mask False — the tail filler) skip the
     optimizer update under lax.cond so the step counter and Adam moments
@@ -142,11 +144,12 @@ def make_train_chunk(model: PertGNN, cfg: Config,
         state, ms = jax.lax.scan(body, state, batches)
         return state, jax.tree.map(lambda a: a.sum(0), ms)
 
-    return jax.jit(chunk, donate_argnums=0)
+    return chunk
 
 
-def make_eval_chunk(model: PertGNN, cfg: Config) -> Callable:
-    """Scan-fused eval over a leading-stacked PackedBatch → metric sums."""
+def eval_chunk_fn(model: PertGNN, cfg: Config) -> Callable:
+    """UNJITTED scan-fused eval over a leading-stacked PackedBatch →
+    metric sums."""
     step = eval_step_fn(model, cfg)
 
     def chunk(state: TrainState, batches: PackedBatch):
@@ -162,7 +165,16 @@ def make_eval_chunk(model: PertGNN, cfg: Config) -> Callable:
         _, ms = jax.lax.scan(body, None, batches)
         return jax.tree.map(lambda a: a.sum(0), ms)
 
-    return jax.jit(chunk)
+    return chunk
+
+
+def make_train_chunk(model: PertGNN, cfg: Config,
+                     tx: optax.GradientTransformation) -> Callable:
+    return jax.jit(train_chunk_fn(model, cfg, tx), donate_argnums=0)
+
+
+def make_eval_chunk(model: PertGNN, cfg: Config) -> Callable:
+    return jax.jit(eval_chunk_fn(model, cfg))
 
 
 def _host_chunks(batches: Iterator[PackedBatch],
@@ -188,18 +200,23 @@ def _chunk_iter(batches: Iterator[PackedBatch],
     return _device_iter(_host_chunks(batches, chunk_size))
 
 
-def _device_iter(batches: Iterator[PackedBatch]) -> Iterator[PackedBatch]:
-    """Single-step prefetch: device-put the next batch while the current one
-    computes (the reference's `data.to(device)` is a blocking copy per batch,
-    pert_gnn.py:231)."""
+def _one_ahead(items):
+    """Yield each item one step behind the producer, so the (async)
+    device-put of the next item overlaps the consumer's compute."""
     pending = None
-    for b in batches:
-        nxt = jax.tree.map(jnp.asarray, b)
+    for nxt in items:
         if pending is not None:
             yield pending
         pending = nxt
     if pending is not None:
         yield pending
+
+
+def _device_iter(batches: Iterator[PackedBatch]) -> Iterator[PackedBatch]:
+    """Single-step prefetch: device-put the next batch while the current one
+    computes (the reference's `data.to(device)` is a blocking copy per batch,
+    pert_gnn.py:231)."""
+    return _one_ahead(jax.tree.map(jnp.asarray, b) for b in batches)
 
 
 def evaluate(eval_step: Callable, state: TrainState,
@@ -242,22 +259,39 @@ def fit(dataset: Dataset, cfg: Config,
     sample = next(dataset.batches("train"))
     if mesh is not None:
         from pertgnn_tpu.parallel.data_parallel import (
-            grouped_batches, make_sharded_eval_step, make_sharded_train_step,
-            shard_batch, stack_batches)
+            grouped_batches, make_sharded_eval_chunk, make_sharded_eval_step,
+            make_sharded_train_chunk, make_sharded_train_step, shard_batch,
+            stack_batches)
         n_shards = mesh.shape["data"]
         init_sample = stack_batches([sample] * n_shards)
         state = create_train_state(model, tx, init_sample, cfg.train.seed)
-        train_step, state = make_sharded_train_step(model, cfg, tx, mesh,
-                                                    state)
-        eval_step = make_sharded_eval_step(model, cfg, mesh, state)
+        if cfg.train.scan_chunk > 1:
+            # scan-fused SPMD: one dispatch per scan_chunk global batches
+            from pertgnn_tpu.parallel.mesh import chunk_batch_shardings
+            train_step, state = make_sharded_train_chunk(model, cfg, tx,
+                                                         mesh, state)
+            eval_step = make_sharded_eval_chunk(model, cfg, mesh, state)
+            cb_sh = chunk_batch_shardings(mesh)
 
-        from pertgnn_tpu.parallel.mesh import batch_shardings
-        b_sh = batch_shardings(mesh)
+            def batch_stream(split, shuffle=False, seed=0):
+                grouped = grouped_batches(
+                    dataset.batches(split, shuffle=shuffle, seed=seed),
+                    n_shards)
+                return _one_ahead(
+                    shard_batch(c, mesh, cb_sh) for c in
+                    _host_chunks(grouped, cfg.train.scan_chunk))
+        else:
+            train_step, state = make_sharded_train_step(model, cfg, tx,
+                                                        mesh, state)
+            eval_step = make_sharded_eval_step(model, cfg, mesh, state)
 
-        def batch_stream(split, shuffle=False, seed=0):
-            return (shard_batch(g, mesh, b_sh) for g in grouped_batches(
-                dataset.batches(split, shuffle=shuffle, seed=seed),
-                n_shards))
+            from pertgnn_tpu.parallel.mesh import batch_shardings
+            b_sh = batch_shardings(mesh)
+
+            def batch_stream(split, shuffle=False, seed=0):
+                return (shard_batch(g, mesh, b_sh) for g in grouped_batches(
+                    dataset.batches(split, shuffle=shuffle, seed=seed),
+                    n_shards))
     elif cfg.train.scan_chunk > 1:
         # scan-fused stepping: one dispatch per `scan_chunk` steps
         state = create_train_state(model, tx, sample, cfg.train.seed)
