@@ -75,14 +75,16 @@ def bench_jax(ds, cfg, steps: int = 200) -> float:
     return n_chunks * graphs_per_chunk / dt
 
 
-def bench_torch_baseline(ds, cfg, steps: int = 6) -> float:
-    """The reference's computation in torch on CPU, same batches."""
+def make_torch_reference(ds, cfg, f_in):
+    """The reference's computation re-implemented in torch (CPU): model,
+    one Adam train step, and a predict fn — used for the measured baseline
+    (bench_torch_baseline) and the quality-parity benchmark
+    (benchmarks/run.py). PyG TransformerConv semantics via scatter ops,
+    BatchNorm1d, pinball loss — the reference stack's behavior on the same
+    packed batches."""
     import torch
 
     hidden = cfg.model.hidden_channels
-    heads = 1
-    batches = list(ds.batches("train"))[:4]
-    f_in = batches[0].x.shape[1]
 
     class Conv(torch.nn.Module):
         def __init__(self, in_ch):
@@ -151,22 +153,36 @@ def bench_torch_baseline(ds, cfg, steps: int = 6) -> float:
                 d[f] = torch.tensor(a, dtype=torch.float32)
         return d
 
-    tbatches = [to_torch(b) for b in batches]
     model = Model()
     opt = torch.optim.Adam(model.parameters(), lr=cfg.train.lr)
     tau = cfg.train.tau
 
     def one_step(b):
+        model.train()
         opt.zero_grad()
         pred = model(b)
         e = b["y"] / cfg.train.label_scale - pred
         mask = b["graph_mask"].float()
         loss = (torch.maximum(tau * e, (tau - 1) * e)
-                * mask).sum() / mask.sum()
+                * mask).sum() / mask.sum().clamp_min(1.0)
         loss.backward()
         opt.step()
         return float(mask.sum())
 
+    @torch.no_grad()
+    def predict(b):
+        model.eval()
+        return (model(b) * cfg.train.label_scale).numpy()
+
+    return model, one_step, predict, to_torch
+
+
+def bench_torch_baseline(ds, cfg, steps: int = 6) -> float:
+    """The reference's computation in torch on CPU, same batches."""
+    batches = list(ds.batches("train"))[:4]
+    _, one_step, _, to_torch = make_torch_reference(
+        ds, cfg, batches[0].x.shape[1])
+    tbatches = [to_torch(b) for b in batches]
     one_step(tbatches[0])  # warm-up
     graphs = 0
     t0 = time.perf_counter()

@@ -12,7 +12,7 @@ DDP/NCCL (SURVEY.md §5.8; BASELINE config 3).
 
 from __future__ import annotations
 
-from typing import Callable, Iterator, Sequence
+from typing import Any, Callable, Iterator, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -94,7 +94,7 @@ def shard_batch(batch: PackedBatch, mesh,
 
 def make_sharded_train_step(model: PertGNN, cfg: Config,
                             tx: optax.GradientTransformation, mesh,
-                            state) -> Callable:
+                            state) -> tuple[Callable, Any]:
     """The single-chip train step (train/loop.py `train_step_fn` — one source
     of truth) jitted with mesh shardings.
 
@@ -122,7 +122,7 @@ def make_sharded_eval_step(model: PertGNN, cfg: Config, mesh,
 
 def make_sharded_train_chunk(model: PertGNN, cfg: Config,
                              tx: optax.GradientTransformation, mesh,
-                             state) -> Callable:
+                             state) -> tuple[Callable, Any]:
     """Scan-fused sharded stepping: `scan_chunk` global-batch steps in ONE
     dispatched SPMD program (loop.train_chunk_fn jitted with mesh
     shardings). The chunk's leading axis is the scan dim; each slice is a
