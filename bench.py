@@ -24,6 +24,8 @@ import numpy as np
 
 
 def build_workload():
+    import jax
+
     from pertgnn_tpu.batching import build_dataset
     from pertgnn_tpu.config import Config, DataConfig, IngestConfig, ModelConfig, TrainConfig
     from pertgnn_tpu.ingest import synthetic
@@ -32,7 +34,11 @@ def build_workload():
     cfg = Config(
         ingest=IngestConfig(min_traces_per_entry=5),
         data=DataConfig(max_traces=100_000, batch_size=170),
-        model=ModelConfig(hidden_channels=32, num_layers=3),
+        # the fused kernel runs compiled only on TPU; off-TPU it would
+        # fall to (very slow) interpret mode
+        model=ModelConfig(hidden_channels=32, num_layers=3,
+                          use_pallas_attention=(
+                              jax.default_backend() == "tpu")),
         train=TrainConfig(lr=3e-4, label_scale=1000.0, scan_chunk=8),
         graph_type="pert",
     )

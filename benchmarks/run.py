@@ -1,7 +1,7 @@
 """Benchmark suite: the five BASELINE.json configs.
 
     python benchmarks/run.py --config smoke_cpu|flagship_chip|dp8|\
-        deep_wide|giant_dag|ingest_pipeline
+        deep_wide|giant_dag|ingest_pipeline|quality_parity
     python benchmarks/run.py --all [--out results.jsonl]
 
 Each config prints one JSON line (same shape as bench.py). The driver's
@@ -23,6 +23,8 @@ plus a host data-path config:
                     attention paths.
 +  ingest_pipeline — host data path raw spans -> packed batches, traces/s
                     (the reference's "10+ hour" offline build).
++  quality_parity  — test MAE, ours vs the torch re-implementation of the
+                    reference stack, median over 3 seeds each.
 """
 
 from __future__ import annotations
@@ -259,7 +261,7 @@ def ingest_pipeline() -> dict:
 def quality_parity() -> dict:
     """Model-quality parity: our model vs the torch re-implementation of
     the reference's stack (bench.make_torch_reference), trained with the
-    same hparams for the same number of epochs on the SAME packed batches,
+    same hparams, epochs, and per-epoch shuffled+repacked batch stream,
     compared on held-out test MAE. The reference publishes no quality
     numbers (BASELINE.md), so this is the measurable stand-in."""
     import bench as bench_mod
@@ -286,18 +288,18 @@ def quality_parity() -> dict:
     # shuffles the train stream each epoch)
     import torch
 
-    train_b = list(ds.batches("train"))
+    sample = next(ds.batches("train"))
     torch_maes = []
     for seed in (0, 1, 2):
         torch.manual_seed(seed)
         _, one_step, predict, to_torch = bench_mod.make_torch_reference(
-            ds, cfg, train_b[0].x.shape[1])
-        t_train = [to_torch(b) for b in train_b]
+            ds, cfg, sample.x.shape[1])
         for epoch in range(epochs):
-            order = np.random.default_rng(
-                cfg.data.shuffle_seed + epoch).permutation(len(t_train))
-            for i in order:
-                one_step(t_train[i])
+            # same stream fit() trains on: shuffled + greedily re-packed
+            # per epoch (batching/dataset.py)
+            for b in ds.batches("train", shuffle=True,
+                                seed=cfg.data.shuffle_seed + epoch):
+                one_step(to_torch(b))
         err = n = 0.0
         for b in ds.batches("test"):
             pred = predict(to_torch(b))

@@ -2,5 +2,6 @@ from pertgnn_tpu.ops.segment import (
     segment_sum,
     segment_max,
     segment_softmax,
+    segment_edge_attention,
     segment_mean_by_graph,
 )

@@ -132,3 +132,25 @@ def test_model_forward_with_pallas_flag():
     np.testing.assert_allclose(np.asarray(outs[False][0]),
                                np.asarray(outs[True][0]),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_gradients_match_on_sorted_cond_path():
+    """The path the model actually differentiates: assume_sorted=True with
+    the runtime guard taking the fused-kernel branch (fwd + fused bwd)."""
+    rng = np.random.default_rng(9)
+    q, k, v, rcv, mask = _case(rng, 80, 320, 2, 16, sort=True)
+    assert (np.diff(np.where(np.asarray(mask), np.asarray(rcv), 80))
+            >= 0).all()
+
+    def loss_pal(q, k, v):
+        return (edge_attention(q, k, v, rcv, mask, 80,
+                               assume_sorted=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_reference(q, k, v, rcv, mask, 80) ** 2).sum()
+
+    g1 = jax.jit(jax.grad(loss_pal, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
