@@ -1,0 +1,342 @@
+"""Vectorized epoch packing over pre-built arenas.
+
+`pack.pack_examples` is the readable reference packer: a per-example Python
+loop that re-gathers node features and copies array slices for every example
+of every epoch. That loop caps the host at ~9k traces/s while the chip
+consumes millions of graphs/s — on fresh data the device starves (the
+reference has the same disease much worse: its per-batch host loop rebuilds
+mixture probabilities every step, /root/reference/pert_gnn.py:219-231).
+
+This module removes the per-example work from the epoch path:
+
+- `MixtureArena` — every entry's mixture arrays concatenated ONCE into flat
+  node/edge arenas with per-entry (start, count) index tables. Built at
+  dataset construction; epoch packing only gathers from it.
+- `FeatureArena` — node features depend only on (ts_bucket, entry's ms ids),
+  so they are gathered ONCE per unique (entry, ts_bucket) pair of a split
+  (one vectorized ResourceLookup call for all pairs together) and re-used by
+  every epoch.
+- `pack_epoch` — packs a whole epoch (any example order) into fixed-shape
+  batches using O(#vectorized-ops) numpy: a scalar greedy pass assigns
+  examples to batches (the same greedy rule as `pack_examples`, bitwise
+  identical output — see tests/test_batching.py fast/slow parity), then
+  ragged-arange gathers scatter nodes/edges/graphs of ALL examples at once,
+  and one composite-key argsort receiver-sorts every batch's edges together.
+
+Memory is bounded by packing in slabs of `slab_batches` batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, NamedTuple
+
+import numpy as np
+
+from pertgnn_tpu.batching.featurize import ResourceLookup
+from pertgnn_tpu.batching.mixture import Mixture
+from pertgnn_tpu.batching.pack import BatchBudget, PackedBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtureArena:
+    """All entries' mixtures concatenated into flat arenas.
+
+    Per-entry views are `node arena[node_start[e] : node_start[e] +
+    node_count[e]]` (same for edges); senders/receivers stay entry-local
+    (0-based within the mixture) and are offset at pack time.
+    """
+
+    node_start: np.ndarray    # (num_entries,) int64, -1 for absent entries
+    node_count: np.ndarray    # (num_entries,) int64
+    edge_start: np.ndarray
+    edge_count: np.ndarray
+    # Node/edge arrays carry ONE extra sentinel row at the end (the pad
+    # row: ms 0 / depth 0 / prob 0 / size 1; sender/receiver 0 / attrs 0),
+    # so index-batch gathers need no masking: pad positions simply index
+    # the sentinel. `node_sentinel`/`edge_sentinel` are its index.
+    ms_id: np.ndarray         # (total_nodes+1,) int32
+    node_depth: np.ndarray    # (total_nodes+1,) float32
+    pattern_prob: np.ndarray  # (total_nodes+1,) float32
+    pattern_size: np.ndarray  # (total_nodes+1,) float32
+    senders: np.ndarray       # (total_edges+1,) int32 — entry-local
+    receivers: np.ndarray     # (total_edges+1,) int32 — entry-local
+    edge_iface: np.ndarray    # (total_edges+1,) int32
+    edge_rpctype: np.ndarray  # (total_edges+1,) int32
+    edge_duration: np.ndarray # (total_edges+1,) float32
+
+    @property
+    def node_sentinel(self) -> int:
+        return len(self.ms_id) - 1
+
+    @property
+    def edge_sentinel(self) -> int:
+        return len(self.senders) - 1
+
+
+def build_mixture_arena(mixtures: dict[int, Mixture]) -> MixtureArena:
+    num_entries = 1 + max(mixtures.keys())
+    node_start = np.full(num_entries, -1, dtype=np.int64)
+    node_count = np.zeros(num_entries, dtype=np.int64)
+    edge_start = np.full(num_entries, -1, dtype=np.int64)
+    edge_count = np.zeros(num_entries, dtype=np.int64)
+    entries = sorted(mixtures.keys())
+    n = e = 0
+    for ent in entries:
+        m = mixtures[ent]
+        node_start[ent], node_count[ent] = n, m.num_nodes
+        edge_start[ent], edge_count[ent] = e, m.num_edges
+        n += m.num_nodes
+        e += m.num_edges
+    mixes = [mixtures[ent] for ent in entries]
+    # Pre-sort each mixture's edges stably by local receiver. A packed
+    # batch's examples occupy disjoint increasing node ranges, so the
+    # batch-level receiver sort (pack.receiver_sort_edges) decomposes into
+    # exactly this per-example order — storing it here removes any sorting
+    # from the epoch path.
+    eorders = [np.argsort(m.receivers, kind="stable") for m in mixes]
+
+    def cat_n(f, pad):
+        parts = [getattr(m, f) for m in mixes]
+        tail = np.array([pad], dtype=parts[0].dtype if parts else np.float32)
+        return np.concatenate(parts + [tail])
+
+    def cat_e(f, pad):
+        parts = [getattr(m, f)[o] for m, o in zip(mixes, eorders)]
+        tail = np.array([pad], dtype=parts[0].dtype if parts else np.float32)
+        return np.concatenate(parts + [tail])
+
+    return MixtureArena(
+        node_start=node_start, node_count=node_count,
+        edge_start=edge_start, edge_count=edge_count,
+        ms_id=cat_n("ms_id", 0), node_depth=cat_n("node_depth", 0.0),
+        pattern_prob=cat_n("pattern_prob", 0.0),
+        pattern_size=cat_n("pattern_size", 1.0),
+        senders=cat_e("senders", 0), receivers=cat_e("receivers", 0),
+        edge_iface=cat_e("edge_iface", 0),
+        edge_rpctype=cat_e("edge_rpctype", 0),
+        edge_duration=cat_e("edge_duration", 0.0))
+
+
+def _ragged_arange(counts: np.ndarray) -> np.ndarray:
+    """[0..c0), [0..c1), ... concatenated: arange per count, flattened."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64)
+    excl = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    return np.arange(total, dtype=np.int64) - np.repeat(excl, counts)
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureArena:
+    """Pre-gathered node features per unique (entry, ts_bucket) pair of a
+    split. `pair_of_example[i]` indexes `feat_start`; feature rows for the
+    example are `x[feat_start[p] : feat_start[p] + node_count[entry]]` and
+    align with the entry's mixture-arena node order. The last row of `x` is
+    an all-zero sentinel (index `sentinel`) for pad positions."""
+
+    pair_of_example: np.ndarray  # (num_examples,) int64
+    feat_start: np.ndarray       # (num_pairs,) int64
+    x: np.ndarray                # (total_rows+1, F) float32
+
+    @property
+    def sentinel(self) -> int:
+        return len(self.x) - 1
+
+
+def build_feature_arena(arena: MixtureArena, entry_ids: np.ndarray,
+                        ts_buckets: np.ndarray, lookup: ResourceLookup,
+                        node_depth_in_x: bool = False) -> FeatureArena:
+    pairs = np.stack([entry_ids.astype(np.int64),
+                      ts_buckets.astype(np.int64)], axis=1)
+    uniq, pair_of_example = np.unique(pairs, axis=0, return_inverse=True)
+    u_entry, u_bucket = uniq[:, 0], uniq[:, 1]
+    counts = arena.node_count[u_entry]
+    feat_start = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int64)
+    ragged = _ragged_arange(counts)
+    src = np.repeat(arena.node_start[u_entry], counts) + ragged
+    ms = arena.ms_id[src].astype(np.int64)
+    buckets = np.repeat(u_bucket, counts)
+    x = lookup(buckets, ms)
+    if node_depth_in_x:
+        x = np.concatenate([x, arena.node_depth[src][:, None]], axis=1)
+    x = np.concatenate([x, np.zeros((1, x.shape[1]), np.float32)])
+    return FeatureArena(pair_of_example=pair_of_example.astype(np.int64),
+                        feat_start=feat_start, x=x)
+
+
+def assign_batches(node_counts: np.ndarray, edge_counts: np.ndarray,
+                   budget: BatchBudget
+                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """The greedy packing rule of `pack_examples`, sizes only.
+
+    Returns per-example (batch_idx, graph_slot, node_offset, edge_offset).
+    Pure scalar arithmetic — the only per-example Python in the fast path.
+    """
+    n_ex = len(node_counts)
+    batch_idx = np.zeros(n_ex, dtype=np.int64)
+    graph_slot = np.zeros(n_ex, dtype=np.int64)
+    node_off = np.zeros(n_ex, dtype=np.int64)
+    edge_off = np.zeros(n_ex, dtype=np.int64)
+    nc = node_counts.tolist()
+    ec = edge_counts.tolist()
+    b = g = n = e = 0
+    max_g, max_n, max_e = budget.max_graphs, budget.max_nodes, budget.max_edges
+    for i in range(n_ex):
+        cn, ce = nc[i], ec[i]
+        if cn > max_n or ce > max_e:
+            raise ValueError(
+                f"example {i} mixture ({cn} nodes, {ce} edges) exceeds "
+                f"budget {budget}")
+        if g + 1 > max_g or n + cn > max_n or e + ce > max_e:
+            b += 1
+            g = n = e = 0
+        batch_idx[i], graph_slot[i] = b, g
+        node_off[i], edge_off[i] = n, e
+        g += 1
+        n += cn
+        e += ce
+    return batch_idx, graph_slot, node_off, edge_off
+
+
+class IndexBatch(NamedTuple):
+    """The per-batch gather recipe — everything the device needs to
+    materialize one PackedBatch from resident arenas.
+
+    Positions are already in the PackedBatch layout: real nodes/edges
+    occupy a prefix (edges receiver-sorted — arena pre-sort + disjoint
+    per-example node ranges make the scattered order sorted by
+    construction), pads the tail. Pad positions hold the arena sentinel
+    index, so gathers need no masking; masks are recovered on device by
+    comparing against the sentinel.
+    """
+
+    src_node: np.ndarray       # (N,) int32 into node arenas; pad: sentinel
+    src_feat: np.ndarray       # (N,) int32 into FeatureArena.x; pad: sentinel
+    node_graph: np.ndarray     # (N,) int32 graph slot; pad: G-1
+    src_edge: np.ndarray       # (E,) int32 into edge arenas; pad: sentinel
+    edge_node_off: np.ndarray  # (E,) int32 batch node offset; pad: 0
+    entry_id: np.ndarray       # (G,) int32
+    y: np.ndarray              # (G,) float32
+    graph_mask: np.ndarray     # (G,) bool
+
+    @property
+    def num_graphs(self) -> int:
+        return len(self.entry_id)
+
+
+def pack_epoch_indices(
+    arena: MixtureArena,
+    feats: FeatureArena,
+    entry_ids: np.ndarray,
+    ys: np.ndarray,
+    budget: BatchBudget,
+    order: np.ndarray | None = None,
+    slab_batches: int = 128,
+) -> Iterator[IndexBatch]:
+    """Pack an epoch into IndexBatches with whole-slab vectorized index
+    arithmetic — the only per-example Python left is `assign_batches`."""
+    if order is None:
+        order = np.arange(len(entry_ids))
+    ex_entry = entry_ids[order].astype(np.int64)
+    ex_y = ys[order].astype(np.float32)
+    ex_pair = feats.pair_of_example[order]
+    counts_n = arena.node_count[ex_entry]
+    counts_e = arena.edge_count[ex_entry]
+    batch_idx, graph_slot, node_off, edge_off = assign_batches(
+        counts_n, counts_e, budget)
+    num_batches = int(batch_idx[-1]) + 1 if len(batch_idx) else 0
+    G = budget.max_graphs + 1  # +1 reserved pad graph slot
+
+    for slab0 in range(0, num_batches, slab_batches):
+        slab1 = min(slab0 + slab_batches, num_batches)
+        B = slab1 - slab0
+        sel = (batch_idx >= slab0) & (batch_idx < slab1)
+        s_entry = ex_entry[sel]
+        s_cn, s_ce = counts_n[sel], counts_e[sel]
+        s_bi = batch_idx[sel] - slab0
+        s_gs, s_no, s_eo = graph_slot[sel], node_off[sel], edge_off[sel]
+
+        rag_n = _ragged_arange(s_cn)
+        dst_n = np.repeat(s_bi * budget.max_nodes + s_no, s_cn) + rag_n
+        src_node = np.full(B * budget.max_nodes, arena.node_sentinel,
+                           dtype=np.int32)
+        src_feat = np.full(B * budget.max_nodes, feats.sentinel,
+                           dtype=np.int32)
+        node_graph = np.full(B * budget.max_nodes, G - 1, dtype=np.int32)
+        src_node[dst_n] = np.repeat(arena.node_start[s_entry], s_cn) + rag_n
+        src_feat[dst_n] = np.repeat(feats.feat_start[ex_pair[sel]],
+                                    s_cn) + rag_n
+        node_graph[dst_n] = np.repeat(s_gs, s_cn).astype(np.int32)
+
+        rag_e = _ragged_arange(s_ce)
+        dst_e = np.repeat(s_bi * budget.max_edges + s_eo, s_ce) + rag_e
+        src_edge = np.full(B * budget.max_edges, arena.edge_sentinel,
+                           dtype=np.int32)
+        edge_node_off = np.zeros(B * budget.max_edges, dtype=np.int32)
+        src_edge[dst_e] = np.repeat(arena.edge_start[s_entry], s_ce) + rag_e
+        edge_node_off[dst_e] = np.repeat(s_no, s_ce).astype(np.int32)
+
+        entry_arr = np.zeros(B * G, dtype=np.int32)
+        y_arr = np.zeros(B * G, dtype=np.float32)
+        graph_mask = np.zeros(B * G, dtype=bool)
+        dst_g = s_bi * G + s_gs
+        entry_arr[dst_g] = s_entry.astype(np.int32)
+        y_arr[dst_g] = ex_y[sel]
+        graph_mask[dst_g] = True
+
+        def r2(a, per):  # (B*per,) -> (B, per)
+            return a.reshape(B, per)
+
+        slab = IndexBatch(
+            src_node=r2(src_node, budget.max_nodes),
+            src_feat=r2(src_feat, budget.max_nodes),
+            node_graph=r2(node_graph, budget.max_nodes),
+            src_edge=r2(src_edge, budget.max_edges),
+            edge_node_off=r2(edge_node_off, budget.max_edges),
+            entry_id=r2(entry_arr, G), y=r2(y_arr, G),
+            graph_mask=r2(graph_mask, G))
+        for i in range(B):
+            yield IndexBatch(*(a[i] for a in slab))
+
+
+def materialize_host(arena: MixtureArena, feats: FeatureArena,
+                     idx: IndexBatch) -> PackedBatch:
+    """Numpy twin of `materialize.materialize_device` — turns a gather
+    recipe into a full PackedBatch on the host (used off-TPU and as the
+    parity oracle for the device path)."""
+    node_mask = idx.src_node != arena.node_sentinel
+    edge_mask = idx.src_edge != arena.edge_sentinel
+    return PackedBatch(
+        x=feats.x[idx.src_feat],
+        ms_id=arena.ms_id[idx.src_node],
+        node_depth=arena.node_depth[idx.src_node],
+        node_graph=idx.node_graph,
+        node_mask=node_mask,
+        pattern_prob=arena.pattern_prob[idx.src_node],
+        pattern_size=arena.pattern_size[idx.src_node],
+        senders=arena.senders[idx.src_edge] + idx.edge_node_off,
+        receivers=arena.receivers[idx.src_edge] + idx.edge_node_off,
+        edge_iface=arena.edge_iface[idx.src_edge],
+        edge_rpctype=arena.edge_rpctype[idx.src_edge],
+        edge_duration=arena.edge_duration[idx.src_edge],
+        edge_mask=edge_mask,
+        entry_id=idx.entry_id, y=idx.y, graph_mask=idx.graph_mask)
+
+
+def pack_epoch(
+    arena: MixtureArena,
+    feats: FeatureArena,
+    entry_ids: np.ndarray,
+    ts_buckets: np.ndarray,   # kept for signature symmetry; features come
+    ys: np.ndarray,           # pre-gathered via `feats`
+    budget: BatchBudget,
+    order: np.ndarray | None = None,
+    slab_batches: int = 128,
+) -> Iterator[PackedBatch]:
+    """Yield the same PackedBatch stream `pack_examples` would produce for
+    `entry_ids[order]`: vectorized index build + host materialization."""
+    del ts_buckets  # folded into `feats` at arena-build time
+    for idx in pack_epoch_indices(arena, feats, entry_ids, ys, budget,
+                                  order=order, slab_batches=slab_batches):
+        yield materialize_host(arena, feats, idx)

@@ -16,6 +16,9 @@ from typing import Iterator, Sequence
 import numpy as np
 
 from pertgnn_tpu.config import Config
+from pertgnn_tpu.batching.arena import (
+    FeatureArena, IndexBatch, MixtureArena, build_feature_arena,
+    build_mixture_arena, materialize_host, pack_epoch_indices)
 from pertgnn_tpu.batching.featurize import ResourceLookup
 from pertgnn_tpu.batching.mixture import Mixture, build_mixtures
 from pertgnn_tpu.batching.pack import (
@@ -56,9 +59,93 @@ class Dataset:
     num_rpctypes: int
     node_feature_dim: int
     config: Config
+    # Built lazily: shared mixture arena + ONE feature arena over all
+    # splits' (entry, ts_bucket) pairs (shared so chip-resident arenas have
+    # one shape -> one compile for train and eval), plus a packed-batch
+    # cache for the deterministic unshuffled splits (valid/test are
+    # identical every epoch — pack them once).
+    _arena: MixtureArena | None = None
+    _feat_all: FeatureArena | None = None
+    _feat_slices: dict = dataclasses.field(default_factory=dict)
+    _epoch_cache: dict = dataclasses.field(default_factory=dict)
+
+    def arena(self) -> MixtureArena:
+        if self._arena is None:
+            self._arena = build_mixture_arena(self.mixtures)
+        return self._arena
+
+    def feat_arena(self) -> FeatureArena:
+        """The whole-dataset feature arena (all splits' unique pairs)."""
+        if self._feat_all is None:
+            names = list(self.splits)
+            entry_ids = np.concatenate(
+                [self.splits[n].entry_ids for n in names])
+            ts_buckets = np.concatenate(
+                [self.splits[n].ts_buckets for n in names])
+            self._feat_all = build_feature_arena(
+                self.arena(), entry_ids, ts_buckets, self.lookup,
+                node_depth_in_x=self.config.model.use_node_depth)
+            off = 0
+            for n in names:
+                self._feat_slices[n] = slice(off, off + len(self.splits[n]))
+                off += len(self.splits[n])
+        return self._feat_all
+
+    def _feat_arena(self, split: str) -> FeatureArena:
+        """Split view of the shared arena: same rows, per-split examples."""
+        full = self.feat_arena()
+        return dataclasses.replace(
+            full, pair_of_example=full.pair_of_example[
+                self._feat_slices[split]])
+
+    def _cacheable(self, split: str, shuffle: bool) -> bool:
+        # Only the deterministic EVAL splits are re-consumed identically
+        # every epoch; caching "train" would eagerly pack the whole split
+        # just because fit() peeks at one init sample.
+        return not shuffle and split != "train"
+
+    def index_batches(self, split: str, shuffle: bool = False,
+                      seed: int = 0) -> Iterator[IndexBatch]:
+        """Gather-recipe stream for device-side materialization
+        (batching/materialize.py). Deterministic eval splits are cached."""
+        s = self.splits[split]
+        key = ("idx", split)
+        if self._cacheable(split, shuffle) and key in self._epoch_cache:
+            yield from self._epoch_cache[key]
+            return
+        order = np.arange(len(s))
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(order)
+        stream = pack_epoch_indices(
+            self.arena(), self._feat_arena(split), s.entry_ids, s.ys,
+            self.budget, order=order)
+        if self._cacheable(split, shuffle):
+            cached = list(stream)
+            self._epoch_cache[key] = cached
+            yield from cached
+        else:
+            yield from stream
 
     def batches(self, split: str, shuffle: bool = False,
                 seed: int = 0) -> Iterator[PackedBatch]:
+        if self._cacheable(split, shuffle) and split in self._epoch_cache:
+            yield from self._epoch_cache[split]
+            return
+        stream = (materialize_host(self.arena(), self._feat_arena(split), i)
+                  for i in self.index_batches(split, shuffle=shuffle,
+                                              seed=seed))
+        if self._cacheable(split, shuffle):
+            cached = list(stream)
+            self._epoch_cache[split] = cached
+            yield from cached
+        else:
+            yield from stream
+
+    def batches_slow(self, split: str, shuffle: bool = False,
+                     seed: int = 0) -> Iterator[PackedBatch]:
+        """The readable per-example reference packer (`pack_examples`);
+        `batches()` is the vectorized arena path and must match it batch for
+        batch (tests/test_batching.py parity)."""
         s = self.splits[split]
         order = np.arange(len(s))
         if shuffle:

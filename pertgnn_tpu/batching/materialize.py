@@ -1,0 +1,107 @@
+"""Device-side batch materialization from chip-resident arenas.
+
+The TPU-native answer to the host-packing bottleneck: topology and feature
+arenas are static for a whole run, so they live in HBM (placed once), and
+each step the host ships only a small int32 gather recipe (`IndexBatch`,
+~1/4 the bytes of a full PackedBatch). The first thing the jitted train step
+does is materialize the PackedBatch with device gathers — pure
+HBM-bandwidth work that XLA fuses with the model's own input reads. Host
+cost per epoch collapses to index arithmetic (`arena.pack_epoch_indices`).
+
+Contrast with the reference, which re-does per-batch host collation +
+feature lookup inside its train loop every epoch
+(/root/reference/pert_gnn.py:219-231, 40-67).
+
+`materialize_device` must stay the exact twin of `arena.materialize_host`
+(tests/test_batching.py device/host parity).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pertgnn_tpu.batching.arena import FeatureArena, IndexBatch, MixtureArena
+from pertgnn_tpu.batching.pack import PackedBatch
+
+
+class DeviceArenas(NamedTuple):
+    """Chip-resident copies of the mixture + (per-split) feature arenas.
+    Sentinel conventions are inherited from the host arenas: the last
+    node/edge/feature row is the pad row."""
+
+    ms_id: jnp.ndarray
+    node_depth: jnp.ndarray
+    pattern_prob: jnp.ndarray
+    pattern_size: jnp.ndarray
+    senders: jnp.ndarray
+    receivers: jnp.ndarray
+    edge_iface: jnp.ndarray
+    edge_rpctype: jnp.ndarray
+    edge_duration: jnp.ndarray
+    feat_x: jnp.ndarray
+
+    @property
+    def node_sentinel(self) -> int:
+        return self.ms_id.shape[0] - 1
+
+    @property
+    def edge_sentinel(self) -> int:
+        return self.senders.shape[0] - 1
+
+
+def build_device_arenas(arena: MixtureArena, feats: FeatureArena,
+                        sharding=None) -> DeviceArenas:
+    """Place the arenas on device (replicated under `sharding` on a mesh)."""
+    put = (jax.device_put if sharding is None
+           else lambda a: jax.device_put(a, sharding))
+    return DeviceArenas(
+        ms_id=put(arena.ms_id), node_depth=put(arena.node_depth),
+        pattern_prob=put(arena.pattern_prob),
+        pattern_size=put(arena.pattern_size),
+        senders=put(arena.senders), receivers=put(arena.receivers),
+        edge_iface=put(arena.edge_iface),
+        edge_rpctype=put(arena.edge_rpctype),
+        edge_duration=put(arena.edge_duration),
+        feat_x=put(feats.x))
+
+
+def materialize_device(dev: DeviceArenas, idx: IndexBatch) -> PackedBatch:
+    """Gather a full PackedBatch out of HBM-resident arenas (jit-traceable;
+    twin of arena.materialize_host)."""
+    node_mask = idx.src_node != dev.node_sentinel
+    edge_mask = idx.src_edge != dev.edge_sentinel
+    return PackedBatch(
+        x=dev.feat_x[idx.src_feat],
+        ms_id=dev.ms_id[idx.src_node],
+        node_depth=dev.node_depth[idx.src_node],
+        node_graph=idx.node_graph,
+        node_mask=node_mask,
+        pattern_prob=dev.pattern_prob[idx.src_node],
+        pattern_size=dev.pattern_size[idx.src_node],
+        senders=dev.senders[idx.src_edge] + idx.edge_node_off,
+        receivers=dev.receivers[idx.src_edge] + idx.edge_node_off,
+        edge_iface=dev.edge_iface[idx.src_edge],
+        edge_rpctype=dev.edge_rpctype[idx.src_edge],
+        edge_duration=dev.edge_duration[idx.src_edge],
+        edge_mask=edge_mask,
+        entry_id=idx.entry_id, y=idx.y, graph_mask=idx.graph_mask)
+
+
+def zero_masked_idx(idx: IndexBatch, arena: MixtureArena,
+                    feats: FeatureArena) -> IndexBatch:
+    """Inert tail filler for scan chunks in index space: every position the
+    sentinel, every graph masked — materializes to a pure-padding batch
+    (the IndexBatch analog of pack.zero_masked)."""
+    return IndexBatch(
+        src_node=np.full_like(idx.src_node, arena.node_sentinel),
+        src_feat=np.full_like(idx.src_feat, feats.sentinel),
+        node_graph=np.full_like(idx.node_graph, idx.num_graphs - 1),
+        src_edge=np.full_like(idx.src_edge, arena.edge_sentinel),
+        edge_node_off=np.zeros_like(idx.edge_node_off),
+        entry_id=np.zeros_like(idx.entry_id),
+        y=np.zeros_like(idx.y),
+        graph_mask=np.zeros_like(idx.graph_mask))

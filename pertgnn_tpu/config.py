@@ -142,6 +142,12 @@ class TrainConfig:
     # batches whose optimizer update is skipped (lax.cond), preserving the
     # reference's step-count semantics. <= 1 disables.
     scan_chunk: int = 16
+    # Device-side batch materialization: keep topology/feature arenas
+    # chip-resident and feed the step small int32 gather recipes
+    # (batching/materialize.py) instead of full packed batches. Removes the
+    # host gather/pack from the epoch critical path entirely; the host's
+    # only per-epoch work is index arithmetic.
+    device_materialize: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -26,6 +26,8 @@ import optax
 from flax import struct
 
 from pertgnn_tpu.batching.dataset import Dataset
+from pertgnn_tpu.batching.materialize import (
+    DeviceArenas, build_device_arenas, materialize_device, zero_masked_idx)
 from pertgnn_tpu.batching.pack import PackedBatch, zero_masked
 from pertgnn_tpu.config import Config
 from pertgnn_tpu.models.pert_model import PertGNN, make_model
@@ -117,20 +119,14 @@ def make_eval_step(model: PertGNN, cfg: Config) -> Callable:
 _METRIC_KEYS = ("mae_sum", "mape_sum", "qloss_sum", "count")
 
 
-def train_chunk_fn(model: PertGNN, cfg: Config,
-                   tx: optax.GradientTransformation) -> Callable:
-    """UNJITTED scan-fused chunk: `scan_chunk` train steps in one program
-    over a leading-stacked PackedBatch. Per-step dispatch latency dominates
-    this workload (TrainConfig.scan_chunk); fusing K steps amortizes it K x.
-    Jitted plain here (make_train_chunk) and with mesh shardings by
-    parallel/data_parallel.make_sharded_train_chunk.
+def _train_chunk_from_step(step: Callable) -> Callable:
+    """Scan-fuse any (state, batch) -> (state, metrics) step over a
+    leading-stacked batch pytree. Pure-padding batches (all graph_mask
+    False — the tail filler) skip the optimizer update under lax.cond so
+    the step counter and Adam moments advance exactly once per REAL batch,
+    as in the per-step path."""
 
-    Pure-padding batches (all graph_mask False — the tail filler) skip the
-    optimizer update under lax.cond so the step counter and Adam moments
-    advance exactly once per REAL batch, as in the per-step path."""
-    step = train_step_fn(model, cfg, tx)
-
-    def chunk(state: TrainState, batches: PackedBatch):
+    def chunk(state: TrainState, batches):
         def body(s, b):
             def run(s):
                 return step(s, b)
@@ -147,14 +143,12 @@ def train_chunk_fn(model: PertGNN, cfg: Config,
     return chunk
 
 
-def eval_chunk_fn(model: PertGNN, cfg: Config) -> Callable:
-    """UNJITTED scan-fused eval over a leading-stacked PackedBatch →
-    metric sums."""
-    step = eval_step_fn(model, cfg)
+def _eval_chunk_from_step(step: Callable) -> Callable:
+    """Scan-fuse an eval step over a leading-stacked batch pytree →
+    metric sums (zero-masked tail fillers skip the forward)."""
 
-    def chunk(state: TrainState, batches: PackedBatch):
+    def chunk(state: TrainState, batches):
         def body(_, b):
-            # skip the forward for zero-masked tail fillers
             m = jax.lax.cond(
                 jnp.any(b.graph_mask),
                 lambda: step(state, b),
@@ -168,6 +162,22 @@ def eval_chunk_fn(model: PertGNN, cfg: Config) -> Callable:
     return chunk
 
 
+def train_chunk_fn(model: PertGNN, cfg: Config,
+                   tx: optax.GradientTransformation) -> Callable:
+    """UNJITTED scan-fused chunk: `scan_chunk` train steps in one program
+    over a leading-stacked PackedBatch. Per-step dispatch latency dominates
+    this workload (TrainConfig.scan_chunk); fusing K steps amortizes it K x.
+    Jitted plain here (make_train_chunk) and with mesh shardings by
+    parallel/data_parallel.make_sharded_train_chunk."""
+    return _train_chunk_from_step(train_step_fn(model, cfg, tx))
+
+
+def eval_chunk_fn(model: PertGNN, cfg: Config) -> Callable:
+    """UNJITTED scan-fused eval over a leading-stacked PackedBatch →
+    metric sums."""
+    return _eval_chunk_from_step(eval_step_fn(model, cfg))
+
+
 def make_train_chunk(model: PertGNN, cfg: Config,
                      tx: optax.GradientTransformation) -> Callable:
     return jax.jit(train_chunk_fn(model, cfg, tx), donate_argnums=0)
@@ -177,21 +187,84 @@ def make_eval_chunk(model: PertGNN, cfg: Config) -> Callable:
     return jax.jit(eval_chunk_fn(model, cfg))
 
 
-def _host_chunks(batches: Iterator[PackedBatch],
-                 chunk_size: int) -> Iterator[PackedBatch]:
+def make_train_chunk_indexed(model: PertGNN, cfg: Config,
+                             tx: optax.GradientTransformation,
+                             dev: DeviceArenas) -> Callable:
+    """Scan-fused train chunk over IndexBatches: each scan iteration first
+    materializes the PackedBatch from the chip-resident arenas (closed over
+    as device constants), then runs the ordinary step. The transfer per
+    chunk is only the int32 gather recipes."""
+    base = train_step_fn(model, cfg, tx)
+    return jax.jit(_train_chunk_from_step(
+        lambda s, i: base(s, materialize_device(dev, i))), donate_argnums=0)
+
+
+def make_eval_chunk_indexed(model: PertGNN, cfg: Config,
+                            dev: DeviceArenas) -> Callable:
+    base = eval_step_fn(model, cfg)
+    return jax.jit(_eval_chunk_from_step(
+        lambda s, i: base(s, materialize_device(dev, i))))
+
+
+def make_train_step_indexed(model: PertGNN, cfg: Config,
+                            tx: optax.GradientTransformation,
+                            dev: DeviceArenas) -> Callable:
+    step = train_step_fn(model, cfg, tx)
+    return jax.jit(lambda s, i: step(s, materialize_device(dev, i)),
+                   donate_argnums=0)
+
+
+def make_eval_step_indexed(model: PertGNN, cfg: Config,
+                           dev: DeviceArenas) -> Callable:
+    step = eval_step_fn(model, cfg)
+    return jax.jit(lambda s, i: step(s, materialize_device(dev, i)))
+
+
+def _host_chunks(batches: Iterator, chunk_size: int,
+                 filler: Callable = zero_masked) -> Iterator:
     """Leading-stack host batches into chunks of `chunk_size` (tail padded
-    with inert zero-mask clones)."""
+    with inert zero-mask clones made by `filler`). Works for PackedBatch
+    and IndexBatch streams alike."""
     import numpy as np
 
-    group: list[PackedBatch] = []
+    group: list = []
     for b in batches:
         group.append(b)
         if len(group) == chunk_size:
             yield jax.tree.map(lambda *xs: np.stack(xs), *group)
             group = []
     if group:
-        group += [zero_masked(group[-1])] * (chunk_size - len(group))
+        group += [filler(group[-1])] * (chunk_size - len(group))
         yield jax.tree.map(lambda *xs: np.stack(xs), *group)
+
+
+def _background(items: Iterator, depth: int = 2) -> Iterator:
+    """Run a host-side producer in a thread so packing/stacking overlaps
+    device compute. numpy-only work belongs behind this; device puts stay on
+    the consuming thread."""
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def produce():
+        try:
+            for it in items:
+                q.put(it)
+            q.put(_END)
+        except BaseException as e:  # surface errors at the consumer
+            q.put(e)
+
+    t = threading.Thread(target=produce, daemon=True)
+    t.start()
+    while True:
+        it = q.get()
+        if it is _END:
+            return
+        if isinstance(it, BaseException):
+            raise it
+        yield it
 
 
 def _chunk_iter(batches: Iterator[PackedBatch],
@@ -292,6 +365,31 @@ def fit(dataset: Dataset, cfg: Config,
                 return (shard_batch(g, mesh, b_sh) for g in grouped_batches(
                     dataset.batches(split, shuffle=shuffle, seed=seed),
                     n_shards))
+    elif cfg.train.device_materialize:
+        # Chip-resident arenas + IndexBatch feeding: the host's per-epoch
+        # work is index arithmetic only (batching/arena.py), done in a
+        # background thread; the device gathers batches out of HBM.
+        arena_h = dataset.arena()
+        feats_h = dataset.feat_arena()
+        dev = build_device_arenas(arena_h, feats_h)
+        state = create_train_state(model, tx, sample, cfg.train.seed)
+        if cfg.train.scan_chunk > 1:
+            train_step = make_train_chunk_indexed(model, cfg, tx, dev)
+            eval_step = make_eval_chunk_indexed(model, cfg, dev)
+        else:
+            train_step = make_train_step_indexed(model, cfg, tx, dev)
+            eval_step = make_eval_step_indexed(model, cfg, dev)
+
+        def idx_filler(b):
+            return zero_masked_idx(b, arena_h, feats_h)
+
+        def batch_stream(split, shuffle=False, seed=0):
+            idxs = dataset.index_batches(split, shuffle=shuffle, seed=seed)
+            if cfg.train.scan_chunk > 1:
+                idxs = _host_chunks(idxs, cfg.train.scan_chunk, idx_filler)
+            if shuffle:  # train: pack off the critical path
+                idxs = _background(idxs)
+            return _device_iter(idxs)
     elif cfg.train.scan_chunk > 1:
         # scan-fused stepping: one dispatch per `scan_chunk` steps
         state = create_train_state(model, tx, sample, cfg.train.seed)
@@ -320,12 +418,10 @@ def fit(dataset: Dataset, cfg: Config,
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         sums = None
-        n_batches = 0
         for batch in batch_stream("train", shuffle=True,
                                   seed=cfg.data.shuffle_seed + epoch):
             state, m = train_step(state, batch)
             sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
-            n_batches += 1
         sums = jax.tree.map(float, sums)
         n = max(sums["count"], 1.0)
         train_time = time.perf_counter() - t0

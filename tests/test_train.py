@@ -63,6 +63,48 @@ class TestTraining:
         assert train_mape < 0.3, f"train MAPE {train_mape}"
 
 
+def test_materialize_device_matches_host(preprocessed):
+    """materialize_device must be the exact twin of materialize_host."""
+    from pertgnn_tpu.batching.arena import materialize_host
+    from pertgnn_tpu.batching.materialize import (
+        build_device_arenas, materialize_device)
+    cfg = Config(ingest=IngestConfig(min_traces_per_entry=10),
+                 data=DataConfig(max_traces=150, batch_size=8))
+    ds = build_dataset(preprocessed, cfg)
+    dev = build_device_arenas(ds.arena(), ds.feat_arena())
+    mat = jax.jit(lambda i: materialize_device(dev, i))
+    for split in ("train", "valid"):
+        for idx in ds.index_batches(split):
+            got = mat(idx)
+            want = materialize_host(ds.arena(), ds._feat_arena(split), idx)
+            for name in want._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(got, name)), getattr(want, name),
+                    err_msg=f"{split}:{name}")
+
+
+@pytest.mark.parametrize("scan_chunk", [1, 4])
+def test_indexed_fit_matches_host_packed(preprocessed, scan_chunk):
+    """fit() with device materialization must reproduce the host-packed
+    path's training trajectory (same batches, same numerics)."""
+    import dataclasses
+    base = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=150, batch_size=8),
+        model=ModelConfig(hidden_channels=8, num_layers=2),
+        train=TrainConfig(lr=1e-2, epochs=2, label_scale=1000.0,
+                          scan_chunk=scan_chunk, device_materialize=True),
+    )
+    host_cfg = base.replace(train=dataclasses.replace(
+        base.train, device_materialize=False))
+    _, hist_idx = fit(build_dataset(preprocessed, base), base)
+    _, hist_host = fit(build_dataset(preprocessed, host_cfg), host_cfg)
+    for ri, rh in zip(hist_idx, hist_host):
+        for k in ("train_qloss", "train_mae", "valid_mae", "test_mae"):
+            np.testing.assert_allclose(ri[k], rh[k], rtol=1e-5,
+                                       err_msg=k)
+
+
 def test_eval_deterministic(preprocessed):
     cfg = Config(
         ingest=IngestConfig(min_traces_per_entry=10),
