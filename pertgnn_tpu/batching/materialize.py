@@ -53,6 +53,20 @@ class DeviceArenas(NamedTuple):
         return self.senders.shape[0] - 1
 
 
+def arena_nbytes(arena: MixtureArena, feats: FeatureArena) -> int:
+    """Bytes of HBM the chip-resident arenas will occupy (per device — they
+    are replicated, not sharded, over a mesh). Drives the
+    `arena_hbm_budget_gb` fallback in fit(): the feature arena scales with
+    unique (entry, ts_bucket) pairs x mixture width and is unbounded by the
+    batch shape."""
+    node_e = (arena.ms_id.nbytes + arena.node_depth.nbytes
+              + arena.pattern_prob.nbytes + arena.pattern_size.nbytes)
+    edge_e = (arena.senders.nbytes + arena.receivers.nbytes
+              + arena.edge_iface.nbytes + arena.edge_rpctype.nbytes
+              + arena.edge_duration.nbytes)
+    return node_e + edge_e + feats.x.nbytes
+
+
 def build_device_arenas(arena: MixtureArena, feats: FeatureArena,
                         sharding=None) -> DeviceArenas:
     """Place the arenas on device (replicated under `sharding` on a mesh)."""

@@ -148,6 +148,12 @@ class TrainConfig:
     # host gather/pack from the epoch critical path entirely; the host's
     # only per-epoch work is index arithmetic.
     device_materialize: bool = True
+    # HBM budget (GiB) for the chip-resident arenas. The feature arena grows
+    # with the number of unique (entry, ts_bucket) pairs and is NOT bounded
+    # by the batch shape; if the arenas would exceed this budget, fit()
+    # falls back to host-packed streaming with a warning instead of OOMing
+    # the chip. None = no limit.
+    arena_hbm_budget_gb: float | None = 4.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -29,6 +29,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pertgnn_tpu.batching.arena import IndexBatch
 from pertgnn_tpu.batching.pack import PackedBatch
 
 DATA_AXIS = "data"
@@ -62,6 +63,25 @@ def chunk_batch_shardings(mesh: Mesh) -> PackedBatch:
     `data`."""
     s = NamedSharding(mesh, P(None, DATA_AXIS))
     return PackedBatch(*([s] * len(PackedBatch._fields)))
+
+
+def index_batch_shardings(mesh: Mesh) -> IndexBatch:
+    """Leading-dim `data` sharding for a global gather recipe
+    (stack_index_batches output): the int32 index arrays shard exactly like
+    the PackedBatch arrays they materialize into."""
+    s = NamedSharding(mesh, P(DATA_AXIS))
+    return IndexBatch(*([s] * len(IndexBatch._fields)))
+
+
+def chunk_index_batch_shardings(mesh: Mesh) -> IndexBatch:
+    """Shardings for a leading-STACKED global gather recipe (scan chunk)."""
+    s = NamedSharding(mesh, P(None, DATA_AXIS))
+    return IndexBatch(*([s] * len(IndexBatch._fields)))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Full replication over the mesh (device arenas, giant-graph batches)."""
+    return NamedSharding(mesh, P())
 
 
 def _param_spec(path: tuple, leaf) -> P:

@@ -27,7 +27,8 @@ from flax import struct
 
 from pertgnn_tpu.batching.dataset import Dataset
 from pertgnn_tpu.batching.materialize import (
-    DeviceArenas, build_device_arenas, materialize_device, zero_masked_idx)
+    DeviceArenas, arena_nbytes, build_device_arenas, materialize_device,
+    zero_masked_idx)
 from pertgnn_tpu.batching.pack import PackedBatch, zero_masked
 from pertgnn_tpu.config import Config
 from pertgnn_tpu.models.pert_model import PertGNN, make_model
@@ -314,6 +315,30 @@ def _evaluate_stream(eval_step: Callable, state: TrainState,
             "qloss": sums["qloss_sum"] / n, "count": sums["count"]}
 
 
+def _resolve_device_materialize(dataset: Dataset, cfg: Config) -> bool:
+    """Gate the chip-resident-arena path on the HBM budget.
+
+    The feature arena is unbounded by the batch shape (it scales with
+    unique (entry, ts_bucket) pairs x mixture width — VERDICT r2 weak #3);
+    rather than OOM the chip, oversized arenas fall back to host-packed
+    streaming with a logged warning."""
+    if not cfg.train.device_materialize:
+        return False
+    nbytes = arena_nbytes(dataset.arena(), dataset.feat_arena())
+    budget = cfg.train.arena_hbm_budget_gb
+    if budget is not None and nbytes > budget * 2**30:
+        log.warning(
+            "device arenas need %.2f GiB > arena_hbm_budget_gb=%.2f — "
+            "falling back to host-packed batch streaming (raise the budget "
+            "or shrink the dataset/feature arena to re-enable "
+            "device_materialize)", nbytes / 2**30, budget)
+        return False
+    log.info("device arenas: %.1f MiB chip-resident (budget %s GiB)",
+             nbytes / 2**20,
+             "inf" if budget is None else f"{budget:g}")
+    return True
+
+
 def fit(dataset: Dataset, cfg: Config,
         epochs: int | None = None,
         checkpoint_manager=None,
@@ -325,22 +350,61 @@ def fit(dataset: Dataset, cfg: Config,
 
     With `mesh` (jax.sharding.Mesh with a `data` axis), per-step batches are
     grouped into global batches sharded over the mesh and the step runs
-    SPMD (BASELINE config 3)."""
+    SPMD (BASELINE config 3). `device_materialize` composes: the arenas are
+    replicated over the mesh and each SPMD program gathers its global batch
+    from HBM, fed only the sharded int32 gather recipes."""
     model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
                        dataset.num_interfaces, dataset.num_rpctypes)
     tx = optax.adam(cfg.train.lr)
     sample = next(dataset.batches("train"))
+    device_materialize = _resolve_device_materialize(dataset, cfg)
     if mesh is not None:
         from pertgnn_tpu.parallel.data_parallel import (
-            grouped_batches, make_sharded_eval_chunk, make_sharded_eval_step,
-            make_sharded_train_chunk, make_sharded_train_step, shard_batch,
-            stack_batches)
+            grouped_batches, grouped_index_batches, make_sharded_eval_chunk,
+            make_sharded_eval_chunk_indexed, make_sharded_eval_step,
+            make_sharded_eval_step_indexed, make_sharded_train_chunk,
+            make_sharded_train_chunk_indexed, make_sharded_train_step,
+            make_sharded_train_step_indexed, shard_batch, stack_batches)
+        from pertgnn_tpu.parallel.mesh import (
+            batch_shardings, chunk_batch_shardings,
+            chunk_index_batch_shardings, index_batch_shardings,
+            replicated_sharding)
         n_shards = mesh.shape["data"]
         init_sample = stack_batches([sample] * n_shards)
         state = create_train_state(model, tx, init_sample, cfg.train.seed)
-        if cfg.train.scan_chunk > 1:
+        if device_materialize:
+            arena_h = dataset.arena()
+            feats_h = dataset.feat_arena()
+            dev = build_device_arenas(arena_h, feats_h,
+                                      sharding=replicated_sharding(mesh))
+            if cfg.train.scan_chunk > 1:
+                train_step, state = make_sharded_train_chunk_indexed(
+                    model, cfg, tx, mesh, state, dev)
+                eval_step = make_sharded_eval_chunk_indexed(model, cfg, mesh,
+                                                            state, dev)
+                sh = chunk_index_batch_shardings(mesh)
+            else:
+                train_step, state = make_sharded_train_step_indexed(
+                    model, cfg, tx, mesh, state, dev)
+                eval_step = make_sharded_eval_step_indexed(model, cfg, mesh,
+                                                           state, dev)
+                sh = index_batch_shardings(mesh)
+
+            def idx_filler(b):
+                return zero_masked_idx(b, arena_h, feats_h)
+
+            def batch_stream(split, shuffle=False, seed=0):
+                idxs = dataset.index_batches(split, shuffle=shuffle,
+                                             seed=seed)
+                glob = grouped_index_batches(idxs, n_shards, idx_filler)
+                if cfg.train.scan_chunk > 1:
+                    glob = _host_chunks(glob, cfg.train.scan_chunk,
+                                        idx_filler)
+                if shuffle:  # train: index packing off the critical path
+                    glob = _background(glob)
+                return _one_ahead(shard_batch(g, mesh, sh) for g in glob)
+        elif cfg.train.scan_chunk > 1:
             # scan-fused SPMD: one dispatch per scan_chunk global batches
-            from pertgnn_tpu.parallel.mesh import chunk_batch_shardings
             train_step, state = make_sharded_train_chunk(model, cfg, tx,
                                                          mesh, state)
             eval_step = make_sharded_eval_chunk(model, cfg, mesh, state)
@@ -357,15 +421,13 @@ def fit(dataset: Dataset, cfg: Config,
             train_step, state = make_sharded_train_step(model, cfg, tx,
                                                         mesh, state)
             eval_step = make_sharded_eval_step(model, cfg, mesh, state)
-
-            from pertgnn_tpu.parallel.mesh import batch_shardings
             b_sh = batch_shardings(mesh)
 
             def batch_stream(split, shuffle=False, seed=0):
                 return (shard_batch(g, mesh, b_sh) for g in grouped_batches(
                     dataset.batches(split, shuffle=shuffle, seed=seed),
                     n_shards))
-    elif cfg.train.device_materialize:
+    elif device_materialize:
         # Chip-resident arenas + IndexBatch feeding: the host's per-epoch
         # work is index arithmetic only (batching/arena.py), done in a
         # background thread; the device gathers batches out of HBM.

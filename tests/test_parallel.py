@@ -179,7 +179,9 @@ class TestEdgeSharding:
 
 
 def test_fit_with_mesh(ds, cfg):
-    """Distributed fit end-to-end on the fake 8-device mesh."""
+    """Distributed fit end-to-end on the fake 8-device mesh (with the
+    default device_materialize=True this exercises the indexed SPMD
+    path)."""
     from pertgnn_tpu.train.loop import fit
 
     mesh = make_mesh(data=8, model=1)
@@ -188,6 +190,142 @@ def test_fit_with_mesh(ds, cfg):
     assert history[1]["train_qloss"] < history[0]["train_qloss"]
     for k, v in history[-1].items():
         assert np.isfinite(v), (k, v)
+
+
+def test_fit_with_mesh_host_packed(ds, cfg):
+    """The host-packed SPMD path still works when the arena budget forces
+    the fallback (arena_hbm_budget_gb=0)."""
+    import dataclasses
+
+    from pertgnn_tpu.train.loop import fit
+
+    mesh = make_mesh(data=8, model=1)
+    c = cfg.replace(train=dataclasses.replace(cfg.train,
+                                              arena_hbm_budget_gb=0.0))
+    _, history = fit(ds, c, epochs=1, mesh=mesh)
+    assert np.isfinite(history[-1]["train_qloss"])
+
+
+class TestIndexedMesh:
+    """Round-2's device-materialize machinery composed with the mesh
+    (VERDICT r2 #2): the SPMD program is fed sharded int32 gather recipes
+    and materializes global batches from mesh-replicated arenas."""
+
+    def test_stacked_recipe_materializes_global_batch(self, ds, cfg):
+        """materialize_host(stack_index_batches(idxs)) == stack_batches of
+        the per-shard batches — node/graph arrays exactly, edges as equal
+        multisets (stack_batches re-sorts edges globally; the indexed path
+        keeps per-shard layout, which segment attention doesn't care
+        about)."""
+        from pertgnn_tpu.batching.arena import materialize_host
+        from pertgnn_tpu.parallel.data_parallel import stack_index_batches
+
+        idxs = list(ds.index_batches("train"))[:4]
+        batches = list(ds.batches("train"))[:4]
+        want = stack_batches(batches)
+        glob_idx = stack_index_batches(idxs)
+        # index_batches uses the split view; its src_feat rows index the
+        # FULL shared feature arena, so materialize against that
+        got = materialize_host(ds.arena(), ds.feat_arena(), glob_idx)
+
+        for f in ("x", "ms_id", "node_depth", "node_graph", "node_mask",
+                  "pattern_prob", "pattern_size", "entry_id", "y",
+                  "graph_mask"):
+            np.testing.assert_array_equal(getattr(got, f), getattr(want, f),
+                                          err_msg=f)
+
+        def edge_key(b):
+            cols = np.stack([b.edge_mask.astype(np.int64), b.receivers,
+                             b.senders, b.edge_iface, b.edge_rpctype])
+            return cols[:, np.lexsort(cols)]
+
+        np.testing.assert_array_equal(edge_key(got), edge_key(want))
+
+    def test_indexed_mesh_grads_equal_host_packed_mesh(self, ds, cfg):
+        """Gradients from the indexed SPMD step == gradients from the
+        host-packed SPMD step on the same global batch."""
+        from pertgnn_tpu.batching.materialize import (build_device_arenas,
+                                                      materialize_device)
+        from pertgnn_tpu.parallel.data_parallel import (
+            make_sharded_train_step, make_sharded_train_step_indexed,
+            stack_index_batches)
+        from pertgnn_tpu.parallel.mesh import (batch_shardings,
+                                               index_batch_shardings,
+                                               replicated_sharding,
+                                               state_shardings)
+        from pertgnn_tpu.train.loop import _loss_fn
+
+        mesh = make_mesh(data=8, model=1)
+        model, tx, state, _ = _setup(ds, cfg, mesh)
+        idxs = list(ds.index_batches("train"))[:8]
+        batches = list(ds.batches("train"))[:8]
+        glob_pb = stack_batches(batches)
+        glob_idx = stack_index_batches(idxs)
+        dev = build_device_arenas(ds.arena(), ds.feat_arena(),
+                                  sharding=replicated_sharding(mesh))
+        st_sh = state_shardings(state, mesh)
+        sh_state = jax.device_put(state, st_sh)
+        rng = jax.random.PRNGKey(0)
+
+        def grads_from_batch(state, batch):
+            return jax.grad(
+                lambda p: _loss_fn(model, cfg, p, state.batch_stats, batch,
+                                   rng)[0])(state.params)
+
+        i_sh = index_batch_shardings(mesh)
+        g_pb = jax.jit(grads_from_batch,
+                       in_shardings=(st_sh, batch_shardings(mesh)))(
+            sh_state, shard_batch(glob_pb, mesh))
+        g_idx = jax.jit(
+            lambda s, i: grads_from_batch(s, materialize_device(dev, i)),
+            in_shardings=(st_sh, i_sh))(
+            sh_state, shard_batch(glob_idx, mesh, i_sh))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b),
+                rtol=1e-4, atol=1e-6 + 1e-4 * np.abs(np.asarray(a)).max()),
+            jax.device_get(g_pb), jax.device_get(g_idx))
+
+        # the full indexed train steps agree on metrics too
+        step_h, st_h = make_sharded_train_step(model, cfg, tx, mesh, state)
+        st_h, m_h = step_h(st_h, shard_batch(glob_pb, mesh))
+        step_i, st_i = make_sharded_train_step_indexed(model, cfg, tx, mesh,
+                                                       state, dev)
+        st_i, m_i = step_i(st_i, shard_batch(glob_idx, mesh, i_sh))
+        np.testing.assert_allclose(float(m_h["qloss_sum"]),
+                                   float(m_i["qloss_sum"]), rtol=1e-5)
+        np.testing.assert_allclose(float(m_h["mae_sum"]),
+                                   float(m_i["mae_sum"]), rtol=1e-5)
+        assert int(st_i.step) == 1
+
+    def test_indexed_mesh_chunk_runs(self, ds, cfg):
+        """Scan-fused indexed SPMD chunk: mechanics + tail filler."""
+        import functools
+
+        from pertgnn_tpu.batching.materialize import (build_device_arenas,
+                                                      zero_masked_idx)
+        from pertgnn_tpu.parallel.data_parallel import (
+            grouped_index_batches, make_sharded_train_chunk_indexed)
+        from pertgnn_tpu.parallel.mesh import replicated_sharding
+        from pertgnn_tpu.train.loop import _host_chunks
+
+        mesh = make_mesh(data=8, model=1)
+        model, tx, state, _ = _setup(ds, cfg, mesh)
+        arena, feats = ds.arena(), ds.feat_arena()
+        dev = build_device_arenas(arena, feats,
+                                  sharding=replicated_sharding(mesh))
+        filler = functools.partial(zero_masked_idx, arena=arena, feats=feats)
+        globs = list(grouped_index_batches(ds.index_batches("train"), 8,
+                                           filler))
+        chunks = list(_host_chunks(iter(globs), 3, filler))
+        chunk_fn, sh_state = make_sharded_train_chunk_indexed(
+            model, cfg, tx, mesh, state, dev)
+        total = 0.0
+        for c in chunks:
+            sh_state, m = chunk_fn(sh_state, jax.tree.map(jnp.asarray, c))
+            total += float(m["count"])
+        assert total == len(ds.splits["train"])
+        assert np.isfinite(float(m["qloss_sum"]))
 
 
 class TestShardedChunk:

@@ -105,6 +105,38 @@ def test_indexed_fit_matches_host_packed(preprocessed, scan_chunk):
                                        err_msg=k)
 
 
+def test_arena_budget_fallback(preprocessed, caplog):
+    """Oversized arenas must fall back to host-packed streaming with a
+    warning rather than OOM the chip (arena_hbm_budget_gb gate)."""
+    import dataclasses
+    import logging
+
+    from pertgnn_tpu.train.loop import _resolve_device_materialize
+
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=120, batch_size=8),
+        model=ModelConfig(hidden_channels=8),
+        train=TrainConfig(epochs=1, label_scale=1000.0),
+    )
+    ds = build_dataset(preprocessed, cfg)
+    assert _resolve_device_materialize(ds, cfg) is True
+
+    tiny = cfg.replace(train=dataclasses.replace(cfg.train,
+                                                 arena_hbm_budget_gb=0.0))
+    with caplog.at_level(logging.WARNING, logger="pertgnn_tpu.train.loop"):
+        assert _resolve_device_materialize(ds, tiny) is False
+    assert any("falling back to host-packed" in r.message
+               for r in caplog.records)
+    # fit still trains end-to-end through the fallback
+    _, history = fit(ds, tiny, epochs=1)
+    assert np.isfinite(history[-1]["train_qloss"])
+
+    unlimited = cfg.replace(train=dataclasses.replace(
+        cfg.train, arena_hbm_budget_gb=None))
+    assert _resolve_device_materialize(ds, unlimited) is True
+
+
 def test_eval_deterministic(preprocessed):
     cfg = Config(
         ingest=IngestConfig(min_traces_per_entry=10),
