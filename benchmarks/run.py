@@ -41,6 +41,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+from pertgnn_tpu.cli.common import apply_platform_env
+
+# honor JAX_PLATFORMS=cpu + virtual-device XLA_FLAGS even when a device
+# plugin (axon TPU tunnel) would otherwise win (dp8 / edge-sharded configs)
+apply_platform_env()
+
 
 def _dataset(spec_kwargs, cfg):
     from pertgnn_tpu.batching import build_dataset
@@ -66,7 +72,8 @@ def _flagship_cfg(**model_overrides):
     )
 
 
-def _train_throughput(ds, cfg, steps: int = 160) -> float:
+def _train_throughput(ds, cfg, steps: int = 160,
+                      edge_shard_mesh=None) -> float:
     """graphs/s of the scan-fused train step on this backend."""
     import jax
     import jax.numpy as jnp
@@ -77,7 +84,8 @@ def _train_throughput(ds, cfg, steps: int = 160) -> float:
                                         make_train_chunk)
 
     model = make_model(cfg.model, ds.num_ms, ds.num_entries,
-                       ds.num_interfaces, ds.num_rpctypes)
+                       ds.num_interfaces, ds.num_rpctypes,
+                       edge_shard_mesh=edge_shard_mesh)
     tx = optax.adam(cfg.train.lr)
     host = list(itertools.islice(ds.batches("train"),
                                  cfg.train.scan_chunk))
@@ -221,6 +229,21 @@ def giant_dag() -> dict:
         cfg.model, use_pallas_attention=True))
     out["pallas_graphs_per_s"] = round(_train_throughput(ds, cfg_p,
                                                          steps=16), 2)
+    # edge-sharded ("sequence parallel") path: the layers shard the edge
+    # set over an 8-device mesh (graph_shard.sharded_edge_attention)
+    import jax
+
+    if len(jax.devices()) >= 8 and edges % 8 == 0:
+        from pertgnn_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(data=8, model=1, devices=jax.devices()[:8])
+        out["edge_sharded_graphs_per_s"] = round(
+            _train_throughput(ds, cfg, steps=16, edge_shard_mesh=mesh), 2)
+        out["edge_sharded_devices"] = 8
+    else:
+        out["edge_sharded"] = ("skipped: needs 8 devices (run under "
+                               "XLA_FLAGS=--xla_force_host_platform_device_"
+                               "count=8 JAX_PLATFORMS=cpu)")
     return out
 
 

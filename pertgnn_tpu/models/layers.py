@@ -26,6 +26,8 @@ stats used at eval.
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
@@ -38,6 +40,12 @@ class GraphTransformerLayer(nn.Module):
     heads: int = 1
     attn_dropout: float = 0.0  # PyG TransformerConv drops attention weights
     use_pallas: bool = False   # fused edge-attention kernel for the hot op
+    # jax.sharding.Mesh: shard the EDGE set over the mesh's `data` axis
+    # inside the layer (parallel/graph_shard.py) — the giant-graph /
+    # "sequence parallel" path for DAGs whose edge set exceeds one chip
+    # (ParallelConfig.shard_edges; BASELINE config 5). Static module attr;
+    # nodes stay replicated.
+    edge_shard_mesh: Any = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -56,11 +64,25 @@ class GraphTransformerLayer(nn.Module):
         v = dense("value", True)(x)
         e = dense("edge", False)(edge_embeds)
 
+        num_nodes = x.shape[0]
+        attn_drop = self.attn_dropout > 0.0 and training
+        if self.edge_shard_mesh is not None and not attn_drop:
+            # k[senders] + e happens inside the shard_map, on each device's
+            # edge shard; attn_dropout falls through to the segment path
+            # (dropout on a sharded alpha would need per-shard rng plumbing)
+            from pertgnn_tpu.parallel.graph_shard import (
+                sharded_edge_attention)
+            out = sharded_edge_attention(
+                q.reshape(-1, H, C), k.reshape(-1, H, C),
+                v.reshape(-1, H, C), e.reshape(-1, H, C),
+                senders, receivers, edge_mask,
+                self.edge_shard_mesh).astype(self.dtype)
+            return out + dense("skip", True)(x)
+
         k_e = k[senders].reshape(-1, H, C) + e.reshape(-1, H, C)
         v_e = v[senders].reshape(-1, H, C) + e.reshape(-1, H, C)
 
-        num_nodes = x.shape[0]
-        if self.use_pallas and not (self.attn_dropout > 0.0 and training):
+        if self.use_pallas and not attn_drop:
             from pertgnn_tpu.ops.pallas_attention import edge_attention
             out = edge_attention(q.reshape(-1, H, C), k_e, v_e, receivers,
                                  edge_mask, num_nodes,

@@ -25,6 +25,8 @@ padding, everything shape-static under jit.
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -39,6 +41,9 @@ class PertGNN(nn.Module):
     num_entries: int
     num_interfaces: int
     num_rpctypes: int
+    # Mesh to shard each layer's EDGE set over (ParallelConfig.shard_edges —
+    # the giant-graph path, parallel/graph_shard.py); None = unsharded.
+    edge_shard_mesh: Any = None
 
     @nn.compact
     def __call__(self, batch, *, training: bool = False):
@@ -63,7 +68,8 @@ class PertGNN(nn.Module):
 
         conv_kwargs = dict(out_channels=hidden, heads=cfg.num_heads,
                            dtype=dtype, attn_dropout=cfg.attn_dropout,
-                           use_pallas=cfg.use_pallas_attention)
+                           use_pallas=cfg.use_pallas_attention,
+                           edge_shard_mesh=self.edge_shard_mesh)
         num_convs = max(2, cfg.num_layers)
         for i in range(num_convs - 1):
             x = GraphTransformerLayer(name=f"conv_{i}", **conv_kwargs)(
@@ -99,6 +105,8 @@ class PertGNN(nn.Module):
 
 
 def make_model(cfg: ModelConfig, num_ms: int, num_entries: int,
-               num_interfaces: int, num_rpctypes: int) -> PertGNN:
+               num_interfaces: int, num_rpctypes: int,
+               edge_shard_mesh: Any = None) -> PertGNN:
     return PertGNN(cfg=cfg, num_ms=num_ms, num_entries=num_entries,
-                   num_interfaces=num_interfaces, num_rpctypes=num_rpctypes)
+                   num_interfaces=num_interfaces, num_rpctypes=num_rpctypes,
+                   edge_shard_mesh=edge_shard_mesh)

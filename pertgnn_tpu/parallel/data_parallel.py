@@ -29,6 +29,7 @@ from pertgnn_tpu.parallel.mesh import (batch_shardings,
                                        chunk_batch_shardings,
                                        chunk_index_batch_shardings,
                                        index_batch_shardings,
+                                       replicated_batch_shardings,
                                        state_shardings)
 from pertgnn_tpu.train import loop as train_loop
 
@@ -63,24 +64,28 @@ def stack_batches(batches: Sequence[PackedBatch]) -> PackedBatch:
     return PackedBatch(**receiver_sort_edges(out, n * len(batches)))
 
 
-def grouped_batches(batches: Iterator[PackedBatch],
-                    num_shards: int) -> Iterator[PackedBatch]:
-    """Group a batch stream into global batches of `num_shards` shards.
-
-    The tail is completed by repeating the last batch with its masks zeroed
-    (pure padding), so every global batch has identical shape.
-    """
-    group: list[PackedBatch] = []
-    for b in batches:
+def _grouped(stream: Iterator, num_shards: int, stacker: Callable,
+             filler: Callable) -> Iterator:
+    """Group a per-shard stream into `num_shards`-wide global items; the
+    tail group is completed with inert `filler` clones of its last item so
+    every global item has identical shape."""
+    group: list = []
+    for b in stream:
         group.append(b)
         if len(group) == num_shards:
-            yield stack_batches(group)
+            yield stacker(group)
             group = []
     if group:
-        pad = zero_masked(group[-1])
+        pad = filler(group[-1])
         while len(group) < num_shards:
             group.append(pad)
-        yield stack_batches(group)
+        yield stacker(group)
+
+
+def grouped_batches(batches: Iterator[PackedBatch],
+                    num_shards: int) -> Iterator[PackedBatch]:
+    """Group a batch stream into global batches of `num_shards` shards."""
+    return _grouped(batches, num_shards, stack_batches, zero_masked)
 
 
 def stack_index_batches(idxs: Sequence[IndexBatch]) -> IndexBatch:
@@ -122,17 +127,7 @@ def grouped_index_batches(idxs: Iterator[IndexBatch], num_shards: int,
     """Group a gather-recipe stream into global recipes of `num_shards`
     shards; the tail is completed with inert sentinel recipes (`filler` =
     materialize.zero_masked_idx under partial)."""
-    group: list[IndexBatch] = []
-    for b in idxs:
-        group.append(b)
-        if len(group) == num_shards:
-            yield stack_index_batches(group)
-            group = []
-    if group:
-        pad = filler(group[-1])
-        while len(group) < num_shards:
-            group.append(pad)
-        yield stack_index_batches(group)
+    return _grouped(idxs, num_shards, stack_index_batches, filler)
 
 
 def shard_batch(batch: PackedBatch, mesh,
@@ -259,3 +254,31 @@ def make_sharded_eval_chunk_indexed(model: PertGNN, cfg: Config, mesh,
     chunk = train_loop._eval_chunk_from_step(
         lambda s, i: base(s, materialize_device(dev, i)))
     return jax.jit(chunk, in_shardings=(st_sh, ci_sh), out_shardings=None)
+
+
+def make_edge_sharded_train_step(model: PertGNN, cfg: Config,
+                                 tx: optax.GradientTransformation, mesh,
+                                 state, chunked: bool = False
+                                 ) -> tuple[Callable, Any]:
+    """Giant-graph mode (ParallelConfig.shard_edges): the model was built
+    with `edge_shard_mesh`, so its attention layers shard the EDGE set over
+    the mesh's `data` axis internally (graph_shard.sharded_edge_attention,
+    psum/pmax over ICI); batch and node arrays stay replicated. `chunked`
+    jits the scan-fused chunk instead of the single step."""
+    st_sh = state_shardings(state, mesh)
+    b_sh = replicated_batch_shardings(mesh)
+    state = jax.device_put(jax.tree.map(jnp.copy, state), st_sh)
+    fn = (train_loop.train_chunk_fn(model, cfg, tx) if chunked
+          else train_loop.train_step_fn(model, cfg, tx))
+    jitted = jax.jit(fn, in_shardings=(st_sh, b_sh),
+                     out_shardings=(st_sh, None), donate_argnums=0)
+    return jitted, state
+
+
+def make_edge_sharded_eval_step(model: PertGNN, cfg: Config, mesh,
+                                state, chunked: bool = False) -> Callable:
+    st_sh = state_shardings(state, mesh)
+    b_sh = replicated_batch_shardings(mesh)
+    fn = (train_loop.eval_chunk_fn(model, cfg) if chunked
+          else train_loop.eval_step_fn(model, cfg))
+    return jax.jit(fn, in_shardings=(st_sh, b_sh), out_shardings=None)

@@ -40,7 +40,10 @@ def sharded_edge_attention(q, k, v, e, senders, receivers, edge_mask,
             jnp.asarray(C, q.dtype))                     # (E_loc, H)
         neg = jnp.asarray(-jnp.inf, scores.dtype)
         scores = jnp.where(msk[:, None], scores, neg)
-        m = segment_max(scores, rcv, num_nodes)          # (N, H) local max
+        # The running max only stabilizes the softmax — its gradient
+        # contribution cancels exactly, and pmax has no differentiation
+        # rule, so compute it outside the autodiff graph.
+        m = segment_max(jax.lax.stop_gradient(scores), rcv, num_nodes)
         m = jax.lax.pmax(m, axis)                        # global max
         m = jnp.where(jnp.isfinite(m), m, 0.0)
         ex = jnp.where(msk[:, None], jnp.exp(scores - m[rcv]), 0.0)

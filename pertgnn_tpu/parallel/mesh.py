@@ -84,6 +84,15 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def replicated_batch_shardings(mesh: Mesh) -> PackedBatch:
+    """Replicated shardings for a packed batch — the giant-graph
+    (shard_edges) mode: nodes/graphs replicated, the layers shard the edge
+    set internally via shard_map (graph_shard.py). P() covers any rank, so
+    this serves plain and leading-stacked (scan chunk) batches alike."""
+    s = NamedSharding(mesh, P())
+    return PackedBatch(*([s] * len(PackedBatch._fields)))
+
+
 def _param_spec(path: tuple, leaf) -> P:
     """Tensor-parallel rule per parameter.
 

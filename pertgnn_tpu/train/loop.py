@@ -353,12 +353,62 @@ def fit(dataset: Dataset, cfg: Config,
     SPMD (BASELINE config 3). `device_materialize` composes: the arenas are
     replicated over the mesh and each SPMD program gathers its global batch
     from HBM, fed only the sharded int32 gather recipes."""
+    edge_shard = mesh is not None and cfg.parallel.shard_edges
     model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
-                       dataset.num_interfaces, dataset.num_rpctypes)
+                       dataset.num_interfaces, dataset.num_rpctypes,
+                       edge_shard_mesh=mesh if edge_shard else None)
     tx = optax.adam(cfg.train.lr)
     sample = next(dataset.batches("train"))
-    device_materialize = _resolve_device_materialize(dataset, cfg)
-    if mesh is not None:
+    if edge_shard and cfg.model.attn_dropout > 0:
+        # the layer would silently fall back to full-edge unsharded
+        # attention in training (layers.py), defeating the giant-graph mode
+        # exactly where it matters — refuse the combination instead
+        raise ValueError(
+            "shard_edges does not support attn_dropout > 0 (attention-"
+            "weight dropout would need per-shard rng plumbing inside the "
+            "shard_map); set attn_dropout=0 or disable shard_edges")
+    mesh_pallas = mesh is not None and cfg.model.use_pallas_attention
+    if mesh_pallas and cfg.train.device_materialize and not edge_shard:
+        # stack_index_batches does NOT restore the global receiver-sorted
+        # edge order the Pallas kernel's assume_sorted block-skipping
+        # requires (stack_batches does) — host-packed keeps it correct
+        log.warning(
+            "use_pallas_attention with a mesh forces the host-packed batch "
+            "path: the stacked gather recipes are not globally "
+            "receiver-sorted, which the fused kernel requires")
+    device_materialize = (not edge_shard and not mesh_pallas
+                          and _resolve_device_materialize(dataset, cfg))
+    if edge_shard:
+        # Giant-graph ("sequence parallel") mode: the layers shard each
+        # batch's EDGE set over the mesh's data axis internally
+        # (graph_shard.sharded_edge_attention); batches stay replicated —
+        # the data axis scales graph size, not batch count (SURVEY.md §5.7,
+        # BASELINE config 5).
+        from pertgnn_tpu.parallel.data_parallel import (
+            make_edge_sharded_eval_step, make_edge_sharded_train_step,
+            shard_batch)
+        from pertgnn_tpu.parallel.mesh import replicated_batch_shardings
+        n_data = mesh.shape["data"]
+        num_edges = sample.senders.shape[0]
+        if num_edges % n_data:
+            raise ValueError(
+                f"shard_edges needs the edge budget ({num_edges}) divisible "
+                f"by the data axis ({n_data}); set data.max_edges_per_batch "
+                f"to a multiple of {n_data}")
+        chunked = cfg.train.scan_chunk > 1
+        state = create_train_state(model, tx, sample, cfg.train.seed)
+        train_step, state = make_edge_sharded_train_step(
+            model, cfg, tx, mesh, state, chunked=chunked)
+        eval_step = make_edge_sharded_eval_step(model, cfg, mesh, state,
+                                                chunked=chunked)
+        b_sh = replicated_batch_shardings(mesh)
+
+        def batch_stream(split, shuffle=False, seed=0):
+            batches = dataset.batches(split, shuffle=shuffle, seed=seed)
+            if chunked:
+                batches = _host_chunks(batches, cfg.train.scan_chunk)
+            return _one_ahead(shard_batch(b, mesh, b_sh) for b in batches)
+    elif mesh is not None:
         from pertgnn_tpu.parallel.data_parallel import (
             grouped_batches, grouped_index_batches, make_sharded_eval_chunk,
             make_sharded_eval_chunk_indexed, make_sharded_eval_step,

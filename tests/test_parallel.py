@@ -206,6 +206,68 @@ def test_fit_with_mesh_host_packed(ds, cfg):
     assert np.isfinite(history[-1]["train_qloss"])
 
 
+class TestShardEdgesModel:
+    """ParallelConfig.shard_edges wired into the model (VERDICT r2 #6):
+    the full PertGNN with edge_shard_mesh must match the unsharded model."""
+
+    def test_full_model_grads_match_unsharded(self, ds, cfg):
+        import optax as _optax
+
+        from pertgnn_tpu.train.loop import _loss_fn
+
+        mesh = make_mesh(data=8, model=1)
+        batch = next(ds.batches("train"))
+        assert batch.senders.shape[0] % 8 == 0  # 128-rounded budget
+        model_u = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                             ds.num_interfaces, ds.num_rpctypes)
+        model_s = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                             ds.num_interfaces, ds.num_rpctypes,
+                             edge_shard_mesh=mesh)
+        tx = _optax.adam(cfg.train.lr)
+        state = create_train_state(model_u, tx, batch, cfg.train.seed)
+        b = jax.tree.map(jnp.asarray, batch)
+        rng = jax.random.PRNGKey(0)
+
+        def grads(model, params):
+            return jax.grad(
+                lambda p: _loss_fn(model, cfg, p, state.batch_stats, b,
+                                   rng)[0])(params)
+
+        # identical params work for both: edge_shard_mesh changes only how
+        # the attention reduction is computed, not the parameter tree
+        g_u = jax.jit(lambda p: grads(model_u, p))(state.params)
+        g_s = jax.jit(lambda p: grads(model_s, p))(state.params)
+        jax.tree.map(
+            lambda a, c: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(c),
+                rtol=2e-4, atol=1e-6 + 1e-4 * np.abs(np.asarray(a)).max()),
+            g_u, g_s)
+
+        out_u, _ = model_u.apply(
+            {"params": state.params, "batch_stats": state.batch_stats}, b)
+        out_s, _ = model_s.apply(
+            {"params": state.params, "batch_stats": state.batch_stats}, b)
+        np.testing.assert_allclose(np.asarray(out_u), np.asarray(out_s),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_fit_shard_edges(self, ds, cfg):
+        """fit(mesh=...) with shard_edges trains end-to-end: replicated
+        batches, edge set sharded inside the layers."""
+        import dataclasses
+
+        from pertgnn_tpu.config import ParallelConfig
+        from pertgnn_tpu.train.loop import fit
+
+        mesh = make_mesh(data=8, model=1)
+        c = cfg.replace(
+            parallel=ParallelConfig(shard_edges=True),
+            train=dataclasses.replace(cfg.train, scan_chunk=2))
+        _, history = fit(ds, c, epochs=2, mesh=mesh)
+        assert len(history) == 2
+        assert history[1]["train_qloss"] < history[0]["train_qloss"]
+        assert np.isfinite(history[-1]["test_mae"])
+
+
 class TestIndexedMesh:
     """Round-2's device-materialize machinery composed with the mesh
     (VERDICT r2 #2): the SPMD program is fed sharded int32 gather recipes
