@@ -1,26 +1,49 @@
-"""Benchmark: call-graphs/sec/chip on the flagship training step.
+"""Benchmark: END-TO-END training throughput of the flagship model.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "graphs/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "graphs/s", "vs_baseline": N, ...}
+
+What is measured (VERDICT r2 #1):
+- **value** — the headline — is the MEDIAN over >=5 real `fit()` training
+  epochs: fresh shuffled data each epoch, vectorized index packing in a
+  background thread, chip-resident arenas, device-side batch
+  materialization, scan-fused steps. Nothing is pre-staged; this is the
+  throughput a user's training run sees (epoch 0 is dropped: compile).
+- **ceiling_graphs_per_s** is the cached-chunk replay ceiling (the same
+  jitted program re-fed one device-resident chunk — pure device compute +
+  dispatch, zero input pipeline). Ceiling windows are INTERLEAVED between
+  fit epochs so the known tunnel/clock variance (ops/pallas_attention.py
+  notes +-40% on microbenches) hits both numbers alike; both carry their
+  window lists and spread.
+- **fit_over_ceiling** quantifies the input-pipeline cost the round-2
+  arena machinery exists to remove.
+- **mfu_pct** relates graphs/s to chip peak via XLA cost analysis
+  (utils/flops.py).
 
 The baseline is MEASURED here, not looked up (the reference publishes no
 numbers — BASELINE.md): a faithful torch-CPU re-implementation of the
 reference's training step (PyG TransformerConv semantics via torch scatter
-ops, BatchNorm1d, Adam, pinball loss) runs on the same packed batches on this
-host — i.e. what the reference stack would do on the available non-TPU
-hardware. vs_baseline = our graphs/s divided by torch's graphs/s.
+ops, BatchNorm1d, Adam, pinball loss) runs on the same packed batches on
+this host. vs_baseline = our fit() graphs/s / torch's graphs/s.
 
-Configuration mirrors the reference defaults (hidden 32, batch 170,
-pert graphs; pert_gnn.py:15-33) on a synthetic workload sized to keep the
-bench under a few minutes.
+Configuration mirrors the reference defaults (hidden 32, batch 170, pert
+graphs; pert_gnn.py:15-33) on a synthetic workload sized so one epoch is
+long enough to time reliably.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import statistics
 import time
 
 import numpy as np
+
+# Scale knobs for smoke-testing the bench itself off-TPU (the driver runs
+# the defaults on the real chip).
+_TRACES_PER_ENTRY = int(os.environ.get("BENCH_TRACES_PER_ENTRY", "2500"))
+_WINDOWS = int(os.environ.get("BENCH_WINDOWS", "6"))
 
 
 def build_workload():
@@ -35,50 +58,90 @@ def build_workload():
         ingest=IngestConfig(min_traces_per_entry=5),
         data=DataConfig(max_traces=100_000, batch_size=170),
         # the fused kernel runs compiled only on TPU; off-TPU it would
-        # fall to (very slow) interpret mode
-        model=ModelConfig(hidden_channels=32, num_layers=3,
-                          use_pallas_attention=(
-                              jax.default_backend() == "tpu")),
-        train=TrainConfig(lr=3e-4, label_scale=1000.0, scan_chunk=8),
+        # fall to (very slow) interpret mode. Keep the default segment
+        # path either way: bench measures the flagship configuration.
+        model=ModelConfig(hidden_channels=32, num_layers=3),
+        train=TrainConfig(lr=3e-4, label_scale=1000.0, scan_chunk=16),
         graph_type="pert",
     )
     data = synthetic.generate(synthetic.SyntheticSpec(
-        num_microservices=60, num_entries=8, patterns_per_entry=4,
-        traces_per_entry=400, seed=42))
+        num_microservices=60, num_entries=16, patterns_per_entry=4,
+        traces_per_entry=_TRACES_PER_ENTRY, seed=42))
     pre = preprocess(data.spans, data.resources, cfg.ingest)
     ds = build_dataset(pre, cfg)
     return ds, cfg
 
 
-def bench_jax(ds, cfg, steps: int = 200) -> float:
+def make_ceiling(ds, cfg):
+    """Cached-chunk replay: one device-resident scan chunk re-fed to the
+    jitted train program. Returns (run_window() -> graphs/s, flops/graph)."""
+    import itertools
+
     import jax
     import jax.numpy as jnp
     import optax
 
     from pertgnn_tpu.models.pert_model import make_model
-    from pertgnn_tpu.train.loop import (create_train_state, make_train_chunk,
-                                        _chunk_iter)
+    from pertgnn_tpu.train.loop import (_chunk_iter, create_train_state,
+                                        make_train_chunk)
+    from pertgnn_tpu.utils.flops import compiled_flops
 
     model = make_model(cfg.model, ds.num_ms, ds.num_entries,
                        ds.num_interfaces, ds.num_rpctypes)
     tx = optax.adam(cfg.train.lr)
-    host_batches = list(ds.batches("train"))[:cfg.train.scan_chunk]
-    graphs_per_chunk = sum(int(b.graph_mask.sum()) for b in host_batches)
-    chunk_batch = next(_chunk_iter(iter(host_batches), cfg.train.scan_chunk))
+    host = list(itertools.islice(ds.batches("train"), cfg.train.scan_chunk))
+    graphs_per_chunk = sum(int(b.graph_mask.sum()) for b in host)
+    chunk_batch = next(_chunk_iter(iter(host), cfg.train.scan_chunk))
     b0 = jax.tree.map(lambda a: jnp.asarray(a[0]), chunk_batch)
     state = create_train_state(model, tx, b0, cfg.train.seed)
     chunk = make_train_chunk(model, cfg, tx)
 
-    state, m = chunk(state, chunk_batch)  # compile
+    flops_per_graph = None
+    fl = compiled_flops(chunk, state, chunk_batch)
+    if fl is not None:
+        flops_per_graph = fl / graphs_per_chunk
+
+    state, m = chunk(state, chunk_batch)  # compile + warm
     jax.block_until_ready(m["qloss_sum"])
 
-    n_chunks = max(1, steps // cfg.train.scan_chunk)
+    # size a window to ~0.4 s so one window rides out dispatch jitter
     t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        state, m = chunk(state, chunk_batch)
-    jax.block_until_ready(m["qloss_sum"])  # single sync at the end
-    dt = time.perf_counter() - t0
-    return n_chunks * graphs_per_chunk / dt
+    state, m = chunk(state, chunk_batch)
+    jax.block_until_ready(m["qloss_sum"])
+    per_chunk = max(time.perf_counter() - t0, 1e-5)
+    reps = max(3, int(0.4 / per_chunk))
+
+    holder = {"state": state}
+
+    def run_window() -> float:
+        s = holder["state"]
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            s, mm = chunk(s, chunk_batch)
+        jax.block_until_ready(mm["qloss_sum"])
+        holder["state"] = s
+        return reps * graphs_per_chunk / (time.perf_counter() - t0)
+
+    return run_window, flops_per_graph
+
+
+def bench_interleaved(ds, cfg, windows: int = 6):
+    """fit() epochs interleaved with cached-chunk ceiling windows.
+
+    Returns (fit_windows, ceiling_windows, flops_per_graph): the per-epoch
+    graphs/s of real training (epoch 0 dropped — compile) and the ceiling
+    window measurements taken BETWEEN those epochs."""
+    from pertgnn_tpu.train.loop import fit
+
+    run_ceiling, flops_per_graph = make_ceiling(ds, cfg)
+    ceiling_windows: list[float] = []
+
+    def hook(epoch: int, row: dict) -> None:
+        ceiling_windows.append(run_ceiling())
+
+    _, history = fit(ds, cfg, epochs=windows + 1, profile_hook=hook)
+    fit_windows = [row["graphs_per_s"] for row in history[1:]]
+    return fit_windows, ceiling_windows[1:], flops_per_graph
 
 
 def make_torch_reference(ds, cfg, f_in):
@@ -184,8 +247,13 @@ def make_torch_reference(ds, cfg, f_in):
 
 
 def bench_torch_baseline(ds, cfg, steps: int = 6) -> float:
-    """The reference's computation in torch on CPU, same batches."""
-    batches = list(ds.batches("train"))[:4]
+    """The reference's computation in torch on CPU, same batches. The
+    torch loop re-feeds pre-converted batches — this is the CEILING of the
+    reference stack (its real loop re-collates on host every step,
+    /root/reference/pert_gnn.py:219-231), so vs_baseline is conservative."""
+    import itertools
+
+    batches = list(itertools.islice(ds.batches("train"), 4))
     _, one_step, _, to_torch = make_torch_reference(
         ds, cfg, batches[0].x.shape[1])
     tbatches = [to_torch(b) for b in batches]
@@ -199,15 +267,44 @@ def bench_torch_baseline(ds, cfg, steps: int = 6) -> float:
 
 
 def main():
+    from pertgnn_tpu.cli.common import apply_platform_env
+    apply_platform_env()  # honor JAX_PLATFORMS=cpu over the axon plugin
+
+    import jax
+
+    from pertgnn_tpu.utils.flops import mfu, peak_flops_per_chip
+
     ds, cfg = build_workload()
-    ours = bench_jax(ds, cfg)
+    fit_w, ceil_w, flops_per_graph = bench_interleaved(ds, cfg,
+                                                       windows=_WINDOWS)
+    fit_med = statistics.median(fit_w)
+    ceil_med = statistics.median(ceil_w)
     baseline = bench_torch_baseline(ds, cfg)
+    eff = mfu(fit_med, flops_per_graph)
+    peak = peak_flops_per_chip()
+
+    def spread_pct(ws):
+        return round(100.0 * (max(ws) - min(ws)) / max(statistics.median(ws),
+                                                       1e-9), 1)
+
     print(json.dumps({
-        "metric": "pert_train_call_graphs_per_sec_per_chip",
-        "value": round(ours, 1),
+        "metric": "pert_e2e_fit_train_call_graphs_per_sec_per_chip",
+        "value": round(fit_med, 1),
         "unit": "graphs/s",
-        "vs_baseline": round(ours / baseline, 2),
+        "vs_baseline": round(fit_med / baseline, 2),
+        "fit_windows": [round(w, 1) for w in fit_w],
+        "fit_spread_pct": spread_pct(fit_w),
+        "ceiling_graphs_per_s": round(ceil_med, 1),
+        "ceiling_windows": [round(w, 1) for w in ceil_w],
+        "ceiling_spread_pct": spread_pct(ceil_w),
+        "fit_over_ceiling": round(fit_med / ceil_med, 3),
+        "mfu_pct": round(100 * eff, 2) if eff is not None else None,
+        "flops_per_graph": (round(flops_per_graph)
+                            if flops_per_graph is not None else None),
+        "peak_flops_per_chip": peak,
         "baseline_torch_cpu_graphs_per_s": round(baseline, 1),
+        "backend": jax.default_backend(),
+        "train_graphs_per_epoch": len(ds.splits["train"]),
     }))
 
 

@@ -126,6 +126,14 @@ class Dataset:
         else:
             yield from stream
 
+    def materializer(self, split: str):
+        """Callable turning one of this split's IndexBatches into a host
+        PackedBatch — multi-host input sharding materializes only the
+        shards this process's devices consume (parallel/multihost.py)."""
+        arena = self.arena()
+        feats = self._feat_arena(split)
+        return lambda idx: materialize_host(arena, feats, idx)
+
     def batches(self, split: str, shuffle: bool = False,
                 seed: int = 0) -> Iterator[PackedBatch]:
         if self._cacheable(split, shuffle) and split in self._epoch_cache:

@@ -69,9 +69,19 @@ def arena_nbytes(arena: MixtureArena, feats: FeatureArena) -> int:
 
 def build_device_arenas(arena: MixtureArena, feats: FeatureArena,
                         sharding=None) -> DeviceArenas:
-    """Place the arenas on device (replicated under `sharding` on a mesh)."""
-    put = (jax.device_put if sharding is None
-           else lambda a: jax.device_put(a, sharding))
+    """Place the arenas on device (replicated under `sharding` on a mesh).
+
+    On multi-host meshes every process holds the identical host arenas, so
+    the replicated global arrays are assembled with
+    make_array_from_process_local_data (device_put cannot target
+    non-addressable devices)."""
+    if sharding is None:
+        put = jax.device_put
+    elif jax.process_count() > 1:
+        from pertgnn_tpu.parallel.multihost import put_replicated
+        put = lambda a: put_replicated(a, sharding)
+    else:
+        put = lambda a: jax.device_put(a, sharding)
     return DeviceArenas(
         ms_id=put(arena.ms_id), node_depth=put(arena.node_depth),
         pattern_prob=put(arena.pattern_prob),

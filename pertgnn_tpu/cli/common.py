@@ -50,9 +50,37 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--nonnegative_pred", action="store_true")
     p.add_argument("--local_loss_weight", type=float, default=0.0)
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--attn_dropout", type=float, default=0.0,
+                   help="dropout on attention weights inside the conv")
+    p.add_argument("--use_pallas_attention", action="store_true",
+                   help="fused Pallas edge-attention kernel (TPU only)")
+    p.add_argument("--missing_indicator_is_zero", action="store_true",
+                   help="preprocess-time indicator convention (1=present) "
+                        "instead of the live get_x convention (1=missing)")
+    p.add_argument("--max_nodes_per_batch", type=int, default=0,
+                   help="packed-batch node budget; 0 = derived from data")
+    p.add_argument("--max_edges_per_batch", type=int, default=0,
+                   help="packed-batch edge budget; 0 = derived from data")
+    p.add_argument("--no_device_materialize", action="store_true",
+                   help="disable chip-resident arenas + device-side batch "
+                        "materialization (host-packed streaming instead)")
+    p.add_argument("--arena_hbm_budget_gb", type=float, default=4.0,
+                   help="HBM budget for chip-resident arenas; exceeding it "
+                        "falls back to host packing; <=0 = unlimited")
+    p.add_argument("--shard_edges", action="store_true",
+                   help="giant-graph mode: shard each batch's edge set "
+                        "over the mesh data axis (nodes replicated)")
     p.add_argument("--data_parallel", type=int, default=1,
                    help="mesh data axis size (1 = single device)")
     p.add_argument("--model_parallel", type=int, default=1)
+    # multi-host (SURVEY.md §5.8): every process runs the same command with
+    # its own --process_id; the mesh then spans all processes' devices
+    p.add_argument("--coordinator_address", default="",
+                   help="host:port of process 0 (multi-host runs)")
+    p.add_argument("--num_processes", type=int, default=0,
+                   help="total process count (0/1 = single-process)")
+    p.add_argument("--process_id", type=int, default=-1,
+                   help="this process's rank in a multi-host run")
     p.add_argument("--checkpoint_dir", default="")
     p.add_argument("--checkpoint_keep", type=int, default=3)
     p.add_argument("--profile_dir", default="",
@@ -82,25 +110,34 @@ def config_from_args(args: argparse.Namespace) -> Config:
             min_traces_per_entry=args.min_traces_per_entry,
             min_resource_coverage=args.min_resource_coverage),
         data=DataConfig(max_traces=args.max_traces,
-                        batch_size=args.batch_size),
+                        batch_size=args.batch_size,
+                        max_nodes_per_batch=args.max_nodes_per_batch or None,
+                        max_edges_per_batch=args.max_edges_per_batch or None),
         model=ModelConfig(
             hidden_channels=args.hidden_channels,
             num_layers=args.num_layers,
             num_heads=args.num_heads,
             dropout=args.dropout,
+            attn_dropout=args.attn_dropout,
             use_node_depth=args.use_node_depth,
             use_edge_durations=args.use_edge_durations,
             nonnegative_pred=args.nonnegative_pred,
             local_loss_weight=args.local_loss_weight,
+            missing_indicator_is_one=not args.missing_indicator_is_zero,
+            use_pallas_attention=args.use_pallas_attention,
             bf16_activations=args.bf16),
         train=TrainConfig(
             lr=args.lr, tau=args.tau, epochs=args.epochs,
             label_scale=args.label_scale, seed=args.seed,
             scan_chunk=args.scan_chunk,
+            device_materialize=not args.no_device_materialize,
+            arena_hbm_budget_gb=(args.arena_hbm_budget_gb
+                                 if args.arena_hbm_budget_gb > 0 else None),
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_keep=args.checkpoint_keep),
         parallel=ParallelConfig(data_parallel=args.data_parallel,
-                                model_parallel=args.model_parallel),
+                                model_parallel=args.model_parallel,
+                                shard_edges=args.shard_edges),
         graph_type=args.graph_type,
     )
 

@@ -30,6 +30,10 @@ def main(argv=None) -> None:
     add_ingest_flags(p)
     add_model_train_flags(p)
     args = p.parse_args(argv)
+    if args.num_processes > 1:
+        from pertgnn_tpu.parallel.multihost import initialize
+        initialize(args.coordinator_address or None, args.num_processes,
+                   args.process_id)
     print(args)
     cfg = config_from_args(args)
 

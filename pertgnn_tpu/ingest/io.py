@@ -23,12 +23,48 @@ import pandas as pd
 from pertgnn_tpu.config import IngestConfig
 from pertgnn_tpu.ingest.assemble import TraceTable, assemble
 from pertgnn_tpu.ingest.preprocess import PreprocessResult, preprocess
+from pertgnn_tpu.ingest.schema import RESOURCE_COLUMNS, SPAN_COLUMNS
 
 log = logging.getLogger(__name__)
 
+try:
+    import pyarrow  # noqa: F401 — pandas engine="pyarrow" availability probe
+    _CSV_ENGINE = "pyarrow"
+except ImportError:  # pragma: no cover — pyarrow ships with the env
+    _CSV_ENGINE = "c"
+
+
+def _read_shard(path: str, columns) -> pd.DataFrame:
+    """One raw CSV shard, schema-hardened:
+
+    - pyarrow engine when available (the reference's choice against the
+      200 GB+ raw dataset, /root/reference/preprocess.py:197, 205, 228);
+    - only the schema columns are kept (raw shards carry an unnamed index
+      column and occasionally extras — dropping them per shard bounds
+      memory at ~1/NumShards of the naive full-tree read);
+    - NaN cells in string columns become the literal "nan" (the raw trace
+      uses both; the reference normalizes the same way via its na handling).
+    """
+    df = pd.read_csv(path, engine=_CSV_ENGINE)
+    missing = [c for c in columns if c not in df.columns]
+    if missing:
+        raise ValueError(f"{path} lacks expected columns {missing}; "
+                         f"found {list(df.columns)}")
+    df = df.loc[:, list(columns)]
+    for c in df.columns:
+        # pandas 3 infers the dedicated `str` dtype for string columns
+        # (object under pandas 2) — cover both
+        if (pd.api.types.is_object_dtype(df[c])
+                or pd.api.types.is_string_dtype(df[c])):
+            df[c] = df[c].fillna("nan")
+    return df
+
 
 def load_raw_csvs(data_dir: str) -> tuple[pd.DataFrame, pd.DataFrame]:
-    """Concatenate the sharded raw CSVs (reference: preprocess.py:203-236)."""
+    """Concatenate the sharded raw CSVs (reference: preprocess.py:203-236).
+
+    Shards are read, pruned, and de-duplicated ONE AT A TIME so peak memory
+    tracks the pruned concatenation, never the raw tree."""
     cg_dir = os.path.join(data_dir, "MSCallGraph")
     rs_dir = os.path.join(data_dir, "MSResource")
     for d in (cg_dir, rs_dir):
@@ -36,15 +72,32 @@ def load_raw_csvs(data_dir: str) -> tuple[pd.DataFrame, pd.DataFrame]:
             raise FileNotFoundError(
                 f"expected raw layout <data_dir>/MSCallGraph and "
                 f"<data_dir>/MSResource; missing {d}")
-    spans = pd.concat(
-        (pd.read_csv(os.path.join(cg_dir, f), index_col=0)
-         .replace(np.nan, "nan")
-         for f in sorted(os.listdir(cg_dir)) if f.endswith(".csv")),
-        ignore_index=True)
-    resources = pd.concat(
-        (pd.read_csv(os.path.join(rs_dir, f))
-         for f in sorted(os.listdir(rs_dir)) if f.endswith(".csv")),
-        ignore_index=True)
+
+    def read_tree(root, columns, dedupe):
+        parts = []
+        files = [f for f in sorted(os.listdir(root)) if f.endswith(".csv")]
+        for f in files:
+            shard = _read_shard(os.path.join(root, f), columns)
+            n_raw = len(shard)
+            if dedupe:
+                shard = shard.drop_duplicates()
+            log.info("read %s: %d rows (%d kept), engine=%s",
+                     f, n_raw, len(shard), _CSV_ENGINE)
+            parts.append(shard)
+        if not parts:
+            raise FileNotFoundError(f"no .csv shards under {root}")
+        return pd.concat(parts, ignore_index=True)
+
+    # Spans: shard-level dedupe is safe (preprocess() dedupes the whole
+    # span frame again anyway — it only bounds memory early). Resources:
+    # NO dedupe anywhere — repeated identical (ts, ms, cpu, mem) readings
+    # are real samples; dropping them would shift the mean/median
+    # aggregates (reference dedupes only the call-graph rows,
+    # /root/reference/preprocess.py:209 vs :227-242).
+    spans = read_tree(cg_dir, SPAN_COLUMNS, dedupe=True)
+    resources = read_tree(rs_dir, RESOURCE_COLUMNS, dedupe=False)
+    log.info("raw load: %d span rows, %d resource rows",
+             len(spans), len(resources))
     return spans, resources
 
 

@@ -163,6 +163,8 @@ def preprocess(spans: pd.DataFrame, resources: pd.DataFrame,
     """Full L0→L2 pipeline on in-memory raw-domain frames."""
     df = spans.drop_duplicates()
     df = df.sort_values(by=["timestamp"], kind="stable")
+    log.info("raw: %d rows (%d after dedupe), %d traces",
+             len(spans), len(df), df["traceid"].nunique())
 
     df, traceid_vocab = factorize_columns(df, ["traceid"])
     df, interface_vocab = factorize_columns(df, ["interface"])
@@ -172,8 +174,19 @@ def preprocess(spans: pd.DataFrame, resources: pd.DataFrame,
     df, rpctype_vocab = factorize_columns(df, ["rpctype"])
 
     resource_df = build_resource_table(resources, cfg)
+    # Per-filter trace accounting, as the reference prints at every stage
+    # (/root/reference/preprocess.py:141-148, 160-176, 183-187) — silent
+    # drops on the real trace are undebuggable.
+    n0 = df["traceid"].nunique()
     df = filter_by_resource_coverage(df, resource_df, cfg)
+    n1 = df["traceid"].nunique()
+    log.info("resource-coverage filter (>= %.2f): %d -> %d traces (-%d)",
+             cfg.min_resource_coverage, n0, n1, n0 - n1)
     df = filter_by_entry_occurrence(df, cfg)
+    n2, e2 = df["traceid"].nunique(), df["entryid"].nunique()
+    log.info("entry-occurrence filter (> %d): %d -> %d traces (-%d), "
+             "%d entries remain",
+             cfg.min_traces_per_entry, n1, n2, n1 - n2, e2)
 
     # shared microservice vocabulary over um ∪ dm ∪ msname
     # (/root/reference/preprocess.py:248-254). The reference builds it from a

@@ -19,6 +19,11 @@ Pipeline and expert axes are deliberately absent: the model has no
 sequential stage structure deep enough to pipeline (max(2, L) small convs)
 and no MoE — the analogous long-context axis for GNNs is GRAPH size, served
 by edge sharding in `graph_shard.py` (SURVEY.md §5.7).
+
+Multi-host: the same mesh spans all processes' devices after
+`multihost.initialize` (jax.distributed); input is sharded per host and
+assembled with make_array_from_process_local_data (parallel/multihost.py;
+2-process CPU equivalence test in tests/test_multihost.py).
 """
 
 from __future__ import annotations
@@ -117,6 +122,23 @@ def param_shardings(params: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: NamedSharding(mesh, _param_spec(path, leaf)),
         params)
+
+
+def place_state(state: Any, st_sh: Any) -> Any:
+    """Place a host-initialized TrainState into its mesh shardings.
+
+    Single-host: device_put of a copy (the donated step would otherwise
+    delete the caller's arrays). Multi-host: every process initialized the
+    identical state (same seed), so each process's local slab of a
+    replicated/within-host-sharded leaf is the full array —
+    make_array_from_process_local_data assembles the global arrays
+    (device_put cannot target non-addressable devices)."""
+    import jax.numpy as jnp
+
+    if jax.process_count() == 1:
+        return jax.device_put(jax.tree.map(jnp.copy, state), st_sh)
+    from pertgnn_tpu.parallel.multihost import put_replicated
+    return put_replicated(state, st_sh)
 
 
 def state_shardings(state: Any, mesh: Mesh) -> Any:

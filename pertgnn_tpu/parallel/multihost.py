@@ -1,0 +1,169 @@
+"""Multi-host distribution: process init, per-host input sharding, global
+batch assembly.
+
+The reference is single-process, single-device by construction
+(/root/reference/pert_gnn.py:36-37 — no torch.distributed anywhere,
+SURVEY.md §5.8). Multi-host here follows the JAX SPMD recipe end-to-end:
+
+- every process runs the SAME program; `initialize` wires the processes
+  together (jax.distributed / coordinator service — the TPU-native stand-in
+  for what a GPU scale-out of the reference would do with NCCL ranks);
+- the device mesh spans ALL processes' devices; the jitted train step is
+  the identical SPMD program as single-host — XLA routes collectives over
+  ICI within a host/slice and DCN across;
+- input is sharded BY HOST: each process materializes only the batch
+  shards its own devices consume (`process_shard_slice`), stacks them with
+  GLOBAL node/graph offsets, and the global device array is assembled with
+  `jax.make_array_from_process_local_data` — no host ever touches the full
+  global batch, so host packing cost divides by process count.
+
+CPU multi-process (tests, 2-process CPU smoke): gloo collectives are
+enabled automatically when the backend is CPU.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Iterator, Sequence
+
+import jax
+import numpy as np
+
+from pertgnn_tpu.batching.arena import IndexBatch
+from pertgnn_tpu.batching.pack import PackedBatch
+
+log = logging.getLogger(__name__)
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """`jax.distributed.initialize` entry point.
+
+    No-op (returns False) when num_processes is absent or 1, so single-host
+    callers can pass CLI flags through unconditionally. On CPU backends the
+    gloo collectives implementation is selected first (required for
+    cross-process psum on CPU; local device count comes from
+    --xla_force_host_platform_device_count)."""
+    if not num_processes or num_processes <= 1:
+        return False
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # older jaxlib without the option: let init try
+            pass
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    log.info("jax.distributed initialized: process %d/%d, %d local / %d "
+             "global devices", jax.process_index(), jax.process_count(),
+             len(jax.local_devices()), len(jax.devices()))
+    return True
+
+
+def process_shard_slice(n_shards: int) -> slice:
+    """The contiguous range of global batch-shard ids this process's
+    devices own (device order in `make_mesh` is `jax.devices()`, which
+    orders devices by process index)."""
+    pc, pi = jax.process_count(), jax.process_index()
+    if n_shards % pc:
+        raise ValueError(
+            f"data-axis size {n_shards} not divisible by process count {pc}")
+    spp = n_shards // pc
+    return slice(pi * spp, (pi + 1) * spp)
+
+
+def _stack_with_global_offsets(parts_cls, batches: Sequence,
+                               shard_offset: int, offset_rules: dict
+                               ) -> "parts_cls":
+    out = {}
+    per = {f: getattr(batches[0], f).shape[0] for f in parts_cls._fields}
+    for field in parts_cls._fields:
+        cols = []
+        for d, b in enumerate(batches):
+            a = getattr(b, field)
+            rule = offset_rules.get(field)
+            if rule is not None:
+                a = a + (shard_offset + d) * per[rule]
+            cols.append(a)
+        out[field] = np.concatenate(cols)
+    return parts_cls(**out)
+
+
+def stack_local_shards(batches: Sequence[PackedBatch],
+                       shard_offset: int) -> PackedBatch:
+    """This host's per-shard batches concatenated with GLOBAL node/graph
+    offsets — the host-local slab of the global batch. No receiver re-sort:
+    multi-host runs the order-free segment attention (data_parallel.
+    stack_index_batches has the same contract)."""
+    return _stack_with_global_offsets(
+        PackedBatch, batches, shard_offset,
+        {"senders": "x", "receivers": "x", "node_graph": "entry_id"})
+
+
+def stack_local_index_shards(idxs: Sequence[IndexBatch],
+                             shard_offset: int) -> IndexBatch:
+    """IndexBatch analog of `stack_local_shards` (matches
+    data_parallel.stack_index_batches with global shard ids)."""
+    return _stack_with_global_offsets(
+        IndexBatch, idxs, shard_offset,
+        {"node_graph": "entry_id", "edge_node_off": "src_node"})
+
+
+def assemble_global(local, shardings, axis: int = 0):
+    """Build global device arrays from each process's local slab
+    (jax.make_array_from_process_local_data per leaf). `axis` is the
+    host-sharded dim: 0 for plain global batches, 1 for leading-STACKED
+    scan chunks (dim 0 is the scan axis, replicated)."""
+    pc = jax.process_count()
+
+    def mk(a, sh):
+        a = np.asarray(a)
+        shape = list(a.shape)
+        shape[axis] *= pc
+        return jax.make_array_from_process_local_data(sh, a, tuple(shape))
+
+    return jax.tree.map(mk, local, shardings,
+                        is_leaf=lambda x: isinstance(x, np.ndarray))
+
+
+def put_replicated(tree, shardings):
+    """Place host arrays fully replicated over a (possibly multi-host)
+    mesh: every process holds the identical value, so the local slab IS the
+    global array (works for single-host too)."""
+    return jax.tree.map(
+        lambda a, sh: jax.make_array_from_process_local_data(
+            sh, np.asarray(a)),
+        tree, shardings, is_leaf=lambda x: isinstance(x, np.ndarray))
+
+
+def host_grouped_batches(index_stream: Iterator[IndexBatch], n_shards: int,
+                         materialize: Callable[[IndexBatch], PackedBatch],
+                         filler: Callable[[IndexBatch], IndexBatch]
+                         ) -> Iterator[PackedBatch]:
+    """Per-host input pipeline: walk the (cheap) whole-epoch gather-recipe
+    stream, but materialize ONLY this host's shards of each global batch.
+    The greedy packer is sequential, so every process must see the same
+    recipe order; the expensive materialization divides by process count."""
+    from pertgnn_tpu.parallel.data_parallel import _grouped
+    sl = process_shard_slice(n_shards)
+    return _grouped(
+        index_stream, n_shards,
+        lambda g: stack_local_shards([materialize(i) for i in g[sl]],
+                                     sl.start),
+        filler)
+
+
+def host_grouped_index_batches(index_stream: Iterator[IndexBatch],
+                               n_shards: int,
+                               filler: Callable[[IndexBatch], IndexBatch]
+                               ) -> Iterator[IndexBatch]:
+    """Per-host gather-recipe pipeline for the device-materialized path:
+    each process stacks only its own shards' recipes (the arenas are
+    replicated on every host's devices)."""
+    from pertgnn_tpu.parallel.data_parallel import _grouped
+    sl = process_shard_slice(n_shards)
+    return _grouped(index_stream, n_shards,
+                    lambda g: stack_local_index_shards(g[sl], sl.start),
+                    filler)

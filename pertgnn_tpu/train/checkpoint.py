@@ -36,16 +36,23 @@ class CheckpointManager:
              ) -> None:
         if (epoch + 1) % self.every:
             return
+        # state is passed as-is: orbax handles (multi-host) sharded
+        # jax.Arrays natively; a device_get here would break multi-host
+        # (no process holds remote shards) and forces a D2H copy
         self._mgr.save(
             epoch,
             args=ocp.args.Composite(
-                state=ocp.args.StandardSave(jax.device_get(state)),
+                state=ocp.args.StandardSave(state),
                 metrics=ocp.args.JsonSave(metrics or {}),
             ),
         )
 
     def maybe_restore(self, state: TrainState) -> tuple[TrainState, int]:
-        """Restore the latest checkpoint if present.
+        """Restore the latest checkpoint if present, directly INTO the
+        live state's shardings — no host-numpy round-trip: the restore
+        target is the abstract (shape, dtype, sharding) tree, so orbax
+        reads each shard where it lives (sharded arrays stay sharded,
+        multi-host restores stay distributed).
 
         Returns (state, start_epoch): start_epoch is one past the saved
         epoch, 0 when nothing is saved.
@@ -53,15 +60,22 @@ class CheckpointManager:
         latest = self._mgr.latest_step()
         if latest is None:
             return state, 0
-        target = jax.device_get(state)
+
+        def abstract(leaf):
+            if isinstance(leaf, jax.Array):
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=leaf.sharding)
+            a = np.asarray(leaf)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        target = jax.tree.map(abstract, state)
         restored = self._mgr.restore(
             latest,
             args=ocp.args.Composite(
                 state=ocp.args.StandardRestore(target)),
         )
         log.info("restored checkpoint at epoch %d", latest)
-        new_state = jax.tree.map(np.asarray, restored["state"])
-        return new_state, latest + 1
+        return restored["state"], latest + 1
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -419,15 +419,35 @@ def fit(dataset: Dataset, cfg: Config,
             batch_shardings, chunk_batch_shardings,
             chunk_index_batch_shardings, index_batch_shardings,
             replicated_sharding)
+        from pertgnn_tpu.parallel.multihost import (
+            assemble_global, host_grouped_batches,
+            host_grouped_index_batches)
         n_shards = mesh.shape["data"]
+        n_proc = jax.process_count()
         init_sample = stack_batches([sample] * n_shards)
         state = create_train_state(model, tx, init_sample, cfg.train.seed)
+        chunked = cfg.train.scan_chunk > 1
+        arena_h = dataset.arena()
+        feats_h = dataset.feat_arena()
+
+        def idx_filler(b):
+            return zero_masked_idx(b, arena_h, feats_h)
+
+        def to_device(glob, sh):
+            """Host global-batch (or per-host slab) stream -> mesh arrays.
+            Single-process: direct sharded device_put. Multi-process: each
+            host built only its slab; assemble the global arrays (the
+            sharded dim is 1 inside a scan chunk, 0 otherwise)."""
+            if n_proc == 1:
+                return _one_ahead(shard_batch(g, mesh, sh) for g in glob)
+            return _one_ahead(
+                assemble_global(g, sh, axis=1 if chunked else 0)
+                for g in glob)
+
         if device_materialize:
-            arena_h = dataset.arena()
-            feats_h = dataset.feat_arena()
             dev = build_device_arenas(arena_h, feats_h,
                                       sharding=replicated_sharding(mesh))
-            if cfg.train.scan_chunk > 1:
+            if chunked:
                 train_step, state = make_sharded_train_chunk_indexed(
                     model, cfg, tx, mesh, state, dev)
                 eval_step = make_sharded_eval_chunk_indexed(model, cfg, mesh,
@@ -440,43 +460,46 @@ def fit(dataset: Dataset, cfg: Config,
                                                            state, dev)
                 sh = index_batch_shardings(mesh)
 
-            def idx_filler(b):
-                return zero_masked_idx(b, arena_h, feats_h)
-
             def batch_stream(split, shuffle=False, seed=0):
                 idxs = dataset.index_batches(split, shuffle=shuffle,
                                              seed=seed)
-                glob = grouped_index_batches(idxs, n_shards, idx_filler)
-                if cfg.train.scan_chunk > 1:
+                if n_proc > 1:  # each process stacks only its own shards
+                    glob = host_grouped_index_batches(idxs, n_shards,
+                                                      idx_filler)
+                else:
+                    glob = grouped_index_batches(idxs, n_shards, idx_filler)
+                if chunked:
                     glob = _host_chunks(glob, cfg.train.scan_chunk,
                                         idx_filler)
                 if shuffle:  # train: index packing off the critical path
                     glob = _background(glob)
-                return _one_ahead(shard_batch(g, mesh, sh) for g in glob)
-        elif cfg.train.scan_chunk > 1:
-            # scan-fused SPMD: one dispatch per scan_chunk global batches
-            train_step, state = make_sharded_train_chunk(model, cfg, tx,
-                                                         mesh, state)
-            eval_step = make_sharded_eval_chunk(model, cfg, mesh, state)
-            cb_sh = chunk_batch_shardings(mesh)
-
-            def batch_stream(split, shuffle=False, seed=0):
-                grouped = grouped_batches(
-                    dataset.batches(split, shuffle=shuffle, seed=seed),
-                    n_shards)
-                return _one_ahead(
-                    shard_batch(c, mesh, cb_sh) for c in
-                    _host_chunks(grouped, cfg.train.scan_chunk))
+                return to_device(glob, sh)
         else:
-            train_step, state = make_sharded_train_step(model, cfg, tx,
-                                                        mesh, state)
-            eval_step = make_sharded_eval_step(model, cfg, mesh, state)
-            b_sh = batch_shardings(mesh)
+            if chunked:
+                # scan-fused SPMD: one dispatch per scan_chunk globals
+                train_step, state = make_sharded_train_chunk(model, cfg, tx,
+                                                             mesh, state)
+                eval_step = make_sharded_eval_chunk(model, cfg, mesh, state)
+                sh = chunk_batch_shardings(mesh)
+            else:
+                train_step, state = make_sharded_train_step(model, cfg, tx,
+                                                            mesh, state)
+                eval_step = make_sharded_eval_step(model, cfg, mesh, state)
+                sh = batch_shardings(mesh)
 
             def batch_stream(split, shuffle=False, seed=0):
-                return (shard_batch(g, mesh, b_sh) for g in grouped_batches(
-                    dataset.batches(split, shuffle=shuffle, seed=seed),
-                    n_shards))
+                if n_proc > 1:  # materialize only this host's shards
+                    glob = host_grouped_batches(
+                        dataset.index_batches(split, shuffle=shuffle,
+                                              seed=seed),
+                        n_shards, dataset.materializer(split), idx_filler)
+                else:
+                    glob = grouped_batches(
+                        dataset.batches(split, shuffle=shuffle, seed=seed),
+                        n_shards)
+                if chunked:
+                    glob = _host_chunks(glob, cfg.train.scan_chunk)
+                return to_device(glob, sh)
     elif device_materialize:
         # Chip-resident arenas + IndexBatch feeding: the host's per-epoch
         # work is index arithmetic only (batching/arena.py), done in a

@@ -1,0 +1,75 @@
+"""FLOPs accounting and MFU (model FLOPs utilization).
+
+The reference publishes no efficiency numbers at all (BASELINE.md); here
+every benchmark can relate graphs/s to what the chip could do: FLOPs per
+compiled program come from XLA's own cost model
+(`jit(...).lower(...).compile().cost_analysis()`), peak chip FLOPs from a
+device-kind table. MFU = achieved FLOPs/s / peak FLOPs/s.
+
+Caveats, stated so the number is interpretable:
+- XLA's `flops` counts the optimized HLO (post-fusion), i.e. hardware
+  FLOPs, not a paper-model count;
+- peaks are the published dense bf16/f32-accumulate MXU numbers per chip;
+  this workload's GEMMs are small (hidden 32 default), so low MFU means
+  "dispatch/HBM-bound", not "broken" — see RESULTS.md.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+log = logging.getLogger(__name__)
+
+# peak dense matmul FLOPs/s per chip (bf16 with f32 accumulate — the MXU
+# path XLA uses for f32 model dtypes too, via 3-pass bf16 decomposition
+# it counts as-is). Public numbers: cloud.google.com/tpu/docs.
+_PEAK_FLOPS_BY_KIND = (
+    ("v6e", 918e12),
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),     # v5e reports device_kind "TPU v5 lite"
+    ("v5", 459e12),
+    ("v4 lite", 138e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops_per_chip() -> float | None:
+    """Peak FLOPs/s of one local device, or None when unknown (CPU)."""
+    dev = jax.devices()[0]
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    if dev.platform != "tpu":
+        return None
+    for key, peak in _PEAK_FLOPS_BY_KIND:
+        if key in kind:
+            return peak
+    log.warning("unknown TPU device_kind %r — MFU unavailable", kind)
+    return None
+
+
+def compiled_flops(jitted, *args) -> float | None:
+    """FLOPs of ONE invocation of an already-jitted callable on `args`,
+    from XLA's cost analysis (None if the backend doesn't report it)."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jax: one dict per program
+            cost = cost[0]
+        f = cost.get("flops")
+        return float(f) if f and f > 0 else None
+    except Exception as e:  # pragma: no cover — backend-dependent
+        log.info("cost_analysis unavailable: %s", e)
+        return None
+
+
+def mfu(graphs_per_s: float, flops_per_graph: float | None) -> float | None:
+    """Achieved fraction of chip peak at `graphs_per_s` throughput."""
+    peak = peak_flops_per_chip()
+    if peak is None or flops_per_graph is None:
+        return None
+    return graphs_per_s * flops_per_graph / peak

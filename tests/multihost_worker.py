@@ -1,0 +1,96 @@
+"""Worker for the 2-process CPU multi-host test (tests/test_multihost.py).
+
+Each process: 2 virtual CPU devices -> 4 global devices over 2 processes.
+Runs (a) ONE host-packed sharded train step on the deterministic first
+global batch, (b) one full fit() epoch through the device-materialized
+multi-host path. Process 0 writes the metrics to the JSON path in argv so
+the parent can compare against its own single-process run of the same
+global batch (SURVEY.md §4 "Distributed").
+
+Not named test_* on purpose: launched as a subprocess, not collected.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pertgnn_tpu.parallel import multihost
+
+PORT, PID, NPROC, OUT = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+                         sys.argv[4])
+assert multihost.initialize(f"localhost:{PORT}", NPROC, PID)
+assert jax.process_count() == NPROC
+
+import dataclasses
+
+import numpy as np
+import optax
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import (Config, DataConfig, IngestConfig, ModelConfig,
+                                TrainConfig)
+from pertgnn_tpu.ingest import synthetic
+from pertgnn_tpu.ingest.preprocess import preprocess
+from pertgnn_tpu.models.pert_model import make_model
+from pertgnn_tpu.parallel.data_parallel import make_sharded_train_step
+from pertgnn_tpu.parallel.mesh import batch_shardings, make_mesh
+from pertgnn_tpu.parallel.multihost import (assemble_global,
+                                            host_grouped_batches)
+from pertgnn_tpu.train.loop import create_train_state, fit
+
+# Must mirror tests/test_multihost.py:_dataset_and_cfg exactly — every
+# process (and the single-process parent) builds the identical dataset.
+cfg = Config(
+    ingest=IngestConfig(min_traces_per_entry=10),
+    data=DataConfig(max_traces=200, batch_size=8),
+    model=ModelConfig(hidden_channels=16, num_layers=2),
+    train=TrainConfig(lr=1e-3, label_scale=1000.0, scan_chunk=1),
+)
+data = synthetic.generate(synthetic.SyntheticSpec(
+    num_microservices=30, num_entries=3, patterns_per_entry=3,
+    traces_per_entry=40, seed=7))
+pre = preprocess(data.spans, data.resources, cfg.ingest)
+ds = build_dataset(pre, cfg)
+
+n_shards = 4
+mesh = make_mesh(data=n_shards, model=1)
+
+# (a) one host-packed sharded step on the first global batch: this process
+# materializes ONLY its own 2 shards
+model = make_model(cfg.model, ds.num_ms, ds.num_entries, ds.num_interfaces,
+                   ds.num_rpctypes)
+tx = optax.adam(cfg.train.lr)
+from pertgnn_tpu.batching.materialize import zero_masked_idx
+
+filler = lambda b: zero_masked_idx(b, ds.arena(), ds.feat_arena())
+local = next(iter(host_grouped_batches(
+    ds.index_batches("train"), n_shards, ds.materializer("train"), filler)))
+glob = assemble_global(local, batch_shardings(mesh))
+init_host = next(ds.batches("train"))
+from pertgnn_tpu.parallel.data_parallel import stack_batches
+
+state = create_train_state(model, tx, stack_batches([init_host] * n_shards),
+                           cfg.train.seed)
+step, sh_state = make_sharded_train_step(model, cfg, tx, mesh, state)
+sh_state, m = step(sh_state, glob)
+result = {k: float(v) for k, v in m.items()}
+
+# (b) full fit() epoch through the device-materialized multi-host path
+cfg_fit = cfg.replace(train=dataclasses.replace(cfg.train, scan_chunk=2))
+_, hist = fit(ds, cfg_fit, epochs=1, mesh=mesh)
+result["fit_train_qloss"] = hist[-1]["train_qloss"]
+assert np.isfinite(result["fit_train_qloss"])
+
+if PID == 0:
+    with open(OUT, "w") as f:
+        json.dump(result, f)
+print(f"worker {PID} done: {result}", flush=True)

@@ -116,6 +116,36 @@ class TestCLI:
         assert "Epoch: 1" in out
         assert "graphs/s" in out
 
+    def test_all_config_fields_settable(self):
+        """Every Config field the benchmarks touch maps from a CLI flag
+        (VERDICT r2 #10)."""
+        import argparse
+
+        from pertgnn_tpu.cli.common import (add_ingest_flags,
+                                            add_model_train_flags,
+                                            config_from_args)
+
+        p = argparse.ArgumentParser()
+        add_ingest_flags(p)
+        add_model_train_flags(p)
+        args = p.parse_args([
+            "--attn_dropout", "0.1", "--use_pallas_attention",
+            "--missing_indicator_is_zero", "--max_nodes_per_batch", "512",
+            "--max_edges_per_batch", "1024", "--no_device_materialize",
+            "--arena_hbm_budget_gb", "0", "--shard_edges",
+            "--num_heads", "4", "--scan_chunk", "2"])
+        c = config_from_args(args)
+        assert c.model.attn_dropout == 0.1
+        assert c.model.use_pallas_attention
+        assert c.model.missing_indicator_is_one is False
+        assert c.data.max_nodes_per_batch == 512
+        assert c.data.max_edges_per_batch == 1024
+        assert c.train.device_materialize is False
+        assert c.train.arena_hbm_budget_gb is None
+        assert c.parallel.shard_edges
+        assert c.model.num_heads == 4
+        assert c.train.scan_chunk == 2
+
     def test_train_cli_with_mesh_and_checkpoint(self, tmp_path, capsys):
         import jax
 

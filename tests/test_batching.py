@@ -169,6 +169,44 @@ class TestArenaPacker:
                 np.testing.assert_array_equal(getattr(f, name),
                                               getattr(s_, name))
 
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_assign_batches_fast_path_matches_scalar(self, seed):
+        """The cumsum fast path must reproduce the scalar greedy rule
+        exactly whenever its no-overflow precondition holds."""
+        from pertgnn_tpu.batching.arena import assign_batches
+        from pertgnn_tpu.batching.pack import BatchBudget
+
+        rng = np.random.default_rng(seed)
+        nc = rng.integers(3, 12, size=500)
+        ec = rng.integers(2, 20, size=500)
+
+        def scalar_greedy(nc, ec, budget):
+            b = g = n = e = 0
+            out = []
+            for cn, ce in zip(nc.tolist(), ec.tolist()):
+                if (g + 1 > budget.max_graphs or n + cn > budget.max_nodes
+                        or e + ce > budget.max_edges):
+                    b += 1
+                    g = n = e = 0
+                out.append((b, g, n, e))
+                g, n, e = g + 1, n + cn, e + ce
+            return tuple(np.array(c) for c in zip(*out))
+
+        # fast-path regime: budgets sized so node/edge never bind
+        roomy = BatchBudget(max_graphs=16, max_nodes=16 * 12, max_edges=16 * 20)
+        got = assign_batches(nc, ec, roomy)
+        want = scalar_greedy(nc, ec, roomy)
+        for a, b_, name in zip(got, want, ("batch", "slot", "noff", "eoff")):
+            np.testing.assert_array_equal(a, b_, err_msg=name)
+
+        # binding regime: budgets that DO bind mid-group -> scalar loop
+        tight = BatchBudget(max_graphs=16, max_nodes=60, max_edges=90)
+        got_t = assign_batches(nc, ec, tight)
+        want_t = scalar_greedy(nc, ec, tight)
+        for a, b_, name in zip(got_t, want_t, ("batch", "slot", "noff",
+                                               "eoff")):
+            np.testing.assert_array_equal(a, b_, err_msg=name)
+
     def test_eval_epoch_cached(self, ds):
         a = list(ds.batches("valid"))
         b = list(ds.batches("valid"))

@@ -1,0 +1,100 @@
+"""Multi-host distributed training test (SURVEY.md §4 "Distributed",
+§5.8): two REAL processes, 2 virtual CPU devices each, gloo collectives.
+
+The workers (tests/multihost_worker.py) run one host-packed sharded train
+step on the first global batch — each process materializing only its own
+shards and assembling the global arrays with
+jax.make_array_from_process_local_data — plus one fit() epoch through the
+device-materialized multi-host path. The parent then runs the SAME global
+step single-process on its 8 virtual devices (data=4 mesh, same dataset,
+same seed) and the metrics must agree: the distributed program is the same
+SPMD computation, so this must hold to float tolerance.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import (Config, DataConfig, IngestConfig, ModelConfig,
+                                TrainConfig)
+from pertgnn_tpu.models.pert_model import make_model
+from pertgnn_tpu.parallel.data_parallel import (grouped_batches,
+                                                make_sharded_train_step,
+                                                shard_batch)
+from pertgnn_tpu.parallel.mesh import make_mesh
+from pertgnn_tpu.train.loop import create_train_state
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 fake CPU devices")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _worker_cfg(preprocessed):
+    # mirror of tests/multihost_worker.py — same dataset on every process
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=200, batch_size=8),
+        model=ModelConfig(hidden_channels=16, num_layers=2),
+        train=TrainConfig(lr=1e-3, label_scale=1000.0, scan_chunk=1),
+    )
+    return build_dataset(preprocessed, cfg), cfg
+
+
+@pytest.fixture(scope="module")
+def worker_result(tmp_path_factory):
+    """Run the 2-process job once; returns process 0's metrics."""
+    out = tmp_path_factory.mktemp("mh") / "result.json"
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    script = os.path.join(_REPO, "tests", "multihost_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(port), str(pid), "2", str(out)],
+        env=env, cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in (0, 1)]
+    outs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{o[-4000:]}"
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_two_process_step_matches_single_process(worker_result, preprocessed):
+    """Distributed step metrics == single-process metrics on the same
+    global batch (VERDICT r2 #3 'done' criterion)."""
+    ds, cfg = _worker_cfg(preprocessed)
+    mesh = make_mesh(data=4, model=1, devices=jax.devices()[:4])
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    tx = optax.adam(cfg.train.lr)
+    glob = next(grouped_batches(ds.batches("train"), 4))
+    state = create_train_state(model, tx, glob, cfg.train.seed)
+    step, sh_state = make_sharded_train_step(model, cfg, tx, mesh, state)
+    _, m = step(sh_state, shard_batch(glob, mesh))
+
+    assert worker_result["count"] == float(m["count"])
+    for key in ("qloss_sum", "mae_sum", "mape_sum"):
+        np.testing.assert_allclose(worker_result[key], float(m[key]),
+                                   rtol=1e-4, err_msg=key)
+
+
+def test_two_process_fit_epoch_finite(worker_result):
+    """The device-materialized multi-host fit() epoch ran and produced
+    finite metrics over the full train split."""
+    assert np.isfinite(worker_result["fit_train_qloss"])
