@@ -73,8 +73,11 @@ def _flagship_cfg(**model_overrides):
 
 
 def _train_throughput(ds, cfg, steps: int = 160,
-                      edge_shard_mesh=None) -> float:
-    """graphs/s of the scan-fused train step on this backend."""
+                      edge_shard_mesh=None, with_mfu: bool = False):
+    """graphs/s of the scan-fused train step on this backend.
+
+    Returns the float, or (with_mfu=True) a dict adding `mfu_pct` and
+    `flops_per_graph` from XLA cost analysis (VERDICT r2 #4)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -82,6 +85,7 @@ def _train_throughput(ds, cfg, steps: int = 160,
     from pertgnn_tpu.models.pert_model import make_model
     from pertgnn_tpu.train.loop import (_chunk_iter, create_train_state,
                                         make_train_chunk)
+    from pertgnn_tpu.utils.flops import compiled_flops, mfu
 
     model = make_model(cfg.model, ds.num_ms, ds.num_entries,
                        ds.num_interfaces, ds.num_rpctypes,
@@ -94,6 +98,10 @@ def _train_throughput(ds, cfg, steps: int = 160,
     b0 = jax.tree.map(lambda a: jnp.asarray(a[0]), chunk_batch)
     state = create_train_state(model, tx, b0, cfg.train.seed)
     chunk = make_train_chunk(model, cfg, tx)
+    flops_per_graph = None
+    if with_mfu:
+        fl = compiled_flops(chunk, state, chunk_batch)
+        flops_per_graph = (fl / graphs_per_chunk) if fl else None
     state, m = chunk(state, chunk_batch)
     jax.block_until_ready(m["qloss_sum"])
     n_chunks = max(1, steps // cfg.train.scan_chunk)
@@ -101,7 +109,14 @@ def _train_throughput(ds, cfg, steps: int = 160,
     for _ in range(n_chunks):
         state, m = chunk(state, chunk_batch)
     jax.block_until_ready(m["qloss_sum"])
-    return n_chunks * graphs_per_chunk / (time.perf_counter() - t0)
+    gps = n_chunks * graphs_per_chunk / (time.perf_counter() - t0)
+    if not with_mfu:
+        return gps
+    eff = mfu(gps, flops_per_graph)
+    return {"graphs_per_s": gps,
+            "mfu_pct": round(100 * eff, 2) if eff is not None else None,
+            "flops_per_graph": (round(flops_per_graph)
+                                if flops_per_graph else None)}
 
 
 def smoke_cpu() -> dict:
@@ -142,9 +157,11 @@ def flagship_chip() -> dict:
     ds = _dataset(dict(num_microservices=60, num_entries=8,
                        patterns_per_entry=4, traces_per_entry=400, seed=42),
                   cfg)
-    gps = _train_throughput(ds, cfg)
-    return {"metric": "flagship_train_graphs_per_s", "value": round(gps, 1),
-            "unit": "graphs/s", "config": "hidden32 L3 batch170 pert"}
+    r = _train_throughput(ds, cfg, with_mfu=True)
+    return {"metric": "flagship_train_graphs_per_s",
+            "value": round(r["graphs_per_s"], 1),
+            "unit": "graphs/s", "config": "hidden32 L3 batch170 pert",
+            "mfu_pct": r["mfu_pct"], "flops_per_graph": r["flops_per_graph"]}
 
 
 def dp8() -> dict:
@@ -203,10 +220,11 @@ def deep_wide() -> dict:
     ds = _dataset(dict(num_microservices=60, num_entries=8,
                        patterns_per_entry=4, traces_per_entry=200, seed=42),
                   cfg)
-    gps = _train_throughput(ds, cfg, steps=40)
+    r = _train_throughput(ds, cfg, steps=40, with_mfu=True)
     return {"metric": "deep_wide_train_graphs_per_s",
-            "value": round(gps, 1), "unit": "graphs/s",
-            "config": "hidden256 L8 H8 batch64 pert"}
+            "value": round(r["graphs_per_s"], 1), "unit": "graphs/s",
+            "config": "hidden256 L8 H8 batch64 pert",
+            "mfu_pct": r["mfu_pct"], "flops_per_graph": r["flops_per_graph"]}
 
 
 def giant_dag() -> dict:
@@ -223,8 +241,10 @@ def giant_dag() -> dict:
     nodes, edges = sample.x.shape[0], sample.senders.shape[0]
     out = {"metric": "giant_dag_train_graphs_per_s", "unit": "graphs/s",
            "padded_nodes": nodes, "padded_edges": edges}
-    gps = _train_throughput(ds, cfg, steps=16)
-    out["value"] = round(gps, 2)
+    r = _train_throughput(ds, cfg, steps=16, with_mfu=True)
+    out["value"] = round(r["graphs_per_s"], 2)
+    out["mfu_pct"] = r["mfu_pct"]
+    out["flops_per_graph"] = r["flops_per_graph"]
     cfg_p = cfg.replace(model=dataclasses.replace(
         cfg.model, use_pallas_attention=True))
     out["pallas_graphs_per_s"] = round(_train_throughput(ds, cfg_p,
@@ -281,65 +301,179 @@ def ingest_pipeline() -> dict:
             "vs_reference_estimate": round((n_traces / total) / 2.8, 1)}
 
 
-def quality_parity() -> dict:
+def _mean_ci95(xs) -> tuple[float, float]:
+    xs = np.asarray(xs, dtype=np.float64)
+    half = 1.96 * xs.std(ddof=1) / np.sqrt(len(xs)) if len(xs) > 1 else 0.0
+    return float(xs.mean()), float(half)
+
+
+def quality_parity(seeds: int = 10) -> dict:
     """Model-quality parity: our model vs the torch re-implementation of
     the reference's stack (bench.make_torch_reference), trained with the
     same hparams, epochs, and per-epoch shuffled+repacked batch stream,
     compared on held-out test MAE. The reference publishes no quality
-    numbers (BASELINE.md), so this is the measurable stand-in."""
+    numbers (BASELINE.md), so this is the measurable stand-in.
+
+    Statistics (VERDICT r2 #8): BOTH graph types, `seeds` seeds each side,
+    mean +- 95% CI (normal approx). Init schemes differ by framework and
+    are part of what each stack ships: flax here uses glorot-uniform for
+    attention projections / lecun-normal Dense heads / N(0,1) embeddings;
+    torch uses kaiming-uniform(a=sqrt5) Linear and N(0,1) embeddings —
+    the seed spread absorbs init variance on both sides."""
     import bench as bench_mod
-    from pertgnn_tpu.train.loop import fit
-
-    cfg = _flagship_cfg()
-    cfg = cfg.replace(
-        data=dataclasses.replace(cfg.data, batch_size=32),
-        train=dataclasses.replace(cfg.train, epochs=8, scan_chunk=4,
-                                  lr=1e-3))
-    ds = _dataset(dict(num_entries=6, traces_per_entry=120, seed=5), cfg)
-    epochs = cfg.train.epochs
-
-    # seed variance dominates at this scale (measured 355-1119 MAE across
-    # seeds on 8 epochs), so report the median of 3 seeds
-    maes = []
-    for seed in (0, 1, 2):
-        c = cfg.replace(train=dataclasses.replace(cfg.train, seed=seed))
-        _, history = fit(ds, c)
-        maes.append(history[-1]["test_mae"])
-    ours_mae = float(np.median(maes))
-
-    # torch gets the same treatment: 3 seeds, per-epoch shuffling (fit()
-    # shuffles the train stream each epoch)
     import torch
 
-    sample = next(ds.batches("train"))
-    torch_maes = []
-    for seed in (0, 1, 2):
-        torch.manual_seed(seed)
-        _, one_step, predict, to_torch = bench_mod.make_torch_reference(
-            ds, cfg, sample.x.shape[1])
-        for epoch in range(epochs):
-            # same stream fit() trains on: shuffled + greedily re-packed
-            # per epoch (batching/dataset.py)
-            for b in ds.batches("train", shuffle=True,
-                                seed=cfg.data.shuffle_seed + epoch):
-                one_step(to_torch(b))
-        err = n = 0.0
-        for b in ds.batches("test"):
-            pred = predict(to_torch(b))
-            mask = np.asarray(b.graph_mask)
-            err += float(np.abs(pred - np.asarray(b.y))[mask].sum())
-            n += float(mask.sum())
-        torch_maes.append(err / max(n, 1.0))
-    torch_mae = float(np.median(torch_maes))
-    return {"metric": "quality_parity_test_mae_ratio",
-            "value": round(ours_mae / max(torch_mae, 1e-9), 3),
-            "unit": "ours/torch (lower is better)",
-            "ours_test_mae_median_of_3_seeds": round(ours_mae, 2),
-            "ours_test_mae_per_seed": [round(m, 1) for m in maes],
-            "torch_reference_test_mae_median_of_3_seeds": round(torch_mae,
-                                                                2),
-            "torch_test_mae_per_seed": [round(m, 1) for m in torch_maes],
-            "epochs": epochs}
+    from pertgnn_tpu.train.loop import fit
+
+    base = _flagship_cfg()
+    epochs = int(os.environ.get("QUALITY_EPOCHS", "20"))
+    base = base.replace(
+        data=dataclasses.replace(base.data, batch_size=32),
+        train=dataclasses.replace(base.train, epochs=epochs, scan_chunk=4,
+                                  lr=1e-3))
+    out = {"metric": "quality_parity_test_mae_ratio",
+           "unit": "ours/torch ratio of mean test MAE (lower is better)",
+           "epochs": epochs, "seeds_per_side": seeds,
+           "init_note": ("flax: glorot-uniform attn / lecun-normal heads; "
+                         "torch: kaiming-uniform(a=sqrt5) Linear; both "
+                         "N(0,1) embeddings")}
+    # TWO measures per graph type:
+    # - test MAE: the reference's own protocol — but its POSITIONAL
+    #   entry-grouped split (pert_gnn.py:196-210) puts mostly-UNSEEN
+    #   entries in the test tail, so test predictions ride on untrained
+    #   entry embeddings: structurally noise-dominated (documented in
+    #   tests/test_train.py too). Reported with CI, interpreted with care.
+    # - train-fit MAE: how well each stack fits the same data — low-noise
+    #   and the meaningful head-to-head of the two implementations.
+    for gtype in ("pert", "span"):
+        cfg = base.replace(graph_type=gtype)
+        ds = _dataset(dict(num_entries=6, traces_per_entry=120, seed=5), cfg)
+        sample = next(ds.batches("train"))
+
+        def eval_split(predict, to_torch, split):
+            err = n = 0.0
+            for b in ds.batches(split):
+                pred = predict(to_torch(b))
+                mask = np.asarray(b.graph_mask)
+                err += float(np.abs(pred - np.asarray(b.y))[mask].sum())
+                n += float(mask.sum())
+            return err / max(n, 1.0)
+
+        ours, ours_fit = [], []
+        for seed in range(seeds):
+            c = cfg.replace(train=dataclasses.replace(cfg.train, seed=seed))
+            state, history = fit(ds, c)
+            ours.append(history[-1]["test_mae"])
+            # train-fit: eval-mode MAE over the train split
+            from pertgnn_tpu.models.pert_model import make_model
+            from pertgnn_tpu.train.loop import evaluate, make_eval_step
+            model = make_model(c.model, ds.num_ms, ds.num_entries,
+                               ds.num_interfaces, ds.num_rpctypes)
+            m = evaluate(make_eval_step(model, c), state,
+                         ds.batches("train"))
+            ours_fit.append(m["mae"])
+
+        torch_maes, torch_fit = [], []
+        for seed in range(seeds):
+            torch.manual_seed(seed)
+            _, one_step, predict, to_torch = bench_mod.make_torch_reference(
+                ds, cfg, sample.x.shape[1])
+            for epoch in range(epochs):
+                # same stream fit() trains on: shuffled + greedily
+                # re-packed per epoch (batching/dataset.py)
+                for b in ds.batches("train", shuffle=True,
+                                    seed=cfg.data.shuffle_seed + epoch):
+                    one_step(to_torch(b))
+            torch_maes.append(eval_split(predict, to_torch, "test"))
+            torch_fit.append(eval_split(predict, to_torch, "train"))
+
+        o_mean, o_ci = _mean_ci95(ours)
+        t_mean, t_ci = _mean_ci95(torch_maes)
+        of_mean, of_ci = _mean_ci95(ours_fit)
+        tf_mean, tf_ci = _mean_ci95(torch_fit)
+        out[gtype] = {
+            "test_ours_mean_mae": round(o_mean, 1),
+            "test_ours_ci95": round(o_ci, 1),
+            "test_torch_mean_mae": round(t_mean, 1),
+            "test_torch_ci95": round(t_ci, 1),
+            "test_ratio_of_means": round(o_mean / max(t_mean, 1e-9), 3),
+            "trainfit_ours_mean_mae": round(of_mean, 1),
+            "trainfit_ours_ci95": round(of_ci, 1),
+            "trainfit_torch_mean_mae": round(tf_mean, 1),
+            "trainfit_torch_ci95": round(tf_ci, 1),
+            "trainfit_ratio_of_means": round(of_mean / max(tf_mean, 1e-9),
+                                             3),
+            "test_ours_per_seed": [round(m, 1) for m in ours],
+            "test_torch_per_seed": [round(m, 1) for m in torch_maes],
+        }
+    out["value"] = out["pert"]["test_ratio_of_means"]
+    out["trainfit_ratio_pert"] = out["pert"]["trainfit_ratio_of_means"]
+    return out
+
+
+def pallas_crossover() -> dict:
+    """Measured crossover table: fused Pallas edge-attention kernel vs the
+    XLA sorted-segment path, forward+backward, across average degree and
+    hidden size (VERDICT r2 #9 — the kernel's keep/demote evidence).
+
+    Interleaved timing: for each cell, alternating (segment, pallas)
+    windows x3, median reported, so tunnel variance hits both alike."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        raise SystemExit("pallas_crossover needs the TPU chip (the kernel "
+                         "runs in slow interpret mode elsewhere)")
+
+    from pertgnn_tpu.ops.pallas_attention import edge_attention
+    from pertgnn_tpu.ops.segment import segment_edge_attention
+
+    N = 4096
+    rows = []
+    for deg in (1, 2, 4, 8):
+        for hidden in (32, 128):
+            E = N * deg
+            rng = np.random.default_rng(deg * 1000 + hidden)
+            q = jnp.asarray(rng.normal(size=(N, 1, hidden)), jnp.float32)
+            k = jnp.asarray(rng.normal(size=(E, 1, hidden)), jnp.float32)
+            v = jnp.asarray(rng.normal(size=(E, 1, hidden)), jnp.float32)
+            rcv = jnp.asarray(np.sort(rng.integers(0, N, E)), jnp.int32)
+            msk = jnp.ones(E, bool)
+
+            def seg_loss(q, k, v):
+                return segment_edge_attention(q, k, v, rcv, msk, N).sum()
+
+            def pal_loss(q, k, v):
+                return edge_attention(q, k, v, rcv, msk, N,
+                                      assume_sorted=True).sum()
+
+            fns = {}
+            for name, f in (("segment", seg_loss), ("pallas", pal_loss)):
+                g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+                out = g(q, k, v)
+                jax.block_until_ready(out[0])  # compile+warm
+
+                def window(g=g):
+                    t0 = time.perf_counter()
+                    for _ in range(30):
+                        out = g(q, k, v)
+                    jax.block_until_ready(out[0])
+                    return (time.perf_counter() - t0) / 30 * 1e3  # ms
+
+                fns[name] = window
+            seg_ms, pal_ms = [], []
+            for _ in range(3):  # interleave
+                seg_ms.append(fns["segment"]())
+                pal_ms.append(fns["pallas"]())
+            s, p = float(np.median(seg_ms)), float(np.median(pal_ms))
+            rows.append({"avg_degree": deg, "hidden": hidden,
+                         "segment_ms": round(s, 3), "pallas_ms": round(p, 3),
+                         "pallas_speedup": round(s / p, 2)})
+    wins = [r for r in rows if r["pallas_speedup"] > 1.05]
+    return {"metric": "pallas_crossover_min_winning_degree",
+            "value": min((r["avg_degree"] for r in wins), default=-1),
+            "unit": "avg degree where the fused kernel first wins >5%",
+            "nodes": N, "table": rows}
 
 
 CONFIGS = {
@@ -350,6 +484,7 @@ CONFIGS = {
     "dp8": dp8,
     "deep_wide": deep_wide,
     "giant_dag": giant_dag,
+    "pallas_crossover": pallas_crossover,
 }
 
 

@@ -17,11 +17,11 @@ This module removes the per-example work from the epoch path:
   (one vectorized ResourceLookup call for all pairs together) and re-used by
   every epoch.
 - `pack_epoch` — packs a whole epoch (any example order) into fixed-shape
-  batches using O(#vectorized-ops) numpy: a scalar greedy pass assigns
-  examples to batches (the same greedy rule as `pack_examples`, bitwise
-  identical output — see tests/test_batching.py fast/slow parity), then
-  ragged-arange gathers scatter nodes/edges/graphs of ALL examples at once,
-  and one composite-key argsort receiver-sorts every batch's edges together.
+  batches using O(#vectorized-ops) numpy: a per-BATCH searchsorted pass
+  assigns examples to batches (the same greedy rule as `pack_examples`,
+  bitwise identical output — see tests/test_batching.py fast/slow parity),
+  then ragged-arange gathers scatter nodes/edges/graphs of ALL examples at
+  once, pre-sorted per mixture so no epoch-path sort remains.
 
 Memory is bounded by packing in slabs of `slab_batches` batches.
 """
@@ -172,50 +172,108 @@ def assign_batches(node_counts: np.ndarray, edge_counts: np.ndarray,
 
     Returns per-example (batch_idx, graph_slot, node_offset, edge_offset).
 
-    Fast path: when no window of `max_graphs` examples can overflow the
-    node/edge budgets (max_count * max_graphs <= budget — true for
-    `derive_budget` outputs on homogeneous mixtures), the greedy rule
-    provably breaks exactly every `max_graphs` examples, so the whole
-    assignment is arange/cumsum arithmetic. Otherwise the exact scalar
-    greedy loop runs (identical output where both apply — tested)."""
+    The greedy rule packs each batch with the MAXIMAL prefix of remaining
+    examples that fits all three budgets, so each batch boundary is a
+    searchsorted into the size cumsums: the Python loop is per-BATCH
+    (~examples/batch_size iterations), not per-example, and the
+    per-example arrays expand vectorized. Exact scalar-greedy equivalence
+    is pinned by tests/test_batching.py."""
     n_ex = len(node_counts)
-    if (n_ex
-            and int(node_counts.max()) * budget.max_graphs
-            <= budget.max_nodes
-            and int(edge_counts.max()) * budget.max_graphs
-            <= budget.max_edges):
-        idx = np.arange(n_ex, dtype=np.int64)
-        batch_idx = idx // budget.max_graphs
-        graph_slot = idx % budget.max_graphs
-        excl_n = np.cumsum(node_counts) - node_counts
-        excl_e = np.cumsum(edge_counts) - edge_counts
-        group_start = batch_idx * budget.max_graphs
-        node_off = excl_n - excl_n[group_start]
-        edge_off = excl_e - excl_e[group_start]
-        return batch_idx, graph_slot, node_off, edge_off
-    batch_idx = np.zeros(n_ex, dtype=np.int64)
-    graph_slot = np.zeros(n_ex, dtype=np.int64)
-    node_off = np.zeros(n_ex, dtype=np.int64)
-    edge_off = np.zeros(n_ex, dtype=np.int64)
-    nc = node_counts.tolist()
-    ec = edge_counts.tolist()
-    b = g = n = e = 0
-    max_g, max_n, max_e = budget.max_graphs, budget.max_nodes, budget.max_edges
-    for i in range(n_ex):
-        cn, ce = nc[i], ec[i]
-        if cn > max_n or ce > max_e:
-            raise ValueError(
-                f"example {i} mixture ({cn} nodes, {ce} edges) exceeds "
-                f"budget {budget}")
-        if g + 1 > max_g or n + cn > max_n or e + ce > max_e:
-            b += 1
-            g = n = e = 0
-        batch_idx[i], graph_slot[i] = b, g
-        node_off[i], edge_off[i] = n, e
-        g += 1
-        n += cn
-        e += ce
+    if n_ex == 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z.copy(), z.copy(), z.copy()
+    node_counts = np.asarray(node_counts, dtype=np.int64)
+    edge_counts = np.asarray(edge_counts, dtype=np.int64)
+    bad = np.where((node_counts > budget.max_nodes)
+                   | (edge_counts > budget.max_edges))[0]
+    if bad.size:
+        i = int(bad[0])
+        raise ValueError(
+            f"example {i} mixture ({int(node_counts[i])} nodes, "
+            f"{int(edge_counts[i])} edges) exceeds budget {budget}")
+    cn = np.concatenate([[0], np.cumsum(node_counts)])
+    ce = np.concatenate([[0], np.cumsum(edge_counts)])
+    starts = []
+    i = 0
+    while i < n_ex:
+        starts.append(i)
+        # largest j with cumsum window <= budget on every axis
+        jn = int(np.searchsorted(cn, cn[i] + budget.max_nodes, "right")) - 1
+        je = int(np.searchsorted(ce, ce[i] + budget.max_edges, "right")) - 1
+        i = min(i + budget.max_graphs, jn, je)
+    starts_a = np.asarray(starts, dtype=np.int64)
+    sizes = np.diff(np.concatenate([starts_a, [n_ex]]))
+    batch_idx = np.repeat(np.arange(len(starts_a), dtype=np.int64), sizes)
+    start_of_ex = np.repeat(starts_a, sizes)
+    idx = np.arange(n_ex, dtype=np.int64)
+    graph_slot = idx - start_of_ex
+    node_off = cn[idx] - cn[start_of_ex]
+    edge_off = ce[idx] - ce[start_of_ex]
     return batch_idx, graph_slot, node_off, edge_off
+
+
+class CompactBatch(NamedTuple):
+    """The O(graphs) gather recipe — what the host actually needs to say
+    about a batch. Everything per-NODE/EDGE that `IndexBatch` spells out
+    (src_node/src_feat/src_edge/offsets) is derivable on DEVICE from the
+    entry ids alone: per-entry node/edge counts live in the chip-resident
+    arenas, so cumsum + searchsorted expand these G-sized arrays into the
+    full N/E-sized index arrays inside the jitted step
+    (materialize.expand_compact). Per-step transfer drops from O(N+E) to
+    O(G) int32s (~30x) and per-epoch host packing collapses to
+    assign_batches + G-sized scatters (pack_epoch_compact)."""
+
+    entry_id: np.ndarray    # (G,) int32; pad slots 0, masked
+    feat_start: np.ndarray  # (G,) int32 row into FeatureArena.x; pad 0
+    y: np.ndarray           # (G,) float32
+    graph_mask: np.ndarray  # (G,) bool
+
+    @property
+    def num_graphs(self) -> int:
+        return len(self.entry_id)
+
+
+def zero_masked_compact(cb: CompactBatch) -> CompactBatch:
+    """Inert all-padding compact recipe (scan-chunk tail filler): masks
+    False -> zero node/edge counts -> expands to a pure-padding batch."""
+    return CompactBatch(entry_id=np.zeros_like(cb.entry_id),
+                        feat_start=np.zeros_like(cb.feat_start),
+                        y=np.zeros_like(cb.y),
+                        graph_mask=np.zeros_like(cb.graph_mask))
+
+
+def pack_epoch_compact(
+    arena: MixtureArena,
+    feats: FeatureArena,
+    entry_ids: np.ndarray,
+    ys: np.ndarray,
+    budget: BatchBudget,
+    order: np.ndarray | None = None,
+) -> Iterator[CompactBatch]:
+    """Pack an epoch into O(graphs) compact recipes: the same greedy
+    assignment as `pack_epoch_indices` but emitting only the per-graph
+    arrays — the whole epoch's host work is a few G-sized scatters."""
+    if order is None:
+        order = np.arange(len(entry_ids))
+    ex_entry = entry_ids[order].astype(np.int64)
+    ex_y = ys[order].astype(np.float32)
+    ex_feat = feats.feat_start[feats.pair_of_example[order]]
+    counts_n = arena.node_count[ex_entry]
+    counts_e = arena.edge_count[ex_entry]
+    batch_idx, graph_slot, _, _ = assign_batches(counts_n, counts_e, budget)
+    num_batches = int(batch_idx[-1]) + 1 if len(batch_idx) else 0
+    G = budget.max_graphs + 1  # +1 reserved pad graph slot
+    entry_arr = np.zeros((num_batches, G), dtype=np.int32)
+    feat_arr = np.zeros((num_batches, G), dtype=np.int32)
+    y_arr = np.zeros((num_batches, G), dtype=np.float32)
+    mask_arr = np.zeros((num_batches, G), dtype=bool)
+    entry_arr[batch_idx, graph_slot] = ex_entry.astype(np.int32)
+    feat_arr[batch_idx, graph_slot] = ex_feat.astype(np.int32)
+    y_arr[batch_idx, graph_slot] = ex_y
+    mask_arr[batch_idx, graph_slot] = True
+    for b in range(num_batches):
+        yield CompactBatch(entry_id=entry_arr[b], feat_start=feat_arr[b],
+                           y=y_arr[b], graph_mask=mask_arr[b])
 
 
 class IndexBatch(NamedTuple):
@@ -254,7 +312,8 @@ def pack_epoch_indices(
     slab_batches: int = 128,
 ) -> Iterator[IndexBatch]:
     """Pack an epoch into IndexBatches with whole-slab vectorized index
-    arithmetic — the only per-example Python left is `assign_batches`."""
+    arithmetic — no per-example Python anywhere (assign_batches loops
+    per batch)."""
     if order is None:
         order = np.arange(len(entry_ids))
     ex_entry = entry_ids[order].astype(np.int64)

@@ -17,8 +17,9 @@ import numpy as np
 
 from pertgnn_tpu.config import Config
 from pertgnn_tpu.batching.arena import (
-    FeatureArena, IndexBatch, MixtureArena, build_feature_arena,
-    build_mixture_arena, materialize_host, pack_epoch_indices)
+    CompactBatch, FeatureArena, IndexBatch, MixtureArena,
+    build_feature_arena, build_mixture_arena, materialize_host,
+    pack_epoch_compact, pack_epoch_indices)
 from pertgnn_tpu.batching.featurize import ResourceLookup
 from pertgnn_tpu.batching.mixture import Mixture, build_mixtures
 from pertgnn_tpu.batching.pack import (
@@ -117,6 +118,29 @@ class Dataset:
         if shuffle:
             order = np.random.default_rng(seed).permutation(order)
         stream = pack_epoch_indices(
+            self.arena(), self._feat_arena(split), s.entry_ids, s.ys,
+            self.budget, order=order)
+        if self._cacheable(split, shuffle):
+            cached = list(stream)
+            self._epoch_cache[key] = cached
+            yield from cached
+        else:
+            yield from stream
+
+    def compact_batches(self, split: str, shuffle: bool = False,
+                        seed: int = 0) -> Iterator[CompactBatch]:
+        """O(graphs) gather-recipe stream for device-side EXPANSION +
+        materialization (materialize.expand_compact) — the cheapest
+        possible per-epoch host path. Deterministic eval splits cached."""
+        s = self.splits[split]
+        key = ("compact", split)
+        if self._cacheable(split, shuffle) and key in self._epoch_cache:
+            yield from self._epoch_cache[key]
+            return
+        order = np.arange(len(s))
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(order)
+        stream = pack_epoch_compact(
             self.arena(), self._feat_arena(split), s.entry_ids, s.ys,
             self.budget, order=order)
         if self._cacheable(split, shuffle):

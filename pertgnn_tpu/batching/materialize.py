@@ -31,7 +31,9 @@ from pertgnn_tpu.batching.pack import PackedBatch
 class DeviceArenas(NamedTuple):
     """Chip-resident copies of the mixture + (per-split) feature arenas.
     Sentinel conventions are inherited from the host arenas: the last
-    node/edge/feature row is the pad row."""
+    node/edge/feature row is the pad row. The per-entry start/count tables
+    let the device expand O(graphs) CompactBatch recipes into full gather
+    index arrays (expand_compact)."""
 
     ms_id: jnp.ndarray
     node_depth: jnp.ndarray
@@ -43,6 +45,10 @@ class DeviceArenas(NamedTuple):
     edge_rpctype: jnp.ndarray
     edge_duration: jnp.ndarray
     feat_x: jnp.ndarray
+    node_start: jnp.ndarray   # (num_entries,) int32
+    node_count: jnp.ndarray   # (num_entries,) int32
+    edge_start: jnp.ndarray
+    edge_count: jnp.ndarray
 
     @property
     def node_sentinel(self) -> int:
@@ -51,6 +57,10 @@ class DeviceArenas(NamedTuple):
     @property
     def edge_sentinel(self) -> int:
         return self.senders.shape[0] - 1
+
+    @property
+    def feat_sentinel(self) -> int:
+        return self.feat_x.shape[0] - 1
 
 
 def arena_nbytes(arena: MixtureArena, feats: FeatureArena) -> int:
@@ -90,7 +100,11 @@ def build_device_arenas(arena: MixtureArena, feats: FeatureArena,
         edge_iface=put(arena.edge_iface),
         edge_rpctype=put(arena.edge_rpctype),
         edge_duration=put(arena.edge_duration),
-        feat_x=put(feats.x))
+        feat_x=put(feats.x),
+        node_start=put(arena.node_start.astype(np.int32)),
+        node_count=put(arena.node_count.astype(np.int32)),
+        edge_start=put(arena.edge_start.astype(np.int32)),
+        edge_count=put(arena.edge_count.astype(np.int32)))
 
 
 def materialize_device(dev: DeviceArenas, idx: IndexBatch) -> PackedBatch:
@@ -113,6 +127,60 @@ def materialize_device(dev: DeviceArenas, idx: IndexBatch) -> PackedBatch:
         edge_duration=dev.edge_duration[idx.src_edge],
         edge_mask=edge_mask,
         entry_id=idx.entry_id, y=idx.y, graph_mask=idx.graph_mask)
+
+
+def expand_compact(dev: DeviceArenas, cb, max_nodes: int,
+                   max_edges: int) -> IndexBatch:
+    """Expand an O(graphs) CompactBatch recipe into the full per-node/edge
+    gather index arrays ON DEVICE (jit-traceable; dense XLA: gather +
+    cumsum + searchsorted + iota arithmetic).
+
+    Produces exactly what `arena.pack_epoch_indices` would have built on
+    the host for the same greedy assignment (parity-tested), so
+    `materialize_device(dev, expand_compact(...))` is a drop-in for the
+    IndexBatch feed with ~30x less host->device traffic."""
+    G = cb.entry_id.shape[0]
+    entry = cb.entry_id.astype(jnp.int32)
+    cnt_n = jnp.where(cb.graph_mask, dev.node_count[entry], 0)
+    cnt_e = jnp.where(cb.graph_mask, dev.edge_count[entry], 0)
+    start_n = jnp.cumsum(cnt_n) - cnt_n       # exclusive per-slot starts
+    start_e = jnp.cumsum(cnt_e) - cnt_e
+    total_n = start_n[-1] + cnt_n[-1]
+    total_e = start_e[-1] + cnt_e[-1]
+
+    def per_axis(start, total, size):
+        ids = jnp.arange(size, dtype=jnp.int32)
+        # slot containing position i: last slot whose start <= i (empty
+        # slots share the next real slot's start; side="right" skips them)
+        g = jnp.clip(jnp.searchsorted(start, ids, side="right") - 1, 0,
+                     G - 1).astype(jnp.int32)
+        within = ids - start[g]
+        valid = ids < total
+        return g, within, valid
+
+    g_n, within_n, valid_n = per_axis(start_n, total_n, max_nodes)
+    g_e, within_e, valid_e = per_axis(start_e, total_e, max_edges)
+    src_node = jnp.where(valid_n, dev.node_start[entry[g_n]] + within_n,
+                         dev.node_sentinel).astype(jnp.int32)
+    src_feat = jnp.where(valid_n,
+                         cb.feat_start.astype(jnp.int32)[g_n] + within_n,
+                         dev.feat_sentinel).astype(jnp.int32)
+    node_graph = jnp.where(valid_n, g_n, G - 1).astype(jnp.int32)
+    src_edge = jnp.where(valid_e, dev.edge_start[entry[g_e]] + within_e,
+                         dev.edge_sentinel).astype(jnp.int32)
+    edge_node_off = jnp.where(valid_e, start_n[g_e], 0).astype(jnp.int32)
+    return IndexBatch(src_node=src_node, src_feat=src_feat,
+                      node_graph=node_graph, src_edge=src_edge,
+                      edge_node_off=edge_node_off,
+                      entry_id=entry, y=cb.y,
+                      graph_mask=cb.graph_mask)
+
+
+def materialize_compact(dev: DeviceArenas, cb, max_nodes: int,
+                        max_edges: int) -> PackedBatch:
+    """CompactBatch -> PackedBatch entirely on device."""
+    return materialize_device(dev, expand_compact(dev, cb, max_nodes,
+                                                  max_edges))
 
 
 def zero_masked_idx(idx: IndexBatch, arena: MixtureArena,

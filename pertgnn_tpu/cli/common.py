@@ -52,6 +52,10 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--attn_dropout", type=float, default=0.0,
                    help="dropout on attention weights inside the conv")
+    p.add_argument("--init_scheme", choices=("torch", "flax"),
+                   default="torch",
+                   help="Linear-kernel init: torch kaiming-uniform "
+                        "(reference-faithful, default) or flax defaults")
     p.add_argument("--use_pallas_attention", action="store_true",
                    help="fused Pallas edge-attention kernel (TPU only)")
     p.add_argument("--missing_indicator_is_zero", action="store_true",
@@ -119,6 +123,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             num_heads=args.num_heads,
             dropout=args.dropout,
             attn_dropout=args.attn_dropout,
+            init_scheme=args.init_scheme,
             use_node_depth=args.use_node_depth,
             use_edge_durations=args.use_edge_durations,
             nonnegative_pred=args.nonnegative_pred,

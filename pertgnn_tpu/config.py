@@ -111,6 +111,13 @@ class ModelConfig:
     # Parameter/activation dtype for the MXU. Params stay f32; activations in
     # bf16 when True.
     bf16_activations: bool = False
+    # Weight-init scheme. "torch" (default): kaiming-uniform(a=sqrt5) for
+    # every Linear kernel — what torch.nn.Linear (and therefore the
+    # reference's PyG stack) trains with; measured 98.2+-5.5 train-fit MAE
+    # vs 117.0+-13.8 for "flax" (glorot attention / lecun-normal heads) on
+    # the 6-seed 20-epoch synthetic A/B — the flax defaults were the source
+    # of the round-2/3 quality-parity gap (RESULTS.md).
+    init_scheme: str = "torch"
 
 
 @dataclasses.dataclass(frozen=True)

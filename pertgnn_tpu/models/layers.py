@@ -35,10 +35,24 @@ from flax import linen as nn
 from pertgnn_tpu.ops.segment import segment_edge_attention
 
 
+def kernel_initializer(scheme: str):
+    """"flax" -> glorot-uniform; "torch" -> kaiming-uniform(a=sqrt5), i.e.
+    U(+-1/sqrt(fan_in)) — torch.nn.Linear's default, hence what the
+    reference's PyG stack trains with (variance_scaling(1/3, fan_in,
+    uniform) gives exactly bound sqrt(3*(1/3)/fan_in) = 1/sqrt(fan_in))."""
+    if scheme == "torch":
+        return nn.initializers.variance_scaling(1.0 / 3.0, "fan_in",
+                                                "uniform")
+    if scheme == "flax":
+        return nn.initializers.glorot_uniform()
+    raise ValueError(f"unknown init_scheme {scheme!r}")
+
+
 class GraphTransformerLayer(nn.Module):
     out_channels: int          # total output width (= heads * per-head dim)
     heads: int = 1
     attn_dropout: float = 0.0  # PyG TransformerConv drops attention weights
+    init_scheme: str = "flax"
     use_pallas: bool = False   # fused edge-attention kernel for the hot op
     # jax.sharding.Mesh: shard the EDGE set over the mesh's `data` axis
     # inside the layer (parallel/graph_shard.py) — the giant-graph /
@@ -58,7 +72,7 @@ class GraphTransformerLayer(nn.Module):
         H, C = self.heads, self.out_channels // self.heads
         dense = lambda name, bias: nn.Dense(
             H * C, use_bias=bias, name=name, dtype=self.dtype,
-            kernel_init=nn.initializers.glorot_uniform())
+            kernel_init=kernel_initializer(self.init_scheme))
         q = dense("query", True)(x)
         k = dense("key", True)(x)
         v = dense("value", True)(x)

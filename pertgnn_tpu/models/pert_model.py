@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pertgnn_tpu.config import ModelConfig
-from pertgnn_tpu.models.layers import GraphTransformerLayer, MaskedBatchNorm
+from pertgnn_tpu.models.layers import (GraphTransformerLayer,
+                                       MaskedBatchNorm, kernel_initializer)
 from pertgnn_tpu.ops.segment import segment_mean_by_graph
 
 
@@ -68,6 +69,7 @@ class PertGNN(nn.Module):
 
         conv_kwargs = dict(out_channels=hidden, heads=cfg.num_heads,
                            dtype=dtype, attn_dropout=cfg.attn_dropout,
+                           init_scheme=cfg.init_scheme,
                            use_pallas=cfg.use_pallas_attention,
                            edge_shard_mesh=self.edge_shard_mesh)
         num_convs = max(2, cfg.num_layers)
@@ -86,7 +88,11 @@ class PertGNN(nn.Module):
             x, edge_embeds, batch.senders, batch.receivers,
             batch.edge_mask, training=training)
 
-        local_pred = nn.Dense(1, name="local_head", dtype=dtype)(x)[:, 0]
+        head_init = (kernel_initializer(cfg.init_scheme)
+                     if cfg.init_scheme != "flax"
+                     else nn.linear.default_kernel_init)
+        local_pred = nn.Dense(1, name="local_head", dtype=dtype,
+                              kernel_init=head_init)(x)[:, 0]
 
         # mixture pooling: zero pad nodes explicitly so they cannot leak
         weights = jnp.where(batch.node_mask,
@@ -95,8 +101,10 @@ class PertGNN(nn.Module):
                                        weights.astype(dtype), num_graphs)
         entry_emb = embed("entry_embed", self.num_entries)(batch.entry_id)
         g = jnp.concatenate([pooled, entry_emb], axis=1)
-        g = nn.relu(nn.Dense(hidden, name="global_head1", dtype=dtype)(g))
-        global_pred = nn.Dense(1, name="global_head2", dtype=dtype)(g)[:, 0]
+        g = nn.relu(nn.Dense(hidden, name="global_head1", dtype=dtype,
+                             kernel_init=head_init)(g))
+        global_pred = nn.Dense(1, name="global_head2", dtype=dtype,
+                               kernel_init=head_init)(g)[:, 0]
         if cfg.nonnegative_pred:
             # softplus, not relu: a relu clamp kills the gradient whenever
             # the raw prediction is negative (dead at init)

@@ -26,8 +26,9 @@ import optax
 from flax import struct
 
 from pertgnn_tpu.batching.dataset import Dataset
+from pertgnn_tpu.batching.arena import zero_masked_compact
 from pertgnn_tpu.batching.materialize import (
-    DeviceArenas, arena_nbytes, build_device_arenas, materialize_device,
+    DeviceArenas, arena_nbytes, build_device_arenas, materialize_compact,
     zero_masked_idx)
 from pertgnn_tpu.batching.pack import PackedBatch, zero_masked
 from pertgnn_tpu.config import Config
@@ -188,37 +189,45 @@ def make_eval_chunk(model: PertGNN, cfg: Config) -> Callable:
     return jax.jit(eval_chunk_fn(model, cfg))
 
 
-def make_train_chunk_indexed(model: PertGNN, cfg: Config,
+def make_train_chunk_compact(model: PertGNN, cfg: Config,
                              tx: optax.GradientTransformation,
-                             dev: DeviceArenas) -> Callable:
-    """Scan-fused train chunk over IndexBatches: each scan iteration first
-    materializes the PackedBatch from the chip-resident arenas (closed over
-    as device constants), then runs the ordinary step. The transfer per
-    chunk is only the int32 gather recipes."""
+                             dev: DeviceArenas, max_nodes: int,
+                             max_edges: int) -> Callable:
+    """Scan-fused train chunk over O(graphs) CompactBatch recipes: each
+    scan iteration expands the per-graph recipe to gather indices and
+    materializes the PackedBatch, all on device (materialize.py)."""
     base = train_step_fn(model, cfg, tx)
     return jax.jit(_train_chunk_from_step(
-        lambda s, i: base(s, materialize_device(dev, i))), donate_argnums=0)
+        lambda s, c: base(s, materialize_compact(dev, c, max_nodes,
+                                                 max_edges))),
+        donate_argnums=0)
 
 
-def make_eval_chunk_indexed(model: PertGNN, cfg: Config,
-                            dev: DeviceArenas) -> Callable:
+def make_eval_chunk_compact(model: PertGNN, cfg: Config, dev: DeviceArenas,
+                            max_nodes: int, max_edges: int) -> Callable:
     base = eval_step_fn(model, cfg)
     return jax.jit(_eval_chunk_from_step(
-        lambda s, i: base(s, materialize_device(dev, i))))
+        lambda s, c: base(s, materialize_compact(dev, c, max_nodes,
+                                                 max_edges))))
 
 
-def make_train_step_indexed(model: PertGNN, cfg: Config,
+def make_train_step_compact(model: PertGNN, cfg: Config,
                             tx: optax.GradientTransformation,
-                            dev: DeviceArenas) -> Callable:
+                            dev: DeviceArenas, max_nodes: int,
+                            max_edges: int) -> Callable:
     step = train_step_fn(model, cfg, tx)
-    return jax.jit(lambda s, i: step(s, materialize_device(dev, i)),
-                   donate_argnums=0)
+    return jax.jit(
+        lambda s, c: step(s, materialize_compact(dev, c, max_nodes,
+                                                 max_edges)),
+        donate_argnums=0)
 
 
-def make_eval_step_indexed(model: PertGNN, cfg: Config,
-                           dev: DeviceArenas) -> Callable:
+def make_eval_step_compact(model: PertGNN, cfg: Config, dev: DeviceArenas,
+                           max_nodes: int, max_edges: int) -> Callable:
     step = eval_step_fn(model, cfg)
-    return jax.jit(lambda s, i: step(s, materialize_device(dev, i)))
+    return jax.jit(
+        lambda s, c: step(s, materialize_compact(dev, c, max_nodes,
+                                                 max_edges)))
 
 
 def _host_chunks(batches: Iterator, chunk_size: int,
@@ -501,30 +510,36 @@ def fit(dataset: Dataset, cfg: Config,
                     glob = _host_chunks(glob, cfg.train.scan_chunk)
                 return to_device(glob, sh)
     elif device_materialize:
-        # Chip-resident arenas + IndexBatch feeding: the host's per-epoch
-        # work is index arithmetic only (batching/arena.py), done in a
-        # background thread; the device gathers batches out of HBM.
+        # Chip-resident arenas + O(graphs) CompactBatch feeding: the host
+        # ships only per-graph (entry, feat_start, y, mask) rows; the
+        # device expands them to gather indices (cumsum + searchsorted)
+        # and materializes the batch out of HBM. Per-epoch host work is
+        # the greedy assignment + G-sized scatters (batching/arena.py).
         arena_h = dataset.arena()
         feats_h = dataset.feat_arena()
         dev = build_device_arenas(arena_h, feats_h)
         state = create_train_state(model, tx, sample, cfg.train.seed)
+        max_nodes = dataset.budget.max_nodes
+        max_edges = dataset.budget.max_edges
         if cfg.train.scan_chunk > 1:
-            train_step = make_train_chunk_indexed(model, cfg, tx, dev)
-            eval_step = make_eval_chunk_indexed(model, cfg, dev)
+            train_step = make_train_chunk_compact(model, cfg, tx, dev,
+                                                  max_nodes, max_edges)
+            eval_step = make_eval_chunk_compact(model, cfg, dev,
+                                                max_nodes, max_edges)
         else:
-            train_step = make_train_step_indexed(model, cfg, tx, dev)
-            eval_step = make_eval_step_indexed(model, cfg, dev)
-
-        def idx_filler(b):
-            return zero_masked_idx(b, arena_h, feats_h)
+            train_step = make_train_step_compact(model, cfg, tx, dev,
+                                                 max_nodes, max_edges)
+            eval_step = make_eval_step_compact(model, cfg, dev,
+                                               max_nodes, max_edges)
 
         def batch_stream(split, shuffle=False, seed=0):
-            idxs = dataset.index_batches(split, shuffle=shuffle, seed=seed)
+            cbs = dataset.compact_batches(split, shuffle=shuffle, seed=seed)
             if cfg.train.scan_chunk > 1:
-                idxs = _host_chunks(idxs, cfg.train.scan_chunk, idx_filler)
+                cbs = _host_chunks(cbs, cfg.train.scan_chunk,
+                                   zero_masked_compact)
             if shuffle:  # train: pack off the critical path
-                idxs = _background(idxs)
-            return _device_iter(idxs)
+                cbs = _background(cbs)
+            return _device_iter(cbs)
     elif cfg.train.scan_chunk > 1:
         # scan-fused stepping: one dispatch per `scan_chunk` steps
         state = create_train_state(model, tx, sample, cfg.train.seed)
@@ -543,6 +558,26 @@ def fit(dataset: Dataset, cfg: Config,
         def batch_stream(split, shuffle=False, seed=0):
             return _device_iter(dataset.batches(split, shuffle=shuffle,
                                                 seed=seed))
+
+    if device_materialize and mesh is None:
+        # Deterministic eval splits are identical every epoch; on the
+        # single-device compact path the per-epoch feed is only O(graphs)
+        # int32 recipes, so stage them on device ONCE and replay (eval
+        # steps don't donate their batch). Mesh runs are excluded: their
+        # feed is full O(nodes+edges) IndexBatch recipes per shard, and
+        # pinning a whole eval split of those in HBM for the run could
+        # OOM. Shuffled (train) streams always rebuild.
+        _eval_device_cache: dict[str, list] = {}
+        _inner_stream = batch_stream
+
+        def batch_stream(split, shuffle=False, seed=0):  # noqa: F811
+            if shuffle:
+                return _inner_stream(split, shuffle=shuffle, seed=seed)
+            cached = _eval_device_cache.get(split)
+            if cached is None:
+                cached = _eval_device_cache[split] = list(
+                    _inner_stream(split, seed=seed))
+            return iter(cached)
 
     start_epoch = 0
     if checkpoint_manager is not None:

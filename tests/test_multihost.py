@@ -98,3 +98,58 @@ def test_two_process_fit_epoch_finite(worker_result):
     """The device-materialized multi-host fit() epoch ran and produced
     finite metrics over the full train split."""
     assert np.isfinite(worker_result["fit_train_qloss"])
+
+
+def test_host_grouped_batches_single_process_equals_grouped(preprocessed):
+    """With one process the per-host pipeline owns ALL shards, so
+    host_grouped_batches must equal grouped_batches (up to the edge
+    re-sort stack_batches performs; multihost slabs skip it — order-free
+    segment attention)."""
+    import functools
+
+    from pertgnn_tpu.batching.materialize import zero_masked_idx
+    from pertgnn_tpu.parallel.multihost import (host_grouped_batches,
+                                                host_grouped_index_batches,
+                                                process_shard_slice,
+                                                stack_local_index_shards)
+    from pertgnn_tpu.parallel.data_parallel import stack_index_batches
+
+    ds, _ = _worker_cfg(preprocessed)
+    assert process_shard_slice(4) == slice(0, 4)
+    filler = functools.partial(zero_masked_idx, arena=ds.arena(),
+                               feats=ds.feat_arena())
+    got = list(host_grouped_batches(ds.index_batches("train"), 4,
+                                    ds.materializer("train"), filler))
+    want = list(grouped_batches(ds.batches("train"), 4))
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        # masks must agree exactly; values only under the mask (the two
+        # paths fill inert pad shards differently: zero_masked keeps the
+        # cloned batch's values, sentinel recipes materialize zeros)
+        np.testing.assert_array_equal(g.node_mask, w.node_mask)
+        np.testing.assert_array_equal(g.graph_mask, w.graph_mask)
+        nm, gm = g.node_mask, g.graph_mask
+        for f in ("x", "ms_id", "node_graph", "pattern_prob"):
+            np.testing.assert_array_equal(getattr(g, f)[nm],
+                                          getattr(w, f)[nm], err_msg=f)
+        for f in ("entry_id", "y"):
+            np.testing.assert_array_equal(getattr(g, f)[gm],
+                                          getattr(w, f)[gm], err_msg=f)
+
+        def edge_key(b):
+            m = b.edge_mask
+            cols = np.stack([b.receivers[m], b.senders[m],
+                             b.edge_iface[m]])
+            return cols[:, np.lexsort(cols)]
+
+        np.testing.assert_array_equal(edge_key(g), edge_key(w))
+
+    # index-recipe variant: local stack over all shards == global stack
+    idxs = list(ds.index_batches("train"))[:4]
+    np.testing.assert_array_equal(
+        stack_local_index_shards(idxs, 0).src_node,
+        stack_index_batches(idxs).src_node)
+    for f in ("node_graph", "edge_node_off", "graph_mask"):
+        np.testing.assert_array_equal(
+            getattr(stack_local_index_shards(idxs, 0), f),
+            getattr(stack_index_batches(idxs), f), err_msg=f)

@@ -83,6 +83,40 @@ def test_materialize_device_matches_host(preprocessed):
                     err_msg=f"{split}:{name}")
 
 
+def test_compact_expansion_matches_host_indices(preprocessed):
+    """Device-side expansion of O(graphs) CompactBatch recipes must
+    reproduce the host-built IndexBatch stream EXACTLY, field for field
+    (same greedy assignment -> same gather indices -> same batches)."""
+    from pertgnn_tpu.batching.materialize import (build_device_arenas,
+                                                  expand_compact)
+
+    cfg = Config(ingest=IngestConfig(min_traces_per_entry=10),
+                 data=DataConfig(max_traces=150, batch_size=8))
+    ds = build_dataset(preprocessed, cfg)
+    dev = build_device_arenas(ds.arena(), ds.feat_arena())
+    exp = jax.jit(lambda c: expand_compact(dev, c, ds.budget.max_nodes,
+                                           ds.budget.max_edges))
+    for split in ("train", "valid"):
+        n = 0
+        for cb, idx in zip(ds.compact_batches(split),
+                           ds.index_batches(split)):
+            got = exp(cb)
+            for name in idx._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(got, name)), getattr(idx, name),
+                    err_msg=f"{split}:{name}")
+            n += 1
+        assert n > 1
+
+    # shuffled epochs expand identically too
+    for cb, idx in zip(ds.compact_batches("train", shuffle=True, seed=3),
+                       ds.index_batches("train", shuffle=True, seed=3)):
+        got = exp(cb)
+        for name in idx._fields:
+            np.testing.assert_array_equal(np.asarray(getattr(got, name)),
+                                          getattr(idx, name), err_msg=name)
+
+
 @pytest.mark.parametrize("scan_chunk", [1, 4])
 def test_indexed_fit_matches_host_packed(preprocessed, scan_chunk):
     """fit() with device materialization must reproduce the host-packed
