@@ -105,21 +105,16 @@ class Dataset:
         # just because fit() peeks at one init sample.
         return not shuffle and split != "train"
 
-    def index_batches(self, split: str, shuffle: bool = False,
-                      seed: int = 0) -> Iterator[IndexBatch]:
-        """Gather-recipe stream for device-side materialization
-        (batching/materialize.py). Deterministic eval splits are cached."""
-        s = self.splits[split]
-        key = ("idx", split)
+    def _cached_epoch(self, kind: str, split: str, shuffle: bool,
+                      make_stream) -> Iterator:
+        """Shared cache shell for every epoch-stream flavor: deterministic
+        eval splits are materialized once per (kind, split); everything
+        else streams fresh."""
+        key = (kind, split)
         if self._cacheable(split, shuffle) and key in self._epoch_cache:
             yield from self._epoch_cache[key]
             return
-        order = np.arange(len(s))
-        if shuffle:
-            order = np.random.default_rng(seed).permutation(order)
-        stream = pack_epoch_indices(
-            self.arena(), self._feat_arena(split), s.entry_ids, s.ys,
-            self.budget, order=order)
+        stream = make_stream()
         if self._cacheable(split, shuffle):
             cached = list(stream)
             self._epoch_cache[key] = cached
@@ -127,28 +122,37 @@ class Dataset:
         else:
             yield from stream
 
+    def _epoch_order(self, split: str, shuffle: bool,
+                     seed: int) -> np.ndarray:
+        order = np.arange(len(self.splits[split]))
+        if shuffle:
+            order = np.random.default_rng(seed).permutation(order)
+        return order
+
+    def index_batches(self, split: str, shuffle: bool = False,
+                      seed: int = 0) -> Iterator[IndexBatch]:
+        """Gather-recipe stream for device-side materialization
+        (batching/materialize.py). Deterministic eval splits are cached."""
+        s = self.splits[split]
+        return self._cached_epoch(
+            "idx", split, shuffle,
+            lambda: pack_epoch_indices(
+                self.arena(), self._feat_arena(split), s.entry_ids, s.ys,
+                self.budget,
+                order=self._epoch_order(split, shuffle, seed)))
+
     def compact_batches(self, split: str, shuffle: bool = False,
                         seed: int = 0) -> Iterator[CompactBatch]:
         """O(graphs) gather-recipe stream for device-side EXPANSION +
         materialization (materialize.expand_compact) — the cheapest
         possible per-epoch host path. Deterministic eval splits cached."""
         s = self.splits[split]
-        key = ("compact", split)
-        if self._cacheable(split, shuffle) and key in self._epoch_cache:
-            yield from self._epoch_cache[key]
-            return
-        order = np.arange(len(s))
-        if shuffle:
-            order = np.random.default_rng(seed).permutation(order)
-        stream = pack_epoch_compact(
-            self.arena(), self._feat_arena(split), s.entry_ids, s.ys,
-            self.budget, order=order)
-        if self._cacheable(split, shuffle):
-            cached = list(stream)
-            self._epoch_cache[key] = cached
-            yield from cached
-        else:
-            yield from stream
+        return self._cached_epoch(
+            "compact", split, shuffle,
+            lambda: pack_epoch_compact(
+                self.arena(), self._feat_arena(split), s.entry_ids, s.ys,
+                self.budget,
+                order=self._epoch_order(split, shuffle, seed)))
 
     def materializer(self, split: str):
         """Callable turning one of this split's IndexBatches into a host
@@ -160,18 +164,12 @@ class Dataset:
 
     def batches(self, split: str, shuffle: bool = False,
                 seed: int = 0) -> Iterator[PackedBatch]:
-        if self._cacheable(split, shuffle) and split in self._epoch_cache:
-            yield from self._epoch_cache[split]
-            return
-        stream = (materialize_host(self.arena(), self._feat_arena(split), i)
-                  for i in self.index_batches(split, shuffle=shuffle,
-                                              seed=seed))
-        if self._cacheable(split, shuffle):
-            cached = list(stream)
-            self._epoch_cache[split] = cached
-            yield from cached
-        else:
-            yield from stream
+        return self._cached_epoch(
+            "packed", split, shuffle,
+            lambda: (materialize_host(self.arena(),
+                                      self._feat_arena(split), i)
+                     for i in self.index_batches(split, shuffle=shuffle,
+                                                 seed=seed)))
 
     def batches_slow(self, split: str, shuffle: bool = False,
                      seed: int = 0) -> Iterator[PackedBatch]:

@@ -183,6 +183,40 @@ def materialize_compact(dev: DeviceArenas, cb, max_nodes: int,
                                                   max_edges))
 
 
+def expand_compact_sharded(dev: DeviceArenas, cb, max_nodes: int,
+                           max_edges: int, mesh, axis: str):
+    """SPMD expansion of a GLOBAL compact recipe (graph dim sharded over
+    `axis`): each device expands ITS (G,)-block locally (shard_map) and
+    shifts node_graph / edge_node_off by its shard's global offsets
+    (axis_index), reproducing exactly what `stack_index_batches` builds on
+    the host for the same per-shard recipes (parity-tested). `max_nodes`/
+    `max_edges` are PER-SHARD budgets; the arenas are mesh-replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(dev_l: DeviceArenas, cb_l) -> IndexBatch:
+        idx = expand_compact(dev_l, cb_l, max_nodes, max_edges)
+        d = jax.lax.axis_index(axis)
+        g = cb_l.entry_id.shape[0]
+        return idx._replace(
+            node_graph=idx.node_graph + d * g,
+            edge_node_off=idx.edge_node_off + d * max_nodes)
+
+    dev_specs = type(dev)(*([P()] * len(dev)))
+    cb_specs = jax.tree.map(lambda _: P(axis), cb)
+    out_specs = IndexBatch(*([P(axis)] * len(IndexBatch._fields)))
+    return jax.shard_map(local, mesh=mesh,
+                         in_specs=(dev_specs, cb_specs),
+                         out_specs=out_specs)(dev, cb)
+
+
+def materialize_compact_sharded(dev: DeviceArenas, cb, max_nodes: int,
+                                max_edges: int, mesh,
+                                axis: str) -> PackedBatch:
+    """Global CompactBatch -> global sharded PackedBatch on the mesh."""
+    return materialize_device(dev, expand_compact_sharded(
+        dev, cb, max_nodes, max_edges, mesh, axis))
+
+
 def zero_masked_idx(idx: IndexBatch, arena: MixtureArena,
                     feats: FeatureArena) -> IndexBatch:
     """Inert tail filler for scan chunks in index space: every position the

@@ -35,16 +35,22 @@ from flax import linen as nn
 from pertgnn_tpu.ops.segment import segment_edge_attention
 
 
-def kernel_initializer(scheme: str):
-    """"flax" -> glorot-uniform; "torch" -> kaiming-uniform(a=sqrt5), i.e.
-    U(+-1/sqrt(fan_in)) — torch.nn.Linear's default, hence what the
-    reference's PyG stack trains with (variance_scaling(1/3, fan_in,
-    uniform) gives exactly bound sqrt(3*(1/3)/fan_in) = 1/sqrt(fan_in))."""
+def kernel_initializer(scheme: str, role: str = "attn"):
+    """Dense-kernel initializer per (scheme, role) — the single mapping.
+
+    "torch": kaiming-uniform(a=sqrt5), i.e. U(+-1/sqrt(fan_in)) for every
+    Linear — torch.nn.Linear's default, hence what the reference's PyG
+    stack trains with (variance_scaling(1/3, fan_in, uniform) gives
+    exactly bound sqrt(3*(1/3)/fan_in) = 1/sqrt(fan_in)).
+    "flax": the framework's conventional defaults — glorot-uniform for
+    attention projections ("attn"), flax's lecun-normal Dense default for
+    output heads ("head")."""
     if scheme == "torch":
         return nn.initializers.variance_scaling(1.0 / 3.0, "fan_in",
                                                 "uniform")
     if scheme == "flax":
-        return nn.initializers.glorot_uniform()
+        return (nn.initializers.glorot_uniform() if role == "attn"
+                else nn.linear.default_kernel_init)
     raise ValueError(f"unknown init_scheme {scheme!r}")
 
 
@@ -52,7 +58,7 @@ class GraphTransformerLayer(nn.Module):
     out_channels: int          # total output width (= heads * per-head dim)
     heads: int = 1
     attn_dropout: float = 0.0  # PyG TransformerConv drops attention weights
-    init_scheme: str = "flax"
+    init_scheme: str = "torch"  # keep aligned with ModelConfig.init_scheme
     use_pallas: bool = False   # fused edge-attention kernel for the hot op
     # jax.sharding.Mesh: shard the EDGE set over the mesh's `data` axis
     # inside the layer (parallel/graph_shard.py) — the giant-graph /

@@ -88,9 +88,7 @@ class PertGNN(nn.Module):
             x, edge_embeds, batch.senders, batch.receivers,
             batch.edge_mask, training=training)
 
-        head_init = (kernel_initializer(cfg.init_scheme)
-                     if cfg.init_scheme != "flax"
-                     else nn.linear.default_kernel_init)
+        head_init = kernel_initializer(cfg.init_scheme, role="head")
         local_pred = nn.Dense(1, name="local_head", dtype=dtype,
                               kernel_init=head_init)(x)[:, 0]
 

@@ -19,8 +19,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from pertgnn_tpu.batching.arena import IndexBatch
-from pertgnn_tpu.batching.materialize import DeviceArenas, materialize_device
+from pertgnn_tpu.batching.arena import (CompactBatch, IndexBatch,
+                                        zero_masked_compact)
+from pertgnn_tpu.batching.materialize import (DeviceArenas,
+                                              materialize_compact_sharded,
+                                              materialize_device)
 from pertgnn_tpu.batching.pack import (PackedBatch, receiver_sort_edges,
                                         zero_masked)
 from pertgnn_tpu.config import Config
@@ -129,6 +132,24 @@ def grouped_index_batches(idxs: Iterator[IndexBatch], num_shards: int,
     shards; the tail is completed with inert sentinel recipes (`filler` =
     materialize.zero_masked_idx under partial)."""
     return _grouped(idxs, num_shards, stack_index_batches, filler)
+
+
+def stack_compact_batches(cbs: Sequence[CompactBatch]) -> CompactBatch:
+    """Concatenate per-shard compact recipes into one global recipe.
+
+    NO offsets here — the per-shard graph/node offsets are added on device
+    by the shard-local expansion (materialize.expand_compact_sharded uses
+    axis_index), so single-host and multi-host stacking are the same plain
+    concat."""
+    return CompactBatch(*(np.concatenate([getattr(b, f) for b in cbs])
+                          for f in CompactBatch._fields))
+
+
+def grouped_compact_batches(cbs: Iterator[CompactBatch],
+                            num_shards: int) -> Iterator[CompactBatch]:
+    """Group a compact-recipe stream into global recipes."""
+    return _grouped(cbs, num_shards, stack_compact_batches,
+                    zero_masked_compact)
 
 
 def shard_batch(batch: PackedBatch, mesh,
@@ -253,6 +274,61 @@ def make_sharded_eval_chunk_indexed(model: PertGNN, cfg: Config, mesh,
     chunk = train_loop._eval_chunk_from_step(
         lambda s, i: base(s, materialize_device(dev, i)))
     return jax.jit(chunk, in_shardings=(st_sh, ci_sh), out_shardings=None)
+
+
+def _compact_shardings(mesh, chunked: bool):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pertgnn_tpu.parallel.mesh import DATA_AXIS
+    s = NamedSharding(mesh, P(None, DATA_AXIS) if chunked else P(DATA_AXIS))
+    return CompactBatch(*([s] * len(CompactBatch._fields)))
+
+
+def compact_batch_shardings(mesh) -> CompactBatch:
+    """Graph-dim `data` sharding for a global compact recipe."""
+    return _compact_shardings(mesh, chunked=False)
+
+
+def chunk_compact_batch_shardings(mesh) -> CompactBatch:
+    return _compact_shardings(mesh, chunked=True)
+
+
+def make_sharded_train_step_compact(model: PertGNN, cfg: Config,
+                                    tx: optax.GradientTransformation, mesh,
+                                    state, dev: DeviceArenas,
+                                    max_nodes: int, max_edges: int,
+                                    chunked: bool = False
+                                    ) -> tuple[Callable, Any]:
+    """O(graphs) SPMD stepping: the per-step transfer is the global
+    compact recipe (graph dim sharded over `data`); the SPMD program
+    expands each shard's block locally (shard_map + axis_index offsets)
+    and materializes the global batch from mesh-replicated arenas.
+    `max_nodes`/`max_edges` are PER-SHARD budgets."""
+    from pertgnn_tpu.parallel.mesh import DATA_AXIS
+    st_sh = state_shardings(state, mesh)
+    c_sh = _compact_shardings(mesh, chunked)
+    state = place_state(state, st_sh)
+    base = train_loop.train_step_fn(model, cfg, tx)
+    step = lambda s, c: base(s, materialize_compact_sharded(
+        dev, c, max_nodes, max_edges, mesh, DATA_AXIS))
+    fn = train_loop._train_chunk_from_step(step) if chunked else step
+    jitted = jax.jit(fn, in_shardings=(st_sh, c_sh),
+                     out_shardings=(st_sh, None), donate_argnums=0)
+    return jitted, state
+
+
+def make_sharded_eval_step_compact(model: PertGNN, cfg: Config, mesh,
+                                   state, dev: DeviceArenas,
+                                   max_nodes: int, max_edges: int,
+                                   chunked: bool = False) -> Callable:
+    from pertgnn_tpu.parallel.mesh import DATA_AXIS
+    st_sh = state_shardings(state, mesh)
+    c_sh = _compact_shardings(mesh, chunked)
+    base = train_loop.eval_step_fn(model, cfg)
+    step = lambda s, c: base(s, materialize_compact_sharded(
+        dev, c, max_nodes, max_edges, mesh, DATA_AXIS))
+    fn = train_loop._eval_chunk_from_step(step) if chunked else step
+    return jax.jit(fn, in_shardings=(st_sh, c_sh), out_shardings=None)
 
 
 def make_edge_sharded_train_step(model: PertGNN, cfg: Config,

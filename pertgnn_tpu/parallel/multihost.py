@@ -167,3 +167,14 @@ def host_grouped_index_batches(index_stream: Iterator[IndexBatch],
     return _grouped(index_stream, n_shards,
                     lambda g: stack_local_index_shards(g[sl], sl.start),
                     filler)
+
+
+def host_grouped_compact_batches(stream, n_shards: int, filler):
+    """Per-host O(graphs) recipe pipeline: this process concatenates only
+    its own shards' compact recipes (offsets are applied on DEVICE by the
+    shard-local expansion, so the local slab is a plain concat)."""
+    from pertgnn_tpu.parallel.data_parallel import (_grouped,
+                                                    stack_compact_batches)
+    sl = process_shard_slice(n_shards)
+    return _grouped(stream, n_shards,
+                    lambda g: stack_compact_batches(g[sl]), filler)

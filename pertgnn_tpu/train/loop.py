@@ -419,18 +419,17 @@ def fit(dataset: Dataset, cfg: Config,
             return _one_ahead(shard_batch(b, mesh, b_sh) for b in batches)
     elif mesh is not None:
         from pertgnn_tpu.parallel.data_parallel import (
-            grouped_batches, grouped_index_batches, make_sharded_eval_chunk,
-            make_sharded_eval_chunk_indexed, make_sharded_eval_step,
-            make_sharded_eval_step_indexed, make_sharded_train_chunk,
-            make_sharded_train_chunk_indexed, make_sharded_train_step,
-            make_sharded_train_step_indexed, shard_batch, stack_batches)
+            chunk_compact_batch_shardings, compact_batch_shardings,
+            grouped_batches, grouped_compact_batches,
+            make_sharded_eval_chunk, make_sharded_eval_step,
+            make_sharded_eval_step_compact, make_sharded_train_chunk,
+            make_sharded_train_step, make_sharded_train_step_compact,
+            shard_batch, stack_batches)
         from pertgnn_tpu.parallel.mesh import (
-            batch_shardings, chunk_batch_shardings,
-            chunk_index_batch_shardings, index_batch_shardings,
-            replicated_sharding)
+            batch_shardings, chunk_batch_shardings, replicated_sharding)
         from pertgnn_tpu.parallel.multihost import (
             assemble_global, host_grouped_batches,
-            host_grouped_index_batches)
+            host_grouped_compact_batches)
         n_shards = mesh.shape["data"]
         n_proc = jax.process_count()
         init_sample = stack_batches([sample] * n_shards)
@@ -454,33 +453,32 @@ def fit(dataset: Dataset, cfg: Config,
                 for g in glob)
 
         if device_materialize:
+            # O(graphs) SPMD feeding: global compact recipes sharded over
+            # `data`; each shard expands its block locally (shard_map) and
+            # the program materializes the global batch from replicated
+            # arenas (materialize.expand_compact_sharded).
             dev = build_device_arenas(arena_h, feats_h,
                                       sharding=replicated_sharding(mesh))
-            if chunked:
-                train_step, state = make_sharded_train_chunk_indexed(
-                    model, cfg, tx, mesh, state, dev)
-                eval_step = make_sharded_eval_chunk_indexed(model, cfg, mesh,
-                                                            state, dev)
-                sh = chunk_index_batch_shardings(mesh)
-            else:
-                train_step, state = make_sharded_train_step_indexed(
-                    model, cfg, tx, mesh, state, dev)
-                eval_step = make_sharded_eval_step_indexed(model, cfg, mesh,
-                                                           state, dev)
-                sh = index_batch_shardings(mesh)
+            mn, me = dataset.budget.max_nodes, dataset.budget.max_edges
+            train_step, state = make_sharded_train_step_compact(
+                model, cfg, tx, mesh, state, dev, mn, me, chunked=chunked)
+            eval_step = make_sharded_eval_step_compact(
+                model, cfg, mesh, state, dev, mn, me, chunked=chunked)
+            sh = (chunk_compact_batch_shardings(mesh) if chunked
+                  else compact_batch_shardings(mesh))
 
             def batch_stream(split, shuffle=False, seed=0):
-                idxs = dataset.index_batches(split, shuffle=shuffle,
-                                             seed=seed)
+                cbs = dataset.compact_batches(split, shuffle=shuffle,
+                                              seed=seed)
                 if n_proc > 1:  # each process stacks only its own shards
-                    glob = host_grouped_index_batches(idxs, n_shards,
-                                                      idx_filler)
+                    glob = host_grouped_compact_batches(
+                        cbs, n_shards, zero_masked_compact)
                 else:
-                    glob = grouped_index_batches(idxs, n_shards, idx_filler)
+                    glob = grouped_compact_batches(cbs, n_shards)
                 if chunked:
                     glob = _host_chunks(glob, cfg.train.scan_chunk,
-                                        idx_filler)
-                if shuffle:  # train: index packing off the critical path
+                                        zero_masked_compact)
+                if shuffle:  # train: packing off the critical path
                     glob = _background(glob)
                 return to_device(glob, sh)
         else:
@@ -563,10 +561,11 @@ def fit(dataset: Dataset, cfg: Config,
         # Deterministic eval splits are identical every epoch; on the
         # single-device compact path the per-epoch feed is only O(graphs)
         # int32 recipes, so stage them on device ONCE and replay (eval
-        # steps don't donate their batch). Mesh runs are excluded: their
-        # feed is full O(nodes+edges) IndexBatch recipes per shard, and
-        # pinning a whole eval split of those in HBM for the run could
-        # OOM. Shuffled (train) streams always rebuild.
+        # steps don't donate their batch). Mesh runs also feed O(graphs)
+        # compact recipes now, but are excluded anyway: multi-host replay
+        # would pin make_array-assembled globals per process and the win
+        # is the same few ms — revisit if mesh eval ever shows up in a
+        # profile. Shuffled (train) streams always rebuild.
         _eval_device_cache: dict[str, list] = {}
         _inner_stream = batch_stream
 

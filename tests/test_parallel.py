@@ -360,6 +360,92 @@ class TestIndexedMesh:
                                    float(m_i["mae_sum"]), rtol=1e-5)
         assert int(st_i.step) == 1
 
+    def test_sharded_compact_expansion_and_step(self, ds, cfg):
+        """The O(graphs) SPMD path: shard-local expansion of the global
+        compact recipe must equal the host-stacked IndexBatch exactly, and
+        the compact SPMD train step must match the host-packed SPMD step's
+        metrics on the same global batch."""
+        from pertgnn_tpu.batching.materialize import (
+            build_device_arenas, expand_compact_sharded)
+        from pertgnn_tpu.parallel.data_parallel import (
+            make_sharded_train_step, make_sharded_train_step_compact,
+            stack_compact_batches, stack_index_batches)
+        from pertgnn_tpu.parallel.mesh import replicated_sharding
+
+        mesh = make_mesh(data=8, model=1)
+        model, tx, state, _ = _setup(ds, cfg, mesh)
+        cbs = list(ds.compact_batches("train"))[:8]
+        idxs = list(ds.index_batches("train"))[:8]
+        batches = list(ds.batches("train"))[:8]
+        glob_cb = stack_compact_batches(cbs)
+        dev = build_device_arenas(ds.arena(), ds.feat_arena(),
+                                  sharding=replicated_sharding(mesh))
+        mn, me = ds.budget.max_nodes, ds.budget.max_edges
+
+        got = expand_compact_sharded(dev, jax.tree.map(jnp.asarray, glob_cb),
+                                     mn, me, mesh, "data")
+        want = stack_index_batches(idxs)
+        for name in want._fields:
+            np.testing.assert_array_equal(np.asarray(getattr(got, name)),
+                                          getattr(want, name), err_msg=name)
+
+        step_h, st_h = make_sharded_train_step(model, cfg, tx, mesh, state)
+        st_h, m_h = step_h(st_h, shard_batch(stack_batches(batches), mesh))
+        step_c, st_c = make_sharded_train_step_compact(
+            model, cfg, tx, mesh, state, dev, mn, me)
+        from pertgnn_tpu.parallel.data_parallel import (
+            compact_batch_shardings)
+        st_c, m_c = step_c(st_c, shard_batch(glob_cb, mesh,
+                                             compact_batch_shardings(mesh)))
+        np.testing.assert_allclose(float(m_h["qloss_sum"]),
+                                   float(m_c["qloss_sum"]), rtol=1e-5)
+        np.testing.assert_allclose(float(m_h["mae_sum"]),
+                                   float(m_c["mae_sum"]), rtol=1e-5)
+
+    def test_sharded_compact_chunked(self, ds, cfg):
+        """The chunked compact SPMD path (what fit(mesh=...) runs with
+        scan_chunk>1): full split coverage through grouped + chunked
+        recipes with tail fillers, and single-chunk metric equality with
+        the unchunked compact step."""
+        from pertgnn_tpu.batching.arena import zero_masked_compact
+        from pertgnn_tpu.batching.materialize import build_device_arenas
+        from pertgnn_tpu.parallel.data_parallel import (
+            chunk_compact_batch_shardings, compact_batch_shardings,
+            grouped_compact_batches, make_sharded_train_step_compact,
+            stack_compact_batches)
+        from pertgnn_tpu.parallel.mesh import replicated_sharding
+        from pertgnn_tpu.train.loop import _host_chunks
+
+        mesh = make_mesh(data=8, model=1)
+        model, tx, state, _ = _setup(ds, cfg, mesh)
+        dev = build_device_arenas(ds.arena(), ds.feat_arena(),
+                                  sharding=replicated_sharding(mesh))
+        mn, me = ds.budget.max_nodes, ds.budget.max_edges
+        chunk_fn, st = make_sharded_train_step_compact(
+            model, cfg, tx, mesh, state, dev, mn, me, chunked=True)
+        c_sh = chunk_compact_batch_shardings(mesh)
+        total = 0.0
+        globs = grouped_compact_batches(ds.compact_batches("train"), 8)
+        for chunk in _host_chunks(globs, 3, zero_masked_compact):
+            st, m = chunk_fn(st, shard_batch(chunk, mesh, c_sh))
+            total += float(m["count"])
+        assert total == len(ds.splits["train"])
+        assert np.isfinite(float(m["qloss_sum"]))
+
+        # single-chunk == single-step metrics (same program semantics)
+        glob = stack_compact_batches(list(ds.compact_batches("train"))[:8])
+        one_chunk = next(_host_chunks(iter([glob]), 1))
+        chunk_fn2, st2 = make_sharded_train_step_compact(
+            model, cfg, tx, mesh, state, dev, mn, me, chunked=True)
+        st2, m_chunk = chunk_fn2(st2, shard_batch(one_chunk, mesh, c_sh))
+        step_fn, st3 = make_sharded_train_step_compact(
+            model, cfg, tx, mesh, state, dev, mn, me)
+        st3, m_step = step_fn(st3, shard_batch(glob, mesh,
+                                               compact_batch_shardings(mesh)))
+        np.testing.assert_allclose(float(m_chunk["qloss_sum"]),
+                                   float(m_step["qloss_sum"]), rtol=1e-5)
+        assert int(st2.step) == int(st3.step) == 1
+
     def test_indexed_mesh_chunk_runs(self, ds, cfg):
         """Scan-fused indexed SPMD chunk: mechanics + tail filler."""
         import functools
