@@ -266,7 +266,37 @@ def bench_torch_baseline(ds, cfg, steps: int = 6) -> float:
     return graphs / dt
 
 
+def _probe_backend() -> bool:
+    """Guard against a wedged TPU tunnel: if backend init hangs in a probe
+    subprocess (observed with the axon relay: jax.devices() blocks
+    forever), fall back to CPU so the bench still reports a number —
+    clearly labeled via the `backend`/`backend_fallback` JSON fields —
+    instead of hanging the driver. Costs one extra backend init on healthy
+    runs (~10-30 s); timeout configurable via BENCH_PROBE_TIMEOUT seconds
+    (generous default so a healthy-but-slow init is not misclassified).
+    Must run BEFORE the first jax import in this process. Returns True if
+    the fallback engaged."""
+    import subprocess
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False
+    timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, check=True, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        return False
+    except Exception as e:
+        print(f"WARNING: accelerator backend probe failed ({e!r}); "
+              f"falling back to JAX_PLATFORMS=cpu", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        return True
+
+
 def main():
+    fallback = _probe_backend()
     from pertgnn_tpu.cli.common import apply_platform_env
     apply_platform_env()  # honor JAX_PLATFORMS=cpu over the axon plugin
 
@@ -304,6 +334,7 @@ def main():
         "peak_flops_per_chip": peak,
         "baseline_torch_cpu_graphs_per_s": round(baseline, 1),
         "backend": jax.default_backend(),
+        "backend_fallback": fallback,
         "train_graphs_per_epoch": len(ds.splits["train"]),
     }))
 

@@ -173,13 +173,14 @@ def dp8() -> dict:
             "dp8 needs 8 devices; run under "
             "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
             "JAX_PLATFORMS=cpu for the virtual-mesh variant")
-    import jax.numpy as jnp
     import optax
 
+    from pertgnn_tpu.batching.materialize import build_device_arenas
     from pertgnn_tpu.models.pert_model import make_model
     from pertgnn_tpu.parallel.data_parallel import (
-        make_sharded_train_step, shard_batch, stack_batches)
-    from pertgnn_tpu.parallel.mesh import batch_shardings, make_mesh
+        compact_batch_shardings, make_sharded_train_step_compact,
+        shard_batch, stack_batches, stack_compact_batches)
+    from pertgnn_tpu.parallel.mesh import make_mesh, replicated_sharding
     from pertgnn_tpu.train.loop import create_train_state
 
     cfg = _flagship_cfg()
@@ -191,13 +192,19 @@ def dp8() -> dict:
     model = make_model(cfg.model, ds.num_ms, ds.num_entries,
                        ds.num_interfaces, ds.num_rpctypes)
     tx = optax.adam(cfg.train.lr)
-    host = list(ds.batches("train"))
-    glob = stack_batches((host * 8)[:8])   # 8 shards, repeat if few
-    graphs = int(glob.graph_mask.sum())
-    state = create_train_state(model, tx, glob, cfg.train.seed)
-    step, sh_state = make_sharded_train_step(model, cfg, tx, mesh, state)
-    b_sh = batch_shardings(mesh)
-    sharded = shard_batch(glob, mesh, b_sh)
+    # the production SPMD path: O(graphs) compact recipes, shard-local
+    # device expansion, global batch materialized from replicated arenas
+    cbs = list(ds.compact_batches("train"))
+    glob_cb = stack_compact_batches((cbs * 8)[:8])  # 8 shards
+    graphs = int(glob_cb.graph_mask.sum())
+    init = stack_batches([next(ds.batches("train"))] * 8)
+    state = create_train_state(model, tx, init, cfg.train.seed)
+    dev = build_device_arenas(ds.arena(), ds.feat_arena(),
+                              sharding=replicated_sharding(mesh))
+    step, sh_state = make_sharded_train_step_compact(
+        model, cfg, tx, mesh, state, dev,
+        ds.budget.max_nodes, ds.budget.max_edges)
+    sharded = shard_batch(glob_cb, mesh, compact_batch_shardings(mesh))
     sh_state, m = step(sh_state, sharded)
     jax.block_until_ready(m["qloss_sum"])
     iters = 30
@@ -208,7 +215,8 @@ def dp8() -> dict:
     gps = iters * graphs / (time.perf_counter() - t0)
     return {"metric": "dp8_global_train_graphs_per_s",
             "value": round(gps, 1), "unit": "graphs/s",
-            "devices": 8, "backend": jax.default_backend()}
+            "devices": 8, "path": "compact-SPMD",
+            "backend": jax.default_backend()}
 
 
 def deep_wide() -> dict:

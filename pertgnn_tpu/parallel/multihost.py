@@ -102,15 +102,6 @@ def stack_local_shards(batches: Sequence[PackedBatch],
         {"senders": "x", "receivers": "x", "node_graph": "entry_id"})
 
 
-def stack_local_index_shards(idxs: Sequence[IndexBatch],
-                             shard_offset: int) -> IndexBatch:
-    """IndexBatch analog of `stack_local_shards` (matches
-    data_parallel.stack_index_batches with global shard ids)."""
-    return _stack_with_global_offsets(
-        IndexBatch, idxs, shard_offset,
-        {"node_graph": "entry_id", "edge_node_off": "src_node"})
-
-
 def assemble_global(local, shardings, axis: int = 0):
     """Build global device arrays from each process's local slab
     (jax.make_array_from_process_local_data per leaf). `axis` is the
@@ -153,20 +144,6 @@ def host_grouped_batches(index_stream: Iterator[IndexBatch], n_shards: int,
         lambda g: stack_local_shards([materialize(i) for i in g[sl]],
                                      sl.start),
         filler)
-
-
-def host_grouped_index_batches(index_stream: Iterator[IndexBatch],
-                               n_shards: int,
-                               filler: Callable[[IndexBatch], IndexBatch]
-                               ) -> Iterator[IndexBatch]:
-    """Per-host gather-recipe pipeline for the device-materialized path:
-    each process stacks only its own shards' recipes (the arenas are
-    replicated on every host's devices)."""
-    from pertgnn_tpu.parallel.data_parallel import _grouped
-    sl = process_shard_slice(n_shards)
-    return _grouped(index_stream, n_shards,
-                    lambda g: stack_local_index_shards(g[sl], sl.start),
-                    filler)
 
 
 def host_grouped_compact_batches(stream, n_shards: int, filler):

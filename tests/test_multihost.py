@@ -109,10 +109,7 @@ def test_host_grouped_batches_single_process_equals_grouped(preprocessed):
 
     from pertgnn_tpu.batching.materialize import zero_masked_idx
     from pertgnn_tpu.parallel.multihost import (host_grouped_batches,
-                                                host_grouped_index_batches,
-                                                process_shard_slice,
-                                                stack_local_index_shards)
-    from pertgnn_tpu.parallel.data_parallel import stack_index_batches
+                                                process_shard_slice)
 
     ds, _ = _worker_cfg(preprocessed)
     assert process_shard_slice(4) == slice(0, 4)
@@ -143,13 +140,3 @@ def test_host_grouped_batches_single_process_equals_grouped(preprocessed):
             return cols[:, np.lexsort(cols)]
 
         np.testing.assert_array_equal(edge_key(g), edge_key(w))
-
-    # index-recipe variant: local stack over all shards == global stack
-    idxs = list(ds.index_batches("train"))[:4]
-    np.testing.assert_array_equal(
-        stack_local_index_shards(idxs, 0).src_node,
-        stack_index_batches(idxs).src_node)
-    for f in ("node_graph", "edge_node_off", "graph_mask"):
-        np.testing.assert_array_equal(
-            getattr(stack_local_index_shards(idxs, 0), f),
-            getattr(stack_index_batches(idxs), f), err_msg=f)

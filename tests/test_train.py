@@ -158,8 +158,17 @@ def test_arena_budget_fallback(preprocessed, caplog):
 
     tiny = cfg.replace(train=dataclasses.replace(cfg.train,
                                                  arena_hbm_budget_gb=0.0))
-    with caplog.at_level(logging.WARNING, logger="pertgnn_tpu.train.loop"):
-        assert _resolve_device_materialize(ds, tiny) is False
+    # setup_logging() (run by earlier CLI tests) sets propagate=False on
+    # the package logger; caplog listens on root — re-enable for the check
+    pkg = logging.getLogger("pertgnn_tpu")
+    prev = pkg.propagate
+    pkg.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="pertgnn_tpu.train.loop"):
+            assert _resolve_device_materialize(ds, tiny) is False
+    finally:
+        pkg.propagate = prev
     assert any("falling back to host-packed" in r.message
                for r in caplog.records)
     # fit still trains end-to-end through the fallback
