@@ -28,7 +28,6 @@ from pertgnn_tpu.config import Config
 from pertgnn_tpu.models.pert_model import PertGNN
 from pertgnn_tpu.parallel.mesh import (batch_shardings,
                                        chunk_batch_shardings,
-                                       chunk_index_batch_shardings,
                                        index_batch_shardings,
                                        place_state,
                                        replicated_batch_shardings,

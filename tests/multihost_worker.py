@@ -25,8 +25,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from pertgnn_tpu.parallel import multihost
 
-PORT, PID, NPROC, OUT = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
-                         sys.argv[4])
+PORT, PID, NPROC, OUT, CKPT_DIR = (sys.argv[1], int(sys.argv[2]),
+                                   int(sys.argv[3]), sys.argv[4],
+                                   sys.argv[5])
 assert multihost.initialize(f"localhost:{PORT}", NPROC, PID)
 assert jax.process_count() == NPROC
 
@@ -89,6 +90,23 @@ cfg_fit = cfg.replace(train=dataclasses.replace(cfg.train, scan_chunk=2))
 _, hist = fit(ds, cfg_fit, epochs=1, mesh=mesh)
 result["fit_train_qloss"] = hist[-1]["train_qloss"]
 assert np.isfinite(result["fit_train_qloss"])
+
+# (c) DISTRIBUTED checkpoint round-trip: all processes save the sharded
+# state cooperatively (orbax) and restore directly into mesh shardings
+from pertgnn_tpu.train.checkpoint import CheckpointManager
+
+mgr = CheckpointManager(CKPT_DIR, keep=1)
+mgr.save(0, sh_state, {"qloss_sum": result["qloss_sum"]})
+mgr.wait()
+restored, start = mgr.maybe_restore(sh_state)
+assert start == 1
+k_live = sh_state.params["conv_0"]["query"]["kernel"]
+k_rest = restored.params["conv_0"]["query"]["kernel"]
+assert k_rest.sharding == k_live.sharding
+np.testing.assert_array_equal(np.asarray(jax.device_get(k_rest)),
+                              np.asarray(jax.device_get(k_live)))
+mgr.close()
+result["ckpt_roundtrip"] = True
 
 if PID == 0:
     with open(OUT, "w") as f:

