@@ -129,6 +129,35 @@ class TestShardedCheckpoint:
         mgr.close()
 
 
+class TestFlops:
+    def test_compiled_flops_counts_matmul(self):
+        """XLA cost analysis of a bare matmul ~= 2*m*n*k FLOPs (the MFU
+        denominator's numerator — utils/flops.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        from pertgnn_tpu.utils.flops import compiled_flops, mfu
+
+        m = n = k = 128
+        f = jax.jit(lambda a, b: a @ b)
+        a = jnp.ones((m, k), jnp.float32)
+        b = jnp.ones((k, n), jnp.float32)
+        fl = compiled_flops(f, a, b)
+        assert fl is not None
+        assert 0.5 * 2 * m * n * k <= fl <= 2 * 2 * m * n * k
+        # CPU has no peak table -> MFU None, never a bogus number
+        assert mfu(1e6, fl) is None
+
+    def test_peak_table_kinds(self):
+        from pertgnn_tpu.utils.flops import _PEAK_FLOPS_BY_KIND
+
+        kinds = [k for k, _ in _PEAK_FLOPS_BY_KIND]
+        # longest-match-first ordering: "v5 lite"/"v5e" must precede "v5"
+        assert kinds.index("v5e") < kinds.index("v5")
+        assert kinds.index("v5 lite") < kinds.index("v5")
+        assert kinds.index("v4 lite") < kinds.index("v4")
+
+
 class TestCLI:
     def test_preprocess_then_train(self, tmp_path, capsys):
         from pertgnn_tpu.cli import preprocess_main, train_main
