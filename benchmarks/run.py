@@ -315,7 +315,7 @@ def _mean_ci95(xs) -> tuple[float, float]:
     return float(xs.mean()), float(half)
 
 
-def quality_parity(seeds: int = 10) -> dict:
+def quality_parity(seeds: int | None = None) -> dict:
     """Model-quality parity: our model vs the torch re-implementation of
     the reference's stack (bench.make_torch_reference), trained with the
     same hparams, epochs, and per-epoch shuffled+repacked batch stream,
@@ -334,6 +334,8 @@ def quality_parity(seeds: int = 10) -> dict:
     from pertgnn_tpu.train.loop import fit
 
     base = _flagship_cfg()
+    if seeds is None:
+        seeds = int(os.environ.get("QUALITY_SEEDS", "10"))
     epochs = int(os.environ.get("QUALITY_EPOCHS", "20"))
     base = base.replace(
         data=dataclasses.replace(base.data, batch_size=32),
