@@ -5,5 +5,20 @@ from pertgnn_tpu.batching.pack import (
     derive_budget,
     pack_examples,
 )
+from pertgnn_tpu.batching.arena import (
+    CompactBatch,
+    IndexBatch,
+    build_feature_arena,
+    build_mixture_arena,
+    pack_epoch_compact,
+    pack_epoch_indices,
+)
+from pertgnn_tpu.batching.materialize import (
+    DeviceArenas,
+    build_device_arenas,
+    expand_compact,
+    materialize_compact,
+    materialize_device,
+)
 from pertgnn_tpu.batching.featurize import ResourceLookup
 from pertgnn_tpu.batching.dataset import Dataset, build_dataset, split_indices

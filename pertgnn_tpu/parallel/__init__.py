@@ -2,13 +2,30 @@ from pertgnn_tpu.parallel.mesh import (
     make_mesh,
     batch_shardings,
     param_shardings,
+    place_state,
+    replicated_sharding,
     state_shardings,
 )
 from pertgnn_tpu.parallel.data_parallel import (
     stack_batches,
+    stack_compact_batches,
     shard_batch,
     make_sharded_train_step,
     make_sharded_eval_step,
+    make_sharded_train_step_compact,
+    make_sharded_eval_step_compact,
+    make_edge_sharded_train_step,
+    make_edge_sharded_eval_step,
     grouped_batches,
+    grouped_compact_batches,
+    compact_batch_shardings,
 )
 from pertgnn_tpu.parallel.graph_shard import sharded_edge_attention
+from pertgnn_tpu.parallel.multihost import (
+    initialize as initialize_distributed,
+    assemble_global,
+    host_grouped_batches,
+    host_grouped_compact_batches,
+    process_shard_slice,
+    put_replicated,
+)
