@@ -129,6 +129,31 @@ class TestShardedCheckpoint:
         mgr.close()
 
 
+class TestProfiling:
+    def test_profile_epochs_writes_trace(self, preprocessed, tmp_path, cfg):
+        """fit(profile_hook=profile_epochs(...)) captures a jax.profiler
+        trace for the chosen epoch (SURVEY.md §5.1 rebuild)."""
+        from pertgnn_tpu.utils.profiling import StepTimer, profile_epochs
+
+        ds = build_dataset(preprocessed, cfg)
+        from pertgnn_tpu.train.loop import fit
+
+        d = str(tmp_path / "prof")
+        _, history = fit(ds, cfg, epochs=2,
+                         profile_hook=profile_epochs(d, epochs=(0,)))
+        assert len(history) == 2
+        import glob
+        assert glob.glob(os.path.join(d, "**", "*.pb"), recursive=True) or \
+            glob.glob(os.path.join(d, "**", "*.json.gz"), recursive=True), \
+            f"no trace artifacts under {d}"
+
+        t = StepTimer()
+        for _ in range(3):
+            with t:
+                pass
+        assert "3 steps" in t.summary()
+
+
 class TestFlops:
     def test_compiled_flops_counts_matmul(self):
         """XLA cost analysis of a bare matmul ~= 2*m*n*k FLOPs (the MFU
