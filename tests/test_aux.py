@@ -2,10 +2,13 @@
 
 import json
 import os
+import sys
 
 import numpy as np
 import pandas as pd
 import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.config import Config, DataConfig, IngestConfig, ModelConfig, TrainConfig
@@ -127,6 +130,32 @@ class TestShardedCheckpoint:
                                                        np.asarray(b)),
             jax.device_get(state.params), jax.device_get(restored.params))
         mgr.close()
+
+
+class TestBenchContract:
+    def test_bench_emits_driver_json(self, tmp_path):
+        """bench.py is the driver's interface: it must print ONE JSON line
+        with the metric/value/unit/vs_baseline contract plus the round-3
+        evidence fields (interleaved windows, spread, ceiling ratio)."""
+        import subprocess
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   BENCH_TRACES_PER_ENTRY="25", BENCH_WINDOWS="5")
+        out = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py")],
+            env=env, cwd=_REPO, capture_output=True, text=True, timeout=900)
+        assert out.returncode == 0, out.stderr[-2000:]
+        line = out.stdout.strip().splitlines()[-1]
+        row = json.loads(line)
+        for key in ("metric", "value", "unit", "vs_baseline", "fit_windows",
+                    "fit_spread_pct", "ceiling_graphs_per_s",
+                    "fit_over_ceiling", "flops_per_graph", "backend"):
+            assert key in row, key
+        assert row["unit"] == "graphs/s"
+        assert row["value"] > 0
+        assert len(row["fit_windows"]) == 5
+        assert len(row["ceiling_windows"]) == 5
+        assert row["backend"] == "cpu"
 
 
 class TestProfiling:
