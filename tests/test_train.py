@@ -180,6 +180,24 @@ def test_arena_budget_fallback(preprocessed, caplog):
     assert _resolve_device_materialize(ds, unlimited) is True
 
 
+def test_fit_deterministic_same_seed(preprocessed):
+    """Two fit() runs with identical config+seed produce identical
+    per-epoch metrics (host packing, shuffling, and the jitted step are
+    all deterministic on a fixed backend)."""
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=150, batch_size=8),
+        model=ModelConfig(hidden_channels=8, num_layers=2),
+        train=TrainConfig(lr=1e-2, epochs=2, label_scale=1000.0,
+                          scan_chunk=4),
+    )
+    _, h1 = fit(build_dataset(preprocessed, cfg), cfg)
+    _, h2 = fit(build_dataset(preprocessed, cfg), cfg)
+    for r1, r2 in zip(h1, h2):
+        for k in ("train_qloss", "train_mae", "valid_mae", "test_mae"):
+            assert r1[k] == r2[k], (k, r1[k], r2[k])
+
+
 def test_eval_deterministic(preprocessed):
     cfg = Config(
         ingest=IngestConfig(min_traces_per_entry=10),
