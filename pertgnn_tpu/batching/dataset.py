@@ -17,7 +17,7 @@ import numpy as np
 
 from pertgnn_tpu.config import Config
 from pertgnn_tpu.batching.arena import (
-    CompactBatch, FeatureArena, IndexBatch, MixtureArena,
+    CompactBatch, FeatureArena, IndexBatch, MixtureArena, assign_batches,
     build_feature_arena, build_mixture_arena, materialize_host,
     pack_epoch_compact, pack_epoch_indices)
 from pertgnn_tpu.batching.featurize import ResourceLookup
@@ -186,25 +186,18 @@ class Dataset:
             node_depth_in_x=self.config.model.use_node_depth)
 
     def num_batches(self, split: str) -> int:
-        """Batch count for the UNSHUFFLED order, computed by simulating the
-        greedy packer on sizes only (no feature gathers / allocations).
+        """Batch count for the UNSHUFFLED order (the greedy packer's
+        assignment on sizes only — arena.assign_batches is the single
+        source of truth for the rule).
 
         Greedy packing is order-dependent, so a shuffled epoch may produce a
         different count — step loops must iterate `batches()` rather than
         range(num_batches())."""
-        s = self.splits[split]
-        g = n = e = count = 0
-        for entry in s.entry_ids:
-            m = self.mixtures[int(entry)]
-            if (g + 1 > self.budget.max_graphs
-                    or n + m.num_nodes > self.budget.max_nodes
-                    or e + m.num_edges > self.budget.max_edges):
-                count += 1
-                g = n = e = 0
-            g += 1
-            n += m.num_nodes
-            e += m.num_edges
-        return count + (1 if g else 0)
+        ids = self.splits[split].entry_ids
+        arena = self.arena()
+        batch_idx, _, _, _ = assign_batches(
+            arena.node_count[ids], arena.edge_count[ids], self.budget)
+        return int(batch_idx[-1]) + 1 if len(batch_idx) else 0
 
 
 def build_dataset(pre: PreprocessResult, cfg: Config,
