@@ -74,6 +74,61 @@ def test_layer_matches_dense_oracle(heads):
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
 
 
+def test_attn_dropout_train_vs_eval():
+    """attn_dropout perturbs attention weights only in training (per-rng),
+    and eval-mode output equals the no-dropout layer exactly (PyG
+    TransformerConv semantics)."""
+    rng = np.random.default_rng(4)
+    N, E = 10, 40
+    x = rng.normal(size=(N, 6)).astype(np.float32)
+    ef = rng.normal(size=(E, 6)).astype(np.float32)
+    snd = jnp.array(rng.integers(0, N, E))
+    rcv = jnp.array(rng.integers(0, N, E))
+    mask = jnp.ones(E, dtype=bool)
+
+    plain = GraphTransformerLayer(out_channels=8)
+    drop = GraphTransformerLayer(out_channels=8, attn_dropout=0.5)
+    params = plain.init(jax.random.PRNGKey(0), x, ef, snd, rcv, mask)
+
+    out_eval = drop.apply(params, x, ef, snd, rcv, mask, training=False)
+    out_plain = plain.apply(params, x, ef, snd, rcv, mask, training=False)
+    np.testing.assert_array_equal(np.asarray(out_eval), np.asarray(out_plain))
+
+    out_t1 = drop.apply(params, x, ef, snd, rcv, mask, training=True,
+                        rngs={"dropout": jax.random.PRNGKey(1)})
+    out_t2 = drop.apply(params, x, ef, snd, rcv, mask, training=True,
+                        rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.allclose(np.asarray(out_t1), np.asarray(out_t2))
+    assert not np.allclose(np.asarray(out_t1), np.asarray(out_plain))
+
+
+def test_bf16_activations_close_to_f32(preprocessed, small_config):
+    """bf16_activations keeps params f32 and runs the forward in bf16:
+    predictions must track the f32 path within bf16 tolerance."""
+    import dataclasses
+
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import ModelConfig
+    from pertgnn_tpu.models.pert_model import make_model
+
+    ds = build_dataset(preprocessed, small_config)
+    batch = jax.tree.map(jnp.asarray, next(ds.batches("train")))
+    m32 = make_model(ModelConfig(hidden_channels=16), ds.num_ms,
+                     ds.num_entries, ds.num_interfaces, ds.num_rpctypes)
+    m16 = make_model(ModelConfig(hidden_channels=16, bf16_activations=True),
+                     ds.num_ms, ds.num_entries, ds.num_interfaces,
+                     ds.num_rpctypes)
+    variables = m32.init(jax.random.PRNGKey(0), batch, training=False)
+    # params stay f32 regardless of activation dtype
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree.leaves(variables["params"]))
+    g32, _ = m32.apply(variables, batch, training=False)
+    g16, _ = m16.apply(variables, batch, training=False)
+    assert g16.dtype == jnp.float32  # heads cast back for the loss
+    np.testing.assert_allclose(np.asarray(g16), np.asarray(g32),
+                               rtol=0.05, atol=0.05)
+
+
 def test_isolated_node_gets_skip_only():
     """A destination with no incoming edges = skip projection only (PyG:
     never appears in the scatter)."""
