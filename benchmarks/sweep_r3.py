@@ -1,0 +1,83 @@
+"""One-off on-chip sweep: how does cached-chunk step throughput respond to
+(a) tighter node/edge budgets, (b) scan_chunk, (c) bf16 activations?
+
+Informs the bucketed-budget design (ROUND3.md future work). Not part of
+the driver bench; run manually: python benchmarks/sweep_r3.py
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bench import build_workload
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import (_chunk_iter, create_train_state,
+                                        make_train_chunk)
+
+    ds, cfg = build_workload(3000)
+    base_budget = ds.budget
+    print("base budget:", base_budget)
+
+    def ceiling(cfg, budget, scan_chunk):
+        ds2 = dataclasses.replace(ds, budget=budget)
+        cfg2 = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, scan_chunk=scan_chunk))
+        model = make_model(cfg2.model, ds.num_ms, ds.num_entries,
+                           ds.num_interfaces, ds.num_rpctypes)
+        tx = optax.adam(cfg2.train.lr)
+        host = list(itertools.islice(ds2.batches("train"), scan_chunk))
+        graphs = sum(int(b.graph_mask.sum()) for b in host)
+        chunk_batch = next(_chunk_iter(iter(host), scan_chunk))
+        b0 = jax.tree.map(lambda a: jnp.asarray(a[0]), chunk_batch)
+        state = create_train_state(model, tx, b0, cfg2.train.seed)
+        chunk = make_train_chunk(model, cfg2, tx)
+        state, m = chunk(state, chunk_batch)
+        jax.block_until_ready(m["qloss_sum"])
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            s = state
+            for _ in range(max(1, 48 // scan_chunk)):
+                s, mm = chunk(s, chunk_batch)
+            jax.block_until_ready(mm["qloss_sum"])
+            dt = time.perf_counter() - t0
+            best = max(best, max(1, 48 // scan_chunk) * graphs / dt)
+        return best
+
+    rows = []
+    b = base_budget
+    tight = dataclasses.replace(
+        b, max_nodes=(int(b.max_nodes * 0.55) + 127) // 128 * 128,
+        max_edges=(int(b.max_edges * 0.55) + 127) // 128 * 128)
+    half_graphs = dataclasses.replace(b, max_graphs=b.max_graphs // 2)
+    for name, budget in [("base", b), ("tight55", tight),
+                         ("g85", half_graphs)]:
+        for sc in (16, 64):
+            v = ceiling(cfg, budget, sc)
+            rows.append({"budget": name, "scan_chunk": sc,
+                         "graphs_per_s": round(v, 1)})
+            print(json.dumps(rows[-1]), flush=True)
+    # bf16 on base budget
+    mcfg = dataclasses.replace(cfg.model, bf16_activations=True)
+    cfg_bf = dataclasses.replace(cfg, model=mcfg)
+    for sc in (16, 64):
+        v = ceiling(cfg_bf, b, sc)
+        rows.append({"budget": "base+bf16", "scan_chunk": sc,
+                     "graphs_per_s": round(v, 1)})
+        print(json.dumps(rows[-1]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -222,7 +222,8 @@ def build_dataset(pre: PreprocessResult, cfg: Config,
     ts_buckets = meta["ts_bucket"].to_numpy(np.int64)
     ys = meta["y"].to_numpy(np.float32)
 
-    budget = derive_budget(mixtures, entry_ids, cfg.data.batch_size)
+    budget = derive_budget(mixtures, entry_ids, cfg.data.batch_size,
+                           headroom=cfg.data.budget_headroom)
     if cfg.data.max_nodes_per_batch is not None:
         budget = dataclasses.replace(budget,
                                      max_nodes=cfg.data.max_nodes_per_batch)

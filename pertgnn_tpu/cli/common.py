@@ -65,6 +65,9 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
                    help="packed-batch node budget; 0 = derived from data")
     p.add_argument("--max_edges_per_batch", type=int, default=0,
                    help="packed-batch edge budget; 0 = derived from data")
+    p.add_argument("--budget_headroom", type=float, default=1.1,
+                   help="derived-budget head-room over mean-mixture * "
+                        "batch_size (batching/pack.py derive_budget)")
     p.add_argument("--no_device_materialize", action="store_true",
                    help="disable chip-resident arenas + device-side batch "
                         "materialization (host-packed streaming instead)")
@@ -116,7 +119,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         data=DataConfig(max_traces=args.max_traces,
                         batch_size=args.batch_size,
                         max_nodes_per_batch=args.max_nodes_per_batch or None,
-                        max_edges_per_batch=args.max_edges_per_batch or None),
+                        max_edges_per_batch=args.max_edges_per_batch or None,
+                        budget_headroom=args.budget_headroom),
         model=ModelConfig(
             hidden_channels=args.hidden_channels,
             num_layers=args.num_layers,

@@ -253,7 +253,8 @@ class TestCLI:
             "--missing_indicator_is_zero", "--max_nodes_per_batch", "512",
             "--max_edges_per_batch", "1024", "--no_device_materialize",
             "--arena_hbm_budget_gb", "0", "--shard_edges",
-            "--num_heads", "4", "--scan_chunk", "2"])
+            "--num_heads", "4", "--scan_chunk", "2",
+            "--budget_headroom", "1.3"])
         c = config_from_args(args)
         assert c.model.attn_dropout == 0.1
         assert c.model.use_pallas_attention
@@ -265,6 +266,7 @@ class TestCLI:
         assert c.parallel.shard_edges
         assert c.model.num_heads == 4
         assert c.train.scan_chunk == 2
+        assert c.data.budget_headroom == 1.3
 
     def test_train_cli_with_mesh_and_checkpoint(self, tmp_path, capsys):
         import jax

@@ -108,6 +108,29 @@ class TestPacking:
         total = sum(int(b.graph_mask.sum()) for b in ds.batches("train"))
         assert total == len(ds.splits["train"])
 
+    def test_budget_headroom_sizing(self, ds, preprocessed, small_config):
+        """derive_budget scales node/edge budgets with `headroom` (floored
+        at the largest single mixture, 128-aligned); DataConfig.budget_
+        headroom reaches it through build_dataset."""
+        import dataclasses
+
+        from pertgnn_tpu.batching.pack import derive_budget
+
+        s = np.concatenate([ds.splits[n].entry_ids
+                            for n in ("train", "valid", "test")])
+        bs = ds.config.data.batch_size
+        lo = derive_budget(ds.mixtures, s, bs, headroom=1.1)
+        hi = derive_budget(ds.mixtures, s, bs, headroom=1.3)
+        # monotone in headroom (128-rounding may collapse small budgets)
+        assert hi.max_nodes >= lo.max_nodes and hi.max_edges >= lo.max_edges
+        assert lo.max_nodes % 128 == 0 and lo.max_edges % 128 == 0
+        sizes = np.array([ds.mixtures[int(e)].num_nodes for e in s])
+        assert lo.max_nodes > sizes.max()  # largest mixture always fits
+        cfg = small_config.replace(data=dataclasses.replace(
+            small_config.data, budget_headroom=2.0))
+        wide = build_dataset(preprocessed, cfg)
+        assert wide.budget.max_nodes > ds.budget.max_nodes  # 2.0 ≫ 1.1
+
     def test_shuffle_changes_order_not_content(self, ds):
         a = [b.y[b.graph_mask] for b in ds.batches("train", shuffle=True,
                                                    seed=1)]
