@@ -15,8 +15,14 @@ What is measured (VERDICT r2 #1):
   fit epochs so the known tunnel/clock variance (ops/pallas_attention.py
   notes +-40% on microbenches) hits both numbers alike; both carry their
   window lists and spread.
-- **fit_over_ceiling** quantifies the input-pipeline cost the round-2
-  arena machinery exists to remove.
+- **fit_over_ceiling** quantifies everything between real training and
+  the pure-compute ceiling. It decomposes via a second interleaved
+  ceiling, **compact_ceiling_graphs_per_s** (the production compact
+  program — on-device recipe expansion + arena materialization — replayed
+  on one resident recipe chunk): `fit_over_compact_ceiling` is the input
+  pipeline alone (host packing + recipe transfer — what the arena
+  machinery exists to remove), `compact_over_packed` is the on-device
+  expansion cost.
 - **mfu_pct** relates graphs/s to chip peak via XLA cost analysis
   (utils/flops.py).
 
@@ -74,18 +80,59 @@ def build_workload(traces_per_entry: int = _TRACES_PER_ENTRY):
     return ds, cfg
 
 
+def _window_runner(chunk, state, chunk_batch, graphs_per_chunk):
+    """Time repeated replays of one device-resident chunk. Sizes a window
+    to ~0.4 s so it rides out dispatch jitter."""
+    import jax
+
+    state, m = chunk(state, chunk_batch)  # compile + warm
+    jax.block_until_ready(m["qloss_sum"])
+    t0 = time.perf_counter()
+    state, m = chunk(state, chunk_batch)
+    jax.block_until_ready(m["qloss_sum"])
+    per_chunk = max(time.perf_counter() - t0, 1e-5)
+    reps = max(3, int(0.4 / per_chunk))
+    holder = {"state": state}
+
+    def run_window() -> float:
+        s = holder["state"]
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            s, mm = chunk(s, chunk_batch)
+        jax.block_until_ready(mm["qloss_sum"])
+        holder["state"] = s
+        return reps * graphs_per_chunk / (time.perf_counter() - t0)
+
+    return run_window
+
+
 def make_ceiling(ds, cfg):
-    """Cached-chunk replay: one device-resident scan chunk re-fed to the
-    jitted train program. Returns (run_window() -> graphs/s, flops/graph)."""
+    """Two cached-chunk replay ceilings decomposing the fit() gap:
+
+    - **packed** — one device-resident PACKED scan chunk re-fed to the
+      jitted train program: pure model compute + dispatch, the absolute
+      ceiling.
+    - **compact** — one device-resident COMPACT-recipe chunk re-fed to the
+      production compact train program (device-side expansion +
+      materialization from the chip-resident arenas, exactly what fit()
+      runs): fit/compact isolates the INPUT PIPELINE cost (host packing +
+      recipe transfer), while compact/packed isolates the on-device
+      expansion cost.
+
+    Returns (run_packed, run_compact, flops/graph)."""
     import itertools
 
     import jax
     import jax.numpy as jnp
     import optax
 
+    from pertgnn_tpu.batching.materialize import build_device_arenas
     from pertgnn_tpu.models.pert_model import make_model
-    from pertgnn_tpu.train.loop import (_chunk_iter, create_train_state,
-                                        make_train_chunk)
+    from pertgnn_tpu.train.loop import (_chunk_iter, _host_chunks,
+                                        create_train_state,
+                                        make_train_chunk,
+                                        make_train_chunk_compact)
+    from pertgnn_tpu.batching.arena import zero_masked_compact
     from pertgnn_tpu.utils.flops import compiled_flops
 
     model = make_model(cfg.model, ds.num_ms, ds.num_entries,
@@ -103,47 +150,47 @@ def make_ceiling(ds, cfg):
     if fl is not None:
         flops_per_graph = fl / graphs_per_chunk
 
-    state, m = chunk(state, chunk_batch)  # compile + warm
-    jax.block_until_ready(m["qloss_sum"])
+    run_packed = _window_runner(chunk, state, chunk_batch, graphs_per_chunk)
 
-    # size a window to ~0.4 s so one window rides out dispatch jitter
-    t0 = time.perf_counter()
-    state, m = chunk(state, chunk_batch)
-    jax.block_until_ready(m["qloss_sum"])
-    per_chunk = max(time.perf_counter() - t0, 1e-5)
-    reps = max(3, int(0.4 / per_chunk))
+    # compact twin: same leading batches as O(graphs) recipes, resident
+    chost = list(itertools.islice(ds.compact_batches("train"),
+                                  cfg.train.scan_chunk))
+    cgraphs = sum(int(c.graph_mask.sum()) for c in chost)
+    cchunk_batch = jax.tree.map(
+        jnp.asarray,
+        next(_host_chunks(iter(chost), cfg.train.scan_chunk,
+                          zero_masked_compact)))
+    dev = build_device_arenas(ds.arena(), ds.feat_arena())
+    cstate = create_train_state(model, tx, b0, cfg.train.seed)
+    cchunk = make_train_chunk_compact(model, cfg, tx, dev,
+                                      ds.budget.max_nodes,
+                                      ds.budget.max_edges)
+    run_compact = _window_runner(cchunk, cstate, cchunk_batch, cgraphs)
 
-    holder = {"state": state}
-
-    def run_window() -> float:
-        s = holder["state"]
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            s, mm = chunk(s, chunk_batch)
-        jax.block_until_ready(mm["qloss_sum"])
-        holder["state"] = s
-        return reps * graphs_per_chunk / (time.perf_counter() - t0)
-
-    return run_window, flops_per_graph
+    return run_packed, run_compact, flops_per_graph
 
 
 def bench_interleaved(ds, cfg, windows: int = 6):
     """fit() epochs interleaved with cached-chunk ceiling windows.
 
-    Returns (fit_windows, ceiling_windows, flops_per_graph): the per-epoch
-    graphs/s of real training (epoch 0 dropped — compile) and the ceiling
-    window measurements taken BETWEEN those epochs."""
+    Returns (fit_windows, packed_windows, compact_windows,
+    flops_per_graph): the per-epoch graphs/s of real training (epoch 0
+    dropped — compile) and both ceilings' window measurements taken
+    BETWEEN those epochs (so tunnel/clock variance hits all three alike)."""
     from pertgnn_tpu.train.loop import fit
 
-    run_ceiling, flops_per_graph = make_ceiling(ds, cfg)
-    ceiling_windows: list[float] = []
+    run_packed, run_compact, flops_per_graph = make_ceiling(ds, cfg)
+    packed_windows: list[float] = []
+    compact_windows: list[float] = []
 
     def hook(epoch: int, row: dict) -> None:
-        ceiling_windows.append(run_ceiling())
+        packed_windows.append(run_packed())
+        compact_windows.append(run_compact())
 
     _, history = fit(ds, cfg, epochs=windows + 1, profile_hook=hook)
     fit_windows = [row["graphs_per_s"] for row in history[1:]]
-    return fit_windows, ceiling_windows[1:], flops_per_graph
+    return (fit_windows, packed_windows[1:], compact_windows[1:],
+            flops_per_graph)
 
 
 def make_torch_reference(ds, cfg, f_in):
@@ -314,10 +361,11 @@ def main():
             and "BENCH_TRACES_PER_ENTRY" not in os.environ):
         tpe = _CPU_TRACES_PER_ENTRY
     ds, cfg = build_workload(tpe)
-    fit_w, ceil_w, flops_per_graph = bench_interleaved(ds, cfg,
-                                                       windows=_WINDOWS)
+    fit_w, ceil_w, cceil_w, flops_per_graph = bench_interleaved(
+        ds, cfg, windows=_WINDOWS)
     fit_med = statistics.median(fit_w)
     ceil_med = statistics.median(ceil_w)
+    cceil_med = statistics.median(cceil_w)
     baseline = bench_torch_baseline(ds, cfg)
     eff = mfu(fit_med, flops_per_graph)
     peak = peak_flops_per_chip()
@@ -337,6 +385,12 @@ def main():
         "ceiling_windows": [round(w, 1) for w in ceil_w],
         "ceiling_spread_pct": spread_pct(ceil_w),
         "fit_over_ceiling": round(fit_med / ceil_med, 3),
+        # the production compact program replayed on one resident chunk:
+        # fit/compact = input-pipeline efficiency; compact/packed = cost
+        # of on-device recipe expansion + arena materialization
+        "compact_ceiling_graphs_per_s": round(cceil_med, 1),
+        "fit_over_compact_ceiling": round(fit_med / cceil_med, 3),
+        "compact_over_packed": round(cceil_med / ceil_med, 3),
         "mfu_pct": round(100 * eff, 2) if eff is not None else None,
         "flops_per_graph": (round(flops_per_graph)
                             if flops_per_graph is not None else None),

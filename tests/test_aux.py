@@ -149,7 +149,9 @@ class TestBenchContract:
         row = json.loads(line)
         for key in ("metric", "value", "unit", "vs_baseline", "fit_windows",
                     "fit_spread_pct", "ceiling_graphs_per_s",
-                    "fit_over_ceiling", "flops_per_graph", "backend"):
+                    "fit_over_ceiling", "compact_ceiling_graphs_per_s",
+                    "fit_over_compact_ceiling", "compact_over_packed",
+                    "flops_per_graph", "backend"):
             assert key in row, key
         assert row["unit"] == "graphs/s"
         assert row["value"] > 0
