@@ -85,7 +85,8 @@ def _train_throughput(ds, cfg, steps: int = 160,
     from pertgnn_tpu.models.pert_model import make_model
     from pertgnn_tpu.train.loop import (_chunk_iter, create_train_state,
                                         make_train_chunk)
-    from pertgnn_tpu.utils.flops import compiled_flops, mfu
+    from pertgnn_tpu.utils.flops import (compiled_cost, mbu, mfu,
+                                         roofline_graphs_per_s)
 
     model = make_model(cfg.model, ds.num_ms, ds.num_entries,
                        ds.num_interfaces, ds.num_rpctypes,
@@ -98,10 +99,11 @@ def _train_throughput(ds, cfg, steps: int = 160,
     b0 = jax.tree.map(lambda a: jnp.asarray(a[0]), chunk_batch)
     state = create_train_state(model, tx, b0, cfg.train.seed)
     chunk = make_train_chunk(model, cfg, tx)
-    flops_per_graph = None
+    flops_per_graph = bytes_per_graph = None
     if with_mfu:
-        fl = compiled_flops(chunk, state, chunk_batch)
+        fl, by = compiled_cost(chunk, state, chunk_batch)
         flops_per_graph = (fl / graphs_per_chunk) if fl else None
+        bytes_per_graph = (by / graphs_per_chunk) if by else None
     state, m = chunk(state, chunk_batch)
     jax.block_until_ready(m["qloss_sum"])
     n_chunks = max(1, steps // cfg.train.scan_chunk)
@@ -113,10 +115,19 @@ def _train_throughput(ds, cfg, steps: int = 160,
     if not with_mfu:
         return gps
     eff = mfu(gps, flops_per_graph)
+    bw_eff = mbu(gps, bytes_per_graph)
+    roof = roofline_graphs_per_s(flops_per_graph, bytes_per_graph)
     return {"graphs_per_s": gps,
             "mfu_pct": round(100 * eff, 2) if eff is not None else None,
+            "mbu_pct": round(100 * bw_eff, 2) if bw_eff is not None else None,
             "flops_per_graph": (round(flops_per_graph)
-                                if flops_per_graph else None)}
+                                if flops_per_graph else None),
+            "bytes_per_graph": (round(bytes_per_graph)
+                                if bytes_per_graph else None),
+            "ai_flops_per_byte": (round(flops_per_graph / bytes_per_graph, 1)
+                                  if flops_per_graph and bytes_per_graph
+                                  else None),
+            "roofline_graphs_per_s": (round(roof) if roof else None)}
 
 
 def smoke_cpu() -> dict:
@@ -161,7 +172,9 @@ def flagship_chip() -> dict:
     return {"metric": "flagship_train_graphs_per_s",
             "value": round(r["graphs_per_s"], 1),
             "unit": "graphs/s", "config": "hidden32 L3 batch170 pert",
-            "mfu_pct": r["mfu_pct"], "flops_per_graph": r["flops_per_graph"]}
+            **{k: r[k] for k in ("mfu_pct", "mbu_pct", "flops_per_graph",
+                                 "bytes_per_graph", "ai_flops_per_byte",
+                                 "roofline_graphs_per_s")}}
 
 
 def dp8() -> dict:
@@ -232,7 +245,9 @@ def deep_wide() -> dict:
     return {"metric": "deep_wide_train_graphs_per_s",
             "value": round(r["graphs_per_s"], 1), "unit": "graphs/s",
             "config": "hidden256 L8 H8 batch64 pert",
-            "mfu_pct": r["mfu_pct"], "flops_per_graph": r["flops_per_graph"]}
+            **{k: r[k] for k in ("mfu_pct", "mbu_pct", "flops_per_graph",
+                                 "bytes_per_graph", "ai_flops_per_byte",
+                                 "roofline_graphs_per_s")}}
 
 
 def giant_dag() -> dict:

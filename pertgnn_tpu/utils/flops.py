@@ -1,14 +1,20 @@
-"""FLOPs accounting and MFU (model FLOPs utilization).
+"""FLOPs/bytes accounting: MFU, MBU, and the HBM roofline.
 
 The reference publishes no efficiency numbers at all (BASELINE.md); here
-every benchmark can relate graphs/s to what the chip could do: FLOPs per
-compiled program come from XLA's own cost model
-(`jit(...).lower(...).compile().cost_analysis()`), peak chip FLOPs from a
-device-kind table. MFU = achieved FLOPs/s / peak FLOPs/s.
+every benchmark can relate graphs/s to what the chip could do: FLOPs and
+bytes-accessed per compiled program come from XLA's own cost model
+(`jit(...).lower(...).compile().cost_analysis()`), chip peaks from a
+device-kind table. MFU = achieved FLOPs/s / peak FLOPs/s; MBU = achieved
+bytes/s / peak HBM bytes/s. For a graph transformer whose arithmetic
+intensity (FLOPs/byte) sits far below the chip's roofline knee
+(peak_flops / peak_bw, ~240 FLOP/B on v5e), MBU is the honest
+utilization number and `roofline_graphs_per_s` the honest ceiling —
+see RESULTS.md deep_wide.
 
-Caveats, stated so the number is interpretable:
-- XLA's `flops` counts the optimized HLO (post-fusion), i.e. hardware
-  FLOPs, not a paper-model count;
+Caveats, stated so the numbers are interpretable:
+- XLA's `flops`/`bytes accessed` count the optimized HLO (post-fusion):
+  hardware FLOPs, and materialized-buffer traffic which can overestimate
+  true HBM traffic when buffers stay VMEM-resident;
 - peaks are the published dense bf16/f32-accumulate MXU numbers per chip;
   this workload's GEMMs are small (hidden 32 default), so low MFU means
   "dispatch/HBM-bound", not "broken" — see RESULTS.md.
@@ -52,19 +58,56 @@ def peak_flops_per_chip() -> float | None:
     return None
 
 
-def compiled_flops(jitted, *args) -> float | None:
-    """FLOPs of ONE invocation of an already-jitted callable on `args`,
-    from XLA's cost analysis (None if the backend doesn't report it)."""
+# peak HBM bandwidth bytes/s per chip (public: cloud.google.com/tpu/docs).
+_PEAK_HBM_BW_BY_KIND = (
+    ("v6e", 1640e9),
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9),
+    ("v5 lite", 819e9),      # v5e reports device_kind "TPU v5 lite"
+    ("v5", 2765e9),
+    ("v4 lite", 614e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+
+def peak_hbm_bw_per_chip() -> float | None:
+    """Peak HBM bytes/s of one local device, or None when unknown (CPU)."""
+    dev = jax.devices()[0]
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    if dev.platform != "tpu":
+        return None
+    for key, bw in _PEAK_HBM_BW_BY_KIND:
+        if key in kind:
+            return bw
+    log.warning("unknown TPU device_kind %r — MBU unavailable", kind)
+    return None
+
+
+def compiled_cost(jitted, *args) -> tuple[float | None, float | None]:
+    """(flops, bytes_accessed) of ONE invocation of an already-jitted
+    callable on `args`, from XLA's cost analysis (None fields when the
+    backend doesn't report them)."""
     try:
         compiled = jitted.lower(*args).compile()
         cost = compiled.cost_analysis()
         if isinstance(cost, list):  # older jax: one dict per program
             cost = cost[0]
         f = cost.get("flops")
-        return float(f) if f and f > 0 else None
+        b = cost.get("bytes accessed")
+        return (float(f) if f and f > 0 else None,
+                float(b) if b and b > 0 else None)
     except Exception as e:  # pragma: no cover — backend-dependent
         log.info("cost_analysis unavailable: %s", e)
-        return None
+        return None, None
+
+
+def compiled_flops(jitted, *args) -> float | None:
+    """FLOPs of ONE invocation of an already-jitted callable on `args`,
+    from XLA's cost analysis (None if the backend doesn't report it)."""
+    return compiled_cost(jitted, *args)[0]
 
 
 def mfu(graphs_per_s: float, flops_per_graph: float | None) -> float | None:
@@ -73,3 +116,26 @@ def mfu(graphs_per_s: float, flops_per_graph: float | None) -> float | None:
     if peak is None or flops_per_graph is None:
         return None
     return graphs_per_s * flops_per_graph / peak
+
+
+def mbu(graphs_per_s: float, bytes_per_graph: float | None) -> float | None:
+    """Achieved fraction of peak HBM bandwidth — the honest utilization
+    number when arithmetic intensity sits below the roofline knee."""
+    bw = peak_hbm_bw_per_chip()
+    if bw is None or bytes_per_graph is None:
+        return None
+    return graphs_per_s * bytes_per_graph / bw
+
+
+def roofline_graphs_per_s(flops_per_graph: float | None,
+                          bytes_per_graph: float | None) -> float | None:
+    """min(compute, bandwidth) roofline ceiling for this chip, in graphs/s:
+    the hard upper bound implied by the compiled program's FLOPs and bytes
+    against the device's peaks."""
+    peak_f, peak_b = peak_flops_per_chip(), peak_hbm_bw_per_chip()
+    bounds = []
+    if peak_f is not None and flops_per_graph:
+        bounds.append(peak_f / flops_per_graph)
+    if peak_b is not None and bytes_per_graph:
+        bounds.append(peak_b / bytes_per_graph)
+    return min(bounds) if bounds else None

@@ -205,13 +205,41 @@ class TestFlops:
         assert mfu(1e6, fl) is None
 
     def test_peak_table_kinds(self):
-        from pertgnn_tpu.utils.flops import _PEAK_FLOPS_BY_KIND
+        from pertgnn_tpu.utils.flops import (_PEAK_FLOPS_BY_KIND,
+                                             _PEAK_HBM_BW_BY_KIND)
 
-        kinds = [k for k, _ in _PEAK_FLOPS_BY_KIND]
-        # longest-match-first ordering: "v5 lite"/"v5e" must precede "v5"
-        assert kinds.index("v5e") < kinds.index("v5")
-        assert kinds.index("v5 lite") < kinds.index("v5")
-        assert kinds.index("v4 lite") < kinds.index("v4")
+        for table in (_PEAK_FLOPS_BY_KIND, _PEAK_HBM_BW_BY_KIND):
+            kinds = [k for k, _ in table]
+            # longest-match-first ordering: "v5 lite"/"v5e" must precede "v5"
+            assert kinds.index("v5e") < kinds.index("v5")
+            assert kinds.index("v5 lite") < kinds.index("v5")
+            assert kinds.index("v4 lite") < kinds.index("v4")
+
+    def test_bytes_mbu_roofline(self, monkeypatch):
+        """compiled_cost reports bytes; MBU and the roofline ceiling follow
+        min(compute, bandwidth) against the (patched) chip peaks."""
+        import jax
+        import jax.numpy as jnp
+
+        from pertgnn_tpu.utils import flops as F
+
+        m = n = k = 128
+        f = jax.jit(lambda a, b: a @ b)
+        a = jnp.ones((m, k), jnp.float32)
+        b = jnp.ones((k, n), jnp.float32)
+        fl, by = F.compiled_cost(f, a, b)
+        assert fl is not None and by is not None
+        # 3 buffers of 128x128 f32 minimum
+        assert by >= 3 * m * n * 4 * 0.5
+        # CPU: no peaks -> all None, never bogus numbers
+        assert F.mbu(1e6, by) is None
+        assert F.roofline_graphs_per_s(fl, by) is None
+        # patched peaks: intensity fl/by vs knee decides the binding roof
+        monkeypatch.setattr(F, "peak_flops_per_chip", lambda: 100.0 * fl)
+        monkeypatch.setattr(F, "peak_hbm_bw_per_chip", lambda: 10.0 * by)
+        assert F.roofline_graphs_per_s(fl, by) == 10.0  # bandwidth-bound
+        assert abs(F.mbu(10.0, by) - 1.0) < 1e-9        # at the roof
+        assert abs(F.mfu(10.0, fl) - 0.1) < 1e-9
 
 
 class TestCLI:
