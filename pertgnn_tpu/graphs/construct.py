@@ -80,7 +80,14 @@ class GraphSpec:
 
 def find_root(trace_df: pd.DataFrame):
     """Root microservice: um of the row with max |rt| and min timestamp
-    (/root/reference/misc.py:138-142)."""
+    (/root/reference/misc.py:138-142).
+
+    Precondition (same as the reference's): such a row exists. Entry
+    filtering guarantees it for every trace that reaches graph
+    construction — traces whose min-timestamp row doesn't carry the max
+    |rt| are dropped by `filter_traces_with_missing_entry` semantics
+    (preprocess.py:111-115); on raw unfiltered input this raises
+    IndexError exactly where the reference would."""
     abs_rt = trace_df["rt"].abs()
     mask = (abs_rt == abs_rt.max()) & (
         trace_df["timestamp"] == trace_df["timestamp"].min())
