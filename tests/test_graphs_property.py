@@ -137,6 +137,53 @@ def test_pert_structural_laws(rows):
     assert g.node_depth.min() >= 0.0 and g.node_depth.max() <= 1.0
 
 
+_sizes = st.lists(st.tuples(st.integers(1, 9), st.integers(0, 14)),
+                  min_size=0, max_size=60)
+_budget = st.tuples(st.integers(1, 7),      # max_graphs
+                    st.integers(9, 40),     # max_nodes (>= biggest example)
+                    st.integers(14, 60))    # max_edges
+
+
+@settings(max_examples=200, deadline=None)
+@given(_sizes, _budget)
+def test_assign_batches_greedy_laws(sizes, budget_tuple):
+    """Fuzz the vectorized greedy packer (batching/arena.py) against the
+    scalar greedy rule and its invariants: every example exactly once, in
+    order, budgets never exceeded, every non-final batch full (adding the
+    next example would overflow some budget)."""
+    from pertgnn_tpu.batching.arena import assign_batches
+    from pertgnn_tpu.batching.pack import BatchBudget
+
+    budget = BatchBudget(*budget_tuple)
+    nc = np.array([s[0] for s in sizes], dtype=np.int64)
+    ec = np.array([s[1] for s in sizes], dtype=np.int64)
+    bi, gs, no, eo = assign_batches(nc, ec, budget)
+    assert len(bi) == len(nc)
+    if len(nc) == 0:
+        return
+    # order-preserving assignment: batch ids non-decreasing, slots count up
+    assert (np.diff(bi) >= 0).all()
+    for b in np.unique(bi):
+        m = bi == b
+        assert (gs[m] == np.arange(int(m.sum()))).all()
+        # offsets are the within-batch cumsums
+        np.testing.assert_array_equal(
+            no[m], np.concatenate([[0], np.cumsum(nc[m])[:-1]]))
+        np.testing.assert_array_equal(
+            eo[m], np.concatenate([[0], np.cumsum(ec[m])[:-1]]))
+        # budgets respected
+        assert m.sum() <= budget.max_graphs
+        assert nc[m].sum() <= budget.max_nodes
+        assert ec[m].sum() <= budget.max_edges
+    # greedy maximality: each batch boundary was forced by SOME budget
+    starts = np.flatnonzero(np.diff(np.concatenate([[-1], bi])))
+    for s in starts[1:]:
+        m = bi == bi[s] - 1
+        assert (m.sum() + 1 > budget.max_graphs
+                or nc[m].sum() + nc[s] > budget.max_nodes
+                or ec[m].sum() + ec[s] > budget.max_edges)
+
+
 @settings(max_examples=200, deadline=None)
 @given(_traces)
 def test_span_structural_laws(rows):
