@@ -126,7 +126,6 @@ def make_ceiling(ds, cfg):
     import jax.numpy as jnp
     import optax
 
-    from pertgnn_tpu.batching.materialize import build_device_arenas
     from pertgnn_tpu.models.pert_model import make_model
     from pertgnn_tpu.train.loop import (_chunk_iter, _host_chunks,
                                         create_train_state,
@@ -160,7 +159,8 @@ def make_ceiling(ds, cfg):
         jnp.asarray,
         next(_host_chunks(iter(chost), cfg.train.scan_chunk,
                           zero_masked_compact)))
-    dev = build_device_arenas(ds.arena(), ds.feat_arena())
+    # shared with fit(): one HBM-resident arena copy for the whole bench
+    dev = ds.device_arenas()
     cstate = create_train_state(model, tx, b0, cfg.train.seed)
     cchunk = make_train_chunk_compact(model, cfg, tx, dev,
                                       ds.budget.max_nodes,

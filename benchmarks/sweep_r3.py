@@ -1,8 +1,15 @@
-"""One-off on-chip sweep: how does cached-chunk step throughput respond to
-(a) tighter node/edge budgets, (b) scan_chunk, (c) bf16 activations?
+"""Round-3 budget study. Two parts:
 
-Informs the bucketed-budget design (ROUND3.md future work). Not part of
-the driver bench; run manually: python benchmarks/sweep_r3.py
+1. `utilization()` (host-only, runs anywhere): padded-slot utilization of
+   a shuffled epoch under (a) the derived budget at various headrooms and
+   (b) 2-3 quantile-BUCKETED budgets — the measurement behind
+   `derive_budget`'s headroom-1.1 default and the bucketing rejection
+   (batching/pack.py docstring; ROUND3.md). Run:
+       python benchmarks/sweep_r3.py --utilization
+2. `main()` (on-chip): cached-chunk step throughput vs tighter budgets,
+   scan_chunk, and bf16 activations.
+
+Not part of the driver bench; run manually.
 """
 
 import dataclasses
@@ -12,6 +19,61 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def utilization():
+    """Node/edge padded-slot utilization of one shuffled epoch, single
+    tight budgets vs quantile buckets (pure host work, no accelerator)."""
+    import numpy as np
+
+    from bench import build_workload
+    from pertgnn_tpu.batching.arena import assign_batches
+    from pertgnn_tpu.batching.pack import BatchBudget, derive_budget
+
+    ds, cfg = build_workload(1000)
+    sp = ds.splits["train"]
+    arena = ds.arena()
+    order = np.random.default_rng(0).permutation(len(sp))
+    ents = sp.entry_ids[order].astype(np.int64)
+    cn, ce = arena.node_count[ents], arena.edge_count[ents]
+    mixes = {int(e): ds.mixtures[int(e)] for e in np.unique(ents)}
+
+    def waste(cn, ce, budget):
+        bi, _, _, _ = assign_batches(cn, ce, budget)
+        nb = int(bi[-1]) + 1 if len(bi) else 0
+        return nb, cn.sum() / (nb * budget.max_nodes), \
+            ce.sum() / (nb * budget.max_edges)
+
+    rows = []
+    for h in (1.3, 1.1, 1.0, 0.9):
+        b = derive_budget(mixes, ents, cfg.data.batch_size, headroom=h)
+        nb, un, ue = waste(cn, ce, b)
+        rows.append({"scheme": f"single headroom={h}", "batches": nb,
+                     "node_util": round(float(un), 2),
+                     "edge_util": round(float(ue), 2)})
+        print(json.dumps(rows[-1]), flush=True)
+    for k in (2, 3):
+        qs = np.quantile(cn, np.linspace(0, 1, k + 1)[1:-1])
+        bucket = np.searchsorted(qs, cn, "right")
+        tot = dict(nb=0, pn=0, pe=0, rn=0, re=0)
+        for bk in range(k):
+            m = bucket == bk
+            bn, be = cn[m], ce[m]
+            bud = BatchBudget(
+                cfg.data.batch_size,
+                max(int(bn.mean() * cfg.data.batch_size * 1.1), int(bn.max()) + 1),
+                max(int(be.mean() * cfg.data.batch_size * 1.1), int(be.max()) + 1))
+            nb, _, _ = waste(bn, be, bud)
+            tot["nb"] += nb
+            tot["pn"] += nb * bud.max_nodes
+            tot["pe"] += nb * bud.max_edges
+            tot["rn"] += int(bn.sum())
+            tot["re"] += int(be.sum())
+        rows.append({"scheme": f"{k} quantile buckets", "batches": tot["nb"],
+                     "node_util": round(tot["rn"] / tot["pn"], 2),
+                     "edge_util": round(tot["re"] / tot["pe"], 2)})
+        print(json.dumps(rows[-1]), flush=True)
+    return rows
 
 
 def main():
@@ -48,9 +110,9 @@ def main():
         best = 0.0
         for _ in range(3):
             t0 = time.perf_counter()
-            s = state
             for _ in range(max(1, 48 // scan_chunk)):
-                s, mm = chunk(s, chunk_batch)
+                # rebind: the chunk donates its state argument
+                state, mm = chunk(state, chunk_batch)
             jax.block_until_ready(mm["qloss_sum"])
             dt = time.perf_counter() - t0
             best = max(best, max(1, 48 // scan_chunk) * graphs / dt)
@@ -80,4 +142,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--utilization" in sys.argv:
+        utilization()
+    else:
+        main()

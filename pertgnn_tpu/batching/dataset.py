@@ -66,6 +66,7 @@ class Dataset:
     # cache for the deterministic unshuffled splits (valid/test are
     # identical every epoch — pack them once).
     _arena: MixtureArena | None = None
+    _device_arenas = None  # DeviceArenas, lazy (see device_arenas())
     _feat_all: FeatureArena | None = None
     _feat_slices: dict = dataclasses.field(default_factory=dict)
     _epoch_cache: dict = dataclasses.field(default_factory=dict)
@@ -74,6 +75,18 @@ class Dataset:
         if self._arena is None:
             self._arena = build_mixture_arena(self.mixtures)
         return self._arena
+
+    def device_arenas(self):
+        """Single-device chip-resident arenas, built ONCE per dataset and
+        shared by every consumer (fit() and bench ceilings alike) so HBM
+        holds one copy regardless of how many programs gather from it.
+        Mesh paths build their own sharded copies (materialize.
+        build_device_arenas(sharding=...))."""
+        if self._device_arenas is None:
+            from pertgnn_tpu.batching.materialize import build_device_arenas
+            self._device_arenas = build_device_arenas(self.arena(),
+                                                      self.feat_arena())
+        return self._device_arenas
 
     def feat_arena(self) -> FeatureArena:
         """The whole-dataset feature arena (all splits' unique pairs)."""

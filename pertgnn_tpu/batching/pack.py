@@ -103,15 +103,16 @@ def derive_budget(mixtures: dict[int, Mixture], entry_ids: np.ndarray,
 
     Why 1.1: a shuffled epoch's batch is a sum of ~batch_size iid mixture
     sizes, so it concentrates tightly around the mean — measured on the
-    bench workload, headroom 1.1 packs the SAME number of 170-graph batches
-    as 1.3 at 0.90 node/edge utilization instead of 0.73 (≈19% less padded
-    work per epoch for free). Quantile BUCKETING of budgets was evaluated
-    and rejected: 2-3 size-bucketed budgets reached only 0.85 utilization
-    on the same epochs (benchmarks/sweep_r3.py) — with greedy packing over
-    a shuffled stream, one modest-headroom shape beats per-bucket shapes
-    (and costs k fewer XLA compiles). Bucketing only pays when a single
-    giant mixture forces max_nodes far above mean*batch_size; the
-    `max(mixture)` floor below is where that regime would show up.
+    bench workload (`python benchmarks/sweep_r3.py --utilization`),
+    headroom 1.1 packs the SAME number of 170-graph batches as 1.3 at
+    0.89/0.90 node/edge padded-slot utilization instead of 0.76 (≈15%
+    less padded work per epoch for free; 0.9 reaches 0.99 util at +11%
+    batches). Quantile BUCKETING of budgets was evaluated there and
+    rejected: 2-3 size-bucketed budgets land at the same ~0.89-0.90
+    utilization as the single 1.1 budget while costing k compiled shapes
+    instead of one. Bucketing only pays when a single giant mixture
+    forces max_nodes far above mean*batch_size; the `max(mixture)` floor
+    below is where that regime would show up.
     """
     sizes_n = np.array([mixtures[int(e)].num_nodes for e in entry_ids])
     sizes_e = np.array([mixtures[int(e)].num_edges for e in entry_ids])

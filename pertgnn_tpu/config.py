@@ -64,7 +64,7 @@ class DataConfig:
     max_edges_per_batch: int | None = None
     # Head-room factor for derived node/edge budgets over
     # mean-mixture-size * batch_size. 1.1 measured: same batch count as
-    # 1.3 at 0.90 (vs 0.73) padded-slot utilization — see
+    # 1.3 at ~0.90 (vs 0.76) padded-slot utilization — see
     # batching/pack.py derive_budget for the sizing law and why quantile
     # bucketing was rejected.
     budget_headroom: float = 1.1

@@ -220,7 +220,15 @@ def _caller_order(um: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 def build_pert_graph(trace_df: pd.DataFrame, *, sanitized: pd.DataFrame
                      | None = None, root=None) -> GraphSpec:
-    """Activity-on-node PERT DAG (misc.py:221-370)."""
+    """Activity-on-node PERT graph (misc.py:221-370).
+
+    NOT guaranteed acyclic: when the sanitized call graph is non-tree
+    (a callee with multiple callers), shared stage chains + call/return
+    edges can form cycles — same as the reference, whose max-depth DFS is
+    disabled precisely "due to cycles" (misc.py:119-134). Everything
+    downstream is cycle-safe: min_depth_from_root is an iterative BFS and
+    the model is attention message-passing (no topological order
+    assumed). Pinned by tests/test_graphs_property.py."""
     if root is None:
         root = find_root(trace_df)
     df = sanitize_edges(trace_df, root) if sanitized is None else sanitized

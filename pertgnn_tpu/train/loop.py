@@ -513,9 +513,7 @@ def fit(dataset: Dataset, cfg: Config,
         # device expands them to gather indices (cumsum + searchsorted)
         # and materializes the batch out of HBM. Per-epoch host work is
         # the greedy assignment + G-sized scatters (batching/arena.py).
-        arena_h = dataset.arena()
-        feats_h = dataset.feat_arena()
-        dev = build_device_arenas(arena_h, feats_h)
+        dev = dataset.device_arenas()  # shared, built once per dataset
         state = create_train_state(model, tx, sample, cfg.train.seed)
         max_nodes = dataset.budget.max_nodes
         max_edges = dataset.budget.max_edges
