@@ -228,6 +228,37 @@ def make_torch_reference(ds, cfg, f_in):
                                                    ve * alpha[:, None])
             return out + self.skip(x)
 
+    class MaskedBN(torch.nn.Module):
+        """BatchNorm1d over REAL nodes only. The reference's ragged PyG
+        batches contain no pad rows (pert_gnn.py:201-209), so a faithful
+        re-implementation on packed batches must exclude padding from the
+        batch statistics — torch.nn.BatchNorm1d would include it."""
+
+        def __init__(self, ch, momentum=0.1, eps=1e-5):
+            super().__init__()
+            self.weight = torch.nn.Parameter(torch.ones(ch))
+            self.bias = torch.nn.Parameter(torch.zeros(ch))
+            self.register_buffer("running_mean", torch.zeros(ch))
+            self.register_buffer("running_var", torch.ones(ch))
+            self.momentum, self.eps = momentum, eps
+
+        def forward(self, x, mask):
+            if self.training:
+                xm = x[mask]
+                mean = xm.mean(0)
+                var = xm.var(0, unbiased=False)
+                with torch.no_grad():
+                    n = xm.shape[0]
+                    unbiased = var * n / max(n - 1, 1)
+                    self.running_mean.mul_(1 - self.momentum).add_(
+                        self.momentum * mean)
+                    self.running_var.mul_(1 - self.momentum).add_(
+                        self.momentum * unbiased)
+            else:
+                mean, var = self.running_mean, self.running_var
+            y = (x - mean) * torch.rsqrt(var + self.eps)
+            return y * self.weight + self.bias
+
     class Model(torch.nn.Module):
         def __init__(self):
             super().__init__()
@@ -239,19 +270,23 @@ def make_torch_reference(ds, cfg, f_in):
             chans = [f_in + hidden] + [hidden] * (n_convs - 1)
             self.convs = torch.nn.ModuleList(Conv(c) for c in chans)
             self.bns = torch.nn.ModuleList(
-                torch.nn.BatchNorm1d(hidden) for _ in range(n_convs - 1))
+                MaskedBN(hidden) for _ in range(n_convs - 1))
             self.g1 = torch.nn.Linear(2 * hidden, hidden)
             self.g2 = torch.nn.Linear(hidden, 1)
 
         def forward(self, b):
             x = torch.cat([b["x"], self.ms(b["ms_id"])], 1)
-            ee = torch.cat([self.iface(b["edge_iface"]),
-                            self.rpc(b["edge_rpctype"])], 1)
+            # drop pad edges: the reference's ragged batches have none
+            em = b["edge_mask"]
+            snd, rcv = b["senders"][em], b["receivers"][em]
+            ee = torch.cat([self.iface(b["edge_iface"][em]),
+                            self.rpc(b["edge_rpctype"][em])], 1)
+            nm = b["node_mask"]
             for i, conv in enumerate(self.convs[:-1]):
-                x = torch.relu(self.bns[i](
-                    conv(x, ee, b["senders"], b["receivers"])))
-            x = self.convs[-1](x, ee, b["senders"], b["receivers"])
+                x = torch.relu(self.bns[i](conv(x, ee, snd, rcv), nm))
+            x = self.convs[-1](x, ee, snd, rcv)
             w = (b["pattern_prob"] / b["pattern_size"])[:, None]
+            w = w * nm[:, None]
             g = b["node_graph"]
             pooled = torch.zeros(b["entry_id"].shape[0],
                                  hidden).index_add(0, g, x * w)

@@ -351,3 +351,61 @@ def test_edge_durations_option():
     np.testing.assert_allclose(np.asarray(gp_b)[:n_real],
                                np.asarray(gp_s)[:n_real],
                                rtol=2e-4, atol=1e-5)
+
+
+def test_torch_reference_stack_weight_transfer_parity(preprocessed,
+                                                      small_config):
+    """The measured baseline (bench.make_torch_reference) must compute the
+    SAME function as our flax model: copy one set of weights into both and
+    compare eval-mode global predictions on a real packed batch. Pins the
+    baseline's architectural faithfulness (pad edges dropped, BN masked —
+    the reference's ragged PyG batches have no padding at all,
+    pert_gnn.py:201-209)."""
+    import torch
+
+    from pertgnn_tpu.batching import build_dataset
+    from bench import make_torch_reference
+
+    ds = build_dataset(preprocessed, small_config)
+    cfg = small_config
+    batch = next(ds.batches("train"))
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    jb = jax.tree.map(jnp.asarray, batch)
+    variables = model.init(jax.random.PRNGKey(3), jb, training=False)
+    ours = np.asarray(model.apply(variables, jb, training=False)[0])
+
+    tmodel, _, _, to_torch = make_torch_reference(ds, cfg, batch.x.shape[1])
+    p = variables["params"]
+
+    def put(t, a):
+        with torch.no_grad():
+            t.copy_(torch.tensor(np.asarray(a)))
+
+    put(tmodel.ms.weight, p["ms_embed"]["embedding"])
+    put(tmodel.iface.weight, p["interface_embed"]["embedding"])
+    put(tmodel.rpc.weight, p["rpctype_embed"]["embedding"])
+    put(tmodel.entry.weight, p["entry_embed"]["embedding"])
+    n_convs = max(2, cfg.model.num_layers)
+    for i in range(n_convs):
+        cp, tc = p[f"conv_{i}"], tmodel.convs[i]
+        for ours_name, theirs in (("query", tc.q), ("key", tc.k),
+                                  ("value", tc.v), ("edge", tc.e),
+                                  ("skip", tc.skip)):
+            put(theirs.weight, np.asarray(cp[ours_name]["kernel"]).T)
+            if ours_name != "edge":
+                put(theirs.bias, cp[ours_name]["bias"])
+    for i in range(n_convs - 1):
+        put(tmodel.bns[i].weight, p[f"bn_{i}"]["scale"])
+        put(tmodel.bns[i].bias, p[f"bn_{i}"]["bias"])
+    put(tmodel.g1.weight, np.asarray(p["global_head1"]["kernel"]).T)
+    put(tmodel.g1.bias, p["global_head1"]["bias"])
+    put(tmodel.g2.weight, np.asarray(p["global_head2"]["kernel"]).T)
+    put(tmodel.g2.bias, p["global_head2"]["bias"])
+
+    tmodel.eval()
+    with torch.no_grad():
+        theirs = tmodel(to_torch(batch)).numpy()
+    mask = batch.graph_mask
+    np.testing.assert_allclose(ours[mask], theirs[mask],
+                               rtol=2e-4, atol=2e-4)
