@@ -28,7 +28,8 @@ def utilization():
 
     from bench import build_workload
     from pertgnn_tpu.batching.arena import assign_batches
-    from pertgnn_tpu.batching.pack import BatchBudget, derive_budget
+    from pertgnn_tpu.batching.pack import (BatchBudget, _round_up,
+                                           derive_budget)
 
     ds, cfg = build_workload(1000)
     sp = ds.splits["train"]
@@ -59,10 +60,14 @@ def utilization():
         for bk in range(k):
             m = bucket == bk
             bn, be = cn[m], ce[m]
+            # same 128-lane alignment derive_budget applies to the single
+            # budget, so both schemes pay identical TPU padding
             bud = BatchBudget(
                 cfg.data.batch_size,
-                max(int(bn.mean() * cfg.data.batch_size * 1.1), int(bn.max()) + 1),
-                max(int(be.mean() * cfg.data.batch_size * 1.1), int(be.max()) + 1))
+                _round_up(max(int(bn.mean() * cfg.data.batch_size * 1.1),
+                              int(bn.max()) + 1)),
+                _round_up(max(int(be.mean() * cfg.data.batch_size * 1.1),
+                              int(be.max()) + 1)))
             nb, _, _ = waste(bn, be, bud)
             tot["nb"] += nb
             tot["pn"] += nb * bud.max_nodes

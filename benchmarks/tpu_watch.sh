@@ -1,0 +1,32 @@
+#!/bin/bash
+# Opportunistic on-chip bench capture (VERDICT r3 next-round #1).
+#
+# The axon relay wedges and recovers on minute-to-hour timescales; a
+# single bench invocation at a fixed time can land in a wedged window and
+# lose the whole round's chip measurement. This watcher polls a cheap
+# probe and, the moment the tunnel answers, runs the full bench — which
+# pins the result + commit hash to benchmarks/last_good_tpu.json via
+# bench.py::_persist_last_good_tpu.
+#
+# Usage: nohup bash benchmarks/tpu_watch.sh >> benchmarks/tpu_watch.log &
+set -u
+cd "$(dirname "$0")/.."
+PROBES=${TPU_WATCH_PROBES:-120}
+SLEEP=${TPU_WATCH_SLEEP:-240}
+for i in $(seq 1 "$PROBES"); do
+  if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) tunnel healthy (probe $i); running bench"
+    BENCH_PROBE_TIMEOUT=75 BENCH_PROBE_TRIES=2 timeout 5400 python bench.py
+    rc=$?
+    echo "$(date -u +%FT%TZ) bench exited rc=$rc"
+    # a wedge can strike mid-bench; only stop once a TPU result is pinned
+    if [ $rc -eq 0 ] && [ -f benchmarks/last_good_tpu.json ]; then
+      exit 0
+    fi
+  else
+    echo "$(date -u +%FT%TZ) probe $i wedged"
+  fi
+  sleep "$SLEEP"
+done
+echo "$(date -u +%FT%TZ) tunnel never recovered"
+exit 1

@@ -66,7 +66,11 @@ class Dataset:
     # cache for the deterministic unshuffled splits (valid/test are
     # identical every epoch — pack them once).
     _arena: MixtureArena | None = None
-    _device_arenas = None  # DeviceArenas, lazy (see device_arenas())
+    # DeviceArenas, lazy (see device_arenas()). A real dataclass field like
+    # the sibling caches so dataclasses.replace() carries it over instead of
+    # silently dropping it (which would rebuild a second HBM-resident copy
+    # and defeat the one-copy contract device_arenas() documents).
+    _device_arenas: object | None = None
     _feat_all: FeatureArena | None = None
     _feat_slices: dict = dataclasses.field(default_factory=dict)
     _epoch_cache: dict = dataclasses.field(default_factory=dict)

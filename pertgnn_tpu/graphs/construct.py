@@ -85,8 +85,10 @@ def find_root(trace_df: pd.DataFrame):
     Precondition (same as the reference's): such a row exists. Entry
     filtering guarantees it for every trace that reaches graph
     construction — traces whose min-timestamp row doesn't carry the max
-    |rt| are dropped by `filter_traces_with_missing_entry` semantics
-    (preprocess.py:111-115); on raw unfiltered input this raises
+    |rt| are dropped by `ingest.preprocess.detect_entries` (this repo's
+    implementation of the reference's
+    `filter_traces_with_missing_entry_and_get_delay`,
+    preprocess.py:111-115); on raw unfiltered input this raises
     IndexError exactly where the reference would."""
     abs_rt = trace_df["rt"].abs()
     mask = (abs_rt == abs_rt.max()) & (

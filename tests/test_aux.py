@@ -151,7 +151,10 @@ class TestBenchContract:
                     "fit_spread_pct", "ceiling_graphs_per_s",
                     "fit_over_ceiling", "compact_ceiling_graphs_per_s",
                     "fit_over_compact_ceiling", "compact_over_packed",
-                    "flops_per_graph", "backend"):
+                    "flops_per_graph", "backend",
+                    # round-4 fields: MBU/roofline accounting (null on CPU)
+                    "mbu_pct", "roofline_graphs_per_s", "bytes_per_graph",
+                    "peak_hbm_bytes_per_s"):
             assert key in row, key
         assert row["unit"] == "graphs/s"
         assert row["value"] > 0
