@@ -1,0 +1,419 @@
+"""Non-circular L0-L2 parity: run the ACTUAL reference preprocessing.
+
+Every parity number in this repo so far was measured against the builder's
+own re-implementations (VERDICT r3 "What's missing" #1: self-certified
+spec). This harness breaks that circularity for the data pipeline: it
+writes synthetic raw CSVs in the reference's expected on-disk layout,
+runs `/root/reference/preprocess.py` VERBATIM as a subprocess (pandas +
+torch + joblib only — no torch_geometric needed for L0-L2), and compares
+its saved artifacts field-by-field against this repo's
+preprocess/assemble/build_runtime_graphs on the same bytes.
+
+torch_geometric itself (the L4 model) cannot be installed here — zero
+egress; see pyg_install_attempt.log in this directory — so the MODEL
+remains pinned by the dense-numpy + torch-scatter oracles; the DATA
+pipeline (entry detection, 5-stage sanitizer, span/PERT construction,
+runtime identity, mixture weights, labels) is now pinned by the
+reference's own code.
+
+Alignment strategy
+------------------
+The reference factorizes ids by first appearance over its row order
+(preprocess.py:216-221), so the harness reads the CSVs EXACTLY as
+`get_df` does (concat in os.listdir order with index_col=0,
+replace(nan, "nan"), drop_duplicates, sort_values("timestamp") —
+preprocess.py:203-213) and feeds that frame to this repo's
+`preprocess()` (whose stable re-sort of the pre-sorted frame is a
+no-op). Row order — and therefore every factorize code except the
+microservice ids — is then identical on both sides, and the ms
+relabeling (the reference builds ms2int from an unordered Python set,
+preprocess.py:248-251; we sort ours) is recovered from the aligned
+um/dm columns and verified to be a bijection.
+
+Graphs are compared on canonical node labels — (ms, stage-occurrence)
+tuples — because node NUMBERING depends on the ms labeling (span:
+torch.unique over ms ints, misc.py:196; pert: value_counts order,
+misc.py:240), which legitimately differs between the two sides.
+
+Run:  python benchmarks/parity/reference_crosscheck.py [--traces 120]
+Exit status 0 iff every check passes; JSON verdict on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REFERENCE = os.environ.get("PERTGNN_REFERENCE_DIR", "/root/reference")
+sys.path.insert(0, REPO)
+
+
+def make_sandbox(root: str, traces_per_entry: int, seed: int = 7) -> dict:
+    """Write synthetic raw CSVs in the reference's ./data layout."""
+    from pertgnn_tpu.ingest import synthetic
+
+    spec = synthetic.SyntheticSpec(
+        num_microservices=30, num_entries=3, patterns_per_entry=3,
+        traces_per_entry=traces_per_entry, seed=seed,
+        # keep the coverage filter exercised but below the 0.6 threshold
+        # for only a handful of traces
+        missing_resource_frac=0.15)
+    data = synthetic.generate(spec)
+    synthetic.write_csvs(data, os.path.join(root, "data"), shards=3)
+    os.makedirs(os.path.join(root, "processed"), exist_ok=True)
+    return {"spec": spec}
+
+
+def run_reference(root: str) -> subprocess.CompletedProcess:
+    """Run the reference's preprocess.py untouched, in the sandbox cwd.
+
+    The reference predates pandas 3: (a) CSV strings now come back as
+    arrow-backed `str` columns that refuse int assignment
+    (preprocess.py:92 raises TypeError), and (b) legacy whole-column
+    `.loc` assignment used to coerce object columns to int64, which the
+    downstream torch.tensor(...) calls depend on (misc.py:178). The shim
+    restores exactly those two legacy dtype semantics — object strings
+    at read time, int64 after factorization (via .infer_objects()) —
+    and then runs the reference's own functions untouched."""
+    ref_path = os.path.join(REFERENCE, "preprocess.py")
+    shim = os.path.join(root, "_run_reference_shim.py")
+    with open(shim, "w") as f:
+        f.write(f"""\
+import pandas as pd
+pd.set_option('future.infer_string', False)
+src = open({ref_path!r}).read()
+ns = {{'__name__': 'reference_preprocess', '__file__': {ref_path!r}}}
+exec(compile(src, {ref_path!r}, 'exec'), ns)
+_orig = ns['map_consecutive_ids']
+def _compat(df, cols):
+    df, uniques = _orig(df, cols)
+    return df.infer_objects(), uniques
+ns['map_consecutive_ids'] = _compat
+ns['main']()
+""")
+    env = dict(os.environ, PYTHONPATH=REFERENCE, PYTHONHASHSEED="0",
+               JAX_PLATFORMS="")  # no jax in the reference; drop axon too
+    return subprocess.run(
+        [sys.executable, shim],
+        cwd=root, env=env, capture_output=True, text=True, timeout=1800)
+
+
+def read_like_reference(root: str) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """The reference's exact CSV read (preprocess.py:203-236), so both
+    pipelines see the same rows in the same order. This is deliberate
+    line-for-line BEHAVIORAL mirroring of ~8 lines of IO glue — the
+    alignment shim the whole comparison rests on."""
+    cg = os.path.join(root, "data", "MSCallGraph")
+    df = pd.concat(
+        (pd.read_csv(os.path.join(cg, f), index_col=0, engine="pyarrow")
+         .replace(np.nan, "nan")
+         for f in os.listdir(cg) if f.endswith(".csv")),
+        ignore_index=True).drop_duplicates()
+    df = df.sort_values(by=["timestamp"])
+    rs = os.path.join(root, "data", "MSResource")
+    resource_df = pd.concat(
+        pd.read_csv(os.path.join(rs, f), engine="pyarrow")
+        .loc[:, ["timestamp", "msname", "instance_cpu_usage",
+                 "instance_memory_usage"]]
+        for f in os.listdir(rs) if f.endswith(".csv"))
+    return df, resource_df
+
+
+class Check:
+    def __init__(self):
+        self.results: dict[str, bool] = {}
+        self.notes: dict[str, str] = {}
+
+    def ok(self, name: str, cond: bool, note: str = ""):
+        self.results[name] = bool(cond)
+        if note and not cond:
+            self.notes[name] = note
+        return cond
+
+    @property
+    def all_ok(self) -> bool:
+        return all(self.results.values())
+
+
+def ms_bijection(check: Check, mine: pd.DataFrame,
+                 ref: pd.DataFrame) -> dict[int, int]:
+    """Recover ref-ms-int -> my-ms-int from the aligned um/dm columns and
+    verify it is a bijection."""
+    pairs = np.concatenate([
+        np.stack([ref["um"].to_numpy(np.int64), mine["um"].to_numpy(np.int64)], 1),
+        np.stack([ref["dm"].to_numpy(np.int64), mine["dm"].to_numpy(np.int64)], 1)])
+    uniq = np.unique(pairs, axis=0)
+    fwd = dict(zip(uniq[:, 0].tolist(), uniq[:, 1].tolist()))
+    check.ok("ms_map_is_function", len(fwd) == len(uniq),
+             "one reference ms id maps to multiple of ours")
+    check.ok("ms_map_is_injective",
+             len(set(fwd.values())) == len(fwd),
+             "two reference ms ids collapse to one of ours")
+    return fwd
+
+
+def canonical_nodes(ms_per_node: np.ndarray) -> list[tuple[int, int]]:
+    """node index -> (ms, k-th occurrence of that ms) — a labeling
+    invariant to how the builder numbered the nodes."""
+    seen: dict[int, int] = {}
+    out = []
+    for ms in np.asarray(ms_per_node).ravel().tolist():
+        k = seen.get(ms, 0)
+        seen[ms] = k + 1
+        out.append((int(ms), k))
+    return out
+
+
+def edge_multiset(senders, receivers, attrs, nodes: list[tuple[int, int]],
+                  msmap: dict[int, int] | None):
+    """Canonical multiset of (src_node_tuple, dst_node_tuple, attr_tuple),
+    with ms labels pushed through `msmap` when comparing the reference's
+    labeling to ours."""
+    def m(t):
+        return (msmap[t[0]], t[1]) if msmap is not None else t
+
+    rows = []
+    for s, r, a in zip(np.asarray(senders).tolist(),
+                       np.asarray(receivers).tolist(),
+                       np.asarray(attrs).tolist()):
+        rows.append((m(nodes[s]), m(nodes[r]), tuple(int(x) for x in a)))
+    return sorted(rows)
+
+
+def _resources_match(my_res: pd.DataFrame, ref_res: pd.DataFrame,
+                     msmap: dict[int, int]) -> bool:
+    """Compare the per-(timestamp, msname) 8-feature resource tables.
+
+    Microservices that appear in surviving traces are matched through the
+    recovered ms bijection; resource-only microservices (never called, so
+    absent from um/dm) are matched as a multiset of per-ms fingerprints —
+    their ids are unconstrained by any aligned column."""
+    feat_cols = [c for c in ref_res.columns
+                 if c not in ("timestamp", "msname")]
+    if sorted(feat_cols) != sorted(
+            c for c in my_res.columns if c not in ("timestamp", "msname")):
+        return False
+
+    def fingerprint(df):
+        rows = df[["timestamp"] + feat_cols].to_numpy(np.float64)
+        return tuple(map(tuple, np.round(rows[np.lexsort(rows.T[::-1])],
+                                         9)))
+
+    ref_by_ms = {int(k): fingerprint(g)
+                 for k, g in ref_res.groupby("msname")}
+    my_by_ms = {int(k): fingerprint(g)
+                for k, g in my_res.groupby("msname")}
+    if len(ref_by_ms) != len(my_by_ms):
+        return False
+    mapped = {r: m for r, m in msmap.items() if r in ref_by_ms}
+    for r, m in mapped.items():
+        if my_by_ms.get(m) != ref_by_ms[r]:
+            return False
+    rest_ref = sorted(v for k, v in ref_by_ms.items() if k not in mapped)
+    rest_my = sorted(v for k, v in my_by_ms.items()
+                     if k not in set(mapped.values()))
+    return rest_ref == rest_my
+
+
+def compare(root: str, check: Check) -> dict:
+    import torch
+    from joblib import load as joblib_load
+
+    from pertgnn_tpu.config import Config, IngestConfig
+    from pertgnn_tpu.graphs.construct import build_runtime_graphs
+    from pertgnn_tpu.ingest.assemble import assemble
+    from pertgnn_tpu.ingest.preprocess import preprocess
+
+    # ---- my pipeline on the reference-aligned read ----
+    raw_df, raw_res = read_like_reference(root)
+    cfg = Config(ingest=IngestConfig())
+    pre = preprocess(raw_df, raw_res, cfg.ingest)
+    table = assemble(pre, cfg.ingest)
+
+    # ---- reference artifacts ----
+    proc = os.path.join(root, "processed")
+    ref_df = pd.read_csv(os.path.join(proc, "processed_df.csv"),
+                         engine="pyarrow")
+    tr2data = torch.load(os.path.join(proc, "tr2data.pt"),
+                         weights_only=False)
+    e2r = joblib_load(os.path.join(proc, "entry2runtimes.joblib"))
+    span_g = torch.load(os.path.join(proc, "runtime2spangraph_map.pt"),
+                        weights_only=False)
+    pert_g = torch.load(os.path.join(proc, "runtime2pertgraph_map.pt"),
+                        weights_only=False)
+
+    mine = pre.spans
+    # ---- processed frame: same rows, same codes ----
+    check.ok("row_count", len(mine) == len(ref_df),
+             f"{len(mine)} vs {len(ref_df)}")
+    if len(mine) == len(ref_df):
+        for col in ("traceid", "timestamp", "rpcid", "rpctype", "interface",
+                    "entryid", "rt"):
+            check.ok(f"col_{col}",
+                     np.array_equal(mine[col].to_numpy(),
+                                    ref_df[col].to_numpy()),
+                     f"column {col} differs")
+        msmap = ms_bijection(check, mine, ref_df)
+    else:
+        return {"fatal": "row count mismatch"}
+    inv = {v: k for k, v in msmap.items()}  # my-ms -> ref-ms
+
+    # ---- resource table (feeds find_most_recent_fts / get_x) ----
+    ref_res = pd.read_csv(os.path.join(proc, "processed_resource_df.csv"),
+                          engine="pyarrow")
+    check.ok("resource_table", _resources_match(pre.resources, ref_res,
+                                                msmap))
+
+    # ---- per-trace table ----
+    meta = table.meta.set_index("traceid")
+    check.ok("trace_count", len(meta) == len(tr2data),
+             f"{len(meta)} vs {len(tr2data)}")
+    ent_ok = rt_ok = ts_ok = y_ok = True
+    missing = 0
+    for tid, rec in tr2data.items():
+        if int(tid) not in meta.index:
+            missing += 1
+            continue
+        row = meta.loc[int(tid)]
+        ent_ok &= int(row["entry_id"]) == int(rec["entry_id"])
+        rt_ok &= int(row["runtime_id"]) == int(rec["runtime_id"])
+        ts_ok &= int(row["ts_bucket"]) == int(rec["timestamp"])
+        # the reference stores y through torch.tensor(...) — float32
+        # (preprocess.py:308); ours stays float64 until batching. The
+        # faithful comparison is exact equality in float32.
+        y_ok &= np.float32(row["y"]) == np.float32(rec["y"])
+    check.ok("trace_ids_aligned", missing == 0,
+             f"{missing} reference traces absent from our table")
+    check.ok("trace_entry_ids", ent_ok)
+    check.ok("trace_runtime_ids", rt_ok)
+    check.ok("trace_ts_buckets", ts_ok)
+    check.ok("trace_labels", y_ok)
+
+    # ---- mixture weights ----
+    probs_ok = set(int(k) for k in e2r) == set(table.entry2runtimes)
+    for ent, (rids, probs) in table.entry2runtimes.items():
+        ref_probs = e2r.get(ent, {})
+        probs_ok &= len(ref_probs) == len(rids)
+        for rid, p in zip(rids.tolist(), probs.tolist()):
+            probs_ok &= abs(ref_probs.get(rid, -1.0) - p) < 1e-12
+    check.ok("entry2runtimes", probs_ok)
+
+    # ---- graphs ----
+    my_span = build_runtime_graphs(pre, table, "span")
+    my_pert = build_runtime_graphs(pre, table, "pert")
+    check.ok("span_runtime_ids", set(span_g) == set(my_span))
+    check.ok("pert_runtime_ids", set(pert_g) == set(my_pert))
+
+    span_ok = depth_ok = True
+    for rid, g in span_g.items():
+        m = my_span.get(rid)
+        if m is None or int(g["num_nodes"]) != m.num_nodes:
+            span_ok = False
+            continue
+        ref_nodes = canonical_nodes(np.asarray(g["ms_id"]).ravel())
+        my_nodes = canonical_nodes(m.ms_id)
+        ei = np.asarray(g["edge_index"])
+        # span edge_attr: (E, 2) interface/rpctype (misc.py:177-181)
+        ref_edges = edge_multiset(ei[0], ei[1], np.asarray(g["edge_attr"]),
+                                  ref_nodes, msmap)
+        my_edges = edge_multiset(m.senders, m.receivers, m.edge_attr,
+                                 my_nodes, None)
+        span_ok &= ref_edges == my_edges
+        # reference node_depth is cast to long AFTER min/max normalization
+        # (misc.py:215: torch.tensor(node_depth, dtype=torch.long)) — i.e.
+        # 1 on max-depth nodes, 0 elsewhere. Compare in that domain.
+        ref_d = {n: int(d) for n, d in zip(
+            ref_nodes, np.asarray(g["node_depth"]).ravel().tolist())}
+        my_d = {(inv.get(n[0], -1 - n[0]), n[1]): int(d) for n, d in zip(
+            my_nodes, m.node_depth.tolist())}
+        depth_ok &= ref_d == my_d
+    check.ok("span_graphs", span_ok)
+    check.ok("span_node_depth_long", depth_ok)
+
+    pert_ok = pdepth_ok = True
+    for rid, g in pert_g.items():
+        m = my_pert.get(rid)
+        if m is None or int(g["num_nodes"]) != m.num_nodes:
+            pert_ok = False
+            continue
+        ref_nodes = canonical_nodes(np.asarray(g["ms_id"]).ravel())
+        my_nodes = canonical_nodes(m.ms_id)
+        ei = np.asarray(g["edge_index"])
+        ref_edges = edge_multiset(ei[0], ei[1], np.asarray(g["edge_attr"]),
+                                  ref_nodes, msmap)
+        my_edges = edge_multiset(m.senders, m.receivers, m.edge_attr,
+                                 my_nodes, None)
+        pert_ok &= ref_edges == my_edges
+        ref_d = {n: int(d) for n, d in zip(
+            ref_nodes, np.asarray(g["node_depth"]).ravel().tolist())}
+        my_d = {(inv.get(n[0], -1 - n[0]), n[1]): int(d) for n, d in zip(
+            my_nodes, m.node_depth.tolist())}
+        pdepth_ok &= ref_d == my_d
+    check.ok("pert_graphs", pert_ok)
+    check.ok("pert_node_depth_long", pdepth_ok)
+
+    return {
+        "rows": len(mine),
+        "traces": len(meta),
+        "entries": len(table.entry2runtimes),
+        "runtimes": len(my_span),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--traces", type=int, default=120,
+                    help="traces per entry (>100 survives the occurrence "
+                         "filter)")
+    ap.add_argument("--sandbox", default=None,
+                    help="keep the sandbox here instead of a temp dir")
+    args = ap.parse_args()
+
+    # identical parsing semantics on both sides (see run_reference shim)
+    pd.set_option("future.infer_string", False)
+    root = args.sandbox or tempfile.mkdtemp(prefix="refparity_")
+    os.makedirs(root, exist_ok=True)
+    make_sandbox(root, args.traces)
+    proc = run_reference(root)
+    if proc.returncode != 0:
+        print(json.dumps({"fatal": "reference preprocess failed",
+                          "stderr": proc.stderr[-2000:]}))
+        sys.exit(2)
+
+    check = Check()
+    # A genuine divergence must still end in a printed verdict naming the
+    # checks that ran (not a bare traceback), and the temp sandbox must
+    # not leak on the failure path — this harness exists to DIAGNOSE
+    # mismatches, so the failure path is the load-bearing one.
+    try:
+        stats = compare(root, check)
+        fatal = None
+    except Exception as e:  # noqa: BLE001 — report, don't crash
+        import traceback
+        stats = {}
+        fatal = f"{type(e).__name__}: {e}"
+        traceback.print_exc(file=sys.stderr)
+    finally:
+        ok = check.all_ok and fatal is None
+        verdict = {"pass": ok, "checks": check.results,
+                   "notes": check.notes, **stats,
+                   "sandbox": root if args.sandbox else "(temp, removed)"}
+        if fatal:
+            verdict["fatal"] = fatal
+        print(json.dumps(verdict, indent=1))
+        if not args.sandbox:
+            import shutil
+            shutil.rmtree(root, ignore_errors=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,9 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--arena_hbm_budget_gb", type=float, default=4.0,
                    help="HBM budget for chip-resident arenas; exceeding it "
                         "falls back to host packing; <=0 = unlimited")
+    p.add_argument("--no_stage_epoch_recipes", action="store_true",
+                   help="disable epoch-level recipe staging (one H2D per "
+                        "epoch); fall back to per-chunk recipe transfer")
     p.add_argument("--shard_edges", action="store_true",
                    help="giant-graph mode: shard each batch's edge set "
                         "over the mesh data axis (nodes replicated)")
@@ -142,6 +145,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             device_materialize=not args.no_device_materialize,
             arena_hbm_budget_gb=(args.arena_hbm_budget_gb
                                  if args.arena_hbm_budget_gb > 0 else None),
+            stage_epoch_recipes=not args.no_stage_epoch_recipes,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_keep=args.checkpoint_keep),
         parallel=ParallelConfig(data_parallel=args.data_parallel,
