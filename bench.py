@@ -337,6 +337,38 @@ def make_torch_reference(ds, cfg, f_in):
     return model, one_step, predict, to_torch
 
 
+def transfer_params_to_torch(tmodel, params, n_convs: int) -> None:
+    """Copy one flax parameter set into the torch reference model — the
+    two stacks then compute the same function (pinned to 2e-4 by
+    tests/test_model.py weight-transfer parity). Shared by that test and
+    the lockstep-trajectory study (benchmarks/span_gap_r4.py)."""
+    import torch
+
+    def put(t, a):
+        with torch.no_grad():
+            t.copy_(torch.tensor(np.asarray(a)))
+
+    put(tmodel.ms.weight, params["ms_embed"]["embedding"])
+    put(tmodel.iface.weight, params["interface_embed"]["embedding"])
+    put(tmodel.rpc.weight, params["rpctype_embed"]["embedding"])
+    put(tmodel.entry.weight, params["entry_embed"]["embedding"])
+    for i in range(n_convs):
+        cp, tc = params[f"conv_{i}"], tmodel.convs[i]
+        for ours_name, theirs in (("query", tc.q), ("key", tc.k),
+                                  ("value", tc.v), ("edge", tc.e),
+                                  ("skip", tc.skip)):
+            put(theirs.weight, np.asarray(cp[ours_name]["kernel"]).T)
+            if ours_name != "edge":
+                put(theirs.bias, cp[ours_name]["bias"])
+    for i in range(n_convs - 1):
+        put(tmodel.bns[i].weight, params[f"bn_{i}"]["scale"])
+        put(tmodel.bns[i].bias, params[f"bn_{i}"]["bias"])
+    put(tmodel.g1.weight, np.asarray(params["global_head1"]["kernel"]).T)
+    put(tmodel.g1.bias, params["global_head1"]["bias"])
+    put(tmodel.g2.weight, np.asarray(params["global_head2"]["kernel"]).T)
+    put(tmodel.g2.bias, params["global_head2"]["bias"])
+
+
 def bench_torch_baseline(ds, cfg, steps: int = 6) -> float:
     """The reference's computation in torch on CPU, same batches. The
     torch loop re-feeds pre-converted batches — this is the CEILING of the

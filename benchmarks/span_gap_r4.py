@@ -1,0 +1,220 @@
+"""Root-cause study: the span 20-epoch train-fit gap (VERDICT r4 #5).
+
+Round-3 measured span train-fit ratio 1.134 at 20 epochs (CIs touch),
+recovering to 1.043 at 100 epochs, and asserted "convergence-speed
+artifact" without isolating a cause. The pert side got exactly this
+treatment in r3 (init A/B) and it found a real bug (kernel init). This
+script runs the same protocol on span graphs, in two stages:
+
+1. `--lockstep` — UPDATE-RULE isolation: initialize both stacks from the
+   SAME weights (bench.transfer_params_to_torch, the mapping pinned to
+   2e-4 by the weight-transfer parity test) and train them on the SAME
+   batch stream. If per-epoch losses track, the optimizer/BN/loss
+   machinery is equivalent and the 20-epoch gap must come from the init
+   DISTRIBUTION or batch boundaries; if they diverge, the update rule
+   itself differs (bug).
+
+2. `--init_ab` — INIT isolation: N seeds of our span model under
+   init_scheme "torch" (zero biases — r3 default) vs "torch_full"
+   (+ torch's U(+-1/sqrt(fan_in)) bias init — the one remaining init
+   difference vs torch.nn.Linear), against N torch-baseline seeds.
+
+Outputs one JSON line per experiment; run manually (CPU is fine):
+    python benchmarks/span_gap_r4.py --lockstep
+    python benchmarks/span_gap_r4.py --init_ab --seeds 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from pertgnn_tpu.cli.common import apply_platform_env
+
+apply_platform_env()
+
+
+def _span_setup(init_scheme: str = "torch"):
+    """The quality_parity span configuration (benchmarks/run.py)."""
+    from benchmarks.run import _dataset, _flagship_cfg
+
+    cfg = _flagship_cfg(init_scheme=init_scheme)
+    cfg = cfg.replace(
+        graph_type="span",
+        data=dataclasses.replace(cfg.data, batch_size=32),
+        train=dataclasses.replace(cfg.train, epochs=20, scan_chunk=4,
+                                  lr=1e-3))
+    ds = _dataset(dict(num_entries=6, traces_per_entry=120, seed=5), cfg)
+    return ds, cfg
+
+
+def _train_fit_mae(ds, cfg, state) -> float:
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import evaluate, make_eval_step
+
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                      ds.num_interfaces, ds.num_rpctypes)
+    return evaluate(make_eval_step(model, cfg), state,
+                    ds.batches("train"))["mae"]
+
+
+def lockstep(epochs: int = 20) -> dict:
+    """Same initial weights, same batches, both update rules; per-epoch
+    mean train pinball loss for each stack."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import torch
+
+    from bench import make_torch_reference, transfer_params_to_torch
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import create_train_state, make_train_step
+
+    ds, cfg = _span_setup()
+    sample = next(ds.batches("train"))
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    tx = optax.adam(cfg.train.lr)
+    state = create_train_state(model, tx, sample, cfg.train.seed)
+
+    torch.manual_seed(0)
+    tmodel, one_step_t, predict_t, to_torch = make_torch_reference(
+        ds, cfg, sample.x.shape[1])
+    transfer_params_to_torch(tmodel, state.params,
+                             max(2, cfg.model.num_layers))
+    opt_t = torch.optim.Adam(tmodel.parameters(), lr=cfg.train.lr)
+    tau = cfg.train.tau
+
+    def torch_epoch_loss(batches) -> float:
+        tot = n = 0.0
+        for b in batches:
+            tb = to_torch(b)
+            tmodel.train()
+            opt_t.zero_grad()
+            pred = tmodel(tb)
+            e = tb["y"] / cfg.train.label_scale - pred
+            mask = tb["graph_mask"].float()
+            loss = (torch.maximum(tau * e, (tau - 1) * e)
+                    * mask).sum() / mask.sum().clamp_min(1.0)
+            loss.backward()
+            opt_t.step()
+            tot += float(loss) * float(mask.sum())
+            n += float(mask.sum())
+        return tot / max(n, 1.0)
+
+    step = make_train_step(model, cfg, tx)
+    ours_hist, torch_hist = [], []
+    for epoch in range(epochs):
+        batches = list(ds.batches("train", shuffle=True,
+                                  seed=cfg.data.shuffle_seed + epoch))
+        sums = {"qloss_sum": 0.0, "count": 0.0}
+        for b in batches:
+            state, m = step(state, jax.tree.map(jnp.asarray, b))
+            sums["qloss_sum"] += float(m["qloss_sum"])
+            sums["count"] += float(m["count"])
+        # metric sums report qloss in RAW label units; the torch loop's
+        # loss is in scaled space — divide ours back for a like comparison
+        ours_hist.append(sums["qloss_sum"] / max(sums["count"], 1.0)
+                         / cfg.train.label_scale)
+        torch_hist.append(torch_epoch_loss(batches))
+
+    ratios = [o / max(t, 1e-9) for o, t in zip(ours_hist, torch_hist)]
+    return {
+        "experiment": "span_lockstep_trajectory",
+        "epochs": epochs,
+        "ours_qloss_per_epoch": [round(v, 3) for v in ours_hist],
+        "torch_qloss_per_epoch": [round(v, 3) for v in torch_hist],
+        "ratio_per_epoch": [round(r, 4) for r in ratios],
+        "final_ratio": round(ratios[-1], 4),
+        "max_abs_log_ratio": round(
+            float(np.max(np.abs(np.log(ratios)))), 4),
+        "ours_trainfit_mae": round(_train_fit_mae(ds, cfg, state), 2),
+    }
+
+
+def init_ab(seeds: int = 8, epochs: int = 20) -> dict:
+    """Our span model, N seeds per init scheme, vs N torch-baseline
+    seeds; train-fit MAE mean +- CI95 per arm."""
+    import torch
+
+    from benchmarks.run import _mean_ci95
+    from bench import make_torch_reference
+    from pertgnn_tpu.train.loop import fit
+
+    out = {"experiment": "span_init_ab", "seeds": seeds, "epochs": epochs}
+    for scheme in ("torch", "torch_full"):
+        ds, cfg = _span_setup(init_scheme=scheme)
+        cfg = cfg.replace(train=dataclasses.replace(cfg.train,
+                                                    epochs=epochs))
+        fits = []
+        for seed in range(seeds):
+            c = cfg.replace(train=dataclasses.replace(cfg.train, seed=seed))
+            state, _ = fit(ds, c)
+            fits.append(_train_fit_mae(ds, c, state))
+        mean, ci = _mean_ci95(fits)
+        out[scheme] = {"trainfit_mean_mae": round(mean, 1),
+                       "ci95": round(ci, 1),
+                       "per_seed": [round(f, 1) for f in fits]}
+
+    # torch baseline arm (same protocol as quality_parity)
+    ds, cfg = _span_setup()
+    sample = next(ds.batches("train"))
+    t_fits = []
+    for seed in range(seeds):
+        torch.manual_seed(seed)
+        _, one_step, predict, to_torch = make_torch_reference(
+            ds, cfg, sample.x.shape[1])
+        for epoch in range(epochs):
+            for b in ds.batches("train", shuffle=True,
+                                seed=cfg.data.shuffle_seed + epoch):
+                one_step(to_torch(b))
+        err = n = 0.0
+        for b in ds.batches("train"):
+            pred = predict(to_torch(b))
+            mask = np.asarray(b.graph_mask)
+            err += float(np.abs(pred - np.asarray(b.y))[mask].sum())
+            n += float(mask.sum())
+        t_fits.append(err / max(n, 1.0))
+    mean, ci = _mean_ci95(t_fits)
+    out["torch_baseline"] = {"trainfit_mean_mae": round(mean, 1),
+                             "ci95": round(ci, 1),
+                             "per_seed": [round(f, 1) for f in t_fits]}
+    for scheme in ("torch", "torch_full"):
+        out[f"ratio_{scheme}"] = round(
+            out[scheme]["trainfit_mean_mae"]
+            / max(out["torch_baseline"]["trainfit_mean_mae"], 1e-9), 3)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--lockstep", action="store_true")
+    ap.add_argument("--init_ab", action="store_true")
+    ap.add_argument("--seeds", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=20)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    rows = []
+    t0 = time.time()
+    if args.lockstep:
+        rows.append(lockstep(epochs=args.epochs))
+    if args.init_ab:
+        rows.append(init_ab(seeds=args.seeds, epochs=args.epochs))
+    for r in rows:
+        r["wall_s"] = round(time.time() - t0, 1)
+        print(json.dumps(r), flush=True)
+    if args.out:
+        with open(args.out, "a") as f:
+            f.write("".join(json.dumps(r) + "\n" for r in rows))
+
+
+if __name__ == "__main__":
+    main()

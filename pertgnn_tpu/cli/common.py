@@ -52,7 +52,7 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--attn_dropout", type=float, default=0.0,
                    help="dropout on attention weights inside the conv")
-    p.add_argument("--init_scheme", choices=("torch", "flax"),
+    p.add_argument("--init_scheme", choices=("torch", "torch_full", "flax"),
                    default="torch",
                    help="Linear-kernel init: torch kaiming-uniform "
                         "(reference-faithful, default) or flax defaults")

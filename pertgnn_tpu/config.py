@@ -122,7 +122,10 @@ class ModelConfig:
     # reference's PyG stack) trains with; measured 98.2+-5.5 train-fit MAE
     # vs 117.0+-13.8 for "flax" (glorot attention / lecun-normal heads) on
     # the 6-seed 20-epoch synthetic A/B — the flax defaults were the source
-    # of the round-2/3 quality-parity gap (RESULTS.md).
+    # of the round-2/3 quality-parity gap (RESULTS.md). "torch_full" adds
+    # torch's U(+-1/sqrt(fan_in)) BIAS init on top (flax biases are zeros)
+    # — the remaining init difference, A/B'd for the span 20-epoch gap
+    # (benchmarks/span_gap_r4.py).
     init_scheme: str = "torch"
 
 

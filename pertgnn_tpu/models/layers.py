@@ -42,16 +42,31 @@ def kernel_initializer(scheme: str, role: str = "attn"):
     Linear — torch.nn.Linear's default, hence what the reference's PyG
     stack trains with (variance_scaling(1/3, fan_in, uniform) gives
     exactly bound sqrt(3*(1/3)/fan_in) = 1/sqrt(fan_in)).
+    "torch_full": same kernels as "torch", plus torch's BIAS init
+    U(+-1/sqrt(fan_in)) (see bias_initializer) — flax's zero biases are
+    the one remaining init difference vs the reference stack.
     "flax": the framework's conventional defaults — glorot-uniform for
     attention projections ("attn"), flax's lecun-normal Dense default for
     output heads ("head")."""
-    if scheme == "torch":
+    if scheme in ("torch", "torch_full"):
         return nn.initializers.variance_scaling(1.0 / 3.0, "fan_in",
                                                 "uniform")
     if scheme == "flax":
         return (nn.initializers.glorot_uniform() if role == "attn"
                 else nn.linear.default_kernel_init)
     raise ValueError(f"unknown init_scheme {scheme!r}")
+
+
+def bias_initializer(scheme: str, fan_in: int):
+    """Dense-bias initializer. torch.nn.Linear draws biases from
+    U(+-1/sqrt(fan_in)); flax uses zeros. Only "torch_full" adopts the
+    torch behavior (fan_in must be supplied by the caller — flax bias
+    initializers only see the bias shape)."""
+    if scheme == "torch_full":
+        bound = 1.0 / (max(fan_in, 1) ** 0.5)
+        return lambda key, shape, dtype=jnp.float32: jax.random.uniform(
+            key, shape, dtype, -bound, bound)
+    return nn.initializers.zeros
 
 
 class GraphTransformerLayer(nn.Module):
@@ -78,7 +93,8 @@ class GraphTransformerLayer(nn.Module):
         H, C = self.heads, self.out_channels // self.heads
         dense = lambda name, bias: nn.Dense(
             H * C, use_bias=bias, name=name, dtype=self.dtype,
-            kernel_init=kernel_initializer(self.init_scheme))
+            kernel_init=kernel_initializer(self.init_scheme),
+            bias_init=bias_initializer(self.init_scheme, x.shape[-1]))
         q = dense("query", True)(x)
         k = dense("key", True)(x)
         v = dense("value", True)(x)

@@ -32,7 +32,8 @@ from flax import linen as nn
 
 from pertgnn_tpu.config import ModelConfig
 from pertgnn_tpu.models.layers import (GraphTransformerLayer,
-                                       MaskedBatchNorm, kernel_initializer)
+                                       MaskedBatchNorm, bias_initializer,
+                                       kernel_initializer)
 from pertgnn_tpu.ops.segment import segment_mean_by_graph
 
 
@@ -89,8 +90,10 @@ class PertGNN(nn.Module):
             batch.edge_mask, training=training)
 
         head_init = kernel_initializer(cfg.init_scheme, role="head")
-        local_pred = nn.Dense(1, name="local_head", dtype=dtype,
-                              kernel_init=head_init)(x)[:, 0]
+        local_pred = nn.Dense(
+            1, name="local_head", dtype=dtype, kernel_init=head_init,
+            bias_init=bias_initializer(cfg.init_scheme, x.shape[-1]),
+        )(x)[:, 0]
 
         # mixture pooling: zero pad nodes explicitly so they cannot leak
         weights = jnp.where(batch.node_mask,
@@ -99,10 +102,13 @@ class PertGNN(nn.Module):
                                        weights.astype(dtype), num_graphs)
         entry_emb = embed("entry_embed", self.num_entries)(batch.entry_id)
         g = jnp.concatenate([pooled, entry_emb], axis=1)
-        g = nn.relu(nn.Dense(hidden, name="global_head1", dtype=dtype,
-                             kernel_init=head_init)(g))
-        global_pred = nn.Dense(1, name="global_head2", dtype=dtype,
-                               kernel_init=head_init)(g)[:, 0]
+        g = nn.relu(nn.Dense(
+            hidden, name="global_head1", dtype=dtype,
+            kernel_init=head_init,
+            bias_init=bias_initializer(cfg.init_scheme, g.shape[-1]))(g))
+        global_pred = nn.Dense(
+            1, name="global_head2", dtype=dtype, kernel_init=head_init,
+            bias_init=bias_initializer(cfg.init_scheme, hidden))(g)[:, 0]
         if cfg.nonnegative_pred:
             # softplus, not relu: a relu clamp kills the gradient whenever
             # the raw prediction is negative (dead at init)

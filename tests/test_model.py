@@ -364,7 +364,7 @@ def test_torch_reference_stack_weight_transfer_parity(preprocessed,
     import torch
 
     from pertgnn_tpu.batching import build_dataset
-    from bench import make_torch_reference
+    from bench import make_torch_reference, transfer_params_to_torch
 
     ds = build_dataset(preprocessed, small_config)
     cfg = small_config
@@ -376,32 +376,8 @@ def test_torch_reference_stack_weight_transfer_parity(preprocessed,
     ours = np.asarray(model.apply(variables, jb, training=False)[0])
 
     tmodel, _, _, to_torch = make_torch_reference(ds, cfg, batch.x.shape[1])
-    p = variables["params"]
-
-    def put(t, a):
-        with torch.no_grad():
-            t.copy_(torch.tensor(np.asarray(a)))
-
-    put(tmodel.ms.weight, p["ms_embed"]["embedding"])
-    put(tmodel.iface.weight, p["interface_embed"]["embedding"])
-    put(tmodel.rpc.weight, p["rpctype_embed"]["embedding"])
-    put(tmodel.entry.weight, p["entry_embed"]["embedding"])
-    n_convs = max(2, cfg.model.num_layers)
-    for i in range(n_convs):
-        cp, tc = p[f"conv_{i}"], tmodel.convs[i]
-        for ours_name, theirs in (("query", tc.q), ("key", tc.k),
-                                  ("value", tc.v), ("edge", tc.e),
-                                  ("skip", tc.skip)):
-            put(theirs.weight, np.asarray(cp[ours_name]["kernel"]).T)
-            if ours_name != "edge":
-                put(theirs.bias, cp[ours_name]["bias"])
-    for i in range(n_convs - 1):
-        put(tmodel.bns[i].weight, p[f"bn_{i}"]["scale"])
-        put(tmodel.bns[i].bias, p[f"bn_{i}"]["bias"])
-    put(tmodel.g1.weight, np.asarray(p["global_head1"]["kernel"]).T)
-    put(tmodel.g1.bias, p["global_head1"]["bias"])
-    put(tmodel.g2.weight, np.asarray(p["global_head2"]["kernel"]).T)
-    put(tmodel.g2.bias, p["global_head2"]["bias"])
+    transfer_params_to_torch(tmodel, variables["params"],
+                             max(2, cfg.model.num_layers))
 
     tmodel.eval()
     with torch.no_grad():
