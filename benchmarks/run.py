@@ -232,9 +232,24 @@ def dp8() -> dict:
             "backend": jax.default_backend()}
 
 
-def deep_wide() -> dict:
-    """Config 4: 8 layers, 256 hidden, 8 heads."""
-    cfg = _flagship_cfg(hidden_channels=256, num_layers=8, num_heads=8)
+def deep_wide(bf16: bool = False) -> dict:
+    """Config 4: 8 layers, 256 hidden, 8 heads.
+
+    Besides MFU/MBU from XLA cost analysis, emits an ANALYTIC HBM bound:
+    per-step traffic = 8x param bytes (params+grads+Adam m/v, read+write)
+    + batch input bytes, assuming activations stay VMEM-resident (one
+    (4.3k, 256) f32 activation is 4.25 MiB vs v5e's 128 MiB VMEM). XLA's
+    `bytes accessed` multiply-counts every op's operands in the optimized
+    HLO (~1.25 GB/step here — more than even spill-everything traffic),
+    so a roofline built on it is a gross UNDER-estimate of achievable
+    graphs/s; `mbu_pct` computed from it can exceed 100. The r2
+    216-256k graphs/s row sits at 44-52% of the analytic bound —
+    consistent — which adjudicates the RESULTS.md "5x over roofline"
+    suspicion in favor of the measurement (VERDICT r4 #4)."""
+    import jax
+
+    cfg = _flagship_cfg(hidden_channels=256, num_layers=8, num_heads=8,
+                        bf16_activations=bf16)
     cfg = cfg.replace(
         data=dataclasses.replace(cfg.data, batch_size=64),
         train=dataclasses.replace(cfg.train, scan_chunk=4))
@@ -242,12 +257,47 @@ def deep_wide() -> dict:
                        patterns_per_entry=4, traces_per_entry=200, seed=42),
                   cfg)
     r = _train_throughput(ds, cfg, steps=40, with_mfu=True)
+
+    import optax
+
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import create_train_state
+    from pertgnn_tpu.utils.flops import peak_hbm_bw_per_chip
+
+    sample = next(ds.batches("train"))
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    # eval_shape: parameter COUNT only — no device init, no Adam state
+    shapes = jax.eval_shape(
+        lambda: create_train_state(model, optax.adam(cfg.train.lr),
+                                   sample, cfg.train.seed))
+    nparams = sum(int(np.prod(p.shape))
+                  for p in jax.tree.leaves(shapes.params))
+    graphs = int(sample.graph_mask.sum())
+    batch_bytes = sum(np.asarray(getattr(sample, f)).nbytes
+                      for f in sample._fields)
+    per_graph_analytic = (nparams * 4 * 8 + batch_bytes) / graphs
+    bw = peak_hbm_bw_per_chip()
+    analytic = (bw / per_graph_analytic) if bw else None
     return {"metric": "deep_wide_train_graphs_per_s",
             "value": round(r["graphs_per_s"], 1), "unit": "graphs/s",
-            "config": "hidden256 L8 H8 batch64 pert",
+            "config": ("hidden256 L8 H8 batch64 pert"
+                       + (" bf16" if bf16 else "")),
+            "params_m": round(nparams / 1e6, 2),
+            "analytic_hbm_bytes_per_graph": round(per_graph_analytic),
+            "analytic_roofline_graphs_per_s": (round(analytic)
+                                               if analytic else None),
+            "analytic_mbu_pct": (
+                round(100 * r["graphs_per_s"] / analytic, 1)
+                if analytic else None),
             **{k: r[k] for k in ("mfu_pct", "mbu_pct", "flops_per_graph",
                                  "bytes_per_graph", "ai_flops_per_byte",
                                  "roofline_graphs_per_s")}}
+
+
+def deep_wide_bf16() -> dict:
+    """Config 4 with bf16 activations — the advertised ~2x bytes lever."""
+    return deep_wide(bf16=True)
 
 
 def giant_dag() -> dict:
@@ -508,6 +558,7 @@ CONFIGS = {
     "flagship_chip": flagship_chip,
     "dp8": dp8,
     "deep_wide": deep_wide,
+    "deep_wide_bf16": deep_wide_bf16,
     "giant_dag": giant_dag,
     "pallas_crossover": pallas_crossover,
 }
