@@ -175,8 +175,10 @@ class TrainConfig:
     # per chunk. An epoch of recipes is O(graphs) int32s (~1.6 MB at 98k
     # graphs) but per-chunk puts pay the link's per-transfer latency
     # (~3.5 ms over the axon tunnel) once per field per chunk — measured
-    # as the main fit-vs-ceiling gap on chip (VERDICT r3). Single-device
-    # compact path only.
+    # as the main fit-vs-ceiling gap on chip (VERDICT r3). Applies to the
+    # compact paths: single-device, and single-process mesh (sharded
+    # staging with the epoch axis replicated); multi-host keeps per-chunk
+    # assembly because each host owns only its slab.
     stage_epoch_recipes: bool = True
 
 

@@ -298,10 +298,42 @@ def _staged_epoch_iter(chunks: Iterator) -> Iterator:
     a full-batch H2D every step (/root/reference/pert_gnn.py:231)."""
     import numpy as np
 
+    yield from _staged_iter(chunks, lambda _path, stacked: jnp.asarray(
+        stacked))
+
+
+def _staged_epoch_iter_sharded(chunks: Iterator, shardings) -> Iterator:
+    """Mesh twin of `_staged_epoch_iter`: one sharded device_put for the
+    whole epoch's global compact recipes, sliced per chunk on device.
+
+    The stacked array gets each leaf's NamedSharding with the epoch axis
+    prepended replicated (P(None, *spec)); slicing away that axis yields
+    exactly the per-chunk sharding the SPMD program was jitted with
+    (pinned by tests/test_parallel.py staged-equivalence)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    flat_sh = jax.tree.leaves(shardings)
+
+    def put(i, stacked):
+        s = flat_sh[i]
+        return jax.device_put(
+            stacked, NamedSharding(s.mesh, PartitionSpec(None, *s.spec)))
+
+    yield from _staged_iter(chunks, put)
+
+
+def _staged_iter(chunks: Iterator, put) -> Iterator:
+    """Shared staging shell: stack the whole epoch on host, device-put
+    each leaf ONCE via `put(leaf_index, stacked)`, slice per chunk on
+    device."""
+    import numpy as np
+
     host = list(chunks)
     if not host:
         return
-    staged = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)), *host)
+    counter = iter(range(len(jax.tree.leaves(host[0]))))
+    staged = jax.tree.map(
+        lambda *xs: put(next(counter), np.stack(xs)), *host)
     for i in range(len(host)):
         yield jax.tree.map(lambda a: a[i], staged)
 
@@ -501,6 +533,11 @@ def fit(dataset: Dataset, cfg: Config,
                 if chunked:
                     glob = _host_chunks(glob, cfg.train.scan_chunk,
                                         zero_masked_compact)
+                if n_proc == 1 and cfg.train.stage_epoch_recipes:
+                    # O(graphs) recipes: one sharded transfer per epoch
+                    # (multi-process keeps per-chunk assembly — each host
+                    # owns only its slab)
+                    return _staged_epoch_iter_sharded(glob, sh)
                 if shuffle:  # train: packing off the critical path
                     glob = _background(glob)
                 return to_device(glob, sh)

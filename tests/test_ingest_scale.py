@@ -1,0 +1,34 @@
+"""Reduced-scale regression for the on-disk ingest harness
+(benchmarks/ingest_scale_r4.py; full-scale measurement in RESULTS.md).
+
+Pins: the tiled tree builder writes the raw layout, the CLI preprocesses
+it end-to-end in a child process, RSS sampling works, and entries
+survive the occurrence filter across tiles (the tiling property the
+multi-GB proof rests on).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ingest_scale_harness_small(tmp_path):
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "benchmarks", "ingest_scale_r4.py"),
+         "--gb", "0.02", "--keep-tree", str(tmp_path / "tree")],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["rc"] == 0
+    assert row["tiles"] >= 2                  # tiling actually happened
+    assert row["raw_traces"] >= 30_000
+    assert row["traces_per_s"] > 500          # CLI really processed them
+    assert row["peak_rss_gb"] > 0             # RSS sampling produced data
+    # artifacts landed (idempotent-cache layout)
+    art = tmp_path / "tree" / "processed"
+    assert (art / "trace_meta.parquet").exists()

@@ -192,6 +192,26 @@ def test_fit_with_mesh(ds, cfg):
         assert np.isfinite(v), (k, v)
 
 
+def test_fit_with_mesh_staged_equals_streamed(ds, cfg):
+    """Sharded epoch-staging (one device_put per epoch, device-side
+    per-chunk slices — _staged_epoch_iter_sharded) must reproduce the
+    per-chunk shard_batch trajectory exactly on the mesh compact path."""
+    import dataclasses
+
+    from pertgnn_tpu.train.loop import fit
+
+    mesh = make_mesh(data=8, model=1)
+    c_staged = cfg.replace(train=dataclasses.replace(
+        cfg.train, scan_chunk=2, stage_epoch_recipes=True))
+    c_stream = cfg.replace(train=dataclasses.replace(
+        cfg.train, scan_chunk=2, stage_epoch_recipes=False))
+    _, h_staged = fit(ds, c_staged, epochs=2, mesh=mesh)
+    _, h_stream = fit(ds, c_stream, epochs=2, mesh=mesh)
+    for rs, rt in zip(h_staged, h_stream):
+        for k in ("train_qloss", "train_mae", "valid_mae", "test_mae"):
+            assert rs[k] == rt[k], (k, rs[k], rt[k])
+
+
 def test_fit_with_mesh_host_packed(ds, cfg):
     """The host-packed SPMD path still works when the arena budget forces
     the fallback (arena_hbm_budget_gb=0)."""
