@@ -225,7 +225,10 @@ def make_torch_reference(ds, cfg, f_in):
             s = (q * ke).sum(-1) / np.sqrt(hidden)
             smax = torch.full((n,), -torch.inf).scatter_reduce(
                 0, rcv, s, reduce="amax")
-            ex = torch.exp(s - smax.clamp_min(0.0)[rcv])
+            # gathered only at rcv positions with edges -> always finite;
+            # subtract the TRUE max (a 0-clamp would lose stabilization
+            # for all-negative score groups and diverge from PyG)
+            ex = torch.exp(s - smax[rcv])
             den = torch.zeros(n).index_add(0, rcv, ex)
             alpha = ex / den.clamp_min(1e-16)[rcv]
             out = torch.zeros(n, hidden).index_add(0, rcv,

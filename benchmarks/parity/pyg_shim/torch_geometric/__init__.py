@@ -1,0 +1,23 @@
+"""Minimal torch_geometric SHIM — just enough API for the reference's
+driver (/root/reference/pert_gnn.py, model.py) to execute verbatim.
+
+torch_geometric cannot be installed here (zero egress —
+benchmarks/parity/pyg_install_attempt.log). This shim implements, from
+PyG's PUBLIC documented semantics, exactly the surface the reference
+imports:
+
+- ``torch_geometric.data.Data``            (attribute container)
+- ``torch_geometric.loader.DataLoader``    (graph-collating loader)
+- ``torch_geometric.nn.TransformerConv``   (Shi et al. 2021 conv)
+- ``torch_geometric.nn.Linear``            (lazy in_channels=-1)
+- ``torch_geometric.nn.global_add_pool``
+
+HONESTY NOTE (PARITY.md "Oracle independence"): running the reference on
+this shim pins the reference's *driver* — get_x featurization, lru_cache
+mixture assembly, collation, split, loss/metric semantics — against our
+pipeline, because all of that is the reference's OWN code executing. It
+does NOT independently pin TransformerConv: the conv here re-implements
+the published equations with the same reading our other oracles use
+(weight-transfer-pinned to the flax layer at 2e-4). A real PyG install
+would still strengthen that one link.
+"""

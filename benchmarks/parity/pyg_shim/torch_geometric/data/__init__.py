@@ -1,0 +1,1 @@
+from torch_geometric.data.data import Batch, Data  # noqa: F401

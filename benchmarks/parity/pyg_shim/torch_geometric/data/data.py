@@ -1,0 +1,69 @@
+"""Data / Batch with PyG's documented collation semantics.
+
+Collation rule (PyG docs, torch_geometric.data.Batch.from_data_list):
+every tensor attribute concatenates along dim 0, EXCEPT ``edge_index``
+which concatenates along dim 1 with values incremented by the cumulative
+node count of the preceding graphs; 0-d tensors stack to a 1-d tensor;
+``batch`` maps each node to its graph index. num_nodes is x.shape[0].
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Data:
+    def __init__(self, **kwargs):
+        self._keys = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+            self._keys.append(k)
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.x.shape[0])
+
+    @property
+    def num_graphs(self) -> int:
+        # a bare Data is one graph; Batch overrides
+        return 1
+
+    def to(self, device):
+        for k in self._keys:
+            v = getattr(self, k)
+            if torch.is_tensor(v):
+                setattr(self, k, v.to(device))
+        if hasattr(self, "batch") and torch.is_tensor(self.batch):
+            self.batch = self.batch.to(device)
+        return self
+
+
+class Batch(Data):
+    @classmethod
+    def from_data_list(cls, data_list):
+        keys = data_list[0]._keys
+        out = {}
+        for k in keys:
+            vals = [getattr(d, k) for d in data_list]
+            if k == "edge_index":
+                offsets = torch.cumsum(
+                    torch.tensor([0] + [d.num_nodes for d in data_list[:-1]]),
+                    dim=0)
+                out[k] = torch.cat(
+                    [v + off for v, off in zip(vals, offsets)], dim=1)
+            elif torch.is_tensor(vals[0]) and vals[0].dim() == 0:
+                out[k] = torch.stack(vals)
+            elif torch.is_tensor(vals[0]):
+                out[k] = torch.cat(vals, dim=0)
+            else:
+                out[k] = torch.tensor(vals)
+        b = cls(**out)
+        b.batch = torch.repeat_interleave(
+            torch.arange(len(data_list)),
+            torch.tensor([d.num_nodes for d in data_list]))
+        b._num_graphs = len(data_list)
+        return b
+
+    @property
+    def num_graphs(self) -> int:
+        return self._num_graphs

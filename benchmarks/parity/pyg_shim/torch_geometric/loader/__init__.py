@@ -1,0 +1,1 @@
+from torch_geometric.loader.dataloader import DataLoader  # noqa: F401

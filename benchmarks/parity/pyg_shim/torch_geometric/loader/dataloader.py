@@ -1,0 +1,16 @@
+"""DataLoader: a torch.utils.data.DataLoader whose collate_fn is PyG's
+Batch.from_data_list (the reference uses batch_size + shuffle only,
+pert_gnn.py:201-209)."""
+
+from __future__ import annotations
+
+import torch
+
+from torch_geometric.data.data import Batch
+
+
+class DataLoader(torch.utils.data.DataLoader):
+    def __init__(self, dataset, batch_size: int = 1, shuffle: bool = False,
+                 **kwargs):
+        super().__init__(dataset, batch_size=batch_size, shuffle=shuffle,
+                         collate_fn=Batch.from_data_list, **kwargs)

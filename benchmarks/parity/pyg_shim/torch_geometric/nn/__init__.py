@@ -1,0 +1,2 @@
+from torch_geometric.nn.modules import (Linear, TransformerConv,  # noqa: F401
+                                        global_add_pool)

@@ -1,0 +1,97 @@
+"""TransformerConv / Linear / global_add_pool for the shim.
+
+TransformerConv implements the published operator (Shi et al. 2021,
+"Masked Label Prediction", eq. 3-4 — the PyG docs' formula) for the
+configuration the reference instantiates (heads=1, concat default,
+root_weight default, edge_dim set; model.py:25-52):
+
+    q_i = W3 x_i,  k_j = W4 x_j,  v_j = W2 x_j,  e_ij = W6 edge_attr_ij
+    alpha_ij = softmax_over_j->i( q_i . (k_j + e_ij) / sqrt(d) )
+    out_i = W1 x_i + sum_j alpha_ij (v_j + e_ij)
+
+Messages flow source -> target: edge_index[0] = source j,
+edge_index[1] = target i (PyG default flow). Math identical to
+bench.make_torch_reference's Conv, which is weight-transfer-pinned to
+the flax GraphTransformerLayer at 2e-4 (tests/test_model.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+
+
+def global_add_pool(x, batch):
+    num_graphs = int(batch.max().item()) + 1 if batch.numel() else 0
+    out = torch.zeros(num_graphs, x.shape[-1], dtype=x.dtype,
+                      device=x.device)
+    return out.index_add(0, batch, x)
+
+
+class Linear(torch.nn.Module):
+    """PyG's Linear: supports lazy in_channels=-1 (model.py:68)."""
+
+    def __init__(self, in_channels: int, out_channels: int, bias: bool = True):
+        super().__init__()
+        if in_channels == -1:
+            self.lin = torch.nn.LazyLinear(out_channels, bias=bias)
+        else:
+            self.lin = torch.nn.Linear(in_channels, out_channels, bias=bias)
+
+    @property
+    def weight(self):
+        return self.lin.weight
+
+    @property
+    def bias(self):
+        return self.lin.bias
+
+    def forward(self, x):
+        return self.lin(x)
+
+
+class TransformerConv(torch.nn.Module):
+    def __init__(self, in_channels: int, out_channels: int, heads: int = 1,
+                 edge_dim: int | None = None, **kwargs):
+        super().__init__()
+        assert heads == 1, "shim supports the reference's heads=1 only"
+        self.out_channels = out_channels
+        self.lin_query = torch.nn.Linear(in_channels, out_channels)
+        self.lin_key = torch.nn.Linear(in_channels, out_channels)
+        self.lin_value = torch.nn.Linear(in_channels, out_channels)
+        self.lin_edge = (torch.nn.Linear(edge_dim, out_channels, bias=False)
+                         if edge_dim is not None else None)
+        self.lin_skip = torch.nn.Linear(in_channels, out_channels)
+
+    def reset_parameters(self):
+        for m in (self.lin_query, self.lin_key, self.lin_value,
+                  self.lin_edge, self.lin_skip):
+            if m is not None:
+                m.reset_parameters()
+
+    def forward(self, x, edge_index, edge_attr=None):
+        src, dst = edge_index[0], edge_index[1]
+        n = x.shape[0]
+        q = self.lin_query(x)[dst]
+        k = self.lin_key(x)[src]
+        v = self.lin_value(x)[src]
+        if self.lin_edge is not None and edge_attr is not None:
+            e = self.lin_edge(edge_attr)
+            k = k + e
+            v = v + e
+        score = (q * k).sum(-1) / math.sqrt(self.out_channels)
+        smax = torch.full((n,), -torch.inf,
+                          device=x.device).scatter_reduce(
+            0, dst, score, reduce="amax")
+        # smax is only gathered at dst positions that HAVE edges, so it is
+        # always finite there — subtract the true max (softmax is
+        # shift-invariant; clamping at 0 would forfeit stabilization for
+        # all-negative score groups)
+        ex = torch.exp(score - smax[dst])
+        den = torch.zeros(n, device=x.device).index_add(0, dst, ex)
+        alpha = ex / den.clamp_min(1e-16)[dst]
+        out = torch.zeros(n, self.out_channels,
+                          device=x.device).index_add(0, dst,
+                                                     v * alpha[:, None])
+        return out + self.lin_skip(x)

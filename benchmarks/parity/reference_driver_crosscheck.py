@@ -1,0 +1,255 @@
+"""Execute the reference's TRAINING DRIVER (pert_gnn.py) verbatim.
+
+Completes what reference_crosscheck.py starts (VERDICT r3 "missing" #1):
+after the reference's own preprocess.py builds processed/ artifacts in a
+sandbox, this harness runs /root/reference/pert_gnn.py — its lru_cache
+get_x featurizer, mixture assembly, PyG collation, positional split,
+quantile loss and metric denominators — on a minimal torch_geometric
+SHIM (benchmarks/parity/pyg_shim; see its docstring for exactly what
+the shim does and does not independently pin).
+
+Checks:
+1. The driver RUNS end-to-end (both graph types): per-epoch metric
+   lines parse, losses finite, train MAE decreases.
+2. EXACT train-time featurization parity: the x matrix the reference's
+   get_x assembles for every unique (entry, ts_bucket) pair equals our
+   `ResourceLookup` gather on the same mixture, row-matched through the
+   per-runtime canonical (ms, occurrence) labels and the ms bijection
+   (ref ms ints differ from ours — recovered as in
+   reference_crosscheck.py). Pins pert_gnn.py:40-67 (incl. the
+   1=missing indicator convention) against batching/featurize.py.
+3. Magnitude sanity: the reference driver's final train MAE and our
+   fit() under matched hparams (raw labels, lr 3e-4) land within 2x —
+   different init/shuffle streams, so exactness is not expected here.
+
+Run:  python benchmarks/parity/reference_driver_crosscheck.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+REFERENCE = os.environ.get("PERTGNN_REFERENCE_DIR", "/root/reference")
+SHIM = os.path.join(HERE, "pyg_shim")
+sys.path.insert(0, REPO)
+
+from benchmarks.parity.reference_crosscheck import (  # noqa: E402
+    Check, canonical_nodes, make_sandbox, ms_bijection,
+    read_like_reference, run_reference)
+
+
+def run_reference_driver(root: str, graph_type: str,
+                         epochs: int) -> subprocess.CompletedProcess:
+    """pert_gnn.py verbatim under the shim. Wrapper compat (documented,
+    logic untouched): pandas-3 legacy string dtype, and torch.load
+    defaulting back to weights_only=False (torch >= 2.6 flipped the
+    default; the reference predates it)."""
+    wrapper = os.path.join(root, "_run_driver_shim.py")
+    ref_path = os.path.join(REFERENCE, "pert_gnn.py")
+    with open(wrapper, "w") as f:
+        f.write(f"""\
+import functools
+import pandas as pd
+import torch
+pd.set_option('future.infer_string', False)
+torch.load = functools.partial(torch.load, weights_only=False)
+import runpy
+runpy.run_path({ref_path!r}, run_name='__main__')
+""")
+    env = dict(os.environ, PYTHONPATH=f"{SHIM}:{REFERENCE}",
+               PYTHONHASHSEED="0", JAX_PLATFORMS="")
+    # 1200 s per driver run keeps the harness's worst case under the
+    # in-suite wrapper's outer timeout (tests/test_reference_driver_
+    # crosscheck.py), so cleanup always runs in THIS process's finally
+    return subprocess.run(
+        [sys.executable, wrapper, "--graph_type", graph_type,
+         "--epochs", str(epochs), "--batch_size", "32"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=1200)
+
+
+_EPOCH_RE = re.compile(
+    r"Epoch: (\d+), Train: ([\d.eE+-]+|nan), Test mae: ([\d.eE+-]+|nan)")
+
+
+def parse_epochs(stdout: str) -> list[dict]:
+    rows = []
+    for m in _EPOCH_RE.finditer(stdout):
+        rows.append({"epoch": int(m.group(1)),
+                     "train_mae": float(m.group(2)),
+                     "test_mae": float(m.group(3))})
+    return rows
+
+
+def check_featurization(root: str, check: Check, graph_type: str) -> None:
+    """EXACT: reference get_x output (saved in the driver's data list)
+    == our ResourceLookup gather, row-matched per runtime block."""
+    if SHIM not in sys.path:  # unpickling Data needs the shim importable
+        sys.path.insert(0, SHIM)
+    import torch
+
+    from pertgnn_tpu.batching.featurize import ResourceLookup
+    from pertgnn_tpu.batching.mixture import build_mixtures
+    from pertgnn_tpu.config import Config
+    from pertgnn_tpu.graphs.construct import build_runtime_graphs
+    from pertgnn_tpu.ingest.assemble import assemble
+    from pertgnn_tpu.ingest.preprocess import preprocess
+
+    raw_df, raw_res = read_like_reference(root)
+    cfg = Config()
+    pre = preprocess(raw_df, raw_res, cfg.ingest)
+    table = assemble(pre, cfg.ingest)
+    graphs = build_runtime_graphs(pre, table, graph_type)
+    mixtures = build_mixtures(graphs, table.entry2runtimes)
+    lookup = ResourceLookup(pre.resources, missing_indicator_is_one=True)
+
+    ref_df = pd.read_csv(os.path.join(root, "processed",
+                                      "processed_df.csv"), engine="pyarrow")
+    msmap = ms_bijection(check, pre.spans, ref_df)
+
+    data_list = torch.load(
+        os.path.join(root, "processed",
+                     f"full_{graph_type}_data_list.pt"),
+        weights_only=False)
+    meta = table.meta  # same insertion order as tr2data (pinned already)
+    check.ok("data_list_len", len(data_list) == len(meta),
+             f"{len(data_list)} vs {len(meta)}")
+
+    seen_pairs = set()
+    feat_ok = True
+    n_checked = 0
+    for d, (_, row) in zip(data_list, meta.iterrows()):
+        pair = (int(row["entry_id"]), int(row["ts_bucket"]))
+        if pair in seen_pairs:
+            continue
+        seen_pairs.add(pair)
+        mix = mixtures[pair[0]]
+        # feature_mask: the reference's live pert get_x features only the
+        # last stage-copy per ms (our default since this harness found it)
+        my_x = lookup(np.full(mix.num_nodes, pair[1], dtype=np.int64),
+                      mix.ms_id.astype(np.int64),
+                      feature_mask=mix.feature_mask)
+        ref_x = d.x.numpy()
+        ref_ms = d.cat_X[:, 0].numpy()
+        if ref_x.shape != my_x.shape:
+            feat_ok = False
+            continue
+        # row match per runtime block via canonical (ms, occurrence);
+        # blocks follow entry2runtimes order on both sides
+        sizes = [graphs[rid].num_nodes
+                 for rid in table.entry2runtimes[pair[0]][0]]
+        off = 0
+        for size in sizes:
+            ref_rows = canonical_nodes(
+                [msmap[int(m)] for m in ref_ms[off:off + size]])
+            my_rows = canonical_nodes(mix.ms_id[off:off + size])
+            index = {lab: i for i, lab in enumerate(my_rows)}
+            perm = [index.get(lab, -1) for lab in ref_rows]
+            if -1 in perm:
+                feat_ok = False
+                break
+            if not np.array_equal(ref_x[off:off + size],
+                                  my_x[off:off + size][perm]):
+                feat_ok = False
+                break
+            off += size
+        n_checked += 1
+    check.ok(f"{graph_type}_get_x_exact", feat_ok,
+             "reference get_x != ResourceLookup")
+    check.ok(f"{graph_type}_pairs_checked", n_checked > 3, str(n_checked))
+
+
+def my_fit_mae(root: str, graph_type: str, epochs: int) -> float:
+    """Our fit() under the reference driver's hparams (raw labels)."""
+    import dataclasses
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # never dial the axon relay
+
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import Config, DataConfig, TrainConfig
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.train.loop import fit
+
+    raw_df, raw_res = read_like_reference(root)
+    cfg = Config(graph_type=graph_type,
+                 data=DataConfig(batch_size=32),
+                 train=TrainConfig(lr=3e-4, label_scale=1.0, epochs=epochs,
+                                   scan_chunk=4))
+    pre = preprocess(raw_df, raw_res, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+    _, hist = fit(ds, cfg)
+    return float(hist[-1]["train_mae"])
+
+
+def main():
+    pd.set_option("future.infer_string", False)
+    epochs = int(os.environ.get("DRIVER_EPOCHS", "3"))
+    root = tempfile.mkdtemp(prefix="refdriver_")
+    check = Check()
+    fatal = None
+    stats: dict = {}
+    try:
+        make_sandbox(root, traces_per_entry=110)
+        pre = run_reference(root)
+        if pre.returncode != 0:
+            raise RuntimeError(
+                f"reference preprocess failed: {pre.stderr[-1500:]}")
+        for gtype in ("pert", "span"):
+            proc = run_reference_driver(root, gtype, epochs)
+            check.ok(f"{gtype}_driver_runs", proc.returncode == 0,
+                     proc.stderr[-1500:])
+            if proc.returncode != 0:
+                continue
+            rows = parse_epochs(proc.stdout)
+            check.ok(f"{gtype}_epoch_lines", len(rows) == epochs,
+                     f"{len(rows)} of {epochs}")
+            finite = all(np.isfinite(r["train_mae"]) for r in rows)
+            check.ok(f"{gtype}_losses_finite", finite)
+            if rows:
+                check.ok(f"{gtype}_train_decreases",
+                         rows[-1]["train_mae"] < rows[0]["train_mae"],
+                         f"{rows[0]['train_mae']} -> "
+                         f"{rows[-1]['train_mae']}")
+                stats[f"{gtype}_ref_train_mae"] = rows[-1]["train_mae"]
+            check_featurization(root, check, gtype)
+        # Magnitude sanity on pert (same corpus, matched hparams). The
+        # reference's printed "Train" is total_loss/len — the PINBALL
+        # loss, which at tau=0.5 is MAE/2 (the "train mae = qloss" quirk,
+        # SURVEY.md §2.1); our train_mae is a true MAE, so the expected
+        # ratio is ~2, not ~1. Observing it tightly around 2 is itself
+        # evidence both stacks compute the same loss.
+        if "pert_ref_train_mae" in stats:
+            ours = my_fit_mae(root, "pert", epochs)
+            stats["pert_our_train_mae"] = round(ours, 1)
+            ratio = ours / max(2.0 * stats["pert_ref_train_mae"], 1e-9)
+            stats["pert_mae_over_twice_ref_pinball"] = round(ratio, 3)
+            check.ok("pert_magnitude_sane", 0.7 < ratio < 1.4,
+                     f"ratio {ratio}")
+    except Exception as e:  # noqa: BLE001 — verdict over traceback
+        import traceback
+        fatal = f"{type(e).__name__}: {e}"
+        traceback.print_exc(file=sys.stderr)
+    finally:
+        ok = check.all_ok and fatal is None and bool(check.results)
+        verdict = {"pass": ok, "checks": check.results,
+                   "notes": check.notes, **stats}
+        if fatal:
+            verdict["fatal"] = fatal
+        print(json.dumps(verdict, indent=1))
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

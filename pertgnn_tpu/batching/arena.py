@@ -59,6 +59,7 @@ class MixtureArena:
     node_depth: np.ndarray    # (total_nodes+1,) float32
     pattern_prob: np.ndarray  # (total_nodes+1,) float32
     pattern_size: np.ndarray  # (total_nodes+1,) float32
+    feature_mask: np.ndarray  # (total_nodes+1,) bool — featurize gate
     senders: np.ndarray       # (total_edges+1,) int32 — entry-local
     receivers: np.ndarray     # (total_edges+1,) int32 — entry-local
     edge_iface: np.ndarray    # (total_edges+1,) int32
@@ -112,6 +113,7 @@ def build_mixture_arena(mixtures: dict[int, Mixture]) -> MixtureArena:
         ms_id=cat_n("ms_id", 0), node_depth=cat_n("node_depth", 0.0),
         pattern_prob=cat_n("pattern_prob", 0.0),
         pattern_size=cat_n("pattern_size", 1.0),
+        feature_mask=cat_n("feature_mask", False),
         senders=cat_e("senders", 0), receivers=cat_e("receivers", 0),
         edge_iface=cat_e("edge_iface", 0),
         edge_rpctype=cat_e("edge_rpctype", 0),
@@ -157,7 +159,7 @@ def build_feature_arena(arena: MixtureArena, entry_ids: np.ndarray,
     src = np.repeat(arena.node_start[u_entry], counts) + ragged
     ms = arena.ms_id[src].astype(np.int64)
     buckets = np.repeat(u_bucket, counts)
-    x = lookup(buckets, ms)
+    x = lookup(buckets, ms, feature_mask=arena.feature_mask[src])
     if node_depth_in_x:
         x = np.concatenate([x, arena.node_depth[src][:, None]], axis=1)
     x = np.concatenate([x, np.zeros((1, x.shape[1]), np.float32)])

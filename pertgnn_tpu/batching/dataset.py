@@ -223,7 +223,9 @@ def build_dataset(pre: PreprocessResult, cfg: Config,
     if table is None:
         table = assemble(pre, cfg.ingest)
     graphs = build_runtime_graphs(pre, table, cfg.graph_type)
-    mixtures = build_mixtures(graphs, table.entry2runtimes)
+    mixtures = build_mixtures(
+        graphs, table.entry2runtimes,
+        feature_all_stage_copies=cfg.model.feature_all_stage_copies)
     lookup = ResourceLookup(
         pre.resources,
         missing_indicator_is_one=cfg.model.missing_indicator_is_one)

@@ -48,15 +48,26 @@ class ResourceLookup:
     def _key(ts: np.ndarray, ms: np.ndarray) -> np.ndarray:
         return ts.astype(np.int64) * np.int64(1 << 22) + ms.astype(np.int64)
 
-    def __call__(self, ts_bucket: np.ndarray, ms_id: np.ndarray) -> np.ndarray:
+    def __call__(self, ts_bucket: np.ndarray, ms_id: np.ndarray,
+                 feature_mask: np.ndarray | None = None) -> np.ndarray:
         """Features for parallel arrays of buckets and microservice ids.
 
         Returns (len(ms_id), 9) float32: 8 resource features (0 where
         missing) + indicator column.
+
+        `feature_mask`: nodes where it is False are treated as missing
+        regardless of the table — the reference's live pert behavior
+        feeds features only to the LAST stage-copy of each microservice
+        (pert_gnn.py:56: `ms2nid` is a dict comprehension over the
+        duplicated stage list, so later copies overwrite earlier ones
+        and only the last index is ever assigned; discovered by
+        benchmarks/parity/reference_driver_crosscheck.py, PARITY.md).
         """
         keys = self._key(np.asarray(ts_bucket), np.asarray(ms_id))
         locs = self._index.get_indexer(keys)
         present = locs >= 0
+        if feature_mask is not None:
+            present = present & np.asarray(feature_mask, dtype=bool)
         x = np.zeros((len(keys), NUM_RESOURCE_FEATURES + 1), dtype=np.float32)
         x[present, :-1] = self._values[locs[present]]
         if self.missing_indicator_is_one:

@@ -34,13 +34,29 @@ class Mixture:
     node_depth: np.ndarray     # (N,) float32
     pattern_prob: np.ndarray   # (N,) float32 — this node's pattern's weight
     pattern_size: np.ndarray   # (N,) float32 — this node's pattern's #nodes
+    # (N,) bool — node receives resource features. The reference's live
+    # get_x assigns features only to the LAST stage-copy of each
+    # microservice within a graph (pert_gnn.py:56 dict-comprehension
+    # overwrite; PARITY.md "Oracle independence"); span graphs have
+    # unique ms per node, so there it is all-True either way.
+    feature_mask: np.ndarray
     num_nodes: int
     num_edges: int
+
+
+def _last_occurrence_mask(ms_id: np.ndarray) -> np.ndarray:
+    """True at the LAST occurrence of each value (the reference's live
+    get_x feature-assignment rule, pert_gnn.py:53-66)."""
+    mask = np.zeros(len(ms_id), dtype=bool)
+    last = list({int(v): i for i, v in enumerate(ms_id)}.values())
+    mask[last] = True
+    return mask
 
 
 def build_mixtures(
     runtime_graphs: dict[int, GraphSpec],
     entry2runtimes: dict[int, tuple[np.ndarray, np.ndarray]],
+    feature_all_stage_copies: bool = False,
 ) -> dict[int, Mixture]:
     out: dict[int, Mixture] = {}
     for entry_id, (rt_ids, probs) in entry2runtimes.items():
@@ -56,6 +72,11 @@ def build_mixtures(
             [g.edge_durations if g.edge_durations is not None
              else np.zeros(g.num_edges, np.float32) for g in graphs])
         ms_id = np.concatenate([g.ms_id for g in graphs])
+        if feature_all_stage_copies:
+            feature_mask = np.ones(len(ms_id), dtype=bool)
+        else:
+            feature_mask = np.concatenate(
+                [_last_occurrence_mask(g.ms_id) for g in graphs])
         node_depth = np.concatenate([g.node_depth for g in graphs])
         pattern_prob = np.repeat(probs.astype(np.float32), sizes)
         pattern_size = np.repeat(sizes.astype(np.float32), sizes)
@@ -70,6 +91,7 @@ def build_mixtures(
             node_depth=node_depth.astype(np.float32),
             pattern_prob=pattern_prob,
             pattern_size=pattern_size,
+            feature_mask=feature_mask,
             num_nodes=int(sizes.sum()),
             num_edges=len(senders),
         )

@@ -187,7 +187,8 @@ def pack_examples(
         ns = slice(n, n + mix.num_nodes)
         es = slice(e, e + mix.num_edges)
         feats = lookup(np.full(mix.num_nodes, bucket, dtype=np.int64),
-                       mix.ms_id.astype(np.int64))
+                       mix.ms_id.astype(np.int64),
+                       feature_mask=mix.feature_mask)
         if node_depth_in_x:
             feats = np.concatenate([feats, mix.node_depth[:, None]], axis=1)
         buf["x"][ns] = feats

@@ -74,6 +74,10 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--arena_hbm_budget_gb", type=float, default=4.0,
                    help="HBM budget for chip-resident arenas; exceeding it "
                         "falls back to host packing; <=0 = unlimited")
+    p.add_argument("--feature_all_stage_copies", action="store_true",
+                   help="feature every PERT stage-copy of a microservice "
+                        "(the reference's live get_x features only the "
+                        "last copy — PARITY.md)")
     p.add_argument("--no_stage_epoch_recipes", action="store_true",
                    help="disable epoch-level recipe staging (one H2D per "
                         "epoch); fall back to per-chunk recipe transfer")
@@ -136,6 +140,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             nonnegative_pred=args.nonnegative_pred,
             local_loss_weight=args.local_loss_weight,
             missing_indicator_is_one=not args.missing_indicator_is_zero,
+            feature_all_stage_copies=args.feature_all_stage_copies,
             use_pallas_attention=args.use_pallas_attention,
             bf16_activations=args.bf16),
         train=TrainConfig(

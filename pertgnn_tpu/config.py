@@ -100,6 +100,16 @@ class ModelConfig:
     # Weight of the per-node local head in the loss (reference computes
     # local_pred but never trains on it, pert_gnn.py:245).
     local_loss_weight: float = 0.0
+    # Resource features on EVERY stage-copy of a microservice in a PERT
+    # graph. The reference's live get_x assigns features only to the LAST
+    # stage-copy (pert_gnn.py:56: ms2nid dict comprehension over the
+    # duplicated stage list — later copies overwrite earlier ones), leaving
+    # the other copies zeros + missing indicator; discovered by executing
+    # the reference's own driver (benchmarks/parity/
+    # reference_driver_crosscheck.py). False (default) = reference-faithful;
+    # True = feature all copies (strictly more information). No-op for span
+    # graphs (one node per ms).
+    feature_all_stage_copies: bool = False
     # Missing-feature indicator convention. The reference has TWO conventions:
     # train-time get_x uses 1=missing (pert_gnn.py:50,62-66) — that is what
     # the model actually sees; preprocess-time uses 1=present (misc.py:153) —

@@ -57,6 +57,45 @@ class TestMixtures:
             blk_r = np.searchsorted(bounds, m.receivers, side="right") - 1
             assert (blk_s == blk_r).all()
 
+    def test_pert_feature_mask_last_stage_copy_only(self, preprocessed):
+        """The reference's live get_x features only the LAST stage-copy of
+        each microservice in a PERT graph (pert_gnn.py:56 dict-comp
+        overwrite — found by executing the reference's own driver,
+        benchmarks/parity/reference_driver_crosscheck.py). Default must
+        match; `feature_all_stage_copies=True` restores full features."""
+        table = assemble(preprocessed)
+        graphs = build_runtime_graphs(preprocessed, table, "pert")
+        mixes = build_mixtures(graphs, table.entry2runtimes)
+        saw_within_graph_duplicate = False
+        for entry, (rt_ids, _) in table.entry2runtimes.items():
+            m = mixes[entry]
+            assert m.feature_mask.dtype == bool
+            off = 0
+            # decompose into per-graph blocks: the rule is per GRAPH
+            for rid in rt_ids:
+                size = graphs[int(rid)].num_nodes
+                block_ms = m.ms_id[off:off + size]
+                block_mask = m.feature_mask[off:off + size]
+                # the exact reference rule: True iff last occurrence of
+                # this ms WITHIN the graph (pert_gnn.py:56)
+                expected = np.zeros(size, dtype=bool)
+                expected[[int(np.where(block_ms == v)[0][-1])
+                          for v in np.unique(block_ms)]] = True
+                np.testing.assert_array_equal(block_mask, expected)
+                if len(np.unique(block_ms)) < size:
+                    saw_within_graph_duplicate = True
+                off += size
+        assert saw_within_graph_duplicate, \
+            "corpus must exercise within-graph stage duplication"
+        # all-copies flag restores full featurization
+        full = build_mixtures(graphs, table.entry2runtimes,
+                              feature_all_stage_copies=True)
+        assert all(mm.feature_mask.all() for mm in full.values())
+        # span graphs have unique ms per node -> mask all-True by default
+        sgraphs = build_runtime_graphs(preprocessed, table, "span")
+        smixes = build_mixtures(sgraphs, table.entry2runtimes)
+        assert all(mm.feature_mask.all() for mm in smixes.values())
+
     def test_per_node_prob_weighting_sums_to_one(self, mixtures):
         """sum over nodes of prob/size == sum over patterns of prob == 1 —
         the invariant behind the model's prob-weighted pooling
