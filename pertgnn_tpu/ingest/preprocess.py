@@ -43,11 +43,24 @@ def factorize_columns(df: pd.DataFrame, cols: Sequence[str]):
     columns and factorized together, so the same value in different columns
     gets the same code. Returns (df, uniques) with codes ordered by first
     appearance (pandas factorize semantics).
+
+    Memory: every live call site passes ONE column, where stacking is a
+    pointless row-count-sized double copy — the fast path factorizes the
+    column directly (identical first-appearance codes; pinned against the
+    reference's own preprocess by tests/test_reference_crosscheck.py).
+    Frames are shallow-copied: pandas-3 copy-on-write makes the column
+    assignment safe without materializing the other columns (measured on
+    the 2.66 GB tree: benchmarks/ingest_scale_r4.py, RESULTS.md).
     """
-    stacked = df[list(cols)].stack()
+    cols = list(cols)
+    out = df.copy(deep=False)
+    if len(cols) == 1:
+        codes, uniques = pd.factorize(df[cols[0]])
+        out[cols[0]] = codes
+        return out, uniques
+    stacked = df[cols].stack()
     codes, uniques = stacked.factorize()
     recoded = pd.Series(codes, index=stacked.index).unstack()
-    out = df.copy()
     for c in cols:
         out[c] = recoded[c]
     return out, uniques
@@ -91,7 +104,9 @@ def detect_entries(df: pd.DataFrame, cfg: IngestConfig = IngestConfig()):
     entry_str = entries["dm"].astype(str) + "_" + entries["interface"].astype(str)
     tr2entry = pd.Series(entry_str.values, index=entries["traceid"].values)
 
-    out = df[df["traceid"].isin(tr2entry.index)].copy()
+    # row filtering already yields a fresh frame under pandas-3 CoW; an
+    # explicit deep copy here would double the surviving rows' footprint
+    out = df[df["traceid"].isin(tr2entry.index)]
     out["entryid"] = out["traceid"].map(tr2entry)
     stats = {
         "num_traces": len(all_traces),
