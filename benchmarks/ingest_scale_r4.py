@@ -78,7 +78,8 @@ def build_tree(out_dir: str, target_gb: float, seed: int = 11) -> dict:
             "span_rows_per_tile": len(base.spans)}
 
 
-def run_cli(data_dir: str, artifact_dir: str) -> dict:
+def run_cli(data_dir: str, artifact_dir: str,
+            stream: bool = False) -> dict:
     """Run the preprocess CLI in a child process, sampling VmHWM."""
     import threading
 
@@ -88,7 +89,8 @@ def run_cli(data_dir: str, artifact_dir: str) -> dict:
     proc = subprocess.Popen(
         [sys.executable, "-m", "pertgnn_tpu.cli.preprocess_main",
          "--data_dir", data_dir, "--artifact_dir", artifact_dir,
-         "--min_traces_per_entry", "100"],
+         "--min_traces_per_entry", "100"]
+        + (["--stream_factorize"] if stream else []),
         cwd=repo, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True)
 
@@ -124,6 +126,9 @@ def main():
     ap.add_argument("--gb", type=float, default=2.5)
     ap.add_argument("--keep-tree", default=None,
                     help="build/keep the tree here instead of a temp dir")
+    ap.add_argument("--stream", action="store_true",
+                    help="measure the --stream_factorize loader instead "
+                         "of the exact path")
     args = ap.parse_args()
     root = args.keep_tree or tempfile.mkdtemp(prefix="ingest_scale_",
                                               dir="/tmp")
@@ -134,10 +139,12 @@ def main():
         t0 = time.perf_counter()
         tree = build_tree(data_dir, args.gb)
         build_s = time.perf_counter() - t0
-        r = run_cli(data_dir, art_dir)
+        r = run_cli(data_dir, art_dir, stream=args.stream)
         ok = r["rc"] == 0
         result = {
-            "metric": "ingest_scale_peak_rss_over_raw",
+            "metric": ("ingest_scale_peak_rss_over_raw_stream"
+                       if args.stream else
+                       "ingest_scale_peak_rss_over_raw"),
             "value": (round(r["peak_rss_bytes"] / tree["raw_bytes"], 2)
                       if ok else None),
             "unit": "peak RSS / raw CSV bytes (lower is better)",

@@ -108,6 +108,11 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
 def add_ingest_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--min_traces_per_entry", type=int, default=100)
     p.add_argument("--min_resource_coverage", type=float, default=0.6)
+    p.add_argument("--stream_factorize", action="store_true",
+                   help="200GB-scale loader: factorize strings per shard "
+                        "against incremental vocabularies so RAM holds "
+                        "only numeric columns; ids are isomorphic (not "
+                        "equal) to the exact path's (ingest/io.py)")
     p.add_argument("--synthetic", action="store_true",
                    help="use the synthetic generator instead of raw CSVs")
     p.add_argument("--synthetic_entries", type=int, default=8)

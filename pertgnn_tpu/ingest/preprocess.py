@@ -140,6 +140,36 @@ def filter_by_resource_coverage(df: pd.DataFrame, resource_df: pd.DataFrame,
     Reference: /root/reference/preprocess.py:155-177 (threshold 0.6,
     comparison is `>=`, preprocess.py:170).
     """
+    def _packable(col, bound):
+        # the packed-key fast path needs ms codes in [0, 2^32) and trace
+        # codes in [0, 2^31) (the >> 32 unpack is an arithmetic shift):
+        # true for StreamVocab/factorize codes, NOT for arbitrary native
+        # int ids (64-bit hashes, negatives) — those take the general path
+        a = df[col].to_numpy()
+        return (pd.api.types.is_integer_dtype(df[col]) and len(a) > 0
+                and int(a.min()) >= 0 and int(a.max()) < bound)
+
+    if (_packable("um", 2**32) and _packable("dm", 2**32)
+            and _packable("traceid", 2**31)):
+        # Numeric fast path (the --stream_factorize loader): distinct
+        # (trace, ms) pairs via ONE packed-int64 np.unique instead of a
+        # 2x-row pandas concat + drop_duplicates — the concat was the
+        # measured peak-RSS phase of the whole pipeline (RESULTS.md
+        # round-4 scale proof; ms codes < 2^32 by construction).
+        t = df["traceid"].to_numpy(np.int64)
+        key = np.concatenate([
+            (t << 32) | df["um"].to_numpy(np.int64),
+            (t << 32) | df["dm"].to_numpy(np.int64)])
+        pairs = np.unique(key)
+        tr = pairs >> 32
+        ms = pairs & np.int64(0xFFFFFFFF)
+        covered = np.isin(
+            ms, np.unique(resource_df["msname"].to_numpy(np.int64)))
+        uniq_tr, start = np.unique(tr, return_index=True)
+        n_pairs = np.diff(np.concatenate([start, [len(tr)]]))
+        n_cov = np.add.reduceat(covered.astype(np.int64), start)
+        keep_tr = uniq_tr[n_cov / n_pairs >= cfg.min_resource_coverage]
+        return df[np.isin(t, keep_tr)]
     ms_with_res = set(resource_df["msname"].values)
     long = pd.concat([
         df[["traceid", "um"]].rename(columns={"um": "ms"}),

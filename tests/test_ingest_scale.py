@@ -32,3 +32,70 @@ def test_ingest_scale_harness_small(tmp_path):
     # artifacts landed (idempotent-cache layout)
     art = tmp_path / "tree" / "processed"
     assert (art / "trace_meta.parquet").exists()
+
+
+def test_streaming_isomorphic(tmp_path):
+    """The 200GB-scale streaming loader (per-shard factorization,
+    numeric-only RAM) must produce a pipeline output ISOMORPHIC to the
+    exact path's: same per-raw-trace (y, ts_bucket), the same partition
+    of traces into entries and into runtime patterns, and the same
+    mixture probabilities — only the opaque id labels may differ."""
+    import numpy as np
+
+    from pertgnn_tpu.config import Config, IngestConfig
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.assemble import assemble
+    from pertgnn_tpu.ingest.io import (load_raw_csvs,
+                                       load_raw_csvs_streaming)
+    from pertgnn_tpu.ingest.preprocess import preprocess
+
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_entries=4, traces_per_entry=40, seed=9))
+    synthetic.write_csvs(data, str(tmp_path / "data"), shards=3)
+    cfg = Config(ingest=IngestConfig(min_traces_per_entry=10))
+
+    spans_e, res_e = load_raw_csvs(str(tmp_path / "data"))
+    pre_e = preprocess(spans_e, res_e, cfg.ingest)
+    tab_e = assemble(pre_e, cfg.ingest)
+
+    spans_s, res_s, cfg_s, vocabs = load_raw_csvs_streaming(
+        str(tmp_path / "data"), cfg.ingest)
+    assert spans_s.select_dtypes(include=object).empty  # numeric-only
+    pre_s = preprocess(spans_s, res_s, cfg_s)
+    tab_s = assemble(pre_s, cfg_s)
+
+    def by_raw_trace(pre, tab, raw_of_code):
+        out = {}
+        for _, row in tab.meta.iterrows():
+            raw = raw_of_code(int(row["traceid"]))
+            out[raw] = (np.float32(row["y"]), int(row["ts_bucket"]),
+                        int(row["entry_id"]), int(row["runtime_id"]))
+        return out
+
+    e_map = by_raw_trace(pre_e, tab_e,
+                         lambda c: str(pre_e.traceid_vocab[c]))
+    s_map = by_raw_trace(pre_s, tab_s,
+                         lambda c: str(vocabs["traceid"].items[
+                             int(pre_s.traceid_vocab[c])]))
+    assert set(e_map) == set(s_map)
+    part_entry_e, part_entry_s = {}, {}
+    part_rt_e, part_rt_s = {}, {}
+    for raw in e_map:
+        ye, be, ee, re_ = e_map[raw]
+        ys, bs, es, rs = s_map[raw]
+        assert ye == ys and be == bs, raw   # identical labels/buckets
+        part_entry_e.setdefault(ee, set()).add(raw)
+        part_entry_s.setdefault(es, set()).add(raw)
+        part_rt_e.setdefault(re_, set()).add(raw)
+        part_rt_s.setdefault(rs, set()).add(raw)
+    # same PARTITIONS (labels may permute)
+    assert (sorted(map(frozenset, part_entry_e.values()))
+            == sorted(map(frozenset, part_entry_s.values())))
+    assert (sorted(map(frozenset, part_rt_e.values()))
+            == sorted(map(frozenset, part_rt_s.values())))
+    # mixture probabilities: same multiset of sorted prob vectors
+    probs_e = sorted(tuple(np.round(np.sort(p), 12))
+                     for _, p in tab_e.entry2runtimes.values())
+    probs_s = sorted(tuple(np.round(np.sort(p), 12))
+                     for _, p in tab_s.entry2runtimes.values())
+    assert probs_e == probs_s
