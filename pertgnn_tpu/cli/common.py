@@ -176,3 +176,21 @@ def get_frames(args: argparse.Namespace):
         return data.spans, data.resources
     from pertgnn_tpu.ingest.io import load_raw_csvs
     return load_raw_csvs(args.data_dir)
+
+
+def get_frames_with_ingest_cfg(args: argparse.Namespace, ingest_cfg):
+    """(spans, resources, ingest_cfg, stream_vocabs|None) honoring
+    --stream_factorize — shared by BOTH CLIs so the flag cannot be
+    silently ignored. Streaming translates the config's special tokens
+    to codes; the returned vocabs must be persisted next to any artifact
+    cache (io.save_stream_vocabs) or the ids are unrecoverable."""
+    if getattr(args, "stream_factorize", False):
+        if args.synthetic:
+            raise SystemExit(
+                "--stream_factorize reads on-disk shards; it cannot "
+                "combine with --synthetic (write the synthetic corpus to "
+                "CSVs and pass --data_dir instead)")
+        from pertgnn_tpu.ingest.io import load_raw_csvs_streaming
+        return load_raw_csvs_streaming(args.data_dir, ingest_cfg)
+    spans, resources = get_frames(args)
+    return spans, resources, ingest_cfg, None

@@ -27,26 +27,11 @@ def main(argv=None) -> None:
     if artifacts_present(args.artifact_dir):
         print(f"artifact cache complete at {args.artifact_dir}; nothing to do")
         return
-    if getattr(args, "stream_factorize", False):
-        if args.synthetic:
-            p.error("--stream_factorize reads on-disk shards; it cannot "
-                    "combine with --synthetic (write the synthetic corpus "
-                    "to CSVs and pass --data_dir instead)")
-        import numpy as np
-
-        from pertgnn_tpu.ingest.io import load_raw_csvs_streaming
-        spans, resources, cfg, vocabs = load_raw_csvs_streaming(
-            args.data_dir, cfg)
-        # persist code -> raw-string recovery next to the artifacts —
-        # without it the cached ids are permanently unmappable back to
-        # the real dataset identifiers
-        import os
-        os.makedirs(args.artifact_dir, exist_ok=True)
-        np.savez(os.path.join(args.artifact_dir, "stream_vocabs.npz"),
-                 **{name: np.asarray(v.items, dtype=object)
-                    for name, v in vocabs.items()})
-    else:
-        spans, resources = get_frames(args)
+    from pertgnn_tpu.cli.common import get_frames_with_ingest_cfg
+    from pertgnn_tpu.ingest.io import save_stream_vocabs
+    spans, resources, cfg, vocabs = get_frames_with_ingest_cfg(args, cfg)
+    if vocabs is not None:
+        save_stream_vocabs(args.artifact_dir, vocabs)
     pre, table = preprocess_cached(args.artifact_dir, spans, resources,
                                    cfg=cfg)
     print(f"preprocessed: {pre.stats}")

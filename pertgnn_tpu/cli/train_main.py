@@ -40,9 +40,14 @@ def main(argv=None) -> None:
     if artifacts_present(args.artifact_dir):
         pre, table = load_artifacts(args.artifact_dir)
     else:
-        spans, resources = get_frames(args)
+        from pertgnn_tpu.cli.common import get_frames_with_ingest_cfg
+        from pertgnn_tpu.ingest.io import save_stream_vocabs
+        spans, resources, ingest_cfg, vocabs = get_frames_with_ingest_cfg(
+            args, cfg.ingest)
+        if vocabs is not None:
+            save_stream_vocabs(args.artifact_dir, vocabs)
         pre, table = preprocess_cached(args.artifact_dir, spans, resources,
-                                       cfg=cfg.ingest)
+                                       cfg=ingest_cfg)
     dataset = build_dataset(pre, cfg, table)
 
     mesh = None

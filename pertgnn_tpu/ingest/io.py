@@ -61,11 +61,7 @@ def _read_shard(path: str, columns) -> pd.DataFrame:
     return df
 
 
-def load_raw_csvs(data_dir: str) -> tuple[pd.DataFrame, pd.DataFrame]:
-    """Concatenate the sharded raw CSVs (reference: preprocess.py:203-236).
-
-    Shards are read, pruned, and de-duplicated ONE AT A TIME so peak memory
-    tracks the pruned concatenation, never the raw tree."""
+def _raw_dirs(data_dir: str) -> tuple[str, str]:
     cg_dir = os.path.join(data_dir, "MSCallGraph")
     rs_dir = os.path.join(data_dir, "MSResource")
     for d in (cg_dir, rs_dir):
@@ -73,20 +69,36 @@ def load_raw_csvs(data_dir: str) -> tuple[pd.DataFrame, pd.DataFrame]:
             raise FileNotFoundError(
                 f"expected raw layout <data_dir>/MSCallGraph and "
                 f"<data_dir>/MSResource; missing {d}")
+    return cg_dir, rs_dir
+
+
+def iter_shards(root: str, columns, dedupe: bool):
+    """Yield (filename, pruned shard frame) for every CSV shard — the ONE
+    shard-walk both loaders share (discovery order, schema hardening,
+    per-shard dedupe, missing-shard error)."""
+    files = [f for f in sorted(os.listdir(root)) if f.endswith(".csv")]
+    if not files:
+        raise FileNotFoundError(f"no .csv shards under {root}")
+    for f in files:
+        shard = _read_shard(os.path.join(root, f), columns)
+        if dedupe:
+            shard = shard.drop_duplicates()
+        yield f, shard
+
+
+def load_raw_csvs(data_dir: str) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """Concatenate the sharded raw CSVs (reference: preprocess.py:203-236).
+
+    Shards are read, pruned, and de-duplicated ONE AT A TIME so peak memory
+    tracks the pruned concatenation, never the raw tree."""
+    cg_dir, rs_dir = _raw_dirs(data_dir)
 
     def read_tree(root, columns, dedupe):
         parts = []
-        files = [f for f in sorted(os.listdir(root)) if f.endswith(".csv")]
-        for f in files:
-            shard = _read_shard(os.path.join(root, f), columns)
-            n_raw = len(shard)
-            if dedupe:
-                shard = shard.drop_duplicates()
-            log.info("read %s: %d rows (%d kept), engine=%s",
-                     f, n_raw, len(shard), _CSV_ENGINE)
+        for f, shard in iter_shards(root, columns, dedupe):
+            log.info("read %s: %d rows kept, engine=%s",
+                     f, len(shard), _CSV_ENGINE)
             parts.append(shard)
-        if not parts:
-            raise FileNotFoundError(f"no .csv shards under {root}")
         return pd.concat(parts, ignore_index=True)
 
     # Spans: shard-level dedupe is safe (preprocess() dedupes the whole
@@ -164,13 +176,7 @@ def load_raw_csvs_streaming(data_dir: str, cfg: IngestConfig
     Returns (spans, resources, translated_cfg, vocabs) where `vocabs`
     maps column -> StreamVocab (code -> raw string recovery).
     """
-    cg_dir = os.path.join(data_dir, "MSCallGraph")
-    rs_dir = os.path.join(data_dir, "MSResource")
-    for d in (cg_dir, rs_dir):
-        if not os.path.isdir(d):
-            raise FileNotFoundError(
-                f"expected raw layout <data_dir>/MSCallGraph and "
-                f"<data_dir>/MSResource; missing {d}")
+    cg_dir, rs_dir = _raw_dirs(data_dir)
     ms_vocab = StreamVocab()  # shared: um, dm, msname
     vocabs = {"traceid": StreamVocab(), "rpcid": StreamVocab(),
               "rpctype": StreamVocab(), "interface": StreamVocab(),
@@ -187,14 +193,7 @@ def load_raw_csvs_streaming(data_dir: str, cfg: IngestConfig
     # concat double buffer), which dominated the measured peak before.
     def encode_tree(root, columns, colmap, dedupe):
         cols: dict[str, list] = {c: [] for c in columns}
-        files = [f for f in sorted(os.listdir(root))
-                 if f.endswith(".csv")]
-        if not files:
-            raise FileNotFoundError(f"no .csv shards under {root}")
-        for f in files:
-            shard = _read_shard(os.path.join(root, f), columns)
-            if dedupe:
-                shard = shard.drop_duplicates()
+        for f, shard in iter_shards(root, columns, dedupe):
             for c in columns:
                 if c in colmap:
                     cols[c].append(
@@ -222,6 +221,15 @@ def load_raw_csvs_streaming(data_dir: str, cfg: IngestConfig
              "%d microservices", len(spans), len(resources),
              len(ms_vocab.items))
     return spans, resources, translated, vocabs
+
+
+def save_stream_vocabs(out_dir: str, vocabs: dict) -> None:
+    """Persist streaming code -> raw-string recovery next to the artifact
+    cache (np.load(..., allow_pickle=True) to read back)."""
+    os.makedirs(out_dir, exist_ok=True)
+    np.savez(os.path.join(out_dir, "stream_vocabs.npz"),
+             **{name: np.asarray(v.items, dtype=object)
+                for name, v in vocabs.items()})
 
 
 def save_artifacts(out_dir: str, pre: PreprocessResult,
