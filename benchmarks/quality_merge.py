@@ -1,0 +1,95 @@
+"""Merge seed-sharded quality_parity rows into one 24-seed verdict.
+
+    python benchmarks/quality_merge.py shard1.jsonl shard2.jsonl ... \
+        [--out merged.json]
+
+Each input line is a `quality_parity` row produced with QUALITY_SEEDS /
+QUALITY_SEED_OFFSET / QUALITY_GRAPH_TYPES (benchmarks/run.py). Per-seed
+arrays are concatenated per graph type and the cross-shard statistics —
+mean ± 95% CI per arm, bootstrap 95% CI of the train-fit ratio-of-means,
+and the pre-registered equivalence verdict (CI ⊂ [0.93, 1.07], VERDICT
+r4 #3) — are recomputed from scratch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from run import _mean_ci95, _ratio_ci95  # noqa: E402
+
+
+def merge(rows: list[dict]) -> dict:
+    parity_rows = [r for r in rows
+                   if r.get("metric") == "quality_parity_test_mae_ratio"
+                   and "failed" not in r and "skipped" not in r]
+    if not parity_rows:
+        raise SystemExit("no successful quality_parity rows in inputs")
+    epochs = {r["epochs"] for r in parity_rows}
+    if len(epochs) != 1:
+        raise SystemExit(f"refusing to merge mixed epoch counts: {epochs}")
+    # overlapping seed ranges would double-count seeds and fabricate CI
+    # precision — refuse (a row without seed_offset predates sharding and
+    # is treated as offset 0)
+    ranges = sorted((r.get("seed_offset", 0),
+                     r.get("seed_offset", 0) + r["seeds_per_side"])
+                    for r in parity_rows)
+    for (a0, a1), (b0, b1) in zip(ranges, ranges[1:]):
+        if b0 < a1:
+            raise SystemExit(
+                f"overlapping shard seed ranges [{a0},{a1}) and [{b0},{b1})"
+                f" — same seeds would be double-counted")
+    out = {"metric": "quality_parity_merged", "epochs": epochs.pop(),
+           "shards": len(parity_rows),
+           "commits": sorted({r.get("commit") or "?" for r in parity_rows})}
+    for gtype in ("pert", "span"):
+        shards = [r[gtype] for r in parity_rows if gtype in r]
+        if not shards:
+            continue
+        g = {}
+        for key in ("test_ours_per_seed", "test_torch_per_seed",
+                    "trainfit_ours_per_seed", "trainfit_torch_per_seed"):
+            g[key] = [v for s in shards for v in s[key]]
+        n = len(g["trainfit_ours_per_seed"])
+        for arm in ("test_ours", "test_torch", "trainfit_ours",
+                    "trainfit_torch"):
+            mean, ci = _mean_ci95(g[f"{arm}_per_seed"])
+            g[f"{arm}_mean_mae"] = round(mean, 1)
+            g[f"{arm}_ci95"] = round(ci, 1)
+        g["seeds_per_side"] = n
+        g["test_ratio_of_means"] = round(
+            g["test_ours_mean_mae"] / max(g["test_torch_mean_mae"], 1e-9), 3)
+        g["trainfit_ratio_of_means"] = round(
+            g["trainfit_ours_mean_mae"]
+            / max(g["trainfit_torch_mean_mae"], 1e-9), 3)
+        lo, hi = _ratio_ci95(g["trainfit_ours_per_seed"],
+                             g["trainfit_torch_per_seed"])
+        g["trainfit_ratio_ci95"] = [round(lo, 3), round(hi, 3)]
+        g["trainfit_equivalent_0.93_1.07"] = bool(lo >= 0.93 and hi <= 1.07)
+        g["trainfit_noninferior_1.07"] = bool(hi <= 1.07)
+        out[gtype] = g
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("inputs", nargs="+")
+    p.add_argument("--out", default="")
+    args = p.parse_args(argv)
+    rows = []
+    for path in args.inputs:
+        with open(path) as f:
+            rows.extend(json.loads(line) for line in f if line.strip())
+    merged = merge(rows)
+    print(json.dumps(merged))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(merged, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

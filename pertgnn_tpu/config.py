@@ -109,6 +109,10 @@ class ModelConfig:
     # reference_driver_crosscheck.py). False (default) = reference-faithful;
     # True = feature all copies (strictly more information). No-op for span
     # graphs (one node per ms).
+    # COMPAT (ADVICE r4): the default flipped True -> False in round 4;
+    # pert checkpoints trained before that commit saw all-copies features
+    # and should be re-trained, or loaded with
+    # --feature_all_stage_copies for input-compatible inference.
     feature_all_stage_copies: bool = False
     # Missing-feature indicator convention. The reference has TWO conventions:
     # train-time get_x uses 1=missing (pert_gnn.py:50,62-66) — that is what
@@ -190,6 +194,11 @@ class TrainConfig:
     # staging with the epoch axis replicated); multi-host keeps per-chunk
     # assembly because each host owns only its slab.
     stage_epoch_recipes: bool = True
+    # Cap (MiB) on the host bytes staged per epoch by stage_epoch_recipes;
+    # past it fit() falls back to per-chunk transfers so staging can never
+    # blow HBM outside the arena budget accounting (ADVICE r4). Recipes
+    # are O(graphs) int32s, so the default never binds in practice.
+    stage_recipes_max_mb: float = 256.0
 
 
 @dataclasses.dataclass(frozen=True)

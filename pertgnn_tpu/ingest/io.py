@@ -46,7 +46,13 @@ def _read_shard(path: str, columns) -> pd.DataFrame:
     - NaN cells in string columns become the literal "nan" (the raw trace
       uses both; the reference normalizes the same way via its na handling).
     """
-    df = pd.read_csv(path, engine=_CSV_ENGINE)
+    try:
+        df = pd.read_csv(path, engine=_CSV_ENGINE)
+    except Exception as e:
+        # truncated / garbled shards happen on 200 GB-scale copies; fail
+        # loudly with the shard path instead of a bare parser traceback
+        raise ValueError(f"failed to parse raw shard {path}: "
+                         f"{type(e).__name__}: {e}") from e
     missing = [c for c in columns if c not in df.columns]
     if missing:
         raise ValueError(f"{path} lacks expected columns {missing}; "

@@ -150,7 +150,11 @@ def filter_by_resource_coverage(df: pd.DataFrame, resource_df: pd.DataFrame,
                 and int(a.min()) >= 0 and int(a.max()) < bound)
 
     if (_packable("um", 2**32) and _packable("dm", 2**32)
-            and _packable("traceid", 2**31)):
+            and _packable("traceid", 2**31)
+            # the fast path also reads msname as int64; a mixed-domain
+            # input (int span codes, string resource names) must take the
+            # general path instead of raising (ADVICE r4)
+            and pd.api.types.is_integer_dtype(resource_df["msname"])):
         # Numeric fast path (the --stream_factorize loader): distinct
         # (trace, ms) pairs via ONE packed-int64 np.unique instead of a
         # 2x-row pandas concat + drop_duplicates — the concat was the

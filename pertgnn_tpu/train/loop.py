@@ -283,7 +283,8 @@ def _chunk_iter(batches: Iterator[PackedBatch],
     return _device_iter(_host_chunks(batches, chunk_size))
 
 
-def _staged_epoch_iter(chunks: Iterator) -> Iterator:
+def _staged_epoch_iter(chunks: Iterator,
+                       max_bytes: int | None = None) -> Iterator:
     """Stage an ENTIRE epoch's compact recipes on device in ONE transfer
     per field, then slice per chunk ON DEVICE.
 
@@ -299,10 +300,11 @@ def _staged_epoch_iter(chunks: Iterator) -> Iterator:
     import numpy as np
 
     yield from _staged_iter(chunks, lambda _path, stacked: jnp.asarray(
-        stacked))
+        stacked), max_bytes=max_bytes)
 
 
-def _staged_epoch_iter_sharded(chunks: Iterator, shardings) -> Iterator:
+def _staged_epoch_iter_sharded(chunks: Iterator, shardings,
+                               max_bytes: int | None = None) -> Iterator:
     """Mesh twin of `_staged_epoch_iter`: one sharded device_put for the
     whole epoch's global compact recipes, sliced per chunk on device.
 
@@ -319,21 +321,43 @@ def _staged_epoch_iter_sharded(chunks: Iterator, shardings) -> Iterator:
         return jax.device_put(
             stacked, NamedSharding(s.mesh, PartitionSpec(None, *s.spec)))
 
-    yield from _staged_iter(chunks, put)
+    yield from _staged_iter(chunks, put, max_bytes=max_bytes)
 
 
-def _staged_iter(chunks: Iterator, put) -> Iterator:
+def _staged_iter(chunks: Iterator, put,
+                 max_bytes: int | None = None) -> Iterator:
     """Shared staging shell: stack the whole epoch on host, device-put
     each leaf ONCE via `put(leaf_index, stacked)`, slice per chunk on
-    device."""
+    device.
+
+    Leaves are paired with their index by one explicit tree_flatten per
+    chunk (ADVICE r4: a shared counter inside tree.map relied on map and
+    leaves agreeing on traversal order). Staged bytes are O(graphs)
+    int32s by construction; `max_bytes` guards the pathological case by
+    falling back to per-chunk transfers (same `put`, epoch axis length 1)
+    so staging can never blow the HBM budget unaccounted (ADVICE r4)."""
     import numpy as np
 
     host = list(chunks)
     if not host:
         return
-    counter = iter(range(len(jax.tree.leaves(host[0]))))
-    staged = jax.tree.map(
-        lambda *xs: put(next(counter), np.stack(xs)), *host)
+    _, treedef = jax.tree.flatten(host[0])
+    cols = list(zip(*(jax.tree.flatten(h)[0] for h in host)))
+    if max_bytes is not None:
+        total = sum(np.asarray(x).nbytes for col in cols for x in col)
+        if total > max_bytes:
+            log.warning(
+                "staged epoch recipes need %.1f MiB > cap %.1f MiB; "
+                "falling back to per-chunk transfers",
+                total / 2**20, max_bytes / 2**20)
+            for h in host:
+                leaves = jax.tree.flatten(h)[0]
+                dev = [put(i, np.asarray(x)[None])
+                       for i, x in enumerate(leaves)]
+                yield jax.tree.unflatten(treedef, [d[0] for d in dev])
+            return
+    staged = jax.tree.unflatten(
+        treedef, [put(i, np.stack(col)) for i, col in enumerate(cols)])
     for i in range(len(host)):
         yield jax.tree.map(lambda a: a[i], staged)
 
@@ -537,7 +561,9 @@ def fit(dataset: Dataset, cfg: Config,
                     # O(graphs) recipes: one sharded transfer per epoch
                     # (multi-process keeps per-chunk assembly — each host
                     # owns only its slab)
-                    return _staged_epoch_iter_sharded(glob, sh)
+                    return _staged_epoch_iter_sharded(
+                        glob, sh,
+                        max_bytes=int(cfg.train.stage_recipes_max_mb * 2**20))
                 if shuffle:  # train: packing off the critical path
                     glob = _background(glob)
                 return to_device(glob, sh)
@@ -597,7 +623,9 @@ def fit(dataset: Dataset, cfg: Config,
                 # one H2D per field per EPOCH (recipes are O(graphs)
                 # int32s); host packing is a few ms so no background
                 # thread is needed ahead of the single transfer
-                return _staged_epoch_iter(cbs)
+                return _staged_epoch_iter(
+                    cbs,
+                    max_bytes=int(cfg.train.stage_recipes_max_mb * 2**20))
             if shuffle:  # train: pack off the critical path
                 cbs = _background(cbs)
             return _device_iter(cbs)

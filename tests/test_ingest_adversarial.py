@@ -1,0 +1,273 @@
+"""Adversarial real-data-shaped ingest fuzzing (VERDICT r4 #2).
+
+Every byte the loaders had seen before this module was well-formed
+synthetic output. The real 200 GB Alibaba MSCallGraph/MSResource trees
+(/root/reference/README.md:4-12) carry documented dirt: the `(?)` um
+token (reference preprocess.py:121), negative rt (preprocess.py:114),
+NaN cells, messy dtypes, duplicated and truncated shard files. For each
+anomaly this module either pins OUR behavior to the reference's
+(preprocess.py:99-149 entry detection; :203-213 load/dedupe/sort) or
+exercises the documented PARITY divergence and its guard.
+
+Harness: corrupt a small synthetic corpus ONE way at a time, run the
+real loaders (`load_raw_csvs`, `load_raw_csvs_streaming`) + `preprocess`
++ `build_dataset` over it, and assert the documented outcome — no path
+may silently return wrong answers.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import Config, DataConfig, IngestConfig
+from pertgnn_tpu.ingest import synthetic
+from pertgnn_tpu.ingest.io import (
+    load_raw_csvs,
+    load_raw_csvs_streaming,
+)
+from pertgnn_tpu.ingest.preprocess import detect_entries, preprocess
+
+CFG = IngestConfig(min_traces_per_entry=5)
+
+
+def _corpus(tmp_path, shards=3, seed=3):
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_entries=4, traces_per_entry=40, seed=seed))
+    root = str(tmp_path / "raw")
+    synthetic.write_csvs(data, root, shards=shards)
+    return root
+
+
+def _pipeline_counts(root, cfg=CFG):
+    spans, resources = load_raw_csvs(root)
+    pre = preprocess(spans, resources, cfg)
+    return (pre.stats["num_traces_final"], pre.stats["num_entries_final"],
+            len(pre.spans), pre)
+
+
+# ---------------------------------------------------------------------------
+# shard-file corruption
+# ---------------------------------------------------------------------------
+
+def test_missing_column_raises_with_shard_path(tmp_path):
+    root = _corpus(tmp_path)
+    shard = os.path.join(root, "MSCallGraph", "MSCallGraph_1.csv")
+    df = pd.read_csv(shard)
+    df.drop(columns=["rt"]).to_csv(shard, index=False)
+    with pytest.raises(ValueError, match="MSCallGraph_1.csv.*rt"):
+        load_raw_csvs(root)
+    with pytest.raises(ValueError, match="MSCallGraph_1.csv.*rt"):
+        load_raw_csvs_streaming(root, CFG)
+
+
+def test_extra_columns_are_dropped(tmp_path):
+    root = _corpus(tmp_path)
+    clean = _pipeline_counts(root)[:3]
+    shard = os.path.join(root, "MSCallGraph", "MSCallGraph_0.csv")
+    df = pd.read_csv(shard)
+    df["nodeid"] = "extra"
+    df["uminstanceid"] = np.arange(len(df))
+    df.to_csv(shard, index=False)
+    assert _pipeline_counts(root)[:3] == clean
+
+
+def test_duplicate_shard_is_a_noop(tmp_path):
+    # a shard copied twice into the tree (interrupted rsync): global
+    # row dedupe (reference preprocess.py:212) must absorb it on BOTH
+    # loader paths
+    root = _corpus(tmp_path)
+    clean = _pipeline_counts(root)[:3]
+    cg = os.path.join(root, "MSCallGraph")
+    shutil.copy(os.path.join(cg, "MSCallGraph_0.csv"),
+                os.path.join(cg, "MSCallGraph_0_copy.csv"))
+    assert _pipeline_counts(root)[:3] == clean
+
+    spans, resources, tcfg, _ = load_raw_csvs_streaming(root, CFG)
+    pre = preprocess(spans, resources, tcfg)
+    assert (pre.stats["num_traces_final"], pre.stats["num_entries_final"],
+            len(pre.spans)) == clean
+
+
+def test_truncated_shard_parses_or_raises_cleanly(tmp_path):
+    # a shard cut mid-row (partial copy). CSV parsers either recover the
+    # complete prefix rows or fail; what is FORBIDDEN is a bare parser
+    # traceback without the shard path, or a silent wrong answer beyond
+    # the lost suffix rows.
+    root = _corpus(tmp_path)
+    shard = os.path.join(root, "MSCallGraph", "MSCallGraph_2.csv")
+    raw = open(shard, "rb").read()
+    open(shard, "wb").write(raw[:int(len(raw) * 0.7)])
+    try:
+        spans, _ = load_raw_csvs(root)
+    except ValueError as e:
+        assert "MSCallGraph_2.csv" in str(e)
+    else:
+        full = pd.read_csv(
+            os.path.join(root, "MSCallGraph", "MSCallGraph_0.csv"))
+        # recovered rows must still be schema-complete
+        assert not spans["traceid"].isna().any()
+        assert len(spans) < len(full) * 3
+
+
+def test_empty_shard_file(tmp_path):
+    root = _corpus(tmp_path)
+    shard = os.path.join(root, "MSCallGraph", "MSCallGraph_9.csv")
+    open(shard, "w").close()  # zero bytes
+    with pytest.raises(ValueError, match="MSCallGraph_9.csv"):
+        load_raw_csvs(root)
+
+
+# ---------------------------------------------------------------------------
+# reference-documented value dirt
+# ---------------------------------------------------------------------------
+
+def _trace_rows(traceid, rows):
+    """rows: (timestamp, rpcid, um, rpctype, dm, interface, rt)"""
+    return pd.DataFrame(
+        [(traceid, *r) for r in rows],
+        columns=["traceid", "timestamp", "rpcid", "um", "rpctype", "dm",
+                 "interface", "rt"])
+
+
+def test_qmark_um_breaks_entry_tie():
+    # two same-timestamp same-|rt| http rows: the reference keeps the
+    # um == "(?)" one (preprocess.py:121); a third trace with NO (?) row
+    # among its ties is dropped as ambiguous
+    df = pd.concat([
+        _trace_rows("t1", [(0, "0", "(?)", "http", "A", "if0", 100.0),
+                           (0, "0.1", "B", "http", "C", "if1", -100.0),
+                           (1, "0.2", "A", "rpc", "D", "if2", 30.0)]),
+        _trace_rows("t2", [(0, "0", "X", "http", "A", "if0", 50.0),
+                           (0, "0.1", "Y", "http", "C", "if1", 50.0)]),
+    ], ignore_index=True)
+    out, stats = detect_entries(df)
+    assert set(out["traceid"]) == {"t1"}
+    assert (out["entryid"] == "A_if0").all()
+    assert stats["num_ambiguous_entry"] == 1
+
+
+def test_negative_rt_on_entry_row():
+    # raw traces carry negative rt; the reference compares |rt|
+    # (preprocess.py:114) and labels with max |rt| — a negative-rt entry
+    # row must still win the candidacy and the label must be its |rt|
+    df = _trace_rows("t1", [(0, "0", "(?)", "http", "A", "if0", -500.0),
+                            (1, "0.1", "A", "rpc", "B", "if1", 400.0)])
+    out, _ = detect_entries(df)
+    assert set(out["traceid"]) == {"t1"}
+    res = pd.DataFrame({"timestamp": [0], "msname": ["A"],
+                        "instance_cpu_usage": [0.5],
+                        "instance_memory_usage": [0.5]})
+    pre = preprocess(df, res, IngestConfig(min_traces_per_entry=0,
+                                           min_resource_coverage=0.0))
+    assert pre.stats["num_traces_final"] == 1
+    # endTimestamp uses |rt| (reference preprocess.py:263)
+    assert (pre.spans["endTimestamp"]
+            == pre.spans["timestamp"] + pre.spans["rt"].abs()).all()
+
+
+def test_nan_rt_rows_never_become_entries():
+    # numeric NaN rt: pandas max() skips NaN, NaN == max is False, so a
+    # NaN-rt row can't be a candidate; a trace whose EVERY rt is NaN has
+    # no candidates and is dropped (matches the reference's groupby loop)
+    df = pd.concat([
+        _trace_rows("t1", [(0, "0", "(?)", "http", "A", "if0", np.nan),
+                           (0, "0.1", "A", "http", "B", "if1", 80.0)]),
+        _trace_rows("t2", [(0, "0", "(?)", "http", "A", "if0", np.nan),
+                           (1, "0.1", "A", "rpc", "B", "if1", np.nan)]),
+    ], ignore_index=True)
+    out, stats = detect_entries(df)
+    assert set(out["traceid"]) == {"t1"}
+    assert (out["entryid"] == "B_if1").all()  # the finite-rt row won
+    assert stats["num_without_entry"] == 1
+
+
+def test_empty_string_um_dm_flow_through():
+    # "" is a legal token — distinct from "nan" and "(?)"; it must ride
+    # the whole pipeline as an ordinary microservice name
+    df = _trace_rows("t1", [(0, "0", "(?)", "http", "", "if0", 90.0),
+                            (1, "0.1", "", "rpc", "B", "if1", 10.0)])
+    res = pd.DataFrame({"timestamp": [0, 0],
+                        "msname": ["", "B"],
+                        "instance_cpu_usage": [0.1, 0.2],
+                        "instance_memory_usage": [0.3, 0.4]})
+    pre = preprocess(df, res, IngestConfig(min_traces_per_entry=0,
+                                           min_resource_coverage=0.0))
+    assert pre.stats["num_traces_final"] == 1
+    assert "" in set(pre.ms_vocab)
+
+
+def test_non_monotonic_timestamps_match_sorted_input(tmp_path):
+    # raw shards arrive in arbitrary order; the reference sorts by
+    # timestamp before factorizing (preprocess.py:213) so row order must
+    # not leak into the output. Distinct timestamps -> the stable sort
+    # fully determines order -> identical PreprocessResult.
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_entries=3, traces_per_entry=30, seed=11))
+    spans = data.spans.copy()
+    spans["timestamp"] = (spans["timestamp"].astype(np.int64) * 1000
+                          + np.random.default_rng(0).permutation(len(spans)))
+    shuffled = spans.sample(frac=1.0, random_state=7).reset_index(drop=True)
+    a = preprocess(spans, data.resources, CFG)
+    b = preprocess(shuffled, data.resources, CFG)
+    pd.testing.assert_frame_equal(a.spans, b.spans)
+    np.testing.assert_array_equal(a.ms_vocab, b.ms_vocab)
+    assert a.stats == b.stats
+
+
+def test_int64_range_timestamps_end_to_end():
+    # timestamps near 2^52: the 30 s bucket is ~2^52 too — beyond the
+    # featurize packed-key bound (2^40), forcing the MultiIndex path —
+    # and the whole pipeline down to a packed batch must stay exact
+    # bucket-aligned shift (a multiple of the 30 s bucket) so trace
+    # buckets still land on resource timestamps after the shift
+    base = (np.int64(1) << 52) // 30_000 * 30_000
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_entries=3, traces_per_entry=40, seed=5))
+    spans = data.spans.copy()
+    spans["timestamp"] = spans["timestamp"].astype(np.int64) + base
+    res = data.resources.copy()
+    res["timestamp"] = res["timestamp"].astype(np.int64) + base
+    cfg = Config(ingest=CFG, data=DataConfig(batch_size=16))
+    pre = preprocess(spans, res, cfg.ingest)
+    assert pre.stats["num_traces_final"] > 0
+    ds = build_dataset(pre, cfg)
+    batch = next(ds.batches("train"))
+    x = np.asarray(batch.x)
+    assert np.isfinite(x).all()
+    # featurization found real table rows (not all-missing): some node
+    # has the missing indicator at 0
+    assert (x[np.asarray(batch.node_mask), -1] == 0).any()
+
+
+def test_all_filtered_corpus_raises_cleanly(tmp_path):
+    # no http rows at all -> every trace is dropped at entry detection;
+    # build_dataset must refuse with the diagnostic, not crash deeper
+    df = _trace_rows("t1", [(0, "0", "A", "rpc", "B", "if0", 10.0)])
+    res = pd.DataFrame({"timestamp": [0], "msname": ["A"],
+                        "instance_cpu_usage": [0.1],
+                        "instance_memory_usage": [0.1]})
+    pre = preprocess(df, res, IngestConfig())
+    assert pre.stats["num_traces_final"] == 0
+    with pytest.raises(ValueError, match="no traces survived"):
+        build_dataset(pre, Config(ingest=IngestConfig()))
+
+
+def test_streaming_handles_all_nan_um_shard(tmp_path):
+    # an all-NaN um column in one shard: the stream vocab normalizes to
+    # the literal "nan" exactly like the exact path's fillna — final
+    # trace counts must agree between the two loaders
+    root = _corpus(tmp_path, shards=2)
+    shard = os.path.join(root, "MSCallGraph", "MSCallGraph_1.csv")
+    df = pd.read_csv(shard)
+    df["um"] = np.nan
+    df.to_csv(shard, index=False)
+    exact_counts = _pipeline_counts(root)[:2]
+    spans, resources, tcfg, vocabs = load_raw_csvs_streaming(root, CFG)
+    pre = preprocess(spans, resources, tcfg)
+    assert (pre.stats["num_traces_final"],
+            pre.stats["num_entries_final"]) == exact_counts
+    assert vocabs["ms"].code_of("nan") >= 0

@@ -1,0 +1,166 @@
+"""Property tests for the three packed-int64 key tricks (VERDICT r4 #7).
+
+The same trick — pack a tuple of non-negative ids into one int64 so a
+vectorized np.unique / Index.get_indexer replaces a pandas groupby — now
+appears at three sites, each with its own bounds:
+
+1. `ingest.preprocess.filter_by_resource_coverage` fast path:
+   key = traceid << 32 | ms, needs ms < 2^32 and 0 <= traceid < 2^31.
+2. `batching.featurize.ResourceLookup`: key = bucket * 2^22 + ms, needs
+   0 <= ms < 2^22 and |bucket| < 2^40 (out-of-bounds queries/tables take
+   a MultiIndex path; this bound check is the VERDICT r4 weak-#5 fix).
+3. `ingest.assemble._runtime_ids_numeric`: dynamic-width token
+   (um << b) | (dm << c) | ifc with sum(bit widths) <= 62, else the
+   caller falls back to the literal string corpus.
+
+Each property pins the packed path to an order-free oracle built the slow
+way (string domains / Python dicts), over id ranges that STRADDLE the
+bounds — so both the in-bounds correctness and the out-of-bounds
+fallback are exercised by the same law: packed result == oracle result,
+for every input.
+"""
+
+import numpy as np
+import pandas as pd
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from pertgnn_tpu.batching.featurize import ResourceLookup
+from pertgnn_tpu.config import IngestConfig
+from pertgnn_tpu.ingest.assemble import _runtime_ids_numeric
+from pertgnn_tpu.ingest.preprocess import filter_by_resource_coverage
+from pertgnn_tpu.ingest.schema import NUM_RESOURCE_FEATURES
+
+# ---------------------------------------------------------------------------
+# 1. coverage filter: packed fast path == string-domain general path
+# ---------------------------------------------------------------------------
+
+# ids straddling the fast path's bounds: small codes, 2^32 ms overflows,
+# 2^31 traceids, negatives — the function must route each case correctly
+_ms_id = st.integers(0, 6) | st.integers(2**32 - 2, 2**32 + 2)
+_trace_id = st.integers(0, 4) | st.integers(2**31 - 1, 2**31 + 1)
+_span_row = st.tuples(_trace_id, _ms_id, _ms_id)
+
+
+@settings(max_examples=60, deadline=None)
+@given(rows=st.lists(_span_row, min_size=1, max_size=40),
+       res_ms=st.lists(_ms_id, max_size=8),
+       thresh=st.sampled_from([0.0, 0.5, 0.6, 1.0]))
+def test_coverage_filter_packed_matches_string_oracle(rows, res_ms, thresh):
+    df = pd.DataFrame(rows, columns=["traceid", "um", "dm"])
+    res = pd.DataFrame({"msname": pd.Series(res_ms, dtype=np.int64)})
+    cfg = IngestConfig(min_resource_coverage=thresh)
+    kept = filter_by_resource_coverage(df, res, cfg)
+
+    # oracle: identical ids mapped to strings — guaranteed general path
+    sdf = df.copy()
+    for c in ("traceid", "um", "dm"):
+        sdf[c] = "s" + sdf[c].astype(str)
+    sres = pd.DataFrame({"msname": "s" + res["msname"].astype(str)})
+    oracle = filter_by_resource_coverage(sdf, sres, cfg)
+
+    assert list(kept.index) == list(oracle.index)
+
+
+def test_coverage_filter_mixed_domain_takes_general_path():
+    # int span codes + string resource names must not raise (ADVICE r4):
+    # zero overlap between domains -> zero coverage -> all filtered
+    df = pd.DataFrame({"traceid": [1, 1], "um": [0, 1], "dm": [1, 2]})
+    res = pd.DataFrame({"msname": ["a", "b"]})
+    kept = filter_by_resource_coverage(df, res, IngestConfig())
+    assert len(kept) == 0
+
+
+# ---------------------------------------------------------------------------
+# 2. ResourceLookup: hashed gather == dict oracle, in and out of bounds
+# ---------------------------------------------------------------------------
+
+_bucket = st.integers(0, 3) | st.integers(2**40 - 1, 2**40 + 1) | \
+    st.integers(-2**40 - 1, -(2**40 - 1))
+_ms_small = st.integers(0, 3) | st.integers(2**22 - 1, 2**22 + 1) | \
+    st.just(-1)
+_pair = st.tuples(_bucket, _ms_small)
+
+
+@settings(max_examples=60, deadline=None)
+@given(table=st.lists(_pair, min_size=1, max_size=20, unique=True),
+       queries=st.lists(_pair, min_size=1, max_size=30))
+def test_resource_lookup_matches_dict_oracle(table, queries):
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(len(table), NUM_RESOURCE_FEATURES)).astype(
+        np.float32)
+    res = pd.DataFrame({
+        "timestamp": pd.Series([t for t, _ in table], dtype=np.int64),
+        "msname": pd.Series([m for _, m in table], dtype=np.int64),
+        **{f"f{i}": feats[:, i] for i in range(NUM_RESOURCE_FEATURES)},
+    })
+    lut = ResourceLookup(res)
+    oracle = {k: feats[i] for i, k in enumerate(table)}
+
+    ts = np.array([t for t, _ in queries], dtype=np.int64)
+    ms = np.array([m for _, m in queries], dtype=np.int64)
+    x = lut(ts, ms)
+    assert x.shape == (len(queries), NUM_RESOURCE_FEATURES + 1)
+    for row, key in zip(x, queries):
+        if key in oracle:
+            np.testing.assert_array_equal(row[:-1], oracle[key])
+            assert row[-1] == 0.0
+        else:  # missing: zero features + indicator — NEVER another row's
+            np.testing.assert_array_equal(
+                row[:-1], np.zeros(NUM_RESOURCE_FEATURES, np.float32))
+            assert row[-1] == 1.0
+
+
+def test_resource_lookup_unpacked_table_path():
+    # one table key beyond the ms bound forces the MultiIndex path for
+    # the WHOLE table; lookups must still be exact
+    res = pd.DataFrame({
+        "timestamp": pd.Series([5, 7], dtype=np.int64),
+        "msname": pd.Series([3, 2**22 + 9], dtype=np.int64),
+        **{f"f{i}": np.float32([i + 1, -(i + 1)])
+           for i in range(NUM_RESOURCE_FEATURES)},
+    })
+    lut = ResourceLookup(res)
+    assert not lut._packed
+    x = lut(np.array([7, 5, 5]), np.array([2**22 + 9, 3, 4]))
+    np.testing.assert_array_equal(
+        x[0, :-1], -(np.arange(NUM_RESOURCE_FEATURES, dtype=np.float32) + 1))
+    np.testing.assert_array_equal(
+        x[1, :-1], np.arange(NUM_RESOURCE_FEATURES, dtype=np.float32) + 1)
+    assert x[2, -1] == 1.0 and not x[2, :-1].any()
+
+
+# ---------------------------------------------------------------------------
+# 3. runtime-pattern identity: packed tokens == string corpus factorize
+# ---------------------------------------------------------------------------
+
+_tok_id = st.integers(0, 5) | st.integers(2**31 - 1, 2**31 + 1)
+
+
+@settings(max_examples=60, deadline=None)
+@given(rows=st.lists(
+    st.tuples(st.integers(0, 6), _tok_id, _tok_id, _tok_id),
+    min_size=1, max_size=40))
+def test_runtime_ids_numeric_matches_string_corpus(rows, ):
+    df = pd.DataFrame(rows, columns=["traceid", "um", "dm", "interface"])
+    got = _runtime_ids_numeric(df)
+
+    token = (df["um"].astype(str) + "_" + df["dm"].astype(str)
+             + "_" + df["interface"].astype(str))
+    corpus = token.groupby(df["traceid"]).agg(" ".join)
+    codes, _ = pd.factorize(corpus)
+    if got is None:
+        # fast path declined (packing would overflow) — legitimate only
+        # when the dynamic widths truly exceed 62 bits
+        bits = [int(df[c].max()).bit_length() + 1
+                for c in ("um", "dm", "interface")]
+        assert sum(bits) > 62
+        return
+    assert list(got.index) == list(corpus.index)
+    np.testing.assert_array_equal(got.values, codes)
+
+
+def test_runtime_ids_numeric_declines_negatives():
+    df = pd.DataFrame({"traceid": [0], "um": [-1], "dm": [0],
+                       "interface": [0]})
+    assert _runtime_ids_numeric(df) is None
