@@ -1,0 +1,222 @@
+"""Endurance run with a crash-resume drill (VERDICT r4 #5).
+
+The reference loses ALL training progress on a crash — no state_dict
+save anywhere in its driver (/root/reference/pert_gnn.py; SURVEY.md
+§5.3/5.4). This drill proves our recovery story end to end, the rude
+way:
+
+1. CONTROL: an uninterrupted `fit()` for --epochs with per-epoch orbax
+   checkpoints, history streamed to disk.
+2. CRASH: the identical run in a fresh directory is `kill -9`ed the
+   moment its history shows --kill-after-epoch done (so it dies mid-
+   epoch, async checkpoint possibly in flight).
+3. RESUME: the same command is relaunched; `CheckpointManager.
+   maybe_restore` must pick up at (latest saved epoch + 1).
+
+Asserts: the resumed history starts exactly one past the last committed
+checkpoint, reaches the final epoch, and the crashed+resumed final
+train qloss matches the control within --rtol (per-epoch shuffle is
+seeded, so the only tolerated divergence is checkpoint-roundtrip float
+noise). Prints one JSON line.
+
+    python benchmarks/endurance_drill.py                  # CPU scale
+    python benchmarks/endurance_drill.py --scale full     # chip scale
+    python benchmarks/endurance_drill.py --worker ...     # (internal)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _worker(args) -> None:
+    from pertgnn_tpu.cli.common import apply_platform_env
+    apply_platform_env()
+
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                    ModelConfig, TrainConfig)
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.train.checkpoint import CheckpointManager
+    from pertgnn_tpu.train.loop import fit
+
+    tpe = {"cpu": 400, "full": 12_000}[args.scale]
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(max_traces=100_000, batch_size=170),
+        model=ModelConfig(hidden_channels=32, num_layers=3),
+        train=TrainConfig(lr=3e-4, label_scale=1000.0, scan_chunk=8,
+                          epochs=args.epochs),
+        graph_type="pert",
+    )
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=60, num_entries=8, patterns_per_entry=4,
+        traces_per_entry=tpe, seed=42))
+    pre = preprocess(data.spans, data.resources, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+
+    ckpt = CheckpointManager(args.ckpt_dir, keep=3)
+    hist_path = args.history
+
+    def hook(epoch: int, row: dict) -> None:
+        with open(hist_path, "a") as f:
+            f.write(json.dumps({"epoch": epoch,
+                                "train_qloss": row["train_qloss"],
+                                "test_mae": row["test_mae"]}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    fit(ds, cfg, checkpoint_manager=ckpt, profile_hook=hook)
+    ckpt.close()
+
+
+def _read_history(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _spawn(scale: str, epochs: int, ckpt_dir: str, history: str):
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker",
+         "--scale", scale, "--epochs", str(epochs),
+         "--ckpt-dir", ckpt_dir, "--history", history],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=dict(os.environ), stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT)
+
+
+def _run_to_completion(scale, epochs, ckpt_dir, history, timeout_s):
+    p = _spawn(scale, epochs, ckpt_dir, history)
+    deadline = time.monotonic() + timeout_s
+    while p.poll() is None:
+        if time.monotonic() > deadline:
+            p.kill()
+            raise RuntimeError("worker timed out")
+        time.sleep(1)
+    if p.returncode != 0:
+        raise RuntimeError(f"worker failed rc={p.returncode}")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--scale", choices=("cpu", "full"), default="cpu")
+    ap.add_argument("--epochs", type=int, default=100)
+    ap.add_argument("--kill-after-epoch", type=int, default=None,
+                    help="SIGKILL once this epoch appears in the history "
+                         "(default: epochs // 3)")
+    ap.add_argument("--rtol", type=float, default=1e-3)
+    ap.add_argument("--timeout", type=float, default=7200)
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--history", default="")
+    args = ap.parse_args()
+    if args.worker:
+        _worker(args)
+        return
+
+    kill_after = (args.epochs // 3 if args.kill_after_epoch is None
+                  else args.kill_after_epoch)
+    root = tempfile.mkdtemp(prefix="endurance_")
+    ctrl_hist = os.path.join(root, "control.jsonl")
+    crash_hist = os.path.join(root, "crash.jsonl")
+    t0 = time.perf_counter()
+
+    # 1) control
+    _run_to_completion(args.scale, args.epochs,
+                       os.path.join(root, "ckpt_control"), ctrl_hist,
+                       args.timeout)
+    control = _read_history(ctrl_hist)
+    assert control and control[-1]["epoch"] == args.epochs - 1, control[-3:]
+
+    # 2) crash: kill -9 once epoch `kill_after` is logged
+    crash_ckpt = os.path.join(root, "ckpt_crash")
+    p = _spawn(args.scale, args.epochs, crash_ckpt, crash_hist)
+    deadline = time.monotonic() + args.timeout
+    while True:
+        if p.poll() is not None:
+            raise RuntimeError(
+                f"worker exited rc={p.returncode} before the kill point")
+        hist = _read_history(crash_hist)
+        if hist and hist[-1]["epoch"] >= kill_after:
+            os.kill(p.pid, signal.SIGKILL)
+            p.wait()
+            break
+        if time.monotonic() > deadline:
+            p.kill()
+            raise RuntimeError("crash-phase worker timed out")
+        time.sleep(0.2)
+    killed_at = _read_history(crash_hist)[-1]["epoch"]
+
+    # The last COMMITTED checkpoint (async saves may trail the history).
+    # Read the directory layout directly: orbax commits a step by
+    # renaming its tmp dir to the bare step number, so a numeric dir
+    # without an uncommitted marker == committed. Deliberately NOT via
+    # orbax in this parent: importing it touches jax backends, and the
+    # axon plugin dials the (possibly wedged) relay from any process
+    # without the config-update protection — the exact hang this drill's
+    # first run died of.
+    steps = []
+    for name in os.listdir(crash_ckpt):
+        full = os.path.join(crash_ckpt, name)
+        if (name.isdigit() and os.path.isdir(full)
+                and not any(m.startswith(("tmp", ".orbax"))
+                            for m in os.listdir(full))):
+            steps.append(int(name))
+    latest_saved = max(steps, default=None)
+    assert latest_saved is not None, "no checkpoint committed before kill"
+
+    # 3) resume: same command, same dirs
+    _run_to_completion(args.scale, args.epochs, crash_ckpt, crash_hist,
+                       args.timeout)
+    full = _read_history(crash_hist)
+    # the resumed segment starts where the appended epoch sequence
+    # restarts (epoch stops increasing)
+    start = 0
+    for i in range(len(full) - 1, 0, -1):
+        if full[i - 1]["epoch"] >= full[i]["epoch"]:
+            start = i
+            break
+    resumed = full[start:]
+    resume_start = resumed[0]["epoch"]
+    final = resumed[-1]
+
+    ok_resume = resume_start == latest_saved + 1
+    ok_final = final["epoch"] == args.epochs - 1
+    ctrl_final = control[-1]["train_qloss"]
+    rel = abs(final["train_qloss"] - ctrl_final) / max(abs(ctrl_final), 1e-9)
+    ok_parity = rel <= args.rtol
+
+    result = {
+        "metric": "endurance_crash_resume_drill",
+        "value": bool(ok_resume and ok_final and ok_parity),
+        "unit": "pass",
+        "scale": args.scale, "epochs": args.epochs,
+        "killed_after_epoch": killed_at,
+        "latest_committed_checkpoint": latest_saved,
+        "resume_started_at_epoch": resume_start,
+        "resume_contract_ok": ok_resume,
+        "reached_final_epoch": ok_final,
+        "final_train_qloss_resumed": round(final["train_qloss"], 6),
+        "final_train_qloss_control": round(ctrl_final, 6),
+        "rel_diff": round(rel, 8), "rtol": args.rtol,
+        "parity_ok": ok_parity,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    print(json.dumps(result))
+    sys.exit(0 if result["value"] else 1)
+
+
+if __name__ == "__main__":
+    main()

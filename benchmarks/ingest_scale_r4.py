@@ -79,7 +79,7 @@ def build_tree(out_dir: str, target_gb: float, seed: int = 11) -> dict:
 
 
 def run_cli(data_dir: str, artifact_dir: str,
-            stream: bool = False) -> dict:
+            stream: bool = False, workers: int = 1) -> dict:
     """Run the preprocess CLI in a child process, sampling VmHWM."""
     import threading
 
@@ -90,7 +90,8 @@ def run_cli(data_dir: str, artifact_dir: str,
         [sys.executable, "-m", "pertgnn_tpu.cli.preprocess_main",
          "--data_dir", data_dir, "--artifact_dir", artifact_dir,
          "--min_traces_per_entry", "100"]
-        + (["--stream_factorize"] if stream else []),
+        + (["--stream_factorize"] if stream else [])
+        + (["--ingest_workers", str(workers)] if workers > 1 else []),
         cwd=repo, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True)
 
@@ -129,6 +130,9 @@ def main():
     ap.add_argument("--stream", action="store_true",
                     help="measure the --stream_factorize loader instead "
                          "of the exact path")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="--ingest_workers for the child CLI (streaming "
+                         "shard fan-out; needs --stream)")
     args = ap.parse_args()
     root = args.keep_tree or tempfile.mkdtemp(prefix="ingest_scale_",
                                               dir="/tmp")
@@ -139,7 +143,8 @@ def main():
         t0 = time.perf_counter()
         tree = build_tree(data_dir, args.gb)
         build_s = time.perf_counter() - t0
-        r = run_cli(data_dir, art_dir, stream=args.stream)
+        r = run_cli(data_dir, art_dir, stream=args.stream,
+                    workers=args.workers)
         ok = r["rc"] == 0
         result = {
             "metric": ("ingest_scale_peak_rss_over_raw_stream"
@@ -156,6 +161,7 @@ def main():
             "traces_per_s": (round(tree["traces"] / r["wall_s"], 1)
                              if ok else None),
             "peak_rss_gb": round(r["peak_rss_bytes"] / 2**30, 2),
+            "ingest_workers": args.workers,
             "rc": r["rc"],
         }
         if not ok:

@@ -32,17 +32,19 @@ def merge(rows: list[dict]) -> dict:
     epochs = {r["epochs"] for r in parity_rows}
     if len(epochs) != 1:
         raise SystemExit(f"refusing to merge mixed epoch counts: {epochs}")
-    # overlapping seed ranges would double-count seeds and fabricate CI
-    # precision — refuse (a row without seed_offset predates sharding and
-    # is treated as offset 0)
-    ranges = sorted((r.get("seed_offset", 0),
-                     r.get("seed_offset", 0) + r["seeds_per_side"])
-                    for r in parity_rows)
-    for (a0, a1), (b0, b1) in zip(ranges, ranges[1:]):
-        if b0 < a1:
-            raise SystemExit(
-                f"overlapping shard seed ranges [{a0},{a1}) and [{b0},{b1})"
-                f" — same seeds would be double-counted")
+    # Overlapping seed ranges would double-count seeds and fabricate CI
+    # precision — refuse, PER GRAPH TYPE (a pert-only and a span-only
+    # shard legitimately reuse the same seed range; a row without
+    # seed_offset predates sharding and is treated as offset 0).
+    for gtype in ("pert", "span"):
+        ranges = sorted((r.get("seed_offset", 0),
+                         r.get("seed_offset", 0) + r["seeds_per_side"])
+                        for r in parity_rows if gtype in r)
+        for (a0, a1), (b0, b1) in zip(ranges, ranges[1:]):
+            if b0 < a1:
+                raise SystemExit(
+                    f"overlapping {gtype} shard seed ranges [{a0},{a1}) "
+                    f"and [{b0},{b1}) — same seeds would be double-counted")
     out = {"metric": "quality_parity_merged", "epochs": epochs.pop(),
            "shards": len(parity_rows),
            "commits": sorted({r.get("commit") or "?" for r in parity_rows})}

@@ -48,6 +48,12 @@ for i in $(seq 1 "$PROBES"); do
       done
       if [ $suite_ok -eq 1 ]; then
         echo "$(date -u +%FT%TZ) TPU suite captured"
+        # opportunistic extra (VERDICT r4 #5): chip-backend crash-resume
+        # drill — failure here must not void the captured suite
+        echo "$(date -u +%FT%TZ) running endurance drill (chip backend)"
+        timeout 5400 python benchmarks/endurance_drill.py --scale cpu \
+          --epochs 60 >> "$OUT"
+        echo "$(date -u +%FT%TZ) endurance drill rc=$?"
         if [ -f benchmarks/cpu_hogs.pid ]; then
           xargs -r kill -CONT < benchmarks/cpu_hogs.pid 2>/dev/null
         fi

@@ -113,6 +113,11 @@ def add_ingest_flags(p: argparse.ArgumentParser) -> None:
                         "against incremental vocabularies so RAM holds "
                         "only numeric columns; ids are isomorphic (not "
                         "equal) to the exact path's (ingest/io.py)")
+    p.add_argument("--ingest_workers", type=int, default=1,
+                   help="worker processes for the --stream_factorize "
+                        "shard parse+factorize fan-out; the parent's "
+                        "shard-order vocab merge keeps results identical "
+                        "to workers=1")
     p.add_argument("--synthetic", action="store_true",
                    help="use the synthetic generator instead of raw CSVs")
     p.add_argument("--synthetic_entries", type=int, default=8)
@@ -191,6 +196,8 @@ def get_frames_with_ingest_cfg(args: argparse.Namespace, ingest_cfg):
                 "combine with --synthetic (write the synthetic corpus to "
                 "CSVs and pass --data_dir instead)")
         from pertgnn_tpu.ingest.io import load_raw_csvs_streaming
-        return load_raw_csvs_streaming(args.data_dir, ingest_cfg)
+        return load_raw_csvs_streaming(
+            args.data_dir, ingest_cfg,
+            workers=getattr(args, "ingest_workers", 1))
     spans, resources = get_frames(args)
     return spans, resources, ingest_cfg, None

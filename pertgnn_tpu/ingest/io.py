@@ -78,14 +78,21 @@ def _raw_dirs(data_dir: str) -> tuple[str, str]:
     return cg_dir, rs_dir
 
 
-def iter_shards(root: str, columns, dedupe: bool):
-    """Yield (filename, pruned shard frame) for every CSV shard — the ONE
-    shard-walk both loaders share (discovery order, schema hardening,
-    per-shard dedupe, missing-shard error)."""
+def list_shards(root: str) -> list[str]:
+    """Shard discovery both loaders share: sorted .csv filenames, loud
+    error on an empty tree."""
     files = [f for f in sorted(os.listdir(root)) if f.endswith(".csv")]
     if not files:
         raise FileNotFoundError(f"no .csv shards under {root}")
-    for f in files:
+    return files
+
+
+def iter_shards(root: str, columns, dedupe: bool):
+    """Yield (filename, pruned shard frame) for every CSV shard — the ONE
+    shard-walk both loaders share (discovery via `list_shards`, schema
+    hardening via `_read_shard`, per-shard dedupe; the streaming loader
+    composes the same pieces in `_factorize_shard`)."""
+    for f in list_shards(root):
         shard = _read_shard(os.path.join(root, f), columns)
         if dedupe:
             shard = shard.drop_duplicates()
@@ -138,6 +145,14 @@ class StreamVocab:
         if col.isna().any():
             col = col.astype(object).fillna("nan")
         codes, uniques = pd.factorize(col)
+        return self.merge(uniques)[codes]
+
+    def merge(self, uniques) -> np.ndarray:
+        """Fold one shard's factorize uniques into the global vocabulary;
+        returns local-code -> global-code remap. This O(uniques) walk is
+        the only serial part of the shard encode — the parallel loader
+        runs it in the parent, in shard order, so worker count never
+        changes the code assignment."""
         glob = np.empty(len(uniques), dtype=np.int64)
         for i, u in enumerate(uniques):
             g = self.map.get(u)
@@ -152,13 +167,40 @@ class StreamVocab:
                 f"({len(self.items)} entries) — the downstream int32 "
                 f"code columns would wrap; shard the dataset or widen "
                 f"the code dtype")
-        return glob[codes]
+        return glob
 
     def code_of(self, value, default=-1) -> int:
         return self.map.get(value, default)
 
 
-def load_raw_csvs_streaming(data_dir: str, cfg: IngestConfig
+def _factorize_shard(path: str, columns, str_cols: tuple, dedupe: bool):
+    """Worker half of the streaming encode: parse + prune + dedupe ONE
+    shard and factorize its string columns to SHARD-LOCAL codes.
+
+    Runs in a worker process under `ingest_workers > 1` — everything
+    heavy (CSV parse, dedupe, vectorized factorize) is here; only the
+    O(uniques) vocab merge stays in the parent (StreamVocab.merge), so
+    results are independent of worker count and identical to the serial
+    path. Returns ({col: codes-or-raw}, {col: uniques}, nrows)."""
+    shard = _read_shard(path, columns)
+    if dedupe:
+        shard = shard.drop_duplicates()
+    codes_d, uniq_d = {}, {}
+    for c in columns:
+        if c in str_cols:
+            col = shard[c]
+            if col.isna().any():  # mirror StreamVocab.encode's NaN rule
+                col = col.astype(object).fillna("nan")
+            codes, uniques = pd.factorize(col)
+            codes_d[c] = codes.astype(np.int32)
+            uniq_d[c] = np.asarray(uniques, dtype=object)
+        else:
+            codes_d[c] = shard[c].to_numpy()
+    return codes_d, uniq_d, len(shard)
+
+
+def load_raw_csvs_streaming(data_dir: str, cfg: IngestConfig,
+                            workers: int = 1,
                             ) -> tuple[pd.DataFrame, pd.DataFrame,
                                        IngestConfig, dict]:
     """200GB-scale loader: factorize every string column PER SHARD
@@ -197,18 +239,60 @@ def load_raw_csvs_streaming(data_dir: str, cfg: IngestConfig
     # lists concatenated one column at a time — peak during load is then
     # ~one numeric frame + one column, not (412 shard frames + a pandas
     # concat double buffer), which dominated the measured peak before.
+    #
+    # workers > 1 (VERDICT r4 #4): shard parse+factorize fan out to a
+    # process pool; the parent folds each shard's uniques into the
+    # global vocabularies IN SHARD ORDER (StreamVocab.merge), so the
+    # output frame, codes, and vocabs are byte-identical to workers=1 —
+    # pinned by tests/test_ingest_scale.py::test_parallel_streaming_equal.
     def encode_tree(root, columns, colmap, dedupe):
+        files = list_shards(root)
+        str_cols = tuple(colmap)
+        jobs = [(os.path.join(root, f), columns, str_cols, dedupe)
+                for f in files]
+        if workers > 1:
+            from collections import deque
+            from concurrent.futures import ProcessPoolExecutor
+            pool = ProcessPoolExecutor(max_workers=workers)
+
+            def windowed():
+                # Bounded in-flight window: at most 2*workers shards are
+                # submitted-or-buffered at once, so a straggler shard
+                # cannot make the parent hold every later shard's
+                # completed result (executor.map would — breaking the
+                # bounded-peak-RSS contract this loader exists for).
+                pending: deque = deque()
+                it = iter(jobs)
+                while len(pending) < 2 * workers:
+                    j = next(it, None)
+                    if j is None:
+                        break
+                    pending.append(pool.submit(_factorize_shard, *j))
+                while pending:
+                    yield pending.popleft().result()  # shard order
+                    j = next(it, None)
+                    if j is not None:
+                        pending.append(pool.submit(_factorize_shard, *j))
+
+            results = windowed()
+        else:
+            pool = None
+            results = (_factorize_shard(*j) for j in jobs)
         cols: dict[str, list] = {c: [] for c in columns}
-        for f, shard in iter_shards(root, columns, dedupe):
-            for c in columns:
-                if c in colmap:
-                    cols[c].append(
-                        colmap[c].encode(shard[c]).astype(np.int32))
-                else:
-                    cols[c].append(shard[c].to_numpy())
-            log.info("stream-read %s: %d rows, vocab sizes ms=%d "
-                     "trace=%d", f, len(shard), len(ms_vocab.items),
-                     len(vocabs["traceid"].items))
+        try:
+            for f, (codes_d, uniq_d, nrows) in zip(files, results):
+                for c in columns:
+                    if c in colmap:
+                        remap = colmap[c].merge(uniq_d[c])
+                        cols[c].append(remap[codes_d[c]].astype(np.int32))
+                    else:
+                        cols[c].append(codes_d[c])
+                log.info("stream-read %s: %d rows, vocab sizes ms=%d "
+                         "trace=%d", f, nrows, len(ms_vocab.items),
+                         len(vocabs["traceid"].items))
+        finally:
+            if pool is not None:
+                pool.shutdown()
         out = {}
         for c in columns:
             out[c] = np.concatenate(cols[c])

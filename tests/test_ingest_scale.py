@@ -186,3 +186,31 @@ def test_streaming_isomorphic(tmp_path):
     probs_s = sorted(tuple(np.round(np.sort(p), 12))
                      for _, p in tab_s.entry2runtimes.values())
     assert probs_e == probs_s
+
+
+def test_parallel_streaming_equal(tmp_path):
+    """workers>1 must be BYTE-IDENTICAL to workers=1 (VERDICT r4 #4):
+    the pool only moves shard parse+factorize off the parent; the
+    shard-order StreamVocab.merge in the parent fixes code assignment
+    independently of worker count or completion order."""
+    import pandas as pd
+
+    from pertgnn_tpu.config import IngestConfig
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.io import load_raw_csvs_streaming
+
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_entries=4, traces_per_entry=40, seed=9))
+    synthetic.write_csvs(data, str(tmp_path / "data"), shards=5)
+    cfg = IngestConfig(min_traces_per_entry=10)
+
+    spans_1, res_1, cfg_1, voc_1 = load_raw_csvs_streaming(
+        str(tmp_path / "data"), cfg, workers=1)
+    spans_4, res_4, cfg_4, voc_4 = load_raw_csvs_streaming(
+        str(tmp_path / "data"), cfg, workers=4)
+
+    pd.testing.assert_frame_equal(spans_1, spans_4)
+    pd.testing.assert_frame_equal(res_1, res_4)
+    assert cfg_1 == cfg_4
+    for name in voc_1:
+        assert voc_1[name].items == voc_4[name].items, name
