@@ -157,7 +157,8 @@ def main():
             p.kill()
             raise RuntimeError("crash-phase worker timed out")
         time.sleep(0.2)
-    killed_at = _read_history(crash_hist)[-1]["epoch"]
+    pre_kill = _read_history(crash_hist)
+    killed_at = pre_kill[-1]["epoch"]
 
     # The last COMMITTED checkpoint (async saves may trail the history).
     # Read the directory layout directly: orbax commits a step by
@@ -181,14 +182,13 @@ def main():
     _run_to_completion(args.scale, args.epochs, crash_ckpt, crash_hist,
                        args.timeout)
     full = _read_history(crash_hist)
-    # the resumed segment starts where the appended epoch sequence
-    # restarts (epoch stops increasing)
-    start = 0
-    for i in range(len(full) - 1, 0, -1):
-        if full[i - 1]["epoch"] >= full[i]["epoch"]:
-            start = i
-            break
-    resumed = full[start:]
+    # The resumed segment is everything appended after the kill. Split
+    # by the line count captured at kill time — NOT by looking for a
+    # non-increasing epoch: when the killed epoch's async checkpoint
+    # committed before SIGKILL landed, resume starts at killed_at+1 and
+    # the epoch sequence never decreases at the boundary.
+    resumed = full[len(pre_kill):]
+    assert resumed, "resumed run appended no history"
     resume_start = resumed[0]["epoch"]
     final = resumed[-1]
 

@@ -132,8 +132,12 @@ def main():
                          "of the exact path")
     ap.add_argument("--workers", type=int, default=1,
                     help="--ingest_workers for the child CLI (streaming "
-                         "shard fan-out; needs --stream)")
+                         "shard fan-out; requires --stream — the exact "
+                         "loader is serial and would mislabel the row)")
     args = ap.parse_args()
+    if args.workers > 1 and not args.stream:
+        ap.error("--workers requires --stream (the exact loader is "
+                 "serial; the row would mislabel ingest_workers)")
     root = args.keep_tree or tempfile.mkdtemp(prefix="ingest_scale_",
                                               dir="/tmp")
     data_dir = os.path.join(root, "data")

@@ -16,14 +16,14 @@ SLEEP=${TPU_WATCH_SLEEP:-240}
 OUT=${TPU_WATCH_OUT:-benchmarks/tpu_r5_results.jsonl}
 # whatever kills the watcher, never leave the paused CPU hogs frozen
 trap 'if [ -f benchmarks/cpu_hogs.pid ]; then
-        xargs -r kill -CONT < benchmarks/cpu_hogs.pid 2>/dev/null; fi' EXIT
+        xargs -r kill -CONT -- < benchmarks/cpu_hogs.pid 2>/dev/null; fi' EXIT
 for i in $(seq 1 "$PROBES"); do
   if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel healthy (probe $i); running bench"
     # single-core host: pause background CPU hogs (e.g. the 24-seed
     # quality run) so host-side dispatch isn't starved mid-measurement
     if [ -f benchmarks/cpu_hogs.pid ]; then
-      xargs -r kill -STOP < benchmarks/cpu_hogs.pid 2>/dev/null \
+      xargs -r kill -STOP -- < benchmarks/cpu_hogs.pid 2>/dev/null \
         && echo "$(date -u +%FT%TZ) paused cpu hogs"
     fi
     BENCH_PROBE_TIMEOUT=75 BENCH_PROBE_TRIES=2 timeout 5400 python bench.py
@@ -55,14 +55,14 @@ for i in $(seq 1 "$PROBES"); do
           --epochs 60 >> "$OUT"
         echo "$(date -u +%FT%TZ) endurance drill rc=$?"
         if [ -f benchmarks/cpu_hogs.pid ]; then
-          xargs -r kill -CONT < benchmarks/cpu_hogs.pid 2>/dev/null
+          xargs -r kill -CONT -- < benchmarks/cpu_hogs.pid 2>/dev/null
         fi
         exit 0
       fi
       echo "$(date -u +%FT%TZ) TPU suite incomplete; will retry"
     fi
     if [ -f benchmarks/cpu_hogs.pid ]; then
-      xargs -r kill -CONT < benchmarks/cpu_hogs.pid 2>/dev/null \
+      xargs -r kill -CONT -- < benchmarks/cpu_hogs.pid 2>/dev/null \
         && echo "$(date -u +%FT%TZ) resumed cpu hogs"
     fi
   else

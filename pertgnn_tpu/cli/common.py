@@ -20,7 +20,14 @@ def apply_platform_env() -> None:
     tunnel) takes precedence over the env var — needed for virtual-device
     mesh runs (`JAX_PLATFORMS=cpu` +
     `--xla_force_host_platform_device_count=N`). No-op once a backend is
-    initialized."""
+    initialized.
+
+    When cpu is requested, the tunnel plugin's backend factory is also
+    REMOVED: the plugin re-sets jax_platforms at interpreter start and
+    its get_backend hook has been observed (round 5) initializing the
+    tunnel backend anyway — which blocks forever inside the PJRT client
+    constructor whenever the relay is half-open. A cpu-intended process
+    must have no path that can dial the relay."""
     want = os.environ.get("JAX_PLATFORMS", "")
     if want:
         import jax
@@ -28,6 +35,15 @@ def apply_platform_env() -> None:
             jax.config.update("jax_platforms", want)
         except RuntimeError:
             pass
+        if all(p.strip() == "cpu" for p in want.split(",")):
+            try:
+                from jax._src import xla_bridge as _xb
+                # only the relay plugin: popping built-in names (tpu,
+                # cuda) breaks later MLIR lowering-rule registration,
+                # which validates platforms against this registry
+                _xb._backend_factories.pop("axon", None)
+            except Exception:  # jax internals moved — config alone stands
+                pass
 
 
 def add_model_train_flags(p: argparse.ArgumentParser) -> None:

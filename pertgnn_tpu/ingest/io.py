@@ -290,6 +290,11 @@ def load_raw_csvs_streaming(data_dir: str, cfg: IngestConfig,
                 log.info("stream-read %s: %d rows, vocab sizes ms=%d "
                          "trace=%d", f, nrows, len(ms_vocab.items),
                          len(vocabs["traceid"].items))
+        except BaseException:
+            if pool is not None:  # don't parse 2*workers more shards
+                pool.shutdown(cancel_futures=True)  # before surfacing the
+                pool = None                         # corrupt-shard error
+            raise
         finally:
             if pool is not None:
                 pool.shutdown()
