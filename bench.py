@@ -485,6 +485,29 @@ def main():
     fit_med = statistics.median(fit_w)
     ceil_med = statistics.median(ceil_w)
     cceil_med = statistics.median(cceil_w)
+    # Direct A/B of the round-4 flagship change in the SAME capture
+    # window: the identical fit() with per-chunk recipe transfers
+    # (stage_epoch_recipes=False) — on the tunnel each small device_put
+    # costs ~3.5 ms x 4 fields x chunks/epoch, the mechanism behind the
+    # r3 on-chip fit_over_ceiling of 0.659 (bench_r3_tpu.json predates
+    # staging, so without this row the staged lever would only ever be
+    # inferred across rounds, never measured in one window).
+    import dataclasses as _dc
+
+    from pertgnn_tpu.train.loop import fit as _fit
+    cfg_uns = cfg.replace(train=_dc.replace(cfg.train,
+                                            stage_epoch_recipes=False))
+    # Guarded: a tunnel flap during this EXTRA measurement (the config
+    # doing thousands of small per-chunk device_puts — the flap-prone
+    # op) must not discard the already-captured main windows.
+    try:
+        _, hist_u = _fit(ds, cfg_uns, epochs=max(3, _WINDOWS // 2) + 1)
+        unstaged_w = [r["graphs_per_s"] for r in hist_u[1:]]
+        unstaged_med = statistics.median(unstaged_w)
+    except Exception as e:
+        print(f"WARNING: unstaged A/B fit failed ({type(e).__name__}: "
+              f"{e}); emitting nulls for the A/B fields")
+        unstaged_w, unstaged_med = [], None
     baseline = bench_torch_baseline(ds, cfg)
     eff = mfu(fit_med, flops_per_graph)
     bw_eff = mbu(fit_med, bytes_per_graph)
@@ -513,6 +536,11 @@ def main():
         "compact_ceiling_graphs_per_s": round(cceil_med, 1),
         "fit_over_compact_ceiling": round(fit_med / cceil_med, 3),
         "compact_over_packed": round(cceil_med / ceil_med, 3),
+        "fit_unstaged_graphs_per_s": (round(unstaged_med, 1)
+                                      if unstaged_med else None),
+        "unstaged_windows": [round(w, 1) for w in unstaged_w],
+        "staged_over_unstaged": (round(fit_med / unstaged_med, 3)
+                                 if unstaged_med else None),
         "mfu_pct": round(100 * eff, 2) if eff is not None else None,
         # MBU + roofline: the honest utilization story for a workload whose
         # arithmetic intensity sits far below the chip's roofline knee

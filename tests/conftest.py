@@ -20,19 +20,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-# Belt and braces: drop every non-cpu backend factory too. The axon
-# plugin re-sets jax_platforms at interpreter start and its get_backend
-# hook has initialized the tunnel backend under a cpu config (round 5) —
-# which blocks forever when the relay is half-open. Same defense as
-# pertgnn_tpu.cli.common.apply_platform_env.
-try:
-    from jax._src import xla_bridge as _xb
-    # only the relay plugin: popping built-in names (tpu, cuda) breaks
-    # later MLIR lowering-rule registration, which validates platforms
-    # against this registry
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
+# Belt and braces: drop the relay plugin's backend factory too — it
+# re-sets jax_platforms at interpreter start and its get_backend hook
+# has initialized the tunnel backend under a cpu config (round 5),
+# which blocks forever when the relay is half-open.
+from pertgnn_tpu.cli.common import drop_relay_backend_factory
+
+drop_relay_backend_factory()
 
 import numpy as np
 import pytest
