@@ -145,7 +145,30 @@ def main():
     shutil.rmtree(art_dir, ignore_errors=True)
     try:
         t0 = time.perf_counter()
-        tree = build_tree(data_dir, args.gb)
+        manifest = os.path.join(data_dir, "tree_manifest.json")
+        tree = None
+        if args.keep_tree and os.path.isfile(manifest):
+            # a kept tree is reused verbatim so exact/stream/workers
+            # variants measure the SAME bytes without a ~15 min rebuild —
+            # but only if it matches both the request and the disk: a
+            # stale manifest would make every ratio in the row relate
+            # counts to bytes the child never processed
+            with open(manifest) as f:
+                tree = json.load(f)
+            on_disk = len([f for f in os.listdir(
+                os.path.join(data_dir, "MSCallGraph")) if f.endswith(".csv")])
+            want_gb_ok = abs(tree["raw_bytes"] / 2**30 - args.gb) \
+                / max(args.gb, 1e-9) < 0.2
+            if tree["tiles"] != on_disk or not want_gb_ok:
+                print(f"kept-tree manifest mismatch (tiles {tree['tiles']} "
+                      f"vs {on_disk} on disk, {tree['raw_bytes']/2**30:.2f} "
+                      f"GB vs --gb {args.gb}); rebuilding", file=sys.stderr)
+                shutil.rmtree(data_dir, ignore_errors=True)
+                tree = None
+        if tree is None:
+            tree = build_tree(data_dir, args.gb)
+            with open(manifest, "w") as f:
+                json.dump(tree, f)
         build_s = time.perf_counter() - t0
         r = run_cli(data_dir, art_dir, stream=args.stream,
                     workers=args.workers)

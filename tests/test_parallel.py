@@ -212,6 +212,31 @@ def test_fit_with_mesh_staged_equals_streamed(ds, cfg):
             assert rs[k] == rt[k], (k, rs[k], rt[k])
 
 
+def test_fit_with_mesh_staged_byte_cap_falls_back(ds, cfg, caplog):
+    """The sharded staging fallback (stage_recipes_max_mb exceeded ->
+    per-chunk put with a length-1 replicated epoch axis, sliced away on
+    device) must warn and keep the exact staged trajectory."""
+    import dataclasses
+    import logging
+
+    from pertgnn_tpu.train.loop import fit
+
+    mesh = make_mesh(data=8, model=1)
+    c_staged = cfg.replace(train=dataclasses.replace(
+        cfg.train, scan_chunk=2, stage_epoch_recipes=True))
+    c_capped = cfg.replace(train=dataclasses.replace(
+        cfg.train, scan_chunk=2, stage_epoch_recipes=True,
+        stage_recipes_max_mb=1e-6))
+    _, h_staged = fit(ds, c_staged, epochs=2, mesh=mesh)
+    with caplog.at_level(logging.WARNING, logger="pertgnn_tpu.train.loop"):
+        _, h_capped = fit(ds, c_capped, epochs=2, mesh=mesh)
+    assert any("falling back to per-chunk transfers" in r.message
+               for r in caplog.records)
+    for rs, rc in zip(h_staged, h_capped):
+        for k in ("train_qloss", "train_mae", "valid_mae", "test_mae"):
+            assert rs[k] == rc[k], (k, rs[k], rc[k])
+
+
 def test_fit_with_mesh_host_packed(ds, cfg):
     """The host-packed SPMD path still works when the arena budget forces
     the fallback (arena_hbm_budget_gb=0)."""

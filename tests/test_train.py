@@ -283,3 +283,30 @@ def test_eval_deterministic(preprocessed):
     a = evaluate(es, state, ds.batches("valid"))
     b = evaluate(es, state, ds.batches("valid"))
     assert a == b
+
+
+def test_staged_recipes_byte_cap_falls_back_per_chunk(preprocessed, caplog):
+    """stage_recipes_max_mb (ADVICE r4): a staged epoch bigger than the
+    cap must warn and fall back to per-chunk transfers through the SAME
+    put path — with an identical training trajectory."""
+    import dataclasses
+    import logging
+
+    base = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=150, batch_size=8),
+        model=ModelConfig(hidden_channels=8, num_layers=2),
+        train=TrainConfig(lr=1e-2, epochs=2, label_scale=1000.0,
+                          scan_chunk=2, device_materialize=True,
+                          stage_epoch_recipes=True),
+    )
+    capped = base.replace(train=dataclasses.replace(
+        base.train, stage_recipes_max_mb=1e-6))  # ~1 byte: always exceeded
+    _, hist_staged = fit(build_dataset(preprocessed, base), base)
+    with caplog.at_level(logging.WARNING, logger="pertgnn_tpu.train.loop"):
+        _, hist_capped = fit(build_dataset(preprocessed, capped), capped)
+    assert any("falling back to per-chunk transfers" in r.message
+               for r in caplog.records)
+    for rs, rc in zip(hist_staged, hist_capped):
+        for k in ("train_qloss", "train_mae", "valid_mae", "test_mae"):
+            assert rs[k] == rc[k], (k, rs[k], rc[k])
