@@ -405,35 +405,10 @@ def _probe_backend() -> bool:
     take TPU if ANY succeeds. Total budget at the defaults (4 x 75 s +
     3 x 10 s pauses ~ 5.5 min) stays near the old single 240 s probe.
     Must run BEFORE the first jax import in this process. Returns True if
-    the fallback engaged."""
-    import subprocess
-    import sys
-
-    # Only guard the known-flaky default (unset, or the axon relay); an
-    # EXPLICIT platform choice is always honored — if it is broken the
-    # bench should fail loudly, not silently remeasure on CPU.
-    if os.environ.get("JAX_PLATFORMS", "axon") not in ("", "axon"):
-        return False
-    timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
-    tries = int(os.environ.get("BENCH_PROBE_TRIES", "4"))
-    last = None
-    for attempt in range(tries):
-        if attempt:
-            time.sleep(int(os.environ.get("BENCH_PROBE_PAUSE", "10")))
-        try:
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout_s, check=True, stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL)
-            return False
-        except Exception as e:
-            last = e
-            print(f"WARNING: accelerator backend probe "
-                  f"{attempt + 1}/{tries} failed ({e!r})", file=sys.stderr)
-    print(f"WARNING: all {tries} backend probes failed (last: {last!r}); "
-          f"falling back to JAX_PLATFORMS=cpu", file=sys.stderr)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    return True
+    the fallback engaged. Implementation is the shared polling probe in
+    pertgnn_tpu.cli.common (also used by the driver's entry())."""
+    from pertgnn_tpu.cli.common import probe_backend_or_fallback
+    return probe_backend_or_fallback()
 
 
 def _persist_last_good_tpu(result: dict) -> None:
