@@ -35,6 +35,21 @@ from pertgnn_tpu.config import Config, IngestConfig, DataConfig
 from pertgnn_tpu.ingest import synthetic
 
 
+@pytest.fixture(autouse=True)
+def _isolate_pkg_logging():
+    """setup_logging() (run by CLI tests) sets propagate=False and adds a
+    handler on the package logger GLOBALLY — which silently breaks any
+    later caplog-based test (caplog listens on root). Snapshot + restore
+    around every test so logging state cannot leak across tests."""
+    import logging
+
+    pkg = logging.getLogger("pertgnn_tpu")
+    prev = (pkg.propagate, list(pkg.handlers), pkg.level)
+    yield
+    pkg.propagate, pkg.level = prev[0], prev[2]
+    pkg.handlers[:] = prev[1]
+
+
 @pytest.fixture(scope="session")
 def synth():
     """A small synthetic dataset shared across the session."""

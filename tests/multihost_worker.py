@@ -1,6 +1,7 @@
-"""Worker for the 2-process CPU multi-host test (tests/test_multihost.py).
+"""Worker for the real-multi-process CPU tests (tests/test_multihost.py).
 
-Each process: 2 virtual CPU devices -> 4 global devices over 2 processes.
+Each process: 2 virtual CPU devices -> NPROC*2 global devices over NPROC
+processes (2 by default in the suite; 4 in the opt-in scale-out test).
 Runs (a) ONE host-packed sharded train step on the deterministic first
 global batch, (b) one full fit() epoch through the device-materialized
 multi-host path. Process 0 writes the metrics to the JSON path in argv so
@@ -62,7 +63,7 @@ data = synthetic.generate(synthetic.SyntheticSpec(
 pre = preprocess(data.spans, data.resources, cfg.ingest)
 ds = build_dataset(pre, cfg)
 
-n_shards = 4
+n_shards = NPROC * 2  # 2 virtual devices per process
 mesh = make_mesh(data=n_shards, model=1)
 
 # (a) one host-packed sharded step on the first global batch: this process
