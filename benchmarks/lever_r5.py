@@ -1,0 +1,80 @@
+"""feature_all_stage_copies lever at the reference's FULL length.
+
+Round 4 measured the lever (features on every PERT stage copy vs the
+reference's live last-copy-only behavior) as a 1.40x train-fit win at
+20 epochs. This re-measures at 100 epochs — the reference's default
+(pert_gnn.py:26) — so the beats-the-reference claim carries the same
+horizon as the parity tables. Ours-vs-ours: both arms are this
+framework, only the featurization flag differs.
+
+    python benchmarks/lever_r5.py [--seeds 8] [--epochs 100]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from pertgnn_tpu.cli.common import apply_platform_env
+
+apply_platform_env()
+
+from run import _dataset, _flagship_cfg, _mean_ci95, _ratio_ci95  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=100)
+    args = ap.parse_args()
+
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import evaluate, fit, make_eval_step
+
+    base = _flagship_cfg()
+    base = base.replace(
+        data=dataclasses.replace(base.data, batch_size=32),
+        train=dataclasses.replace(base.train, epochs=args.epochs,
+                                  scan_chunk=4, lr=1e-3),
+        graph_type="pert")
+    arms = {}
+    for name, all_copies in (("last_copy_reference", False),
+                             ("all_copies_lever", True)):
+        cfg = base.replace(model=dataclasses.replace(
+            base.model, feature_all_stage_copies=all_copies))
+        ds = _dataset(dict(num_entries=6, traces_per_entry=120, seed=5), cfg)
+        fits = []
+        for seed in range(args.seeds):
+            c = cfg.replace(train=dataclasses.replace(cfg.train, seed=seed))
+            state, _ = fit(ds, c)
+            model = make_model(c.model, ds.num_ms, ds.num_entries,
+                               ds.num_interfaces, ds.num_rpctypes)
+            m = evaluate(make_eval_step(model, c), state,
+                         ds.batches("train"))
+            fits.append(m["mae"])
+        mean, ci = _mean_ci95(fits)
+        arms[name] = {"trainfit_mean_mae": round(mean, 1),
+                      "ci95": round(ci, 1),
+                      "per_seed": [round(v, 1) for v in fits]}
+    lo, hi = _ratio_ci95(arms["last_copy_reference"]["per_seed"],
+                         arms["all_copies_lever"]["per_seed"])
+    ratio = (arms["last_copy_reference"]["trainfit_mean_mae"]
+             / max(arms["all_copies_lever"]["trainfit_mean_mae"], 1e-9))
+    print(json.dumps({
+        "metric": "feature_all_stage_copies_lever_100ep",
+        "value": round(ratio, 3),
+        "unit": "reference-faithful MAE / lever MAE (>1 = lever wins)",
+        "ratio_ci95": [round(lo, 3), round(hi, 3)],
+        "epochs": args.epochs, "seeds": args.seeds, **arms,
+    }))
+
+
+if __name__ == "__main__":
+    main()
