@@ -398,6 +398,19 @@ def _ratio_ci95(num, den, n_boot: int = 20_000,
             float(np.percentile(ratios, 97.5)))
 
 
+def parity_protocol(epochs: int):
+    """The quality-parity training protocol shared by `quality_parity`
+    and `lever_r5.py` (so cross-table comparisons can't silently
+    diverge): flagship hparams at batch 32 / lr 1e-3 / scan_chunk 4 and
+    the fixed 6-entry corpus. Returns (base_cfg, dataset_spec_kwargs)."""
+    base = _flagship_cfg()
+    base = base.replace(
+        data=dataclasses.replace(base.data, batch_size=32),
+        train=dataclasses.replace(base.train, epochs=epochs, scan_chunk=4,
+                                  lr=1e-3))
+    return base, dict(num_entries=6, traces_per_entry=120, seed=5)
+
+
 def quality_parity(seeds: int | None = None) -> dict:
     """Model-quality parity: our model vs the torch re-implementation of
     the reference's stack (bench.make_torch_reference), trained with the
@@ -416,7 +429,6 @@ def quality_parity(seeds: int | None = None) -> dict:
 
     from pertgnn_tpu.train.loop import fit
 
-    base = _flagship_cfg()
     if seeds is None:
         seeds = int(os.environ.get("QUALITY_SEEDS", "10"))
     epochs = int(os.environ.get("QUALITY_EPOCHS", "20"))
@@ -431,10 +443,7 @@ def quality_parity(seeds: int | None = None) -> dict:
     if bad or not gtypes:
         raise SystemExit(f"QUALITY_GRAPH_TYPES must name pert and/or span, "
                          f"got {bad or 'nothing'}")
-    base = base.replace(
-        data=dataclasses.replace(base.data, batch_size=32),
-        train=dataclasses.replace(base.train, epochs=epochs, scan_chunk=4,
-                                  lr=1e-3))
+    base, spec_kwargs = parity_protocol(epochs)
     out = {"metric": "quality_parity_test_mae_ratio",
            "unit": "ours/torch ratio of mean test MAE (lower is better)",
            "epochs": epochs, "seeds_per_side": seeds,
@@ -452,7 +461,7 @@ def quality_parity(seeds: int | None = None) -> dict:
     #   and the meaningful head-to-head of the two implementations.
     for gtype in gtypes:
         cfg = base.replace(graph_type=gtype)
-        ds = _dataset(dict(num_entries=6, traces_per_entry=120, seed=5), cfg)
+        ds = _dataset(spec_kwargs, cfg)
         sample = next(ds.batches("train"))
 
         def eval_split(predict, to_torch, split):
