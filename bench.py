@@ -55,6 +55,60 @@ _TRACES_PER_ENTRY = int(os.environ.get("BENCH_TRACES_PER_ENTRY", "12500"))
 _CPU_TRACES_PER_ENTRY = 300
 _WINDOWS = int(os.environ.get("BENCH_WINDOWS", "6"))
 
+# Wedge-resilient capture (round 5): the axon relay flaps on minute
+# timescales, and a flap mid-bench used to lose EVERY already-measured
+# window when the watcher's outer `timeout` killed the process (a blocked
+# PJRT call never raises, so in-process guards can't fire). Every
+# completed window/phase is therefore flushed to this partial file the
+# moment it exists; `bench.py --finalize-partial` (host-only, run by the
+# watcher after a dead bench) promotes >=_MIN_FIT_WINDOWS fit windows
+# into the pinned official result.
+_PARTIAL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "bench_partial_tpu.json")
+# a promotable salvage displaced by a NEW bench attempt parks here so the
+# new attempt dying early can't destroy it (finalizer falls back to it)
+_ORPHAN = _PARTIAL + ".orphan"
+_PIN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "benchmarks", "last_good_tpu.json")
+_MIN_FIT_WINDOWS = 3
+
+
+def _update_partial(**fields) -> None:
+    """Merge fields into the partial-capture file (atomic rename so a kill
+    mid-write can't corrupt it). Cost is ~ms against >=0.4 s windows."""
+    data = {}
+    try:
+        with open(_PARTIAL) as f:
+            data = json.load(f)
+    except Exception:
+        pass
+    data.update(fields)
+    data["updated_unix_time"] = time.time()
+    tmp = _PARTIAL + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1)
+    os.replace(tmp, _PARTIAL)
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _n_fit_windows(d: dict | None) -> int:
+    return len((d or {}).get("fit_windows") or [])
+
+
+def _discard_partials() -> None:
+    for path in (_PARTIAL, _ORPHAN):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
 
 def build_workload(traces_per_entry: int = _TRACES_PER_ENTRY):
     from pertgnn_tpu.batching import build_dataset
@@ -182,14 +236,32 @@ def bench_interleaved(ds, cfg, windows: int = 6):
     all three alike)."""
     from pertgnn_tpu.train.loop import fit
 
+    from pertgnn_tpu.utils.flops import (peak_flops_per_chip,
+                                         peak_hbm_bw_per_chip)
+
     run_packed, run_compact, flops_per_graph, bytes_per_graph = \
         make_ceiling(ds, cfg)
+    # chip peaks are queried from the LIVE backend here; the finalizer
+    # runs forced-CPU where they'd resolve to None, so they ride the
+    # partial file alongside the flops/bytes they normalize
+    _update_partial(phase="interleaved",
+                    flops_per_graph=flops_per_graph,
+                    bytes_per_graph=bytes_per_graph,
+                    peak_flops_per_chip=peak_flops_per_chip(),
+                    peak_hbm_bytes_per_s=peak_hbm_bw_per_chip())
     packed_windows: list[float] = []
     compact_windows: list[float] = []
+    fit_rows: list[float] = []
 
     def hook(epoch: int, row: dict) -> None:
+        fit_rows.append(row["graphs_per_s"])
         packed_windows.append(run_packed())
         compact_windows.append(run_compact())
+        # epoch/window 0 is compile warm-up on every list; flush the
+        # usable tails so a wedge one window later loses nothing
+        _update_partial(fit_windows=fit_rows[1:],
+                        ceiling_windows=packed_windows[1:],
+                        compact_windows=compact_windows[1:])
 
     _, history = fit(ds, cfg, epochs=windows + 1, profile_hook=hook)
     fit_windows = [row["graphs_per_s"] for row in history[1:]]
@@ -411,11 +483,7 @@ def _probe_backend() -> bool:
     return probe_backend_or_fallback()
 
 
-def _persist_last_good_tpu(result: dict) -> None:
-    """On a successful on-chip measurement, pin the JSON + commit hash to
-    benchmarks/last_good_tpu.json so a mid-round tunnel-up window is never
-    lost to the official record (VERDICT r3 weakness 1: the only r3 chip
-    number was a stale manual run)."""
+def _git_state() -> tuple[str | None, bool | None]:
     import subprocess
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -431,12 +499,176 @@ def _persist_last_good_tpu(result: dict) -> None:
             capture_output=True, text=True, timeout=10).stdout.strip())
     except Exception:
         dirty = None
+    return commit, dirty
+
+
+def _persist_last_good_tpu(result: dict, commit: str | None = None,
+                           dirty: bool | None = None) -> None:
+    """On a successful on-chip measurement, pin the JSON + commit hash to
+    benchmarks/last_good_tpu.json so a mid-round tunnel-up window is never
+    lost to the official record (VERDICT r3 weakness 1: the only r3 chip
+    number was a stale manual run). `commit`/`dirty` override HEAD when
+    finalizing a partial captured before later commits landed."""
+    if commit is None:
+        commit, dirty = _git_state()
+    here = os.path.dirname(os.path.abspath(__file__))
     path = os.path.join(here, "benchmarks", "last_good_tpu.json")
-    with open(path, "w") as f:
+    # atomic: the watcher gates future bench attempts on this file's
+    # existence, so a timeout-kill mid-write must not leave a corrupt pin
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"commit": commit, "dirty_worktree": dirty,
                    "captured_unix_time": time.time(), **result}, f, indent=1)
+    os.replace(tmp, path)
     print(f"NOTE: on-chip result pinned to {path} @ {commit}",
           file=__import__("sys").stderr)
+
+
+def _assemble_result(*, fit_w, ceil_w, cceil_w, unstaged_w, flops_per_graph,
+                     bytes_per_graph, baseline, backend, fallback,
+                     train_graphs, partial_capture=False,
+                     peak_flops=None, peak_bw=None):
+    """Build the official result JSON from measured windows. Shared by the
+    live path (main) and --finalize-partial (a wedge-killed capture with
+    >=_MIN_FIT_WINDOWS usable fit windows); ceiling/A-B fields degrade to
+    None when their windows were never reached. `peak_flops`/`peak_bw`
+    override the live-backend query with the peaks recorded at capture
+    time (the finalizer runs forced-CPU, where the query returns None)."""
+    from pertgnn_tpu.utils.flops import (mbu, mfu, peak_flops_per_chip,
+                                         peak_hbm_bw_per_chip,
+                                         roofline_graphs_per_s)
+
+    if peak_flops is None:
+        peak_flops = peak_flops_per_chip()
+    if peak_bw is None:
+        peak_bw = peak_hbm_bw_per_chip()
+    fit_med = statistics.median(fit_w)
+    ceil_med = statistics.median(ceil_w) if ceil_w else None
+    cceil_med = statistics.median(cceil_w) if cceil_w else None
+    unstaged_med = statistics.median(unstaged_w) if unstaged_w else None
+    eff = mfu(fit_med, flops_per_graph, peak=peak_flops)
+    bw_eff = mbu(fit_med, bytes_per_graph, bw=peak_bw)
+    roofline = roofline_graphs_per_s(flops_per_graph, bytes_per_graph,
+                                     peak_f=peak_flops, peak_b=peak_bw)
+
+    def spread_pct(ws):
+        return round(100.0 * (max(ws) - min(ws)) / max(statistics.median(ws),
+                                                       1e-9), 1)
+
+    result = {
+        "metric": "pert_e2e_fit_train_call_graphs_per_sec_per_chip",
+        "value": round(fit_med, 1),
+        "unit": "graphs/s",
+        "vs_baseline": round(fit_med / baseline, 2),
+        "fit_windows": [round(w, 1) for w in fit_w],
+        "fit_spread_pct": spread_pct(fit_w),
+        "ceiling_graphs_per_s": (round(ceil_med, 1)
+                                 if ceil_med is not None else None),
+        "ceiling_windows": [round(w, 1) for w in ceil_w],
+        "ceiling_spread_pct": spread_pct(ceil_w) if ceil_w else None,
+        "fit_over_ceiling": (round(fit_med / ceil_med, 3)
+                             if ceil_med is not None else None),
+        # the production compact program replayed on one resident chunk:
+        # fit/compact = input-pipeline efficiency; compact/packed = cost
+        # of on-device recipe expansion + arena materialization
+        "compact_ceiling_graphs_per_s": (round(cceil_med, 1)
+                                         if cceil_med is not None else None),
+        "fit_over_compact_ceiling": (round(fit_med / cceil_med, 3)
+                                     if cceil_med is not None else None),
+        "compact_over_packed": (round(cceil_med / ceil_med, 3)
+                                if ceil_med is not None
+                                and cceil_med is not None else None),
+        "fit_unstaged_graphs_per_s": (round(unstaged_med, 1)
+                                      if unstaged_med is not None else None),
+        "unstaged_windows": [round(w, 1) for w in unstaged_w],
+        "staged_over_unstaged": (round(fit_med / unstaged_med, 3)
+                                 if unstaged_med is not None else None),
+        "mfu_pct": round(100 * eff, 2) if eff is not None else None,
+        # MBU + roofline: the honest utilization story for a workload whose
+        # arithmetic intensity sits far below the chip's roofline knee
+        "mbu_pct": round(100 * bw_eff, 2) if bw_eff is not None else None,
+        "roofline_graphs_per_s": (round(roofline, 1)
+                                  if roofline is not None else None),
+        "flops_per_graph": (round(flops_per_graph)
+                            if flops_per_graph is not None else None),
+        "bytes_per_graph": (round(bytes_per_graph)
+                            if bytes_per_graph is not None else None),
+        "peak_flops_per_chip": peak_flops,
+        "peak_hbm_bytes_per_s": peak_bw,
+        "baseline_torch_cpu_graphs_per_s": round(baseline, 1),
+        "backend": backend,
+        "backend_fallback": fallback,
+        # what vs_baseline actually compares (VERDICT r4 #6): the torch
+        # baseline always runs on this host's CPU, so the ratio is only a
+        # cross-backend claim when our side ran on the chip
+        "comparison": f"{backend}-vs-cpu",
+        "train_graphs_per_epoch": train_graphs,
+    }
+    if partial_capture:
+        result["partial_capture"] = True
+        result["n_fit_windows"] = len(fit_w)
+    return result
+
+
+def finalize_partial() -> int:
+    """Promote a wedge-killed capture's partial file into the official
+    result. Host-only: forces the CPU backend (the relay factory is also
+    removed by apply_platform_env) so a wedged tunnel can never hang the
+    finalizer; the only compute is the torch-CPU baseline if the live run
+    died before reaching it."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from pertgnn_tpu.cli.common import apply_platform_env
+    apply_platform_env()
+
+    # candidates: the latest attempt's partial, and any orphaned salvage a
+    # newer attempt displaced — take whichever holds more fit windows
+    p = max((_read_json(_PARTIAL), _read_json(_ORPHAN)),
+            key=_n_fit_windows)
+    if not p:
+        print("finalize-partial: no partial capture file", flush=True)
+        return 1
+    fit_w = p.get("fit_windows") or []
+    if len(fit_w) < _MIN_FIT_WINDOWS:
+        print(f"finalize-partial: only {len(fit_w)} fit windows "
+              f"(< {_MIN_FIT_WINDOWS}); not promoting", flush=True)
+        return 1
+    # never downgrade: a full pin always wins; a partial pin survives
+    # unless this candidate captured strictly more fit windows
+    pin = _read_json(_PIN)
+    if pin and pin.get("backend") == "tpu":
+        if not pin.get("partial_capture"):
+            print("finalize-partial: full pin already exists; keeping it",
+                  flush=True)
+            _discard_partials()
+            return 0
+        if _n_fit_windows(pin) >= len(fit_w):
+            print(f"finalize-partial: existing partial pin has "
+                  f"{_n_fit_windows(pin)} fit windows >= candidate's "
+                  f"{len(fit_w)}; keeping it", flush=True)
+            _discard_partials()
+            return 0
+    baseline = p.get("baseline_torch_cpu_graphs_per_s")
+    if baseline is None:
+        ds, cfg = build_workload(p["traces_per_entry"])
+        baseline = bench_torch_baseline(ds, cfg)
+    result = _assemble_result(
+        fit_w=fit_w, ceil_w=p.get("ceiling_windows") or [],
+        cceil_w=p.get("compact_windows") or [],
+        unstaged_w=p.get("unstaged_windows") or [],
+        flops_per_graph=p.get("flops_per_graph"),
+        bytes_per_graph=p.get("bytes_per_graph"),
+        baseline=baseline, backend=p.get("backend", "unknown"),
+        fallback=p.get("backend_fallback", False),
+        train_graphs=p.get("train_graphs_per_epoch"),
+        partial_capture=True,
+        peak_flops=p.get("peak_flops_per_chip"),
+        peak_bw=p.get("peak_hbm_bytes_per_s"))
+    if result["backend"] == "tpu":
+        _persist_last_good_tpu(result, commit=p.get("commit"),
+                               dirty=p.get("dirty_worktree"))
+    _discard_partials()
+    print(json.dumps(result))
+    return 0
 
 
 def main():
@@ -446,20 +678,35 @@ def main():
 
     import jax
 
-    from pertgnn_tpu.utils.flops import (mbu, mfu, peak_flops_per_chip,
-                                         peak_hbm_bw_per_chip,
-                                         roofline_graphs_per_s)
-
+    # a promotable salvage from a previous attempt must survive until
+    # something better exists: park it as the orphan (the finalizer falls
+    # back to it if THIS attempt dies before _MIN_FIT_WINDOWS)
+    prev = _read_json(_PARTIAL)
+    if _n_fit_windows(prev) >= _MIN_FIT_WINDOWS:
+        os.replace(_PARTIAL, _ORPHAN)
+    else:
+        try:
+            os.remove(_PARTIAL)  # a present partial always = THIS attempt
+        except OSError:
+            pass
     tpe = _TRACES_PER_ENTRY
     if ((fallback or jax.default_backend() == "cpu")
             and "BENCH_TRACES_PER_ENTRY" not in os.environ):
         tpe = _CPU_TRACES_PER_ENTRY
     ds, cfg = build_workload(tpe)
+    commit, dirty = _git_state()
+    _update_partial(phase="workload_built", commit=commit,
+                    dirty_worktree=dirty, traces_per_entry=tpe,
+                    backend=jax.default_backend(),
+                    backend_fallback=fallback,
+                    train_graphs_per_epoch=len(ds.splits["train"]))
     fit_w, ceil_w, cceil_w, flops_per_graph, bytes_per_graph = \
         bench_interleaved(ds, cfg, windows=_WINDOWS)
-    fit_med = statistics.median(fit_w)
-    ceil_med = statistics.median(ceil_w)
-    cceil_med = statistics.median(cceil_w)
+    # torch-CPU baseline BEFORE the flap-prone A/B: it cannot wedge, and
+    # once it lands the partial file holds a complete promotable headline
+    baseline = bench_torch_baseline(ds, cfg)
+    _update_partial(phase="baseline_done",
+                    baseline_torch_cpu_graphs_per_s=baseline)
     # Direct A/B of the round-4 flagship change in the SAME capture
     # window: the identical fit() with per-chunk recipe transfers
     # (stage_epoch_recipes=False) — on the tunnel each small device_put
@@ -472,75 +719,31 @@ def main():
     from pertgnn_tpu.train.loop import fit as _fit
     cfg_uns = cfg.replace(train=_dc.replace(cfg.train,
                                             stage_epoch_recipes=False))
-    # Guarded: a tunnel flap during this EXTRA measurement (the config
-    # doing thousands of small per-chunk device_puts — the flap-prone
-    # op) must not discard the already-captured main windows.
+    # Guarded: a tunnel flap during this EXTRA measurement must not
+    # discard the already-captured main windows. (A flap that BLOCKS
+    # instead of raising is covered by the partial file + finalizer.)
     try:
         _, hist_u = _fit(ds, cfg_uns, epochs=max(3, _WINDOWS // 2) + 1)
         unstaged_w = [r["graphs_per_s"] for r in hist_u[1:]]
-        unstaged_med = statistics.median(unstaged_w)
+        _update_partial(phase="ab_done", unstaged_windows=unstaged_w)
     except Exception as e:
         print(f"WARNING: unstaged A/B fit failed ({type(e).__name__}: "
               f"{e}); emitting nulls for the A/B fields")
-        unstaged_w, unstaged_med = [], None
-    baseline = bench_torch_baseline(ds, cfg)
-    eff = mfu(fit_med, flops_per_graph)
-    bw_eff = mbu(fit_med, bytes_per_graph)
-    roofline = roofline_graphs_per_s(flops_per_graph, bytes_per_graph)
-    peak = peak_flops_per_chip()
-    peak_bw = peak_hbm_bw_per_chip()
-
-    def spread_pct(ws):
-        return round(100.0 * (max(ws) - min(ws)) / max(statistics.median(ws),
-                                                       1e-9), 1)
-
-    result = ({
-        "metric": "pert_e2e_fit_train_call_graphs_per_sec_per_chip",
-        "value": round(fit_med, 1),
-        "unit": "graphs/s",
-        "vs_baseline": round(fit_med / baseline, 2),
-        "fit_windows": [round(w, 1) for w in fit_w],
-        "fit_spread_pct": spread_pct(fit_w),
-        "ceiling_graphs_per_s": round(ceil_med, 1),
-        "ceiling_windows": [round(w, 1) for w in ceil_w],
-        "ceiling_spread_pct": spread_pct(ceil_w),
-        "fit_over_ceiling": round(fit_med / ceil_med, 3),
-        # the production compact program replayed on one resident chunk:
-        # fit/compact = input-pipeline efficiency; compact/packed = cost
-        # of on-device recipe expansion + arena materialization
-        "compact_ceiling_graphs_per_s": round(cceil_med, 1),
-        "fit_over_compact_ceiling": round(fit_med / cceil_med, 3),
-        "compact_over_packed": round(cceil_med / ceil_med, 3),
-        "fit_unstaged_graphs_per_s": (round(unstaged_med, 1)
-                                      if unstaged_med else None),
-        "unstaged_windows": [round(w, 1) for w in unstaged_w],
-        "staged_over_unstaged": (round(fit_med / unstaged_med, 3)
-                                 if unstaged_med else None),
-        "mfu_pct": round(100 * eff, 2) if eff is not None else None,
-        # MBU + roofline: the honest utilization story for a workload whose
-        # arithmetic intensity sits far below the chip's roofline knee
-        "mbu_pct": round(100 * bw_eff, 2) if bw_eff is not None else None,
-        "roofline_graphs_per_s": (round(roofline, 1)
-                                  if roofline is not None else None),
-        "flops_per_graph": (round(flops_per_graph)
-                            if flops_per_graph is not None else None),
-        "bytes_per_graph": (round(bytes_per_graph)
-                            if bytes_per_graph is not None else None),
-        "peak_flops_per_chip": peak,
-        "peak_hbm_bytes_per_s": peak_bw,
-        "baseline_torch_cpu_graphs_per_s": round(baseline, 1),
-        "backend": jax.default_backend(),
-        "backend_fallback": fallback,
-        # what vs_baseline actually compares (VERDICT r4 #6): the torch
-        # baseline always runs on this host's CPU, so the ratio is only a
-        # cross-backend claim when our side ran on the chip
-        "comparison": f"{jax.default_backend()}-vs-cpu",
-        "train_graphs_per_epoch": len(ds.splits["train"]),
-    })
+        unstaged_w = []
+    result = _assemble_result(
+        fit_w=fit_w, ceil_w=ceil_w, cceil_w=cceil_w, unstaged_w=unstaged_w,
+        flops_per_graph=flops_per_graph, bytes_per_graph=bytes_per_graph,
+        baseline=baseline, backend=jax.default_backend(), fallback=fallback,
+        train_graphs=len(ds.splits["train"]))
     if result["backend"] == "tpu":
-        _persist_last_good_tpu(result)
+        _persist_last_good_tpu(result, commit=commit, dirty=dirty)
+    _discard_partials()  # complete capture: the official JSON wins
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
+    import sys
+
+    if "--finalize-partial" in sys.argv[1:]:
+        raise SystemExit(finalize_partial())
     main()

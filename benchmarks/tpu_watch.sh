@@ -1,12 +1,20 @@
 #!/bin/bash
-# Opportunistic on-chip bench capture (VERDICT r3 next-round #1).
+# Opportunistic on-chip bench capture (VERDICT r3 #1 / r4 #1).
 #
 # The axon relay wedges and recovers on minute-to-hour timescales; a
 # single bench invocation at a fixed time can land in a wedged window and
 # lose the whole round's chip measurement. This watcher polls a cheap
-# probe and, the moment the tunnel answers, runs the full bench — which
-# pins the result + commit hash to benchmarks/last_good_tpu.json via
-# bench.py::_persist_last_good_tpu.
+# probe and, the moment the tunnel answers, runs whatever of the capture
+# is still missing:
+#   1. bench.py — pins benchmarks/last_good_tpu.json on success; on a
+#      mid-run wedge (the outer timeout kills it) the per-window partial
+#      file is promoted by `bench.py --finalize-partial` (host-only), so
+#      >=3 captured fit windows are never lost again.
+#   2. the adjudication configs (flagship_chip, deep_wide, deep_wide_bf16,
+#      giant_dag, pallas_crossover) — one row each into $OUT, with a
+#      .r5_done marker per config so a retry window only runs what's
+#      missing.
+#   3. a chip-backend crash-resume endurance drill (best-effort extra).
 #
 # Usage: nohup bash benchmarks/tpu_watch.sh >> benchmarks/tpu_watch.log &
 set -u
@@ -14,37 +22,99 @@ cd "$(dirname "$0")/.."
 PROBES=${TPU_WATCH_PROBES:-170}
 SLEEP=${TPU_WATCH_SLEEP:-240}
 OUT=${TPU_WATCH_OUT:-benchmarks/tpu_r5_results.jsonl}
+PIN=benchmarks/last_good_tpu.json
+UPGRADE_TRIES=${TPU_WATCH_UPGRADE_TRIES:-2}
+
+# A pin only suppresses the headline bench if it parses, is on-chip, and
+# is fresh (<24 h): a stale or corrupt leftover from an earlier run must
+# not silently end this round's capture.
+pin_state() {  # prints: missing | full | partial
+  python - "$PIN" <<'EOF'
+import json, sys, time
+try:
+    d = json.load(open(sys.argv[1]))
+    ok = (d.get("backend") == "tpu"
+          and time.time() - d.get("captured_unix_time", 0) < 86400)
+    print(("partial" if d.get("partial_capture") else "full")
+          if ok else "missing")
+except Exception:
+    print("missing")
+EOF
+}
+
+upgrades_used=0
 # whatever kills the watcher, never leave the paused CPU hogs frozen
 trap 'if [ -f benchmarks/cpu_hogs.pid ]; then
         xargs -r kill -CONT -- < benchmarks/cpu_hogs.pid 2>/dev/null; fi' EXIT
 for i in $(seq 1 "$PROBES"); do
   if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "$(date -u +%FT%TZ) tunnel healthy (probe $i); running bench"
-    # single-core host: pause background CPU hogs (e.g. the 24-seed
-    # quality run) so host-side dispatch isn't starved mid-measurement
+    echo "$(date -u +%FT%TZ) tunnel healthy (probe $i)"
+    # single-core host: pause background CPU hogs (e.g. long test or
+    # quality runs) so host-side dispatch isn't starved mid-measurement
     if [ -f benchmarks/cpu_hogs.pid ]; then
       xargs -r kill -STOP -- < benchmarks/cpu_hogs.pid 2>/dev/null \
         && echo "$(date -u +%FT%TZ) paused cpu hogs"
     fi
-    BENCH_PROBE_TIMEOUT=75 BENCH_PROBE_TRIES=2 timeout 5400 python bench.py
-    rc=$?
-    echo "$(date -u +%FT%TZ) bench exited rc=$rc"
-    # a wedge can strike mid-bench; only stop once a TPU result is pinned
-    if [ $rc -eq 0 ] && [ -f benchmarks/last_good_tpu.json ]; then
-      # opportunistically capture the on-chip adjudication rows too
-      # (VERDICT r4 #4): deep_wide + bf16 lever + giant_dag + crossover.
-      # A wedge mid-suite must NOT end the watcher: record each rc and
-      # only stop once every config produced a row; otherwise keep
-      # polling and retry the whole capture on the next healthy probe.
+    ran_bench=0; bench_ok=1
+    state=$([ -f "$PIN" ] && pin_state || echo missing)
+    if [ "$state" = missing ] && [ -f "$PIN" ]; then
+      echo "$(date -u +%FT%TZ) discarding stale/corrupt pin"
+      mv -f "$PIN" "$PIN.stale"
+    fi
+    # a partial (wedge-salvaged) pin is kept but upgraded to a full
+    # capture while upgrade budget remains
+    if [ "$state" = missing ] || { [ "$state" = partial ] \
+        && [ "$upgrades_used" -lt "$UPGRADE_TRIES" ]; }; then
+      [ "$state" = partial ] && upgrades_used=$((upgrades_used + 1)) \
+        && echo "$(date -u +%FT%TZ) upgrading partial pin (try $upgrades_used/$UPGRADE_TRIES)"
+      echo "$(date -u +%FT%TZ) running bench.py"
+      ran_bench=1
+      BENCH_PROBE_TIMEOUT=75 BENCH_PROBE_TRIES=2 timeout 5400 python bench.py
+      rc=$?
+      echo "$(date -u +%FT%TZ) bench exited rc=$rc"
+      if [ $rc -ne 0 ]; then
+        bench_ok=0
+        # promote whatever windows the dead bench flushed (host-only,
+        # cannot dial the wedged tunnel); an existing partial pin
+        # survives if this attempt produced nothing better
+        JAX_PLATFORMS=cpu timeout 1800 python bench.py --finalize-partial
+        echo "$(date -u +%FT%TZ) finalize-partial rc=$?"
+      fi
+    fi
+    # Attempt the config suite only in a window where the tunnel is
+    # known-healthy: either bench just succeeded here, or bench was
+    # already pinned and the probe above just answered.
+    if [ -f "$PIN" ] && { [ $ran_bench -eq 0 ] || [ $bench_ok -eq 1 ]; }; then
       suite_ok=1
       for cfgname in flagship_chip deep_wide deep_wide_bf16 giant_dag \
                      pallas_crossover; do
+        marker="benchmarks/.r5_done_$cfgname"
+        [ -f "$marker" ] && continue
         echo "$(date -u +%FT%TZ) running benchmarks/run.py --config $cfgname"
+        tmp_row=$(mktemp)
         timeout 3600 python benchmarks/run.py --config "$cfgname" \
-          >> "$OUT"
+          > "$tmp_row"
         crc=$?
-        echo "$(date -u +%FT%TZ) $cfgname rc=$crc"
-        [ $crc -eq 0 ] || suite_ok=0
+        cat "$tmp_row" >> "$OUT"
+        # run.py exits 0 even when it only emitted a failed/skipped row
+        # (it catches per-config exceptions); the marker must mean "a
+        # real measurement exists", else a flap permanently skips the
+        # config. Gate on the row content, not just the exit code.
+        if [ $crc -eq 0 ] && python - "$tmp_row" <<'EOF'
+import json, sys
+rows = []
+for l in open(sys.argv[1]):
+    try:
+        rows.append(json.loads(l))
+    except ValueError:
+        pass  # non-JSON progress chatter doesn't decide the outcome
+ok = bool(rows) and not any(("failed" in r) or ("skipped" in r)
+                            for r in rows)
+sys.exit(0 if ok else 1)
+EOF
+        then touch "$marker"; else suite_ok=0; fi
+        echo "$(date -u +%FT%TZ) $cfgname rc=$crc done=$([ -f "$marker" ] && echo yes || echo no)"
+        rm -f "$tmp_row"
       done
       if [ $suite_ok -eq 1 ]; then
         echo "$(date -u +%FT%TZ) TPU suite captured"

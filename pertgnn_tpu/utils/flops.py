@@ -110,29 +110,41 @@ def compiled_flops(jitted, *args) -> float | None:
     return compiled_cost(jitted, *args)[0]
 
 
-def mfu(graphs_per_s: float, flops_per_graph: float | None) -> float | None:
-    """Achieved fraction of chip peak at `graphs_per_s` throughput."""
-    peak = peak_flops_per_chip()
+def mfu(graphs_per_s: float, flops_per_graph: float | None,
+        peak: float | None = None) -> float | None:
+    """Achieved fraction of chip peak at `graphs_per_s` throughput. `peak`
+    overrides the live-backend query (e.g. finalizing a capture on a host
+    whose backend differs from the one that measured)."""
+    if peak is None:
+        peak = peak_flops_per_chip()
     if peak is None or flops_per_graph is None:
         return None
     return graphs_per_s * flops_per_graph / peak
 
 
-def mbu(graphs_per_s: float, bytes_per_graph: float | None) -> float | None:
+def mbu(graphs_per_s: float, bytes_per_graph: float | None,
+        bw: float | None = None) -> float | None:
     """Achieved fraction of peak HBM bandwidth — the honest utilization
-    number when arithmetic intensity sits below the roofline knee."""
-    bw = peak_hbm_bw_per_chip()
+    number when arithmetic intensity sits below the roofline knee. `bw`
+    overrides the live-backend query."""
+    if bw is None:
+        bw = peak_hbm_bw_per_chip()
     if bw is None or bytes_per_graph is None:
         return None
     return graphs_per_s * bytes_per_graph / bw
 
 
 def roofline_graphs_per_s(flops_per_graph: float | None,
-                          bytes_per_graph: float | None) -> float | None:
+                          bytes_per_graph: float | None,
+                          peak_f: float | None = None,
+                          peak_b: float | None = None) -> float | None:
     """min(compute, bandwidth) roofline ceiling for this chip, in graphs/s:
     the hard upper bound implied by the compiled program's FLOPs and bytes
-    against the device's peaks."""
-    peak_f, peak_b = peak_flops_per_chip(), peak_hbm_bw_per_chip()
+    against the device's peaks (overridable, as above)."""
+    if peak_f is None:
+        peak_f = peak_flops_per_chip()
+    if peak_b is None:
+        peak_b = peak_hbm_bw_per_chip()
     bounds = []
     if peak_f is not None and flops_per_graph:
         bounds.append(peak_f / flops_per_graph)
