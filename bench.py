@@ -102,8 +102,24 @@ def _n_fit_windows(d: dict | None) -> int:
     return len((d or {}).get("fit_windows") or [])
 
 
-def _discard_partials() -> None:
+def _salvage_rank(d: dict | None) -> tuple[bool, int]:
+    """Orders salvage candidates: any on-chip capture outranks any
+    CPU-fallback one (only TPU results can be pinned), then more fit
+    windows wins."""
+    return ((d or {}).get("backend") == "tpu", _n_fit_windows(d))
+
+
+def _discard_partials(keep_tpu_salvage: bool = False) -> None:
+    """Remove salvage files. With keep_tpu_salvage (a completed run that
+    did NOT pin an on-chip result), a still-promotable TPU salvage
+    survives for a later finalize — a CPU fallback must never destroy the
+    round's only chip windows."""
     for path in (_PARTIAL, _ORPHAN):
+        if keep_tpu_salvage:
+            d = _read_json(path)
+            if (d and d.get("backend") == "tpu"
+                    and _n_fit_windows(d) >= _MIN_FIT_WINDOWS):
+                continue
         try:
             os.remove(path)
         except OSError:
@@ -254,13 +270,15 @@ def bench_interleaved(ds, cfg, windows: int = 6):
     fit_rows: list[float] = []
 
     def hook(epoch: int, row: dict) -> None:
+        # flush the fit window BEFORE the ceiling replays: those device
+        # calls are as flap-prone as a fit epoch, and a wedge inside them
+        # must not cost the fit measurement already in hand. Epoch/window
+        # 0 is compile warm-up on every list — only the tails are usable.
         fit_rows.append(row["graphs_per_s"])
+        _update_partial(fit_windows=fit_rows[1:])
         packed_windows.append(run_packed())
         compact_windows.append(run_compact())
-        # epoch/window 0 is compile warm-up on every list; flush the
-        # usable tails so a wedge one window later loses nothing
-        _update_partial(fit_windows=fit_rows[1:],
-                        ceiling_windows=packed_windows[1:],
+        _update_partial(ceiling_windows=packed_windows[1:],
                         compact_windows=compact_windows[1:])
 
     _, history = fit(ds, cfg, epochs=windows + 1, profile_hook=hook)
@@ -511,16 +529,14 @@ def _persist_last_good_tpu(result: dict, commit: str | None = None,
     finalizing a partial captured before later commits landed."""
     if commit is None:
         commit, dirty = _git_state()
-    here = os.path.dirname(os.path.abspath(__file__))
-    path = os.path.join(here, "benchmarks", "last_good_tpu.json")
     # atomic: the watcher gates future bench attempts on this file's
     # existence, so a timeout-kill mid-write must not leave a corrupt pin
-    tmp = path + ".tmp"
+    tmp = _PIN + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"commit": commit, "dirty_worktree": dirty,
                    "captured_unix_time": time.time(), **result}, f, indent=1)
-    os.replace(tmp, path)
-    print(f"NOTE: on-chip result pinned to {path} @ {commit}",
+    os.replace(tmp, _PIN)
+    print(f"NOTE: on-chip result pinned to {_PIN} @ {commit}",
           file=__import__("sys").stderr)
 
 
@@ -621,9 +637,10 @@ def finalize_partial() -> int:
     apply_platform_env()
 
     # candidates: the latest attempt's partial, and any orphaned salvage a
-    # newer attempt displaced — take whichever holds more fit windows
+    # newer attempt displaced — a TPU capture outranks a CPU-fallback one
+    # regardless of window count (only TPU results pin), then more windows
     p = max((_read_json(_PARTIAL), _read_json(_ORPHAN)),
-            key=_n_fit_windows)
+            key=_salvage_rank)
     if not p:
         print("finalize-partial: no partial capture file", flush=True)
         return 1
@@ -680,9 +697,11 @@ def main():
 
     # a promotable salvage from a previous attempt must survive until
     # something better exists: park it as the orphan (the finalizer falls
-    # back to it if THIS attempt dies before _MIN_FIT_WINDOWS)
+    # back to it if THIS attempt dies before _MIN_FIT_WINDOWS) — unless
+    # the orphan slot already holds a higher-ranked salvage
     prev = _read_json(_PARTIAL)
-    if _n_fit_windows(prev) >= _MIN_FIT_WINDOWS:
+    if (_n_fit_windows(prev) >= _MIN_FIT_WINDOWS
+            and _salvage_rank(prev) > _salvage_rank(_read_json(_ORPHAN))):
         os.replace(_PARTIAL, _ORPHAN)
     else:
         try:
@@ -737,7 +756,23 @@ def main():
         train_graphs=len(ds.splits["train"]))
     if result["backend"] == "tpu":
         _persist_last_good_tpu(result, commit=commit, dirty=dirty)
-    _discard_partials()  # complete capture: the official JSON wins
+    else:
+        # CPU fallback at capture time: if the watcher pinned an on-chip
+        # result earlier in the round, carry it inside this JSON so the
+        # round artifact holds the chip evidence next to the fallback
+        # number instead of forcing readers to a second file
+        pin = _read_json(_PIN)
+        if pin and pin.get("backend") == "tpu":
+            result["last_good_tpu"] = {
+                k: pin.get(k) for k in (
+                    "commit", "captured_unix_time", "value", "unit",
+                    "vs_baseline", "fit_over_ceiling",
+                    "ceiling_graphs_per_s", "staged_over_unstaged",
+                    "partial_capture", "n_fit_windows")
+                if k in pin}
+    # complete capture: the official JSON wins — but a CPU fallback must
+    # not destroy an unfinalized TPU salvage it didn't supersede
+    _discard_partials(keep_tpu_salvage=(result["backend"] != "tpu"))
     print(json.dumps(result))
 
 
