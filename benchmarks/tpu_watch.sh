@@ -24,6 +24,10 @@ SLEEP=${TPU_WATCH_SLEEP:-240}
 OUT=${TPU_WATCH_OUT:-benchmarks/tpu_r5_results.jsonl}
 PIN=benchmarks/last_good_tpu.json
 UPGRADE_TRIES=${TPU_WATCH_UPGRADE_TRIES:-2}
+# per-config budget: generous vs a legitimate run (minutes), small vs the
+# relay's recovery timescale — a wedged config must not hold a recovered
+# tunnel hostage for a full hour before the next retry
+CFG_TIMEOUT=${TPU_WATCH_CFG_TIMEOUT:-1800}
 
 # A pin only suppresses the headline bench if it parses, is on-chip, and
 # is fresh (<24 h): a stale or corrupt leftover from an earlier run must
@@ -92,7 +96,7 @@ for i in $(seq 1 "$PROBES"); do
         [ -f "$marker" ] && continue
         echo "$(date -u +%FT%TZ) running benchmarks/run.py --config $cfgname"
         tmp_row=$(mktemp)
-        timeout 3600 python benchmarks/run.py --config "$cfgname" \
+        timeout "$CFG_TIMEOUT" python benchmarks/run.py --config "$cfgname" \
           > "$tmp_row"
         crc=$?
         cat "$tmp_row" >> "$OUT"
