@@ -25,6 +25,9 @@ plus a host data-path config:
                     (the reference's "10+ hour" offline build).
 +  quality_parity  — test MAE, ours vs the torch re-implementation of the
                     reference stack, median over 3 seeds each.
++  scan_chunk_sweep — lax.scan fusion depth {8,16,32,64} on the flagship,
+                    cached-chunk replay, interleaved; picks the dispatch-
+                    amortization default with on-chip evidence.
 """
 
 from __future__ import annotations
@@ -604,8 +607,78 @@ def pallas_crossover() -> dict:
             "nodes": N, "table": rows}
 
 
+def scan_chunk_sweep() -> dict:
+    """Scan-fusion depth sweep on the flagship model: how many train steps
+    to fuse into one `lax.scan` program per dispatch.
+
+    Per-program dispatch is the dominant per-step overhead on the
+    tunnel-attached chip (~300 us dispatch vs ~60 us compute per step —
+    RESULTS.md notes; scan fusion at depth 16 took the r1 flagship from
+    410k to 2.37M graphs/s on cached chunks). This row measures
+    cached-chunk replay graphs/s at scan_chunk in {8, 16, 32, 64} so the
+    flagship default is picked with on-chip evidence. Depths are
+    interleaved round-robin x3 so tunnel variance hits all alike. Runs on
+    any backend (stamped); only the chip rows carry decision weight —
+    CPU has no dispatch gap to amortize.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bench import _window_runner
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import (_host_chunks, create_train_state,
+                                        make_train_chunk)
+
+    depths = (8, 16, 32, 64)
+    base = _flagship_cfg()
+    ds = _dataset(dict(num_microservices=60, num_entries=8,
+                       patterns_per_entry=4, traces_per_entry=3000,
+                       seed=42), base)
+    # pack the deepest chunk's batches ONCE and slice per depth (the
+    # unshuffled train stream is deterministic, so host64[:d] is exactly
+    # what a per-depth islice would repack at much more host cost)
+    host64 = list(itertools.islice(ds.batches("train"), max(depths)))
+    if len(host64) < max(depths):
+        # padded filler chunks would bill compute for zero graphs and
+        # understate the deep depths — refuse rather than mis-measure
+        raise SystemExit(f"scan_chunk_sweep needs {max(depths)} real "
+                         f"train batches, got {len(host64)}")
+    model = make_model(base.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    tx = optax.adam(base.train.lr)
+    runners = {}
+    for d in depths:
+        cfg = base.replace(train=dataclasses.replace(base.train,
+                                                     scan_chunk=d))
+        host = host64[:d]
+        graphs = sum(int(b.graph_mask.sum()) for b in host)
+        chunk_batch = jax.tree.map(jnp.asarray,
+                                   next(_host_chunks(iter(host), d)))
+        b0 = jax.tree.map(lambda a: jnp.asarray(a[0]), chunk_batch)
+        state = create_train_state(model, tx, b0, cfg.train.seed)
+        chunk = make_train_chunk(model, cfg, tx)
+        runners[d] = _window_runner(chunk, state, chunk_batch, graphs)
+
+    windows = {d: [] for d in depths}
+    for _ in range(3):
+        for d in depths:
+            windows[d].append(runners[d]())
+    meds = {d: float(np.median(w)) for d, w in windows.items()}
+    best = max(meds, key=meds.get)
+    return {"metric": "scan_chunk_sweep_graphs_per_s",
+            "value": round(meds[best], 1), "unit": "graphs/s",
+            "best_scan_chunk": best,
+            "medians": {str(d): round(v, 1) for d, v in meds.items()},
+            "windows": {str(d): [round(x, 1) for x in w]
+                        for d, w in windows.items()},
+            "best_over_chunk8": round(meds[best] / meds[8], 3),
+            "chunk16_over_chunk8": round(meds[16] / meds[8], 3)}
+
+
 CONFIGS = {
     "ingest_pipeline": ingest_pipeline,
+    "scan_chunk_sweep": scan_chunk_sweep,
     "quality_parity": quality_parity,
     "smoke_cpu": smoke_cpu,
     "flagship_chip": flagship_chip,

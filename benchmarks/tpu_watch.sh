@@ -82,7 +82,8 @@ for i in $(seq 1 "$PROBES"); do
         # cannot dial the wedged tunnel); an existing partial pin
         # survives if this attempt produced nothing better
         JAX_PLATFORMS=cpu timeout 1800 python bench.py --finalize-partial
-        echo "$(date -u +%FT%TZ) finalize-partial rc=$?"
+        frc=$?
+        echo "$(date -u +%FT%TZ) finalize-partial rc=$frc"
       fi
     fi
     # Attempt the config suite only in a window where the tunnel is
@@ -122,12 +123,19 @@ EOF
       done
       if [ $suite_ok -eq 1 ]; then
         echo "$(date -u +%FT%TZ) TPU suite captured"
-        # opportunistic extra (VERDICT r4 #5): chip-backend crash-resume
-        # drill — failure here must not void the captured suite
+        # opportunistic extras — failures here must not void the
+        # captured suite: scan-fusion depth sweep (flagship dispatch
+        # lever), then a chip-backend crash-resume drill (VERDICT r4 #5)
+        echo "$(date -u +%FT%TZ) running scan_chunk_sweep"
+        timeout "$CFG_TIMEOUT" python benchmarks/run.py \
+          --config scan_chunk_sweep >> "$OUT"
+        src=$?
+        echo "$(date -u +%FT%TZ) scan_chunk_sweep rc=$src"
         echo "$(date -u +%FT%TZ) running endurance drill (chip backend)"
         timeout 5400 python benchmarks/endurance_drill.py --scale cpu \
           --epochs 60 >> "$OUT"
-        echo "$(date -u +%FT%TZ) endurance drill rc=$?"
+        drc=$?
+        echo "$(date -u +%FT%TZ) endurance drill rc=$drc"
         if [ -f benchmarks/cpu_hogs.pid ]; then
           xargs -r kill -CONT -- < benchmarks/cpu_hogs.pid 2>/dev/null
         fi
