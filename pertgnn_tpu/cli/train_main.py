@@ -13,14 +13,34 @@ pert_gnn.py:348-350) plus throughput; checkpoints via orbax when
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 
 from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
                                     apply_platform_env,
                                     config_from_args, get_frames)
 from pertgnn_tpu.ingest.io import artifacts_present, load_artifacts, preprocess_cached
+from pertgnn_tpu.train import supervisor
 from pertgnn_tpu.train.loop import fit
 from pertgnn_tpu.utils.logging import setup_logging
+
+
+def _strip_flags(argv: list[str], flags: tuple[str, ...]) -> list[str]:
+    """Remove value-taking flags (both `--f V` and `--f=V` forms) from an
+    argv list — the supervised child must not re-enter the supervisor."""
+    out, skip = [], False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok in flags:
+            skip = True
+            continue
+        if any(tok.startswith(f + "=") for f in flags):
+            continue
+        out.append(tok)
+    return out
 
 
 def main(argv=None) -> None:
@@ -29,7 +49,27 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     add_ingest_flags(p)
     add_model_train_flags(p)
+    p.add_argument("--supervise", type=int, default=0, metavar="N",
+                   help="run training under a crash/hang supervisor with "
+                        "up to N automatic restart-and-resumes (requires "
+                        "--checkpoint_dir; see train/supervisor.py)")
+    p.add_argument("--hang_timeout", type=float, default=900.0,
+                   help="supervisor: kill the run if the checkpoint dir "
+                        "shows no progress for this many seconds (must "
+                        "exceed startup + one checkpoint interval)")
     args = p.parse_args(argv)
+    if args.supervise > 0 and supervisor.CHILD_ENV_MARKER not in os.environ:
+        if not args.checkpoint_dir:
+            p.error("--supervise requires --checkpoint_dir (progress "
+                    "detection and resume both live there)")
+        child_argv = _strip_flags(list(argv if argv is not None
+                                       else sys.argv[1:]),
+                                  ("--supervise", "--hang_timeout"))
+        raise SystemExit(supervisor.supervise(
+            [sys.executable, "-m", "pertgnn_tpu.cli.train_main",
+             *child_argv],
+            args.checkpoint_dir, max_restarts=args.supervise,
+            hang_timeout=args.hang_timeout))
     if args.num_processes > 1:
         from pertgnn_tpu.parallel.multihost import initialize
         initialize(args.coordinator_address or None, args.num_processes,
