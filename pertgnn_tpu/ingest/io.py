@@ -251,9 +251,20 @@ def load_raw_csvs_streaming(data_dir: str, cfg: IngestConfig,
         jobs = [(os.path.join(root, f), columns, str_cols, dedupe)
                 for f in files]
         if workers > 1:
+            import multiprocessing
             from collections import deque
             from concurrent.futures import ProcessPoolExecutor
-            pool = ProcessPoolExecutor(max_workers=workers)
+
+            # spawn, not fork: the caller may be a process that already
+            # imported jax (train_main does), and forking a multithreaded
+            # parent risks deadlock in the child (Python 3.12 warns on
+            # exactly this). Cost: spawn re-imports the caller's __main__
+            # in every worker — from train_main that includes the jax
+            # stack, seconds per worker — but one-time per pool and noise
+            # against a multi-GB tree; a silent fork deadlock is not.
+            pool = ProcessPoolExecutor(
+                max_workers=workers,
+                mp_context=multiprocessing.get_context("spawn"))
 
             def windowed():
                 # Bounded in-flight window: at most 2*workers shards are
