@@ -375,13 +375,18 @@ def main():
                          "filter)")
     ap.add_argument("--sandbox", default=None,
                     help="keep the sandbox here instead of a temp dir")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="synthetic-corpus seed: every seed is a fresh "
+                         "randomized cross-check against the reference's "
+                         "own code (topologies, timestamps, resource "
+                         "gaps all resample)")
     args = ap.parse_args()
 
     # identical parsing semantics on both sides (see run_reference shim)
     pd.set_option("future.infer_string", False)
     root = args.sandbox or tempfile.mkdtemp(prefix="refparity_")
     os.makedirs(root, exist_ok=True)
-    make_sandbox(root, args.traces)
+    make_sandbox(root, args.traces, seed=args.seed)
     proc = run_reference(root)
     if proc.returncode != 0:
         print(json.dumps({"fatal": "reference preprocess failed",
@@ -403,7 +408,7 @@ def main():
         traceback.print_exc(file=sys.stderr)
     finally:
         ok = check.all_ok and fatal is None
-        verdict = {"pass": ok, "checks": check.results,
+        verdict = {"pass": ok, "seed": args.seed, "checks": check.results,
                    "notes": check.notes, **stats,
                    "sandbox": root if args.sandbox else "(temp, removed)"}
         if fatal:
