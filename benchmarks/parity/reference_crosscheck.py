@@ -72,6 +72,24 @@ def make_sandbox(root: str, traces_per_entry: int, seed: int = 7) -> dict:
     return {"spec": spec}
 
 
+def fingerprint_corpus(root: str) -> str:
+    """sha1 over the sandbox's raw CSV bytes (sorted relative paths) —
+    proof in the verdict that a given --seed produced a distinct corpus,
+    so a dropped seed pass-through can't silently collapse the sweep
+    back to one golden input."""
+    import hashlib
+
+    h = hashlib.sha1()
+    data_root = os.path.join(root, "data")
+    for dirpath, _dirs, files in sorted(os.walk(data_root)):
+        for name in sorted(files):
+            path = os.path.join(dirpath, name)
+            h.update(os.path.relpath(path, data_root).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
 def run_reference(root: str) -> subprocess.CompletedProcess:
     """Run the reference's preprocess.py untouched, in the sandbox cwd.
 
@@ -387,6 +405,7 @@ def main():
     root = args.sandbox or tempfile.mkdtemp(prefix="refparity_")
     os.makedirs(root, exist_ok=True)
     make_sandbox(root, args.traces, seed=args.seed)
+    corpus_sha1 = fingerprint_corpus(root)
     proc = run_reference(root)
     if proc.returncode != 0:
         print(json.dumps({"fatal": "reference preprocess failed",
@@ -408,7 +427,8 @@ def main():
         traceback.print_exc(file=sys.stderr)
     finally:
         ok = check.all_ok and fatal is None
-        verdict = {"pass": ok, "seed": args.seed, "checks": check.results,
+        verdict = {"pass": ok, "seed": args.seed,
+                   "corpus_sha1": corpus_sha1, "checks": check.results,
                    "notes": check.notes, **stats,
                    "sandbox": root if args.sandbox else "(temp, removed)"}
         if fatal:

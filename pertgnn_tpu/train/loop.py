@@ -446,7 +446,12 @@ def fit(dataset: Dataset, cfg: Config,
                        dataset.num_interfaces, dataset.num_rpctypes,
                        edge_shard_mesh=mesh if edge_shard else None)
     tx = optax.adam(cfg.train.lr)
-    sample = next(dataset.batches("train"))
+    sample = next(dataset.batches("train"), None)
+    if sample is None:
+        raise ValueError(
+            "fit: the train split is empty — the ingest filters "
+            "(min_traces_per_entry, resource coverage) likely dropped "
+            "every trace; lower them or feed a larger corpus")
     if edge_shard and cfg.model.attn_dropout > 0:
         # the layer would silently fall back to full-edge unsharded
         # attention in training (layers.py), defeating the giant-graph mode

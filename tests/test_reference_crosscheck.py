@@ -62,3 +62,29 @@ def test_reference_preprocess_crosscheck_random_seeds(seed, tmp_path):
     the reference's actual executing code rather than a golden replay."""
     verdict = _run_crosscheck(tmp_path, seed=seed)
     assert verdict["seed"] == seed
+
+
+def test_sandbox_seed_actually_changes_corpus(tmp_path):
+    """Guards the sweep's premise: --seed must reach corpus generation.
+    The verdict echoing args.seed can't detect a dropped pass-through
+    (the sweep would silently re-check one golden corpus), so compare
+    corpus fingerprints for two seeds directly."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "refxcheck", os.path.join(_REPO, "benchmarks", "parity",
+                                  "reference_crosscheck.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    prints = {}
+    for seed in (7, 8):
+        root = str(tmp_path / f"s{seed}")
+        os.makedirs(root)
+        mod.make_sandbox(root, traces_per_entry=20, seed=seed)
+        prints[seed] = mod.fingerprint_corpus(root)
+    assert prints[7] != prints[8]
+    # and the fingerprint itself is deterministic for a fixed seed
+    root = str(tmp_path / "s7b")
+    os.makedirs(root)
+    mod.make_sandbox(root, traces_per_entry=20, seed=7)
+    assert mod.fingerprint_corpus(root) == prints[7]

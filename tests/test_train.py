@@ -310,3 +310,20 @@ def test_staged_recipes_byte_cap_falls_back_per_chunk(preprocessed, caplog):
     for rs, rc in zip(hist_staged, hist_capped):
         for k in ("train_qloss", "train_mae", "valid_mae", "test_mae"):
             assert rs[k] == rc[k], (k, rs[k], rc[k])
+
+
+def test_fit_empty_train_split_raises_clearly(preprocessed):
+    """A corpus whose filters leave so few examples that the positional
+    60/20/20 split gives train ZERO graphs (n=1: edges [0,0,0,1]) must
+    fail with an actionable message, not a bare StopIteration from the
+    sample probe or a TypeError from the metric sums."""
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=1, batch_size=4),
+        model=ModelConfig(hidden_channels=8),
+        train=TrainConfig(epochs=1, label_scale=1000.0),
+    )
+    ds = build_dataset(preprocessed, cfg)
+    assert len(ds.splits["train"]) == 0  # the scenario under test
+    with pytest.raises(ValueError, match="train split is empty"):
+        fit(ds, cfg)
