@@ -258,6 +258,26 @@ def get_frames(args: argparse.Namespace):
     return load_raw_csvs(args.data_dir)
 
 
+def load_or_ingest_artifacts(args: argparse.Namespace, ingest_cfg):
+    """(pre, table) from the artifact cache if complete, else ingest +
+    persist (including stream vocabs when --stream_factorize produced
+    them). Shared by train_main and predict_main so the two CLIs cannot
+    drift — notably the vocab persistence, which a predict-first
+    workflow would otherwise silently drop."""
+    from pertgnn_tpu.ingest.io import (artifacts_present, load_artifacts,
+                                       preprocess_cached,
+                                       save_stream_vocabs)
+
+    if artifacts_present(args.artifact_dir):
+        return load_artifacts(args.artifact_dir)
+    spans, resources, ingest_cfg, vocabs = get_frames_with_ingest_cfg(
+        args, ingest_cfg)
+    if vocabs is not None:
+        save_stream_vocabs(args.artifact_dir, vocabs)
+    return preprocess_cached(args.artifact_dir, spans, resources,
+                             cfg=ingest_cfg)
+
+
 def get_frames_with_ingest_cfg(args: argparse.Namespace, ingest_cfg):
     """(spans, resources, ingest_cfg, stream_vocabs|None) honoring
     --stream_factorize — shared by BOTH CLIs so the flag cannot be

@@ -1,0 +1,85 @@
+"""Inference CLI: per-trace latency predictions from a trained checkpoint.
+
+    python -m pertgnn_tpu.cli.predict_main --artifact_dir processed \
+        --graph_type pert --checkpoint_dir ckpts --out predictions.csv
+    python -m pertgnn_tpu.cli.predict_main --synthetic ... --split all
+
+Writes one CSV row per trace: traceid (factorized code — joinable back to
+raw ids via the persisted stream vocabs when --stream_factorize was used),
+entry_id, runtime_id, ts_bucket, split, y_true, y_pred. The reference has
+no inference path at all — its predictions exist only inside test()'s
+metric loop (/root/reference/pert_gnn.py:254-294).
+
+The restore target comes from train/loop.restore_target_state, the same
+construction fit() checkpoints — tree-shape compatibility by shared code,
+not by parallel maintenance.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.batching.dataset import split_indices
+from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
+                                    apply_platform_env, config_from_args,
+                                    load_or_ingest_artifacts)
+from pertgnn_tpu.train.loop import restore_target_state
+from pertgnn_tpu.train.predict import make_predict_step, predict_split
+from pertgnn_tpu.utils.logging import setup_logging
+
+_SPLITS = ("train", "valid", "test")
+
+
+def main(argv=None) -> None:
+    setup_logging()
+    apply_platform_env()
+    p = argparse.ArgumentParser(description=__doc__)
+    add_ingest_flags(p)
+    add_model_train_flags(p)
+    p.add_argument("--split", default="test",
+                   choices=(*_SPLITS, "all"),
+                   help="which positional split(s) to predict")
+    p.add_argument("--out", default="predictions.csv",
+                   help="output CSV path")
+    args = p.parse_args(argv)
+    if not args.checkpoint_dir:
+        p.error("--checkpoint_dir is required: predictions come from a "
+                "trained checkpoint (run train_main with --checkpoint_dir "
+                "first)")
+    cfg = config_from_args(args)
+
+    pre, table = load_or_ingest_artifacts(args, cfg.ingest)
+    dataset = build_dataset(pre, cfg, table)
+
+    from pertgnn_tpu.train.checkpoint import CheckpointManager
+    model, state = restore_target_state(dataset, cfg)
+    ckpt = CheckpointManager(args.checkpoint_dir,
+                             keep=args.checkpoint_keep)
+    state, start_epoch = ckpt.maybe_restore(state)
+    if start_epoch == 0:
+        p.error(f"no checkpoint found in {args.checkpoint_dir}")
+
+    # positional split ranges over the SAME meta slice build_dataset used
+    meta = table.meta.iloc[:cfg.data.max_traces]
+    parts = dict(zip(_SPLITS, split_indices(len(meta), cfg.data.split)))
+    wanted = _SPLITS if args.split == "all" else (args.split,)
+    step = make_predict_step(model, cfg)  # one compile for every split
+    frames = []
+    for split in wanted:
+        pred = predict_split(dataset, cfg, state, split, step=step)
+        rows = meta.iloc[parts[split]].copy()
+        rows["split"] = split
+        rows["y_pred"] = np.asarray(pred, np.float32)
+        frames.append(rows.rename(columns={"y": "y_true"}))
+    out = pd.concat(frames, ignore_index=True)
+    out.to_csv(args.out, index=False)
+    print(f"wrote {len(out)} predictions "
+          f"(epochs trained: {start_epoch}) to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

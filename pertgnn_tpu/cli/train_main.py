@@ -18,9 +18,8 @@ import sys
 
 from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
-                                    apply_platform_env,
-                                    config_from_args, get_frames)
-from pertgnn_tpu.ingest.io import artifacts_present, load_artifacts, preprocess_cached
+                                    apply_platform_env, config_from_args,
+                                    load_or_ingest_artifacts)
 from pertgnn_tpu.train import supervisor
 from pertgnn_tpu.train.loop import fit
 from pertgnn_tpu.utils.logging import setup_logging
@@ -77,17 +76,7 @@ def main(argv=None) -> None:
     print(args)
     cfg = config_from_args(args)
 
-    if artifacts_present(args.artifact_dir):
-        pre, table = load_artifacts(args.artifact_dir)
-    else:
-        from pertgnn_tpu.cli.common import get_frames_with_ingest_cfg
-        from pertgnn_tpu.ingest.io import save_stream_vocabs
-        spans, resources, ingest_cfg, vocabs = get_frames_with_ingest_cfg(
-            args, cfg.ingest)
-        if vocabs is not None:
-            save_stream_vocabs(args.artifact_dir, vocabs)
-        pre, table = preprocess_cached(args.artifact_dir, spans, resources,
-                                       cfg=ingest_cfg)
+    pre, table = load_or_ingest_artifacts(args, cfg.ingest)
     dataset = build_dataset(pre, cfg, table)
 
     mesh = None

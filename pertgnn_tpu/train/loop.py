@@ -403,6 +403,36 @@ def _evaluate_stream(eval_step: Callable, state: TrainState,
             "qloss": sums["qloss_sum"] / n, "count": sums["count"]}
 
 
+def make_tx(cfg: Config) -> optax.GradientTransformation:
+    """THE training optimizer — the single construction point. Checkpoint
+    restore targets (cli/predict_main.py) must build the identical
+    opt_state tree, so any change here (schedule, weight decay, clipping)
+    propagates to them by construction instead of by hand."""
+    return optax.adam(cfg.train.lr)
+
+
+def _train_sample(dataset: Dataset) -> PackedBatch:
+    sample = next(dataset.batches("train"), None)
+    if sample is None:
+        raise ValueError(
+            "fit: the train split is empty — the ingest filters "
+            "(min_traces_per_entry, resource coverage) likely dropped "
+            "every trace; lower them or feed a larger corpus")
+    return sample
+
+
+def restore_target_state(dataset: Dataset, cfg: Config
+                         ) -> tuple[PertGNN, TrainState]:
+    """(model, freshly-initialized TrainState) with exactly the tree
+    shapes the single-chip fit() trains and checkpoints — the orbax
+    restore target for inference/resume outside fit()."""
+    model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
+                       dataset.num_interfaces, dataset.num_rpctypes)
+    state = create_train_state(model, make_tx(cfg), _train_sample(dataset),
+                               cfg.train.seed)
+    return model, state
+
+
 def _resolve_device_materialize(dataset: Dataset, cfg: Config) -> bool:
     """Gate the chip-resident-arena path on the HBM budget.
 
@@ -445,13 +475,8 @@ def fit(dataset: Dataset, cfg: Config,
     model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
                        dataset.num_interfaces, dataset.num_rpctypes,
                        edge_shard_mesh=mesh if edge_shard else None)
-    tx = optax.adam(cfg.train.lr)
-    sample = next(dataset.batches("train"), None)
-    if sample is None:
-        raise ValueError(
-            "fit: the train split is empty — the ingest filters "
-            "(min_traces_per_entry, resource coverage) likely dropped "
-            "every trace; lower them or feed a larger corpus")
+    tx = make_tx(cfg)
+    sample = _train_sample(dataset)
     if edge_shard and cfg.model.attn_dropout > 0:
         # the layer would silently fall back to full-edge unsharded
         # attention in training (layers.py), defeating the giant-graph mode

@@ -1,0 +1,76 @@
+"""Per-trace latency prediction (inference) from a trained state.
+
+The reference computes predictions only transiently inside `test()`
+(/root/reference/pert_gnn.py:254-294) and discards them after metric
+accumulation — there is no way to get the model's answer for a given
+trace out of it. Here prediction is a first-class output: a jitted
+forward over a split's packed batches whose per-graph predictions are
+aligned back to the split's rows (and from there to trace ids via the
+assembled meta table — cli/predict_main.py).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import numpy as np
+
+from pertgnn_tpu.batching.dataset import Dataset
+from pertgnn_tpu.config import Config
+from pertgnn_tpu.models.pert_model import make_model
+from pertgnn_tpu.train.loop import TrainState, _device_iter
+
+log = logging.getLogger(__name__)
+
+
+def make_predict_step(model, cfg: Config):
+    """Jitted (state, batch) -> per-graph predicted latency in label units
+    (the model regresses y / label_scale; predictions are scaled back)."""
+
+    def step(state: TrainState, batch):
+        global_pred, _ = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch, training=False)
+        return global_pred * cfg.train.label_scale
+
+    return jax.jit(step)
+
+
+def predict_split(dataset: Dataset, cfg: Config, state: TrainState,
+                  split: str, step=None) -> np.ndarray:
+    """Predicted end-to-end latency for EVERY example in `split`, in the
+    split's positional order.
+
+    Alignment relies on the greedy packer filling each batch with the
+    maximal prefix of the remaining unshuffled order (batching/arena.py
+    `assign_batches`), so concatenating each batch's valid graphs
+    restores the split order — asserted, not assumed, by comparing the
+    concatenated labels to the split's label array bit-for-bit.
+
+    `step` (from make_predict_step) is rebuilt when omitted; callers
+    predicting several splits should build it once — the XLA program is
+    identical across splits (one shared batch shape).
+    """
+    if step is None:
+        model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
+                           dataset.num_interfaces, dataset.num_rpctypes)
+        step = make_predict_step(model, cfg)
+    preds: list[np.ndarray] = []
+    ys: list[np.ndarray] = []
+    for batch in _device_iter(dataset.batches(split)):
+        p = step(state, batch)
+        mask = np.asarray(batch.graph_mask)
+        preds.append(np.asarray(p)[mask])
+        ys.append(np.asarray(batch.y)[mask])
+    pred = (np.concatenate(preds) if preds
+            else np.zeros(0, np.float32))
+    got_y = (np.concatenate(ys) if ys else np.zeros(0, np.float32))
+    want_y = np.asarray(dataset.splits[split].ys, np.float32)
+    if not np.array_equal(got_y, want_y):
+        raise AssertionError(
+            f"prediction order lost alignment with the '{split}' split "
+            f"({len(got_y)} graphs vs {len(want_y)} rows) — the packer's "
+            "prefix-order invariant this function documents no longer "
+            "holds")
+    return pred

@@ -171,10 +171,13 @@ def test_cli_supervised_run_resumes_from_checkpoint(tmp_path):
     ckpt = tmp_path / "ckpt"
 
     def argv(epochs):
+        # explicit --artifact_dir keeps the child hermetic (the default
+        # ./processed would read/poison a real cache in the repo cwd)
         return ["-m", "pertgnn_tpu.cli.train_main", "--synthetic",
                 "--synthetic_entries", "2", "--synthetic_traces_per_entry",
                 "60", "--min_traces_per_entry", "5", "--epochs",
                 str(epochs), "--label_scale", "1000",
+                "--artifact_dir", str(tmp_path / "art"),
                 "--checkpoint_dir", str(ckpt)]
 
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
