@@ -172,6 +172,11 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
                    help="this process's rank in a multi-host run")
     p.add_argument("--checkpoint_dir", default="")
     p.add_argument("--checkpoint_keep", type=int, default=3)
+    p.add_argument("--allow_config_mismatch", action="store_true",
+                   help="downgrade the checkpoint config-sidecar "
+                        "cross-check (label_scale/graph_type/model "
+                        "fields at resume and inference) from an error "
+                        "to a warning")
     p.add_argument("--profile_dir", default="",
                    help="write a jax.profiler trace of epoch 2 here")
     p.add_argument("--seed", type=int, default=0)

@@ -34,6 +34,44 @@ from pertgnn_tpu.utils.logging import setup_logging
 _SPLITS = ("train", "valid", "test")
 
 
+def _check_train_config(p, ckpt, cfg, allow_mismatch: bool) -> None:
+    """Cross-check output-critical fields against the sidecar the
+    training CLI saved: a label_scale / graph_type / architecture /
+    featurization mismatch restores CLEANLY (tree shapes are blind to
+    semantics) and then silently mis-predicts — exactly the failure this
+    turns into an error. Older checkpoints (no sidecar, or predating a
+    config field) get warnings, not walls; --allow_config_mismatch
+    downgrades everything to warnings."""
+    import logging
+
+    from pertgnn_tpu.train.checkpoint import config_mismatches
+
+    log = logging.getLogger(__name__)
+    saved = ckpt.load_config_dict()
+    if saved is None:
+        log.warning(
+            "checkpoint has no train_config.json sidecar (pre-sidecar "
+            "run?) — cannot verify label_scale/graph_type/model flags "
+            "match training; predictions are silently wrong if they "
+            "don't")
+        return
+    mism, unknown = config_mismatches(saved, cfg)
+    for key in unknown:
+        log.warning("sidecar predates config field %s — cannot verify it "
+                    "matches training", key)
+    if mism:
+        detail = "; ".join(f"{k}: trained={a!r} vs now={b!r}"
+                           for k, a, b in mism)
+        if allow_mismatch:
+            log.warning("config mismatch overridden "
+                        "(--allow_config_mismatch): %s", detail)
+        else:
+            p.error("flags differ from the checkpoint's training run — "
+                    f"predictions would be silently wrong: {detail} "
+                    "(pass the training-time flags, or "
+                    "--allow_config_mismatch to proceed anyway)")
+
+
 def main(argv=None) -> None:
     setup_logging()
     apply_platform_env()
@@ -52,13 +90,20 @@ def main(argv=None) -> None:
                 "first)")
     cfg = config_from_args(args)
 
+    # fail in seconds on a missing/typo'd checkpoint dir, BEFORE minutes
+    # of ingest + dataset build + model init (latest_step is orbax's own
+    # answer — no hand-rolled layout knowledge)
+    from pertgnn_tpu.train.checkpoint import CheckpointManager
+    ckpt = CheckpointManager(args.checkpoint_dir,
+                             keep=args.checkpoint_keep)
+    if ckpt.latest_step() is None:
+        p.error(f"no checkpoint steps in {args.checkpoint_dir!r}")
+    _check_train_config(p, ckpt, cfg, args.allow_config_mismatch)
+
     pre, table = load_or_ingest_artifacts(args, cfg.ingest)
     dataset = build_dataset(pre, cfg, table)
 
-    from pertgnn_tpu.train.checkpoint import CheckpointManager
     model, state = restore_target_state(dataset, cfg)
-    ckpt = CheckpointManager(args.checkpoint_dir,
-                             keep=args.checkpoint_keep)
     state, start_epoch = ckpt.maybe_restore(state)
     if start_epoch == 0:
         p.error(f"no checkpoint found in {args.checkpoint_dir}")
@@ -72,6 +117,15 @@ def main(argv=None) -> None:
     for split in wanted:
         pred = predict_split(dataset, cfg, state, split, step=step)
         rows = meta.iloc[parts[split]].copy()
+        # the one link predict_split's internal assertion cannot see:
+        # these meta rows must BE the rows build_dataset split — pin it
+        if not np.array_equal(rows["y"].to_numpy(np.float32),
+                              np.asarray(dataset.splits[split].ys,
+                                         np.float32)):
+            raise AssertionError(
+                f"meta rows for '{split}' no longer match the dataset "
+                "split — build_dataset's meta slicing changed without "
+                "this CLI following")
         rows["split"] = split
         rows["y_pred"] = np.asarray(pred, np.float32)
         frames.append(rows.rename(columns={"y": "y_true"}))

@@ -86,9 +86,27 @@ def main(argv=None) -> None:
 
     ckpt = None
     if args.checkpoint_dir:
-        from pertgnn_tpu.train.checkpoint import CheckpointManager
+        from pertgnn_tpu.train.checkpoint import (CheckpointManager,
+                                                  config_mismatches)
         ckpt = CheckpointManager(args.checkpoint_dir,
                                  keep=args.checkpoint_keep)
+        # Resume must cross-check the sidecar BEFORE overwriting it:
+        # resuming with (say) the label_scale flag forgotten restores
+        # cleanly, silently continues training in the wrong label space,
+        # AND would launder the sidecar so inference checks pass too.
+        saved = ckpt.load_config_dict()
+        if ckpt.latest_step() is not None and saved is not None:
+            mism, _unknown = config_mismatches(saved, cfg)
+            if mism and not args.allow_config_mismatch:
+                detail = "; ".join(f"{k}: trained={a!r} vs now={b!r}"
+                                   for k, a, b in mism)
+                p.error("resuming with different semantics than the "
+                        f"checkpoint was trained with: {detail} (pass "
+                        "the original flags, or --allow_config_mismatch "
+                        "to adopt the new ones)")
+        # sidecar for inference-time cross-checking (predict_main):
+        # restore is blind to semantics like label_scale / graph_type
+        ckpt.save_config(cfg)
     hook = None
     if args.profile_dir:
         from pertgnn_tpu.utils.profiling import profile_epochs

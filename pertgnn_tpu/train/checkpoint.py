@@ -78,5 +78,73 @@ class CheckpointManager:
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 
+    # -- config sidecar -------------------------------------------------
+    # Checkpoints restore by TREE SHAPE, which is blind to semantics:
+    # a label_scale / graph_type / featurization mismatch between train
+    # and inference restores cleanly and then silently mis-predicts.
+    # CLIs persist the training Config next to the steps and cross-check
+    # it at restore (cli/predict_main.py).
+
+    def save_config(self, cfg) -> None:
+        import dataclasses
+        import json
+
+        path = os.path.join(str(self._mgr.directory),
+                            "train_config.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dataclasses.asdict(cfg), f, indent=1, default=str)
+        os.replace(tmp, path)
+
+    def load_config_dict(self) -> dict | None:
+        import json
+
+        try:
+            with open(os.path.join(str(self._mgr.directory),
+                                   "train_config.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+
+# Fields that change model OUTPUTS given the same restored weights.
+# dropout/attn_dropout only act in train mode (no rngs at inference);
+# init_scheme only shapes the initialization the restore overwrites.
+_OUTPUT_IRRELEVANT_MODEL_FIELDS = frozenset(
+    {"dropout", "attn_dropout", "init_scheme"})
+
+
+def config_mismatches(saved: dict, cfg) -> tuple[list, list]:
+    """Compare a sidecar dict against the live Config on the semantics a
+    checkpoint restore is blind to: graph_type, label_scale, and every
+    output-relevant model field. Returns (mismatches [(key, saved,
+    ours)], unknown [key]) — `unknown` are fields the sidecar predates
+    (a newer code version): callers should warn, not wall, or every old
+    checkpoint bricks the moment a ModelConfig field is added."""
+    import dataclasses
+
+    ours = dataclasses.asdict(cfg)
+    mism: list = []
+    unknown: list = []
+
+    def probe(key, container, our_val):
+        leaf = key.rsplit(".", 1)[-1]
+        if leaf not in container:
+            unknown.append(key)
+        elif container[leaf] != our_val:
+            mism.append((key, container[leaf], our_val))
+
+    probe("graph_type", saved, ours["graph_type"])
+    probe("train.label_scale", saved.get("train") or {},
+          ours["train"]["label_scale"])
+    saved_model = saved.get("model") or {}
+    for k, v in ours["model"].items():
+        if k not in _OUTPUT_IRRELEVANT_MODEL_FIELDS:
+            probe(f"model.{k}", saved_model, v)
+    return mism, unknown
+
     def close(self) -> None:
         self._mgr.close()

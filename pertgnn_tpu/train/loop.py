@@ -414,8 +414,10 @@ def make_tx(cfg: Config) -> optax.GradientTransformation:
 def _train_sample(dataset: Dataset) -> PackedBatch:
     sample = next(dataset.batches("train"), None)
     if sample is None:
+        # surfaced by fit() AND by inference's restore-target init
+        # (restore_target_state) — keep the wording path-neutral
         raise ValueError(
-            "fit: the train split is empty — the ingest filters "
+            "the train split is empty — the ingest filters "
             "(min_traces_per_entry, resource coverage) likely dropped "
             "every trace; lower them or feed a larger corpus")
     return sample

@@ -86,6 +86,108 @@ def test_predict_cli_round_trip(tmp_path):
     assert df["traceid"].is_unique
 
 
+def test_predict_from_mesh_trained_checkpoint(preprocessed, tmp_path):
+    """Train sharded over a 2-device mesh, predict single-chip from the
+    checkpoint: orbax must reshard the mesh-sharded state into the
+    single-device restore target (restore_target_state), so distributed
+    training composes with local inference."""
+    from pertgnn_tpu.parallel.mesh import make_mesh
+    from pertgnn_tpu.train.checkpoint import CheckpointManager
+    from pertgnn_tpu.train.loop import restore_target_state
+
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=200, batch_size=16),
+        model=ModelConfig(hidden_channels=8, num_layers=2),
+        train=TrainConfig(lr=1e-2, epochs=2, label_scale=1000.0),
+        graph_type="pert",
+    )
+    ds = build_dataset(preprocessed, cfg)
+    mesh = make_mesh(data=2, model=1)
+    fit(ds, cfg, checkpoint_manager=CheckpointManager(str(tmp_path), keep=2),
+        mesh=mesh)
+
+    _model, target = restore_target_state(ds, cfg)
+    restored, start = CheckpointManager(str(tmp_path),
+                                        keep=2).maybe_restore(target)
+    assert start == 2
+    pred = predict_split(ds, cfg, restored, "test")
+    assert pred.shape == ds.splits["test"].ys.shape
+    assert np.isfinite(pred).all()
+
+
+def test_predict_cli_rejects_mismatched_train_flags(tmp_path, capsys):
+    """A label_scale (or arch) differing from the training run restores
+    cleanly — tree shapes are blind to semantics — and would silently
+    scale every prediction wrong; the config sidecar turns it into an
+    error naming the field."""
+    from pertgnn_tpu.cli import predict_main, train_main
+
+    ckpt = str(tmp_path / "ckpt")
+    common = ["--synthetic", "--synthetic_entries", "2",
+              "--synthetic_traces_per_entry", "60",
+              "--min_traces_per_entry", "5",
+              "--artifact_dir", str(tmp_path / "art"),
+              "--checkpoint_dir", ckpt]
+    train_main.main([*common, "--label_scale", "1000", "--epochs", "1"])
+    with pytest.raises(SystemExit) as e:
+        predict_main.main([*common, "--out", str(tmp_path / "p.csv")])
+    assert e.value.code == 2
+    assert "label_scale" in capsys.readouterr().err
+    # matching flags succeed
+    predict_main.main([*common, "--label_scale", "1000",
+                       "--out", str(tmp_path / "p.csv")])
+    assert (tmp_path / "p.csv").exists()
+
+
+def test_train_cli_resume_rejects_mismatched_flags(tmp_path, capsys):
+    """Resume must cross-check the sidecar BEFORE overwriting it — a
+    forgotten label_scale at resume would continue training in the wrong
+    label space AND launder the sidecar so inference checks pass."""
+    from pertgnn_tpu.cli import train_main
+
+    common = ["--synthetic", "--synthetic_entries", "2",
+              "--synthetic_traces_per_entry", "60",
+              "--min_traces_per_entry", "5",
+              "--artifact_dir", str(tmp_path / "art"),
+              "--checkpoint_dir", str(tmp_path / "ckpt")]
+    train_main.main([*common, "--label_scale", "1000", "--epochs", "1"])
+    with pytest.raises(SystemExit) as e:
+        train_main.main([*common, "--epochs", "2"])  # flag forgotten
+    assert e.value.code == 2
+    assert "label_scale" in capsys.readouterr().err
+    # explicit override adopts the new flags and proceeds
+    train_main.main([*common, "--epochs", "2", "--allow_config_mismatch"])
+
+
+def test_predict_warns_not_walls_on_sidecar_unknown_field(tmp_path,
+                                                          caplog):
+    """A sidecar written before a config field existed must WARN, not
+    brick every old checkpoint the day a ModelConfig field is added."""
+    import json
+    import logging
+
+    from pertgnn_tpu.cli import predict_main, train_main
+
+    ckpt = tmp_path / "ckpt"
+    common = ["--synthetic", "--synthetic_entries", "2",
+              "--synthetic_traces_per_entry", "60",
+              "--min_traces_per_entry", "5", "--label_scale", "1000",
+              "--artifact_dir", str(tmp_path / "art"),
+              "--checkpoint_dir", str(ckpt)]
+    train_main.main([*common, "--epochs", "1"])
+    sidecar = ckpt / "train_config.json"
+    d = json.loads(sidecar.read_text())
+    del d["model"]["hidden_channels"]  # simulate an older sidecar
+    sidecar.write_text(json.dumps(d))
+    logging.getLogger("pertgnn_tpu").propagate = True
+    with caplog.at_level(logging.WARNING, logger="pertgnn_tpu"):
+        predict_main.main([*common, "--out", str(tmp_path / "p.csv")])
+    assert (tmp_path / "p.csv").exists()
+    assert any("predates config field model.hidden_channels" in r.message
+               for r in caplog.records)
+
+
 def test_predict_cli_requires_checkpoint(tmp_path, capsys):
     from pertgnn_tpu.cli import predict_main
 
