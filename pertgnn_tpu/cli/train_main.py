@@ -97,13 +97,22 @@ def main(argv=None) -> None:
         saved = ckpt.load_config_dict()
         if ckpt.latest_step() is not None and saved is not None:
             mism, _unknown = config_mismatches(saved, cfg)
-            if mism and not args.allow_config_mismatch:
+            if mism:
                 detail = "; ".join(f"{k}: trained={a!r} vs now={b!r}"
                                    for k, a, b in mism)
-                p.error("resuming with different semantics than the "
-                        f"checkpoint was trained with: {detail} (pass "
-                        "the original flags, or --allow_config_mismatch "
-                        "to adopt the new ones)")
+                if not args.allow_config_mismatch:
+                    p.error("resuming with different semantics than the "
+                            f"checkpoint was trained with: {detail} "
+                            "(pass the original flags, or "
+                            "--allow_config_mismatch to adopt the new "
+                            "ones)")
+                # leave a trace BEFORE save_config overwrites the
+                # sidecar — otherwise the override launders the change
+                import logging
+                logging.getLogger(__name__).warning(
+                    "config mismatch overridden "
+                    "(--allow_config_mismatch); sidecar will now record "
+                    "the NEW semantics: %s", detail)
         # sidecar for inference-time cross-checking (predict_main):
         # restore is blind to semantics like label_scale / graph_type
         ckpt.save_config(cfg)

@@ -109,6 +109,9 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def close(self) -> None:
+        self._mgr.close()
+
 
 # Fields that change model OUTPUTS given the same restored weights.
 # dropout/attn_dropout only act in train mode (no rngs at inference);
@@ -145,6 +148,3 @@ def config_mismatches(saved: dict, cfg) -> tuple[list, list]:
         if k not in _OUTPUT_IRRELEVANT_MODEL_FIELDS:
             probe(f"model.{k}", saved_model, v)
     return mism, unknown
-
-    def close(self) -> None:
-        self._mgr.close()
