@@ -55,15 +55,28 @@ upgrades_used=0
 # later unrelated `git commit` can't silently sweep them up. Any step
 # hitting a concurrent index.lock just returns — retried next window.
 commit_capture() {
-  local paths=() p
+  local paths=() p err
   for p in "$PIN" "$OUT"; do [ -f "$p" ] && paths+=("$p"); done
   [ ${#paths[@]} -eq 0 ] && return 0
-  git add -- "${paths[@]}" 2>/dev/null || return 0
+  # a persistent add failure (ownership, future ignore rule) must be
+  # VISIBLE in the log, or the feature can be dead all round unnoticed
+  if ! err=$(git add -- "${paths[@]}" 2>&1); then
+    echo "$(date -u +%FT%TZ) commit_capture: git add failed: $err"
+    return 0
+  fi
   if git commit -m "On-chip capture artifacts (watcher auto-commit)" \
        -- "${paths[@]}" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) capture artifacts committed"
   else
-    git reset -q -- "${paths[@]}" 2>/dev/null
+    # unstage so a later unrelated commit can't sweep these up; the
+    # reset can hit the same transient index.lock the commit did —
+    # retry briefly and LOG if the paths remain staged
+    for _ in 1 2 3; do
+      git reset -q -- "${paths[@]}" 2>/dev/null && return 0
+      sleep 2
+    done
+    echo "$(date -u +%FT%TZ) commit_capture: WARNING — commit failed and" \
+         "paths may still be staged: ${paths[*]}"
   fi
   return 0
 }
