@@ -69,15 +69,22 @@ def main() -> None:
             pin = json.load(f)
     except (OSError, ValueError):
         pass
-    # same freshness rule as the watcher's pin_state: a stale pin from a
-    # previous round must not masquerade as this round's criterion 1
-    if pin and time.time() - pin.get("captured_unix_time", 0) >= 86400:
+    # Freshness: unlike the watcher's 24h re-run rule (a live-window
+    # decision), adjudication happens whenever the round ends — a pin
+    # captured days ago by the auto-commit machinery is still THIS
+    # round's data. Only a pin clearly predating the round (>7 days) is
+    # rejected; the age and commit are always reported for the reader.
+    if pin:
+        age_h = (time.time() - pin.get("captured_unix_time", 0)) / 3600
+    if pin and age_h >= 7 * 24:
         verdicts.append({
             "criterion": "flagship fit_over_ceiling >= 0.9",
-            "verdict": "NO DATA (pin is stale — captured >24h ago)",
+            "verdict": "NO DATA (pin predates the round — "
+                       f"captured {age_h:.0f}h ago)",
             "stale_pin_commit": pin.get("commit")})
         pin = None
     elif pin and pin.get("backend") == "tpu":
+        pin["captured_age_h"] = round(age_h, 1)
         foc = pin.get("fit_over_ceiling")
         verdicts.append({
             "criterion": "flagship fit_over_ceiling >= 0.9",
@@ -86,6 +93,7 @@ def main() -> None:
             "staged_over_unstaged": pin.get("staged_over_unstaged"),
             "partial_capture": bool(pin.get("partial_capture")),
             "commit": pin.get("commit"),
+            "captured_age_h": pin.get("captured_age_h"),
             "verdict": (None if foc is None
                         else "PASS" if foc >= FIT_OVER_CEILING_TARGET
                         else "FAIL"),

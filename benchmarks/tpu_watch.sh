@@ -59,9 +59,12 @@ commit_capture() {
   for p in "$PIN" "$OUT"; do [ -f "$p" ] && paths+=("$p"); done
   [ ${#paths[@]} -eq 0 ] && return 0
   # a persistent add failure (ownership, future ignore rule) must be
-  # VISIBLE in the log, or the feature can be dead all round unnoticed
+  # VISIBLE in the log, or the feature can be dead all round unnoticed —
+  # and a PARTIAL add must be unstaged, or a later unrelated commit
+  # sweeps the staged half up
   if ! err=$(git add -- "${paths[@]}" 2>&1); then
     echo "$(date -u +%FT%TZ) commit_capture: git add failed: $err"
+    git reset -q -- "${paths[@]}" 2>/dev/null
     return 0
   fi
   if git commit -m "On-chip capture artifacts (watcher auto-commit)" \

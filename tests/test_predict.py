@@ -58,9 +58,10 @@ def test_predictions_carry_signal(fitted):
     assert mae_model < mae_const
 
 
-def test_predict_cli_round_trip(tmp_path):
+@pytest.mark.parametrize("graph_type", ["pert", "span"])
+def test_predict_cli_round_trip(tmp_path, graph_type):
     """train_main writes a checkpoint; predict_main restores it and emits
-    one aligned CSV row per trace."""
+    one aligned CSV row per trace — both graph families."""
     import pandas as pd
 
     from pertgnn_tpu.cli import predict_main, train_main
@@ -72,6 +73,7 @@ def test_predict_cli_round_trip(tmp_path):
     common = ["--synthetic", "--synthetic_entries", "2",
               "--synthetic_traces_per_entry", "60",
               "--min_traces_per_entry", "5", "--label_scale", "1000",
+              "--graph_type", graph_type,
               "--artifact_dir", str(tmp_path / "art"),
               "--checkpoint_dir", ckpt]
     train_main.main([*common, "--epochs", "2"])
