@@ -1,0 +1,224 @@
+"""Serving benchmark: bucketed AOT request path vs naive per-request jit.
+
+Prints ONE JSON line in the BENCH_r0*.json schema family:
+
+  {"metric": "pert_serve_request_latency_ms_p50", "value": ..., "unit":
+   "ms", "p50_ms": ..., "p95_ms": ..., "p99_ms": ..., "throughput_rps":
+   ..., "pad_waste_ratio": ..., "cache_misses_after_warmup": 0,
+   "buckets_used": N, "speedup_vs_naive": ..., ...}
+
+What is measured:
+- **bucketed** — a randomized request stream (microbatches of 1..G
+  requests over entries with heterogeneous mixture shapes, spanning >= 3
+  shape buckets) dispatched through the warmed serving engine
+  (serve/engine.py): per-dispatch client latency, throughput, pad waste,
+  and the executable-cache counters. Steady-state serving must show ZERO
+  cache misses — asserted, not just reported.
+- **naive** — the SAME stream through the obvious alternative: a single
+  `jax.jit` forward fed each microbatch packed at its EXACT shape. jit
+  caches by shape, so every previously-unseen (graphs, nodes, edges)
+  signature recompiles on the request path — the tail-latency failure
+  mode the bucket ladder exists to remove.
+- **speedup_vs_naive** = naive mean latency / bucketed mean latency over
+  the identical stream (means, not medians: the naive path's damage IS
+  its compile tail, and a median would hide exactly that).
+
+Run off-TPU it auto-falls back to CPU like bench.py (the engine is
+backend-agnostic; bucket discipline matters on any backend with compiled
+static shapes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_serve_workload(traces_per_entry: int = 300):
+    """A synthetic corpus with deliberately heterogeneous mixture shapes
+    (wide pattern_size_range) so single-request node/edge totals land in
+    different ladder rungs."""
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                    ModelConfig, ServeConfig, TrainConfig)
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(max_traces=100_000, batch_size=64),
+        model=ModelConfig(hidden_channels=32, num_layers=3),
+        train=TrainConfig(label_scale=1000.0),
+        serve=ServeConfig(bucket_growth=2.0, max_graphs_per_batch=8,
+                          min_bucket_nodes=128, min_bucket_edges=128),
+        graph_type="pert",
+    )
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=60, num_entries=12, patterns_per_entry=3,
+        pattern_size_range=(3, 24), traces_per_entry=traces_per_entry,
+        seed=42))
+    pre = preprocess(data.spans, data.resources, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+    return ds, cfg
+
+
+def make_request_stream(ds, max_graphs: int, n_batches: int, seed: int = 0):
+    """Randomized stream of microbatches: (entry_ids, ts_buckets) tuples
+    with 1..max_graphs requests each, entries drawn across the whole
+    test split so shapes vary."""
+    s = ds.splits["test"]
+    rng = np.random.default_rng(seed)
+    stream = []
+    for _ in range(n_batches):
+        k = int(rng.integers(1, max_graphs + 1))
+        idx = rng.integers(0, len(s.entry_ids), size=k)
+        stream.append((s.entry_ids[idx], s.ts_buckets[idx]))
+    return stream
+
+
+def run_bucketed(engine, stream):
+    """The engine path: per-dispatch latency + engine counters. Returns
+    (latencies_s, preds per microbatch)."""
+    lat, preds = [], []
+    for entries, buckets in stream:
+        t0 = time.perf_counter()
+        p = engine.predict_microbatch(entries, buckets)
+        lat.append(time.perf_counter() - t0)
+        preds.append(p)
+    return np.asarray(lat), preds
+
+
+def run_naive(ds, cfg, state, stream):
+    """The obvious alternative: one jit'd forward, each microbatch packed
+    at its EXACT (graphs, nodes, edges) shape — every new signature
+    recompiles inside the request's latency budget."""
+    import jax
+
+    from pertgnn_tpu.batching.pack import BatchBudget, pack_single
+    from pertgnn_tpu.models.pert_model import make_model
+
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    scale = cfg.train.label_scale
+
+    @jax.jit
+    def step(variables, batch):
+        pred, _ = model.apply(variables, batch, training=False)
+        return pred * scale
+
+    lat, preds, shapes = [], [], set()
+    for entries, buckets in stream:
+        t0 = time.perf_counter()
+        g = len(entries)
+        n = sum(ds.mixtures[int(e)].num_nodes for e in entries)
+        e_tot = sum(ds.mixtures[int(e)].num_edges for e in entries)
+        shapes.add((g, n, e_tot))
+        batch = pack_single(
+            ds.mixtures, entries, buckets,
+            BatchBudget(max_graphs=g, max_nodes=n, max_edges=e_tot),
+            ds.lookup, node_depth_in_x=cfg.model.use_node_depth)
+        p = np.asarray(step(variables, batch))[:g]
+        lat.append(time.perf_counter() - t0)
+        preds.append(p)
+    return np.asarray(lat), preds, len(shapes)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batches", type=int,
+                    default=int(os.environ.get("SERVE_BENCH_BATCHES",
+                                               "120")),
+                    help="microbatches in the randomized request stream")
+    ap.add_argument("--traces_per_entry", type=int, default=300)
+    ap.add_argument("--out", default="",
+                    help="also write the JSON record here")
+    args = ap.parse_args()
+
+    from pertgnn_tpu.cli.common import (apply_platform_env,
+                                        probe_backend_or_fallback)
+    fallback = probe_backend_or_fallback()
+    apply_platform_env()
+
+    import jax
+
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.train.loop import restore_target_state
+
+    ds, cfg = build_serve_workload(args.traces_per_entry)
+    # serving perf is independent of the weights; a fresh init (the
+    # checkpoint restore target) keeps the bench self-contained
+    _model, state = restore_target_state(ds, cfg)
+
+    engine = InferenceEngine.from_dataset(ds, cfg, state).warmup()
+    stream = make_request_stream(ds, cfg.serve.max_graphs_per_batch,
+                                 args.batches)
+
+    lat_b, preds_b = run_bucketed(engine, stream)
+    stats = engine.stats_dict()
+    used = [b for b in stats["buckets"] if b["dispatches"]]
+    if stats["cache_misses"]:
+        raise AssertionError(
+            f"{stats['cache_misses']} executable-cache misses after "
+            "warmup — the ladder no longer covers the request stream")
+    if len(used) < 3:
+        raise AssertionError(
+            f"request stream only exercised {len(used)} shape buckets "
+            "(need >= 3 for a meaningful bucketing claim) — widen "
+            "pattern_size_range or the microbatch size range")
+
+    lat_n, preds_n, naive_shapes = run_naive(ds, cfg, state, stream)
+    for pb, pn in zip(preds_b, preds_n):
+        np.testing.assert_allclose(pb, pn, rtol=1e-4, atol=1e-5)
+
+    n_requests = sum(len(e) for e, _ in stream)
+    speedup = float(lat_n.mean() / lat_b.mean())
+    record = {
+        "metric": "pert_serve_request_latency_ms_p50",
+        "value": float(np.percentile(lat_b * 1e3, 50)),
+        "unit": "ms",
+        "p50_ms": float(np.percentile(lat_b * 1e3, 50)),
+        "p95_ms": float(np.percentile(lat_b * 1e3, 95)),
+        "p99_ms": float(np.percentile(lat_b * 1e3, 99)),
+        "mean_ms": float(lat_b.mean() * 1e3),
+        "throughput_rps": float(n_requests / lat_b.sum()),
+        "pad_waste_ratio": stats["pad_waste_ratio"],
+        "cache_misses_after_warmup": stats["cache_misses"],
+        "cache_hits": stats["cache_hits"],
+        "warmup_s": stats["warmup_s"],
+        "buckets_total": len(engine.ladder),
+        "buckets_used": len(used),
+        "microbatches": len(stream),
+        "requests": n_requests,
+        "naive_p50_ms": float(np.percentile(lat_n * 1e3, 50)),
+        "naive_p99_ms": float(np.percentile(lat_n * 1e3, 99)),
+        "naive_mean_ms": float(lat_n.mean() * 1e3),
+        "naive_distinct_shapes": naive_shapes,
+        "speedup_vs_naive": speedup,
+        "backend": jax.default_backend(),
+        "backend_fallback": fallback,
+        "captured_unix_time": time.time(),
+    }
+    out = json.dumps(record)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    if speedup < 5.0:
+        print(f"WARNING: bucketed speedup vs naive is {speedup:.1f}x "
+              "(< 5x acceptance threshold)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

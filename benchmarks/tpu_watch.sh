@@ -110,8 +110,10 @@ for i in $(seq 1 "$PROBES"); do
         && echo "$(date -u +%FT%TZ) upgrading partial pin (try $upgrades_used/$UPGRADE_TRIES)"
       echo "$(date -u +%FT%TZ) running bench.py"
       ran_bench=1
-      BENCH_PROBE_TIMEOUT=75 BENCH_PROBE_TRIES=2 timeout 5400 python bench.py
-      rc=$?
+      bench_out=$(mktemp)
+      BENCH_PROBE_TIMEOUT=75 BENCH_PROBE_TRIES=2 timeout 5400 python bench.py \
+        | tee "$bench_out"
+      rc=${PIPESTATUS[0]}
       echo "$(date -u +%FT%TZ) bench exited rc=$rc"
       if [ $rc -ne 0 ]; then
         bench_ok=0
@@ -121,7 +123,18 @@ for i in $(seq 1 "$PROBES"); do
         JAX_PLATFORMS=cpu timeout 1800 python bench.py --finalize-partial
         frc=$?
         echo "$(date -u +%FT%TZ) finalize-partial rc=$frc"
+      elif ! grep -q '"backend": *"tpu"' "$bench_out"; then
+        # rc=0 but the run fell back off-chip: bench.py deliberately
+        # keeps a promotable TPU salvage (_discard_partials
+        # keep_tpu_salvage) — promote it NOW, or surviving chip windows
+        # sit orphaned until some later failing run happens to finalize
+        bench_ok=0
+        echo "$(date -u +%FT%TZ) bench completed off-chip; finalizing any TPU salvage"
+        JAX_PLATFORMS=cpu timeout 1800 python bench.py --finalize-partial
+        frc=$?
+        echo "$(date -u +%FT%TZ) finalize-partial rc=$frc"
       fi
+      rm -f "$bench_out"
     fi
     # Attempt the config suite only in a window where the tunnel is
     # known-healthy: either bench just succeeded here, or bench was

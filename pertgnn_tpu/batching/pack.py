@@ -124,6 +124,48 @@ def derive_budget(mixtures: dict[int, Mixture], entry_ids: np.ndarray,
                        max_edges=max_edges)
 
 
+def pack_single(
+    mixtures: dict[int, Mixture],
+    entry_ids: np.ndarray,
+    ts_buckets: np.ndarray,
+    budget: BatchBudget,
+    lookup: ResourceLookup,
+    ys: np.ndarray | None = None,
+    node_depth_in_x: bool = False,
+) -> PackedBatch:
+    """Pack the given examples into exactly ONE budget-shaped batch.
+
+    The serving request path (serve/engine.py): a microbatch of requests
+    is packed into one bucket shape with every `pack_examples` invariant
+    intact (receiver-sorted edges, reserved pad graph slot) — by reusing
+    its buffer machinery rather than re-implementing it. Unlike the epoch
+    packer it never flushes: examples that cannot share one batch raise
+    (the caller sizes its bucket BEFORE packing — serve/buckets.py
+    `select_bucket`).
+
+    `ys` defaults to zeros: a live request has no label; the y slots ride
+    along only because the batch layout is shared with training.
+    """
+    entry_ids = np.asarray(entry_ids)
+    if len(entry_ids) == 0:
+        raise ValueError("pack_single needs at least one example")
+    if ys is None:
+        ys = np.zeros(len(entry_ids), dtype=np.float32)
+    n = sum(mixtures[int(e)].num_nodes for e in entry_ids)
+    e_tot = sum(mixtures[int(e)].num_edges for e in entry_ids)
+    if (len(entry_ids) > budget.max_graphs or n > budget.max_nodes
+            or e_tot > budget.max_edges):
+        raise ValueError(
+            f"{len(entry_ids)} examples ({n} nodes, {e_tot} edges) do not "
+            f"fit one batch of {budget}")
+    batches = list(pack_examples(mixtures, entry_ids,
+                                 np.asarray(ts_buckets), ys, budget, lookup,
+                                 node_depth_in_x=node_depth_in_x))
+    # the fit pre-check above makes a second flush impossible
+    (batch,) = batches
+    return batch
+
+
 def pack_examples(
     mixtures: dict[int, Mixture],
     entry_ids: np.ndarray,

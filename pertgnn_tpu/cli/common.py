@@ -12,7 +12,7 @@ import argparse
 import os
 
 from pertgnn_tpu.config import (Config, DataConfig, IngestConfig, ModelConfig,
-                                ParallelConfig, TrainConfig)
+                                ParallelConfig, ServeConfig, TrainConfig)
 
 
 def apply_platform_env() -> None:
@@ -185,6 +185,27 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
                         "lax.scan program; 1 disables scan fusion")
 
 
+def add_serve_flags(p: argparse.ArgumentParser) -> None:
+    """Serving-engine knobs (ServeConfig) — serve_main and predict_main's
+    bucketed path."""
+    p.add_argument("--bucket_growth", type=float,
+                   default=ServeConfig.bucket_growth,
+                   help="geometric growth of the serving bucket ladder "
+                        "(serve/buckets.py); 2.0 = powers-of-two rungs")
+    p.add_argument("--max_graphs_per_batch", type=int,
+                   default=ServeConfig.max_graphs_per_batch,
+                   help="graph slots per serving microbatch")
+    p.add_argument("--flush_deadline_ms", type=float,
+                   default=ServeConfig.flush_deadline_ms,
+                   help="microbatch queue: max wait for co-arriving "
+                        "requests before a batch is flushed; 0 = dispatch "
+                        "per request")
+    p.add_argument("--no_serve_warmup", action="store_true",
+                   help="skip AOT-compiling the bucket ladder at engine "
+                        "construction (first request per bucket then pays "
+                        "the compile)")
+
+
 def add_ingest_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--min_traces_per_entry", type=int, default=100)
     p.add_argument("--min_resource_coverage", type=float, default=0.6)
@@ -246,6 +267,16 @@ def config_from_args(args: argparse.Namespace) -> Config:
         parallel=ParallelConfig(data_parallel=args.data_parallel,
                                 model_parallel=args.model_parallel,
                                 shard_edges=args.shard_edges),
+        # getattr falls back to the DATACLASS defaults: only parsers that
+        # call add_serve_flags carry these (train_main does not serve)
+        serve=ServeConfig(
+            bucket_growth=getattr(args, "bucket_growth",
+                                  ServeConfig.bucket_growth),
+            max_graphs_per_batch=getattr(args, "max_graphs_per_batch",
+                                         ServeConfig.max_graphs_per_batch),
+            flush_deadline_ms=getattr(args, "flush_deadline_ms",
+                                      ServeConfig.flush_deadline_ms),
+            warmup=not getattr(args, "no_serve_warmup", False)),
         graph_type=args.graph_type,
     )
 

@@ -25,10 +25,12 @@ import pandas as pd
 from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.batching.dataset import split_indices
 from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
-                                    apply_platform_env, config_from_args,
+                                    add_serve_flags, apply_platform_env,
+                                    config_from_args,
                                     load_or_ingest_artifacts)
 from pertgnn_tpu.train.loop import restore_target_state
-from pertgnn_tpu.train.predict import make_predict_step, predict_split
+from pertgnn_tpu.train.predict import (make_predict_step, predict_split,
+                                       predict_split_served)
 from pertgnn_tpu.utils.logging import setup_logging
 
 _SPLITS = ("train", "valid", "test")
@@ -78,11 +80,17 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     add_ingest_flags(p)
     add_model_train_flags(p)
+    add_serve_flags(p)
     p.add_argument("--split", default="test",
                    choices=(*_SPLITS, "all"),
                    help="which positional split(s) to predict")
     p.add_argument("--out", default="predictions.csv",
                    help="output CSV path")
+    p.add_argument("--serve_bucketed", action="store_true",
+                   help="route prediction through the serving engine's "
+                        "bucketed AOT request path (serve/engine.py) "
+                        "instead of the epoch packer — exercises exactly "
+                        "what serve_main serves")
     args = p.parse_args(argv)
     if not args.checkpoint_dir:
         p.error("--checkpoint_dir is required: predictions come from a "
@@ -112,10 +120,22 @@ def main(argv=None) -> None:
     meta = table.meta.iloc[:cfg.data.max_traces]
     parts = dict(zip(_SPLITS, split_indices(len(meta), cfg.data.split)))
     wanted = _SPLITS if args.split == "all" else (args.split,)
-    step = make_predict_step(model, cfg)  # one compile for every split
+    engine = step = None
+    if args.serve_bucketed:
+        # one engine (= one warmed executable cache) for every split
+        from pertgnn_tpu.serve.engine import InferenceEngine
+        engine = InferenceEngine.from_dataset(dataset, cfg, state)
+        if cfg.serve.warmup:
+            engine.warmup()
+    else:
+        step = make_predict_step(model, cfg)  # one compile for every split
     frames = []
     for split in wanted:
-        pred = predict_split(dataset, cfg, state, split, step=step)
+        if engine is not None:
+            pred = predict_split_served(dataset, cfg, state, split,
+                                        engine=engine)
+        else:
+            pred = predict_split(dataset, cfg, state, split, step=step)
         rows = meta.iloc[parts[split]].copy()
         # the one link predict_split's internal assertion cannot see:
         # these meta rows must BE the rows build_dataset split — pin it
@@ -133,6 +153,9 @@ def main(argv=None) -> None:
     out.to_csv(args.out, index=False)
     print(f"wrote {len(out)} predictions "
           f"(epochs trained: {start_epoch}) to {args.out}")
+    if engine is not None:
+        import json
+        print(json.dumps({"serve_stats": engine.stats_dict()}))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,181 @@
+"""Serving CLI: answer per-trace latency requests from a trained checkpoint
+through the bucketed online inference engine.
+
+    python -m pertgnn_tpu.cli.serve_main --artifact_dir processed \
+        --graph_type pert --checkpoint_dir ckpts --from_split test \
+        --concurrency 8 --out served.csv
+    python -m pertgnn_tpu.cli.serve_main --synthetic ... \
+        --requests requests.csv
+
+Requests are (entry_id, ts_bucket) rows — from a CSV (--requests) or
+sampled from a positional split (--from_split). They are driven through
+the full serving stack: `--concurrency` client threads submit to the
+microbatch queue (serve/queue.py), which coalesces co-arriving requests
+under the flush deadline and dispatches bucket-shaped batches to the AOT
+executable cache (serve/engine.py). Output: one CSV row per request
+(entry_id, ts_bucket, y_pred) in request order, plus ONE JSON line of
+serving metrics (engine counters + client-observed latency percentiles —
+the same schema family as benchmarks/serve_bench.py).
+
+This is the long-lived process the ROADMAP's request-serving north star
+needs; an RPC front-end would wrap `MicrobatchQueue.submit` — the queue,
+not the transport, is the engineered part.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
+                                    add_serve_flags, apply_platform_env,
+                                    config_from_args,
+                                    load_or_ingest_artifacts)
+from pertgnn_tpu.train.loop import restore_target_state
+from pertgnn_tpu.utils.logging import setup_logging
+from pertgnn_tpu.utils.profiling import LatencyRecorder
+
+
+def _load_requests(args, dataset) -> tuple[np.ndarray, np.ndarray]:
+    if args.requests:
+        import pandas as pd
+
+        df = pd.read_csv(args.requests)
+        missing = {"entry_id", "ts_bucket"} - set(df.columns)
+        if missing:
+            raise SystemExit(
+                f"--requests CSV lacks columns {sorted(missing)}")
+        entries = df["entry_id"].to_numpy(np.int64)
+        buckets = df["ts_bucket"].to_numpy(np.int64)
+    else:
+        s = dataset.splits[args.from_split]
+        entries = np.asarray(s.entry_ids, np.int64)
+        buckets = np.asarray(s.ts_buckets, np.int64)
+    if args.num_requests:
+        entries = entries[:args.num_requests]
+        buckets = buckets[:args.num_requests]
+    unknown = [int(e) for e in np.unique(entries)
+               if int(e) not in dataset.mixtures]
+    if unknown:
+        raise SystemExit(
+            f"requests name entry ids absent from the dataset's mixtures: "
+            f"{unknown[:10]}{'...' if len(unknown) > 10 else ''}")
+    return entries, buckets
+
+
+def main(argv=None) -> None:
+    setup_logging()
+    apply_platform_env()
+    p = argparse.ArgumentParser(description=__doc__)
+    add_ingest_flags(p)
+    add_model_train_flags(p)
+    add_serve_flags(p)
+    p.add_argument("--requests", default="",
+                   help="CSV of requests (entry_id, ts_bucket columns); "
+                        "default: replay --from_split")
+    p.add_argument("--from_split", default="test",
+                   choices=("train", "valid", "test"),
+                   help="split to replay as the request stream when no "
+                        "--requests CSV is given")
+    p.add_argument("--num_requests", type=int, default=0,
+                   help="cap the request stream (0 = all)")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="client threads submitting to the microbatch "
+                        "queue")
+    p.add_argument("--out", default="served.csv",
+                   help="per-request prediction CSV path")
+    args = p.parse_args(argv)
+    if not args.checkpoint_dir:
+        p.error("--checkpoint_dir is required: serving answers from a "
+                "trained checkpoint (run train_main with --checkpoint_dir "
+                "first)")
+    cfg = config_from_args(args)
+
+    from pertgnn_tpu.cli.predict_main import _check_train_config
+    from pertgnn_tpu.train.checkpoint import CheckpointManager
+    ckpt = CheckpointManager(args.checkpoint_dir, keep=args.checkpoint_keep)
+    if ckpt.latest_step() is None:
+        p.error(f"no checkpoint steps in {args.checkpoint_dir!r}")
+    _check_train_config(p, ckpt, cfg, args.allow_config_mismatch)
+
+    pre, table = load_or_ingest_artifacts(args, cfg.ingest)
+    dataset = build_dataset(pre, cfg, table)
+    _model, state = restore_target_state(dataset, cfg)
+    state, start_epoch = ckpt.maybe_restore(state)
+    if start_epoch == 0:
+        p.error(f"no checkpoint found in {args.checkpoint_dir}")
+
+    entries, buckets = _load_requests(args, dataset)
+    if len(entries) == 0:
+        raise SystemExit("no requests to serve")
+
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+    engine = InferenceEngine.from_dataset(dataset, cfg, state)
+    if cfg.serve.warmup:
+        engine.warmup()
+
+    client_latency = LatencyRecorder()
+    preds = np.zeros(len(entries), np.float32)
+    failures: list[tuple[int, BaseException]] = []
+
+    def client(indices) -> None:
+        for i in indices:
+            t0 = time.perf_counter()
+            try:
+                preds[i] = queue.predict(int(entries[i]), int(buckets[i]))
+            except BaseException as exc:
+                # surface on the MAIN thread: a traceback printed by a
+                # dying client thread exits 0 and leaves silent zero
+                # predictions in the CSV
+                failures.append((i, exc))
+                return
+            client_latency.record_s(time.perf_counter() - t0)
+
+    import threading
+
+    t_serve0 = time.perf_counter()
+    with MicrobatchQueue(engine) as queue:
+        # round-robin so concurrent clients interleave distinct requests
+        # (each index is served exactly once; preds/latency cells are
+        # disjoint per thread, so no locking beyond the queue's own)
+        threads = [threading.Thread(
+            target=client, args=(range(t, len(entries), args.concurrency),))
+            for t in range(max(1, args.concurrency))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    serve_wall_s = time.perf_counter() - t_serve0
+    if failures:
+        i, exc = failures[0]
+        raise SystemExit(
+            f"{len(failures)} client thread(s) failed; first: request {i} "
+            f"(entry_id={int(entries[i])}) -> "
+            f"{type(exc).__name__}: {exc}")
+
+    import pandas as pd
+
+    pd.DataFrame({"entry_id": entries, "ts_bucket": buckets,
+                  "y_pred": preds}).to_csv(args.out, index=False)
+    stats = {
+        "metric": "pert_serve_request_latency_ms",
+        "unit": "ms",
+        "requests": len(entries),
+        "concurrency": args.concurrency,
+        "epochs_trained": start_epoch,
+        "throughput_rps": len(entries) / max(serve_wall_s, 1e-9),
+        "client_latency": client_latency.summary_dict(),
+        "engine": engine.stats_dict(),
+        "captured_unix_time": time.time(),
+    }
+    print(f"wrote {len(entries)} served predictions to {args.out}")
+    print(json.dumps(stats))
+
+
+if __name__ == "__main__":
+    main()

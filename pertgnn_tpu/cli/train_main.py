@@ -95,6 +95,17 @@ def main(argv=None) -> None:
         # cleanly, silently continues training in the wrong label space,
         # AND would launder the sidecar so inference checks pass too.
         saved = ckpt.load_config_dict()
+        if ckpt.latest_step() is not None and saved is None:
+            # mirror predict_main's no-sidecar warning: the sidecar about
+            # to be written is seeded from the CURRENT flags, which
+            # nothing can verify against the original training run
+            import logging
+            logging.getLogger(__name__).warning(
+                "resuming a checkpoint that has no train_config.json "
+                "sidecar (pre-sidecar run?) — seeding the sidecar from "
+                "the current flags, which CANNOT be verified against the "
+                "run that produced the checkpoint; later inference "
+                "cross-checks will trust them")
         if ckpt.latest_step() is not None and saved is not None:
             mism, _unknown = config_mismatches(saved, cfg)
             if mism:

@@ -202,6 +202,34 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Online inference engine knobs (pertgnn_tpu/serve/).
+
+    The request path applies the training packer's static-shape discipline
+    to latency-sensitive serving: a small geometric ladder of bucket
+    shapes, each AOT-compiled once at warmup, with every request padded up
+    to the smallest fitting bucket so steady-state serving never
+    recompiles (serve/buckets.py, serve/engine.py)."""
+
+    # Geometric growth factor of the bucket ladder's node/edge capacities
+    # (2.0 = powers-of-two rungs up to the dataset-derived budget).
+    bucket_growth: float = 2.0
+    # Smallest rung's node/edge capacity (rounded up to multiples of 128
+    # for TPU lane alignment, like the training budget).
+    min_bucket_nodes: int = 128
+    min_bucket_edges: int = 128
+    # Graph slots per serving microbatch (every rung shares this graph
+    # capacity — the per-graph arrays are O(G) and cost nothing to pad).
+    max_graphs_per_batch: int = 16
+    # Microbatch queue: a request waits at most this long for co-arriving
+    # requests before its batch is flushed to the engine (serve/queue.py).
+    flush_deadline_ms: float = 2.0
+    # AOT-compile every ladder rung at engine construction so the first
+    # request of each shape pays dispatch, not compilation.
+    warmup: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class ParallelConfig:
     """Mesh / sharding layout.
 
@@ -227,6 +255,7 @@ class Config:
     model: ModelConfig = ModelConfig()
     train: TrainConfig = TrainConfig()
     parallel: ParallelConfig = ParallelConfig()
+    serve: ServeConfig = ServeConfig()
     # span | pert (reference: pert_gnn.py:32).
     graph_type: str = "span"
 

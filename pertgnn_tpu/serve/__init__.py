@@ -1,0 +1,25 @@
+"""Online inference engine: shape-bucketed AOT serving of trained models.
+
+Training already pays the irregular-graph-on-dense-hardware tax exactly
+once — every epoch batch has ONE static shape so the train step compiles
+once (batching/pack.py). Serving faces the same problem at request
+granularity: per-request graph shapes vary, and a naive per-request
+`jax.jit` recompiles on every new shape, destroying tail latency. This
+package re-applies the training discipline to the request path:
+
+- `buckets`  — a small geometric ladder of `BatchBudget` shapes up to the
+  dataset-derived training budget; every request pads up to the smallest
+  fitting rung;
+- `engine`   — per-rung executables AOT-compiled once at warmup
+  (`jax.jit(...).lower(...).compile()`), a single-batch fast pack
+  (batching/pack.py `pack_single`), and hit/miss/pad-waste counters;
+- `queue`    — a deadline-based microbatching queue coalescing concurrent
+  requests into one bucket-shaped dispatch.
+"""
+
+from pertgnn_tpu.serve.buckets import make_bucket_ladder, select_bucket
+from pertgnn_tpu.serve.engine import InferenceEngine
+from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+__all__ = ["InferenceEngine", "MicrobatchQueue", "make_bucket_ladder",
+           "select_bucket"]

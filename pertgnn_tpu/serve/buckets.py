@@ -1,0 +1,72 @@
+"""Shape-bucket ladder for the serving engine.
+
+One compiled executable per distinct input shape is the whole game on
+dense hardware (DGL and "Fast Training of Sparse GNNs on Dense Hardware"
+apply the same static-shape/padding discipline to training — PAPERS.md).
+Serving cannot use the single training budget directly: padding every
+1-graph request to a 170-graph epoch batch wastes >100x compute. Instead a
+small geometric ladder of budgets covers the request-size range; each rung
+is compiled once at warmup and every request pads up to the smallest rung
+that fits, so steady-state serving never recompiles and pad waste stays
+bounded by the ladder's growth factor.
+"""
+
+from __future__ import annotations
+
+from pertgnn_tpu.batching.pack import BatchBudget, _round_up
+from pertgnn_tpu.config import ServeConfig
+
+
+def make_bucket_ladder(top: BatchBudget,
+                       cfg: ServeConfig) -> tuple[BatchBudget, ...]:
+    """Ascending ladder of bucket shapes whose last rung covers `top`.
+
+    Rungs shrink geometrically from the dataset-derived training budget
+    (`top`, which any single mixture fits by construction —
+    pack.derive_budget's max-mixture floor) down to the configured
+    minimum, nodes and edges in lockstep, every capacity rounded up to a
+    multiple of 128 for TPU lane alignment. All rungs share the serving
+    graph capacity `cfg.max_graphs_per_batch` (per-graph arrays are O(G)
+    — padding them is free) except that no rung exceeds the training
+    budget's graph count.
+    """
+    if cfg.bucket_growth <= 1.0:
+        raise ValueError(
+            f"bucket_growth must be > 1 (got {cfg.bucket_growth})")
+    max_graphs = min(cfg.max_graphs_per_batch, top.max_graphs)
+    rungs: list[BatchBudget] = []
+    n, e = float(top.max_nodes), float(top.max_edges)
+    while True:
+        rung = BatchBudget(max_graphs=max_graphs,
+                           max_nodes=_round_up(int(n)),
+                           max_edges=_round_up(int(e)))
+        if (rungs and rung.max_nodes >= rungs[-1].max_nodes
+                and rung.max_edges >= rungs[-1].max_edges):
+            break  # 128-rounding converged — smaller rungs are duplicates
+        rungs.append(rung)
+        if (rung.max_nodes <= cfg.min_bucket_nodes
+                and rung.max_edges <= cfg.min_bucket_edges):
+            break
+        n, e = n / cfg.bucket_growth, e / cfg.bucket_growth
+    return tuple(reversed(rungs))
+
+
+def select_bucket(ladder: tuple[BatchBudget, ...], num_graphs: int,
+                  num_nodes: int, num_edges: int) -> int | None:
+    """Index of the smallest rung fitting the request, None if none does.
+
+    The ladder is ascending and short (typically < 10 rungs), so a linear
+    scan beats anything clever."""
+    for i, b in enumerate(ladder):
+        if (num_graphs <= b.max_graphs and num_nodes <= b.max_nodes
+                and num_edges <= b.max_edges):
+            return i
+    return None
+
+
+def pad_waste(bucket: BatchBudget, num_nodes: int, num_edges: int) -> float:
+    """Fraction of the bucket's node+edge slots burned on padding — the
+    serving twin of the training padded-slot utilization measure
+    (pack.derive_budget's sizing law)."""
+    total = bucket.max_nodes + bucket.max_edges
+    return (total - num_nodes - num_edges) / total

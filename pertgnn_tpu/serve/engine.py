@@ -1,0 +1,271 @@
+"""The serving engine: shape-bucketed AOT executable cache + fast pack.
+
+Offline prediction (`train/predict.py`) answers "what does the model say
+about an entire split" by re-packing the split through the epoch packer.
+Serving answers "what does the model say about THIS request, now" — and a
+naive `jax.jit` forward retraces and recompiles on every unseen graph
+shape, turning a sub-millisecond forward into a multi-second stall. The
+engine removes compilation from the request path entirely:
+
+1. at construction it derives a bucket ladder from the dataset's training
+   budget (serve/buckets.py) and AOT-compiles ONE executable per rung via
+   ``jax.jit(...).lower(...).compile()`` (warmup);
+2. per request (or coalesced microbatch — serve/queue.py) it packs the
+   entry mixtures into the smallest fitting rung with the training
+   packer's own invariants (batching/pack.py ``pack_single``: receiver-
+   sorted edges, reserved pad graph) and dispatches the precompiled
+   executable — a pure cache hit in steady state (misses are counted and
+   logged; after warmup any miss means the ladder no longer covers the
+   request range);
+3. every dispatch feeds the latency/pad-waste/bucket counters surfaced by
+   ``stats_dict`` (utils/profiling.LatencyRecorder) — the serving metrics
+   schema benchmarks/serve_bench.py reports.
+
+The engine itself is single-threaded by design: concurrent callers go
+through MicrobatchQueue, whose one worker owns all engine calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pertgnn_tpu.batching.featurize import ResourceLookup
+from pertgnn_tpu.batching.mixture import Mixture
+from pertgnn_tpu.batching.pack import BatchBudget, PackedBatch, pack_single
+from pertgnn_tpu.config import Config
+from pertgnn_tpu.models.pert_model import make_model
+from pertgnn_tpu.serve.buckets import (make_bucket_ladder, pad_waste,
+                                       select_bucket)
+from pertgnn_tpu.utils.profiling import LatencyRecorder
+
+log = logging.getLogger(__name__)
+
+
+class RequestTooLarge(ValueError):
+    """The request exceeds the ladder's top rung (== training budget):
+    no single batch can hold it. Callers split or reject."""
+
+
+def abstract_batch(budget: BatchBudget, n_feat: int) -> PackedBatch:
+    """The ShapeDtypeStruct tree of a budget-shaped PackedBatch — the AOT
+    lowering target. Dtypes mirror pack.pack_examples' buffers exactly;
+    any drift fails loudly at dispatch (compiled executables reject
+    mismatched signatures)."""
+    G = budget.max_graphs + 1
+    N, E = budget.max_nodes, budget.max_edges
+
+    def s(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    return PackedBatch(
+        x=s((N, n_feat), np.float32), ms_id=s((N,), np.int32),
+        node_depth=s((N,), np.float32), node_graph=s((N,), np.int32),
+        node_mask=s((N,), np.bool_), pattern_prob=s((N,), np.float32),
+        pattern_size=s((N,), np.float32), senders=s((E,), np.int32),
+        receivers=s((E,), np.int32), edge_iface=s((E,), np.int32),
+        edge_rpctype=s((E,), np.int32), edge_duration=s((E,), np.float32),
+        edge_mask=s((E,), np.bool_), entry_id=s((G,), np.int32),
+        y=s((G,), np.float32), graph_mask=s((G,), np.bool_))
+
+
+@dataclasses.dataclass
+class _BucketStats:
+    dispatches: int = 0
+    real_nodes: int = 0
+    real_edges: int = 0
+    padded_nodes: int = 0
+    padded_edges: int = 0
+
+
+class InferenceEngine:
+    """Bucketed AOT inference over one trained state.
+
+    Build with ``from_dataset`` (shares the dataset's mixtures, feature
+    lookup, and derived budget), then ``warmup()`` once before taking
+    traffic. ``predict_microbatch`` is one bucket-shaped dispatch;
+    ``predict_many`` greedily splits an arbitrary request list into
+    capacity-respecting microbatches (prefix order preserved, so outputs
+    align 1:1 with inputs)."""
+
+    def __init__(self, model, state, cfg: Config,
+                 mixtures: dict[int, Mixture], lookup: ResourceLookup,
+                 budget: BatchBudget):
+        self._cfg = cfg
+        self._mixtures = mixtures
+        self._lookup = lookup
+        self._node_depth_in_x = cfg.model.use_node_depth
+        self._n_feat = lookup.num_features + (
+            1 if self._node_depth_in_x else 0)
+        self.ladder = make_bucket_ladder(budget, cfg.serve)
+        # device-resident once: per-dispatch H2D is then only the batch
+        self._variables = jax.tree.map(
+            jnp.asarray, {"params": state.params,
+                          "batch_stats": state.batch_stats})
+        label_scale = cfg.train.label_scale
+
+        def step(variables, batch):
+            global_pred, _ = model.apply(variables, batch, training=False)
+            return global_pred * label_scale
+
+        self._step = step
+        self._exe: dict[int, object] = {}
+        self._warmed = False
+        self.warmup_s: float | None = None
+        self.latency = LatencyRecorder()
+        self._bucket_stats = {i: _BucketStats()
+                              for i in range(len(self.ladder))}
+        self.requests = 0
+        self.batches = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.compiles = 0
+
+    @classmethod
+    def from_dataset(cls, dataset, cfg: Config, state) -> "InferenceEngine":
+        model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
+                           dataset.num_interfaces, dataset.num_rpctypes)
+        return cls(model, state, cfg, dataset.mixtures, dataset.lookup,
+                   dataset.budget)
+
+    # -- executable cache ------------------------------------------------
+
+    def _compile(self, idx: int) -> object:
+        exe = jax.jit(self._step).lower(
+            self._variables,
+            abstract_batch(self.ladder[idx], self._n_feat)).compile()
+        self._exe[idx] = exe
+        self.compiles += 1
+        return exe
+
+    def warmup(self) -> "InferenceEngine":
+        """AOT-compile every ladder rung so steady-state serving never
+        compiles. Idempotent; returns self for chaining."""
+        t0 = time.perf_counter()
+        for i in range(len(self.ladder)):
+            if i not in self._exe:
+                self._compile(i)
+        self.warmup_s = time.perf_counter() - t0
+        self._warmed = True
+        log.info("serve warmup: %d bucket executables in %.2fs (ladder %s)",
+                 len(self.ladder), self.warmup_s,
+                 [(b.max_nodes, b.max_edges) for b in self.ladder])
+        return self
+
+    # -- request path ----------------------------------------------------
+
+    def request_size(self, entry_id: int) -> tuple[int, int]:
+        """(nodes, edges) one request for this entry costs — the queue's
+        capacity accounting."""
+        m = self._mixtures[int(entry_id)]
+        return m.num_nodes, m.num_edges
+
+    def predict_microbatch(self, entry_ids, ts_buckets) -> np.ndarray:
+        """One bucket-shaped dispatch for a coalesced microbatch.
+
+        Returns per-request predictions in request order (label units).
+        Raises RequestTooLarge if the microbatch exceeds the top rung —
+        callers that cannot pre-size (predict_many, the queue) split
+        instead."""
+        entry_ids = np.asarray(entry_ids)
+        g = len(entry_ids)
+        n = sum(self._mixtures[int(e)].num_nodes for e in entry_ids)
+        e_tot = sum(self._mixtures[int(e)].num_edges for e in entry_ids)
+        idx = select_bucket(self.ladder, g, n, e_tot)
+        if idx is None:
+            raise RequestTooLarge(
+                f"microbatch of {g} graphs ({n} nodes, {e_tot} edges) "
+                f"exceeds the top bucket {self.ladder[-1]}")
+        with self.latency.time():
+            if idx in self._exe:
+                self.cache_hits += 1
+                exe = self._exe[idx]
+            else:
+                self.cache_misses += 1
+                if self._warmed:
+                    log.warning(
+                        "executable cache miss AFTER warmup for bucket %s "
+                        "— the ladder no longer covers the request range",
+                        self.ladder[idx])
+                exe = self._compile(idx)
+            bucket = self.ladder[idx]
+            batch = pack_single(self._mixtures, entry_ids,
+                                np.asarray(ts_buckets), bucket,
+                                self._lookup,
+                                node_depth_in_x=self._node_depth_in_x)
+            pred = np.asarray(exe(self._variables, batch))[:g]
+        self.requests += g
+        self.batches += 1
+        bs = self._bucket_stats[idx]
+        bs.dispatches += 1
+        bs.real_nodes += n
+        bs.real_edges += e_tot
+        bs.padded_nodes += bucket.max_nodes
+        bs.padded_edges += bucket.max_edges
+        return pred
+
+    def predict_many(self, entry_ids, ts_buckets) -> np.ndarray:
+        """Predictions for an arbitrary request list, split greedily into
+        capacity-respecting microbatches (prefix order preserved — output
+        row i answers input row i)."""
+        entry_ids = np.asarray(entry_ids)
+        ts_buckets = np.asarray(ts_buckets)
+        top = self.ladder[-1]
+        max_g = top.max_graphs
+        preds: list[np.ndarray] = []
+        i = 0
+        while i < len(entry_ids):
+            g = n = e = 0
+            j = i
+            while j < len(entry_ids) and g < max_g:
+                dn, de = self.request_size(entry_ids[j])
+                if g and (n + dn > top.max_nodes or e + de > top.max_edges):
+                    break
+                g, n, e = g + 1, n + dn, e + de
+                j += 1
+            preds.append(self.predict_microbatch(entry_ids[i:j],
+                                                 ts_buckets[i:j]))
+            i = j
+        return (np.concatenate(preds) if preds
+                else np.zeros(0, np.float32))
+
+    # -- instrumentation -------------------------------------------------
+
+    def pad_waste_ratio(self) -> float:
+        """Aggregate fraction of dispatched node+edge slots that were
+        padding (serve/buckets.pad_waste per dispatch, pooled)."""
+        real = sum(b.real_nodes + b.real_edges
+                   for b in self._bucket_stats.values())
+        padded = sum(b.padded_nodes + b.padded_edges
+                     for b in self._bucket_stats.values())
+        return (padded - real) / padded if padded else 0.0
+
+    def stats_dict(self) -> dict:
+        """JSON-ready serving counters — the schema serve_bench reports
+        and the serving docs describe."""
+        buckets = []
+        for i, b in enumerate(self.ladder):
+            s = self._bucket_stats[i]
+            buckets.append({
+                **dataclasses.asdict(b),
+                "dispatches": s.dispatches,
+                "pad_waste": (pad_waste(
+                    b, s.real_nodes / s.dispatches,
+                    s.real_edges / s.dispatches) if s.dispatches else None),
+            })
+        return {
+            "requests": self.requests,
+            "batches": self.batches,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "compiles": self.compiles,
+            "warmup_s": self.warmup_s,
+            "pad_waste_ratio": self.pad_waste_ratio(),
+            "latency": self.latency.summary_dict(),
+            "buckets": buckets,
+        }

@@ -1,0 +1,153 @@
+"""Deadline-based microbatching queue for concurrent serving traffic.
+
+Per-dispatch overhead (host pack + H2D + program launch) is the serving
+twin of the per-step dispatch latency the train loop amortizes with
+lax.scan (TrainConfig.scan_chunk): a single-graph forward pays the same
+fixed cost as a 16-graph one. The queue coalesces requests that arrive
+within a flush deadline into ONE bucket-shaped microbatch, amortizing
+that fixed cost across concurrent callers exactly the way the epoch
+packer amortizes padding across a batch.
+
+Semantics:
+- `submit` returns a Future; `predict` is the blocking convenience.
+- A batch flushes when (a) the oldest queued request has waited
+  `flush_deadline_ms`, or (b) the pending set would overflow the engine's
+  top bucket (graphs, nodes, or edges) — whichever comes first. Deadline
+  0 degrades to per-request dispatch (lowest latency, no amortization).
+- One worker thread owns ALL engine calls, so the engine needs no locks
+  and per-request prediction alignment is preserved by construction:
+  each flush packs its requests in submission order and fans the
+  engine's per-request outputs back to the matching futures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+from pertgnn_tpu.serve.engine import InferenceEngine
+
+
+class MicrobatchQueue:
+    """Thread-safe request front-end over a (single-threaded) engine."""
+
+    def __init__(self, engine: InferenceEngine,
+                 flush_deadline_ms: float | None = None,
+                 max_graphs: int | None = None):
+        cfg = engine._cfg.serve
+        self._engine = engine
+        self._deadline_s = (cfg.flush_deadline_ms
+                            if flush_deadline_ms is None
+                            else flush_deadline_ms) / 1e3
+        top = engine.ladder[-1]
+        self._max_graphs = min(max_graphs or top.max_graphs, top.max_graphs)
+        self._max_nodes = top.max_nodes
+        self._max_edges = top.max_edges
+        # (entry_id, ts_bucket, arrival_time, future) — arrival anchors
+        # the flush deadline even when the worker was busy dispatching
+        self._pending: list[tuple[int, int, float, Future]] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-microbatch")
+        self._worker.start()
+
+    # -- client side -----------------------------------------------------
+
+    def submit(self, entry_id: int, ts_bucket: int) -> Future:
+        """Enqueue one request; the Future resolves to its predicted
+        latency (label units) once its microbatch is served."""
+        # size it NOW so an entry the engine has never seen fails the
+        # caller, not the shared worker
+        self._engine.request_size(entry_id)
+        fut: Future = Future()
+        with self._wake:
+            if self._closed:
+                raise RuntimeError("MicrobatchQueue is closed")
+            self._pending.append((int(entry_id), int(ts_bucket),
+                                  time.perf_counter(), fut))
+            self._wake.notify()
+        return fut
+
+    def predict(self, entry_id: int, ts_bucket: int) -> float:
+        return float(self.submit(entry_id, ts_bucket).result())
+
+    def close(self) -> None:
+        """Drain pending requests, then stop the worker. Idempotent."""
+        with self._wake:
+            if self._closed:
+                return
+            self._closed = True
+            self._wake.notify()
+        self._worker.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- worker side -----------------------------------------------------
+
+    def _take_batch_locked(self) -> list[tuple[int, int, float, Future]]:
+        """Pop the maximal capacity-respecting prefix of the pending list
+        (submission order — alignment depends on it)."""
+        g = n = e = 0
+        take = 0
+        for entry_id, _ts, _t, _f in self._pending:
+            dn, de = self._engine.request_size(entry_id)
+            if take and (g + 1 > self._max_graphs
+                         or n + dn > self._max_nodes
+                         or e + de > self._max_edges):
+                break
+            g, n, e = g + 1, n + dn, e + de
+            take += 1
+        batch = self._pending[:take]
+        del self._pending[:take]
+        return batch
+
+    def _full_locked(self) -> bool:
+        """Would waiting longer be pointless? True once the pending
+        prefix already saturates a top-bucket batch."""
+        g = n = e = 0
+        for entry_id, _ts, _t, _f in self._pending:
+            dn, de = self._engine.request_size(entry_id)
+            if (g + 1 > self._max_graphs or n + dn > self._max_nodes
+                    or e + de > self._max_edges):
+                return True
+            g, n, e = g + 1, n + dn, e + de
+        return False
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                while not self._pending and not self._closed:
+                    self._wake.wait()
+                if not self._pending and self._closed:
+                    return
+                # deadline anchored at the OLDEST queued request's ARRIVAL
+                # (not at worker observation: a request that queued while
+                # the worker was dispatching has already been waiting)
+                t_flush = self._pending[0][2] + self._deadline_s
+                while (not self._closed and not self._full_locked()):
+                    remaining = t_flush - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._wake.wait(timeout=remaining)
+                batch = self._take_batch_locked()
+            if not batch:
+                continue
+            entries = [b[0] for b in batch]
+            buckets = [b[1] for b in batch]
+            futures = [b[3] for b in batch]
+            try:
+                preds = self._engine.predict_microbatch(entries, buckets)
+            except BaseException as exc:
+                for f in futures:
+                    f.set_exception(exc)
+                continue
+            for f, p in zip(futures, preds):
+                f.set_result(float(p))

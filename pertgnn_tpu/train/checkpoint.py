@@ -36,12 +36,14 @@ class CheckpointManager:
             return
         # state is passed as-is: orbax handles (multi-host) sharded
         # jax.Arrays natively; a device_get here would break multi-host
-        # (no process holds remote shards) and forces a D2H copy
+        # (no process holds remote shards) and forces a D2H copy.
+        # The epoch-metrics item is named "history": orbax >= 0.7 reserves
+        # the item name "metrics" for itself and rejects the save.
         self._mgr.save(
             epoch,
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(state),
-                metrics=ocp.args.JsonSave(metrics or {}),
+                history=ocp.args.JsonSave(metrics or {}),
             ),
         )
 
@@ -89,9 +91,16 @@ class CheckpointManager:
         import dataclasses
         import json
 
+        # Only process 0 writes: on a shared checkpoint dir every process
+        # races the same file, and two writers using one fixed tmp name
+        # can interleave truncate/rename into a torn sidecar (ADVICE r5).
+        # The pid suffix keeps even same-host processes (supervisor
+        # restarts, multi-process CPU meshes) from sharing a tmp path.
+        if jax.process_index() != 0:
+            return
         path = os.path.join(str(self._mgr.directory),
                             "train_config.json")
-        tmp = path + ".tmp"
+        tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(dataclasses.asdict(cfg), f, indent=1, default=str)
         os.replace(tmp, path)

@@ -74,3 +74,35 @@ def predict_split(dataset: Dataset, cfg: Config, state: TrainState,
             "prefix-order invariant this function documents no longer "
             "holds")
     return pred
+
+
+def predict_split_served(dataset: Dataset, cfg: Config, state: TrainState,
+                         split: str, engine=None) -> np.ndarray:
+    """`predict_split` routed through the serving engine's bucketed
+    request path (serve/engine.py) instead of the epoch packer.
+
+    Same contract — one prediction per split row, positional order — but
+    the split is consumed as a request stream: greedy microbatches packed
+    into the engine's shape buckets and dispatched through the AOT
+    executable cache. Alignment is per-request by construction
+    (engine.predict_many preserves prefix order), so unlike
+    `predict_split` there is no packer invariant to re-assert; the row
+    count is still pinned.
+
+    `engine` (an InferenceEngine built over THIS dataset's mixtures and
+    already warmed) is rebuilt when omitted; callers predicting several
+    splits should build it once — the executable cache is shared.
+    """
+    from pertgnn_tpu.serve.engine import InferenceEngine
+
+    if engine is None:
+        engine = InferenceEngine.from_dataset(dataset, cfg, state)
+        if cfg.serve.warmup:
+            engine.warmup()
+    s = dataset.splits[split]
+    pred = engine.predict_many(s.entry_ids, s.ts_buckets)
+    if pred.shape != np.asarray(s.ys).shape:
+        raise AssertionError(
+            f"served prediction count {pred.shape} diverged from the "
+            f"'{split}' split rows {np.asarray(s.ys).shape}")
+    return pred

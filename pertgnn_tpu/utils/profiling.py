@@ -2,6 +2,8 @@
 
 The reference's only instrumentation is tqdm bars (SURVEY.md §5.1). Here:
 - `StepTimer` — wall-clock EMA per step with one-line summaries;
+- `LatencyRecorder` — percentile latency tracking for the serving engine
+  (p50/p95/p99, throughput) — serve/engine.py and benchmarks/serve_bench.py;
 - `profile_epochs` — a `fit(profile_hook=...)` hook that captures a
   jax.profiler trace (viewable in TensorBoard/Perfetto) for chosen epochs.
 """
@@ -39,6 +41,67 @@ class StepTimer:
         if self.ema is None:
             return "no steps timed"
         return f"{self.count} steps, ema {self.ema * 1e3:.2f} ms/step"
+
+
+class LatencyRecorder:
+    """Latency samples + percentile summary for the serving path.
+
+    Samples are kept raw (one float per observation) rather than binned:
+    serving streams are at most ~1e6 requests per process lifetime here,
+    so exact percentiles cost nothing and the bench JSON stays honest.
+    Not thread-safe on its own — the serving engine serializes all
+    recording behind the microbatch queue's single worker."""
+
+    def __init__(self) -> None:
+        self._ms: list[float] = []
+
+    def record_s(self, seconds: float) -> None:
+        self._ms.append(seconds * 1e3)
+
+    def time(self):
+        """Context manager recording one sample."""
+        return _LatencySpan(self)
+
+    @property
+    def count(self) -> int:
+        return len(self._ms)
+
+    def percentile_ms(self, q: float) -> float:
+        if not self._ms:
+            return float("nan")
+        import numpy as np
+
+        return float(np.percentile(np.asarray(self._ms), q))
+
+    def summary_dict(self) -> dict:
+        """p50/p95/p99/mean latency (ms) + sample count — the serving
+        metrics schema shared by engine stats and serve_bench JSON."""
+        import numpy as np
+
+        if not self._ms:
+            return {"count": 0, "p50_ms": None, "p95_ms": None,
+                    "p99_ms": None, "mean_ms": None}
+        a = np.asarray(self._ms)
+        return {
+            "count": len(a),
+            "p50_ms": float(np.percentile(a, 50)),
+            "p95_ms": float(np.percentile(a, 95)),
+            "p99_ms": float(np.percentile(a, 99)),
+            "mean_ms": float(a.mean()),
+        }
+
+
+class _LatencySpan:
+    def __init__(self, rec: LatencyRecorder):
+        self._rec = rec
+
+    def __enter__(self):
+        self._t = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._rec.record_s(time.perf_counter() - self._t)
+        return False
 
 
 def profile_epochs(log_dir: str, epochs: Sequence[int] = (1,)

@@ -23,6 +23,12 @@ and assert the structural invariants that must hold for EVERY input:
 
 import numpy as np
 import pandas as pd
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need the hypothesis dev extra "
+           "(pip install -e .[dev])")
 from hypothesis import assume, given, settings
 from hypothesis import strategies as st
 

@@ -22,6 +22,12 @@ for every input.
 
 import numpy as np
 import pandas as pd
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need the hypothesis dev extra "
+           "(pip install -e .[dev])")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

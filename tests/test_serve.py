@@ -1,0 +1,318 @@
+"""Serving path (pertgnn_tpu/serve/): bucket ladder, AOT executable
+cache, single-batch fast pack, and the microbatching queue.
+
+The load-bearing guarantees:
+- bucket selection always picks the SMALLEST fitting rung (pad waste is
+  bounded by the ladder's growth factor only if this holds);
+- padding a request up to a bucket must be unobservable — the padded
+  output is bit-identical to the exact-shape (unpadded) forward;
+- after warmup the executable cache never misses over a request stream
+  spanning several shape buckets (steady-state serving never compiles);
+- microbatch coalescing preserves per-request prediction alignment.
+"""
+
+import numpy as np
+import pytest
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.batching.pack import BatchBudget, pack_single
+from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                ModelConfig, ServeConfig, TrainConfig)
+from pertgnn_tpu.serve.buckets import (make_bucket_ladder, pad_waste,
+                                       select_bucket)
+from pertgnn_tpu.serve.engine import InferenceEngine, RequestTooLarge
+from pertgnn_tpu.serve.queue import MicrobatchQueue
+from pertgnn_tpu.train.loop import restore_target_state
+
+SERVE = ServeConfig(bucket_growth=2.0, min_bucket_nodes=128,
+                    min_bucket_edges=128, max_graphs_per_batch=8)
+
+
+@pytest.fixture(scope="module")
+def served(preprocessed):
+    """(dataset, cfg, state, warmed engine) over the shared synthetic
+    corpus — weights are a fresh init (serving behavior is independent of
+    training quality; the e2e CLI test covers trained checkpoints)."""
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=200, batch_size=16),
+        model=ModelConfig(hidden_channels=16, num_layers=2),
+        train=TrainConfig(label_scale=1000.0),
+        serve=SERVE,
+        graph_type="pert",
+    )
+    ds = build_dataset(preprocessed, cfg)
+    _model, state = restore_target_state(ds, cfg)
+    engine = InferenceEngine.from_dataset(ds, cfg, state).warmup()
+    return ds, cfg, state, engine
+
+
+class TestBucketLadder:
+    def test_ladder_shape(self):
+        top = BatchBudget(max_graphs=170, max_nodes=4096, max_edges=5120)
+        ladder = make_bucket_ladder(top, SERVE)
+        assert len(ladder) >= 3
+        # ascending, 128-aligned, top rung covers the training budget
+        for lo, hi in zip(ladder, ladder[1:]):
+            assert lo.max_nodes <= hi.max_nodes
+            assert lo.max_edges <= hi.max_edges
+        for b in ladder:
+            assert b.max_nodes % 128 == 0 and b.max_edges % 128 == 0
+            assert b.max_graphs == SERVE.max_graphs_per_batch
+        assert ladder[-1].max_nodes >= top.max_nodes
+        assert ladder[-1].max_edges >= top.max_edges
+        assert ladder[0].max_nodes <= SERVE.min_bucket_nodes
+
+    def test_tiny_budget_single_rung(self):
+        ladder = make_bucket_ladder(
+            BatchBudget(max_graphs=4, max_nodes=128, max_edges=128), SERVE)
+        assert len(ladder) == 1
+        assert ladder[0].max_graphs == 4  # never exceeds the budget's
+
+    def test_select_bucket_picks_smallest_fitting(self):
+        top = BatchBudget(max_graphs=170, max_nodes=4096, max_edges=5120)
+        ladder = make_bucket_ladder(top, SERVE)
+        for g, n, e in [(1, 1, 1), (1, 128, 128), (1, 129, 1),
+                        (8, 1000, 900), (3, 4096, 5120)]:
+            idx = select_bucket(ladder, g, n, e)
+            assert idx is not None
+            b = ladder[idx]
+            assert g <= b.max_graphs and n <= b.max_nodes and e <= b.max_edges
+            # every smaller rung must NOT fit — "smallest" is the law
+            for smaller in ladder[:idx]:
+                assert (g > smaller.max_graphs or n > smaller.max_nodes
+                        or e > smaller.max_edges)
+
+    def test_select_bucket_none_when_oversized(self):
+        ladder = make_bucket_ladder(
+            BatchBudget(max_graphs=4, max_nodes=256, max_edges=256), SERVE)
+        assert select_bucket(ladder, 1, 10_000, 1) is None
+        assert select_bucket(ladder, 99, 1, 1) is None
+
+    def test_pad_waste(self):
+        b = BatchBudget(max_graphs=8, max_nodes=100, max_edges=100)
+        assert pad_waste(b, 100, 100) == 0.0
+        assert pad_waste(b, 50, 50) == pytest.approx(0.5)
+
+
+class TestPackSingle:
+    def test_rejects_overflow_and_empty(self, served):
+        ds, cfg, _state, _engine = served
+        tiny = BatchBudget(max_graphs=1, max_nodes=2, max_edges=1)
+        s = ds.splits["test"]
+        with pytest.raises(ValueError, match="do not fit"):
+            pack_single(ds.mixtures, s.entry_ids[:1], s.ts_buckets[:1],
+                        tiny, ds.lookup)
+        with pytest.raises(ValueError, match="at least one"):
+            pack_single(ds.mixtures, s.entry_ids[:0], s.ts_buckets[:0],
+                        ds.budget, ds.lookup)
+
+    def test_matches_epoch_packer_invariants(self, served):
+        ds, cfg, _state, _engine = served
+        s = ds.splits["test"]
+        b = pack_single(ds.mixtures, s.entry_ids[:3], s.ts_buckets[:3],
+                        ds.budget, ds.lookup)
+        # receiver-sorted real edges, pad edges at the tail
+        real = b.edge_mask.nonzero()[0]
+        assert (np.diff(b.receivers[real]) >= 0).all()
+        assert not b.edge_mask[len(real):].any()
+        # pad nodes point at the reserved pad graph slot
+        assert (b.node_graph[~b.node_mask] == b.num_graphs - 1).all()
+        assert not b.graph_mask[-1]
+        assert b.graph_mask[:3].all() and not b.graph_mask[3:].any()
+
+
+class TestPaddingInvariance:
+    def test_bucket_padding_is_bit_identical_to_unpadded(self, served):
+        """The same request packed at exact shape (zero padding) and
+        padded up to ANY ladder rung must produce bit-identical
+        predictions — padding must be unobservable, not merely small.
+
+        Compiled execution (what serving dispatches — jit here, the AOT
+        twin in the engine cache) IS bit-stable across pad shapes; the
+        eager trace is not (op-by-op reassociation differs by 1 ulp), so
+        the assertion deliberately runs the compiled path."""
+        import jax
+
+        ds, cfg, _state, engine = served
+        step = jax.jit(engine._step)
+        s = ds.splits["test"]
+        for k in (1, 3):
+            entries, buckets = s.entry_ids[:k], s.ts_buckets[:k]
+            n = sum(ds.mixtures[int(e)].num_nodes for e in entries)
+            e_tot = sum(ds.mixtures[int(e)].num_edges for e in entries)
+            exact = BatchBudget(max_graphs=k, max_nodes=n, max_edges=e_tot)
+            outs = []
+            for budget in [exact, *engine.ladder]:
+                if (n > budget.max_nodes or e_tot > budget.max_edges
+                        or k > budget.max_graphs):
+                    continue
+                batch = pack_single(ds.mixtures, entries, buckets, budget,
+                                    ds.lookup)
+                pred = np.asarray(step(engine._variables, batch))[:k]
+                outs.append((budget, pred))
+            assert len(outs) >= 3  # exact + at least two rungs
+            ref_budget, ref = outs[0]
+            assert ref_budget is exact
+            for budget, out in outs[1:]:
+                np.testing.assert_array_equal(
+                    out, ref,
+                    err_msg=f"padding to {budget} changed the prediction")
+
+    def test_served_split_matches_offline_predict(self, served):
+        """The bucketed request path must reproduce the epoch-packed
+        offline prediction for a whole split."""
+        from pertgnn_tpu.train.predict import (predict_split,
+                                               predict_split_served)
+
+        ds, cfg, state, engine = served
+        off = predict_split(ds, cfg, state, "test")
+        srv = predict_split_served(ds, cfg, state, "test", engine=engine)
+        np.testing.assert_array_equal(srv, off)
+
+
+class TestExecutableCache:
+    def test_zero_misses_after_warmup(self, served):
+        """A randomized stream spanning >= 3 shape buckets must be served
+        entirely from the warmed executable cache."""
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        rng = np.random.default_rng(0)
+        hits0, misses0 = engine.cache_hits, engine.cache_misses
+        used = set()
+        for _ in range(30):
+            k = int(rng.integers(1, cfg.serve.max_graphs_per_batch + 1))
+            idx = rng.integers(0, len(s.entry_ids), size=k)
+            entries, buckets = s.entry_ids[idx], s.ts_buckets[idx]
+            n = sum(ds.mixtures[int(e)].num_nodes for e in entries)
+            e_tot = sum(ds.mixtures[int(e)].num_edges for e in entries)
+            used.add(select_bucket(engine.ladder, k, n, e_tot))
+            engine.predict_microbatch(entries, buckets)
+        assert len(used) >= 3, (
+            "stream too uniform to exercise the ladder — widen the "
+            "microbatch size range")
+        assert engine.cache_misses == misses0
+        assert engine.cache_hits == hits0 + 30
+
+    def test_compiles_once_per_rung(self, served):
+        _ds, _cfg, _state, engine = served
+        assert engine.compiles == len(engine.ladder)
+        assert engine.warmup_s is not None
+
+    def test_oversized_request_raises(self, served):
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        # enough copies of the largest mixture to overflow the top rung
+        big = max(ds.mixtures, key=lambda k: ds.mixtures[k].num_nodes)
+        reps = (engine.ladder[-1].max_nodes
+                // ds.mixtures[big].num_nodes) + 1
+        reps = min(reps, engine.ladder[-1].max_graphs + 1)
+        with pytest.raises(RequestTooLarge):
+            engine.predict_microbatch(
+                np.full(reps, big), np.full(reps, s.ts_buckets[0]))
+
+    def test_stats_schema(self, served):
+        _ds, _cfg, _state, engine = served
+        stats = engine.stats_dict()
+        assert {"requests", "batches", "cache_hits", "cache_misses",
+                "compiles", "warmup_s", "pad_waste_ratio", "latency",
+                "buckets"} <= set(stats)
+        assert 0.0 <= stats["pad_waste_ratio"] < 1.0
+        assert {"p50_ms", "p95_ms", "p99_ms"} <= set(stats["latency"])
+        assert len(stats["buckets"]) == len(engine.ladder)
+
+
+class TestMicrobatchQueue:
+    def test_coalescing_preserves_alignment(self, served):
+        """Requests submitted concurrently and coalesced into shared
+        batches must each get THEIR prediction — identical to serving the
+        same request alone."""
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        k = min(12, len(s.entry_ids))
+        solo = np.concatenate([
+            engine.predict_microbatch(s.entry_ids[i:i + 1],
+                                      s.ts_buckets[i:i + 1])
+            for i in range(k)])
+        batches0 = engine.batches
+        with MicrobatchQueue(engine, flush_deadline_ms=25) as q:
+            futs = [q.submit(int(s.entry_ids[i]), int(s.ts_buckets[i]))
+                    for i in range(k)]
+            got = np.asarray([f.result(timeout=30) for f in futs],
+                             np.float32)
+        np.testing.assert_array_equal(got, solo)
+        # the deadline actually coalesced: far fewer dispatches than
+        # requests (worst realistic case: a flush per capacity fill)
+        assert engine.batches - batches0 < k
+
+    def test_deadline_zero_serves_singly(self, served):
+        ds, _cfg, _state, engine = served
+        s = ds.splits["test"]
+        with MicrobatchQueue(engine, flush_deadline_ms=0) as q:
+            v = q.predict(int(s.entry_ids[0]), int(s.ts_buckets[0]))
+        assert np.isfinite(v)
+
+    def test_submit_after_close_raises(self, served):
+        ds, _cfg, _state, engine = served
+        s = ds.splits["test"]
+        q = MicrobatchQueue(engine, flush_deadline_ms=1)
+        q.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            q.submit(int(s.entry_ids[0]), int(s.ts_buckets[0]))
+
+    def test_unknown_entry_fails_caller_not_worker(self, served):
+        _ds, _cfg, _state, engine = served
+        with MicrobatchQueue(engine, flush_deadline_ms=1) as q:
+            with pytest.raises(KeyError):
+                q.submit(10_000_000, 0)
+
+
+def test_serve_cli_round_trip(tmp_path):
+    """train_main writes a checkpoint; serve_main restores it and serves
+    a split replay through the full queue+engine stack, emitting aligned
+    predictions and the serving-metrics JSON line."""
+    import json
+
+    import pandas as pd
+
+    from pertgnn_tpu.cli import serve_main, train_main
+
+    ckpt = str(tmp_path / "ckpt")
+    common = ["--synthetic", "--synthetic_entries", "2",
+              "--synthetic_traces_per_entry", "60",
+              "--min_traces_per_entry", "5", "--label_scale", "1000",
+              "--artifact_dir", str(tmp_path / "art"),
+              "--checkpoint_dir", ckpt]
+    train_main.main([*common, "--epochs", "2"])
+    out = str(tmp_path / "served.csv")
+    serve_main.main([*common, "--from_split", "test", "--concurrency", "3",
+                     "--flush_deadline_ms", "5", "--out", out],)
+    df = pd.read_csv(out)
+    assert set(df.columns) == {"entry_id", "ts_bucket", "y_pred"}
+    assert len(df) > 0 and np.isfinite(df["y_pred"]).all()
+
+
+def test_predict_cli_serve_bucketed_matches_offline(tmp_path):
+    """--serve_bucketed must write the SAME predictions as the offline
+    epoch-packed path (both CSVs row-aligned to the meta table)."""
+    import pandas as pd
+
+    from pertgnn_tpu.cli import predict_main, train_main
+
+    ckpt = str(tmp_path / "ckpt")
+    common = ["--synthetic", "--synthetic_entries", "2",
+              "--synthetic_traces_per_entry", "60",
+              "--min_traces_per_entry", "5", "--label_scale", "1000",
+              "--artifact_dir", str(tmp_path / "art"),
+              "--checkpoint_dir", ckpt]
+    train_main.main([*common, "--epochs", "2"])
+    off_csv = str(tmp_path / "off.csv")
+    srv_csv = str(tmp_path / "srv.csv")
+    predict_main.main([*common, "--split", "all", "--out", off_csv])
+    predict_main.main([*common, "--split", "all", "--serve_bucketed",
+                       "--out", srv_csv])
+    off = pd.read_csv(off_csv)
+    srv = pd.read_csv(srv_csv)
+    assert (off["traceid"] == srv["traceid"]).all()
+    np.testing.assert_allclose(srv["y_pred"], off["y_pred"],
+                               rtol=1e-5, atol=1e-5)
