@@ -192,6 +192,11 @@ def main() -> int:
         "mean_ms": float(lat_b.mean() * 1e3),
         "throughput_rps": float(n_requests / lat_b.sum()),
         "pad_waste_ratio": stats["pad_waste_ratio"],
+        # per-stage request-lifecycle breakdown (engine stage recorders:
+        # pack / dispatch / compute; queue stays empty — this bench
+        # drives the engine directly). Each value is the shared latency
+        # summary schema (utils/profiling.SUMMARY_KEYS).
+        "stages": {s: d for s, d in stats["stages"].items() if d["count"]},
         "cache_misses_after_warmup": stats["cache_misses"],
         "cache_hits": stats["cache_hits"],
         "warmup_s": stats["warmup_s"],

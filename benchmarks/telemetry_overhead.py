@@ -1,0 +1,143 @@
+"""Micro-bench: the no-op telemetry bus must be free.
+
+Instrumentation stays in the hot paths unconditionally (train chunk
+dispatch, serve request loop, the packer), so the disabled-bus cost is a
+per-step tax on EVERY untelemetered run. This bench measures it against
+a real CPU train step and asserts the ratio stays under 1%:
+
+- `step_ms`   — mean wall time of one jit'd train step (tiny synthetic
+  model, CPU) — the unit of work the tax is paid per;
+- `noop_ms`   — mean wall time of the per-step instrumentation bundle as
+  fit() actually emits it (one level-2 span enter/exit + the host/device
+  perf_counter bookkeeping), measured on the NoopBus over many reps;
+- `overhead_pct` = 100 * noop_ms / step_ms — asserted < 1.0.
+
+Prints ONE JSON line in the BENCH_r0*.json schema family; exits 1 on a
+bound violation so CI can gate on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_step():
+    """One jit'd CPU train step over a small synthetic workload (the
+    serve-bench corpus builder, batch-sized down)."""
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import Config, DataConfig, IngestConfig
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import (create_train_state, make_train_step,
+                                        make_tx)
+
+    cfg = Config(ingest=IngestConfig(min_traces_per_entry=5),
+                 data=DataConfig(max_traces=500, batch_size=16))
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=30, num_entries=4, patterns_per_entry=2,
+        traces_per_entry=60, seed=3))
+    ds = build_dataset(preprocess(data.spans, data.resources, cfg.ingest),
+                      cfg)
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    tx = make_tx(cfg)
+    sample = next(ds.batches("train"))
+    state = create_train_state(model, tx, sample, 0)
+    step = make_train_step(model, cfg, tx)
+    import jax
+    import jax.numpy as jnp
+    batch = jax.tree.map(jnp.asarray, sample)
+    state, _ = step(state, batch)  # compile outside the timed region
+    return step, state, batch
+
+
+def time_step(step, state, batch, iters: int) -> float:
+    """Mean seconds per train step (donated state threaded through)."""
+    import jax
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = step(state, batch)
+    jax.block_until_ready(m)
+    return (time.perf_counter() - t0) / iters
+
+
+def time_noop_bundle(iters: int) -> float:
+    """Mean seconds of fit()'s per-step telemetry work on the NoopBus:
+    the level-2 chunk span plus the two perf_counter samples of the
+    host/device split bookkeeping."""
+    from pertgnn_tpu.telemetry import NOOP_BUS
+
+    bus = NOOP_BUS
+    t_host = 0.0
+    t0 = time.perf_counter()
+    for i in range(iters):
+        t1 = time.perf_counter()
+        with bus.span("train.chunk", level=2, epoch=0, step=i):
+            pass
+        t_host += time.perf_counter() - t1
+    total = time.perf_counter() - t0
+    assert t_host >= 0
+    return total / iters
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--step_iters", type=int, default=50)
+    ap.add_argument("--noop_iters", type=int, default=200_000)
+    ap.add_argument("--max_overhead_pct", type=float, default=1.0)
+    ap.add_argument("--out", default="",
+                    help="also write the JSON record here")
+    args = ap.parse_args()
+
+    from pertgnn_tpu.cli.common import apply_platform_env
+    apply_platform_env()
+    import jax
+
+    from pertgnn_tpu import telemetry
+    assert not telemetry.get_bus().enabled, \
+        "default process-wide bus must be the no-op"
+
+    step, state, batch = build_step()
+    step_s = time_step(step, state, batch, args.step_iters)
+    noop_s = time_noop_bundle(args.noop_iters)
+    overhead_pct = 100.0 * noop_s / step_s
+    record = {
+        "metric": "telemetry_noop_overhead_pct",
+        "value": overhead_pct,
+        "unit": "%",
+        "step_ms": step_s * 1e3,
+        "noop_us": noop_s * 1e6,
+        "step_iters": args.step_iters,
+        "noop_iters": args.noop_iters,
+        "max_overhead_pct": args.max_overhead_pct,
+        "backend": jax.default_backend(),
+        "captured_unix_time": time.time(),
+    }
+    out = json.dumps(record)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    if overhead_pct >= args.max_overhead_pct:
+        print(f"FAIL: no-op telemetry bundle is {overhead_pct:.3f}% of a "
+              f"CPU train step (bound {args.max_overhead_pct}%)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -205,6 +205,21 @@ def assign_batches(node_counts: np.ndarray, edge_counts: np.ndarray,
         i = min(i + budget.max_graphs, jn, je)
     starts_a = np.asarray(starts, dtype=np.int64)
     sizes = np.diff(np.concatenate([starts_a, [n_ex]]))
+    # Padded-slot waste of this assignment — previously computed here
+    # (the cumsums know it exactly) and thrown away; one event per epoch
+    # pack on the process-wide bus (no-op when telemetry is off). The
+    # aggregate over the epoch equals pad_waste of the mean per-batch
+    # fill (n_ex > 0 here, so there is at least one batch).
+    from pertgnn_tpu import telemetry
+    from pertgnn_tpu.batching.pack import pad_waste
+    bus = telemetry.get_bus()
+    if bus.enabled:
+        n_batches = len(starts_a)
+        bus.gauge("pack.pad_waste",
+                  pad_waste(budget, float(cn[-1]) / n_batches,
+                            float(ce[-1]) / n_batches),
+                  batches=n_batches, examples=n_ex,
+                  max_nodes=budget.max_nodes, max_edges=budget.max_edges)
     batch_idx = np.repeat(np.arange(len(starts_a), dtype=np.int64), sizes)
     start_of_ex = np.repeat(starts_a, sizes)
     idx = np.arange(n_ex, dtype=np.int64)

@@ -22,6 +22,7 @@ from typing import Iterator, NamedTuple
 
 import numpy as np
 
+from pertgnn_tpu import telemetry
 from pertgnn_tpu.batching.featurize import ResourceLookup
 from pertgnn_tpu.batching.mixture import Mixture
 
@@ -65,6 +66,17 @@ class BatchBudget:
 
 def _round_up(v: int, m: int = 128) -> int:
     return ((v + m - 1) // m) * m
+
+
+def pad_waste(budget: BatchBudget, num_nodes: float,
+              num_edges: float) -> float:
+    """Fraction of a budget's node+edge slots burned on padding — THE
+    pad-waste metric, shared by the serving engine's per-bucket stats
+    (serve/buckets.py re-exports it), the epoch packer's telemetry
+    (assign_batches, flush) and the serve-bench JSON, so every stream
+    reports the same quantity."""
+    total = budget.max_nodes + budget.max_edges
+    return (total - num_nodes - num_edges) / total
 
 
 EDGE_FIELDS = ("senders", "receivers", "edge_iface", "edge_rpctype",
@@ -158,12 +170,14 @@ def pack_single(
         raise ValueError(
             f"{len(entry_ids)} examples ({n} nodes, {e_tot} edges) do not "
             f"fit one batch of {budget}")
-    batches = list(pack_examples(mixtures, entry_ids,
-                                 np.asarray(ts_buckets), ys, budget, lookup,
-                                 node_depth_in_x=node_depth_in_x))
-    # the fit pre-check above makes a second flush impossible
-    (batch,) = batches
-    return batch
+    with telemetry.span("pack.single", level=2, graphs=len(entry_ids)):
+        batches = list(pack_examples(mixtures, entry_ids,
+                                     np.asarray(ts_buckets), ys, budget,
+                                     lookup,
+                                     node_depth_in_x=node_depth_in_x))
+        # the fit pre-check above makes a second flush impossible
+        (batch,) = batches
+        return batch
 
 
 def pack_examples(
@@ -208,6 +222,10 @@ def pack_examples(
 
     def flush():
         nonlocal buf, g, n, e
+        bus = telemetry.get_bus()
+        if bus.enabled:
+            bus.histogram("pack.batch_pad_waste", pad_waste(budget, n, e),
+                          level=2, graphs=g, nodes=n, edges=e)
         # Receiver-sort the edge arrays (pad edges to the tail). Segment
         # aggregation is order-free, so this changes nothing for the XLA
         # path, and it lets the fused Pallas kernel skip its in-jit sort

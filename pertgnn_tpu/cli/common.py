@@ -12,7 +12,8 @@ import argparse
 import os
 
 from pertgnn_tpu.config import (Config, DataConfig, IngestConfig, ModelConfig,
-                                ParallelConfig, ServeConfig, TrainConfig)
+                                ParallelConfig, ServeConfig, TelemetryConfig,
+                                TrainConfig)
 
 
 def apply_platform_env() -> None:
@@ -206,6 +207,47 @@ def add_serve_flags(p: argparse.ArgumentParser) -> None:
                         "the compile)")
 
 
+def add_telemetry_flags(p: argparse.ArgumentParser) -> None:
+    """Telemetry-bus + logging knobs — shared by ALL CLIs (the bus is
+    process-wide; any entry point can produce a JSONL stream)."""
+    p.add_argument("--telemetry_dir", default="",
+                   help="write schema-versioned telemetry JSONL here "
+                        "(docs/OBSERVABILITY.md); empty = telemetry off")
+    p.add_argument("--telemetry_level", default="basic",
+                   choices=("off", "basic", "trace"),
+                   help="bus verbosity: basic = run/epoch granularity, "
+                        "trace adds per-chunk / per-request events")
+    p.add_argument("--tensorboard", action="store_true",
+                   help="mirror scalar telemetry to a TensorBoard sink "
+                        "under <telemetry_dir>/tb (needs tensorboardX)")
+    p.add_argument("--log_level", default="",
+                   help="logging level name (DEBUG/INFO/...); default: "
+                        "$PERTGNN_LOG_LEVEL or INFO")
+
+
+def telemetry_config_from_args(args: argparse.Namespace) -> TelemetryConfig:
+    """The ONE flags -> TelemetryConfig mapping: config_from_args embeds
+    it in the Config (checkpoint-sidecar provenance) and setup_telemetry
+    configures the live bus from it, so the two cannot drift."""
+    return TelemetryConfig(
+        telemetry_dir=getattr(args, "telemetry_dir", ""),
+        telemetry_level=getattr(args, "telemetry_level", "basic"),
+        tensorboard=getattr(args, "tensorboard", False))
+
+
+def setup_telemetry(args: argparse.Namespace, cli: str):
+    """Install the process-wide bus from parsed flags (and apply
+    --log_level). Returns the bus. Call AFTER apply_platform_env so the
+    writer's process-index stamp can see an initialized backend."""
+    from pertgnn_tpu import telemetry
+    from pertgnn_tpu.utils.logging import set_level
+
+    if getattr(args, "log_level", ""):
+        set_level(args.log_level)
+    return telemetry.configure_from_config(
+        telemetry_config_from_args(args), run_meta={"cli": cli})
+
+
 def add_ingest_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--min_traces_per_entry", type=int, default=100)
     p.add_argument("--min_resource_coverage", type=float, default=0.6)
@@ -277,6 +319,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             flush_deadline_ms=getattr(args, "flush_deadline_ms",
                                       ServeConfig.flush_deadline_ms),
             warmup=not getattr(args, "no_serve_warmup", False)),
+        telemetry=telemetry_config_from_args(args),
         graph_type=args.graph_type,
     )
 

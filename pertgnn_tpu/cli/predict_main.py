@@ -25,9 +25,10 @@ import pandas as pd
 from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.batching.dataset import split_indices
 from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
-                                    add_serve_flags, apply_platform_env,
-                                    config_from_args,
-                                    load_or_ingest_artifacts)
+                                    add_serve_flags, add_telemetry_flags,
+                                    apply_platform_env, config_from_args,
+                                    load_or_ingest_artifacts,
+                                    setup_telemetry)
 from pertgnn_tpu.train.loop import restore_target_state
 from pertgnn_tpu.train.predict import (make_predict_step, predict_split,
                                        predict_split_served)
@@ -81,6 +82,7 @@ def main(argv=None) -> None:
     add_ingest_flags(p)
     add_model_train_flags(p)
     add_serve_flags(p)
+    add_telemetry_flags(p)
     p.add_argument("--split", default="test",
                    choices=(*_SPLITS, "all"),
                    help="which positional split(s) to predict")
@@ -96,6 +98,7 @@ def main(argv=None) -> None:
         p.error("--checkpoint_dir is required: predictions come from a "
                 "trained checkpoint (run train_main with --checkpoint_dir "
                 "first)")
+    bus = setup_telemetry(args, "predict_main")
     cfg = config_from_args(args)
 
     # fail in seconds on a missing/typo'd checkpoint dir, BEFORE minutes
@@ -155,7 +158,8 @@ def main(argv=None) -> None:
           f"(epochs trained: {start_epoch}) to {args.out}")
     if engine is not None:
         import json
-        print(json.dumps({"serve_stats": engine.stats_dict()}))
+        print(json.dumps({"serve_stats": engine.publish_stats()}))
+    bus.flush()
 
 
 if __name__ == "__main__":

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import argparse
 
-from pertgnn_tpu.cli.common import add_ingest_flags, get_frames
+from pertgnn_tpu.cli.common import (add_ingest_flags, add_telemetry_flags,
+                                    get_frames, setup_telemetry)
 from pertgnn_tpu.config import IngestConfig
 from pertgnn_tpu.ingest.io import artifacts_present, preprocess_cached
 from pertgnn_tpu.utils.logging import setup_logging
@@ -21,7 +22,9 @@ def main(argv=None) -> None:
     setup_logging()
     p = argparse.ArgumentParser(description=__doc__)
     add_ingest_flags(p)
+    add_telemetry_flags(p)
     args = p.parse_args(argv)
+    bus = setup_telemetry(args, "preprocess_main")
     cfg = IngestConfig(min_traces_per_entry=args.min_traces_per_entry,
                        min_resource_coverage=args.min_resource_coverage)
     if artifacts_present(args.artifact_dir):
@@ -37,6 +40,7 @@ def main(argv=None) -> None:
     print(f"preprocessed: {pre.stats}")
     print(f"traces: {len(table.meta)}, entries: {len(table.entry2runtimes)}, "
           f"runtime patterns: {len(table.runtime2trace)}")
+    bus.flush()
 
 
 if __name__ == "__main__":

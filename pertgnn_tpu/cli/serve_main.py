@@ -32,9 +32,10 @@ import numpy as np
 
 from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
-                                    add_serve_flags, apply_platform_env,
-                                    config_from_args,
-                                    load_or_ingest_artifacts)
+                                    add_serve_flags, add_telemetry_flags,
+                                    apply_platform_env, config_from_args,
+                                    load_or_ingest_artifacts,
+                                    setup_telemetry)
 from pertgnn_tpu.train.loop import restore_target_state
 from pertgnn_tpu.utils.logging import setup_logging
 from pertgnn_tpu.utils.profiling import LatencyRecorder
@@ -74,6 +75,7 @@ def main(argv=None) -> None:
     add_ingest_flags(p)
     add_model_train_flags(p)
     add_serve_flags(p)
+    add_telemetry_flags(p)
     p.add_argument("--requests", default="",
                    help="CSV of requests (entry_id, ts_bucket columns); "
                         "default: replay --from_split")
@@ -93,6 +95,7 @@ def main(argv=None) -> None:
         p.error("--checkpoint_dir is required: serving answers from a "
                 "trained checkpoint (run train_main with --checkpoint_dir "
                 "first)")
+    bus = setup_telemetry(args, "serve_main")
     cfg = config_from_args(args)
 
     from pertgnn_tpu.cli.predict_main import _check_train_config
@@ -170,9 +173,12 @@ def main(argv=None) -> None:
         "epochs_trained": start_epoch,
         "throughput_rps": len(entries) / max(serve_wall_s, 1e-9),
         "client_latency": client_latency.summary_dict(),
-        "engine": engine.stats_dict(),
+        # publish_stats also lands the aggregate counters + per-bucket
+        # pad waste in the telemetry JSONL at basic level
+        "engine": engine.publish_stats(),
         "captured_unix_time": time.time(),
     }
+    bus.flush()
     print(f"wrote {len(entries)} served predictions to {args.out}")
     print(json.dumps(stats))
 

@@ -18,8 +18,10 @@ import sys
 
 from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
-                                    apply_platform_env, config_from_args,
-                                    load_or_ingest_artifacts)
+                                    add_telemetry_flags, apply_platform_env,
+                                    config_from_args,
+                                    load_or_ingest_artifacts,
+                                    setup_telemetry)
 from pertgnn_tpu.train import supervisor
 from pertgnn_tpu.train.loop import fit
 from pertgnn_tpu.utils.logging import setup_logging
@@ -48,6 +50,7 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     add_ingest_flags(p)
     add_model_train_flags(p)
+    add_telemetry_flags(p)
     p.add_argument("--supervise", type=int, default=0, metavar="N",
                    help="run training under a crash/hang supervisor with "
                         "up to N automatic restart-and-resumes (requires "
@@ -64,6 +67,10 @@ def main(argv=None) -> None:
         child_argv = _strip_flags(list(argv if argv is not None
                                        else sys.argv[1:]),
                                   ("--supervise", "--hang_timeout"))
+        # the parent gets its own (pid-unique) telemetry file so the
+        # restart/hang counters land somewhere even though the child owns
+        # the training stream
+        setup_telemetry(args, "train_main_supervisor")
         raise SystemExit(supervisor.supervise(
             [sys.executable, "-m", "pertgnn_tpu.cli.train_main",
              *child_argv],
@@ -73,6 +80,8 @@ def main(argv=None) -> None:
         from pertgnn_tpu.parallel.multihost import initialize
         initialize(args.coordinator_address or None, args.num_processes,
                    args.process_id)
+    # after multihost init so the JSONL process-index stamp is real
+    bus = setup_telemetry(args, "train_main")
     print(args)
     cfg = config_from_args(args)
 
@@ -133,7 +142,8 @@ def main(argv=None) -> None:
         hook = profile_epochs(args.profile_dir)
 
     state, history = fit(dataset, cfg, checkpoint_manager=ckpt,
-                         profile_hook=hook, mesh=mesh)
+                         profile_hook=hook, mesh=mesh, bus=bus)
+    bus.flush()
     for row in history:
         print(f"Epoch: {row['epoch']}, Train: {row['train_qloss']:.4f}, "
               f"Test mae: {row['test_mae']:.4f}, "

@@ -230,6 +230,26 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Unified telemetry bus knobs (pertgnn_tpu/telemetry/).
+
+    The bus is a no-op unless `telemetry_dir` is set AND
+    `telemetry_level` != "off"; the no-op costs nanoseconds per call
+    site (benchmarks/telemetry_overhead.py), so instrumentation is
+    always compiled in. Schema + workflow: docs/OBSERVABILITY.md."""
+
+    # Directory for the append-only JSONL event stream (one
+    # pid/process-index-stamped file per process). Empty = disabled.
+    telemetry_dir: str = ""
+    # Verbosity: "off" | "basic" (run/epoch granularity) | "trace"
+    # (adds per-chunk and per-request events).
+    telemetry_level: str = "basic"
+    # Mirror scalar events to a TensorBoard sink under telemetry_dir/tb
+    # (requires tensorboardX; silently JSONL-only without it).
+    tensorboard: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class ParallelConfig:
     """Mesh / sharding layout.
 
@@ -256,6 +276,7 @@ class Config:
     train: TrainConfig = TrainConfig()
     parallel: ParallelConfig = ParallelConfig()
     serve: ServeConfig = ServeConfig()
+    telemetry: TelemetryConfig = TelemetryConfig()
     # span | pert (reference: pert_gnn.py:32).
     graph_type: str = "span"
 

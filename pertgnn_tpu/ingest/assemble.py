@@ -102,6 +102,12 @@ def _runtime_ids_numeric(df: pd.DataFrame) -> pd.Series | None:
 
 def assemble(pre: PreprocessResult,
              cfg: IngestConfig = IngestConfig()) -> TraceTable:
+    from pertgnn_tpu import telemetry
+    with telemetry.span("ingest.assemble", rows=len(pre.spans)):
+        return _assemble(pre, cfg)
+
+
+def _assemble(pre: PreprocessResult, cfg: IngestConfig) -> TraceTable:
     df = pre.spans
 
     tr2runtime = _runtime_ids_numeric(df)

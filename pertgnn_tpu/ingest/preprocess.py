@@ -210,6 +210,13 @@ class PreprocessResult:
 def preprocess(spans: pd.DataFrame, resources: pd.DataFrame,
                cfg: IngestConfig = IngestConfig()) -> PreprocessResult:
     """Full L0→L2 pipeline on in-memory raw-domain frames."""
+    from pertgnn_tpu import telemetry
+    with telemetry.span("ingest.preprocess", rows=len(spans)):
+        return _preprocess(spans, resources, cfg)
+
+
+def _preprocess(spans: pd.DataFrame, resources: pd.DataFrame,
+                cfg: IngestConfig) -> PreprocessResult:
     df = spans.drop_duplicates()
     df = df.sort_values(by=["timestamp"], kind="stable")
     log.info("raw: %d rows (%d after dedupe), %d traces",

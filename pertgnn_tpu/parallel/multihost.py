@@ -56,6 +56,8 @@ def initialize(coordinator_address: str | None = None,
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+    from pertgnn_tpu.utils.logging import set_process_context
+    set_process_context(jax.process_index(), jax.process_count())
     log.info("jax.distributed initialized: process %d/%d, %d local / %d "
              "global devices", jax.process_index(), jax.process_count(),
              len(jax.local_devices()), len(jax.devices()))

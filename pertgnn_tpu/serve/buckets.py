@@ -13,8 +13,13 @@ bounded by the ladder's growth factor.
 
 from __future__ import annotations
 
-from pertgnn_tpu.batching.pack import BatchBudget, _round_up
+from pertgnn_tpu.batching.pack import (BatchBudget, _round_up,  # noqa: F401
+                                       pad_waste)
 from pertgnn_tpu.config import ServeConfig
+
+# pad_waste lives next to BatchBudget in batching/pack.py (the metric is
+# shared with the epoch packer's telemetry); re-exported here because the
+# serving engine and bench reach it through this module.
 
 
 def make_bucket_ladder(top: BatchBudget,
@@ -62,11 +67,3 @@ def select_bucket(ladder: tuple[BatchBudget, ...], num_graphs: int,
                 and num_edges <= b.max_edges):
             return i
     return None
-
-
-def pad_waste(bucket: BatchBudget, num_nodes: int, num_edges: int) -> float:
-    """Fraction of the bucket's node+edge slots burned on padding — the
-    serving twin of the training padded-slot utilization measure
-    (pack.derive_budget's sizing law)."""
-    total = bucket.max_nodes + bucket.max_edges
-    return (total - num_nodes - num_edges) / total

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pertgnn_tpu import telemetry
 from pertgnn_tpu.batching.featurize import ResourceLookup
 from pertgnn_tpu.batching.mixture import Mixture
 from pertgnn_tpu.batching.pack import BatchBudget, PackedBatch, pack_single
@@ -43,6 +44,11 @@ from pertgnn_tpu.models.pert_model import make_model
 from pertgnn_tpu.serve.buckets import (make_bucket_ladder, pad_waste,
                                        select_bucket)
 from pertgnn_tpu.utils.profiling import LatencyRecorder
+
+# The per-request lifecycle stages whose latency breakdown the engine
+# (pack/dispatch/compute) and the microbatch queue (queue) record —
+# stats_dict["stages"] and the serve-bench span percentiles share it.
+STAGES = ("queue", "pack", "dispatch", "compute")
 
 log = logging.getLogger(__name__)
 
@@ -95,8 +101,12 @@ class InferenceEngine:
 
     def __init__(self, model, state, cfg: Config,
                  mixtures: dict[int, Mixture], lookup: ResourceLookup,
-                 budget: BatchBudget):
+                 budget: BatchBudget, bus=None):
         self._cfg = cfg
+        # injected telemetry bus; None = resolve the process-wide bus
+        # LAZILY per emission (self._bus property) — an engine built
+        # before telemetry.configure() must not freeze the NoopBus
+        self._injected_bus = bus
         self._mixtures = mixtures
         self._lookup = lookup
         self._node_depth_in_x = cfg.model.use_node_depth
@@ -118,6 +128,9 @@ class InferenceEngine:
         self._warmed = False
         self.warmup_s: float | None = None
         self.latency = LatencyRecorder()
+        # per-stage latency breakdown of the request lifecycle; "queue"
+        # is fed by MicrobatchQueue (the engine itself never queues)
+        self.stage_latency = {s: LatencyRecorder() for s in STAGES}
         self._bucket_stats = {i: _BucketStats()
                               for i in range(len(self.ladder))}
         self.requests = 0
@@ -127,29 +140,33 @@ class InferenceEngine:
         self.compiles = 0
 
     @classmethod
-    def from_dataset(cls, dataset, cfg: Config, state) -> "InferenceEngine":
+    def from_dataset(cls, dataset, cfg: Config, state,
+                     bus=None) -> "InferenceEngine":
         model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
                            dataset.num_interfaces, dataset.num_rpctypes)
         return cls(model, state, cfg, dataset.mixtures, dataset.lookup,
-                   dataset.budget)
+                   dataset.budget, bus=bus)
 
     # -- executable cache ------------------------------------------------
 
     def _compile(self, idx: int) -> object:
-        exe = jax.jit(self._step).lower(
-            self._variables,
-            abstract_batch(self.ladder[idx], self._n_feat)).compile()
+        with self._bus.span("serve.compile", bucket=idx):
+            exe = jax.jit(self._step).lower(
+                self._variables,
+                abstract_batch(self.ladder[idx], self._n_feat)).compile()
         self._exe[idx] = exe
         self.compiles += 1
+        self._bus.counter("serve.compiles", bucket=idx)
         return exe
 
     def warmup(self) -> "InferenceEngine":
         """AOT-compile every ladder rung so steady-state serving never
         compiles. Idempotent; returns self for chaining."""
         t0 = time.perf_counter()
-        for i in range(len(self.ladder)):
-            if i not in self._exe:
-                self._compile(i)
+        with self._bus.span("serve.warmup", buckets=len(self.ladder)):
+            for i in range(len(self.ladder)):
+                if i not in self._exe:
+                    self._compile(i)
         self.warmup_s = time.perf_counter() - t0
         self._warmed = True
         log.info("serve warmup: %d bucket executables in %.2fs (ladder %s)",
@@ -158,6 +175,27 @@ class InferenceEngine:
         return self
 
     # -- request path ----------------------------------------------------
+
+    @property
+    def _bus(self):
+        if self._injected_bus is not None:
+            return self._injected_bus
+        return telemetry.get_bus()
+
+    @property
+    def bus(self):
+        """The engine's telemetry bus (injected, else the process-wide
+        bus resolved at each use)."""
+        return self._bus
+
+    def record_queue_wait(self, seconds: float, coalesced: int) -> None:
+        """The 'queue' stage of the request lifecycle, fed by the
+        MicrobatchQueue fronting this engine (the engine itself never
+        queues): one call per request when its microbatch leaves the
+        queue, `coalesced` = that batch's request count."""
+        self.stage_latency["queue"].record_s(seconds)
+        self._bus.histogram("serve.queue_wait_ms", seconds * 1e3, level=2,
+                            coalesced=coalesced)
 
     def request_size(self, entry_id: int) -> tuple[int, int]:
         """(nodes, edges) one request for this entry costs — the queue's
@@ -181,12 +219,16 @@ class InferenceEngine:
             raise RequestTooLarge(
                 f"microbatch of {g} graphs ({n} nodes, {e_tot} edges) "
                 f"exceeds the top bucket {self.ladder[-1]}")
+        bus = self._bus
         with self.latency.time():
             if idx in self._exe:
                 self.cache_hits += 1
+                bus.counter("serve.cache_hit", bucket=idx, level=2)
                 exe = self._exe[idx]
             else:
                 self.cache_misses += 1
+                bus.counter("serve.cache_miss", bucket=idx,
+                            after_warmup=self._warmed)
                 if self._warmed:
                     log.warning(
                         "executable cache miss AFTER warmup for bucket %s "
@@ -194,11 +236,21 @@ class InferenceEngine:
                         self.ladder[idx])
                 exe = self._compile(idx)
             bucket = self.ladder[idx]
-            batch = pack_single(self._mixtures, entry_ids,
-                                np.asarray(ts_buckets), bucket,
-                                self._lookup,
-                                node_depth_in_x=self._node_depth_in_x)
-            pred = np.asarray(exe(self._variables, batch))[:g]
+            # stage breakdown: pack (host featurize+copy) -> dispatch
+            # (program launch, async) -> compute (the block until the
+            # device result is host-readable: execution + D2H)
+            with self.stage_latency["pack"].time(), \
+                    bus.span("serve.pack", level=2, bucket=idx, graphs=g):
+                batch = pack_single(self._mixtures, entry_ids,
+                                    np.asarray(ts_buckets), bucket,
+                                    self._lookup,
+                                    node_depth_in_x=self._node_depth_in_x)
+            with self.stage_latency["dispatch"].time(), \
+                    bus.span("serve.dispatch", level=2, bucket=idx):
+                out = exe(self._variables, batch)
+            with self.stage_latency["compute"].time(), \
+                    bus.span("serve.compute", level=2, bucket=idx):
+                pred = np.asarray(out)[:g]
         self.requests += g
         self.batches += 1
         bs = self._bucket_stats[idx]
@@ -207,6 +259,8 @@ class InferenceEngine:
         bs.real_edges += e_tot
         bs.padded_nodes += bucket.max_nodes
         bs.padded_edges += bucket.max_edges
+        bus.histogram("serve.pad_waste", pad_waste(bucket, n, e_tot),
+                      bucket=idx, level=2)
         return pred
 
     def predict_many(self, entry_ids, ts_buckets) -> np.ndarray:
@@ -267,5 +321,35 @@ class InferenceEngine:
             "warmup_s": self.warmup_s,
             "pad_waste_ratio": self.pad_waste_ratio(),
             "latency": self.latency.summary_dict(),
+            # per-stage request-lifecycle percentiles (engine stages;
+            # "queue" is populated only when a MicrobatchQueue fronts
+            # this engine)
+            "stages": {s: r.summary_dict()
+                       for s, r in self.stage_latency.items()},
             "buckets": buckets,
         }
+
+    def publish_stats(self) -> dict:
+        """Emit the aggregate counters onto the bus at BASIC level (the
+        per-dispatch events above are trace-level) and return stats_dict.
+        Serving CLIs/benches call this once at end of run so a basic-level
+        JSONL stream still carries cache hit/miss totals and per-bucket
+        pad waste."""
+        stats = self.stats_dict()
+        bus = self._bus
+        # gauges, not counters: these are lifetime TOTALS (snapshots), and
+        # publish_stats may be called repeatedly on a long-lived engine —
+        # a consumer summing counter deltas must not double-count them
+        bus.gauge("serve.requests", self.requests)
+        bus.gauge("serve.batches", self.batches)
+        bus.gauge("serve.cache_hits_total", self.cache_hits)
+        bus.gauge("serve.cache_misses_total", self.cache_misses)
+        bus.gauge("serve.pad_waste_ratio", stats["pad_waste_ratio"])
+        for i, b in enumerate(stats["buckets"]):
+            if b["dispatches"]:
+                bus.gauge("serve.bucket_pad_waste", b["pad_waste"],
+                          bucket=i, dispatches=b["dispatches"],
+                          max_nodes=b["max_nodes"],
+                          max_edges=b["max_edges"])
+        bus.event("serve.stats", fields=stats)
+        return stats

@@ -143,11 +143,22 @@ class MicrobatchQueue:
             entries = [b[0] for b in batch]
             buckets = [b[1] for b in batch]
             futures = [b[3] for b in batch]
+            # queue-wait stage of the request lifecycle: submit -> the
+            # moment its microbatch leaves the queue for the engine
+            t_now = time.perf_counter()
+            for _e, _ts, t_arrival, _f in batch:
+                self._engine.record_queue_wait(t_now - t_arrival,
+                                               coalesced=len(batch))
             try:
                 preds = self._engine.predict_microbatch(entries, buckets)
             except BaseException as exc:
                 for f in futures:
                     f.set_exception(exc)
                 continue
+            t_done = time.perf_counter()
+            for _e, _ts, t_arrival, _f in batch:
+                self._engine.bus.histogram("serve.request_total_ms",
+                                           (t_done - t_arrival) * 1e3,
+                                           level=2)
             for f, p in zip(futures, preds):
                 f.set_result(float(p))

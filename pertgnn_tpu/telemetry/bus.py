@@ -1,0 +1,164 @@
+"""The telemetry bus: counters, gauges, histograms, spans — or nothing.
+
+Two implementations share one interface:
+
+- ``NoopBus`` — the default. Every method is an attribute lookup + an
+  immediate return; ``span`` hands back a shared do-nothing context
+  manager. Instrumentation in hot paths (the packer, the serve request
+  loop) therefore costs nanoseconds when telemetry is off — pinned by
+  benchmarks/telemetry_overhead.py (< 1% of a CPU train step) and the
+  bound test in tests/test_telemetry.py.
+- ``TelemetryBus`` — a MetricsWriter-backed bus with a verbosity
+  ``level``: 1 ("basic") records run/epoch-granularity events, 2
+  ("trace") additionally records per-chunk / per-request events. Call
+  sites mark hot events with ``level=2`` and the bus drops them below
+  that verbosity without allocating a span object.
+
+Levels: "off"=0, "basic"=1, "trace"=2 (ints accepted)."""
+
+from __future__ import annotations
+
+import functools
+import time
+
+LEVELS = {"off": 0, "basic": 1, "trace": 2}
+
+
+def parse_level(level: int | str) -> int:
+    if isinstance(level, int):
+        return level
+    try:
+        return LEVELS[level.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown telemetry level {level!r} (want one of "
+            f"{sorted(LEVELS)} or an int)") from None
+
+
+class _NullSpan:
+    """Shared, reusable no-op context manager (no per-call allocation)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class NoopBus:
+    """The disabled bus — also the interface definition. All kwargs
+    beyond the named ones are tags."""
+
+    enabled = False
+    level = 0
+
+    def counter(self, name: str, value: float = 1, *, level: int = 1,
+                **tags) -> None:
+        pass
+
+    def gauge(self, name: str, value: float, *, level: int = 1,
+              **tags) -> None:
+        pass
+
+    def histogram(self, name: str, value: float, *, level: int = 1,
+                  **tags) -> None:
+        pass
+
+    def event(self, name: str, fields: dict | None = None, *,
+              level: int = 1, **tags) -> None:
+        pass
+
+    def span(self, name: str, *, level: int = 1, **tags):
+        return NULL_SPAN
+
+    def wrap(self, name: str, *, level: int = 1, **tags):
+        """Decorator form of ``span``: times every call of the wrapped
+        function. On the noop bus the function is returned UNCHANGED —
+        zero per-call overhead, not even a frame."""
+        return lambda fn: fn
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+NOOP_BUS = NoopBus()
+
+
+class _Span:
+    __slots__ = ("_bus", "_name", "_tags", "_t0")
+
+    def __init__(self, bus, name, tags):
+        self._bus = bus
+        self._name = name
+        self._tags = tags
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur_ms = (time.perf_counter() - self._t0) * 1e3
+        self._bus._writer.write("span", self._name, dur_ms=dur_ms,
+                                tags=self._tags or None)
+        return False
+
+
+class TelemetryBus(NoopBus):
+    """MetricsWriter-backed bus. Construct via telemetry.configure()."""
+
+    enabled = True
+
+    def __init__(self, writer, level: int | str = "basic"):
+        self._writer = writer
+        self.level = parse_level(level)
+
+    def counter(self, name, value=1, *, level=1, **tags):
+        if level <= self.level:
+            self._writer.write("counter", name, value=value,
+                               tags=tags or None)
+
+    def gauge(self, name, value, *, level=1, **tags):
+        if level <= self.level:
+            self._writer.write("gauge", name, value=value, tags=tags or None)
+
+    def histogram(self, name, value, *, level=1, **tags):
+        if level <= self.level:
+            self._writer.write("histogram", name, value=value,
+                               tags=tags or None)
+
+    def event(self, name, fields=None, *, level=1, **tags):
+        if level <= self.level:
+            self._writer.write("meta", name, fields=fields or {},
+                               tags=tags or None)
+
+    def span(self, name, *, level=1, **tags):
+        if level <= self.level:
+            return _Span(self, name, tags)
+        return NULL_SPAN
+
+    def wrap(self, name, *, level=1, **tags):
+        def deco(fn):
+            @functools.wraps(fn)
+            def timed(*a, **kw):
+                with self.span(name, level=level, **tags):
+                    return fn(*a, **kw)
+            return timed
+        return deco
+
+    def flush(self):
+        self._writer.flush()
+
+    def close(self):
+        self._writer.close()
+
+    @property
+    def path(self) -> str:
+        return self._writer.path

@@ -1,0 +1,58 @@
+"""Forward jax.monitoring events (compiles, tracing) onto the bus.
+
+XLA compilation is the serving engine's tail-latency enemy and the train
+loop's startup cost; jax already announces every compile through
+``jax.monitoring`` — this module is the listener that turns those
+announcements into schema events instead of letting them evaporate.
+
+Event mapping (names keep jax's own event keys, prefixed ``jax``):
+
+- plain events      -> counter  ``jax<event>``  (value 1)
+- duration events   -> histogram ``jax<event>`` in the event's NATIVE
+  units — true durations are seconds (jax's ``*_duration_secs`` keys say
+  so in the name), but jax also routes non-durations (bytes_per_sec,
+  future counts) through the same listener, so no unit rewrite is safe
+
+jax.monitoring has no public unregister; the installer returns an
+``uninstall()`` that uses the private helpers when present and otherwise
+flips a dead-switch flag so a stale listener never writes to a closed
+bus (listener registries are process-global)."""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def install_jax_monitoring(bus):
+    """Register bus-forwarding listeners; returns uninstall()."""
+    import jax.monitoring as mon
+
+    alive = {"on": True}
+
+    def on_event(event, **kw):
+        if alive["on"]:
+            bus.counter("jax" + str(event))
+
+    def on_duration(event, duration_secs, **kw):
+        if alive["on"]:
+            bus.histogram("jax" + str(event), float(duration_secs))
+
+    mon.register_event_listener(on_event)
+    mon.register_event_duration_secs_listener(on_duration)
+
+    def uninstall():
+        alive["on"] = False
+        try:
+            from jax._src import monitoring as _m
+            _m._unregister_event_listener_by_callback(on_event)
+            _m._unregister_event_duration_listener_by_callback(on_duration)
+        except Exception:
+            # private helpers moved: the dead-switch above already
+            # guarantees no further writes — leaking two inert closures
+            # in a process-global list is acceptable
+            log.debug("jax.monitoring unregister helpers unavailable; "
+                      "listeners left registered but disabled")
+
+    return uninstall

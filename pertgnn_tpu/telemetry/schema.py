@@ -1,0 +1,125 @@
+"""The telemetry JSONL event schema (versioned, validated).
+
+One event per line, append-only, crash-safe at line granularity: a run
+killed mid-write loses at most its final partial line, which the reader
+skips. Every event is stamped with the schema version, wall time, pid and
+jax process index so streams from a multi-host run can be concatenated
+and still attributed.
+
+Event kinds:
+
+- ``meta``      — run-level context (argv, jax version, config dir);
+                  carries a free-form ``fields`` dict.
+- ``counter``   — monotonic increment (``value`` = the delta).
+- ``gauge``     — point-in-time level (``value`` = the reading).
+- ``histogram`` — one observation of a distribution (``value``).
+- ``span``      — one timed region (``dur_ms``); emitted at exit.
+
+``tags`` is an optional flat dict of scalar dimensions (bucket index,
+epoch, split, ...). Loading into pandas is one call:
+``pd.read_json(path, lines=True)`` — see docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, Iterator
+
+SCHEMA_VERSION = 1
+
+KINDS = ("meta", "counter", "gauge", "histogram", "span")
+
+# kinds that must carry a numeric "value"
+_VALUE_KINDS = ("counter", "gauge", "histogram")
+
+_TAG_SCALARS = (str, int, float, bool, type(None))
+
+
+class SchemaError(ValueError):
+    """An event violates the telemetry JSONL schema."""
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise SchemaError(msg)
+
+
+def validate_event(ev: dict) -> dict:
+    """Validate one decoded event against the schema; returns it.
+
+    Raises SchemaError naming the first violated constraint — the
+    round-trip test (tests/test_telemetry.py) feeds every writer-emitted
+    event through this, so writer and schema cannot drift apart.
+    """
+    _require(isinstance(ev, dict), f"event is not an object: {type(ev)}")
+    _require(ev.get("v") == SCHEMA_VERSION,
+             f"schema version {ev.get('v')!r} != {SCHEMA_VERSION}")
+    _require(isinstance(ev.get("t"), (int, float)),
+             f"missing/non-numeric timestamp 't': {ev.get('t')!r}")
+    _require(isinstance(ev.get("pid"), int),
+             f"missing/non-int 'pid': {ev.get('pid')!r}")
+    _require(isinstance(ev.get("pi"), int),
+             f"missing/non-int process index 'pi': {ev.get('pi')!r}")
+    kind = ev.get("kind")
+    _require(kind in KINDS, f"unknown kind {kind!r} (want one of {KINDS})")
+    name = ev.get("name")
+    _require(isinstance(name, str) and name != "",
+             f"missing/empty 'name': {name!r}")
+    if kind in _VALUE_KINDS:
+        _require(isinstance(ev.get("value"), (int, float))
+                 and not isinstance(ev.get("value"), bool),
+                 f"{kind} {name!r} needs a numeric 'value': "
+                 f"{ev.get('value')!r}")
+    if kind == "span":
+        _require(isinstance(ev.get("dur_ms"), (int, float))
+                 and not isinstance(ev.get("dur_ms"), bool),
+                 f"span {name!r} needs a numeric 'dur_ms': "
+                 f"{ev.get('dur_ms')!r}")
+    if kind == "meta":
+        _require(isinstance(ev.get("fields"), dict),
+                 f"meta {name!r} needs a 'fields' object")
+    tags = ev.get("tags")
+    if tags is not None:
+        _require(isinstance(tags, dict), f"'tags' is not an object: {tags!r}")
+        for k, v in tags.items():
+            _require(isinstance(k, str), f"non-string tag key {k!r}")
+            _require(isinstance(v, _TAG_SCALARS),
+                     f"tag {k!r} has non-scalar value {v!r}")
+    return ev
+
+
+def iter_events(lines: Iterable[str], strict: bool = True) -> Iterator[dict]:
+    """Decode + validate a JSONL stream line by line.
+
+    A trailing UNDECODABLE line (truncated JSON — the crash-mid-write
+    signature) is always skipped. A line that decodes but violates the
+    schema is never a crash tail — a partial write cannot produce valid
+    JSON with wrong fields — so it raises (strict) or is skipped
+    (strict=False) wherever it appears."""
+    pending_decode: Exception | None = None
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        # an earlier line failed to DECODE but was not the last line —
+        # that is corruption, not a crash tail
+        if pending_decode is not None and strict:
+            raise pending_decode
+        pending_decode = None
+        try:
+            ev = json.loads(line)
+        except ValueError as e:
+            pending_decode = SchemaError(f"undecodable line: {e}")
+            continue
+        try:
+            yield validate_event(ev)
+        except SchemaError:
+            if strict:
+                raise
+    # swallow pending_decode: the stream ended on it -> crash tail
+
+
+def load_events(path: str, strict: bool = True) -> list[dict]:
+    """All validated events from one telemetry JSONL file."""
+    with open(path) as f:
+        return list(iter_events(f, strict=strict))

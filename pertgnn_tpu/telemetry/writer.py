@@ -1,0 +1,159 @@
+"""MetricsWriter: append-only, line-buffered, schema-versioned JSONL.
+
+The durability contract is one event per line with the file opened
+line-buffered: every completed event reaches the OS on the newline, so a
+SIGKILL'd run loses at most one partial final line (which the schema
+reader skips as the crash tail). No background flusher thread, no
+buffering policy to tune — crash-safety by construction.
+
+An optional TensorBoard sink mirrors scalar events (tensorboardX when
+importable; absent -> the option is a logged no-op, never an import
+error: the container may not ship it)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import numbers
+import os
+import threading
+import time
+
+from pertgnn_tpu.telemetry.schema import SCHEMA_VERSION
+
+log = logging.getLogger(__name__)
+
+
+def _num(name: str, x):
+    """Coerce a metric value to a plain int/float AT WRITE TIME — a
+    numpy scalar must fail (or convert) at the emitting call site, not
+    poison the stream for the strict reader (json default=str would
+    silently stringify it)."""
+    if isinstance(x, bool) or not isinstance(x, numbers.Real):
+        raise TypeError(f"event {name!r}: non-numeric value {x!r}")
+    return int(x) if isinstance(x, numbers.Integral) else float(x)
+
+
+def _tag(v):
+    """Tags are scalar dimensions: keep str/bool/None, normalize any
+    Real (incl. numpy scalars) to int/float, stringify the rest."""
+    if v is None or isinstance(v, (bool, str)):
+        return v
+    if isinstance(v, numbers.Integral):
+        return int(v)
+    if isinstance(v, numbers.Real):
+        return float(v)
+    return str(v)
+
+
+def _process_index() -> int:
+    """jax.process_index() if a backend is already up, else 0. Never
+    initializes a backend: telemetry must not be the first thing that
+    dials a (possibly wedged) device transport — cli/common.py
+    apply_platform_env owns backend selection."""
+    try:
+        import jax
+        from jax._src import xla_bridge
+        if getattr(xla_bridge, "_backends", None):
+            return jax.process_index()
+    except Exception:
+        pass
+    return 0
+
+
+class MetricsWriter:
+    """Structured scalar events -> one pid-unique JSONL file.
+
+    Thread-safe: the serve path writes from the microbatch worker and
+    client threads concurrently; a lock serializes line emission (events
+    are small — contention is negligible next to a device dispatch)."""
+
+    def __init__(self, directory: str, *, tensorboard: bool = False,
+                 run_meta: dict | None = None):
+        os.makedirs(directory, exist_ok=True)
+        self.pid = os.getpid()
+        self.process_index = _process_index()
+        # process_index + hostname + pid in the name: multi-host runs on
+        # a shared telemetry_dir and supervisor restarts append to
+        # distinct files, never interleave. The hostname keeps the
+        # guarantee even if process-index detection degrades to 0 (it is
+        # best-effort — _process_index): two hosts with equal pids still
+        # get distinct files.
+        import socket
+        host = socket.gethostname().split(".")[0] or "host"
+        self.path = os.path.join(
+            directory,
+            f"telemetry-p{self.process_index}-{host}-{self.pid}.jsonl")
+        self._f = open(self.path, "a", buffering=1)
+        self._lock = threading.Lock()
+        self._closed = False
+        self._tb = None
+        self._tb_steps: dict[str, int] = {}
+        if tensorboard:
+            self._tb = self._open_tensorboard(directory)
+        self.write("meta", "run_start", fields={
+            "schema_version": SCHEMA_VERSION,
+            "argv": list(__import__("sys").argv),
+            "start_unix_time": time.time(),
+            **(run_meta or {}),
+        })
+
+    @staticmethod
+    def _open_tensorboard(directory: str):
+        try:
+            from tensorboardX import SummaryWriter
+        except ImportError:
+            log.warning("tensorboard sink requested but tensorboardX is "
+                        "not installed — JSONL only")
+            return None
+        return SummaryWriter(logdir=os.path.join(directory, "tb"))
+
+    def write(self, kind: str, name: str, value: float | None = None,
+              dur_ms: float | None = None, tags: dict | None = None,
+              fields: dict | None = None) -> None:
+        ev: dict = {"v": SCHEMA_VERSION, "t": time.time(), "pid": self.pid,
+                    "pi": self.process_index, "kind": kind, "name": name}
+        if value is not None:
+            ev["value"] = _num(name, value)
+        if dur_ms is not None:
+            ev["dur_ms"] = _num(name, dur_ms)
+        if tags:
+            ev["tags"] = {k: _tag(v) for k, v in tags.items()}
+        if fields is not None:
+            ev["fields"] = fields
+        line = json.dumps(ev, default=str)
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write(line + "\n")
+            if self._tb is not None:
+                self._to_tensorboard(kind, name, value, dur_ms)
+
+    def _to_tensorboard(self, kind, name, value, dur_ms) -> None:
+        scalar = dur_ms if kind == "span" else value
+        if scalar is None:
+            return
+        step = self._tb_steps.get(name, 0)
+        self._tb_steps[name] = step + 1
+        try:
+            self._tb.add_scalar(name, float(scalar), step)
+        except Exception:
+            log.exception("tensorboard sink failed for %s; disabling", name)
+            self._tb = None
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._f.flush()
+                if self._tb is not None:
+                    self._tb.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._f.flush()
+            self._f.close()
+            if self._tb is not None:
+                self._tb.close()

@@ -15,6 +15,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from pertgnn_tpu import telemetry
 from pertgnn_tpu.train.loop import TrainState
 
 log = logging.getLogger(__name__)
@@ -39,13 +40,16 @@ class CheckpointManager:
         # (no process holds remote shards) and forces a D2H copy.
         # The epoch-metrics item is named "history": orbax >= 0.7 reserves
         # the item name "metrics" for itself and rejects the save.
-        self._mgr.save(
-            epoch,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                history=ocp.args.JsonSave(metrics or {}),
-            ),
-        )
+        # NB the span times the (async) save INITIATION, not the write —
+        # the commit itself overlaps training by design (wait() below).
+        with telemetry.span("checkpoint.save", epoch=epoch):
+            self._mgr.save(
+                epoch,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    history=ocp.args.JsonSave(metrics or {}),
+                ),
+            )
 
     def maybe_restore(self, state: TrainState) -> tuple[TrainState, int]:
         """Restore the latest checkpoint if present, directly INTO the
@@ -69,16 +73,18 @@ class CheckpointManager:
             return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
         target = jax.tree.map(abstract, state)
-        restored = self._mgr.restore(
-            latest,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(target)),
-        )
+        with telemetry.span("checkpoint.restore", epoch=latest):
+            restored = self._mgr.restore(
+                latest,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(target)),
+            )
         log.info("restored checkpoint at epoch %d", latest)
         return restored["state"], latest + 1
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        with telemetry.span("checkpoint.wait"):
+            self._mgr.wait_until_finished()
 
     # -- config sidecar -------------------------------------------------
     # Checkpoints restore by TREE SHAPE, which is blind to semantics:

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import optax
 from flax import struct
 
+from pertgnn_tpu import telemetry
 from pertgnn_tpu.batching.dataset import Dataset
 from pertgnn_tpu.batching.arena import zero_masked_compact
 from pertgnn_tpu.batching.materialize import (
@@ -338,7 +339,8 @@ def _staged_iter(chunks: Iterator, put,
     so staging can never blow the HBM budget unaccounted (ADVICE r4)."""
     import numpy as np
 
-    host = list(chunks)
+    with telemetry.span("train.stage_epoch.pack"):
+        host = list(chunks)
     if not host:
         return
     _, treedef = jax.tree.flatten(host[0])
@@ -356,8 +358,9 @@ def _staged_iter(chunks: Iterator, put,
                        for i, x in enumerate(leaves)]
                 yield jax.tree.unflatten(treedef, [d[0] for d in dev])
             return
-    staged = jax.tree.unflatten(
-        treedef, [put(i, np.stack(col)) for i, col in enumerate(cols)])
+    with telemetry.span("train.stage_epoch.h2d", chunks=len(host)):
+        staged = jax.tree.unflatten(
+            treedef, [put(i, np.stack(col)) for i, col in enumerate(cols)])
     for i in range(len(host)):
         yield jax.tree.map(lambda a: a[i], staged)
 
@@ -464,6 +467,7 @@ def fit(dataset: Dataset, cfg: Config,
         checkpoint_manager=None,
         profile_hook: Callable[[int, dict], None] | None = None,
         mesh=None,
+        bus=None,
         ) -> tuple[TrainState, list[dict]]:
     """Epoch driver: train on `train`, evaluate `valid`+`test` per epoch
     (pert_gnn.py:344-350). Returns (final state, per-epoch history).
@@ -472,7 +476,19 @@ def fit(dataset: Dataset, cfg: Config,
     grouped into global batches sharded over the mesh and the step runs
     SPMD (BASELINE config 3). `device_materialize` composes: the arenas are
     replicated over the mesh and each SPMD program gathers its global batch
-    from HBM, fed only the sharded int32 gather recipes."""
+    from HBM, fed only the sharded int32 gather recipes.
+
+    `bus` is an injected telemetry bus (default: the process-wide bus,
+    a no-op unless a CLI configured one). Per epoch it receives the
+    host/device wall-time split (train.epoch_host_s / train.epoch_device_s
+    — host = time blocked on the batch iterator: packing, staging,
+    assembly; device = step dispatch + the metric sync, which absorbs
+    device execution), graph/step counters, and eval + checkpoint spans.
+    When the process-wide bus is still the no-op, the injected bus is
+    installed as the process-wide bus for the duration of this call so
+    the global-bus call sites underneath (the packer's pad-waste gauges,
+    staging spans, checkpoint spans) reach it too; an explicitly
+    configured global bus is never displaced."""
     edge_shard = mesh is not None and cfg.parallel.shard_edges
     model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
                        dataset.num_interfaces, dataset.num_rpctypes,
@@ -701,25 +717,67 @@ def fit(dataset: Dataset, cfg: Config,
                     _inner_stream(split, seed=seed))
             return iter(cached)
 
+    restore_bus = None
+    if bus is None:
+        bus = telemetry.get_bus()
+    elif not telemetry.get_bus().enabled:
+        # scope the injected bus process-wide so the global-bus call
+        # sites below fit (packer, staging, checkpoints) see it too
+        restore_bus = telemetry.set_bus(bus)
+    try:
+        return _fit_epochs(dataset, cfg, epochs, checkpoint_manager,
+                           profile_hook, state, train_step, eval_step,
+                           batch_stream, bus)
+    finally:
+        if restore_bus is not None:
+            telemetry.set_bus(restore_bus)
+
+
+def _fit_epochs(dataset, cfg, epochs, checkpoint_manager, profile_hook,
+                state, train_step, eval_step, batch_stream, bus
+                ) -> tuple[TrainState, list[dict]]:
+    """fit()'s epoch driver, split out so the injected-bus scoping wraps
+    it in one try/finally."""
     start_epoch = 0
     if checkpoint_manager is not None:
         state, start_epoch = checkpoint_manager.maybe_restore(state)
 
     history: list[dict] = []
     epochs = cfg.train.epochs if epochs is None else epochs
+    _END = object()
     for epoch in range(start_epoch, epochs):
         t0 = time.perf_counter()
         sums = None
-        for batch in batch_stream("train", shuffle=True,
-                                  seed=cfg.data.shuffle_seed + epoch):
-            state, m = train_step(state, batch)
-            sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+        # Host/device wall split: t_host = blocked on the batch iterator
+        # (packing / staging / H2D assembly); t_dev = step dispatch + the
+        # final metric sync — with async dispatch the device's execution
+        # time surfaces wherever the host blocks, which is here.
+        t_host = t_dev = 0.0
+        steps = 0
+        stream = iter(batch_stream("train", shuffle=True,
+                                   seed=cfg.data.shuffle_seed + epoch))
+        while True:
+            t1 = time.perf_counter()
+            batch = next(stream, _END)
+            t_host += time.perf_counter() - t1
+            if batch is _END:
+                break
+            t1 = time.perf_counter()
+            with bus.span("train.chunk", level=2, epoch=epoch, step=steps):
+                state, m = train_step(state, batch)
+                sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+            t_dev += time.perf_counter() - t1
+            steps += 1
+        t1 = time.perf_counter()
         sums = jax.tree.map(float, sums)
+        t_dev += time.perf_counter() - t1
         n = max(sums["count"], 1.0)
         train_time = time.perf_counter() - t0
 
-        valid = _evaluate_stream(eval_step, state, batch_stream("valid"))
-        test = _evaluate_stream(eval_step, state, batch_stream("test"))
+        with bus.span("train.eval", epoch=epoch, split="valid"):
+            valid = _evaluate_stream(eval_step, state, batch_stream("valid"))
+        with bus.span("train.eval", epoch=epoch, split="test"):
+            test = _evaluate_stream(eval_step, state, batch_stream("test"))
         row = {
             "epoch": epoch,
             "train_qloss": sums["qloss_sum"] / n,
@@ -730,8 +788,20 @@ def fit(dataset: Dataset, cfg: Config,
             "test_mae": test["mae"], "test_mape": test["mape"],
             "test_qloss": test["qloss"],
             "train_time_s": train_time,
+            "host_time_s": t_host,
+            "device_time_s": t_dev,
             "graphs_per_s": sums["count"] / max(train_time, 1e-9),
         }
+        bus.gauge("train.epoch_host_s", t_host, epoch=epoch)
+        bus.gauge("train.epoch_device_s", t_dev, epoch=epoch)
+        bus.gauge("train.epoch_graphs_per_s", row["graphs_per_s"],
+                  epoch=epoch)
+        bus.gauge("train.epoch_qloss", row["train_qloss"], epoch=epoch)
+        bus.counter("train.graphs", sums["count"], epoch=epoch)
+        # every train_step/chunk dispatch donates its input state buffers
+        # (make_train_* jit with donate_argnums=0) — the reuse count was
+        # previously computed and thrown away
+        bus.counter("train.donated_buffer_dispatches", steps, epoch=epoch)
         history.append(row)
         log.info(
             "epoch %d: train qloss %.4f mae %.4f | valid mae %.4f mape %.4f "

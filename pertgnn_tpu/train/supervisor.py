@@ -94,6 +94,8 @@ def supervise(cmd: list[str], progress_dir: str, *,
         prev_term = signal.signal(signal.SIGTERM, _term)
     except ValueError:  # not the main thread: rely on the finally alone
         prev_term = None
+    from pertgnn_tpu import telemetry
+    bus = telemetry.get_bus()
     attempt = 0
     child = None
     try:
@@ -126,12 +128,17 @@ def supervise(cmd: list[str], progress_dir: str, *,
             if rc == 0:
                 log.info("supervisor: child completed (attempt %d)",
                          attempt)
+                bus.counter("supervisor.completed", attempt=attempt)
                 return 0
             log.warning("supervisor: child %s (rc=%s) on attempt %d",
                         "hung" if hung else "died", rc, attempt)
+            bus.counter("supervisor.hang" if hung else "supervisor.crash",
+                        attempt=attempt, rc=rc)
             if attempt > max_restarts:
                 log.error("supervisor: restart budget exhausted")
+                bus.counter("supervisor.budget_exhausted", rc=rc)
                 return rc
+            bus.counter("supervisor.restart", attempt=attempt)
     finally:
         if child is not None and child.poll() is None:
             log.warning("supervisor: exiting; killing the live child")

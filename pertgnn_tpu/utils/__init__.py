@@ -1,2 +1,3 @@
-from pertgnn_tpu.utils.profiling import StepTimer, profile_epochs
+from pertgnn_tpu.utils.profiling import (LatencyRecorder, StepTimer,
+                                         profile_epochs)
 from pertgnn_tpu.utils.logging import setup_logging

@@ -1,20 +1,70 @@
-"""Structured logging setup (the reference prints; SURVEY.md §5.5)."""
+"""Structured logging setup (the reference prints; SURVEY.md §5.5).
+
+Multihost-aware: once `set_process_context` is called with world size
+> 1 (parallel/multihost.initialize does this after
+jax.distributed.initialize), every line is prefixed with this process's
+jax.process_index() so interleaved multi-host logs stay attributable.
+
+The level is tunable without code changes: `$PERTGNN_LOG_LEVEL` names
+the default, the CLIs' `--log_level` flag (cli/common.setup_telemetry ->
+`set_level`) overrides it at runtime.
+"""
 
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
+_BASE_FMT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+_DATE_FMT = "%H:%M:%S"
 
-def setup_logging(level: int = logging.INFO) -> None:
+
+def _resolve_level(level: int | str | None) -> int:
+    if level is None:
+        level = os.environ.get("PERTGNN_LOG_LEVEL", "") or logging.INFO
+    if isinstance(level, int):
+        return level
+    name = str(level).upper()
+    resolved = logging.getLevelName(name)
+    if not isinstance(resolved, int):
+        raise ValueError(f"unknown log level {level!r}")
+    return resolved
+
+
+def setup_logging(level: int | str | None = None) -> None:
+    """Idempotent handler setup; `level` accepts an int or a name and
+    defaults to $PERTGNN_LOG_LEVEL (INFO when unset)."""
     root = logging.getLogger("pertgnn_tpu")
     if root.handlers:
+        if level is not None:
+            root.setLevel(_resolve_level(level))
         return
     handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(logging.Formatter(
-        "%(asctime)s %(name)s %(levelname)s %(message)s",
-        datefmt="%H:%M:%S"))
+    handler.setFormatter(logging.Formatter(_BASE_FMT, datefmt=_DATE_FMT))
     root.addHandler(handler)
-    root.setLevel(level)
+    root.setLevel(_resolve_level(level))
     root.propagate = False  # avoid double lines when the root logger has
     # a handler (absl installs one)
+
+
+def set_level(level: int | str) -> None:
+    """Adjust the package log level (handler setup if not done yet).
+    setup_logging already applies the level in both of its branches."""
+    setup_logging(level)
+
+
+def set_process_context(process_index: int, process_count: int) -> None:
+    """Stamp `[pN]` into the log format when world size > 1 so multihost
+    stderr streams are attributable. Called by
+    parallel/multihost.initialize AFTER jax.distributed.initialize (this
+    module never queries jax itself — doing so could be the first thing
+    to dial a wedged backend)."""
+    if process_count <= 1:
+        return
+    setup_logging()
+    fmt = logging.Formatter(
+        f"%(asctime)s [p{int(process_index)}] " + _BASE_FMT.split(" ", 1)[1],
+        datefmt=_DATE_FMT)
+    for handler in logging.getLogger("pertgnn_tpu").handlers:
+        handler.setFormatter(fmt)

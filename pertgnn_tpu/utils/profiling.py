@@ -1,62 +1,80 @@
 """Profiling hooks: jax.profiler traces + step timing.
 
 The reference's only instrumentation is tqdm bars (SURVEY.md §5.1). Here:
-- `StepTimer` — wall-clock EMA per step with one-line summaries;
+- `StepTimer` — per-step wall-clock stats (EMA + min/max/percentiles)
+  reporting through the SAME summary schema as serving latency, so train
+  and serve metrics are one shape;
 - `LatencyRecorder` — percentile latency tracking for the serving engine
-  (p50/p95/p99, throughput) — serve/engine.py and benchmarks/serve_bench.py;
+  (p50/p95/p99, throughput) — serve/engine.py and benchmarks/serve_bench.py.
+  Raw samples are capped by reservoir sampling so a long-lived serving
+  process has bounded memory; percentiles are exact below the cap;
 - `profile_epochs` — a `fit(profile_hook=...)` hook that captures a
-  jax.profiler trace (viewable in TensorBoard/Perfetto) for chosen epochs.
+  jax.profiler trace (viewable in TensorBoard/Perfetto) for chosen epochs
+  and cross-references the capture into the telemetry JSONL stream
+  (profiler.trace_start/stop events tagged with the epoch — see
+  docs/OBSERVABILITY.md for joining the two).
 """
 
 from __future__ import annotations
 
 import logging
+import random
+import threading
 import time
 from typing import Callable, Sequence
 
-import jax
+from pertgnn_tpu import telemetry
 
 log = logging.getLogger(__name__)
 
-
-class StepTimer:
-    def __init__(self, alpha: float = 0.1):
-        self.alpha = alpha
-        self.ema = None
-        self.count = 0
-        self._t = None
-
-    def __enter__(self):
-        self._t = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        dt = time.perf_counter() - self._t
-        self.ema = dt if self.ema is None else (
-            (1 - self.alpha) * self.ema + self.alpha * dt)
-        self.count += 1
-        return False
-
-    def summary(self) -> str:
-        if self.ema is None:
-            return "no steps timed"
-        return f"{self.count} steps, ema {self.ema * 1e3:.2f} ms/step"
+# The shared train/serve latency-summary schema: LatencyRecorder
+# .summary_dict and StepTimer.summary_dict both emit exactly these keys
+# (StepTimer adds ema_ms on top).
+SUMMARY_KEYS = ("count", "p50_ms", "p95_ms", "p99_ms", "mean_ms",
+                "min_ms", "max_ms")
 
 
 class LatencyRecorder:
     """Latency samples + percentile summary for the serving path.
 
-    Samples are kept raw (one float per observation) rather than binned:
-    serving streams are at most ~1e6 requests per process lifetime here,
-    so exact percentiles cost nothing and the bench JSON stays honest.
-    Not thread-safe on its own — the serving engine serializes all
-    recording behind the microbatch queue's single worker."""
+    Memory is bounded: up to `max_samples` raw observations are kept (so
+    percentiles are EXACT below the cap); past it, reservoir sampling
+    (Algorithm R, seeded — deterministic) keeps a uniform sample while
+    count/mean/min/max stay exact over the full stream. The default cap
+    (100k float64s = 0.8 MB) is far above any bench horizon here but
+    makes a months-lived serving process safe by construction.
 
-    def __init__(self) -> None:
+    Recording is serialized by the serving engine behind the microbatch
+    queue's single worker; the internal lock exists for READERS — a
+    long-lived server calling summary_dict/percentile_ms from another
+    thread (engine.publish_stats) must see a consistent
+    count/sum/reservoir snapshot."""
+
+    def __init__(self, max_samples: int = 100_000, seed: int = 0) -> None:
+        if max_samples < 1:
+            raise ValueError(f"max_samples must be >= 1 (got {max_samples})")
+        self.max_samples = max_samples
         self._ms: list[float] = []
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
 
     def record_s(self, seconds: float) -> None:
-        self._ms.append(seconds * 1e3)
+        ms = seconds * 1e3
+        with self._lock:
+            self._count += 1
+            self._sum += ms
+            self._min = min(self._min, ms)
+            self._max = max(self._max, ms)
+            if len(self._ms) < self.max_samples:
+                self._ms.append(ms)
+            else:
+                j = self._rng.randrange(self._count)
+                if j < self.max_samples:
+                    self._ms[j] = ms
 
     def time(self):
         """Context manager recording one sample."""
@@ -64,30 +82,39 @@ class LatencyRecorder:
 
     @property
     def count(self) -> int:
-        return len(self._ms)
+        """Total observations (NOT the retained-sample count)."""
+        return self._count
 
     def percentile_ms(self, q: float) -> float:
-        if not self._ms:
-            return float("nan")
         import numpy as np
 
-        return float(np.percentile(np.asarray(self._ms), q))
+        with self._lock:
+            if not self._ms:
+                return float("nan")
+            a = np.asarray(self._ms)
+        return float(np.percentile(a, q))
 
     def summary_dict(self) -> dict:
-        """p50/p95/p99/mean latency (ms) + sample count — the serving
-        metrics schema shared by engine stats and serve_bench JSON."""
+        """p50/p95/p99/mean/min/max latency (ms) + sample count — the
+        metrics summary schema shared by serving stats, serve_bench JSON
+        and StepTimer (SUMMARY_KEYS)."""
         import numpy as np
 
-        if not self._ms:
-            return {"count": 0, "p50_ms": None, "p95_ms": None,
-                    "p99_ms": None, "mean_ms": None}
-        a = np.asarray(self._ms)
+        with self._lock:
+            if not self._count:
+                return {k: (0 if k == "count" else None)
+                        for k in SUMMARY_KEYS}
+            a = np.asarray(self._ms)
+            count, total = self._count, self._sum
+            lo, hi = self._min, self._max
         return {
-            "count": len(a),
+            "count": count,
             "p50_ms": float(np.percentile(a, 50)),
             "p95_ms": float(np.percentile(a, 95)),
             "p99_ms": float(np.percentile(a, 99)),
-            "mean_ms": float(a.mean()),
+            "mean_ms": total / count,
+            "min_ms": lo,
+            "max_ms": hi,
         }
 
 
@@ -104,30 +131,104 @@ class _LatencySpan:
         return False
 
 
-def profile_epochs(log_dir: str, epochs: Sequence[int] = (1,)
-                   ) -> Callable[[int, dict], None]:
+class StepTimer:
+    """Wall-clock step timer: EMA plus full distribution stats.
+
+    Backed by a LatencyRecorder so train-side step timing reports the
+    SAME summary shape as serving latency (`summary_dict`, SUMMARY_KEYS)
+    with the EMA added as `ema_ms`."""
+
+    def __init__(self, alpha: float = 0.1, max_samples: int = 100_000):
+        self.alpha = alpha
+        self.ema = None
+        self._rec = LatencyRecorder(max_samples=max_samples)
+        self._t = None
+
+    def __enter__(self):
+        self._t = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t
+        self.ema = dt if self.ema is None else (
+            (1 - self.alpha) * self.ema + self.alpha * dt)
+        self._rec.record_s(dt)
+        return False
+
+    @property
+    def count(self) -> int:
+        return self._rec.count
+
+    def summary_dict(self) -> dict:
+        """The serving metrics summary schema + `ema_ms`."""
+        out = self._rec.summary_dict()
+        out["ema_ms"] = None if self.ema is None else self.ema * 1e3
+        return out
+
+    def summary(self) -> str:
+        if self.ema is None:
+            return "no steps timed"
+        s = self._rec.summary_dict()
+        return (f"{s['count']} steps, ema {self.ema * 1e3:.2f} ms/step, "
+                f"p50 {s['p50_ms']:.2f} min {s['min_ms']:.2f} "
+                f"max {s['max_ms']:.2f}")
+
+
+def profile_epochs(log_dir: str, epochs: Sequence[int] = (1,),
+                   profiler=None, bus=None) -> Callable[[int, dict], None]:
     """Hook for `fit(profile_hook=...)`: trace the NEXT epoch after each
     epoch in `epochs` completes (epoch 0 compiles, so default traces
-    epoch 2's steps by starting after epoch 1)."""
-    state = {"active": False}
+    epoch 2's steps by starting after epoch 1).
+
+    Each capture start/stop is mirrored onto the telemetry bus
+    (profiler.trace_start / profiler.trace_stop, tagged with the epoch
+    range) so the jax.profiler trace can be cross-referenced from the
+    JSONL stream: the trace covers exactly the epochs between a start
+    and its stop event. `profiler` defaults to `jax.profiler` — tests
+    inject a stub to exercise the start/stop/close state machine without
+    a real capture."""
+    if profiler is None:
+        import jax
+
+        profiler = jax.profiler
+    state = {"active": False, "start_epoch": None, "last_completed": None}
+
+    def _bus():
+        return bus if bus is not None else telemetry.get_bus()
+
+    def _stop(last_epoch: int | None, final: bool) -> None:
+        profiler.stop_trace()
+        state["active"] = False
+        _bus().event("profiler.trace_stop",
+                     fields={"log_dir": log_dir, "final": final},
+                     first_epoch=state["start_epoch"],
+                     last_epoch=last_epoch)
+        log.info("profiler trace (epochs %s..%s) written to %s",
+                 state["start_epoch"], last_epoch, log_dir)
 
     def hook(epoch: int, row: dict) -> None:
+        state["last_completed"] = epoch
         if state["active"]:
-            jax.profiler.stop_trace()
-            state["active"] = False
-            log.info("profiler trace for epoch %d written to %s", epoch,
-                     log_dir)
+            _stop(epoch, final=False)
         if epoch in epochs:
-            jax.profiler.start_trace(log_dir)
+            profiler.start_trace(log_dir)
             state["active"] = True
+            state["start_epoch"] = epoch + 1
+            _bus().event("profiler.trace_start",
+                         fields={"log_dir": log_dir},
+                         first_epoch=epoch + 1)
 
     def close() -> None:
         """Flush an open trace if training ended mid-capture (fit calls
-        this after the epoch loop)."""
+        this after the epoch loop). last_epoch is the last epoch that
+        ACTUALLY completed inside the capture — None when training ended
+        before any did (the trigger epoch was the final one), so the
+        JSONL cross-reference never names an epoch that never ran."""
         if state["active"]:
-            jax.profiler.stop_trace()
-            state["active"] = False
-            log.info("profiler trace (final epoch) written to %s", log_dir)
+            last = state["last_completed"]
+            if last is None or last < state["start_epoch"]:
+                last = None
+            _stop(last, final=True)
 
     hook.close = close
     return hook

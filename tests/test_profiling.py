@@ -1,0 +1,114 @@
+"""profile_epochs start/stop/close state machine, with a stubbed
+jax.profiler (no real capture): ISSUE 2 satellite — the hook's state
+machine was previously untested, notably the training-ends-mid-capture
+path that must still flush the trace."""
+
+import pytest
+
+from pertgnn_tpu.utils.profiling import profile_epochs
+
+
+class StubProfiler:
+    def __init__(self):
+        self.calls: list[tuple] = []
+
+    def start_trace(self, log_dir):
+        # a real double-start raises in jax.profiler; mirror that so the
+        # state machine can't silently double-start
+        assert not self.active, "start_trace while a trace is active"
+        self.calls.append(("start", log_dir))
+
+    def stop_trace(self):
+        assert self.active, "stop_trace without an active trace"
+        self.calls.append(("stop",))
+
+    @property
+    def active(self) -> bool:
+        starts = sum(1 for c in self.calls if c[0] == "start")
+        stops = len(self.calls) - starts
+        return starts > stops
+
+
+class RecordingBus:
+    """Minimal bus stand-in capturing event() calls."""
+
+    enabled = True
+
+    def event(self, name, fields=None, **tags):
+        self.events.append((name, fields, tags))
+
+    def __init__(self):
+        self.events = []
+
+
+@pytest.fixture()
+def stub():
+    return StubProfiler()
+
+
+@pytest.fixture()
+def bus():
+    return RecordingBus()
+
+
+def test_traces_epoch_after_trigger(stub, bus):
+    hook = profile_epochs("logs", epochs=(1,), profiler=stub, bus=bus)
+    hook(0, {})                      # not a trigger epoch: nothing
+    assert stub.calls == []
+    hook(1, {})                      # trigger: capture starts for epoch 2
+    assert stub.calls == [("start", "logs")]
+    hook(2, {})                      # next epoch completes: trace stops
+    assert stub.calls == [("start", "logs"), ("stop",)]
+    hook.close()                     # nothing open: close is a no-op
+    assert stub.calls == [("start", "logs"), ("stop",)]
+    names = [n for n, _f, _t in bus.events]
+    assert names == ["profiler.trace_start", "profiler.trace_stop"]
+    stop_tags = bus.events[1][2]
+    assert stop_tags == {"first_epoch": 2, "last_epoch": 2}
+
+
+def test_training_ends_mid_capture_flushes(stub, bus):
+    """The last configured epoch starts a capture that no later epoch
+    will stop — fit() calls hook.close(), which must flush it."""
+    hook = profile_epochs("logs", epochs=(0,), profiler=stub, bus=bus)
+    hook(0, {})
+    assert stub.active
+    hook.close()
+    assert not stub.active
+    assert stub.calls == [("start", "logs"), ("stop",)]
+    (_, _, start_tags), (stop_name, stop_fields, stop_tags) = bus.events
+    assert stop_name == "profiler.trace_stop"
+    assert stop_fields["final"] is True
+    assert start_tags["first_epoch"] == 1
+    # no epoch completed inside the capture: the cross-reference must
+    # not name a phantom epoch
+    assert stop_tags["last_epoch"] is None
+
+
+def test_close_idempotent(stub, bus):
+    hook = profile_epochs("logs", epochs=(0,), profiler=stub, bus=bus)
+    hook(0, {})
+    hook.close()
+    hook.close()
+    assert stub.calls.count(("stop",)) == 1
+
+
+def test_back_to_back_capture_epochs(stub, bus):
+    """Consecutive trigger epochs: each completion stops the open trace
+    before starting the next — never two concurrent captures."""
+    hook = profile_epochs("logs", epochs=(0, 1), profiler=stub, bus=bus)
+    hook(0, {})
+    hook(1, {})                      # stop epoch-1 trace, start epoch-2
+    hook(2, {})
+    hook.close()
+    assert stub.calls == [("start", "logs"), ("stop",),
+                          ("start", "logs"), ("stop",)]
+
+
+def test_default_bus_is_process_global(stub):
+    """Without an injected bus the hook publishes to the process-wide
+    bus (a no-op by default) — it must not crash on it."""
+    hook = profile_epochs("logs", epochs=(0,), profiler=stub)
+    hook(0, {})
+    hook.close()
+    assert stub.calls == [("start", "logs"), ("stop",)]
