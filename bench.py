@@ -26,6 +26,13 @@ What is measured (VERDICT r2 #1):
 - **mfu_pct** relates graphs/s to chip peak via XLA cost analysis
   (utils/flops.py).
 
+Cold start (ISSUE 3): every compile persists to the on-disk cache at
+$PERTGNN_COMPILE_CACHE_DIR (default benchmarks/compile_cache), and
+`bench.py --precompile` populates it ahead of a capture window — run by
+tpu_watch.sh the moment the tunnel answers, so the measured window's
+first step is execute-only. The result JSON's `compile_cache` field
+reports the hit/miss split as evidence.
+
 The baseline is MEASURED here, not looked up (the reference publishes no
 numbers — BASELINE.md): a faithful torch-CPU re-implementation of the
 reference's training step (PyG TransformerConv semantics via torch scatter
@@ -71,6 +78,16 @@ _ORPHAN = _PARTIAL + ".orphan"
 _PIN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "benchmarks", "last_good_tpu.json")
 _MIN_FIT_WINDOWS = 3
+
+# Persistent compile cache (ISSUE 3): executables land on disk keyed by
+# (HLO, backend) so a bench attempt never re-pays a compile an earlier
+# attempt (or the host-side `bench.py --precompile` stage the watcher
+# runs before arming a window) already performed — first-step wall time
+# inside a scarce TPU window becomes execute-only. Empty env disables.
+_CACHE_DIR = os.environ.get(
+    "PERTGNN_COMPILE_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "benchmarks", "compile_cache"))
 
 
 def _update_partial(**fields) -> None:
@@ -128,7 +145,8 @@ def _discard_partials(keep_tpu_salvage: bool = False) -> None:
 
 def build_workload(traces_per_entry: int = _TRACES_PER_ENTRY):
     from pertgnn_tpu.batching import build_dataset
-    from pertgnn_tpu.config import Config, DataConfig, IngestConfig, ModelConfig, TrainConfig
+    from pertgnn_tpu.config import (CompileCacheConfig, Config, DataConfig,
+                                    IngestConfig, ModelConfig, TrainConfig)
     from pertgnn_tpu.ingest import synthetic
     from pertgnn_tpu.ingest.preprocess import preprocess
 
@@ -140,6 +158,7 @@ def build_workload(traces_per_entry: int = _TRACES_PER_ENTRY):
         # path either way: bench measures the flagship configuration.
         model=ModelConfig(hidden_channels=32, num_layers=3),
         train=TrainConfig(lr=3e-4, label_scale=1000.0, scan_chunk=16),
+        aot=CompileCacheConfig(cache_dir=_CACHE_DIR),
         graph_type="pert",
     )
     data = synthetic.generate(synthetic.SyntheticSpec(
@@ -543,21 +562,29 @@ def _persist_last_good_tpu(result: dict, commit: str | None = None,
 def _assemble_result(*, fit_w, ceil_w, cceil_w, unstaged_w, flops_per_graph,
                      bytes_per_graph, baseline, backend, fallback,
                      train_graphs, partial_capture=False,
-                     peak_flops=None, peak_bw=None):
+                     peak_flops=None, peak_bw=None, device_kind=None):
     """Build the official result JSON from measured windows. Shared by the
     live path (main) and --finalize-partial (a wedge-killed capture with
     >=_MIN_FIT_WINDOWS usable fit windows); ceiling/A-B fields degrade to
     None when their windows were never reached. `peak_flops`/`peak_bw`
     override the live-backend query with the peaks recorded at capture
-    time (the finalizer runs forced-CPU, where the query returns None)."""
-    from pertgnn_tpu.utils.flops import (mbu, mfu, peak_flops_per_chip,
+    time (the finalizer runs forced-CPU, where the query returns None);
+    failing those, `device_kind` (also stamped at capture) resolves the
+    peaks from the chip table so mfu_pct/mbu_pct stop degrading to null
+    on salvaged chip captures — CPU runs stay honestly null (no peak is
+    published for a host CPU)."""
+    from pertgnn_tpu.utils.flops import (mbu, mfu, peak_flops_for_kind,
+                                         peak_flops_per_chip,
+                                         peak_hbm_bw_for_kind,
                                          peak_hbm_bw_per_chip,
                                          roofline_graphs_per_s)
 
     if peak_flops is None:
-        peak_flops = peak_flops_per_chip()
+        peak_flops = (peak_flops_for_kind(device_kind) if device_kind
+                      else peak_flops_per_chip())
     if peak_bw is None:
-        peak_bw = peak_hbm_bw_per_chip()
+        peak_bw = (peak_hbm_bw_for_kind(device_kind) if device_kind
+                   else peak_hbm_bw_per_chip())
     fit_med = statistics.median(fit_w)
     ceil_med = statistics.median(ceil_w) if ceil_w else None
     cceil_med = statistics.median(cceil_w) if cceil_w else None
@@ -611,6 +638,7 @@ def _assemble_result(*, fit_w, ceil_w, cceil_w, unstaged_w, flops_per_graph,
                             if bytes_per_graph is not None else None),
         "peak_flops_per_chip": peak_flops,
         "peak_hbm_bytes_per_s": peak_bw,
+        "device_kind": device_kind,
         "baseline_torch_cpu_graphs_per_s": round(baseline, 1),
         "backend": backend,
         "backend_fallback": fallback,
@@ -679,12 +707,47 @@ def finalize_partial() -> int:
         train_graphs=p.get("train_graphs_per_epoch"),
         partial_capture=True,
         peak_flops=p.get("peak_flops_per_chip"),
-        peak_bw=p.get("peak_hbm_bytes_per_s"))
+        peak_bw=p.get("peak_hbm_bytes_per_s"),
+        device_kind=p.get("device_kind"))
     if result["backend"] == "tpu":
         _persist_last_good_tpu(result, commit=p.get("commit"),
                                dirty=p.get("dirty_worktree"))
     _discard_partials()
     print(json.dumps(result))
+    return 0
+
+
+def precompile() -> int:
+    """`bench.py --precompile`: populate the persistent compile cache
+    with every program the bench's fit() + replay ceilings will run,
+    then exit — no measurement. The watcher runs this the moment the
+    tunnel answers (outside a capture window), so the window itself
+    starts execute-only. Prints ONE JSON line of per-program compile
+    seconds + cache hit/miss counts (cache-hit-dominated output means a
+    previous stage already paid — the steady state)."""
+    fallback = _probe_backend()
+    from pertgnn_tpu.cli.common import apply_platform_env
+    apply_platform_env()
+
+    import jax
+
+    from pertgnn_tpu.aot.precompile import precompile_train
+
+    if not _CACHE_DIR:
+        print("precompile: PERTGNN_COMPILE_CACHE_DIR is empty — nothing "
+              "to populate", file=__import__("sys").stderr)
+        return 1
+    tpe = _TRACES_PER_ENTRY
+    if ((fallback or jax.default_backend() == "cpu")
+            and "BENCH_TRACES_PER_ENTRY" not in os.environ):
+        tpe = _CPU_TRACES_PER_ENTRY
+    ds, cfg = build_workload(tpe)
+    # the ceilings replay the PACKED chunk program too — prime both
+    stats = precompile_train(ds, cfg, include_packed=True)
+    stats["metric"] = "precompile_cache_population"
+    stats["backend_fallback"] = fallback
+    stats["traces_per_entry"] = tpe
+    print(json.dumps(stats))
     return 0
 
 
@@ -694,6 +757,20 @@ def main():
     apply_platform_env()  # honor JAX_PLATFORMS=cpu over the axon plugin
 
     import jax
+
+    from pertgnn_tpu.aot import enable_compile_cache
+    from pertgnn_tpu.config import CompileCacheConfig
+    from pertgnn_tpu.telemetry import watch_xla_cache
+
+    # compiles persist to (and replay from) disk for the whole run; the
+    # watcher stays entered for the whole of main() — the hit/miss
+    # split is the evidence of whether a precompile stage already paid
+    # for this run's programs. The CM object must stay referenced: a
+    # GC'd suspended generator runs its finally and would unregister
+    # the listener mid-run.
+    enable_compile_cache(CompileCacheConfig(cache_dir=_CACHE_DIR))
+    cache_watch = watch_xla_cache()
+    cache_counts = cache_watch.__enter__()
 
     # a promotable salvage from a previous attempt must survive until
     # something better exists: park it as the orphan (the finalizer falls
@@ -714,9 +791,11 @@ def main():
         tpe = _CPU_TRACES_PER_ENTRY
     ds, cfg = build_workload(tpe)
     commit, dirty = _git_state()
+    device_kind = getattr(jax.devices()[0], "device_kind", "") or ""
     _update_partial(phase="workload_built", commit=commit,
                     dirty_worktree=dirty, traces_per_entry=tpe,
                     backend=jax.default_backend(),
+                    device_kind=device_kind,
                     backend_fallback=fallback,
                     train_graphs_per_epoch=len(ds.splits["train"]))
     fit_w, ceil_w, cceil_w, flops_per_graph, bytes_per_graph = \
@@ -753,7 +832,12 @@ def main():
         fit_w=fit_w, ceil_w=ceil_w, cceil_w=cceil_w, unstaged_w=unstaged_w,
         flops_per_graph=flops_per_graph, bytes_per_graph=bytes_per_graph,
         baseline=baseline, backend=jax.default_backend(), fallback=fallback,
-        train_graphs=len(ds.splits["train"]))
+        train_graphs=len(ds.splits["train"]), device_kind=device_kind)
+    result["compile_cache"] = {
+        "dir": _CACHE_DIR or None,
+        "xla_cache_hits": cache_counts["hits"],
+        "xla_cache_misses": cache_counts["misses"],
+    }
     if result["backend"] == "tpu":
         _persist_last_good_tpu(result, commit=commit, dirty=dirty)
     else:
@@ -781,4 +865,6 @@ if __name__ == "__main__":
 
     if "--finalize-partial" in sys.argv[1:]:
         raise SystemExit(finalize_partial())
+    if "--precompile" in sys.argv[1:]:
+        raise SystemExit(precompile())
     main()

@@ -1,0 +1,204 @@
+"""Cold-start benchmark: time-to-first-step and serve warmup, cold vs
+warm compile cache, across REAL process boundaries.
+
+Prints ONE JSON line:
+
+  {"metric": "coldstart_ttfs_warm_speedup", "value": N, "unit": "x",
+   "cold": {...}, "warm": {...}, "ttfs_speedup": N,
+   "serve_warmup_speedup": N, "warm_serve_fresh_compiles": 0, ...}
+
+What is measured (the ISSUE 3 acceptance evidence):
+
+- Two CHILD PROCESSES run the identical workload against the same
+  compile-cache dir. The first is the cold start (empty cache: every
+  executable freshly XLA-compiled, then persisted); the second is the
+  warm start (training chunk programs replay from JAX's persistent
+  compilation cache; serve-rung executables deserialize from the AOT
+  store). Process isolation is the point — in-process jit caches cannot
+  fake a hit.
+- **ttfs_s** — fit()'s time-to-first-train-step (model build + state
+  init + first batch + first-chunk compile/replay + execution; the
+  `ttfs_s` field of fit's first history row).
+- **serve_warmup_s** — InferenceEngine.warmup() over the full bucket
+  ladder, plus its compiles/deserialized counters and the XLA cache
+  hit/miss counts observed by the whole child.
+
+HARD-ASSERTED (exit 1): the warm child's serve warmup performs ZERO
+fresh compiles (every rung deserialized) and its XLA cache records zero
+misses for the train path. The ≥5x speedup claim is reported, not
+asserted — wall-clock ratios belong in the JSON, invariants in the
+exit code.
+
+CPU by default (deterministic in this environment; pass-through via
+PERTGNN_COLDSTART_PLATFORM for on-chip runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def build_workload(cache_dir: str, traces_per_entry: int = 300):
+    """serve_bench's heterogeneous-shape synthetic corpus (>= 3 ladder
+    rungs), with the compile cache wired into the Config."""
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import (CompileCacheConfig, Config, DataConfig,
+                                    IngestConfig, ModelConfig, ServeConfig,
+                                    TrainConfig)
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        # batch/chunk sized small: execution time rides BOTH sides of
+        # the cold/warm ratio — the measurement targets compile cost
+        data=DataConfig(max_traces=100_000, batch_size=32),
+        model=ModelConfig(hidden_channels=32, num_layers=3),
+        train=TrainConfig(label_scale=1000.0, scan_chunk=8),
+        serve=ServeConfig(bucket_growth=2.0, max_graphs_per_batch=8,
+                          min_bucket_nodes=128, min_bucket_edges=128),
+        aot=CompileCacheConfig(cache_dir=cache_dir),
+        graph_type="pert",
+    )
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=60, num_entries=12, patterns_per_entry=3,
+        pattern_size_range=(3, 24), traces_per_entry=traces_per_entry,
+        seed=42))
+    pre = preprocess(data.spans, data.resources, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+    return ds, cfg
+
+
+def child(cache_dir: str, traces_per_entry: int) -> dict:
+    """One process's cold-start story: build data (excluded from the
+    timings), fit one epoch (ttfs), warm the serve ladder."""
+    from pertgnn_tpu.cli.common import apply_platform_env
+    apply_platform_env()
+
+    from pertgnn_tpu import telemetry
+    from pertgnn_tpu.aot import enable_compile_cache
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.train.loop import fit
+
+    t0 = time.perf_counter()
+    ds, cfg = build_workload(cache_dir, traces_per_entry)
+    data_s = time.perf_counter() - t0
+    enable_compile_cache(cfg.aot)
+
+    with telemetry.watch_xla_cache() as train_cache:
+        state, hist = fit(ds, cfg, epochs=1)
+    with telemetry.watch_xla_cache() as serve_cache:
+        engine = InferenceEngine.from_dataset(ds, cfg, state).warmup()
+    # one dispatch proves the deserialized executables actually serve
+    s = ds.splits["test"]
+    pred = engine.predict_many(s.entry_ids[:4], s.ts_buckets[:4])
+    return {
+        "data_s": round(data_s, 3),
+        "ttfs_s": round(hist[0]["ttfs_s"], 3),
+        "epoch0_s": round(hist[0]["train_time_s"], 3),
+        "serve_warmup_s": round(engine.warmup_s, 3),
+        "serve_buckets": len(engine.ladder),
+        "serve_compiles": engine.compiles,
+        "serve_deserialized": engine.deserialized,
+        "train_xla_hits": train_cache["hits"],
+        "train_xla_misses": train_cache["misses"],
+        "serve_xla_hits": serve_cache["hits"],
+        "serve_xla_misses": serve_cache["misses"],
+        "first_predictions": [round(float(p), 4) for p in pred],
+    }
+
+
+def run_child(cache_dir: str, traces_per_entry: int) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS",
+                   os.environ.get("PERTGNN_COLDSTART_PLATFORM", "cpu"))
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--as-child",
+         "--cache_dir", cache_dir,
+         "--traces_per_entry", str(traces_per_entry)],
+        capture_output=True, text=True, env=env)
+    if out.returncode != 0:
+        print(out.stdout, file=sys.stderr)
+        print(out.stderr, file=sys.stderr)
+        raise SystemExit(f"coldstart child failed rc={out.returncode}")
+    # last stdout line is the child's JSON (logging chatter precedes it)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--cache_dir", default="",
+                   help="compile-cache dir (default: fresh temp dir, "
+                        "removed afterwards)")
+    p.add_argument("--traces_per_entry", type=int, default=300)
+    p.add_argument("--as-child", action="store_true", dest="as_child",
+                   help="internal: run one measurement process")
+    args = p.parse_args()
+
+    if args.as_child:
+        print(json.dumps(child(args.cache_dir, args.traces_per_entry)))
+        return 0
+
+    cache_dir = args.cache_dir or tempfile.mkdtemp(prefix="coldstart_")
+    cleanup = not args.cache_dir
+    try:
+        if os.path.isdir(cache_dir) and os.listdir(cache_dir):
+            print(f"NOTE: cache dir {cache_dir} is not empty — the "
+                  "'cold' phase may be partially warm", file=sys.stderr)
+        cold = run_child(cache_dir, args.traces_per_entry)
+        warm = run_child(cache_dir, args.traces_per_entry)
+
+        ttfs_speedup = cold["ttfs_s"] / max(warm["ttfs_s"], 1e-9)
+        warmup_speedup = (cold["serve_warmup_s"]
+                          / max(warm["serve_warmup_s"], 1e-9))
+        failures = []
+        if warm["serve_compiles"] != 0:
+            failures.append(
+                f"warm serve warmup performed {warm['serve_compiles']} "
+                "fresh compiles (want 0: every rung deserialized)")
+        if warm["serve_deserialized"] != warm["serve_buckets"]:
+            failures.append(
+                f"warm serve deserialized {warm['serve_deserialized']}"
+                f"/{warm['serve_buckets']} rungs")
+        if warm["train_xla_misses"] != 0:
+            failures.append(
+                f"warm train path recorded {warm['train_xla_misses']} "
+                "XLA cache misses (want 0: all programs replayed)")
+        if warm["first_predictions"] != cold["first_predictions"]:
+            failures.append(
+                "deserialized executables predict differently than "
+                "freshly compiled ones")
+        result = {
+            "metric": "coldstart_ttfs_warm_speedup",
+            "value": round(ttfs_speedup, 2),
+            "unit": "x",
+            "ttfs_speedup": round(ttfs_speedup, 2),
+            "serve_warmup_speedup": round(warmup_speedup, 2),
+            "warm_serve_fresh_compiles": warm["serve_compiles"],
+            "warm_train_xla_misses": warm["train_xla_misses"],
+            "cold": cold,
+            "warm": warm,
+            "cache_dir": None if cleanup else cache_dir,
+            "failures": failures,
+            "captured_unix_time": time.time(),
+        }
+        print(json.dumps(result))
+        return 1 if failures else 0
+    finally:
+        if cleanup:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

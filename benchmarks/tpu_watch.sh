@@ -6,6 +6,9 @@
 # lose the whole round's chip measurement. This watcher polls a cheap
 # probe and, the moment the tunnel answers, runs whatever of the capture
 # is still missing:
+#   0. bench.py --precompile (once per round) — populates the on-disk
+#      compile cache so the capture window's first step is execute-only
+#      (ISSUE 3); its stats row (cache hit/miss split) lands in $OUT.
 #   1. bench.py — pins benchmarks/last_good_tpu.json on success; on a
 #      mid-run wedge (the outer timeout kills it) the per-window partial
 #      file is promoted by `bench.py --finalize-partial` (host-only), so
@@ -84,6 +87,10 @@ commit_capture() {
   return 0
 }
 
+# a fresh watcher = a fresh round: new code means new HLO and new cache
+# keys, so last round's precompile marker must not suppress this one's
+rm -f benchmarks/.precompiled_this_round
+
 # whatever kills the watcher, never leave the paused CPU hogs frozen
 trap 'if [ -f benchmarks/cpu_hogs.pid ]; then
         xargs -r kill -CONT -- < benchmarks/cpu_hogs.pid 2>/dev/null; fi' EXIT
@@ -108,6 +115,22 @@ for i in $(seq 1 "$PROBES"); do
         && [ "$upgrades_used" -lt "$UPGRADE_TRIES" ]; }; then
       [ "$state" = partial ] && upgrades_used=$((upgrades_used + 1)) \
         && echo "$(date -u +%FT%TZ) upgrading partial pin (try $upgrades_used/$UPGRADE_TRIES)"
+      # Cold-start elimination (ISSUE 3): populate the persistent
+      # compile cache BEFORE arming the capture window, so the window's
+      # first step is execute-only instead of wedging inside XLA. The
+      # stats row (per-program seconds + cache hit/miss split) goes
+      # into $OUT as evidence; a failed precompile only costs the
+      # warm start — the bench still runs.
+      if [ ! -f benchmarks/.precompiled_this_round ]; then
+        echo "$(date -u +%FT%TZ) running bench.py --precompile"
+        BENCH_PROBE_TIMEOUT=75 BENCH_PROBE_TRIES=2 timeout 1800 \
+          python bench.py --precompile >> "$OUT"
+        prc=$?
+        echo "$(date -u +%FT%TZ) precompile rc=$prc"
+        # only a SUCCESSFUL precompile is done-for-the-round; a wedged
+        # one retries in the next healthy window
+        [ $prc -eq 0 ] && touch benchmarks/.precompiled_this_round
+      fi
       echo "$(date -u +%FT%TZ) running bench.py"
       ran_bench=1
       bench_out=$(mktemp)

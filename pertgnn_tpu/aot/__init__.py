@@ -1,0 +1,87 @@
+"""Cold-start elimination: persistent compile cache + serialized AOT
+executables (ISSUE 3).
+
+BENCH_r05 put ``fit()`` at 1.01x of the measured step ceiling —
+steady-state throughput is no longer the bottleneck; COLD START is: the
+round's only TPU window (<1 min) wedged inside first-step compilation
+before a single measurement persisted, and every serve process start
+re-paid a full ``lower().compile()`` per ladder rung. This package makes
+every hot executable resumable from disk, two mechanisms deep:
+
+1. **Persistent compilation cache** (`enable_compile_cache`): JAX's
+   ``jax_compilation_cache_dir`` pointed at ``<cache_dir>/xla``. Every
+   jit compile — the scan-fused train/eval chunk programs (including
+   donated-buffer programs ``jax.export`` cannot carry), model init, the
+   packed ceiling twins — is written to disk keyed by XLA over (HLO,
+   compile options, backend) and replayed by any later process.
+2. **Serialized serve executables** (`aot/store.py`): the serve ladder's
+   per-rung executables persisted under a content-hash key over
+   (jax/jaxlib version, device kind, mesh, Config subtree, function
+   identity, abstract signature — `aot/keys.py`), with loud invalidation
+   on any mismatch and corrupt-entry fallback to fresh compilation.
+
+The host-only **precompile stage** (`aot/precompile.py`, surfaced as
+``bench.py --precompile`` and ``serve_main --precompile_only``)
+populates both before a TPU window opens, so the in-window first step is
+execute-only. Workflow: docs/GUIDE.md "Precompile workflow"; metrics:
+docs/OBSERVABILITY.md ``aot.*``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from pertgnn_tpu.aot.keys import (abstract_signature, cache_key,
+                                  environment_fingerprint)
+from pertgnn_tpu.aot.store import ExecutableStore, diff_components
+from pertgnn_tpu.config import CompileCacheConfig
+from pertgnn_tpu.telemetry.jaxmon import watch_xla_cache
+
+__all__ = [
+    "CompileCacheConfig", "ExecutableStore", "abstract_signature",
+    "cache_key", "diff_components", "enable_compile_cache",
+    "environment_fingerprint", "store_from_config", "watch_xla_cache",
+]
+
+log = logging.getLogger(__name__)
+
+
+def enable_compile_cache(cfg: CompileCacheConfig) -> str | None:
+    """Point JAX's persistent compilation cache at ``<cache_dir>/xla``.
+
+    Returns the cache directory actually enabled, or None when the
+    config disables it. Call BEFORE the first compile (the CLIs do,
+    right after apply_platform_env); calling again with the same config
+    is a no-op, with a different dir redirects future compiles.
+
+    The min-entry-size floor is dropped to \"cache everything\": this
+    workload's cold start is the SUM of many small programs (eager init
+    ops, chunk programs, per-rung serve executables), so the default
+    floor would exempt exactly the entries we need."""
+    if not cfg.enabled:
+        return None
+    xla_dir = os.path.abspath(os.path.join(cfg.cache_dir, "xla"))
+    os.makedirs(xla_dir, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", xla_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(cfg.min_compile_time_s))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    log.info("persistent compilation cache enabled at %s "
+             "(min_compile_time_s=%g)", xla_dir, cfg.min_compile_time_s)
+    return xla_dir
+
+
+def store_from_config(cfg, bus=None) -> ExecutableStore | None:
+    """The serialized-executable store for a Config (or a bare
+    CompileCacheConfig), or None when disabled. Also enables the
+    persistent compilation cache — the store's stablehlo format replays
+    through it, so the two are only ever on together."""
+    aot_cfg = getattr(cfg, "aot", cfg)
+    enable_compile_cache(aot_cfg)  # cache-only mode still wants XLA on
+    if not aot_cfg.enabled or not aot_cfg.serialize_executables:
+        return None
+    return ExecutableStore(
+        os.path.abspath(os.path.join(aot_cfg.cache_dir, "exe")), bus=bus)

@@ -1,0 +1,92 @@
+"""Content-hash keys for persisted executables.
+
+A serialized executable is only reusable when EVERYTHING that shaped its
+compilation is identical: the jax/jaxlib pair that lowered it, the
+backend and device kind it was compiled for, the mesh/topology it was
+sharded over, the Config semantics baked into the program as constants
+(label_scale, model arch), and the abstract calling signature. The key
+is a sha256 over a canonical JSON of all of those components; the
+components themselves are persisted next to each entry so a miss can say
+loudly WHICH ingredient changed (store.py) instead of silently
+recompiling forever after an invisible drift.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any
+
+import jax
+
+
+def environment_fingerprint(mesh=None) -> dict:
+    """The lowering environment a compiled artifact is welded to:
+    jax/jaxlib versions, backend platform + device kind, local device
+    count, and (when given) the mesh axis layout."""
+    import jaxlib
+
+    dev = jax.devices()[0]
+    fp = {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "") or "",
+        "num_devices": jax.device_count(),
+    }
+    if mesh is not None:
+        fp["mesh"] = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    return fp
+
+
+def _canonical(obj: Any) -> Any:
+    """JSON-stable view: dataclasses -> dicts, tuples -> lists, sets
+    sorted; anything else must already be JSON-serializable (enforced by
+    json.dumps below — an unserializable component should fail loudly at
+    key time, not silently hash its repr)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return _canonical(dataclasses.asdict(obj))
+    if isinstance(obj, dict):
+        return {str(k): _canonical(v) for k, v in sorted(obj.items())}
+    if isinstance(obj, (list, tuple)):
+        return [_canonical(v) for v in obj]
+    if isinstance(obj, (set, frozenset)):
+        return sorted(_canonical(v) for v in obj)
+    return obj
+
+
+def abstract_signature(tree) -> dict:
+    """The calling convention of a pytree of ShapeDtypeStructs (or
+    arrays): per-leaf shape:dtype plus the treedef — what a compiled
+    executable actually binds to at dispatch."""
+    import numpy as np
+
+    leaves, treedef = jax.tree.flatten(tree)
+    sig = []
+    for x in leaves:
+        if not hasattr(x, "shape") or not hasattr(x, "dtype"):
+            x = np.asarray(x)
+        sig.append(f"{tuple(x.shape)}:{np.dtype(x.dtype).name}")
+    return {"leaves": sig, "treedef": str(treedef)}
+
+
+def cache_key(*, fn_id: str, config: dict, args_sig: dict,
+              env: dict | None = None) -> tuple[str, dict]:
+    """(hex key, components) for one executable.
+
+    `fn_id` names the Python function AND its revision — bump it when
+    the function's body changes meaning without changing its signature
+    (the one ingredient a content hash over inputs cannot see).
+    `config` carries the Config subtrees whose values are baked into the
+    program as constants; `args_sig` the abstract_signature of the call
+    args; `env` defaults to the live environment_fingerprint()."""
+    components = {
+        "fn": fn_id,
+        "env": _canonical(env if env is not None
+                          else environment_fingerprint()),
+        "config": _canonical(config),
+        "args": _canonical(args_sig),
+    }
+    blob = json.dumps(components, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:32], components

@@ -1,0 +1,128 @@
+"""Host-driven precompile: populate the compile caches BEFORE the run
+that needs them.
+
+``fit()``'s first step pays model init + the scan-fused chunk compile;
+a serve process start pays one compile per ladder rung. This stage
+builds the SAME programs those paths run — through
+``train.loop.build_single_device_programs``, the construction fit()
+itself uses, so the persisted artifacts match by code identity — and
+exits. The next process's "compiles" are then disk replays: the fused
+init and any non-exportable program through JAX's persistent
+compilation cache, the train/eval chunk programs and serve rungs
+through the serialized-executable store (aot/store.py).
+``tpu_watch.sh`` runs this the moment the tunnel answers, before arming
+a capture window, so the in-window first step is execute-only (the
+<1 min windows this environment grants no longer die inside XLA).
+
+Entry points: ``bench.py --precompile`` (train + ceiling programs over
+the bench workload) and ``serve_main --precompile_only`` (the serve
+ladder via the engine's own warmup). Mirrors fit()'s SINGLE-PROCESS
+program selection; mesh runs are skipped with a warning (their programs
+shard over the live mesh — precompile them by running the same command
+shape on the same slice).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.aot import enable_compile_cache
+from pertgnn_tpu.config import Config
+
+log = logging.getLogger(__name__)
+
+
+def precompile_train(dataset, cfg: Config, *, include_packed: bool = False,
+                     mesh=None, bus=None) -> dict:
+    """Build (= compile + persist) every program fit() will run on this
+    dataset/config; returns a JSON-ready stats dict. ``include_packed``
+    additionally primes the packed chunk program even when the compact
+    path is active (bench.py's replay ceilings run both)."""
+    if bus is None:
+        bus = telemetry.get_bus()
+    if mesh is not None:
+        log.warning("precompile_train skips mesh configs: SPMD programs "
+                    "compile against the live mesh — run the real "
+                    "command on the same slice to prime them")
+        return {"programs": [], "skipped": "mesh"}
+    if not cfg.aot.enabled:
+        raise ValueError(
+            "precompile needs CompileCacheConfig.cache_dir set "
+            "(--compile_cache_dir) — without it the compiled programs "
+            "die with this process")
+    enable_compile_cache(cfg.aot)
+
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import (_resolve_device_materialize,
+                                        _train_eval_abstract, _train_sample,
+                                        build_single_device_programs,
+                                        make_train_chunk, make_train_step,
+                                        make_tx)
+
+    stats: list[dict] = []
+    t_all = time.perf_counter()
+    with telemetry.watch_xla_cache() as cache:
+        model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
+                           dataset.num_interfaces, dataset.num_rpctypes)
+        tx = make_tx(cfg)
+        sample = _train_sample(dataset)
+        compact = _resolve_device_materialize(dataset, cfg)
+
+        t0 = time.perf_counter()
+        with bus.span("aot.compile", program="fit_programs"):
+            state, train_step, eval_step = build_single_device_programs(
+                dataset, cfg, model=model, tx=tx, sample=sample,
+                device_materialize=compact, bus=bus)
+        stats.append({"name": "init+fit_programs",
+                      "seconds": round(time.perf_counter() - t0, 3)})
+
+        # store-less mode returns lazily-jitted programs — force their
+        # compiles into the persistent cache now, that is the job
+        abs_args = None
+        for name, step in (("train", train_step), ("eval", eval_step)):
+            if not hasattr(step, "lower"):
+                continue  # already an AOT-compiled executable
+            if abs_args is None:
+                abs_args = _train_eval_abstract(dataset, cfg, state,
+                                                compact)
+            t0 = time.perf_counter()
+            with bus.span("aot.compile", program=name):
+                step.lower(*abs_args).compile()
+            dt = time.perf_counter() - t0
+            bus.histogram("aot.compile_seconds", dt, program=name)
+            stats.append({"name": name, "seconds": round(dt, 3)})
+
+        if include_packed and compact:
+            # bench.py's packed replay ceiling compiles the packed chunk
+            # program in its ORIGINAL jit form — prime exactly that
+            pabs = _train_eval_abstract(dataset, cfg, state, False)
+            packed = (make_train_chunk(model, cfg, tx)
+                      if cfg.train.scan_chunk > 1
+                      else make_train_step(model, cfg, tx))
+            t0 = time.perf_counter()
+            with bus.span("aot.compile", program="train_packed_ceiling"):
+                packed.lower(*pabs).compile()
+            dt = time.perf_counter() - t0
+            bus.histogram("aot.compile_seconds", dt,
+                          program="train_packed_ceiling")
+            stats.append({"name": "train_packed_ceiling",
+                          "seconds": round(dt, 3)})
+        for row in stats:
+            log.info("precompiled %s in %.2fs", row["name"],
+                     row["seconds"])
+    dev = jax.devices()[0]
+    return {
+        "backend": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "") or "",
+        "cache_dir": cfg.aot.cache_dir or None,
+        "programs": stats,
+        "total_seconds": round(time.perf_counter() - t_all, 3),
+        # hits mean a previous stage (or run) already paid these
+        # compiles; misses are the fresh ones this stage just persisted
+        "xla_cache_hits": cache["hits"],
+        "xla_cache_misses": cache["misses"],
+    }

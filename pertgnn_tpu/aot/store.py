@@ -1,0 +1,402 @@
+"""On-disk store of serialized AOT executables.
+
+The serve engine compiles one executable per ladder rung at warmup and
+``fit()`` compiles its train/eval chunk programs at first step; on every
+process start those used to re-pay a full ``lower().compile()`` each.
+The store makes them resumable. Two formats, picked by a one-time probe
+of what the backend's PJRT client supports:
+
+- ``pjrt`` — the compiled executable itself
+  (jax.experimental.serialize_executable): load is a pure
+  deserialization, no XLA involved, and every compile-time property
+  (donated buffers included) survives byte-for-byte. TPU/GPU backends.
+- ``stablehlo`` — XLA:CPU executables do not survive the pjrt
+  round-trip (unresolved JIT symbols), so the fallback persists the
+  ``jax.export`` StableHLO artifact. ``load_or_build`` then makes the
+  REPLAYED form (``jit(deserialize(blob).call)``) the live executable on
+  BOTH the cold and the warm path: cold pays exactly one backend
+  compile (of the replay form, which lands in the persistent
+  compilation cache), warm re-lowers the deserialized artifact and hits
+  that cache entry — no model re-trace, no fresh XLA compile, and no
+  double-compile on the cold path. Replay output is bit-identical to
+  the original program (pinned by tests/test_aot.py).
+
+A miss with OTHER keys present under the same logical name diffs the
+persisted key components and logs loudly WHICH ingredient changed (jax
+upgrade, device kind, config field, signature) — silent permanent
+recompiles are the failure mode this kills. A corrupt or truncated
+entry logs a warning and falls back to fresh compilation (never crashes
+the caller); the fresh save overwrites it.
+
+Telemetry: ``aot.cache_hit`` / ``aot.cache_miss`` counters (tag
+``program``, plus ``reason`` on misses), ``aot.compile_seconds`` /
+``aot.deserialize_seconds`` / ``aot.serialize_seconds`` histograms and
+``aot.compile`` / ``aot.deserialize`` spans (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import time
+
+import jax
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.telemetry.jaxmon import watch_xla_cache
+
+log = logging.getLogger(__name__)
+
+# Bump to orphan every existing entry (layout/semantics change in the
+# store itself — entries are format-versioned independently of the
+# content key).
+_STORE_VERSION = 2
+
+_pjrt_support: bool | None = None
+_export_types_registered = False
+
+
+def pjrt_roundtrip_supported() -> bool:
+    """Whether this backend's compiled executables survive
+    serialize -> deserialize_and_load (probed ONCE per process with a
+    trivial program; ~100 ms). XLA:CPU serializes without complaint but
+    fails at load ("Symbols not found"), which is why the probe must
+    round-trip, not just serialize."""
+    global _pjrt_support
+    if _pjrt_support is None:
+        try:
+            from jax.experimental import serialize_executable as se
+            probe = jax.jit(lambda x: x + 1).lower(
+                jax.ShapeDtypeStruct((), "int32")).compile()
+            exe = se.deserialize_and_load(*se.serialize(probe))
+            _pjrt_support = exe is not None
+        except Exception as e:
+            log.info("pjrt executable serialization unsupported on this "
+                     "backend (%s: %s); using stablehlo entries",
+                     type(e).__name__, e)
+            _pjrt_support = False
+    return _pjrt_support
+
+
+def register_export_types() -> None:
+    """Register this repo's pytree node types (and the optax states
+    inside TrainState) with jax.export's serializer. Idempotent; lazy —
+    called on first export/deserialize so importing the store never
+    drags in the train stack."""
+    global _export_types_registered
+    if _export_types_registered:
+        return
+    import optax
+    from jax import export
+
+    from pertgnn_tpu.batching.arena import CompactBatch
+    from pertgnn_tpu.batching.pack import PackedBatch
+    from pertgnn_tpu.train.loop import TrainState
+
+    for nt, name in ((optax.ScaleByAdamState, "optax.ScaleByAdamState"),
+                     (optax.EmptyState, "optax.EmptyState"),
+                     (PackedBatch, "pertgnn.PackedBatch"),
+                     (CompactBatch, "pertgnn.CompactBatch")):
+        try:
+            export.register_namedtuple_serialization(nt,
+                                                     serialized_name=name)
+        except ValueError:
+            pass  # a previous partial registration pass got here
+    try:
+        # TrainState is a flax struct dataclass: every field is pytree
+        # data, so its auxdata is the empty tuple
+        export.register_pytree_node_serialization(
+            TrainState, serialized_name="pertgnn.TrainState",
+            serialize_auxdata=lambda aux: b"",
+            deserialize_auxdata=lambda b: ())
+    except ValueError:
+        pass
+    _export_types_registered = True
+
+
+class ExecutableStore:
+    """Content-addressed serialized executables under ``root``.
+
+    Layout: ``<root>/<name>/<key>.bin`` (pickled payload) +
+    ``<root>/<name>/<key>.json`` (the key's components — the diff
+    source for loud invalidation). ``name`` is a logical slot ("which
+    program"), ``key`` the content hash ("compiled against what")."""
+
+    def __init__(self, root: str, bus=None):
+        self.root = root
+        self._injected_bus = bus
+        os.makedirs(root, exist_ok=True)
+
+    @property
+    def _bus(self):
+        return (self._injected_bus if self._injected_bus is not None
+                else telemetry.get_bus())
+
+    def _paths(self, name: str, key: str) -> tuple[str, str]:
+        d = os.path.join(self.root, name)
+        return os.path.join(d, f"{key}.bin"), os.path.join(d, f"{key}.json")
+
+    # -- the one-stop entry point ---------------------------------------
+
+    def load_or_build(self, name: str, key: str, components: dict,
+                      jit_fn, abstract_args) -> tuple[object, str]:
+        """(executable, outcome) for (name, key): outcome is
+        "deserialized" (store hit — zero fresh model compiles) or
+        "compiled" (miss — built fresh, persisted for the next process).
+        ``jit_fn`` must be the already-``jax.jit``-wrapped function
+        (donation flags and all); ``abstract_args`` its
+        ShapeDtypeStruct calling signature."""
+        exe = self.load(name, key, components, abstract_args=abstract_args)
+        if exe is not None:
+            return exe, "deserialized"
+        bus = self._bus
+        t0 = time.perf_counter()
+        with bus.span("aot.compile", program=name):
+            if pjrt_roundtrip_supported():
+                exe = jit_fn.lower(*abstract_args).compile()
+                self.save(name, key, components, exe, jit_fn=jit_fn,
+                          abstract_args=abstract_args)
+            else:
+                exe = self._build_and_save_stablehlo(
+                    name, key, components, jit_fn, abstract_args)
+        bus.histogram("aot.compile_seconds", time.perf_counter() - t0,
+                      program=name)
+        return exe, "compiled"
+
+    def _build_and_save_stablehlo(self, name, key, components, jit_fn,
+                                  abstract_args):
+        """Export first, then compile the REPLAYED form and make it the
+        live executable — the warm path re-lowers the identical
+        deserialized artifact, so its backend compile hits the
+        persistent-cache entry this one writes. Falls back to a plain
+        (unserialized) compile when export cannot carry the program."""
+        from jax import export
+
+        try:
+            register_export_types()
+            blob = export.export(jit_fn)(*abstract_args).serialize()
+        except Exception as e:
+            log.warning(
+                "could not export %s (%s: %s) — compiling unserialized; "
+                "this program will recompile on every process start "
+                "(the persistent XLA cache may still shortcut it)",
+                name, type(e).__name__, e)
+            self._bus.counter("aot.serialize_failed", program=name)
+            return jit_fn.lower(*abstract_args).compile()
+        exe = self._replay(blob, abstract_args)
+        self._save(name, key, components,
+                   {"format": "stablehlo", "payload": blob})
+        return exe
+
+    # -- load ------------------------------------------------------------
+
+    def load(self, name: str, key: str, components: dict, *,
+             abstract_args=None):
+        """The executable for (name, key), or None (miss/corrupt —
+        callers compile fresh and save). ``abstract_args`` is required
+        to replay ``stablehlo`` entries (the re-lowering target)."""
+        bus = self._bus
+        bin_path, _ = self._paths(name, key)
+        if not os.path.exists(bin_path):
+            self._log_invalidation(name, key, components)
+            bus.counter("aot.cache_miss", program=name, reason="absent")
+            return None
+        t0 = time.perf_counter()
+        try:
+            with bus.span("aot.deserialize", program=name):
+                with open(bin_path, "rb") as f:
+                    entry = pickle.load(f)
+                if entry.get("store_version") != _STORE_VERSION:
+                    raise ValueError(
+                        f"store version {entry.get('store_version')!r} != "
+                        f"{_STORE_VERSION}")
+                exe = self._deserialize(entry, abstract_args)
+        except Exception as e:
+            # corrupt/truncated/stale entry: NEVER crash the caller —
+            # fall back to a fresh compile (whose save overwrites this)
+            log.warning(
+                "corrupt AOT store entry %s/%s (%s: %s) — falling back "
+                "to fresh compile", name, key, type(e).__name__, e)
+            bus.counter("aot.cache_miss", program=name, reason="corrupt")
+            return None
+        dt = time.perf_counter() - t0
+        bus.counter("aot.cache_hit", program=name, format=entry["format"])
+        bus.histogram("aot.deserialize_seconds", dt, program=name,
+                      format=entry["format"])
+        return exe
+
+    def _deserialize(self, entry: dict, abstract_args):
+        if entry["format"] == "pjrt":
+            from jax.experimental import serialize_executable as se
+            return se.deserialize_and_load(entry["payload"],
+                                           entry["in_tree"],
+                                           entry["out_tree"])
+        if entry["format"] == "stablehlo":
+            if abstract_args is None:
+                raise ValueError(
+                    "stablehlo entry needs abstract_args to replay")
+            with watch_xla_cache() as cache:
+                exe = self._replay(entry["payload"], abstract_args)
+            if cache["misses"]:
+                # the save-time compile of this exact form should have
+                # landed in the persistent cache — a miss means that
+                # cache was cleared/moved out from under the store:
+                # still correct, but this "deserialize" paid a compile
+                log.warning(
+                    "stablehlo replay was NOT served by the persistent "
+                    "compilation cache (%d fresh XLA compiles) — was "
+                    "the cache dir cleared?", cache["misses"])
+                self._bus.counter("aot.replay_uncached")
+            return exe
+        raise ValueError(f"unknown entry format {entry['format']!r}")
+
+    @staticmethod
+    def _replay(blob: bytes, abstract_args):
+        from jax import export
+
+        register_export_types()
+        return jax.jit(export.deserialize(blob).call).lower(
+            *abstract_args).compile()
+
+    # -- save ------------------------------------------------------------
+
+    def _serialize_pjrt(self, compiled) -> dict:
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = se.serialize(compiled)
+        return {"format": "pjrt", "payload": payload,
+                "in_tree": in_tree, "out_tree": out_tree}
+
+    def save(self, name: str, key: str, components: dict, compiled, *,
+             jit_fn=None, abstract_args=None) -> str | None:
+        """Persist an already-compiled executable under (name, key);
+        returns the format written or None. Prefer ``load_or_build``,
+        which picks the format BEFORE compiling; this entry point is for
+        callers that already hold a compiled program (pjrt backends
+        only — on stablehlo backends it exports the function when
+        ``jit_fn``/``abstract_args`` are given, but the caller's live
+        executable then differs in form from what later processes
+        deserialize; bit-equality between the two is pinned by
+        tests/test_aot.py)."""
+        entry = None
+        if pjrt_roundtrip_supported():
+            try:
+                entry = self._serialize_pjrt(compiled)
+                # validate THIS entry, not just the probe: XLA:CPU
+                # reloads trivial programs fine but rejects ones whose
+                # kernels JIT'd runtime symbols ("Symbols not found")
+                self._deserialize(
+                    {**entry, "store_version": _STORE_VERSION}, None)
+            except Exception as e:
+                entry = None
+                log.info("pjrt serialization of %s failed validation "
+                         "(%s: %s); trying stablehlo", name,
+                         type(e).__name__, e)
+        if entry is None and jit_fn is not None and abstract_args is not None:
+            from jax import export
+
+            try:
+                register_export_types()
+                entry = {"format": "stablehlo",
+                         "payload": export.export(jit_fn)(
+                             *abstract_args).serialize()}
+                # prime the replay form so the next process's load is a
+                # persistent-cache hit, not a fresh compile
+                self._replay(entry["payload"], abstract_args)
+            except Exception as e:
+                log.warning("could not serialize %s in any format "
+                            "(%s: %s) — it will recompile on every "
+                            "process start", name, type(e).__name__, e)
+                self._bus.counter("aot.serialize_failed", program=name)
+                return None
+        if entry is None:
+            return None
+        return self._save(name, key, components, entry)
+
+    def _save(self, name: str, key: str, components: dict,
+              entry: dict) -> str:
+        bus = self._bus
+        t0 = time.perf_counter()
+        entry["store_version"] = _STORE_VERSION
+        bin_path, meta_path = self._paths(name, key)
+        os.makedirs(os.path.dirname(bin_path), exist_ok=True)
+        # atomic pair: a kill mid-write must not leave a torn entry the
+        # next process trips over (it would fall back anyway, but noisily)
+        for path, data in (
+                (bin_path, pickle.dumps(entry)),
+                (meta_path, json.dumps(
+                    {"key": key, "format": entry["format"],
+                     "created_unix_time": time.time(), **components},
+                    indent=1, sort_keys=True, default=str).encode())):
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        dt = time.perf_counter() - t0
+        bus.histogram("aot.serialize_seconds", dt, program=name,
+                      format=entry["format"])
+        log.info("AOT store: saved %s/%s (%s, %.0f KiB) in %.2fs",
+                 name, key, entry["format"],
+                 os.path.getsize(bin_path) / 1024, dt)
+        return entry["format"]
+
+    # -- invalidation diagnostics ---------------------------------------
+
+    def _log_invalidation(self, name: str, key: str,
+                          components: dict) -> None:
+        """A miss while OTHER entries exist under this name means
+        something about the environment/config changed since they were
+        saved — name the ingredient instead of recompiling silently."""
+        d = os.path.join(self.root, name)
+        try:
+            metas = [f for f in os.listdir(d) if f.endswith(".json")]
+        except OSError:
+            return
+        if not metas:
+            return
+        # diff against the NEWEST entry (by its recorded creation time,
+        # not the arbitrary hex-hash filename order): with several
+        # entries in a slot, naming the ingredient that changed since
+        # the latest save is the message an operator can act on
+        prev = None
+        for f in metas:
+            try:
+                with open(os.path.join(d, f)) as fh:
+                    m = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            if (prev is None or m.get("created_unix_time", 0)
+                    > prev.get("created_unix_time", 0)):
+                prev = m
+        if prev is None:
+            log.warning("AOT store: %s has entries but unreadable "
+                        "metadata; recompiling fresh", name)
+            return
+        changed = diff_components(prev, components)
+        log.warning(
+            "AOT store: invalidating %s (saved key %s != wanted %s); "
+            "changed: %s — recompiling fresh", name,
+            prev.get("key", "?")[:12], key[:12],
+            "; ".join(changed) if changed else "unknown (metadata "
+            "predates these components)")
+        self._bus.counter("aot.invalidated", program=name)
+
+
+def diff_components(prev: dict, now: dict) -> list[str]:
+    """Human-readable 'what changed' between two key-component dicts
+    (dotted paths, saved vs wanted)."""
+    out: list[str] = []
+
+    def walk(path, a, b):
+        if isinstance(a, dict) and isinstance(b, dict):
+            for k in sorted(set(a) | set(b)):
+                walk(f"{path}.{k}" if path else str(k),
+                     a.get(k), b.get(k))
+        elif a != b:
+            out.append(f"{path}: saved={a!r} vs now={b!r}")
+
+    for field in ("fn", "env", "config", "args"):
+        walk(field, prev.get(field), now.get(field))
+    return out

@@ -11,9 +11,9 @@ from __future__ import annotations
 import argparse
 import os
 
-from pertgnn_tpu.config import (Config, DataConfig, IngestConfig, ModelConfig,
-                                ParallelConfig, ServeConfig, TelemetryConfig,
-                                TrainConfig)
+from pertgnn_tpu.config import (CompileCacheConfig, Config, DataConfig,
+                                IngestConfig, ModelConfig, ParallelConfig,
+                                ServeConfig, TelemetryConfig, TrainConfig)
 
 
 def apply_platform_env() -> None:
@@ -207,6 +207,47 @@ def add_serve_flags(p: argparse.ArgumentParser) -> None:
                         "the compile)")
 
 
+def add_aot_flags(p: argparse.ArgumentParser) -> None:
+    """Cold-start / compile-cache knobs (CompileCacheConfig,
+    pertgnn_tpu/aot/) — shared by ALL CLIs and bench.py: any entry point
+    that compiles can persist and replay its executables."""
+    p.add_argument("--compile_cache_dir", default="",
+                   help="persist compiled executables here (xla/ = JAX's "
+                        "persistent compilation cache; exe/ = serialized "
+                        "serve-rung executables) so later processes skip "
+                        "cold-start compilation; empty = off "
+                        "(docs/GUIDE.md 'Precompile workflow')")
+    p.add_argument("--aot_min_compile_time_s", type=float, default=0.0,
+                   help="only persist XLA cache entries whose compile "
+                        "took at least this long; 0 caches everything")
+    p.add_argument("--no_serialize_executables", action="store_true",
+                   help="skip the serialized serve-executable store "
+                        "(persistent XLA cache only)")
+
+
+def aot_config_from_args(args: argparse.Namespace) -> CompileCacheConfig:
+    """The ONE flags -> CompileCacheConfig mapping (same pattern as
+    telemetry_config_from_args): config_from_args embeds it and
+    setup_compile_cache enables the live cache from it."""
+    return CompileCacheConfig(
+        cache_dir=getattr(args, "compile_cache_dir", ""),
+        min_compile_time_s=getattr(args, "aot_min_compile_time_s", 0.0),
+        serialize_executables=not getattr(args, "no_serialize_executables",
+                                          False))
+
+
+def setup_compile_cache(args: argparse.Namespace) -> CompileCacheConfig:
+    """Enable the persistent compilation cache from parsed flags (no-op
+    when --compile_cache_dir is empty). Call AFTER apply_platform_env
+    and BEFORE anything compiles — cache entries are keyed per backend,
+    so the platform decision must already be final."""
+    from pertgnn_tpu.aot import enable_compile_cache
+
+    cfg = aot_config_from_args(args)
+    enable_compile_cache(cfg)
+    return cfg
+
+
 def add_telemetry_flags(p: argparse.ArgumentParser) -> None:
     """Telemetry-bus + logging knobs — shared by ALL CLIs (the bus is
     process-wide; any entry point can produce a JSONL stream)."""
@@ -320,6 +361,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                                       ServeConfig.flush_deadline_ms),
             warmup=not getattr(args, "no_serve_warmup", False)),
         telemetry=telemetry_config_from_args(args),
+        aot=aot_config_from_args(args),
         graph_type=args.graph_type,
     )
 

@@ -24,11 +24,12 @@ import pandas as pd
 
 from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.batching.dataset import split_indices
-from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
-                                    add_serve_flags, add_telemetry_flags,
-                                    apply_platform_env, config_from_args,
+from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
+                                    add_model_train_flags, add_serve_flags,
+                                    add_telemetry_flags, apply_platform_env,
+                                    config_from_args,
                                     load_or_ingest_artifacts,
-                                    setup_telemetry)
+                                    setup_compile_cache, setup_telemetry)
 from pertgnn_tpu.train.loop import restore_target_state
 from pertgnn_tpu.train.predict import (make_predict_step, predict_split,
                                        predict_split_served)
@@ -62,6 +63,24 @@ def _check_train_config(p, ckpt, cfg, allow_mismatch: bool) -> None:
     for key in unknown:
         log.warning("sidecar predates config field %s — cannot verify it "
                     "matches training", key)
+    # Split-layout drift is a WARNING, not a wall: max_traces / split
+    # change WHICH traces land in which positional split, so rows tagged
+    # "test" here may have been training rows — per-trace predictions
+    # stay valid, but any held-out-metric claim over them does not.
+    saved_data = saved.get("data") or {}
+    for field, ours_val in (("max_traces", cfg.data.max_traces),
+                            ("split", list(cfg.data.split))):
+        if field in saved_data:
+            theirs = saved_data[field]
+            theirs_n = list(theirs) if isinstance(theirs, (list, tuple)) \
+                else theirs
+            if theirs_n != ours_val:
+                log.warning(
+                    "data.%s differs from the training run (trained=%r "
+                    "vs now=%r): the positional splits no longer match "
+                    "— split labels in the output CSV are NOT the "
+                    "training run's held-out sets", field, theirs,
+                    ours_val)
     if mism:
         detail = "; ".join(f"{k}: trained={a!r} vs now={b!r}"
                            for k, a, b in mism)
@@ -83,6 +102,7 @@ def main(argv=None) -> None:
     add_model_train_flags(p)
     add_serve_flags(p)
     add_telemetry_flags(p)
+    add_aot_flags(p)
     p.add_argument("--split", default="test",
                    choices=(*_SPLITS, "all"),
                    help="which positional split(s) to predict")
@@ -99,6 +119,7 @@ def main(argv=None) -> None:
                 "trained checkpoint (run train_main with --checkpoint_dir "
                 "first)")
     bus = setup_telemetry(args, "predict_main")
+    setup_compile_cache(args)
     cfg = config_from_args(args)
 
     # fail in seconds on a missing/typo'd checkpoint dir, BEFORE minutes

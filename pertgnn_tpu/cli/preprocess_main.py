@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import argparse
 
-from pertgnn_tpu.cli.common import (add_ingest_flags, add_telemetry_flags,
-                                    get_frames, setup_telemetry)
+from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
+                                    add_telemetry_flags, get_frames,
+                                    setup_compile_cache, setup_telemetry)
 from pertgnn_tpu.config import IngestConfig
 from pertgnn_tpu.ingest.io import artifacts_present, preprocess_cached
 from pertgnn_tpu.utils.logging import setup_logging
@@ -23,8 +24,12 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     add_ingest_flags(p)
     add_telemetry_flags(p)
+    add_aot_flags(p)
     args = p.parse_args(argv)
     bus = setup_telemetry(args, "preprocess_main")
+    # ingest itself never compiles, but a shared --compile_cache_dir in a
+    # pipeline script must not be a parse error on this CLI
+    setup_compile_cache(args)
     cfg = IngestConfig(min_traces_per_entry=args.min_traces_per_entry,
                        min_resource_coverage=args.min_resource_coverage)
     if artifacts_present(args.artifact_dir):

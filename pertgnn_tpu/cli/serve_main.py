@@ -20,6 +20,11 @@ the same schema family as benchmarks/serve_bench.py).
 This is the long-lived process the ROADMAP's request-serving north star
 needs; an RPC front-end would wrap `MicrobatchQueue.submit` — the queue,
 not the transport, is the engineered part.
+
+Cold start: with `--compile_cache_dir` the warmed ladder executables
+persist across process starts (warmup deserializes instead of
+compiling), and `--precompile_only` populates that cache ahead of time
+— without needing a checkpoint (docs/GUIDE.md §8).
 """
 
 from __future__ import annotations
@@ -31,11 +36,12 @@ import time
 import numpy as np
 
 from pertgnn_tpu.batching import build_dataset
-from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
-                                    add_serve_flags, add_telemetry_flags,
-                                    apply_platform_env, config_from_args,
+from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
+                                    add_model_train_flags, add_serve_flags,
+                                    add_telemetry_flags, apply_platform_env,
+                                    config_from_args,
                                     load_or_ingest_artifacts,
-                                    setup_telemetry)
+                                    setup_compile_cache, setup_telemetry)
 from pertgnn_tpu.train.loop import restore_target_state
 from pertgnn_tpu.utils.logging import setup_logging
 from pertgnn_tpu.utils.profiling import LatencyRecorder
@@ -76,6 +82,7 @@ def main(argv=None) -> None:
     add_model_train_flags(p)
     add_serve_flags(p)
     add_telemetry_flags(p)
+    add_aot_flags(p)
     p.add_argument("--requests", default="",
                    help="CSV of requests (entry_id, ts_bucket columns); "
                         "default: replay --from_split")
@@ -90,33 +97,69 @@ def main(argv=None) -> None:
                         "queue")
     p.add_argument("--out", default="served.csv",
                    help="per-request prediction CSV path")
+    p.add_argument("--precompile_only", action="store_true",
+                   help="populate the compile cache (--compile_cache_dir) "
+                        "with every ladder-rung executable and exit "
+                        "WITHOUT serving — the host-side stage that makes "
+                        "the next serve process's warmup execute-only. "
+                        "Works without a checkpoint (executables depend "
+                        "on shapes, not weights); docs/GUIDE.md "
+                        "'Precompile workflow'")
     args = p.parse_args(argv)
-    if not args.checkpoint_dir:
+    if not args.checkpoint_dir and not args.precompile_only:
         p.error("--checkpoint_dir is required: serving answers from a "
                 "trained checkpoint (run train_main with --checkpoint_dir "
                 "first)")
+    if args.precompile_only and not args.compile_cache_dir:
+        p.error("--precompile_only without --compile_cache_dir would "
+                "compile into this process and throw the result away")
     bus = setup_telemetry(args, "serve_main")
+    setup_compile_cache(args)
     cfg = config_from_args(args)
 
-    from pertgnn_tpu.cli.predict_main import _check_train_config
-    from pertgnn_tpu.train.checkpoint import CheckpointManager
-    ckpt = CheckpointManager(args.checkpoint_dir, keep=args.checkpoint_keep)
-    if ckpt.latest_step() is None:
-        p.error(f"no checkpoint steps in {args.checkpoint_dir!r}")
-    _check_train_config(p, ckpt, cfg, args.allow_config_mismatch)
+    ckpt = None
+    if args.checkpoint_dir:
+        from pertgnn_tpu.cli.predict_main import _check_train_config
+        from pertgnn_tpu.train.checkpoint import CheckpointManager
+        ckpt = CheckpointManager(args.checkpoint_dir,
+                                 keep=args.checkpoint_keep)
+        if ckpt.latest_step() is None:
+            p.error(f"no checkpoint steps in {args.checkpoint_dir!r}")
+        _check_train_config(p, ckpt, cfg, args.allow_config_mismatch)
 
     pre, table = load_or_ingest_artifacts(args, cfg.ingest)
     dataset = build_dataset(pre, cfg, table)
     _model, state = restore_target_state(dataset, cfg)
-    state, start_epoch = ckpt.maybe_restore(state)
-    if start_epoch == 0:
-        p.error(f"no checkpoint found in {args.checkpoint_dir}")
+    start_epoch = 0
+    if ckpt is not None:
+        state, start_epoch = ckpt.maybe_restore(state)
+        if start_epoch == 0:
+            p.error(f"no checkpoint found in {args.checkpoint_dir}")
+
+    from pertgnn_tpu.serve.engine import InferenceEngine
+
+    if args.precompile_only:
+        from pertgnn_tpu import telemetry
+        with telemetry.watch_xla_cache() as cache:
+            engine = InferenceEngine.from_dataset(dataset, cfg,
+                                                  state).warmup()
+        print(json.dumps({
+            "precompile_only": True,
+            "buckets": len(engine.ladder),
+            "compiles": engine.compiles,
+            "deserialized": engine.deserialized,
+            "warmup_s": engine.warmup_s,
+            "xla_cache_hits": cache["hits"],
+            "xla_cache_misses": cache["misses"],
+            "compile_cache_dir": args.compile_cache_dir,
+        }))
+        bus.flush()
+        return
 
     entries, buckets = _load_requests(args, dataset)
     if len(entries) == 0:
         raise SystemExit("no requests to serve")
 
-    from pertgnn_tpu.serve.engine import InferenceEngine
     from pertgnn_tpu.serve.queue import MicrobatchQueue
     engine = InferenceEngine.from_dataset(dataset, cfg, state)
     if cfg.serve.warmup:

@@ -17,11 +17,12 @@ import os
 import sys
 
 from pertgnn_tpu.batching import build_dataset
-from pertgnn_tpu.cli.common import (add_ingest_flags, add_model_train_flags,
+from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
+                                    add_model_train_flags,
                                     add_telemetry_flags, apply_platform_env,
                                     config_from_args,
                                     load_or_ingest_artifacts,
-                                    setup_telemetry)
+                                    setup_compile_cache, setup_telemetry)
 from pertgnn_tpu.train import supervisor
 from pertgnn_tpu.train.loop import fit
 from pertgnn_tpu.utils.logging import setup_logging
@@ -51,6 +52,7 @@ def main(argv=None) -> None:
     add_ingest_flags(p)
     add_model_train_flags(p)
     add_telemetry_flags(p)
+    add_aot_flags(p)
     p.add_argument("--supervise", type=int, default=0, metavar="N",
                    help="run training under a crash/hang supervisor with "
                         "up to N automatic restart-and-resumes (requires "
@@ -82,6 +84,9 @@ def main(argv=None) -> None:
                    args.process_id)
     # after multihost init so the JSONL process-index stamp is real
     bus = setup_telemetry(args, "train_main")
+    # before anything compiles: first-step chunk programs should land in
+    # (or replay from) the persistent cache
+    setup_compile_cache(args)
     print(args)
     cfg = config_from_args(args)
 

@@ -230,6 +230,37 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CompileCacheConfig:
+    """Cold-start elimination knobs (pertgnn_tpu/aot/).
+
+    Every hot executable in this repo is resumable from disk: JAX's
+    persistent compilation cache replays train/eval chunk programs, and
+    the serve ladder's per-rung executables are serialized with a
+    content-hash key (aot/store.py). A process that compiled yesterday
+    makes today's first step execute-only — the mechanism that turns a
+    sub-minute TPU window from useless (wedged inside first-step
+    compilation) into sufficient (docs/GUIDE.md "Precompile workflow")."""
+
+    # Root directory for persisted compilation artifacts: `xla/` holds
+    # JAX's persistent compilation cache (every jit compile, keyed by
+    # XLA over the HLO + backend), `exe/` the serialized serve-rung
+    # executables. Empty = disabled (every process cold-starts).
+    cache_dir: str = ""
+    # Only persist XLA cache entries whose compile took at least this
+    # long (seconds). 0 caches everything — right for this workload,
+    # whose many small programs are exactly what cold start re-pays.
+    min_compile_time_s: float = 0.0
+    # Serialize serve-ladder executables into `exe/` at warmup so a
+    # later process's warmup deserializes instead of compiling. Off =
+    # persistent XLA cache only.
+    serialize_executables: bool = True
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.cache_dir)
+
+
+@dataclasses.dataclass(frozen=True)
 class TelemetryConfig:
     """Unified telemetry bus knobs (pertgnn_tpu/telemetry/).
 
@@ -277,6 +308,7 @@ class Config:
     parallel: ParallelConfig = ParallelConfig()
     serve: ServeConfig = ServeConfig()
     telemetry: TelemetryConfig = TelemetryConfig()
+    aot: CompileCacheConfig = CompileCacheConfig()
     # span | pert (reference: pert_gnn.py:32).
     graph_type: str = "span"
 

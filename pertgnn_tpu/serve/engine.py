@@ -9,7 +9,12 @@ engine removes compilation from the request path entirely:
 
 1. at construction it derives a bucket ladder from the dataset's training
    budget (serve/buckets.py) and AOT-compiles ONE executable per rung via
-   ``jax.jit(...).lower(...).compile()`` (warmup);
+   ``jax.jit(...).lower(...).compile()`` (warmup). With a
+   CompileCacheConfig cache dir, each rung executable is persisted by
+   the AOT store (pertgnn_tpu/aot/) under a content-hash key — a later
+   process's warmup DESERIALIZES instead of compiling (zero fresh
+   compiles; ``deserialized`` counts them), and any config/jax/device
+   drift invalidates loudly and recompiles;
 2. per request (or coalesced microbatch — serve/queue.py) it packs the
    entry mixtures into the smallest fitting rung with the training
    packer's own invariants (batching/pack.py ``pack_single``: receiver-
@@ -101,8 +106,11 @@ class InferenceEngine:
 
     def __init__(self, model, state, cfg: Config,
                  mixtures: dict[int, Mixture], lookup: ResourceLookup,
-                 budget: BatchBudget, bus=None):
+                 budget: BatchBudget, bus=None, store=None):
         self._cfg = cfg
+        # serialized-executable store (pertgnn_tpu/aot/); None = every
+        # process compiles its own ladder
+        self._store = store
         # injected telemetry bus; None = resolve the process-wide bus
         # LAZILY per emission (self._bus property) — an engine built
         # before telemetry.configure() must not freeze the NoopBus
@@ -138,18 +146,62 @@ class InferenceEngine:
         self.cache_hits = 0
         self.cache_misses = 0
         self.compiles = 0
+        # rung executables deserialized from the AOT store instead of
+        # freshly compiled (cross-process cold-start elimination)
+        self.deserialized = 0
 
     @classmethod
-    def from_dataset(cls, dataset, cfg: Config, state,
-                     bus=None) -> "InferenceEngine":
+    def from_dataset(cls, dataset, cfg: Config, state, bus=None,
+                     store=None) -> "InferenceEngine":
         model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
                            dataset.num_interfaces, dataset.num_rpctypes)
+        if store is None and cfg.aot.enabled:
+            from pertgnn_tpu import aot
+            store = aot.store_from_config(cfg, bus=bus)
         return cls(model, state, cfg, dataset.mixtures, dataset.lookup,
-                   dataset.budget, bus=bus)
+                   dataset.budget, bus=bus, store=store)
 
     # -- executable cache ------------------------------------------------
 
+    def _rung_entry(self, idx: int):
+        """(name, key, components, abstract_args) addressing rung `idx`
+        in the AOT store. The name is the rung's shape (the logical
+        slot); the key hashes everything the compiled program is welded
+        to — so e.g. a hidden_channels or jax upgrade lands in the SAME
+        slot with a DIFFERENT key, which is exactly the shape of miss
+        the store diagnoses loudly (aot/store.py)."""
+        from pertgnn_tpu import aot
+
+        b = self.ladder[idx]
+        abstract_args = (
+            jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                         self._variables),
+            abstract_batch(b, self._n_feat))
+        cfg = self._cfg
+        key, components = aot.cache_key(
+            fn_id="serve.engine.step.v1",
+            config={"model": cfg.model, "serve": cfg.serve,
+                    "label_scale": cfg.train.label_scale,
+                    "graph_type": cfg.graph_type},
+            args_sig=aot.abstract_signature(abstract_args))
+        name = f"serve_rung_g{b.max_graphs}_n{b.max_nodes}_e{b.max_edges}"
+        return name, key, components, abstract_args
+
     def _compile(self, idx: int) -> object:
+        if self._store is not None:
+            name, key, components, abstract_args = self._rung_entry(idx)
+            with self._bus.span("serve.compile", bucket=idx):
+                exe, outcome = self._store.load_or_build(
+                    name, key, components, jax.jit(self._step),
+                    abstract_args)
+            self._exe[idx] = exe
+            if outcome == "deserialized":
+                self.deserialized += 1
+                self._bus.counter("serve.deserialized", bucket=idx)
+            else:
+                self.compiles += 1
+                self._bus.counter("serve.compiles", bucket=idx)
+            return exe
         with self._bus.span("serve.compile", bucket=idx):
             exe = jax.jit(self._step).lower(
                 self._variables,
@@ -169,8 +221,10 @@ class InferenceEngine:
                     self._compile(i)
         self.warmup_s = time.perf_counter() - t0
         self._warmed = True
-        log.info("serve warmup: %d bucket executables in %.2fs (ladder %s)",
-                 len(self.ladder), self.warmup_s,
+        log.info("serve warmup: %d bucket executables in %.2fs "
+                 "(%d compiled, %d deserialized; ladder %s)",
+                 len(self.ladder), self.warmup_s, self.compiles,
+                 self.deserialized,
                  [(b.max_nodes, b.max_edges) for b in self.ladder])
         return self
 
@@ -318,6 +372,7 @@ class InferenceEngine:
             "cache_hits": self.cache_hits,
             "cache_misses": self.cache_misses,
             "compiles": self.compiles,
+            "deserialized": self.deserialized,
             "warmup_s": self.warmup_s,
             "pad_waste_ratio": self.pad_waste_ratio(),
             "latency": self.latency.summary_dict(),
@@ -344,6 +399,7 @@ class InferenceEngine:
         bus.gauge("serve.batches", self.batches)
         bus.gauge("serve.cache_hits_total", self.cache_hits)
         bus.gauge("serve.cache_misses_total", self.cache_misses)
+        bus.gauge("serve.deserialized_total", self.deserialized)
         bus.gauge("serve.pad_waste_ratio", stats["pad_waste_ratio"])
         for i, b in enumerate(stats["buckets"]):
             if b["dispatches"]:

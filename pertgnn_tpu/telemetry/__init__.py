@@ -37,7 +37,8 @@ from __future__ import annotations
 
 from pertgnn_tpu.telemetry.bus import (NOOP_BUS, NULL_SPAN, NoopBus,
                                        TelemetryBus, parse_level)
-from pertgnn_tpu.telemetry.jaxmon import install_jax_monitoring
+from pertgnn_tpu.telemetry.jaxmon import (install_jax_monitoring,
+                                          watch_xla_cache)
 from pertgnn_tpu.telemetry.schema import (SCHEMA_VERSION, SchemaError,
                                           iter_events, load_events,
                                           validate_event)
@@ -47,8 +48,8 @@ __all__ = [
     "NOOP_BUS", "NULL_SPAN", "NoopBus", "TelemetryBus", "MetricsWriter",
     "SCHEMA_VERSION", "SchemaError", "validate_event", "iter_events",
     "load_events", "parse_level", "install_jax_monitoring",
-    "configure", "configure_from_config", "get_bus", "set_bus", "span",
-    "shutdown",
+    "watch_xla_cache", "configure", "configure_from_config", "get_bus",
+    "set_bus", "span", "shutdown",
 ]
 
 _bus: NoopBus = NOOP_BUS

@@ -20,9 +20,54 @@ bus (listener registries are process-global)."""
 
 from __future__ import annotations
 
+import contextlib
 import logging
 
 log = logging.getLogger(__name__)
+
+# jax.monitoring event keys announcing persistent-compilation-cache
+# behavior (jax/_src/compilation_cache.py) — the ground truth for
+# "did this process actually compile, or replay from disk?".
+XLA_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+XLA_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+@contextlib.contextmanager
+def watch_xla_cache():
+    """Count XLA persistent-compilation-cache hits/misses inside a
+    ``with`` block: yields a dict whose ``hits``/``misses`` are live.
+
+    This is how the AOT layer (pertgnn_tpu/aot/) distinguishes a fresh
+    XLA compile from a disk replay — jit's API looks identical either
+    way. Only meaningful when the persistent cache is enabled
+    (aot.enable_compile_cache); with it off, neither event ever fires
+    and both counts stay 0."""
+    import jax.monitoring as mon
+
+    counts = {"hits": 0, "misses": 0}
+    alive = {"on": True}
+
+    def on_event(event, **kw):
+        if not alive["on"]:
+            return
+        if event == XLA_CACHE_HIT_EVENT:
+            counts["hits"] += 1
+        elif event == XLA_CACHE_MISS_EVENT:
+            counts["misses"] += 1
+
+    mon.register_event_listener(on_event)
+    try:
+        yield counts
+    finally:
+        alive["on"] = False
+        try:
+            from jax._src import monitoring as _m
+            _m._unregister_event_listener_by_callback(on_event)
+        except Exception:
+            # same story as uninstall() below: the dead-switch already
+            # guarantees the counts stop moving
+            log.debug("could not unregister xla cache watcher; listener "
+                      "left registered but disabled")
 
 
 def install_jax_monitoring(bus):

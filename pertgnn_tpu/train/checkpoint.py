@@ -135,24 +135,42 @@ _OUTPUT_IRRELEVANT_MODEL_FIELDS = frozenset(
     {"dropout", "attn_dropout", "init_scheme"})
 
 
+# Ingest fields that change model INPUTS (and therefore outputs) given
+# the same restored weights: the time-bucket keying of resource lookups,
+# which aggregations become the 8 numeric features, and which traces
+# survive the coverage filter (feature-table contents). The other ingest
+# knobs (occurrence threshold, tie-break token) reshape WHICH entries
+# exist, which the dataset build surfaces as its own shape errors.
+_OUTPUT_RELEVANT_INGEST_FIELDS = (
+    "ts_bucket_ms", "resource_aggs", "min_resource_coverage")
+
+
 def config_mismatches(saved: dict, cfg) -> tuple[list, list]:
     """Compare a sidecar dict against the live Config on the semantics a
-    checkpoint restore is blind to: graph_type, label_scale, and every
-    output-relevant model field. Returns (mismatches [(key, saved,
-    ours)], unknown [key]) — `unknown` are fields the sidecar predates
-    (a newer code version): callers should warn, not wall, or every old
-    checkpoint bricks the moment a ModelConfig field is added."""
+    checkpoint restore is blind to: graph_type, label_scale, every
+    output-relevant model field, and the output-relevant ingest fields
+    (ts_bucket_ms / resource_aggs / min_resource_coverage — these shape
+    the feature values fed to the restored weights). Returns
+    (mismatches [(key, saved, ours)], unknown [key]) — `unknown` are
+    fields the sidecar predates (a newer code version): callers should
+    warn, not wall, or every old checkpoint bricks the moment a config
+    field is added."""
     import dataclasses
 
     ours = dataclasses.asdict(cfg)
     mism: list = []
     unknown: list = []
 
+    def norm(v):
+        # sequences round-trip through the JSON sidecar as lists; the
+        # live Config holds tuples (e.g. resource_aggs) — compare values
+        return list(v) if isinstance(v, (list, tuple)) else v
+
     def probe(key, container, our_val):
         leaf = key.rsplit(".", 1)[-1]
         if leaf not in container:
             unknown.append(key)
-        elif container[leaf] != our_val:
+        elif norm(container[leaf]) != norm(our_val):
             mism.append((key, container[leaf], our_val))
 
     probe("graph_type", saved, ours["graph_type"])
@@ -162,4 +180,7 @@ def config_mismatches(saved: dict, cfg) -> tuple[list, list]:
     for k, v in ours["model"].items():
         if k not in _OUTPUT_IRRELEVANT_MODEL_FIELDS:
             probe(f"model.{k}", saved_model, v)
+    saved_ingest = saved.get("ingest") or {}
+    for k in _OUTPUT_RELEVANT_INGEST_FIELDS:
+        probe(f"ingest.{k}", saved_ingest, ours["ingest"][k])
     return mism, unknown

@@ -16,6 +16,7 @@ parity.
 
 from __future__ import annotations
 
+import functools
 import logging
 import time
 from typing import Any, Callable, Iterator
@@ -47,10 +48,33 @@ class TrainState:
     step: jnp.ndarray
 
 
+@functools.lru_cache(maxsize=8)
+def _jitted_model_init(model: PertGNN):
+    """model.init fused into ONE jitted program (keyed on the model,
+    which hashes by its config dataclass fields — equal configs share
+    the compiled program across fit() calls). Eager flax init dispatches
+    ~100 tiny programs; fused it is a single compile — and with the
+    persistent compilation cache on, a single DISK REPLAY in every later
+    process, the first chunk of fit()'s cold-start cost."""
+    return jax.jit(
+        lambda rng, sample: model.init(rng, sample, training=False))
+
+
 def create_train_state(model: PertGNN, tx: optax.GradientTransformation,
-                       sample: PackedBatch, seed: int = 0) -> TrainState:
-    variables = model.init(jax.random.PRNGKey(seed),
-                           jax.tree.map(jnp.asarray, sample), training=False)
+                       sample: PackedBatch, seed: int = 0, *,
+                       jit_init: bool = False) -> TrainState:
+    sample = jax.tree.map(jnp.asarray, sample)
+    init = None
+    if jit_init:
+        try:
+            init = _jitted_model_init(model)
+        except TypeError:
+            # unhashable module (e.g. a live mesh baked into an
+            # edge-shard model) — the eager path always works
+            log.info("model not hashable; using eager (unjitted) init")
+    if init is None:
+        init = functools.partial(model.init, training=False)
+    variables = init(jax.random.PRNGKey(seed), sample)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     return TrainState(params=params, batch_stats=batch_stats,
@@ -434,7 +458,7 @@ def restore_target_state(dataset: Dataset, cfg: Config
     model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
                        dataset.num_interfaces, dataset.num_rpctypes)
     state = create_train_state(model, make_tx(cfg), _train_sample(dataset),
-                               cfg.train.seed)
+                               cfg.train.seed, jit_init=cfg.aot.enabled)
     return model, state
 
 
@@ -462,6 +486,187 @@ def _resolve_device_materialize(dataset: Dataset, cfg: Config) -> bool:
     return True
 
 
+def _abstract_tree(tree):
+    import numpy as np
+
+    def leaf(x):
+        if not hasattr(x, "shape") or not hasattr(x, "dtype"):
+            x = np.asarray(x)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    return jax.tree.map(leaf, tree)
+
+
+def _dataset_fingerprint(dataset: Dataset) -> str:
+    """Content hash of the arenas the compact programs bake in as
+    constants. A serialized compact executable replayed against a
+    DIFFERENT dataset would silently materialize yesterday's features —
+    this hash in the cache key turns that into a loud store miss."""
+    import hashlib
+
+    import numpy as np
+
+    import dataclasses
+
+    h = hashlib.sha256()
+    # MixtureArena / FeatureArena are plain frozen dataclasses of numpy
+    # arrays, NOT registered pytrees — walk their fields explicitly (a
+    # tree.flatten would treat each arena as one opaque leaf and hash
+    # object identity, which differs every process)
+    for arena in (dataset.arena(), dataset.feat_arena()):
+        for f in dataclasses.fields(arena):
+            a = np.ascontiguousarray(np.asarray(getattr(arena, f.name)))
+            h.update(f"{f.name}:{a.shape}:{a.dtype}".encode())
+            h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+def _train_eval_abstract(dataset: Dataset, cfg: Config, state: TrainState,
+                         compact: bool):
+    """The (state, batch) ShapeDtypeStruct signature of the train/eval
+    programs fit() will run (train and eval share it: same budget, same
+    chunking, tail chunks zero-pad to shape)."""
+    if compact:
+        batches = dataset.compact_batches("train")
+        filler = zero_masked_compact
+    else:
+        batches = dataset.batches("train")
+        filler = zero_masked
+    if cfg.train.scan_chunk > 1:
+        b = next(_host_chunks(batches, cfg.train.scan_chunk, filler))
+    else:
+        b = next(batches)
+    return _abstract_tree(state), _abstract_tree(b)
+
+
+# Arena bytes above this are not worth serializing into store entries
+# (the compact programs embed the arenas as constants; export/replay
+# cost scales with them). The persistent XLA cache still applies.
+_STORE_ARENA_LIMIT_BYTES = 256 * 2**20
+
+
+def _stored_train_eval(store, dataset: Dataset, cfg: Config,
+                       state: TrainState, train_jit: Callable,
+                       eval_jit: Callable, *, compact: bool
+                       ) -> tuple[Callable, Callable]:
+    """Resolve fit()'s train/eval programs through the AOT executable
+    store (pertgnn_tpu/aot/): a hit deserializes yesterday's executable
+    (zero fresh model traces/compiles), a miss compiles ONCE and
+    persists. Key = (env fingerprint, model+train config, graph_type,
+    dataset arena hash for compact programs, abstract signature)."""
+    from pertgnn_tpu import aot
+
+    abs_args = _train_eval_abstract(dataset, cfg, state, compact)
+    # only the TrainConfig fields BAKED INTO the program as constants:
+    # keying the whole dataclass would invalidate on epochs/log_every/
+    # checkpoint knobs that the compiled chunk never sees
+    config = {"model": cfg.model, "graph_type": cfg.graph_type,
+              "train": {k: getattr(cfg.train, k)
+                        for k in ("lr", "tau", "label_scale", "seed",
+                                  "scan_chunk")}}
+    if compact:
+        config["dataset_sha"] = _dataset_fingerprint(dataset)
+    kind = "compact" if compact else "packed"
+    suffix = "chunk" if cfg.train.scan_chunk > 1 else "step"
+    sig = aot.abstract_signature(abs_args)
+    out = []
+    for tag, jit_fn in (("train", train_jit), ("eval", eval_jit)):
+        name = f"{tag}_{suffix}_{kind}"
+        key, components = aot.cache_key(
+            fn_id=f"train.loop.{name}.v1", config=config, args_sig=sig)
+        exe, outcome = store.load_or_build(name, key, components, jit_fn,
+                                           abs_args)
+        log.info("AOT %s program: %s", name, outcome)
+        out.append(exe)
+    return out[0], out[1]
+
+
+def _stored_init_state(store, cfg: Config, model: PertGNN,
+                       tx: optax.GradientTransformation,
+                       sample: PackedBatch) -> TrainState | None:
+    """TrainState whose model init ran through the executable store —
+    warm processes deserialize the init program instead of re-tracing
+    the model. None when the model can't take the jitted path."""
+    from pertgnn_tpu import aot
+
+    try:
+        init_jit = _jitted_model_init(model)
+    except TypeError:
+        return None
+    sample_dev = jax.tree.map(jnp.asarray, sample)
+    rng = jax.random.PRNGKey(cfg.train.seed)
+    abs_args = (_abstract_tree(rng), _abstract_tree(sample_dev))
+    key, components = aot.cache_key(
+        fn_id="train.loop.model_init.v1",
+        config={"model": cfg.model, "graph_type": cfg.graph_type},
+        args_sig=aot.abstract_signature(abs_args))
+    exe, outcome = store.load_or_build("model_init", key, components,
+                                       init_jit, abs_args)
+    log.info("AOT model_init program: %s", outcome)
+    variables = exe(rng, sample_dev)
+    params = variables["params"]
+    return TrainState(params=params,
+                      batch_stats=variables.get("batch_stats", {}),
+                      opt_state=tx.init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def build_single_device_programs(dataset: Dataset, cfg: Config, *,
+                                 model: PertGNN,
+                                 tx: optax.GradientTransformation,
+                                 sample: PackedBatch,
+                                 device_materialize: bool, bus=None
+                                 ) -> tuple[TrainState, Callable, Callable]:
+    """(state, train_step, eval_step) exactly as single-process fit()
+    runs them — THE shared construction for fit() and the host-side
+    precompile stage (aot/precompile.py), so the programs the precompile
+    persists are the programs fit() replays, by code identity rather
+    than by parallel maintenance. With CompileCacheConfig.cache_dir set,
+    init is one fused jitted program and init + train/eval programs
+    resolve through the serialized-executable store."""
+    store = None
+    if cfg.aot.enabled and cfg.aot.serialize_executables:
+        if device_materialize and arena_nbytes(
+                dataset.arena(),
+                dataset.feat_arena()) > _STORE_ARENA_LIMIT_BYTES:
+            log.info("arenas exceed the executable-store size guard "
+                     "(%d MiB) — compact programs rely on the "
+                     "persistent XLA cache only",
+                     _STORE_ARENA_LIMIT_BYTES // 2**20)
+        else:
+            from pertgnn_tpu import aot
+            store = aot.store_from_config(cfg, bus=bus)
+    state = None
+    if store is not None:
+        state = _stored_init_state(store, cfg, model, tx, sample)
+    if state is None:
+        state = create_train_state(model, tx, sample, cfg.train.seed,
+                                   jit_init=cfg.aot.enabled)
+    chunked = cfg.train.scan_chunk > 1
+    if device_materialize:
+        dev = dataset.device_arenas()
+        mn, me = dataset.budget.max_nodes, dataset.budget.max_edges
+        if chunked:
+            train_step = make_train_chunk_compact(model, cfg, tx, dev,
+                                                  mn, me)
+            eval_step = make_eval_chunk_compact(model, cfg, dev, mn, me)
+        else:
+            train_step = make_train_step_compact(model, cfg, tx, dev,
+                                                 mn, me)
+            eval_step = make_eval_step_compact(model, cfg, dev, mn, me)
+    elif chunked:
+        train_step = make_train_chunk(model, cfg, tx)
+        eval_step = make_eval_chunk(model, cfg)
+    else:
+        train_step = make_train_step(model, cfg, tx)
+        eval_step = make_eval_step(model, cfg)
+    if store is not None:
+        train_step, eval_step = _stored_train_eval(
+            store, dataset, cfg, state, train_step, eval_step,
+            compact=device_materialize)
+    return state, train_step, eval_step
+
+
 def fit(dataset: Dataset, cfg: Config,
         epochs: int | None = None,
         checkpoint_manager=None,
@@ -478,6 +683,14 @@ def fit(dataset: Dataset, cfg: Config,
     replicated over the mesh and each SPMD program gathers its global batch
     from HBM, fed only the sharded int32 gather recipes.
 
+    The first history row carries ``ttfs_s`` — wall time from fit()
+    entry to the first completed train step (model build + state init +
+    first batch + first-chunk compile & execute: THE cold-start metric;
+    also emitted as the ``train.time_to_first_step_s`` gauge). With a
+    persistent compile cache (CompileCacheConfig + a precompile pass)
+    the compile component is a disk replay — benchmarks/
+    coldstart_bench.py measures the delta.
+
     `bus` is an injected telemetry bus (default: the process-wide bus,
     a no-op unless a CLI configured one). Per epoch it receives the
     host/device wall-time split (train.epoch_host_s / train.epoch_device_s
@@ -489,6 +702,7 @@ def fit(dataset: Dataset, cfg: Config,
     the global-bus call sites underneath (the packer's pad-waste gauges,
     staging spans, checkpoint spans) reach it too; an explicitly
     configured global bus is never displaced."""
+    t_fit0 = time.perf_counter()
     edge_shard = mesh is not None and cfg.parallel.shard_edges
     model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
                        dataset.num_interfaces, dataset.num_rpctypes,
@@ -641,60 +855,47 @@ def fit(dataset: Dataset, cfg: Config,
                 if chunked:
                     glob = _host_chunks(glob, cfg.train.scan_chunk)
                 return to_device(glob, sh)
-    elif device_materialize:
-        # Chip-resident arenas + O(graphs) CompactBatch feeding: the host
-        # ships only per-graph (entry, feat_start, y, mask) rows; the
-        # device expands them to gather indices (cumsum + searchsorted)
-        # and materializes the batch out of HBM. Per-epoch host work is
-        # the greedy assignment + G-sized scatters (batching/arena.py).
-        dev = dataset.device_arenas()  # shared, built once per dataset
-        state = create_train_state(model, tx, sample, cfg.train.seed)
-        max_nodes = dataset.budget.max_nodes
-        max_edges = dataset.budget.max_edges
-        if cfg.train.scan_chunk > 1:
-            train_step = make_train_chunk_compact(model, cfg, tx, dev,
-                                                  max_nodes, max_edges)
-            eval_step = make_eval_chunk_compact(model, cfg, dev,
-                                                max_nodes, max_edges)
-        else:
-            train_step = make_train_step_compact(model, cfg, tx, dev,
-                                                 max_nodes, max_edges)
-            eval_step = make_eval_step_compact(model, cfg, dev,
-                                               max_nodes, max_edges)
-
-        def batch_stream(split, shuffle=False, seed=0):
-            cbs = dataset.compact_batches(split, shuffle=shuffle, seed=seed)
-            if cfg.train.scan_chunk > 1:
-                cbs = _host_chunks(cbs, cfg.train.scan_chunk,
-                                   zero_masked_compact)
-            if cfg.train.stage_epoch_recipes:
-                # one H2D per field per EPOCH (recipes are O(graphs)
-                # int32s); host packing is a few ms so no background
-                # thread is needed ahead of the single transfer
-                return _staged_epoch_iter(
-                    cbs,
-                    max_bytes=int(cfg.train.stage_recipes_max_mb * 2**20))
-            if shuffle:  # train: pack off the critical path
-                cbs = _background(cbs)
-            return _device_iter(cbs)
-    elif cfg.train.scan_chunk > 1:
-        # scan-fused stepping: one dispatch per `scan_chunk` steps
-        state = create_train_state(model, tx, sample, cfg.train.seed)
-        train_step = make_train_chunk(model, cfg, tx)
-        eval_step = make_eval_chunk(model, cfg)
-
-        def batch_stream(split, shuffle=False, seed=0):
-            return _chunk_iter(dataset.batches(split, shuffle=shuffle,
-                                               seed=seed),
-                               cfg.train.scan_chunk)
     else:
-        state = create_train_state(model, tx, sample, cfg.train.seed)
-        train_step = make_train_step(model, cfg, tx)
-        eval_step = make_eval_step(model, cfg)
-
-        def batch_stream(split, shuffle=False, seed=0):
-            return _device_iter(dataset.batches(split, shuffle=shuffle,
-                                                seed=seed))
+        # single-device paths: program construction (incl. the AOT
+        # executable store / fused init when a compile cache is
+        # configured) is shared with the precompile stage
+        state, train_step, eval_step = build_single_device_programs(
+            dataset, cfg, model=model, tx=tx, sample=sample,
+            device_materialize=device_materialize, bus=bus)
+        if device_materialize:
+            # Chip-resident arenas + O(graphs) CompactBatch feeding: the
+            # host ships only per-graph (entry, feat_start, y, mask)
+            # rows; the device expands them to gather indices (cumsum +
+            # searchsorted) and materializes the batch out of HBM.
+            # Per-epoch host work is the greedy assignment + G-sized
+            # scatters (batching/arena.py).
+            def batch_stream(split, shuffle=False, seed=0):
+                cbs = dataset.compact_batches(split, shuffle=shuffle,
+                                              seed=seed)
+                if cfg.train.scan_chunk > 1:
+                    cbs = _host_chunks(cbs, cfg.train.scan_chunk,
+                                       zero_masked_compact)
+                if cfg.train.stage_epoch_recipes:
+                    # one H2D per field per EPOCH (recipes are O(graphs)
+                    # int32s); host packing is a few ms so no background
+                    # thread is needed ahead of the single transfer
+                    return _staged_epoch_iter(
+                        cbs,
+                        max_bytes=int(cfg.train.stage_recipes_max_mb
+                                      * 2**20))
+                if shuffle:  # train: pack off the critical path
+                    cbs = _background(cbs)
+                return _device_iter(cbs)
+        elif cfg.train.scan_chunk > 1:
+            # scan-fused stepping: one dispatch per `scan_chunk` steps
+            def batch_stream(split, shuffle=False, seed=0):
+                return _chunk_iter(dataset.batches(split, shuffle=shuffle,
+                                                   seed=seed),
+                                   cfg.train.scan_chunk)
+        else:
+            def batch_stream(split, shuffle=False, seed=0):
+                return _device_iter(dataset.batches(split, shuffle=shuffle,
+                                                    seed=seed))
 
     if device_materialize and mesh is None:
         # Deterministic eval splits are identical every epoch; on the
@@ -727,14 +928,15 @@ def fit(dataset: Dataset, cfg: Config,
     try:
         return _fit_epochs(dataset, cfg, epochs, checkpoint_manager,
                            profile_hook, state, train_step, eval_step,
-                           batch_stream, bus)
+                           batch_stream, bus, t_fit0)
     finally:
         if restore_bus is not None:
             telemetry.set_bus(restore_bus)
 
 
 def _fit_epochs(dataset, cfg, epochs, checkpoint_manager, profile_hook,
-                state, train_step, eval_step, batch_stream, bus
+                state, train_step, eval_step, batch_stream, bus,
+                t_start: float | None = None
                 ) -> tuple[TrainState, list[dict]]:
     """fit()'s epoch driver, split out so the injected-bus scoping wraps
     it in one try/finally."""
@@ -742,6 +944,7 @@ def _fit_epochs(dataset, cfg, epochs, checkpoint_manager, profile_hook,
     if checkpoint_manager is not None:
         state, start_epoch = checkpoint_manager.maybe_restore(state)
 
+    ttfs_s: float | None = None
     history: list[dict] = []
     epochs = cfg.train.epochs if epochs is None else epochs
     _END = object()
@@ -766,6 +969,16 @@ def _fit_epochs(dataset, cfg, epochs, checkpoint_manager, profile_hook,
             with bus.span("train.chunk", level=2, epoch=epoch, step=steps):
                 state, m = train_step(state, batch)
                 sums = m if sums is None else jax.tree.map(jnp.add, sums, m)
+            if ttfs_s is None and t_start is not None:
+                # time-to-first-step: everything between fit() entry and
+                # the first step's results being real — model build,
+                # state init, first batch, first-chunk compile (a disk
+                # replay when the persistent compile cache is warm) and
+                # execution. The one extra sync is first-step-only.
+                jax.block_until_ready(m)
+                ttfs_s = time.perf_counter() - t_start
+                bus.gauge("train.time_to_first_step_s", ttfs_s)
+                log.info("time to first train step: %.2fs", ttfs_s)
             t_dev += time.perf_counter() - t1
             steps += 1
         t1 = time.perf_counter()
@@ -792,6 +1005,8 @@ def _fit_epochs(dataset, cfg, epochs, checkpoint_manager, profile_hook,
             "device_time_s": t_dev,
             "graphs_per_s": sums["count"] / max(train_time, 1e-9),
         }
+        if ttfs_s is not None and epoch == start_epoch:
+            row["ttfs_s"] = ttfs_s
         bus.gauge("train.epoch_host_s", t_host, epoch=epoch)
         bus.gauge("train.epoch_device_s", t_dev, epoch=epoch)
         bus.gauge("train.epoch_graphs_per_s", row["graphs_per_s"],

@@ -45,17 +45,31 @@ _PEAK_FLOPS_BY_KIND = (
 )
 
 
-def peak_flops_per_chip() -> float | None:
-    """Peak FLOPs/s of one local device, or None when unknown (CPU)."""
-    dev = jax.devices()[0]
-    kind = (getattr(dev, "device_kind", "") or "").lower()
-    if dev.platform != "tpu":
+def peak_flops_for_kind(kind: str | None) -> float | None:
+    """Peak FLOPs/s for a TPU device-kind STRING (e.g. \"TPU v5 lite\"),
+    or None when unknown/non-TPU. Takes the string rather than a live
+    device so capture-time kinds recorded in partial files can be
+    resolved later on a host whose backend differs (bench.py
+    --finalize-partial runs forced-CPU)."""
+    kind = (kind or "").lower()
+    if "tpu" not in kind:
         return None
     for key, peak in _PEAK_FLOPS_BY_KIND:
         if key in kind:
             return peak
     log.warning("unknown TPU device_kind %r — MFU unavailable", kind)
     return None
+
+
+def peak_flops_per_chip() -> float | None:
+    """Peak FLOPs/s of one local device, or None when unknown (CPU)."""
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return None
+    # platform says tpu but the kind string may not: pass a marker the
+    # kind-table's "tpu" gate accepts
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    return peak_flops_for_kind(kind if "tpu" in kind else f"tpu {kind}")
 
 
 # peak HBM bandwidth bytes/s per chip (public: cloud.google.com/tpu/docs).
@@ -73,17 +87,26 @@ _PEAK_HBM_BW_BY_KIND = (
 )
 
 
-def peak_hbm_bw_per_chip() -> float | None:
-    """Peak HBM bytes/s of one local device, or None when unknown (CPU)."""
-    dev = jax.devices()[0]
-    kind = (getattr(dev, "device_kind", "") or "").lower()
-    if dev.platform != "tpu":
+def peak_hbm_bw_for_kind(kind: str | None) -> float | None:
+    """Peak HBM bytes/s for a TPU device-kind STRING, or None when
+    unknown/non-TPU (same contract as peak_flops_for_kind)."""
+    kind = (kind or "").lower()
+    if "tpu" not in kind:
         return None
     for key, bw in _PEAK_HBM_BW_BY_KIND:
         if key in kind:
             return bw
     log.warning("unknown TPU device_kind %r — MBU unavailable", kind)
     return None
+
+
+def peak_hbm_bw_per_chip() -> float | None:
+    """Peak HBM bytes/s of one local device, or None when unknown (CPU)."""
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        return None
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    return peak_hbm_bw_for_kind(kind if "tpu" in kind else f"tpu {kind}")
 
 
 def compiled_cost(jitted, *args) -> tuple[float | None, float | None]:

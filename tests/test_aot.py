@@ -1,0 +1,496 @@
+"""Cold-start elimination (pertgnn_tpu/aot/): cache keying, the
+serialized-executable store, and the precompile stage.
+
+The load-bearing guarantees:
+- a SECOND engine/process over the same config performs ZERO fresh
+  compiles: every ladder rung deserializes from the store (asserted on
+  the engine counters AND the aot.* telemetry events);
+- deserialized executables answer bit-identically to freshly compiled
+  ones;
+- ANY drift in the key's ingredients (config, jax version, device kind,
+  signature) changes the key — replaying a stale executable is
+  impossible by construction, and the miss is diagnosed loudly;
+- a corrupt/truncated store entry falls back to a fresh compile with a
+  warning — never a crash.
+
+Tests that pay more than one ladder/program compile are marked `slow`
+(tier-1 runs `-m 'not slow'`; ROADMAP.md) so suite wall time does not
+regress — the in-budget tests share ONE warmed module-scoped engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from pertgnn_tpu import aot, telemetry
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import (CompileCacheConfig, Config, DataConfig,
+                                IngestConfig, ModelConfig, ServeConfig,
+                                TrainConfig)
+from pertgnn_tpu.serve.engine import InferenceEngine
+from pertgnn_tpu.train.loop import restore_target_state
+
+SERVE = ServeConfig(bucket_growth=4.0, min_bucket_nodes=128,
+                    min_bucket_edges=128, max_graphs_per_batch=4)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _reset_compile_cache():
+    """These tests flip the GLOBAL persistent-compile-cache config onto
+    module-temp dirs; restore the disabled default afterwards so the
+    rest of the suite doesn't write cache entries into dead paths."""
+    yield
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", None)
+
+
+def _cfg(cache_dir: str, hidden: int = 8) -> Config:
+    return Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=200, batch_size=16),
+        model=ModelConfig(hidden_channels=hidden, num_layers=1),
+        train=TrainConfig(label_scale=1000.0, scan_chunk=2),
+        serve=SERVE,
+        aot=CompileCacheConfig(cache_dir=cache_dir),
+        graph_type="pert",
+    )
+
+
+class _RecordingBus(telemetry.NoopBus):
+    """Collects (kind, name, tags) — enough to counter-assert aot.*."""
+
+    def __init__(self):
+        self.events: list[tuple[str, str, dict]] = []
+
+    def counter(self, name, value=1, *, level=1, **tags):
+        self.events.append(("counter", name, tags))
+
+    def histogram(self, name, value, *, level=1, **tags):
+        self.events.append(("histogram", name, tags))
+
+    def count(self, name: str) -> int:
+        return sum(1 for _, n, _t in self.events if n == name)
+
+
+@pytest.fixture(scope="module")
+def warmed(preprocessed, tmp_path_factory):
+    """(cache_root, dataset, cfg, state, engine A) — engine A compiled
+    the ladder once and persisted every rung; everything else in this
+    module reuses it (ONE ladder compile for the in-budget tests)."""
+    root = str(tmp_path_factory.mktemp("aot_store"))
+    cfg = _cfg(root)
+    ds = build_dataset(preprocessed, cfg)
+    _model, state = restore_target_state(ds, cfg)
+    bus = _RecordingBus()
+    engine = InferenceEngine.from_dataset(ds, cfg, state,
+                                          bus=bus).warmup()
+    return root, ds, cfg, state, engine, bus
+
+
+class TestKeys:
+    def test_key_is_deterministic(self):
+        env = {"jax": "1", "jaxlib": "1", "platform": "cpu",
+               "device_kind": "cpu", "num_devices": 1}
+        sig = {"leaves": ["(4,):float32"], "treedef": "*"}
+        k1, _ = aot.cache_key(fn_id="f.v1", config={"a": 1},
+                              args_sig=sig, env=env)
+        k2, _ = aot.cache_key(fn_id="f.v1", config={"a": 1},
+                              args_sig=sig, env=env)
+        assert k1 == k2
+
+    @pytest.mark.parametrize("mutate", [
+        lambda kw: kw["config"].update(a=2),
+        lambda kw: kw["env"].update(jax="2"),
+        lambda kw: kw["env"].update(device_kind="TPU v5 lite"),
+        lambda kw: kw["args_sig"].update(leaves=["(8,):float32"]),
+        lambda kw: kw.update(fn_id="f.v2"),
+    ])
+    def test_any_ingredient_changes_key(self, mutate):
+        kw = dict(fn_id="f.v1", config={"a": 1},
+                  args_sig={"leaves": ["(4,):float32"], "treedef": "*"},
+                  env={"jax": "1", "jaxlib": "1", "platform": "cpu",
+                       "device_kind": "cpu", "num_devices": 1})
+        base, _ = aot.cache_key(**kw)
+        mutate(kw)
+        changed, _ = aot.cache_key(fn_id=kw["fn_id"], config=kw["config"],
+                                   args_sig=kw["args_sig"], env=kw["env"])
+        assert changed != base
+
+    def test_config_dataclasses_hash_by_value(self):
+        sig = {"leaves": [], "treedef": "*"}
+        env = {"jax": "1"}
+        k1, _ = aot.cache_key(fn_id="f", config={"m": ModelConfig()},
+                              args_sig=sig, env=env)
+        k2, _ = aot.cache_key(fn_id="f", config={"m": ModelConfig()},
+                              args_sig=sig, env=env)
+        k3, _ = aot.cache_key(
+            fn_id="f", config={"m": ModelConfig(hidden_channels=64)},
+            args_sig=sig, env=env)
+        assert k1 == k2 != k3
+
+    def test_diff_components_names_the_change(self):
+        _, c1 = aot.cache_key(fn_id="f", config={"hidden": 8},
+                              args_sig={"leaves": [], "treedef": "*"},
+                              env={"jax": "1"})
+        _, c2 = aot.cache_key(fn_id="f", config={"hidden": 16},
+                              args_sig={"leaves": [], "treedef": "*"},
+                              env={"jax": "1"})
+        changed = aot.diff_components(c1, c2)
+        assert any("hidden" in c for c in changed)
+
+
+class TestStoreRoundTrip:
+    def test_first_engine_compiled_and_persisted(self, warmed):
+        root, _ds, cfg, _state, engine, bus = warmed
+        n = len(engine.ladder)
+        assert engine.compiles == n
+        assert engine.deserialized == 0
+        # every rung missed (absent) then persisted an entry
+        assert bus.count("aot.cache_miss") == n
+        exe_root = os.path.join(root, "exe")
+        slots = [d for d in os.listdir(exe_root)
+                 if d.startswith("serve_rung")]
+        assert len(slots) == n
+        for d in slots:
+            files = os.listdir(os.path.join(exe_root, d))
+            assert any(f.endswith(".bin") for f in files)
+            assert any(f.endswith(".json") for f in files)
+
+    def test_second_engine_zero_fresh_compiles(self, warmed):
+        """THE acceptance property: a fresh engine over the same config
+        warms up purely by deserialization — counter-asserted on the
+        engine, the aot.* bus events, and the XLA cache monitor."""
+        _root, ds, cfg, state, engine_a, _bus = warmed
+        bus = _RecordingBus()
+        with telemetry.watch_xla_cache() as cache:
+            engine_b = InferenceEngine.from_dataset(
+                ds, cfg, state, bus=bus).warmup()
+        n = len(engine_b.ladder)
+        assert engine_b.compiles == 0
+        assert engine_b.deserialized == n
+        assert bus.count("aot.cache_hit") == n
+        assert bus.count("aot.cache_miss") == 0
+        # stablehlo replays must be disk-cache hits, not fresh compiles
+        assert cache["misses"] == 0
+        assert engine_b.stats_dict()["deserialized"] == n
+
+    def test_deserialized_executable_matches_compiled(self, warmed):
+        _root, ds, cfg, state, engine_a, _bus = warmed
+        engine_b = InferenceEngine.from_dataset(ds, cfg, state).warmup()
+        s = ds.splits["test"]
+        a = engine_a.predict_many(s.entry_ids[:6], s.ts_buckets[:6])
+        b = engine_b.predict_many(s.entry_ids[:6], s.ts_buckets[:6])
+        np.testing.assert_array_equal(a, b)
+
+    def test_corrupt_entry_falls_back_to_fresh_compile(
+            self, warmed, tmp_path, caplog):
+        """Truncate one rung's payload: the next engine must log the
+        corruption, recompile JUST that rung, and overwrite the entry —
+        never crash."""
+        import logging
+        import shutil
+
+        root, ds, cfg, state, _engine, _bus = warmed
+        # work on a copy so the shared store stays intact
+        copy = tmp_path / "store_copy"
+        shutil.copytree(root, copy)
+        exe_root = copy / "exe"
+        slot = sorted(d for d in os.listdir(exe_root)
+                      if d.startswith("serve_rung"))[0]
+        [bin_path] = [exe_root / slot / f
+                      for f in os.listdir(exe_root / slot)
+                      if f.endswith(".bin")]
+        bin_path.write_bytes(bin_path.read_bytes()[:64])  # truncate
+
+        cfg2 = cfg.replace(aot=CompileCacheConfig(cache_dir=str(copy)))
+        with caplog.at_level(logging.WARNING, logger="pertgnn_tpu"):
+            engine = InferenceEngine.from_dataset(ds, cfg2,
+                                                  state).warmup()
+        n = len(engine.ladder)
+        assert engine.compiles == 1  # only the corrupted rung
+        assert engine.deserialized == n - 1
+        assert any("corrupt" in r.message.lower()
+                   for r in caplog.records)
+        # the fresh save overwrote the truncated entry: next load works
+        engine2 = InferenceEngine.from_dataset(ds, cfg2, state).warmup()
+        assert engine2.compiles == 0
+        assert engine2.deserialized == n
+
+    def test_store_version_mismatch_is_corrupt_not_crash(
+            self, warmed, tmp_path):
+        root, ds, cfg, state, _engine, _bus = warmed
+        store = aot.ExecutableStore(str(tmp_path / "vstore"))
+        name, key = "prog", "k" * 32
+        os.makedirs(os.path.join(store.root, name), exist_ok=True)
+        with open(os.path.join(store.root, name, f"{key}.bin"),
+                  "wb") as f:
+            pickle.dump({"store_version": 999, "format": "pjrt"}, f)
+        assert store.load(name, key, {}) is None
+
+
+@pytest.mark.slow
+class TestInvalidation:
+    def test_model_change_misses_loudly_and_recompiles(
+            self, preprocessed, tmp_path, caplog):
+        """Same slot name (rung shapes unchanged), different model →
+        different key → loud invalidation naming the changed field,
+        fresh compile. Compiles two ladders: slow."""
+        import logging
+
+        root = str(tmp_path / "store")
+        cfg8 = _cfg(root, hidden=8)
+        ds8 = build_dataset(preprocessed, cfg8)
+        _m, state8 = restore_target_state(ds8, cfg8)
+        e8 = InferenceEngine.from_dataset(ds8, cfg8, state8).warmup()
+        assert e8.compiles == len(e8.ladder)
+
+        cfg16 = _cfg(root, hidden=16)
+        ds16 = build_dataset(preprocessed, cfg16)
+        _m, state16 = restore_target_state(ds16, cfg16)
+        with caplog.at_level(logging.WARNING, logger="pertgnn_tpu"):
+            e16 = InferenceEngine.from_dataset(ds16, cfg16,
+                                               state16).warmup()
+        assert e16.deserialized == 0
+        assert e16.compiles == len(e16.ladder)
+        inval = [r.message for r in caplog.records
+                 if "invalidating" in r.message]
+        assert inval and any("hidden_channels" in m for m in inval)
+
+
+@pytest.mark.slow
+class TestTrainPrograms:
+    def test_precompile_then_fit_deserializes_programs(
+            self, preprocessed, tmp_path):
+        """precompile_train persists fit()'s init + train/eval programs;
+        after clearing every IN-PROCESS jax cache (process-boundary
+        stand-in), fit() resolves all three from the store — zero fresh
+        model compiles, counter-asserted on the aot.* events. (The tiny
+        eager EXECUTION-time ops a first epoch also runs are covered by
+        the persistent XLA cache across real runs, not by precompile —
+        benchmarks/coldstart_bench.py measures that end to end.)"""
+        import jax
+
+        from pertgnn_tpu.aot.precompile import precompile_train
+        from pertgnn_tpu.train.loop import fit
+
+        cfg = _cfg(str(tmp_path / "cache"))
+        ds = build_dataset(preprocessed, cfg)
+        stats = precompile_train(ds, cfg)
+        assert stats["programs"]
+        slots = set(os.listdir(tmp_path / "cache" / "exe"))
+        assert {"model_init", "train_chunk_compact",
+                "eval_chunk_compact"} <= slots
+
+        jax.clear_caches()
+        try:
+            bus = _RecordingBus()
+            _state, hist = fit(ds, cfg, epochs=1, bus=bus)
+            assert bus.count("aot.cache_hit") == 3
+            assert bus.count("aot.cache_miss") == 0
+            assert hist and np.isfinite(hist[0]["train_qloss"])
+            assert "ttfs_s" in hist[0]
+        finally:
+            jax.clear_caches()  # drop replay-form programs from memory
+
+    def test_fit_results_match_with_and_without_store(
+            self, preprocessed, tmp_path):
+        """The store path may not change training numerics: one epoch
+        with the AOT store vs the plain jit path, identical history."""
+        from pertgnn_tpu.train.loop import fit
+
+        cfg_plain = _cfg("")  # aot disabled
+        ds = build_dataset(preprocessed, cfg_plain)
+        _s1, h1 = fit(ds, cfg_plain, epochs=1)
+
+        cfg_store = _cfg(str(tmp_path / "cache2"))
+        ds2 = build_dataset(preprocessed, cfg_store)
+        _s2, h2 = fit(ds2, cfg_store, epochs=1)
+        for k in ("train_qloss", "train_mae", "valid_mae", "test_mae"):
+            assert h1[0][k] == pytest.approx(h2[0][k], rel=1e-5), k
+
+
+@pytest.mark.slow
+def test_second_process_serve_warmup_zero_compiles(
+        preprocessed, tmp_path):
+    """The cross-PROCESS acceptance assert: a child process over the
+    same store warms the ladder with zero fresh compiles. (The
+    in-process variant is TestStoreRoundTrip's; this one cannot be
+    faked by in-memory jit caches.)"""
+    import subprocess
+    import sys
+
+    root = str(tmp_path / "store")
+    cfg = _cfg(root)
+    ds = build_dataset(preprocessed, cfg)
+    _m, state = restore_target_state(ds, cfg)
+    InferenceEngine.from_dataset(ds, cfg, state).warmup()
+
+    code = f"""
+import json
+import numpy as np
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import (CompileCacheConfig, Config, DataConfig,
+                                IngestConfig, ModelConfig, ServeConfig,
+                                TrainConfig)
+from pertgnn_tpu.ingest import synthetic
+from pertgnn_tpu.ingest.preprocess import preprocess
+from pertgnn_tpu.serve.engine import InferenceEngine
+from pertgnn_tpu.train.loop import restore_target_state
+
+cfg = Config(
+    ingest=IngestConfig(min_traces_per_entry=10),
+    data=DataConfig(max_traces=200, batch_size=16),
+    model=ModelConfig(hidden_channels=8, num_layers=1),
+    train=TrainConfig(label_scale=1000.0, scan_chunk=2),
+    serve=ServeConfig(bucket_growth=4.0, min_bucket_nodes=128,
+                      min_bucket_edges=128, max_graphs_per_batch=4),
+    aot=CompileCacheConfig(cache_dir={root!r}),
+    graph_type="pert",
+)
+data = synthetic.generate(synthetic.SyntheticSpec(
+    num_microservices=30, num_entries=3, patterns_per_entry=3,
+    traces_per_entry=40, seed=7))
+pre = preprocess(data.spans, data.resources, cfg.ingest)
+ds = build_dataset(pre, cfg)
+_m, state = restore_target_state(ds, cfg)
+with telemetry.watch_xla_cache() as cache:
+    engine = InferenceEngine.from_dataset(ds, cfg, state).warmup()
+print(json.dumps({{"compiles": engine.compiles,
+                   "deserialized": engine.deserialized,
+                   "buckets": len(engine.ladder),
+                   "xla_misses": cache["misses"]}}))
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["compiles"] == 0
+    assert row["deserialized"] == row["buckets"]
+    assert row["xla_misses"] == 0
+
+
+class TestCompileCacheConfig:
+    def test_disabled_by_default(self):
+        assert not CompileCacheConfig().enabled
+        assert CompileCacheConfig(cache_dir="/x").enabled
+
+    def test_cli_flags_round_trip(self):
+        import argparse
+
+        from pertgnn_tpu.cli.common import add_aot_flags, config_from_args
+        from pertgnn_tpu.cli.common import (add_ingest_flags,
+                                            add_model_train_flags)
+
+        p = argparse.ArgumentParser()
+        add_ingest_flags(p)
+        add_model_train_flags(p)
+        add_aot_flags(p)
+        args = p.parse_args(["--compile_cache_dir", "/tmp/c",
+                             "--aot_min_compile_time_s", "0.5",
+                             "--no_serialize_executables"])
+        cfg = config_from_args(args)
+        assert cfg.aot.cache_dir == "/tmp/c"
+        assert cfg.aot.min_compile_time_s == 0.5
+        assert cfg.aot.serialize_executables is False
+
+    def test_store_from_config_respects_flags(self, tmp_path):
+        assert aot.store_from_config(CompileCacheConfig()) is None
+        cfg = CompileCacheConfig(cache_dir=str(tmp_path),
+                                 serialize_executables=False)
+        assert aot.store_from_config(cfg) is None
+        cfg = CompileCacheConfig(cache_dir=str(tmp_path))
+        store = aot.store_from_config(cfg)
+        assert store is not None
+        assert os.path.isdir(store.root)
+
+
+class TestConfigMismatchSatellite:
+    """ADVICE #3: output-relevant ingest fields join the sidecar
+    cross-check; sequence fields compare list-vs-tuple safely."""
+
+    def test_ingest_fields_checked(self):
+        import dataclasses
+
+        from pertgnn_tpu.train.checkpoint import config_mismatches
+
+        cfg = Config()
+        saved = dataclasses.asdict(cfg)
+        saved["ingest"]["ts_bucket_ms"] = 60_000
+        saved["ingest"]["min_resource_coverage"] = 0.9
+        mism, _unknown = config_mismatches(saved, cfg)
+        keys = {k for k, _a, _b in mism}
+        assert "ingest.ts_bucket_ms" in keys
+        assert "ingest.min_resource_coverage" in keys
+
+    def test_resource_aggs_tuple_vs_json_list_not_a_mismatch(self):
+        import dataclasses
+
+        from pertgnn_tpu.train.checkpoint import config_mismatches
+
+        cfg = Config()
+        saved = json.loads(json.dumps(dataclasses.asdict(cfg)))
+        # JSON round-trip turns the tuple into a list — must NOT flag
+        assert isinstance(saved["ingest"]["resource_aggs"], list)
+        mism, _ = config_mismatches(saved, cfg)
+        assert not [k for k, _a, _b in mism
+                    if k == "ingest.resource_aggs"]
+        saved["ingest"]["resource_aggs"] = ["max", "min"]
+        mism, _ = config_mismatches(saved, cfg)
+        assert [k for k, _a, _b in mism if k == "ingest.resource_aggs"]
+
+    def test_old_sidecar_without_ingest_warns_not_walls(self):
+        from pertgnn_tpu.train.checkpoint import config_mismatches
+
+        mism, unknown = config_mismatches({"graph_type": "span"},
+                                          Config())
+        assert not [k for k, _a, _b in mism if k.startswith("ingest.")]
+        assert any(k.startswith("ingest.") for k in unknown)
+
+
+class TestFlopsSatellite:
+    def test_kind_lookup_resolves_known_tpus(self):
+        from pertgnn_tpu.utils.flops import (peak_flops_for_kind,
+                                             peak_hbm_bw_for_kind)
+
+        assert peak_flops_for_kind("TPU v5 lite") == 197e12
+        assert peak_flops_for_kind("TPU v4") == 275e12
+        assert peak_hbm_bw_for_kind("TPU v5 lite") == 819e9
+        # CPU / unknown stay honestly null
+        assert peak_flops_for_kind("cpu") is None
+        assert peak_flops_for_kind("") is None
+        assert peak_flops_for_kind(None) is None
+
+    def test_finalizer_resolves_peaks_from_recorded_kind(self):
+        """A salvaged partial that recorded device_kind but predates
+        the peak fields must still produce MFU/MBU."""
+        import bench
+
+        result = bench._assemble_result(
+            fit_w=[100.0, 100.0, 100.0], ceil_w=[], cceil_w=[],
+            unstaged_w=[], flops_per_graph=1e9, bytes_per_graph=1e6,
+            baseline=10.0, backend="tpu", fallback=False,
+            train_graphs=100, partial_capture=True,
+            device_kind="TPU v5 lite")
+        assert result["peak_flops_per_chip"] == 197e12
+        assert result["mfu_pct"] is not None
+        assert result["mbu_pct"] is not None
+        assert result["device_kind"] == "TPU v5 lite"
+
+    def test_cpu_run_stays_null(self):
+        import bench
+
+        result = bench._assemble_result(
+            fit_w=[100.0], ceil_w=[], cceil_w=[], unstaged_w=[],
+            flops_per_graph=1e9, bytes_per_graph=1e6, baseline=10.0,
+            backend="cpu", fallback=True, train_graphs=100,
+            device_kind="")
+        assert result["mfu_pct"] is None
+        assert result["mbu_pct"] is None
