@@ -32,6 +32,15 @@ Telemetry: ``aot.cache_hit`` / ``aot.cache_miss`` counters (tag
 ``program``, plus ``reason`` on misses), ``aot.compile_seconds`` /
 ``aot.deserialize_seconds`` / ``aot.serialize_seconds`` histograms and
 ``aot.compile`` / ``aot.deserialize`` spans (docs/OBSERVABILITY.md).
+
+TRUST BOUNDARY: entries are unpickled at load (the pjrt format's
+in_tree/out_tree are jax treedefs that have no stable non-pickle
+serialization), so anyone who can write under ``cache_dir`` gains code
+execution in every later train/serve process that reads it — the same
+trust level as the persistent XLA cache and the checkpoint directory.
+Point ``--compile_cache_dir`` only at directories writable solely by
+the user running the jobs; never at world-writable or multi-tenant
+shared paths (docs/GUIDE.md "Precompile workflow").
 """
 
 from __future__ import annotations

@@ -245,6 +245,9 @@ class CompileCacheConfig:
     # JAX's persistent compilation cache (every jit compile, keyed by
     # XLA over the HLO + backend), `exe/` the serialized serve-rung
     # executables. Empty = disabled (every process cold-starts).
+    # TRUST: store entries are unpickled at load — whoever can write
+    # this directory can execute code in every process that reads it;
+    # keep it as private as your checkpoints (aot/store.py docstring).
     cache_dir: str = ""
     # Only persist XLA cache entries whose compile took at least this
     # long (seconds). 0 caches everything — right for this workload,

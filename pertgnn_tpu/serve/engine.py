@@ -178,9 +178,16 @@ class InferenceEngine:
                          self._variables),
             abstract_batch(b, self._n_feat))
         cfg = self._cfg
+        # deliberately NO ServeConfig fields: nothing in it is baked
+        # into the step program — the ladder knobs only select WHICH
+        # rung shapes exist (already in the slot name + args signature),
+        # and queue/transport knobs (flush_deadline_ms, warmup) never
+        # reach the compiled program. Keying the whole dataclass would
+        # spuriously invalidate every rung on a queue-tuning change —
+        # the same restraint _stored_train_eval applies to TrainConfig.
         key, components = aot.cache_key(
             fn_id="serve.engine.step.v1",
-            config={"model": cfg.model, "serve": cfg.serve,
+            config={"model": cfg.model,
                     "label_scale": cfg.train.label_scale,
                     "graph_type": cfg.graph_type},
             args_sig=aot.abstract_signature(abstract_args))

@@ -545,6 +545,29 @@ def _train_eval_abstract(dataset: Dataset, cfg: Config, state: TrainState,
 _STORE_ARENA_LIMIT_BYTES = 256 * 2**20
 
 
+def _train_eval_key_config(dataset: Dataset, cfg: Config, *,
+                           compact: bool) -> dict:
+    """The Config/dataset ingredients baked into the train/eval programs
+    as constants — everything the abstract signature CANNOT see."""
+    # only the TrainConfig fields BAKED INTO the program as constants:
+    # keying the whole dataclass would invalidate on epochs/log_every/
+    # checkpoint knobs that the compiled chunk never sees
+    config = {"model": cfg.model, "graph_type": cfg.graph_type,
+              "train": {k: getattr(cfg.train, k)
+                        for k in ("lr", "tau", "label_scale", "seed",
+                                  "scan_chunk")},
+              # the packer budget sizes the program's padded buffers
+              # (compact programs take it as make_*_compact constants and
+              # their CompactBatch signature is (G,)-shaped, so the
+              # abstract args can't see max_nodes/max_edges; without it a
+              # budget_headroom/max_*_per_batch change would replay a
+              # program whose scatters silently drop out-of-bounds rows)
+              "budget": dataset.budget}
+    if compact:
+        config["dataset_sha"] = _dataset_fingerprint(dataset)
+    return config
+
+
 def _stored_train_eval(store, dataset: Dataset, cfg: Config,
                        state: TrainState, train_jit: Callable,
                        eval_jit: Callable, *, compact: bool
@@ -553,19 +576,12 @@ def _stored_train_eval(store, dataset: Dataset, cfg: Config,
     store (pertgnn_tpu/aot/): a hit deserializes yesterday's executable
     (zero fresh model traces/compiles), a miss compiles ONCE and
     persists. Key = (env fingerprint, model+train config, graph_type,
-    dataset arena hash for compact programs, abstract signature)."""
+    batch budget, dataset arena hash for compact programs, abstract
+    signature)."""
     from pertgnn_tpu import aot
 
     abs_args = _train_eval_abstract(dataset, cfg, state, compact)
-    # only the TrainConfig fields BAKED INTO the program as constants:
-    # keying the whole dataclass would invalidate on epochs/log_every/
-    # checkpoint knobs that the compiled chunk never sees
-    config = {"model": cfg.model, "graph_type": cfg.graph_type,
-              "train": {k: getattr(cfg.train, k)
-                        for k in ("lr", "tau", "label_scale", "seed",
-                                  "scan_chunk")}}
-    if compact:
-        config["dataset_sha"] = _dataset_fingerprint(dataset)
+    config = _train_eval_key_config(dataset, cfg, compact=compact)
     kind = "compact" if compact else "packed"
     suffix = "chunk" if cfg.train.scan_chunk > 1 else "step"
     sig = aot.abstract_signature(abs_args)
@@ -579,6 +595,19 @@ def _stored_train_eval(store, dataset: Dataset, cfg: Config,
         log.info("AOT %s program: %s", name, outcome)
         out.append(exe)
     return out[0], out[1]
+
+
+def _model_init_key_config(cfg: Config, model: PertGNN) -> dict:
+    """model_init bakes the dataset vocab sizes into the embedding table
+    shapes (make_model constructor args) — the packed-sample signature
+    alone can't distinguish two datasets with different vocabs, and a
+    stale init would hand back undersized tables that clamped gathers
+    then index silently wrong."""
+    return {"model": cfg.model, "graph_type": cfg.graph_type,
+            "vocab": {"num_ms": model.num_ms,
+                      "num_entries": model.num_entries,
+                      "num_interfaces": model.num_interfaces,
+                      "num_rpctypes": model.num_rpctypes}}
 
 
 def _stored_init_state(store, cfg: Config, model: PertGNN,
@@ -598,7 +627,7 @@ def _stored_init_state(store, cfg: Config, model: PertGNN,
     abs_args = (_abstract_tree(rng), _abstract_tree(sample_dev))
     key, components = aot.cache_key(
         fn_id="train.loop.model_init.v1",
-        config={"model": cfg.model, "graph_type": cfg.graph_type},
+        config=_model_init_key_config(cfg, model),
         args_sig=aot.abstract_signature(abs_args))
     exe, outcome = store.load_or_build("model_init", key, components,
                                        init_jit, abs_args)
@@ -625,17 +654,24 @@ def build_single_device_programs(dataset: Dataset, cfg: Config, *,
     init is one fused jitted program and init + train/eval programs
     resolve through the serialized-executable store."""
     store = None
-    if cfg.aot.enabled and cfg.aot.serialize_executables:
-        if device_materialize and arena_nbytes(
-                dataset.arena(),
-                dataset.feat_arena()) > _STORE_ARENA_LIMIT_BYTES:
-            log.info("arenas exceed the executable-store size guard "
-                     "(%d MiB) — compact programs rely on the "
-                     "persistent XLA cache only",
-                     _STORE_ARENA_LIMIT_BYTES // 2**20)
-        else:
-            from pertgnn_tpu import aot
-            store = aot.store_from_config(cfg, bus=bus)
+    if cfg.aot.enabled:
+        from pertgnn_tpu import aot
+
+        # unconditional: the branches below that SKIP the executable
+        # store (large arenas, serialize_executables=False) are exactly
+        # the ones that depend on the persistent XLA cache, and
+        # programmatic fit() callers have no CLI to have enabled it
+        aot.enable_compile_cache(cfg.aot)
+        if cfg.aot.serialize_executables:
+            if device_materialize and arena_nbytes(
+                    dataset.arena(),
+                    dataset.feat_arena()) > _STORE_ARENA_LIMIT_BYTES:
+                log.info("arenas exceed the executable-store size guard "
+                         "(%d MiB) — compact programs rely on the "
+                         "persistent XLA cache only",
+                         _STORE_ARENA_LIMIT_BYTES // 2**20)
+            else:
+                store = aot.store_from_config(cfg, bus=bus)
     state = None
     if store is not None:
         state = _stored_init_state(store, cfg, model, tx, sample)

@@ -134,6 +134,58 @@ class TestKeys:
             args_sig=sig, env=env)
         assert k1 == k2 != k3
 
+    def test_budget_change_changes_train_eval_key(self, preprocessed):
+        """Compact programs bake max_nodes/max_edges into their scatter
+        buffers but CompactBatch's (G,)-shaped signature can't see them:
+        a budget-only change (same dataset, same batch_size) MUST miss,
+        or yesterday's smaller program silently drops scatter rows."""
+        import dataclasses
+
+        from pertgnn_tpu.train.loop import _train_eval_key_config
+
+        cfg = _cfg("")
+        ds = build_dataset(preprocessed, cfg)
+        cfg2 = cfg.replace(data=dataclasses.replace(
+            cfg.data, max_nodes_per_batch=ds.budget.max_nodes + 128))
+        ds2 = build_dataset(preprocessed, cfg2)
+        assert ds2.budget != ds.budget
+        env = {"jax": "1"}
+        sig = {"leaves": ["(5,):int32"], "treedef": "*"}
+        k1, c1 = aot.cache_key(
+            fn_id="f", config=_train_eval_key_config(ds, cfg,
+                                                     compact=True),
+            args_sig=sig, env=env)
+        k2, c2 = aot.cache_key(
+            fn_id="f", config=_train_eval_key_config(ds2, cfg2,
+                                                     compact=True),
+            args_sig=sig, env=env)
+        assert k1 != k2
+        assert any(c.startswith("config.budget")
+                   for c in aot.diff_components(c1, c2))
+
+    def test_model_init_key_covers_vocab_sizes(self):
+        """make_model bakes the dataset vocab sizes into embedding
+        table shapes; same packed-sample signature + different vocab
+        must be a different model_init key (stale tables would make
+        clamped gathers silently wrong)."""
+        from pertgnn_tpu.models.pert_model import make_model
+        from pertgnn_tpu.train.loop import _model_init_key_config
+
+        cfg = _cfg("")
+        env = {"jax": "1"}
+        sig = {"leaves": ["(2,):uint32"], "treedef": "*"}
+        m1 = make_model(cfg.model, 30, 3, 5, 4)
+        m2 = make_model(cfg.model, 30, 7, 5, 4)
+        k1, c1 = aot.cache_key(
+            fn_id="f", config=_model_init_key_config(cfg, m1),
+            args_sig=sig, env=env)
+        k2, c2 = aot.cache_key(
+            fn_id="f", config=_model_init_key_config(cfg, m2),
+            args_sig=sig, env=env)
+        assert k1 != k2
+        assert any("vocab.num_entries" in c
+                   for c in aot.diff_components(c1, c2))
+
     def test_diff_components_names_the_change(self):
         _, c1 = aot.cache_key(fn_id="f", config={"hidden": 8},
                               args_sig={"leaves": [], "treedef": "*"},
@@ -187,6 +239,22 @@ class TestStoreRoundTrip:
         a = engine_a.predict_many(s.entry_ids[:6], s.ts_buckets[:6])
         b = engine_b.predict_many(s.entry_ids[:6], s.ts_buckets[:6])
         np.testing.assert_array_equal(a, b)
+
+    def test_queue_knobs_do_not_invalidate_rung_entries(self, warmed):
+        """flush_deadline_ms / warmup are queue/transport knobs that the
+        compiled step program never sees — tuning them must land on the
+        SAME rung keys (no spurious invalidation, no recompiles)."""
+        import dataclasses
+
+        _root, ds, cfg, state, engine, _bus = warmed
+        cfg2 = cfg.replace(serve=dataclasses.replace(
+            cfg.serve, flush_deadline_ms=99.0, warmup=False))
+        other = InferenceEngine.from_dataset(ds, cfg2, state)
+        assert len(other.ladder) == len(engine.ladder)
+        for i in range(len(engine.ladder)):
+            name_a, key_a, _c, _a = engine._rung_entry(i)
+            name_b, key_b, _c2, _a2 = other._rung_entry(i)
+            assert (name_a, key_a) == (name_b, key_b)
 
     def test_corrupt_entry_falls_back_to_fresh_compile(
             self, warmed, tmp_path, caplog):
