@@ -1,0 +1,399 @@
+"""Chaos benchmark: concurrent serving traffic under injected faults.
+
+Drives the full queue+engine stack (pertgnn_tpu/serve/) through each
+deterministic fault in pertgnn_tpu/testing/faults.py and EXIT-CODE
+ASSERTS the reliability invariants (docs/RELIABILITY.md):
+
+- **dispatch exception** (a persistently poisoned request): bisect
+  quarantine pins the failure on the offender; every innocent request's
+  prediction is BIT-IDENTICAL to a fault-free run; ``serve.poisoned`` /
+  ``serve.quarantined`` land in the telemetry JSONL.
+- **device wedge** (a dispatch that stalls past the watchdog timeout):
+  the watchdog trips, one rebuild-from-AOT-store recovery retries the
+  batch — NO caller loses a prediction to a transient wedge;
+  ``serve.watchdog_trip`` / ``serve.recovered`` recorded.
+- **NaN output**: the guard quarantines the batch and the bisect retry
+  returns real values — garbage NEVER reaches a caller;
+  ``serve.nan_outputs`` recorded.
+- **overload**: admission control sheds with QueueFull instead of
+  growing the pending set without bound; every ADMITTED request still
+  resolves bit-identically; ``serve.shed`` recorded.
+- **SIGTERM drain** (real serve_main child process): admissions stop,
+  in-flight batches flush, the process exits 0 with "drained": true —
+  preemption of a serving replica is not a crash. The child's
+  --health_port readiness probe is polled to time the signal.
+
+Wall-clock numbers are REPORTED in the JSON; invariants live in the
+exit code (same split as coldstart_bench.py). One JSON line on stdout.
+
+CPU by default (deterministic here); faults are seeded and
+occurrence-addressed, so the fire pattern is reproducible run to run.
+
+    python benchmarks/chaos_bench.py [--quick] [--skip_drain]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_workload(traces_per_entry: int = 120):
+    """A heterogeneous-shape synthetic corpus (several ladder rungs) and
+    a fresh-init engine — fault semantics are weight-independent."""
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                    ModelConfig, ServeConfig, TrainConfig)
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.train.loop import restore_target_state
+
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(max_traces=100_000, batch_size=32),
+        model=ModelConfig(hidden_channels=16, num_layers=2),
+        train=TrainConfig(label_scale=1000.0),
+        serve=ServeConfig(bucket_growth=2.0, max_graphs_per_batch=8,
+                          min_bucket_nodes=128, min_bucket_edges=128),
+        graph_type="pert",
+    )
+    data = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=40, num_entries=8, patterns_per_entry=3,
+        pattern_size_range=(3, 18), traces_per_entry=traces_per_entry,
+        seed=42))
+    pre = preprocess(data.spans, data.resources, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+    _model, state = restore_target_state(ds, cfg)
+    engine = InferenceEngine.from_dataset(ds, cfg, state).warmup()
+    return ds, cfg, state, engine
+
+
+def request_stream(ds, n: int):
+    """(entries, ts_buckets): every split concatenated (entry variety —
+    the poison scenario needs innocents) and tiled to n requests."""
+    e = np.concatenate([np.asarray(s.entry_ids, np.int64)
+                        for s in ds.splits.values()])
+    t = np.concatenate([np.asarray(s.ts_buckets, np.int64)
+                        for s in ds.splits.values()])
+    # splits are entry-ordered; a seeded shuffle keeps a short stream
+    # entry-diverse (deterministic: same stream every run)
+    perm = np.random.default_rng(0).permutation(len(e))
+    e, t = e[perm], t[perm]
+    reps = -(-n // len(e))
+    e, t = np.tile(e, reps)[:n], np.tile(t, reps)[:n]
+    assert len(np.unique(e)) >= 2, "chaos stream needs innocent entries"
+    return e, t
+
+
+def reference_preds(engine, entries, ts_buckets) -> np.ndarray:
+    """Fault-free per-request predictions, each served alone. Padding
+    invariance (tests/test_serve.py) makes these bit-identical to ANY
+    coalescing the queue applies under faults — the comparison anchor."""
+    return np.asarray([
+        float(engine.predict_microbatch(entries[i:i + 1],
+                                        ts_buckets[i:i + 1])[0])
+        for i in range(len(entries))], np.float32)
+
+
+def drive(queue, entries, ts_buckets, concurrency: int = 8,
+          timeout: float = 120.0):
+    """Concurrent clients over the queue; returns (preds, errors) with
+    errors[i] = exception class name (preds[i] NaN) for failed requests.
+    Every request RESOLVES within `timeout` — a hang fails the bench."""
+    preds = np.full(len(entries), np.nan, np.float32)
+    errors: dict[int, str] = {}
+    lock = threading.Lock()
+
+    def client(indices):
+        for i in indices:
+            try:
+                preds[i] = queue.predict(int(entries[i]),
+                                         int(ts_buckets[i]),
+                                         timeout=timeout)
+            except Exception as exc:  # noqa — typed outcome recording
+                with lock:
+                    errors[i] = type(exc).__name__
+    threads = [threading.Thread(
+        target=client, args=(range(t, len(entries), concurrency),))
+        for t in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return preds, errors
+
+
+class Check:
+    def __init__(self):
+        self.failures: list[str] = []
+
+    def expect(self, cond: bool, what: str):
+        if not cond:
+            self.failures.append(what)
+            print(f"CHAOS FAIL: {what}", file=sys.stderr)
+
+
+def counters_in(telemetry_dir: str) -> set:
+    from pertgnn_tpu.telemetry import load_events
+    names = set()
+    for fname in os.listdir(telemetry_dir):
+        if fname.endswith(".jsonl"):
+            for ev in load_events(os.path.join(telemetry_dir, fname)):
+                names.add(ev["name"])
+    return names
+
+
+def scenario_dispatch_error(ds, engine, ref, entries, tsb, check):
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+    from pertgnn_tpu.testing import faults
+    from pertgnn_tpu.testing.faults import FaultPlan, FaultSpec
+
+    poison = int(entries[0])
+    faults.install(FaultPlan([FaultSpec(site="serve.dispatch",
+                                        kind="error", entry_id=poison)]))
+    try:
+        with MicrobatchQueue(engine, flush_deadline_ms=5,
+                             dispatch_timeout_s=30.0,
+                             quarantine_threshold=3) as q:
+            preds, errors = drive(q, entries, tsb)
+            stats = q.stats_dict()
+    finally:
+        faults.install(None)
+    innocent = entries != poison
+    check.expect(not np.isnan(preds[innocent]).any(),
+                 "dispatch_error: an innocent request lost its prediction")
+    check.expect((preds[innocent] == ref[innocent]).all(),
+                 "dispatch_error: innocent predictions not bit-identical")
+    check.expect(all(np.isnan(preds[i]) for i in range(len(entries))
+                     if entries[i] == poison),
+                 "dispatch_error: the poisoned entry produced predictions")
+    check.expect(stats["poisoned"] >= 1,
+                 "dispatch_error: no poisoned-request isolation recorded")
+    check.expect(poison in stats["quarantined_entries"],
+                 "dispatch_error: repeat offender not quarantined")
+    return {"errors": len(errors), "poisoned": stats["poisoned"],
+            "quarantined": stats["quarantined_entries"]}
+
+
+def scenario_wedge(ds, engine, ref, entries, tsb, check):
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+    from pertgnn_tpu.testing import faults
+    from pertgnn_tpu.testing.faults import FaultPlan, FaultSpec
+
+    faults.install(FaultPlan([FaultSpec(site="serve.dispatch",
+                                        kind="wedge", wedge_s=3.0,
+                                        nth=(2,))]))
+    t0 = time.perf_counter()
+    try:
+        with MicrobatchQueue(engine, flush_deadline_ms=5,
+                             dispatch_timeout_s=0.5) as q:
+            preds, errors = drive(q, entries, tsb)
+            stats = q.stats_dict()
+    finally:
+        faults.install(None)
+    wall = time.perf_counter() - t0
+    check.expect(not errors and not np.isnan(preds).any(),
+                 f"wedge: {len(errors)} request(s) lost to a TRANSIENT "
+                 f"wedge (watchdog must recover and retry)")
+    check.expect((preds == ref).all(),
+                 "wedge: surviving predictions not bit-identical")
+    check.expect(stats["watchdog_trips"] >= 1,
+                 "wedge: watchdog never tripped")
+    check.expect(stats["recovered"] >= 1, "wedge: engine never recovered")
+    check.expect(engine.healthy, "wedge: engine left unhealthy")
+    return {"wall_s": round(wall, 2), **{k: stats[k] for k in
+            ("watchdog_trips", "recovered")}}
+
+
+def scenario_nan(ds, engine, ref, entries, tsb, check):
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+    from pertgnn_tpu.testing import faults
+    from pertgnn_tpu.testing.faults import FaultPlan, FaultSpec
+
+    nans0 = engine.nan_outputs
+    faults.install(FaultPlan([FaultSpec(site="serve.dispatch",
+                                        kind="nan", nth=(2,))]))
+    try:
+        with MicrobatchQueue(engine, flush_deadline_ms=5,
+                             dispatch_timeout_s=30.0) as q:
+            preds, errors = drive(q, entries, tsb)
+    finally:
+        faults.install(None)
+    check.expect(not errors and not np.isnan(preds).any(),
+                 "nan: a caller received garbage or lost its prediction")
+    check.expect((preds == ref).all(),
+                 "nan: quarantine-retried predictions not bit-identical")
+    check.expect(engine.nan_outputs == nans0 + 1,
+                 "nan: the output guard never fired")
+    return {"nan_outputs": engine.nan_outputs - nans0,
+            "errors": len(errors)}
+
+
+def scenario_overload(ds, engine, ref, entries, tsb, check):
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+    with MicrobatchQueue(engine, flush_deadline_ms=20, max_pending=4,
+                         dispatch_timeout_s=30.0) as q:
+        preds, errors = drive(q, entries, tsb, concurrency=16)
+        stats = q.stats_dict()
+    shed = [i for i, name in errors.items() if name == "QueueFull"]
+    check.expect(len(shed) == len(errors),
+                 f"overload: non-shed errors {set(errors.values())}")
+    check.expect(stats["shed"] >= 1,
+                 "overload: admission control never shed under pressure")
+    admitted = np.ones(len(entries), bool)
+    admitted[shed] = False
+    check.expect(not np.isnan(preds[admitted]).any(),
+                 "overload: an ADMITTED request lost its prediction")
+    check.expect((preds[admitted] == ref[admitted]).all(),
+                 "overload: admitted predictions not bit-identical")
+    return {"shed": stats["shed"], "admitted": int(admitted.sum()),
+            "requests": len(entries)}
+
+
+def scenario_drain(check, quick: bool) -> dict:
+    """Real serve_main child: train a tiny checkpoint, start serving a
+    long stream, poll /healthz until ready, SIGTERM, assert exit 0 +
+    drained:true + all in-flight futures resolved."""
+    from pertgnn_tpu.cli import train_main
+
+    tmp = tempfile.mkdtemp(prefix="chaos_drain_")
+    ckpt = os.path.join(tmp, "ckpt")
+    art = os.path.join(tmp, "art")
+    common = ["--synthetic", "--synthetic_entries", "2",
+              "--synthetic_traces_per_entry", "60",
+              "--min_traces_per_entry", "5", "--label_scale", "1000",
+              "--artifact_dir", art, "--checkpoint_dir", ckpt]
+    train_main.main([*common, "--epochs", "1"])
+    # a stream long enough that the child cannot finish before SIGTERM
+    n_req = 5_000 if quick else 50_000
+    req_csv = os.path.join(tmp, "req.csv")
+    import pandas as pd
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import Config, IngestConfig, TrainConfig
+    from pertgnn_tpu.ingest.io import load_artifacts
+    pre, table = load_artifacts(art)
+    child_cfg = Config(ingest=IngestConfig(min_traces_per_entry=5),
+                       train=TrainConfig(label_scale=1000.0))
+    child_ds = build_dataset(pre, child_cfg, table)
+    s = child_ds.splits["train"]
+    eid, tsb = int(s.entry_ids[0]), int(s.ts_buckets[0])
+    pd.DataFrame({"entry_id": [eid] * n_req,
+                  "ts_bucket": [tsb] * n_req}).to_csv(req_csv, index=False)
+    port = 18000 + (os.getpid() % 2000)
+    child = subprocess.Popen(
+        [sys.executable, "-m", "pertgnn_tpu.cli.serve_main", *common,
+         "--requests", req_csv, "--concurrency", "2",
+         "--flush_deadline_ms", "5", "--health_port", str(port),
+         "--out", os.path.join(tmp, "served.csv")],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    ready = False
+    deadline = time.monotonic() + 600
+    url = f"http://127.0.0.1:{port}/healthz"
+    while time.monotonic() < deadline and child.poll() is None:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                if resp.status == 200:
+                    ready = True
+                    break
+        except OSError:
+            time.sleep(0.5)
+    check.expect(ready, "drain: /healthz never answered 200")
+    time.sleep(1.0)  # let it serve mid-stream before preemption
+    child.send_signal(signal.SIGTERM)
+    try:
+        out, _ = child.communicate(timeout=300)
+        rc = child.returncode
+    except subprocess.TimeoutExpired:
+        child.kill()
+        out, rc = "", -9
+    check.expect(rc == 0, f"drain: serve_main exited {rc}, not 0")
+    stats = {}
+    for line in out.strip().splitlines():
+        if line.startswith("{"):
+            stats = json.loads(line)
+    check.expect(bool(stats.get("drained")),
+                 "drain: child did not report drained:true (finished "
+                 "before the signal? raise the request count)")
+    served = stats.get("served", 0)
+    check.expect(0 < served < n_req,
+                 f"drain: served={served} of {n_req} — expected a "
+                 f"mid-stream preemption")
+    return {"rc": rc, "served": served, "requests": n_req,
+            "health_probe": ready}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--quick", action="store_true",
+                   help="smaller request streams (CI-sized)")
+    p.add_argument("--skip_drain", action="store_true",
+                   help="skip the subprocess SIGTERM scenario")
+    p.add_argument("--requests", type=int, default=0,
+                   help="requests per in-process scenario (0 = auto)")
+    args = p.parse_args(argv)
+
+    from pertgnn_tpu import telemetry
+
+    tele_dir = tempfile.mkdtemp(prefix="chaos_tele_")
+    telemetry.configure(tele_dir, level="trace",
+                        run_meta={"bench": "chaos"})
+    check = Check()
+    t0 = time.perf_counter()
+    ds, cfg, state, engine = build_workload()
+    n = args.requests or (48 if args.quick else 160)
+    entries, tsb = request_stream(ds, n)
+    ref = reference_preds(engine, entries, tsb)
+
+    results = {}
+    results["dispatch_error"] = scenario_dispatch_error(
+        ds, engine, ref, entries, tsb, check)
+    results["wedge"] = scenario_wedge(ds, engine, ref, entries, tsb, check)
+    results["nan"] = scenario_nan(ds, engine, ref, entries, tsb, check)
+    results["overload"] = scenario_overload(ds, engine, ref, entries, tsb,
+                                            check)
+    telemetry.get_bus().flush()
+    names = counters_in(tele_dir)
+    for counter in ("serve.shed", "serve.poisoned", "serve.quarantined",
+                    "serve.watchdog_trip", "serve.recovered",
+                    "serve.nan_outputs"):
+        check.expect(counter in names,
+                     f"telemetry: {counter} missing from the JSONL")
+    if not args.skip_drain:
+        results["drain"] = scenario_drain(check, args.quick)
+    telemetry.shutdown()
+
+    print(json.dumps({
+        "metric": "chaos_invariants_ok",
+        "value": int(not check.failures),
+        "unit": "bool",
+        "requests_per_scenario": n,
+        "scenarios": results,
+        "violations": check.failures,
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "telemetry_dir": tele_dir,
+        "captured_unix_time": time.time(),
+    }))
+    return 1 if check.failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -205,6 +205,25 @@ def add_serve_flags(p: argparse.ArgumentParser) -> None:
                    help="skip AOT-compiling the bucket ladder at engine "
                         "construction (first request per bucket then pays "
                         "the compile)")
+    # fault-tolerance knobs (serve/queue.py, docs/RELIABILITY.md)
+    p.add_argument("--max_pending", type=int,
+                   default=ServeConfig.max_pending,
+                   help="admission control: max queued requests; submit "
+                        "past it fast-fails with QueueFull (serve.shed)")
+    p.add_argument("--request_deadline_ms", type=float,
+                   default=ServeConfig.request_deadline_ms,
+                   help="per-request deadline: undispatched past it, the "
+                        "future resolves with DeadlineExceeded; 0 = none")
+    p.add_argument("--dispatch_timeout_s", type=float,
+                   default=ServeConfig.dispatch_timeout_s,
+                   help="dispatch watchdog: abandon an engine call wedged "
+                        "past this, mark the engine unhealthy, attempt "
+                        "one rebuild-from-AOT-store recovery; 0 = no "
+                        "watchdog (engine calls run inline)")
+    p.add_argument("--quarantine_threshold", type=int,
+                   default=ServeConfig.quarantine_threshold,
+                   help="reject an entry at submit after it poisoned this "
+                        "many microbatches (bisect-isolated)")
 
 
 def add_aot_flags(p: argparse.ArgumentParser) -> None:
@@ -359,7 +378,16 @@ def config_from_args(args: argparse.Namespace) -> Config:
                                          ServeConfig.max_graphs_per_batch),
             flush_deadline_ms=getattr(args, "flush_deadline_ms",
                                       ServeConfig.flush_deadline_ms),
-            warmup=not getattr(args, "no_serve_warmup", False)),
+            warmup=not getattr(args, "no_serve_warmup", False),
+            max_pending=getattr(args, "max_pending",
+                                ServeConfig.max_pending),
+            request_deadline_ms=getattr(args, "request_deadline_ms",
+                                        ServeConfig.request_deadline_ms),
+            dispatch_timeout_s=getattr(args, "dispatch_timeout_s",
+                                       ServeConfig.dispatch_timeout_s),
+            quarantine_threshold=getattr(
+                args, "quarantine_threshold",
+                ServeConfig.quarantine_threshold)),
         telemetry=telemetry_config_from_args(args),
         aot=aot_config_from_args(args),
         graph_type=args.graph_type,

@@ -21,6 +21,13 @@ This is the long-lived process the ROADMAP's request-serving north star
 needs; an RPC front-end would wrap `MicrobatchQueue.submit` — the queue,
 not the transport, is the engineered part.
 
+Operationally hardened (docs/RELIABILITY.md): SIGTERM drains gracefully
+(admissions stop, in-flight batches flush, exit 0 with "drained": true
+in the stats JSON), `--health_port` serves a 200/503 readiness probe
+from `engine.health()`, and typed request failures (shed, deadline,
+quarantine — serve/errors.py) are counted per class in the stats JSON
+instead of killing the run; their CSV rows hold NaN.
+
 Cold start: with `--compile_cache_dir` the warmed ladder executables
 persist across process starts (warmup deserializes instead of
 compiling), and `--precompile_only` populates that cache ahead of time
@@ -74,6 +81,37 @@ def _load_requests(args, dataset) -> tuple[np.ndarray, np.ndarray]:
     return entries, buckets
 
 
+def _start_health_server(port: int, engine, queue):
+    """A readiness probe on 127.0.0.1:<port>: 200 + engine.health() JSON
+    while the engine is healthy and admissions are open, 503 while
+    unhealthy or draining — what a load balancer polls to pull a
+    wedged/draining replica out of rotation. Daemon-threaded stdlib
+    http.server: the probe must never compete with the request path."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            health = engine.health()
+            ready = bool(health["healthy"]) and not queue.draining
+            body = _json.dumps({**health, "draining": queue.draining,
+                                "ready": ready}).encode()
+            self.send_response(200 if ready else 503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # probes are periodic; don't spam
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="serve-healthz").start()
+    return server
+
+
 def main(argv=None) -> None:
     setup_logging()
     apply_platform_env()
@@ -97,6 +135,11 @@ def main(argv=None) -> None:
                         "queue")
     p.add_argument("--out", default="served.csv",
                    help="per-request prediction CSV path")
+    p.add_argument("--health_port", type=int, default=0,
+                   help="serve a readiness probe on 127.0.0.1:<port> "
+                        "(GET /healthz: 200 while the engine is healthy "
+                        "and admissions are open, 503 while unhealthy or "
+                        "draining, body = engine.health() JSON); 0 = off")
     p.add_argument("--precompile_only", action="store_true",
                    help="populate the compile cache (--compile_cache_dir) "
                         "with every ladder-rung executable and exit "
@@ -160,42 +203,94 @@ def main(argv=None) -> None:
     if len(entries) == 0:
         raise SystemExit("no requests to serve")
 
+    from pertgnn_tpu.serve.errors import QueueClosed, ServeError
     from pertgnn_tpu.serve.queue import MicrobatchQueue
     engine = InferenceEngine.from_dataset(dataset, cfg, state)
     if cfg.serve.warmup:
         engine.warmup()
 
+    import collections
+    import signal
+    import threading
+
     client_latency = LatencyRecorder()
-    preds = np.zeros(len(entries), np.float32)
+    preds = np.full(len(entries), np.nan, np.float32)
+    served = np.zeros(len(entries), np.bool_)
+    request_errors: collections.Counter = collections.Counter()
+    errors_lock = threading.Lock()
     failures: list[tuple[int, BaseException]] = []
+    draining = threading.Event()
 
     def client(indices) -> None:
         for i in indices:
+            if draining.is_set():
+                return
             t0 = time.perf_counter()
             try:
                 preds[i] = queue.predict(int(entries[i]), int(buckets[i]))
-            except BaseException as exc:
-                # surface on the MAIN thread: a traceback printed by a
-                # dying client thread exits 0 and leaves silent zero
-                # predictions in the CSV
+            except QueueClosed:
+                return  # admission stopped: drain raced this submit
+            except ServeError as exc:
+                # typed request failure (shed / deadline / quarantine /
+                # unhealthy — serve/errors.py): the request stream goes
+                # on; the failure is counted, its CSV row stays NaN
+                with errors_lock:
+                    request_errors[type(exc).__name__] += 1
+                continue
+            except BaseException as exc:  # lint: allow-silent-except
+                # surface on the MAIN thread (SystemExit below): a
+                # traceback printed by a dying client thread exits 0 and
+                # leaves silent zero predictions in the CSV
                 failures.append((i, exc))
                 return
+            served[i] = True
             client_latency.record_s(time.perf_counter() - t0)
 
-    import threading
-
     t_serve0 = time.perf_counter()
-    with MicrobatchQueue(engine) as queue:
-        # round-robin so concurrent clients interleave distinct requests
-        # (each index is served exactly once; preds/latency cells are
-        # disjoint per thread, so no locking beyond the queue's own)
-        threads = [threading.Thread(
-            target=client, args=(range(t, len(entries), args.concurrency),))
-            for t in range(max(1, args.concurrency))]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+    health_server = None
+    prev_term = None
+    handler_installed = False
+    try:
+        with MicrobatchQueue(engine) as queue:
+            # graceful drain: SIGTERM stops admissions immediately
+            # (submit raises QueueClosed, clients wind down), in-flight
+            # batches flush on close(), and the process EXITS 0 —
+            # preemption of a serving replica must not read as a crash.
+            # The handler stays installed until AFTER close() so a
+            # repeated SIGTERM during the drain flush is idempotent
+            # instead of killing the process mid-flush.
+            def _on_term(signum, frame):
+                draining.set()
+                queue.begin_drain()
+
+            try:
+                prev_term = signal.signal(signal.SIGTERM, _on_term)
+                handler_installed = True
+            except ValueError:  # not the main thread (embedded use)
+                pass
+            if args.health_port:
+                health_server = _start_health_server(args.health_port,
+                                                     engine, queue)
+            # round-robin so concurrent clients interleave distinct
+            # requests (each index is served exactly once; preds/latency
+            # cells are disjoint per thread, so no locking beyond the
+            # queue's own)
+            threads = [threading.Thread(
+                target=client,
+                args=(range(t, len(entries), args.concurrency),))
+                for t in range(max(1, args.concurrency))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    finally:
+        # prev_term is None when the prior handler was installed by
+        # non-Python code — None is not restorable (TypeError); leave
+        # ours in place (begin_drain on a closed queue is a no-op)
+        if handler_installed and prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
+        if health_server is not None:
+            health_server.shutdown()
     serve_wall_s = time.perf_counter() - t_serve0
     if failures:
         i, exc = failures[0]
@@ -212,18 +307,34 @@ def main(argv=None) -> None:
         "metric": "pert_serve_request_latency_ms",
         "unit": "ms",
         "requests": len(entries),
+        "served": int(served.sum()),
+        "request_errors": dict(request_errors),
+        "drained": draining.is_set(),
         "concurrency": args.concurrency,
         "epochs_trained": start_epoch,
-        "throughput_rps": len(entries) / max(serve_wall_s, 1e-9),
+        "throughput_rps": int(served.sum()) / max(serve_wall_s, 1e-9),
         "client_latency": client_latency.summary_dict(),
         # publish_stats also lands the aggregate counters + per-bucket
         # pad waste in the telemetry JSONL at basic level
         "engine": engine.publish_stats(),
+        "queue": queue.stats_dict(),
+        "health": engine.health(),
         "captured_unix_time": time.time(),
     }
     bus.flush()
-    print(f"wrote {len(entries)} served predictions to {args.out}")
+    if draining.is_set():
+        print(f"drained on SIGTERM: {int(served.sum())}/{len(entries)} "
+              f"requests served before shutdown; all in-flight futures "
+              f"resolved")
+    print(f"wrote {len(entries)} predictions ({int(served.sum())} "
+          f"served) to {args.out}")
     print(json.dumps(stats))
+    # a run in which NOTHING was served (outside a drain) is a failure,
+    # not a quietly all-NaN CSV — automation must see a nonzero exit
+    if not draining.is_set() and not served.any():
+        raise SystemExit(
+            f"no request was served: all {len(entries)} failed "
+            f"({dict(request_errors) or 'no typed errors recorded'})")
 
 
 if __name__ == "__main__":

@@ -61,6 +61,17 @@ def main(argv=None) -> None:
                    help="supervisor: kill the run if the checkpoint dir "
                         "shows no progress for this many seconds (must "
                         "exceed startup + one checkpoint interval)")
+    p.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="supervisor: base seconds of the exponential "
+                        "restart backoff (doubles per consecutive fast "
+                        "failure); 0 = immediate respawn")
+    p.add_argument("--restart_backoff_cap", type=float, default=60.0,
+                   help="supervisor: backoff ceiling in seconds")
+    p.add_argument("--min_uptime", type=float, default=5.0,
+                   help="supervisor: a child dying within this many "
+                        "seconds of spawn counts as a crash loop "
+                        "(supervisor.crash_loop) and escalates the "
+                        "backoff")
     args = p.parse_args(argv)
     if args.supervise > 0 and supervisor.CHILD_ENV_MARKER not in os.environ:
         if not args.checkpoint_dir:
@@ -68,7 +79,10 @@ def main(argv=None) -> None:
                     "detection and resume both live there)")
         child_argv = _strip_flags(list(argv if argv is not None
                                        else sys.argv[1:]),
-                                  ("--supervise", "--hang_timeout"))
+                                  ("--supervise", "--hang_timeout",
+                                   "--restart_backoff",
+                                   "--restart_backoff_cap",
+                                   "--min_uptime"))
         # the parent gets its own (pid-unique) telemetry file so the
         # restart/hang counters land somewhere even though the child owns
         # the training stream
@@ -77,7 +91,10 @@ def main(argv=None) -> None:
             [sys.executable, "-m", "pertgnn_tpu.cli.train_main",
              *child_argv],
             args.checkpoint_dir, max_restarts=args.supervise,
-            hang_timeout=args.hang_timeout))
+            hang_timeout=args.hang_timeout,
+            backoff_base=args.restart_backoff,
+            backoff_cap=args.restart_backoff_cap,
+            min_uptime_s=args.min_uptime))
     if args.num_processes > 1:
         from pertgnn_tpu.parallel.multihost import initialize
         initialize(args.coordinator_address or None, args.num_processes,

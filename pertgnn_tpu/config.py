@@ -227,6 +227,27 @@ class ServeConfig:
     # AOT-compile every ladder rung at engine construction so the first
     # request of each shape pays dispatch, not compilation.
     warmup: bool = True
+    # --- fault tolerance (serve/queue.py, docs/RELIABILITY.md) ---
+    # Admission control: max requests queued awaiting dispatch; submit
+    # past it fast-fails with QueueFull (counter serve.shed) instead of
+    # growing the pending set without bound under overload.
+    max_pending: int = 1024
+    # Per-request deadline: a request not DISPATCHED within this many ms
+    # of submission resolves with DeadlineExceeded instead of waiting
+    # forever (counter serve.deadline_exceeded). 0 = no deadline.
+    request_deadline_ms: float = 0.0
+    # Dispatch watchdog: an engine call exceeding this many seconds is
+    # abandoned (the wedged-device signature raises nothing, ever), the
+    # engine is marked unhealthy, and ONE rebuild-from-AOT-store
+    # recovery is attempted before a fail-fast cooldown (counters
+    # serve.watchdog_trip / serve.recovered). 0 = no watchdog: engine
+    # calls run inline on the queue worker (zero thread-hop overhead,
+    # but a wedge hangs the worker and every future behind it).
+    dispatch_timeout_s: float = 60.0
+    # A request (entry_id) isolated as the poisoner of this many
+    # microbatches (bisect-retry, serve/queue.py) is rejected at submit
+    # with RequestQuarantined (counter serve.quarantined).
+    quarantine_threshold: int = 3
 
 
 @dataclasses.dataclass(frozen=True)

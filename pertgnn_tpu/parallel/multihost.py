@@ -52,7 +52,8 @@ def initialize(coordinator_address: str | None = None,
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:  # older jaxlib without the option: let init try
-            pass
+            log.debug("jax_cpu_collectives_implementation unavailable; "
+                      "distributed init will pick its own transport")
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

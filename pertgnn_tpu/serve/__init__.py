@@ -14,12 +14,22 @@ package re-applies the training discipline to the request path:
   (`jax.jit(...).lower(...).compile()`), a single-batch fast pack
   (batching/pack.py `pack_single`), and hit/miss/pad-waste counters;
 - `queue`    — a deadline-based microbatching queue coalescing concurrent
-  requests into one bucket-shaped dispatch.
+  requests into one bucket-shaped dispatch, hardened with admission
+  control, per-request deadlines, poisoned-batch quarantine, and a
+  dispatch watchdog (docs/RELIABILITY.md);
+- `errors`   — the typed serving failure vocabulary (every submitted
+  Future resolves to a prediction or one of these).
 """
 
 from pertgnn_tpu.serve.buckets import make_bucket_ladder, select_bucket
 from pertgnn_tpu.serve.engine import InferenceEngine
+from pertgnn_tpu.serve.errors import (DeadlineExceeded, DispatchTimeout,
+                                      EngineUnhealthy, NonFiniteOutput,
+                                      QueueClosed, QueueFull,
+                                      RequestQuarantined, ServeError)
 from pertgnn_tpu.serve.queue import MicrobatchQueue
 
 __all__ = ["InferenceEngine", "MicrobatchQueue", "make_bucket_ladder",
-           "select_bucket"]
+           "select_bucket", "ServeError", "QueueFull", "QueueClosed",
+           "DeadlineExceeded", "RequestQuarantined", "DispatchTimeout",
+           "EngineUnhealthy", "NonFiniteOutput"]

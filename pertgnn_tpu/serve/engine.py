@@ -48,6 +48,8 @@ from pertgnn_tpu.config import Config
 from pertgnn_tpu.models.pert_model import make_model
 from pertgnn_tpu.serve.buckets import (make_bucket_ladder, pad_waste,
                                        select_bucket)
+from pertgnn_tpu.serve.errors import NonFiniteOutput
+from pertgnn_tpu.testing import faults
 from pertgnn_tpu.utils.profiling import LatencyRecorder
 
 # The per-request lifecycle stages whose latency breakdown the engine
@@ -149,6 +151,12 @@ class InferenceEngine:
         # rung executables deserialized from the AOT store instead of
         # freshly compiled (cross-process cold-start elimination)
         self.deserialized = 0
+        # -- health (docs/RELIABILITY.md): flipped by the queue's
+        # dispatch watchdog on a wedge signature, restored by rebuild()
+        self.healthy = True
+        self.unhealthy_reason: str | None = None
+        self.nan_outputs = 0
+        self.rebuilds = 0
 
     @classmethod
     def from_dataset(cls, dataset, cfg: Config, state, bus=None,
@@ -195,6 +203,9 @@ class InferenceEngine:
         return name, key, components, abstract_args
 
     def _compile(self, idx: int) -> object:
+        plan = faults.active()
+        if plan is not None:
+            plan.fire("serve.compile", entry_ids=None)
         if self._store is not None:
             name, key, components, abstract_args = self._rung_entry(idx)
             with self._bus.span("serve.compile", bucket=idx):
@@ -233,6 +244,48 @@ class InferenceEngine:
                  len(self.ladder), self.warmup_s, self.compiles,
                  self.deserialized,
                  [(b.max_nodes, b.max_edges) for b in self.ladder])
+        return self
+
+    # -- health / recovery -----------------------------------------------
+
+    def mark_unhealthy(self, reason: str) -> None:
+        """Flip the readiness signal (health(), serve_main's
+        --health_port probe answer 503). Called by the queue's dispatch
+        watchdog when an engine call wedges past its timeout."""
+        self.healthy = False
+        self.unhealthy_reason = reason
+        log.error("engine marked unhealthy: %s", reason)
+
+    def mark_recovered(self) -> None:
+        self.healthy = True
+        self.unhealthy_reason = None
+
+    def health(self) -> dict:
+        """JSON-ready readiness snapshot — what a load balancer polls
+        before routing traffic here."""
+        return {
+            "healthy": self.healthy,
+            "reason": self.unhealthy_reason,
+            "warmed": self._warmed,
+            "executables": len(self._exe),
+            "buckets": len(self.ladder),
+            "rebuilds": self.rebuilds,
+            "nan_outputs": self.nan_outputs,
+        }
+
+    def rebuild(self) -> "InferenceEngine":
+        """Drop every cached rung executable and re-run warmup — the
+        one-shot recovery the watchdog attempts after a wedge. With an
+        AOT store (PR 3) the re-warmup is a disk deserialize, not a
+        recompile, so recovery costs seconds, not minutes. Raises if the
+        rebuild itself fails (the caller decides the cooldown)."""
+        self.rebuilds += 1
+        self._bus.counter("serve.rebuild")
+        log.warning("engine rebuild: dropping %d cached executables and "
+                    "re-warming the ladder", len(self._exe))
+        self._exe = {}
+        self._warmed = False
+        self.warmup()
         return self
 
     # -- request path ----------------------------------------------------
@@ -281,6 +334,13 @@ class InferenceEngine:
                 f"microbatch of {g} graphs ({n} nodes, {e_tot} edges) "
                 f"exceeds the top bucket {self.ladder[-1]}")
         bus = self._bus
+        # fault-injection hook (pertgnn_tpu/testing/faults.py): "error"
+        # raises here, "wedge" stalls here (mid-dispatch, where a real
+        # device-transport hang lives), "nan" corrupts the output below
+        # so the finite guard must catch it
+        plan = faults.active()
+        injected = (plan.fire("serve.dispatch", entry_ids=entry_ids)
+                    if plan is not None else None)
         with self.latency.time():
             if idx in self._exe:
                 self.cache_hits += 1
@@ -312,6 +372,24 @@ class InferenceEngine:
             with self.stage_latency["compute"].time(), \
                     bus.span("serve.compute", level=2, bucket=idx):
                 pred = np.asarray(out)[:g]
+            if injected == "nan":
+                pred = np.full_like(pred, np.nan)
+            # output guard: NEVER hand garbage to a caller. A non-finite
+            # prediction fails the batch (the queue's bisect then
+            # isolates the offending request; direct callers see the
+            # typed error instead of silently propagating NaN).
+            if not np.isfinite(pred).all():
+                bad = entry_ids[~np.isfinite(pred)]
+                self.nan_outputs += 1
+                bus.counter("serve.nan_outputs", bucket=idx,
+                            graphs=int(g))
+                log.error("non-finite model output for %d/%d requests "
+                          "(entries %s) — quarantining the batch",
+                          int((~np.isfinite(pred)).sum()), g,
+                          bad[:8].tolist())
+                raise NonFiniteOutput(
+                    f"model returned non-finite predictions for entries "
+                    f"{bad[:8].tolist()}")
         self.requests += g
         self.batches += 1
         bs = self._bucket_stats[idx]
@@ -380,6 +458,9 @@ class InferenceEngine:
             "cache_misses": self.cache_misses,
             "compiles": self.compiles,
             "deserialized": self.deserialized,
+            "healthy": self.healthy,
+            "rebuilds": self.rebuilds,
+            "nan_outputs": self.nan_outputs,
             "warmup_s": self.warmup_s,
             "pad_waste_ratio": self.pad_waste_ratio(),
             "latency": self.latency.summary_dict(),

@@ -1,0 +1,62 @@
+"""The serving failure vocabulary: every way a request can fail, typed.
+
+The request path promises that a caller's Future ALWAYS resolves — to a
+prediction or to one of these exceptions — and that the exception names
+WHY, so an RPC front-end can map each to the right status code (429 for
+shed, 504 for deadline, 503 for an unhealthy engine) instead of pattern-
+matching message strings. docs/RELIABILITY.md tabulates failure mode ->
+detection -> behavior -> telemetry counter.
+"""
+
+from __future__ import annotations
+
+
+class ServeError(RuntimeError):
+    """Base class of all typed serving failures."""
+
+
+class QueueFull(ServeError):
+    """Admission control shed this request: the pending set is at
+    ServeConfig.max_pending. Fast-fail at submit — under overload the
+    queue rejects new work instead of growing without bound until every
+    caller times out. Counter: ``serve.shed``."""
+
+
+class QueueClosed(ServeError):
+    """Submit after close() or during a graceful drain. The message
+    contains "closed" for callers matching on it."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request waited past ServeConfig.request_deadline_ms without
+    being dispatched; its Future resolves with this instead of waiting
+    forever. Counter: ``serve.deadline_exceeded``."""
+
+
+class RequestQuarantined(ServeError):
+    """This entry_id poisoned >= ServeConfig.quarantine_threshold
+    microbatches (isolated by bisect-retry) and is now rejected at
+    submit so it cannot keep taking innocent co-batched requests down.
+    Counter: ``serve.quarantined`` (on quarantine) /
+    ``serve.quarantine_rejected`` (per rejected submit)."""
+
+
+class DispatchTimeout(ServeError):
+    """An engine dispatch exceeded ServeConfig.dispatch_timeout_s — the
+    wedged-device-transport signature (a blocked device call raises
+    nothing, ever). The watchdog abandons the dispatch, marks the engine
+    unhealthy, and attempts a rebuild-from-AOT-store recovery. Counter:
+    ``serve.watchdog_trip``."""
+
+
+class EngineUnhealthy(ServeError):
+    """Fast-fail during the post-watchdog cooldown: the engine is marked
+    unhealthy and requests are rejected immediately instead of queuing
+    behind a dead device. ``engine.health()`` (and serve_main's
+    --health_port probe) reports the same state."""
+
+
+class NonFiniteOutput(ServeError):
+    """The model returned NaN/inf for this request. The output guard
+    quarantines the batch rather than returning garbage to a caller.
+    Counter: ``serve.nan_outputs``."""

@@ -14,19 +14,139 @@ Semantics:
   `flush_deadline_ms`, or (b) the pending set would overflow the engine's
   top bucket (graphs, nodes, or edges) — whichever comes first. Deadline
   0 degrades to per-request dispatch (lowest latency, no amortization).
-- One worker thread owns ALL engine calls, so the engine needs no locks
-  and per-request prediction alignment is preserved by construction:
-  each flush packs its requests in submission order and fans the
-  engine's per-request outputs back to the matching futures.
+- One worker thread owns the engine-call ORDER (batches are formed and
+  resolved strictly serially), so per-request prediction alignment is
+  preserved by construction: each flush packs its requests in submission
+  order and fans the engine's per-request outputs back to the matching
+  futures.
+
+Failure semantics (docs/RELIABILITY.md) — a submitted Future ALWAYS
+resolves, to a prediction or to a typed serve error (serve/errors.py):
+
+- **admission control**: submit past `max_pending` queued requests
+  fast-fails with QueueFull (counter ``serve.shed``) — under overload
+  the queue sheds instead of growing without bound;
+- **per-request deadlines**: a request not dispatched within
+  `request_deadline_ms` resolves with DeadlineExceeded (counter
+  ``serve.deadline_exceeded``);
+- **poisoned-batch quarantine**: a failing microbatch is bisect-retried
+  so only the offending request gets the exception while innocent
+  co-batched callers still get their predictions; an entry isolated as
+  the poisoner of `quarantine_threshold` batches is rejected at submit
+  with RequestQuarantined (counters ``serve.poisoned`` /
+  ``serve.quarantined``);
+- **dispatch watchdog**: with `dispatch_timeout_s` > 0 engine calls run
+  on an abandonable helper thread; a call that wedges past the timeout
+  (the device-transport hang signature, which raises nothing) trips the
+  watchdog (counter ``serve.watchdog_trip``): the engine is marked
+  unhealthy, ONE rebuild-from-AOT-store recovery is attempted (cheap —
+  PR 3 made recompiles disk hits; counter ``serve.recovered``) and the
+  batch retried once; while unhealthy, batches fail fast with
+  EngineUnhealthy for a cooldown instead of queuing behind a dead
+  device. NOTE a tripped watchdog abandons the wedged helper thread
+  mid-engine-call; the single-threaded-engine invariant is then
+  best-effort until that thread unwedges or the process exits — the
+  rebuilt executables are fresh objects, so the zombie can only touch
+  stale ones.
 """
 
 from __future__ import annotations
 
+import logging
+import math
 import threading
 import time
 from concurrent.futures import Future
 
 from pertgnn_tpu.serve.engine import InferenceEngine
+from pertgnn_tpu.serve.errors import (DeadlineExceeded, DispatchTimeout,
+                                      EngineUnhealthy, QueueClosed,
+                                      QueueFull, RequestQuarantined)
+
+log = logging.getLogger(__name__)
+
+# pending-entry tuple layout (submission order is load-bearing):
+# (entry_id, ts_bucket, arrival_time, deadline_abs, future)
+
+
+def _call_abandonable(fn, timeout: float, name: str):
+    """Run ``fn()`` on a daemon thread and wait at most `timeout`.
+
+    Returns (finished, box) with box["value"] or box["error"] when
+    finished. On timeout the thread is ABANDONED, not joined — a wedged
+    device call raises nothing, ever, and a daemon thread dies with the
+    process. (ThreadPoolExecutor is unusable for this: its workers are
+    non-daemon and joined by concurrent.futures' atexit hook even after
+    shutdown(wait=False), so one truly wedged call would hang process
+    exit forever.)"""
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # lint: allow-silent-except
+            box["error"] = exc  # consumed by the waiting caller
+        finally:
+            done.set()
+
+    threading.Thread(target=run, daemon=True, name=name).start()
+    return done.wait(timeout), box
+
+
+class _Dispatcher:
+    """One persistent daemon thread owning engine calls so the queue
+    worker can TIME OUT a wedged dispatch and abandon it (a blocked
+    device call raises nothing, ever — join is not an option). After a
+    timeout the dispatcher is dead: its thread may still be inside the
+    engine; the queue builds a fresh one for the next call.
+
+    A PERSISTENT daemon thread, unlike ``_call_abandonable``'s per-call
+    spawn, so steady-state dispatches pay no thread start; the
+    why-not-ThreadPoolExecutor rationale lives on _call_abandonable."""
+
+    def __init__(self, engine: InferenceEngine):
+        self._engine = engine
+        self._calls: list = []
+        self._have_call = threading.Semaphore(0)
+        self.dead = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-dispatch")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            self._have_call.acquire()
+            item = self._calls.pop(0)
+            if item is None:
+                return
+            box, entries, buckets = item
+            try:
+                box["value"] = self._engine.predict_microbatch(entries,
+                                                               buckets)
+            except BaseException as exc:  # lint: allow-silent-except
+                box["error"] = exc  # re-raised by call() on the worker
+            box["done"].set()
+            if self.dead:
+                return
+
+    def call(self, entries, buckets, timeout: float):
+        box: dict = {"done": threading.Event()}
+        self._calls.append((box, entries, buckets))
+        self._have_call.release()
+        if not box["done"].wait(timeout):
+            self.dead = True
+            raise DispatchTimeout(
+                f"engine dispatch of {len(entries)} request(s) exceeded "
+                f"{timeout:g}s (wedge signature); abandoning the dispatch "
+                f"thread")
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    def close(self) -> None:
+        self._calls.append(None)
+        self._have_call.release()
 
 
 class MicrobatchQueue:
@@ -34,7 +154,11 @@ class MicrobatchQueue:
 
     def __init__(self, engine: InferenceEngine,
                  flush_deadline_ms: float | None = None,
-                 max_graphs: int | None = None):
+                 max_graphs: int | None = None,
+                 max_pending: int | None = None,
+                 request_deadline_ms: float | None = None,
+                 dispatch_timeout_s: float | None = None,
+                 quarantine_threshold: int | None = None):
         cfg = engine._cfg.serve
         self._engine = engine
         self._deadline_s = (cfg.flush_deadline_ms
@@ -44,12 +168,42 @@ class MicrobatchQueue:
         self._max_graphs = min(max_graphs or top.max_graphs, top.max_graphs)
         self._max_nodes = top.max_nodes
         self._max_edges = top.max_edges
-        # (entry_id, ts_bucket, arrival_time, future) — arrival anchors
-        # the flush deadline even when the worker was busy dispatching
-        self._pending: list[tuple[int, int, float, Future]] = []
+        self._max_pending = (cfg.max_pending if max_pending is None
+                             else max_pending)
+        self._req_deadline_s = (cfg.request_deadline_ms
+                                if request_deadline_ms is None
+                                else request_deadline_ms) / 1e3
+        self._dispatch_timeout_s = (cfg.dispatch_timeout_s
+                                    if dispatch_timeout_s is None
+                                    else dispatch_timeout_s)
+        self._quarantine_threshold = (cfg.quarantine_threshold
+                                      if quarantine_threshold is None
+                                      else quarantine_threshold)
+        # fail-fast window after a watchdog trip whose recovery failed
+        self._cooldown_s = max(1.0, self._dispatch_timeout_s)
+        self._cooldown_until = 0.0
+        self._rebuild_timeout_s = max(30.0, 5 * self._dispatch_timeout_s)
+        self._dispatcher: _Dispatcher | None = None
+        # poisoned-batch bookkeeping: entry_id -> isolated failure count
+        self._offenders: dict[int, int] = {}
+        self._quarantined: set[int] = set()
+        # counters mirrored to the bus (serve.* names); stats_dict()
+        # snapshots them for serve_main's metrics JSON
+        self.shed = 0
+        self.deadline_exceeded = 0
+        self.poisoned = 0
+        self.quarantine_rejected = 0
+        self.watchdog_trips = 0
+        self.recovered = 0
+        self._pending: list[tuple[int, int, float, float, Future]] = []
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
+        self._draining = False
+        # an EXTERNAL drain request (begin_drain — SIGTERM); close()
+        # also stops admissions via _draining but is not "a drain"
+        self._drain_requested = False
+        self._drain_announced = False
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="serve-microbatch")
         self._worker.start()
@@ -58,21 +212,77 @@ class MicrobatchQueue:
 
     def submit(self, entry_id: int, ts_bucket: int) -> Future:
         """Enqueue one request; the Future resolves to its predicted
-        latency (label units) once its microbatch is served."""
+        latency (label units) once its microbatch is served, or to a
+        typed serve error. Raises QueueClosed / QueueFull /
+        RequestQuarantined at admission (fast-fail: a rejected request
+        never occupies a pending slot)."""
+        eid = int(entry_id)
         # size it NOW so an entry the engine has never seen fails the
         # caller, not the shared worker
-        self._engine.request_size(entry_id)
+        self._engine.request_size(eid)
         fut: Future = Future()
+        reject = counter = None
         with self._wake:
-            if self._closed:
-                raise RuntimeError("MicrobatchQueue is closed")
-            self._pending.append((int(entry_id), int(ts_bucket),
-                                  time.perf_counter(), fut))
-            self._wake.notify()
+            if self._closed or self._draining:
+                reject = QueueClosed(
+                    "MicrobatchQueue is closed"
+                    + (" (draining)" if self._draining else ""))
+            elif eid in self._quarantined:
+                self.quarantine_rejected += 1
+                counter = "serve.quarantine_rejected"
+                reject = RequestQuarantined(
+                    f"entry {eid} is quarantined (poisoned "
+                    f"{self._offenders.get(eid, 0)} microbatches)")
+            elif len(self._pending) >= self._max_pending:
+                self.shed += 1
+                counter = "serve.shed"
+                reject = QueueFull(
+                    f"pending set is at max_pending={self._max_pending}; "
+                    f"request shed")
+            else:
+                deadline = (time.perf_counter() + self._req_deadline_s
+                            if self._req_deadline_s > 0 else math.inf)
+                self._pending.append((eid, int(ts_bucket),
+                                      time.perf_counter(), deadline, fut))
+                self._wake.notify()
+        if reject is not None:
+            # counter emission OUTSIDE the lock: a telemetry disk write
+            # must not serialize the admission path — under overload the
+            # shed fast-path fires on every submit, exactly when the
+            # worker and other clients are contending for this lock
+            if counter is not None:
+                self._engine.bus.counter(counter, entry_id=eid)
+            raise reject
         return fut
 
-    def predict(self, entry_id: int, ts_bucket: int) -> float:
-        return float(self.submit(entry_id, ts_bucket).result())
+    def predict(self, entry_id: int, ts_bucket: int,
+                timeout: float | None = None) -> float:
+        """Blocking convenience; `timeout` bounds the wait on the Future
+        (concurrent.futures.TimeoutError past it) so a caller cannot
+        hang even with deadlines and the watchdog disabled."""
+        return float(self.submit(entry_id, ts_bucket).result(timeout))
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Stop admissions NOW (submit raises QueueClosed) while the
+        worker keeps flushing already-admitted requests. Safe to call
+        from a signal handler: it never blocks on the queue lock — the
+        flag write is enough (submit reads it under the lock), and the
+        worker wake-up is best-effort. `close()` completes the drain."""
+        self._draining = True
+        self._drain_requested = True
+        # the serve.drain_begin counter is emitted by the WORKER thread
+        # (next loop turn), not here: bus.counter takes the writer's
+        # non-reentrant lock and does file I/O — poison for a handler
+        # interrupting a thread that was mid-telemetry-write
+        if self._lock.acquire(blocking=False):
+            try:
+                self._wake.notify()
+            finally:
+                self._lock.release()
 
     def close(self) -> None:
         """Drain pending requests, then stop the worker. Idempotent."""
@@ -80,8 +290,17 @@ class MicrobatchQueue:
             if self._closed:
                 return
             self._closed = True
+            self._draining = True
             self._wake.notify()
         self._worker.join()
+        if self._drain_requested and not self._drain_announced:
+            # the worker never woke between begin_drain and close
+            # (empty queue); emit the marker from this safe context
+            self._drain_announced = True
+            self._engine.bus.counter("serve.drain_begin")
+        if self._dispatcher is not None:
+            self._dispatcher.close()
+            self._dispatcher = None
 
     def __enter__(self):
         return self
@@ -90,14 +309,30 @@ class MicrobatchQueue:
         self.close()
         return False
 
+    def stats_dict(self) -> dict:
+        """JSON-ready fault-path counters (the queue-side complement of
+        engine.stats_dict)."""
+        with self._lock:
+            return {
+                "shed": self.shed,
+                "deadline_exceeded": self.deadline_exceeded,
+                "poisoned": self.poisoned,
+                "quarantined_entries": sorted(self._quarantined),
+                "quarantine_rejected": self.quarantine_rejected,
+                "watchdog_trips": self.watchdog_trips,
+                "recovered": self.recovered,
+                "pending": len(self._pending),
+            }
+
     # -- worker side -----------------------------------------------------
 
-    def _take_batch_locked(self) -> list[tuple[int, int, float, Future]]:
+    def _take_batch_locked(self) -> list[tuple[int, int, float, float,
+                                               Future]]:
         """Pop the maximal capacity-respecting prefix of the pending list
         (submission order — alignment depends on it)."""
         g = n = e = 0
         take = 0
-        for entry_id, _ts, _t, _f in self._pending:
+        for entry_id, _ts, _t, _dl, _f in self._pending:
             dn, de = self._engine.request_size(entry_id)
             if take and (g + 1 > self._max_graphs
                          or n + dn > self._max_nodes
@@ -113,7 +348,7 @@ class MicrobatchQueue:
         """Would waiting longer be pointless? True once the pending
         prefix already saturates a top-bucket batch."""
         g = n = e = 0
-        for entry_id, _ts, _t, _f in self._pending:
+        for entry_id, _ts, _t, _dl, _f in self._pending:
             dn, de = self._engine.request_size(entry_id)
             if (g + 1 > self._max_graphs or n + dn > self._max_nodes
                     or e + de > self._max_edges):
@@ -121,44 +356,210 @@ class MicrobatchQueue:
             g, n, e = g + 1, n + dn, e + de
         return False
 
+    def _pop_expired_locked(self, now: float) -> list:
+        """Drop overdue requests from the pending set and RETURN them;
+        the caller resolves their futures OUTSIDE the lock (a Future
+        callback that re-enters the queue — an RPC front-end resubmitting
+        — must not deadlock on the non-reentrant lock)."""
+        if self._req_deadline_s <= 0:
+            return []
+        expired = [item for item in self._pending if item[3] <= now]
+        if expired:
+            self._pending[:] = [item for item in self._pending
+                                if item[3] > now]
+        return expired
+
+    def _fail_expired(self, expired: list) -> None:
+        """Resolve deadline-overdue requests — a future must never wait
+        forever. Called WITHOUT the lock held."""
+        for item in expired:
+            self.deadline_exceeded += 1
+            self._engine.bus.counter("serve.deadline_exceeded",
+                                     entry_id=item[0])
+            item[4].set_exception(DeadlineExceeded(
+                f"request for entry {item[0]} waited past its "
+                f"{self._req_deadline_s * 1e3:g}ms deadline without "
+                f"being dispatched"))
+
     def _run(self) -> None:
         while True:
+            expired: list = []
+            batch: list = []
             with self._wake:
                 while not self._pending and not self._closed:
                     self._wake.wait()
                 if not self._pending and self._closed:
                     return
-                # deadline anchored at the OLDEST queued request's ARRIVAL
-                # (not at worker observation: a request that queued while
-                # the worker was dispatching has already been waiting)
-                t_flush = self._pending[0][2] + self._deadline_s
-                while (not self._closed and not self._full_locked()):
-                    remaining = t_flush - time.perf_counter()
-                    if remaining <= 0:
+                # coalesce until the flush deadline (anchored at the
+                # OLDEST queued request's ARRIVAL — a request that
+                # queued while the worker was dispatching has already
+                # been waiting), capacity saturation, a request-deadline
+                # expiry, or close — whichever comes first
+                while not self._closed:
+                    now = time.perf_counter()
+                    expired += self._pop_expired_locked(now)
+                    if expired:
+                        break  # resolve them promptly, outside the lock
+                    if not self._pending or self._full_locked():
                         break
-                    self._wake.wait(timeout=remaining)
-                batch = self._take_batch_locked()
+                    t_flush = self._pending[0][2] + self._deadline_s
+                    if now >= t_flush:
+                        break
+                    t_wake = min([t_flush] + [p[3] for p in self._pending
+                                              if p[3] < math.inf])
+                    self._wake.wait(timeout=max(t_wake - now, 0.0))
+                now = time.perf_counter()
+                expired += self._pop_expired_locked(now)
+                # flush only when a flush condition held (an
+                # expiry-only wakeup goes back to coalescing)
+                if self._pending and (
+                        self._closed or self._full_locked()
+                        or now >= self._pending[0][2] + self._deadline_s):
+                    batch = self._take_batch_locked()
+            if self._drain_requested and not self._drain_announced:
+                self._drain_announced = True
+                self._engine.bus.counter("serve.drain_begin")
+            self._fail_expired(expired)
             if not batch:
                 continue
-            entries = [b[0] for b in batch]
-            buckets = [b[1] for b in batch]
-            futures = [b[3] for b in batch]
             # queue-wait stage of the request lifecycle: submit -> the
             # moment its microbatch leaves the queue for the engine
             t_now = time.perf_counter()
-            for _e, _ts, t_arrival, _f in batch:
+            for _e, _ts, t_arrival, _dl, _f in batch:
                 self._engine.record_queue_wait(t_now - t_arrival,
                                                coalesced=len(batch))
             try:
-                preds = self._engine.predict_microbatch(entries, buckets)
-            except BaseException as exc:
-                for f in futures:
-                    f.set_exception(exc)
-                continue
-            t_done = time.perf_counter()
-            for _e, _ts, t_arrival, _f in batch:
-                self._engine.bus.histogram("serve.request_total_ms",
-                                           (t_done - t_arrival) * 1e3,
-                                           level=2)
-            for f, p in zip(futures, preds):
-                f.set_result(float(p))
+                self._resolve(batch)
+            except BaseException as exc:  # never kill the worker thread
+                log.exception("unexpected worker-side failure; failing "
+                              "the batch's futures")
+                self._fail(batch, exc)
+
+    # -- failure handling ------------------------------------------------
+
+    @staticmethod
+    def _fail(batch, exc: BaseException) -> None:
+        for *_rest, fut in batch:
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def _resolve(self, batch, retried: bool = False) -> None:
+        """Dispatch one capacity-respecting batch and resolve its
+        futures — through the watchdog, the unhealthy fail-fast window,
+        and the poisoned-batch bisect."""
+        bus = self._engine.bus
+        if not self._engine.healthy:
+            if (time.perf_counter() < self._cooldown_until
+                    or not self._try_recover()):
+                bus.counter("serve.failfast", requests=len(batch))
+                self._fail(batch, EngineUnhealthy(
+                    f"engine unhealthy "
+                    f"({self._engine.unhealthy_reason}); failing fast "
+                    f"during cooldown"))
+                return
+        entries = [b[0] for b in batch]
+        ts_buckets = [b[1] for b in batch]
+        try:
+            preds = self._dispatch(entries, ts_buckets)
+        except DispatchTimeout as exc:
+            self._trip_watchdog(exc)
+            # a transient wedge must not cost innocent requests their
+            # predictions: one rebuild-from-store recovery, one retry
+            if not retried and self._try_recover():
+                self._resolve(batch, retried=True)
+            else:
+                self._fail(batch, exc)
+            return
+        except Exception as exc:
+            if len(batch) == 1:
+                self._record_offender(batch[0][0], exc)
+                self._fail(batch, exc)
+                return
+            # poisoned batch: bisect-retry so only the offending
+            # request(s) fail while innocent co-batched callers still
+            # get predictions (alignment is per-sub-batch, so surviving
+            # futures resolve to exactly their own outputs)
+            bus.counter("serve.bisect", graphs=len(batch))
+            log.warning("microbatch of %d failed (%s: %s); bisecting to "
+                        "isolate the poisoned request", len(batch),
+                        type(exc).__name__, exc)
+            mid = len(batch) // 2
+            self._resolve(batch[:mid], retried=retried)
+            self._resolve(batch[mid:], retried=retried)
+            return
+        t_done = time.perf_counter()
+        for _e, _ts, t_arrival, _dl, _f in batch:
+            bus.histogram("serve.request_total_ms",
+                          (t_done - t_arrival) * 1e3, level=2)
+        for (*_rest, fut), p in zip(batch, preds):
+            fut.set_result(float(p))
+
+    def _dispatch(self, entries, ts_buckets):
+        if self._dispatch_timeout_s <= 0:
+            return self._engine.predict_microbatch(entries, ts_buckets)
+        if self._dispatcher is None or self._dispatcher.dead:
+            self._dispatcher = _Dispatcher(self._engine)
+        return self._dispatcher.call(entries, ts_buckets,
+                                     self._dispatch_timeout_s)
+
+    def _trip_watchdog(self, exc: DispatchTimeout) -> None:
+        self.watchdog_trips += 1
+        self._engine.bus.counter("serve.watchdog_trip")
+        self._engine.mark_unhealthy(str(exc))
+        self._cooldown_until = time.perf_counter() + self._cooldown_s
+        self._dispatcher = None  # its thread may be wedged mid-call
+
+    def _try_recover(self) -> bool:
+        """ONE bounded rebuild-from-AOT-store attempt; True when the
+        engine is healthy again. The rebuild runs on an abandonable
+        thread too — recovery of a wedged device must not wedge the
+        worker."""
+        bus = self._engine.bus
+        finished, box = _call_abandonable(self._engine.rebuild,
+                                          self._rebuild_timeout_s,
+                                          "serve-rebuild")
+        if not finished or "error" in box:
+            err = box.get("error", "rebuild timed out")
+            log.error("engine rebuild failed (%s); failing fast for "
+                      "%.1fs", err, self._cooldown_s)
+            bus.counter("serve.recovery_failed")
+            self._cooldown_until = time.perf_counter() + self._cooldown_s
+            return False
+        self._engine.mark_recovered()
+        self.recovered += 1
+        bus.counter("serve.recovered")
+        self._cooldown_until = 0.0
+        # quarantine evidence predates the rebuild: failures during an
+        # engine-wide sick period (a wedging transport, a NaN streak)
+        # blame whichever entries happened to be in flight, and a
+        # permanent blackhole of legitimate traffic is worse than
+        # re-learning a genuinely poisoned entry over a few batches
+        with self._lock:
+            dropped = len(self._quarantined)
+            self._offenders.clear()
+            self._quarantined.clear()
+        if dropped:
+            log.warning("engine recovery amnestied %d quarantined "
+                        "entr%s (offender evidence reset)", dropped,
+                        "y" if dropped == 1 else "ies")
+        log.warning("engine recovered after watchdog trip (rebuild #%d)",
+                    self._engine.rebuilds)
+        return True
+
+    def _record_offender(self, entry_id: int, exc: Exception) -> None:
+        bus = self._engine.bus
+        with self._lock:
+            self.poisoned += 1
+            count = self._offenders[entry_id] = (
+                self._offenders.get(entry_id, 0) + 1)
+            newly_quarantined = (count >= self._quarantine_threshold
+                                 and entry_id not in self._quarantined)
+            if newly_quarantined:
+                self._quarantined.add(entry_id)
+        bus.counter("serve.poisoned", entry_id=entry_id,
+                    error=type(exc).__name__)
+        if newly_quarantined:
+            bus.counter("serve.quarantined", entry_id=entry_id)
+            log.error("entry %d quarantined: poisoned %d microbatches "
+                      "(threshold %d); rejecting it at submit from now "
+                      "on", entry_id, count, self._quarantine_threshold)

@@ -57,7 +57,8 @@ def _process_index() -> int:
         if getattr(xla_bridge, "_backends", None):
             return jax.process_index()
     except Exception:
-        pass
+        log.debug("no initialized backend to read a process index from; "
+                  "stamping pi=0")
     return 0
 
 

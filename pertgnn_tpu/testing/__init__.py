@@ -1,0 +1,13 @@
+"""Deterministic fault injection for robustness validation.
+
+Production code consults :mod:`pertgnn_tpu.testing.faults` at a handful
+of named hook sites (the serve dispatch, rung compiles, checkpoint
+saves). With no plan installed every hook is one module-global read —
+the subsystem costs nothing unless a test or benchmarks/chaos_bench.py
+arms it.
+"""
+
+from pertgnn_tpu.testing.faults import (FaultPlan, FaultSpec, InjectedFault,
+                                        active, install)
+
+__all__ = ["FaultPlan", "FaultSpec", "InjectedFault", "active", "install"]

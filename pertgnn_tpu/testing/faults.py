@@ -1,0 +1,223 @@
+"""Deterministic, seedable fault injection (the chaos half of serving).
+
+The serving path claims invariants under failure — no innocent request
+loses its prediction, no future hangs forever, watchdog trips recover —
+and claims need falsifiable tests, not hope. A ``FaultPlan`` is a list
+of ``FaultSpec``s armed at named hook sites in production code:
+
+===================  =====================================================
+site                 where it fires
+===================  =====================================================
+``serve.dispatch``   InferenceEngine.predict_microbatch, before the
+                     executable runs (``error`` raises, ``wedge`` stalls
+                     the dispatch, ``nan`` corrupts the batch output)
+``serve.compile``    InferenceEngine._compile (``error`` fails the rung)
+``checkpoint.save``  CheckpointManager.save (``corrupt`` garbles the
+                     just-committed step on disk)
+===================  =====================================================
+
+Faults address occurrences deterministically: ``nth=(3,)`` fires on the
+3rd call at that site, ``entry_id=7`` fires whenever entry 7 is in the
+dispatched microbatch (a *persistently* poisoned request — the shape
+quarantine must isolate), ``p=0.3`` fires pseudo-randomly from the
+plan's seeded RNG (same seed + same call sequence = same fire pattern,
+pinned by tests/test_faults.py).
+
+Arming a plan:
+
+- in-process: ``faults.install(plan)`` (tests), uninstall with
+  ``install(None)``;
+- cross-process: export ``PERTGNN_FAULT_PLAN=<plan.to_json()>`` before
+  spawning (how benchmarks/chaos_bench.py arms a real serve_main child).
+
+With no plan armed a hook site is one module-global read — production
+overhead is nil. This module imports nothing heavy (no jax, no numpy):
+importing it from the serve hot path is free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import random
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "PERTGNN_FAULT_PLAN"
+
+KINDS = ("error", "wedge", "nan", "corrupt")
+
+
+class InjectedFault(RuntimeError):
+    """The exception an armed ``error`` fault raises at its hook site."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One fault: where, what, and which occurrences."""
+
+    site: str
+    kind: str  # error | wedge | nan | corrupt
+    # 1-based occurrence numbers of `site` calls this spec fires on;
+    # empty = every occurrence that passes the other filters.
+    nth: tuple[int, ...] = ()
+    # Only fire when this entry id is in the dispatched microbatch
+    # (dispatch-site faults; None = any batch).
+    entry_id: int | None = None
+    # Stall duration for kind="wedge" (simulated device-transport hang).
+    wedge_s: float = 0.0
+    # Fire probability per matching occurrence, drawn from the plan's
+    # seeded RNG. 1.0 = always.
+    p: float = 1.0
+    message: str = ""
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(want one of {KINDS})")
+        object.__setattr__(self, "nth", tuple(int(n) for n in self.nth))
+
+
+class FaultPlan:
+    """A deterministic schedule of injected faults.
+
+    Thread-safe: the serve path fires hooks from the queue worker and
+    the dispatch watchdog thread; one lock serializes the occurrence
+    counters and the seeded RNG so the fire pattern is a pure function
+    of (specs, seed, call sequence)."""
+
+    def __init__(self, specs: list[FaultSpec] | tuple[FaultSpec, ...] = (),
+                 seed: int = 0):
+        self.specs = tuple(specs)
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self._calls: dict[str, int] = {}
+        # append-only record of (site, occurrence, kind) actually fired —
+        # what the determinism tests compare
+        self.fired: list[tuple[str, int, str]] = []
+
+    # -- the hook --------------------------------------------------------
+
+    def fire(self, site: str, *, entry_ids=None, sleep=time.sleep
+             ) -> str | None:
+        """Consume one occurrence of `site`; enact the matching fault.
+
+        ``error`` raises InjectedFault here; ``wedge`` sleeps wedge_s
+        here (the call site is mid-dispatch, so the sleep IS the stall);
+        ``nan`` / ``corrupt`` are returned as strings for the call site
+        to enact (it owns the output buffer / the checkpoint files).
+        Returns None when nothing fires. At most one spec fires per
+        occurrence (first match in plan order)."""
+        with self._lock:
+            n = self._calls.get(site, 0) + 1
+            self._calls[site] = n
+            spec = self._match_locked(site, n, entry_ids)
+            if spec is None:
+                return None
+            self.fired.append((site, n, spec.kind))
+        log.warning("fault injection: %s #%d -> %s%s", site, n, spec.kind,
+                    f" ({spec.message})" if spec.message else "")
+        if spec.kind == "error":
+            raise InjectedFault(
+                spec.message or f"injected {site} error (occurrence {n})")
+        if spec.kind == "wedge":
+            sleep(spec.wedge_s)
+        return spec.kind
+
+    def _match_locked(self, site, n, entry_ids) -> FaultSpec | None:
+        for spec in self.specs:
+            if spec.site != site:
+                continue
+            if spec.nth and n not in spec.nth:
+                continue
+            if spec.entry_id is not None:
+                if entry_ids is None or not any(
+                        int(e) == spec.entry_id for e in entry_ids):
+                    continue
+            if spec.p < 1.0 and self._rng.random() >= spec.p:
+                continue
+            return spec
+        return None
+
+    # -- (de)serialization: config/env injection -------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "specs": [dataclasses.asdict(s) for s in self.specs],
+        })
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        raw = json.loads(text)
+        specs = [FaultSpec(**{**s, "nth": tuple(s.get("nth", ()))})
+                 for s in raw.get("specs", ())]
+        return cls(specs, seed=raw.get("seed", 0))
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan | None":
+        """The plan exported in $PERTGNN_FAULT_PLAN, or None. A malformed
+        value raises — a chaos run with an unparseable plan must fail
+        loudly, not silently measure the happy path."""
+        text = os.environ.get(ENV_VAR, "")
+        return cls.from_json(text) if text else None
+
+
+# -- process-wide arming ------------------------------------------------
+
+_ACTIVE: FaultPlan | None = None
+_ENV_CHECKED = False
+
+
+def install(plan: FaultPlan | None) -> FaultPlan | None:
+    """Arm `plan` process-wide (None disarms). Returns the previous
+    plan so tests can restore it."""
+    global _ACTIVE, _ENV_CHECKED
+    prev = _ACTIVE
+    _ACTIVE = plan
+    _ENV_CHECKED = True  # explicit install wins over the env var
+    return prev
+
+
+def active() -> FaultPlan | None:
+    """The armed plan, if any. First call also adopts a plan from
+    $PERTGNN_FAULT_PLAN so spawned processes (chaos_bench children)
+    inherit their faults without code changes."""
+    global _ACTIVE, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        env_plan = FaultPlan.from_env()
+        if env_plan is not None:
+            _ACTIVE = env_plan
+            log.warning("fault plan armed from $%s: %d spec(s)", ENV_VAR,
+                        len(env_plan.specs))
+    return _ACTIVE
+
+
+# -- checkpoint corruption helper ---------------------------------------
+
+def corrupt_checkpoint_step(directory: str, step: int) -> int:
+    """Garble a committed orbax step in place (truncate every regular
+    file to a byte of junk) so a later restore of that step fails — the
+    on-disk half of the ``checkpoint.save``/``corrupt`` fault and the
+    fixture behind CheckpointManager.maybe_restore's fallback test.
+    Returns the number of files corrupted; raises if the step directory
+    does not exist (corrupting nothing must not pass silently)."""
+    step_dir = os.path.join(directory, str(step))
+    if not os.path.isdir(step_dir):
+        raise FileNotFoundError(f"no checkpoint step dir {step_dir!r}")
+    count = 0
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            path = os.path.join(root, name)
+            with open(path, "wb") as f:
+                f.write(b"\x00")
+            count += 1
+    log.warning("fault injection: corrupted checkpoint step %d (%d files "
+                "truncated) in %s", step, count, directory)
+    return count

@@ -50,6 +50,13 @@ class CheckpointManager:
                     history=ocp.args.JsonSave(metrics or {}),
                 ),
             )
+        from pertgnn_tpu.testing import faults
+        plan = faults.active()
+        if plan is not None and plan.fire("checkpoint.save") == "corrupt":
+            # the chaos half of maybe_restore's fallback: commit the
+            # step, then garble it on disk as a torn write would
+            self._mgr.wait_until_finished()
+            faults.corrupt_checkpoint_step(str(self._mgr.directory), epoch)
 
     def maybe_restore(self, state: TrainState) -> tuple[TrainState, int]:
         """Restore the latest checkpoint if present, directly INTO the
@@ -60,9 +67,18 @@ class CheckpointManager:
 
         Returns (state, start_epoch): start_epoch is one past the saved
         epoch, 0 when nothing is saved.
+
+        A corrupt/partial newest step (torn write, killed mid-commit,
+        bad disk) does NOT crash the resume path: it is logged, counted
+        (``checkpoint.restore_fallback``), and the next-oldest preserved
+        step is tried — losing one checkpoint interval of progress beats
+        losing the run. Only when EVERY preserved step fails does the
+        last error propagate (resuming from nothing would silently
+        discard all progress, which a supervisor restart loop must not
+        paper over).
         """
-        latest = self._mgr.latest_step()
-        if latest is None:
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
             return state, 0
 
         def abstract(leaf):
@@ -73,14 +89,34 @@ class CheckpointManager:
             return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
         target = jax.tree.map(abstract, state)
-        with telemetry.span("checkpoint.restore", epoch=latest):
-            restored = self._mgr.restore(
-                latest,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(target)),
-            )
-        log.info("restored checkpoint at epoch %d", latest)
-        return restored["state"], latest + 1
+        last_err: Exception | None = None
+        for step in steps:
+            try:
+                with telemetry.span("checkpoint.restore", epoch=step):
+                    restored = self._mgr.restore(
+                        step,
+                        args=ocp.args.Composite(
+                            state=ocp.args.StandardRestore(target)),
+                    )
+            except Exception as exc:
+                last_err = exc
+                log.warning(
+                    "checkpoint step %d failed to restore (%s: %s); "
+                    "falling back to the next-oldest preserved step",
+                    step, type(exc).__name__, exc)
+                telemetry.get_bus().counter("checkpoint.restore_fallback",
+                                            step=step,
+                                            error=type(exc).__name__)
+                continue
+            if step != steps[0]:
+                log.warning("restored FALLBACK checkpoint at epoch %d "
+                            "(newest step %d was corrupt); one "
+                            "checkpoint interval of progress re-trains",
+                            step, steps[0])
+            else:
+                log.info("restored checkpoint at epoch %d", step)
+            return restored["state"], step + 1
+        raise last_err
 
     def wait(self) -> None:
         with telemetry.span("checkpoint.wait"):

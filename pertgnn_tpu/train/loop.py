@@ -288,7 +288,7 @@ def _background(items: Iterator, depth: int = 2) -> Iterator:
             for it in items:
                 q.put(it)
             q.put(_END)
-        except BaseException as e:  # surface errors at the consumer
+        except BaseException as e:  # lint: allow-silent-except — surfaced at the consumer
             q.put(e)
 
     t = threading.Thread(target=produce, daemon=True)

@@ -61,9 +61,20 @@ def progress_token(progress_dir: str) -> tuple:
     return (tuple(entries), newest)
 
 
+def restart_backoff(consecutive_failures: int, base: float,
+                    cap: float) -> float:
+    """Seconds to wait before restart number `consecutive_failures`
+    (1-based): exponential from `base`, clamped at `cap`. Pure — the
+    backoff tests pin the schedule without sleeping through it."""
+    if consecutive_failures <= 0 or base <= 0:
+        return 0.0
+    return min(cap, base * (2.0 ** (consecutive_failures - 1)))
+
+
 def supervise(cmd: list[str], progress_dir: str, *,
               max_restarts: int = 3, hang_timeout: float = 900.0,
-              poll_interval: float = 5.0) -> int:
+              poll_interval: float = 5.0, backoff_base: float = 1.0,
+              backoff_cap: float = 60.0, min_uptime_s: float = 5.0) -> int:
     """Run `cmd` under crash/hang supervision; returns the final exit code
     (0 on eventual success, the last failure code once `max_restarts` is
     exhausted, 124 if the final attempt hung).
@@ -71,6 +82,17 @@ def supervise(cmd: list[str], progress_dir: str, *,
     `hang_timeout` must exceed the child's startup (data build + first
     compile) plus one checkpoint interval — progress is only visible at
     checkpoint granularity.
+
+    Restarts back off exponentially (`backoff_base` * 2^k, clamped at
+    `backoff_cap`) instead of respawning immediately: a child that dies
+    during startup (bad flag, wedged transport, poisoned cache) would
+    otherwise burn its whole restart budget in seconds. A child that
+    dies within `min_uptime_s` of spawn is the crash-loop signature —
+    counted separately (``supervisor.crash_loop``) so a dashboard can
+    tell "it keeps dying instantly" from "it trained for an hour and
+    crashed". A child that survives `min_uptime_s` resets the backoff
+    (the same restart discipline the serve watchdog applies to the
+    request path — docs/RELIABILITY.md).
     """
 
     def _kill_group(child) -> None:
@@ -97,12 +119,14 @@ def supervise(cmd: list[str], progress_dir: str, *,
     from pertgnn_tpu import telemetry
     bus = telemetry.get_bus()
     attempt = 0
+    consecutive_failures = 0
     child = None
     try:
         while True:
             attempt += 1
             log.info("supervisor: starting attempt %d/%d: %s",
                      attempt, max_restarts + 1, " ".join(cmd))
+            t_spawn = time.monotonic()
             child = subprocess.Popen(
                 cmd, env={**os.environ, CHILD_ENV_MARKER: "1"},
                 start_new_session=True)
@@ -130,14 +154,39 @@ def supervise(cmd: list[str], progress_dir: str, *,
                          attempt)
                 bus.counter("supervisor.completed", attempt=attempt)
                 return 0
-            log.warning("supervisor: child %s (rc=%s) on attempt %d",
-                        "hung" if hung else "died", rc, attempt)
+            uptime = time.monotonic() - t_spawn
+            log.warning("supervisor: child %s (rc=%s) on attempt %d "
+                        "after %.1fs", "hung" if hung else "died", rc,
+                        attempt, uptime)
             bus.counter("supervisor.hang" if hung else "supervisor.crash",
                         attempt=attempt, rc=rc)
+            # a child that ran for a while earned a clean slate; one
+            # that died within min_uptime_s is crash-looping — escalate
+            # the backoff instead of burning the restart budget in
+            # seconds (hangs always ran >= hang_timeout, so they reset)
+            if not hung and uptime < min_uptime_s:
+                consecutive_failures += 1
+                log.warning("supervisor: crash loop signature — child "
+                            "died within min_uptime_s=%.1fs (%d "
+                            "consecutive fast failures)", min_uptime_s,
+                            consecutive_failures)
+                bus.counter("supervisor.crash_loop",
+                            consecutive=consecutive_failures, rc=rc)
+            else:
+                consecutive_failures = 0
             if attempt > max_restarts:
                 log.error("supervisor: restart budget exhausted")
                 bus.counter("supervisor.budget_exhausted", rc=rc)
                 return rc
+            # every restart waits at least `backoff_base`; consecutive
+            # fast failures double it up to the cap
+            delay = restart_backoff(max(1, consecutive_failures),
+                                    backoff_base, backoff_cap)
+            if delay > 0:
+                log.info("supervisor: backing off %.1fs before restart",
+                         delay)
+                bus.gauge("supervisor.backoff_s", delay, attempt=attempt)
+                time.sleep(delay)
             bus.counter("supervisor.restart", attempt=attempt)
     finally:
         if child is not None and child.poll() is None:

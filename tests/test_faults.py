@@ -1,0 +1,437 @@
+"""Fault injection (pertgnn_tpu/testing/faults.py) and the hardened
+request path it validates (serve/queue.py, serve/engine.py,
+train/checkpoint.py — docs/RELIABILITY.md).
+
+The load-bearing guarantees:
+- a FaultPlan's fire pattern is a pure function of (specs, seed, call
+  sequence) — chaos runs are reproducible, not flaky;
+- a submitted Future ALWAYS resolves: shed, deadline, quarantine,
+  watchdog — every failure is a typed exception, never a hang;
+- bisect-retry isolates a poisoned request: innocent co-batched callers
+  get predictions BIT-IDENTICAL to a fault-free run;
+- a watchdog trip recovers via rebuild and retries the batch once, so a
+  transient wedge costs no caller their prediction;
+- a corrupt newest checkpoint falls back to the next-oldest preserved
+  step instead of crashing the resume path.
+"""
+
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+
+import numpy as np
+import pytest
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                ModelConfig, ServeConfig, TrainConfig)
+from pertgnn_tpu.serve.engine import InferenceEngine
+from pertgnn_tpu.serve.errors import (DeadlineExceeded, DispatchTimeout,
+                                      EngineUnhealthy, QueueClosed,
+                                      QueueFull, RequestQuarantined)
+from pertgnn_tpu.serve.queue import MicrobatchQueue
+from pertgnn_tpu.telemetry import MetricsWriter, TelemetryBus, load_events
+from pertgnn_tpu.testing import faults
+from pertgnn_tpu.testing.faults import FaultPlan, FaultSpec, InjectedFault
+from pertgnn_tpu.train.loop import restore_target_state
+
+# small model + coarse ladder: the fault tests rebuild (recompile) the
+# engine several times, so per-rung compile cost dominates runtime
+SERVE = ServeConfig(bucket_growth=2.0, min_bucket_nodes=256,
+                    min_bucket_edges=256, max_graphs_per_batch=8,
+                    dispatch_timeout_s=30.0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test starts and ends with no armed fault plan."""
+    prev = faults.install(None)
+    yield
+    faults.install(prev)
+
+
+@pytest.fixture(scope="module")
+def served(preprocessed):
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=200, batch_size=16),
+        model=ModelConfig(hidden_channels=8, num_layers=1),
+        train=TrainConfig(label_scale=1000.0),
+        serve=SERVE,
+        graph_type="pert",
+    )
+    ds = build_dataset(preprocessed, cfg)
+    _model, state = restore_target_state(ds, cfg)
+    engine = InferenceEngine.from_dataset(ds, cfg, state).warmup()
+    return ds, cfg, state, engine
+
+
+def _solo_preds(ds, engine, idx):
+    """Fault-free per-request predictions (each served alone) — the
+    bit-identical reference the fault paths must reproduce."""
+    s = ds.splits["test"]
+    return np.concatenate([
+        engine.predict_microbatch(s.entry_ids[i:i + 1],
+                                  s.ts_buckets[i:i + 1]) for i in idx])
+
+
+class TestFaultPlan:
+    def test_deterministic_fire_pattern(self):
+        specs = [FaultSpec(site="serve.dispatch", kind="nan", nth=(2, 5)),
+                 FaultSpec(site="serve.dispatch", kind="wedge", p=0.5,
+                           wedge_s=0.0),
+                 FaultSpec(site="serve.compile", kind="error")]
+        logs = []
+        for _ in range(2):
+            plan = FaultPlan(specs, seed=7)
+            for _i in range(10):
+                try:
+                    plan.fire("serve.dispatch", entry_ids=[1])
+                except InjectedFault:
+                    pass
+            logs.append(list(plan.fired))
+        assert logs[0] == logs[1]
+        # the nth spec fired exactly on occurrences 2 and 5
+        nans = [(n, k) for _s, n, k in logs[0] if k == "nan"]
+        assert nans == [(2, "nan"), (5, "nan")]
+
+    def test_json_round_trip_preserves_pattern(self):
+        plan = FaultPlan([FaultSpec(site="serve.dispatch", kind="error",
+                                    nth=(3,), entry_id=9, p=0.8)], seed=3)
+        clone = FaultPlan.from_json(plan.to_json())
+        assert clone.specs == plan.specs and clone.seed == plan.seed
+
+    def test_env_arming(self, monkeypatch):
+        plan = FaultPlan([FaultSpec(site="serve.dispatch", kind="nan")])
+        monkeypatch.setenv(faults.ENV_VAR, plan.to_json())
+        faults.install(None)
+        faults._ENV_CHECKED = False  # simulate a fresh process
+        armed = faults.active()
+        assert armed is not None and armed.specs == plan.specs
+
+    def test_kinds_and_filters(self):
+        slept = []
+        plan = FaultPlan([
+            FaultSpec(site="serve.dispatch", kind="error", entry_id=4),
+            FaultSpec(site="serve.dispatch", kind="wedge", wedge_s=1.5),
+        ])
+        # entry 4 absent: the error spec is skipped, the wedge fires
+        assert plan.fire("serve.dispatch", entry_ids=[1, 2],
+                         sleep=slept.append) == "wedge"
+        assert slept == [1.5]
+        with pytest.raises(InjectedFault):
+            plan.fire("serve.dispatch", entry_ids=[3, 4])
+        # unknown site: nothing ever fires
+        assert plan.fire("nope") is None
+
+    def test_rejects_unknown_kind(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSpec(site="s", kind="explode")
+
+
+class TestQuarantineBisect:
+    def test_innocents_survive_a_poisoned_batch_bit_identical(self, served):
+        """One persistently-poisoned entry fails every batch containing
+        it; bisect must hand every OTHER caller its exact fault-free
+        prediction and pin the exception on the poisoned one."""
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        k = min(8, len(s.entry_ids))
+        idx = list(range(k))
+        solo = _solo_preds(ds, engine, idx)
+        poison = int(s.entry_ids[k - 2])  # mid-batch, exercises both halves
+        faults.install(FaultPlan([FaultSpec(
+            site="serve.dispatch", kind="error", entry_id=poison,
+            message="poisoned request")]))
+        with MicrobatchQueue(engine, flush_deadline_ms=25,
+                             quarantine_threshold=100) as q:
+            futs = [q.submit(int(s.entry_ids[i]), int(s.ts_buckets[i]))
+                    for i in idx]
+            results = []
+            for i, f in enumerate(futs):
+                if int(s.entry_ids[i]) == poison:
+                    with pytest.raises(InjectedFault):
+                        f.result(timeout=60)
+                    results.append(None)
+                else:
+                    results.append(f.result(timeout=60))
+            assert q.poisoned >= 1
+        for i, (got, want) in enumerate(zip(results, solo)):
+            if got is not None:
+                assert got == float(want), f"request {i} misaligned"
+
+    def test_repeat_offender_is_quarantined_at_submit(self, served):
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        poison = int(s.entry_ids[0])
+        faults.install(FaultPlan([FaultSpec(
+            site="serve.dispatch", kind="error", entry_id=poison)]))
+        with MicrobatchQueue(engine, flush_deadline_ms=1,
+                             quarantine_threshold=2) as q:
+            for _ in range(2):
+                with pytest.raises(InjectedFault):
+                    q.predict(poison, int(s.ts_buckets[0]), timeout=60)
+            with pytest.raises(RequestQuarantined):
+                q.submit(poison, int(s.ts_buckets[0]))
+            assert q.quarantine_rejected == 1
+            # an innocent entry still serves normally (search every
+            # split: the test split can be single-entry)
+            other, other_ts = next(
+                (int(e), int(t)) for sp in ds.splits.values()
+                for e, t in zip(sp.entry_ids, sp.ts_buckets)
+                if int(e) != poison)
+            assert np.isfinite(q.predict(other, other_ts, timeout=60))
+            assert q.stats_dict()["quarantined_entries"] == [poison]
+
+
+class TestNaNGuard:
+    def test_transient_nan_is_quarantined_not_returned(self, served):
+        """A NaN batch output must never reach a caller: the batch is
+        retried via bisect (the transient fault has been consumed) and
+        every caller gets the real, bit-identical prediction."""
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        k = min(6, len(s.entry_ids))
+        idx = list(range(k))
+        solo = _solo_preds(ds, engine, idx)
+        nans0 = engine.nan_outputs
+        faults.install(FaultPlan([FaultSpec(
+            site="serve.dispatch", kind="nan", nth=(1,))]))
+        with MicrobatchQueue(engine, flush_deadline_ms=25) as q:
+            futs = [q.submit(int(s.entry_ids[i]), int(s.ts_buckets[i]))
+                    for i in idx]
+            got = np.asarray([f.result(timeout=60) for f in futs],
+                             np.float32)
+        assert engine.nan_outputs == nans0 + 1
+        assert np.isfinite(got).all()
+        np.testing.assert_array_equal(got, solo)
+
+
+class TestWatchdog:
+    def test_transient_wedge_recovers_and_retries(self, served):
+        """One dispatch wedges past the timeout: the watchdog trips,
+        rebuild recovers the engine, the batch is retried once, and NO
+        caller loses a prediction."""
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        k = min(4, len(s.entry_ids))
+        idx = list(range(k))
+        solo = _solo_preds(ds, engine, idx)
+        rebuilds0 = engine.rebuilds
+        faults.install(FaultPlan([FaultSpec(
+            site="serve.dispatch", kind="wedge", wedge_s=3.0, nth=(1,))]))
+        with MicrobatchQueue(engine, flush_deadline_ms=25,
+                             dispatch_timeout_s=0.3) as q:
+            futs = [q.submit(int(s.entry_ids[i]), int(s.ts_buckets[i]))
+                    for i in idx]
+            got = np.asarray([f.result(timeout=120) for f in futs],
+                             np.float32)
+            assert q.watchdog_trips == 1
+            assert q.recovered == 1
+        np.testing.assert_array_equal(got, solo)
+        assert engine.healthy
+        assert engine.rebuilds == rebuilds0 + 1
+
+    def test_persistent_wedge_fails_fast_then_heals(self, served):
+        """A wedge that outlives the one recovery retry fails the batch
+        with a typed error and fail-fasts subsequent batches through the
+        cooldown — no future ever hangs — then serves again once the
+        fault clears and the cooldown expires."""
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        eid, tsb = int(s.entry_ids[0]), int(s.ts_buckets[0])
+        faults.install(FaultPlan([FaultSpec(
+            site="serve.dispatch", kind="wedge", wedge_s=2.0)]))
+        with MicrobatchQueue(engine, flush_deadline_ms=1,
+                             dispatch_timeout_s=0.2) as q:
+            with pytest.raises(DispatchTimeout):
+                q.predict(eid, tsb, timeout=120)
+            assert q.watchdog_trips == 2  # first trip + failed retry
+            assert not engine.healthy
+            # inside the cooldown: fail fast, not queue-behind-a-wedge
+            with pytest.raises(EngineUnhealthy):
+                q.predict(eid, tsb, timeout=60)
+            faults.install(None)  # transport un-wedges
+            time.sleep(q._cooldown_s + 0.1)
+            got = q.predict(eid, tsb, timeout=120)
+            assert q.recovered >= 1
+        assert engine.healthy
+        assert np.isfinite(got)
+
+
+class TestAdmissionAndDeadlines:
+    def test_overload_sheds_with_queue_full(self, served):
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        eid, tsb = int(s.entry_ids[0]), int(s.ts_buckets[0])
+        with MicrobatchQueue(engine, flush_deadline_ms=10_000,
+                             max_pending=3) as q:
+            futs = [q.submit(eid, tsb) for _ in range(3)]
+            with pytest.raises(QueueFull):
+                q.submit(eid, tsb)
+            assert q.shed == 1
+            # the admitted requests are NOT casualties of the overload:
+            # close() drains them to real predictions
+        for f in futs:
+            assert np.isfinite(f.result(timeout=60))
+
+    def test_request_deadline_resolves_instead_of_waiting(self, served):
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        with MicrobatchQueue(engine, flush_deadline_ms=30_000,
+                             request_deadline_ms=50) as q:
+            fut = q.submit(int(s.entry_ids[0]), int(s.ts_buckets[0]))
+            with pytest.raises(DeadlineExceeded):
+                fut.result(timeout=10)
+            assert q.deadline_exceeded == 1
+
+    def test_predict_timeout_bounds_the_blocking_caller(self, served):
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        with MicrobatchQueue(engine, flush_deadline_ms=30_000) as q:
+            t0 = time.perf_counter()
+            with pytest.raises(FutureTimeout):
+                q.predict(int(s.entry_ids[0]), int(s.ts_buckets[0]),
+                          timeout=0.1)
+            assert time.perf_counter() - t0 < 5.0
+
+    def test_drain_stops_admissions_but_flushes_in_flight(self, served):
+        ds, cfg, _state, engine = served
+        s = ds.splits["test"]
+        q = MicrobatchQueue(engine, flush_deadline_ms=200)
+        try:
+            futs = [q.submit(int(s.entry_ids[i]), int(s.ts_buckets[i]))
+                    for i in range(min(3, len(s.entry_ids)))]
+            q.begin_drain()
+            assert q.draining
+            with pytest.raises(QueueClosed):
+                q.submit(int(s.entry_ids[0]), int(s.ts_buckets[0]))
+        finally:
+            q.close()
+        for f in futs:
+            assert np.isfinite(f.result(timeout=60))
+
+
+class TestCompileFault:
+    def test_rung_compile_failure_is_loud(self, served):
+        ds, cfg, state, _engine = served
+        faults.install(FaultPlan([FaultSpec(site="serve.compile",
+                                            kind="error", nth=(1,))]))
+        fresh = InferenceEngine.from_dataset(ds, cfg, state)
+        with pytest.raises(InjectedFault):
+            fresh.warmup()
+
+
+class TestCheckpointFallback:
+    def _state(self, served):
+        ds, cfg, state, _engine = served
+        return state
+
+    def test_corrupt_newest_step_falls_back(self, served, tmp_path):
+        from pertgnn_tpu.train.checkpoint import CheckpointManager
+
+        state = self._state(served)
+        writer = MetricsWriter(str(tmp_path / "tele"))
+        bus = TelemetryBus(writer, level="trace")
+        prev = telemetry.set_bus(bus)
+        try:
+            # the checkpoint.save/corrupt fault garbles step 1 on disk
+            # right after its commit — the torn-write signature
+            faults.install(FaultPlan([FaultSpec(
+                site="checkpoint.save", kind="corrupt", nth=(2,))]))
+            mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=3)
+            mgr.save(0, state)
+            mgr.save(1, state)
+            mgr.wait()
+            restored, start_epoch = mgr.maybe_restore(state)
+            mgr.close()
+        finally:
+            telemetry.set_bus(prev)
+            bus.close()
+        assert start_epoch == 1  # fell back to step 0, not crashed
+        names = [e["name"] for e in load_events(writer.path)]
+        assert "checkpoint.restore_fallback" in names
+
+    def test_all_steps_corrupt_raises(self, served, tmp_path):
+        from pertgnn_tpu.train.checkpoint import CheckpointManager
+
+        state = self._state(served)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=3)
+        mgr.save(0, state)
+        mgr.wait()
+        faults.corrupt_checkpoint_step(str(tmp_path / "ckpt"), 0)
+        with pytest.raises(Exception):
+            mgr.maybe_restore(state)
+        mgr.close()
+
+    def test_corrupt_helper_requires_existing_step(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            faults.corrupt_checkpoint_step(str(tmp_path), 7)
+
+
+@pytest.mark.slow
+def test_sigterm_drain_exits_zero(tmp_path):
+    """End-to-end through a REAL serve_main process: SIGTERM mid-stream
+    stops admissions, flushes in-flight batches, and exits 0 with
+    drained:true — preemption of a serving replica is not a crash.
+    (benchmarks/chaos_bench.py asserts the same invariant; this is the
+    tier-2 pin. The fast in-process drain semantics are covered by
+    TestAdmissionAndDeadlines.test_drain_stops_admissions...)"""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+    import urllib.request
+
+    import pandas as pd
+
+    from pertgnn_tpu.cli import train_main
+
+    ckpt = str(tmp_path / "ckpt")
+    art = str(tmp_path / "art")
+    common = ["--synthetic", "--synthetic_entries", "2",
+              "--synthetic_traces_per_entry", "60",
+              "--min_traces_per_entry", "5", "--label_scale", "1000",
+              "--artifact_dir", art, "--checkpoint_dir", ckpt]
+    train_main.main([*common, "--epochs", "1"])
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import Config, IngestConfig, TrainConfig
+    from pertgnn_tpu.ingest.io import load_artifacts
+    pre, table = load_artifacts(art)
+    ds = build_dataset(pre, Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        train=TrainConfig(label_scale=1000.0)), table)
+    s = ds.splits["train"]
+    req_csv = str(tmp_path / "req.csv")
+    pd.DataFrame({"entry_id": [int(s.entry_ids[0])] * 50_000,
+                  "ts_bucket": [int(s.ts_buckets[0])] * 50_000,
+                  }).to_csv(req_csv, index=False)
+    port = 18000 + (os.getpid() % 2000)
+    child = subprocess.Popen(
+        [sys.executable, "-m", "pertgnn_tpu.cli.serve_main", *common,
+         "--requests", req_csv, "--concurrency", "2",
+         "--flush_deadline_ms", "5", "--health_port", str(port),
+         "--out", str(tmp_path / "served.csv")],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    deadline = time.monotonic() + 600
+    ready = False
+    while time.monotonic() < deadline and child.poll() is None:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2) as r:
+                if r.status == 200:
+                    ready = True
+                    break
+        except OSError:
+            time.sleep(0.5)
+    assert ready, "healthz probe never came up"
+    time.sleep(1.0)
+    child.send_signal(signal.SIGTERM)
+    out, _ = child.communicate(timeout=300)
+    assert child.returncode == 0
+    stats = json.loads([ln for ln in out.splitlines()
+                        if ln.startswith("{")][-1])
+    assert stats["drained"] is True
+    assert 0 < stats["served"] < 50_000

@@ -130,6 +130,90 @@ def test_supervisor_death_takes_the_child_with_it(tmp_path):
         pytest.fail("child survived its supervisor")
 
 
+def test_restart_backoff_schedule():
+    """Pure schedule: exponential from base, clamped at cap, zero when
+    disabled — pinned without sleeping through it."""
+    assert supervisor.restart_backoff(0, 1.0, 60.0) == 0.0
+    assert supervisor.restart_backoff(1, 1.0, 60.0) == 1.0
+    assert supervisor.restart_backoff(2, 1.0, 60.0) == 2.0
+    assert supervisor.restart_backoff(3, 1.0, 60.0) == 4.0
+    assert supervisor.restart_backoff(9, 1.0, 60.0) == 60.0  # capped
+    assert supervisor.restart_backoff(5, 0.0, 60.0) == 0.0  # disabled
+
+
+def test_crash_loop_backs_off_and_counts(tmp_path):
+    """A child that dies instantly is the crash-loop signature: each
+    restart must wait the (escalating) backoff instead of respawning
+    immediately, and supervisor.crash_loop must count every fast death
+    distinctly from plain supervisor.crash."""
+    import time as _time
+
+    from pertgnn_tpu import telemetry
+    from pertgnn_tpu.telemetry import (MetricsWriter, TelemetryBus,
+                                       load_events)
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    cmd = _script(tmp_path, "import sys; sys.exit(7)")
+    writer = MetricsWriter(str(tmp_path / "tele"))
+    bus = TelemetryBus(writer, level="trace")
+    prev = telemetry.set_bus(bus)
+    t0 = _time.monotonic()
+    try:
+        rc = supervisor.supervise(cmd, str(ckpt), max_restarts=2,
+                                  hang_timeout=60.0, poll_interval=0.1,
+                                  backoff_base=0.2, backoff_cap=0.3,
+                                  min_uptime_s=30.0)
+    finally:
+        telemetry.set_bus(prev)
+        bus.close()
+    elapsed = _time.monotonic() - t0
+    assert rc == 7
+    events = load_events(writer.path)
+    crash_loops = [e for e in events if e["name"] == "supervisor.crash_loop"]
+    assert len(crash_loops) == 3  # every attempt died within min_uptime
+    backoffs = [e["value"] for e in events
+                if e["name"] == "supervisor.backoff_s"]
+    assert backoffs == [0.2, 0.3]  # 0.2 * 2 = 0.4 clamped to the cap
+    assert elapsed >= 0.5  # the sleeps actually happened
+
+
+def test_long_uptime_is_not_a_crash_loop(tmp_path):
+    """A child that outlives min_uptime_s before dying must not count as
+    a crash loop (and the backoff stays at base)."""
+    from pertgnn_tpu import telemetry
+    from pertgnn_tpu.telemetry import (MetricsWriter, TelemetryBus,
+                                       load_events)
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    cmd = _script(tmp_path, f"""
+        import os, sys, time
+        marker = {str(tmp_path / 'ran_once')!r}
+        time.sleep(0.5)
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(3)
+        sys.exit(0)
+    """)
+    writer = MetricsWriter(str(tmp_path / "tele"))
+    bus = TelemetryBus(writer, level="trace")
+    prev = telemetry.set_bus(bus)
+    try:
+        rc = supervisor.supervise(cmd, str(ckpt), max_restarts=2,
+                                  hang_timeout=60.0, poll_interval=0.1,
+                                  backoff_base=0.1, backoff_cap=1.0,
+                                  min_uptime_s=0.3)
+    finally:
+        telemetry.set_bus(prev)
+        bus.close()
+    assert rc == 0
+    events = load_events(writer.path)
+    assert not [e for e in events if e["name"] == "supervisor.crash_loop"]
+    assert [e["value"] for e in events
+            if e["name"] == "supervisor.backoff_s"] == [0.1]
+
+
 def test_progress_token_tracks_entries_and_mtime(tmp_path):
     assert supervisor.progress_token(str(tmp_path / "nope")) == ("missing",)
     t0 = supervisor.progress_token(str(tmp_path))

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Lint: no silently-swallowed exceptions in pertgnn_tpu/.
+
+The reference codebase's failure story was bare ``except:`` blocks that
+ate errors and kept going — a training run that "finished" with half its
+batches silently dropped. This repo's rule, enforced in tier-1 via
+tests/test_check_excepts.py:
+
+1. bare ``except:`` is forbidden outright (it catches SystemExit and
+   KeyboardInterrupt too — nothing in a library should);
+2. an ``except Exception`` / ``except BaseException`` handler that
+   SWALLOWS (its body neither re-raises nor propagates via a bare
+   ``raise``) must leave a trace: a logging call, a ``warnings.warn``,
+   or a telemetry counter/gauge/event — failures may be survivable, but
+   never invisible.
+
+A deliberate, documented swallow that genuinely needs silence can carry
+``# lint: allow-silent-except`` on its ``except`` line; the escape is
+greppable, so every exemption stays reviewable.
+
+Usage: ``python tools/check_excepts.py [root ...]`` — prints one line
+per violation, exits 1 if any. Defaults to the repo's pertgnn_tpu/.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+PRAGMA = "lint: allow-silent-except"
+
+# A Call whose func is an Attribute with one of these names counts as
+# "leaving a trace" (logger methods, warnings.warn, telemetry bus).
+_TRACE_ATTRS = {
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log",  # logger.log(level, ...)
+    "counter", "gauge", "histogram", "event",  # telemetry bus
+}
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except (reported separately, but also broad)
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in BROAD:
+            return True
+    return False
+
+
+def _leaves_trace(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True  # not a swallow: it propagates
+        if isinstance(node, ast.Return) and node.value is not None:
+            # `return some_call(...)` style fallbacks still swallow —
+            # only an explicit trace call below counts
+            pass
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _TRACE_ATTRS:
+                return True
+            if isinstance(fn, ast.Name) and fn.id in ("warn", "print"):
+                # warnings.warn imported bare / loud CLI print
+                return True
+    return False
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [f"{path}:{exc.lineno}: unparseable ({exc.msg})"]
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if PRAGMA in line:
+            continue
+        if node.type is None:
+            out.append(f"{path}:{node.lineno}: bare `except:` is "
+                       f"forbidden (catch a specific type, or at widest "
+                       f"`Exception`)")
+            continue
+        if _is_broad(node) and not _leaves_trace(node):
+            out.append(
+                f"{path}:{node.lineno}: `except "
+                f"{ast.unparse(node.type)}` swallows silently — log it, "
+                f"count it on the telemetry bus, or re-raise "
+                f"(# {PRAGMA} to exempt deliberately)")
+    return out
+
+
+def check_tree(root: str) -> list[str]:
+    violations: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                violations.extend(check_file(os.path.join(dirpath, name)))
+    return violations
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        args = [os.path.join(repo, "pertgnn_tpu")]
+    violations = []
+    for root in args:
+        violations.extend(check_tree(root) if os.path.isdir(root)
+                          else check_file(root))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} silent-exception violation(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
