@@ -89,6 +89,24 @@ _CACHE_DIR = os.environ.get(
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  "benchmarks", "compile_cache"))
 
+# Persistent arena store (ISSUE 5): the synthetic workload's dataset
+# arenas persist across bench attempts keyed on the generator spec, so
+# a warm attempt skips the ingest+graph+featurize rebuild (minutes at
+# the TPU-sized corpus) the same way the compile cache skips XLA. Empty
+# env disables.
+_ARENA_DIR = os.environ.get(
+    "PERTGNN_ARENA_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "benchmarks", "arena_cache"))
+
+# Backend-probe verdict cache (ISSUE 5 satellite): BENCH_r05 burned
+# 4x75 s re-timing-out IDENTICAL dead-relay probes before every
+# fallback run of the round; the verdict now persists for
+# BENCH_PROBE_CACHE_TTL_S (default 1 h). `bench.py --reprobe` forces a
+# fresh probe.
+_PROBE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "benchmarks", "backend_probe.json")
+
 
 def _update_partial(**fields) -> None:
     """Merge fields into the partial-capture file (atomic rename so a kill
@@ -97,8 +115,8 @@ def _update_partial(**fields) -> None:
     try:
         with open(_PARTIAL) as f:
             data = json.load(f)
-    except Exception:
-        pass
+    except (OSError, ValueError):
+        pass  # absent/corrupt partial: start a fresh one
     data.update(fields)
     data["updated_unix_time"] = time.time()
     tmp = _PARTIAL + ".tmp"
@@ -111,7 +129,7 @@ def _read_json(path: str) -> dict | None:
     try:
         with open(path) as f:
             return json.load(f)
-    except Exception:
+    except (OSError, ValueError):
         return None
 
 
@@ -150,9 +168,13 @@ def build_workload(traces_per_entry: int = _TRACES_PER_ENTRY):
     from pertgnn_tpu.ingest import synthetic
     from pertgnn_tpu.ingest.preprocess import preprocess
 
+    spec = synthetic.SyntheticSpec(
+        num_microservices=60, num_entries=16, patterns_per_entry=4,
+        traces_per_entry=traces_per_entry, seed=42)
     cfg = Config(
         ingest=IngestConfig(min_traces_per_entry=5),
-        data=DataConfig(max_traces=1_000_000, batch_size=170),
+        data=DataConfig(max_traces=1_000_000, batch_size=170,
+                        arena_cache_dir=_ARENA_DIR),
         # the fused kernel runs compiled only on TPU; off-TPU it would
         # fall to (very slow) interpret mode. Keep the default segment
         # path either way: bench measures the flagship configuration.
@@ -161,11 +183,23 @@ def build_workload(traces_per_entry: int = _TRACES_PER_ENTRY):
         aot=CompileCacheConfig(cache_dir=_CACHE_DIR),
         graph_type="pert",
     )
-    data = synthetic.generate(synthetic.SyntheticSpec(
-        num_microservices=60, num_entries=16, patterns_per_entry=4,
-        traces_per_entry=traces_per_entry, seed=42))
-    pre = preprocess(data.spans, data.resources, cfg.ingest)
-    ds = build_dataset(pre, cfg)
+
+    def build():
+        data = synthetic.generate(spec)
+        pre = preprocess(data.spans, data.resources, cfg.ingest)
+        return build_dataset(pre, cfg)
+
+    if not _ARENA_DIR:
+        return build(), cfg
+    # warm attempts (and the --precompile stage before a capture
+    # window) reconstruct the dataset from the mmap'd arena store
+    # instead of re-running ingest+graph+featurize
+    from pertgnn_tpu.batching.arena_store import ArenaStore
+
+    import dataclasses as _dc
+
+    ds = ArenaStore(_ARENA_DIR).load_or_build(
+        cfg, {"kind": "synthetic-bench", **_dc.asdict(spec)}, build)
     return ds, cfg
 
 
@@ -515,9 +549,16 @@ def _probe_backend() -> bool:
     3 x 10 s pauses ~ 5.5 min) stays near the old single 240 s probe.
     Must run BEFORE the first jax import in this process. Returns True if
     the fallback engaged. Implementation is the shared polling probe in
-    pertgnn_tpu.cli.common (also used by the driver's entry())."""
+    pertgnn_tpu.cli.common (also used by the driver's entry()).
+
+    The verdict persists at benchmarks/backend_probe.json for the round
+    (BENCH_r05 re-paid the full 4x75 s timeout budget before EVERY
+    fallback run of the round); `--reprobe` forces a fresh probe."""
+    import sys
+
     from pertgnn_tpu.cli.common import probe_backend_or_fallback
-    return probe_backend_or_fallback()
+    return probe_backend_or_fallback(cache_path=_PROBE_CACHE,
+                                     reprobe="--reprobe" in sys.argv[1:])
 
 
 def _git_state() -> tuple[str | None, bool | None]:
@@ -528,13 +569,13 @@ def _git_state() -> tuple[str | None, bool | None]:
         commit = subprocess.run(
             ["git", "-C", here, "rev-parse", "HEAD"], capture_output=True,
             text=True, timeout=10).stdout.strip()
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
         commit = None
     try:
         dirty = bool(subprocess.run(
             ["git", "-C", here, "status", "--porcelain"],
             capture_output=True, text=True, timeout=10).stdout.strip())
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
         dirty = None
     return commit, dirty
 

@@ -1,0 +1,400 @@
+"""Persistent on-disk arena store: the data path's cold-start killer.
+
+PR 3 made every *executable* resumable from disk; every process still
+re-ran the entire host data path from raw inputs — preprocess → graph
+construct → mixture collation → featurize — just to rebuild the SAME
+``MixtureArena`` / ``FeatureArena`` byte for byte. This module persists
+those arenas (plus the per-epoch-invariant pack metadata: splits,
+budget, vocab sizes, the resource-lookup table) as ``.npy`` files under
+``--arena_cache_dir``, memory-mapped back on load, so a warm process
+skips ingest entirely: its first epoch gathers from the mmap'd arenas
+exactly as the cold process gathered from the freshly built ones —
+bit-identical batches by construction
+(benchmarks/pipeline_bench.py asserts it across real processes).
+
+Keying reuses the AOT content-hash machinery (``aot/keys.cache_key``):
+sha256 over the ingest/data/graph Config subtree that shapes the arenas,
+the arena-relevant model fields (``use_node_depth``,
+``feature_all_stage_copies``, ``missing_indicator_is_one``), and a
+caller-supplied raw-input fingerprint (synthetic spec, or the artifact/
+CSV tree's file stats — ``cli/common.raw_input_fingerprint``). A miss
+with other entries present diffs the persisted components and names the
+changed ingredient loudly (same discipline as ``aot/store.py``); a
+corrupt or truncated entry logs a warning and falls back to a fresh
+build — never a crash.
+
+Telemetry (docs/OBSERVABILITY.md): ``arena.cache_hit`` /
+``arena.cache_miss`` (reason ``absent``/``corrupt``),
+``arena.invalidated``, ``arena.build_seconds`` /
+``arena.load_seconds`` / ``arena.save_seconds`` histograms, and the
+``arena.mmap_bytes`` gauge (bytes now served from mmap instead of
+rebuilt RAM).
+
+TRUST BOUNDARY: entries are plain ``.npy`` arrays + a JSON manifest —
+no pickle, no code execution at load (unlike the executable store). But
+the arrays ARE the training data: whoever can write the cache dir can
+silently alter every later run's features and labels. Point
+``--arena_cache_dir`` only at directories writable solely by the user
+running the jobs (docs/GUIDE.md §8).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import time
+
+import numpy as np
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.batching.arena import FeatureArena, MixtureArena
+from pertgnn_tpu.batching.featurize import ResourceLookup
+from pertgnn_tpu.batching.mixture import Mixture
+from pertgnn_tpu.batching.pack import BatchBudget
+
+log = logging.getLogger(__name__)
+
+# Bump to orphan every existing entry on a layout/semantics change of
+# the store itself (it rides inside the key via fn_id).
+_STORE_VERSION = 1
+_FN_ID = f"batching.arena_store.v{_STORE_VERSION}"
+
+_ARENA_FIELDS = ("node_start", "node_count", "edge_start", "edge_count",
+                 "ms_id", "node_depth", "pattern_prob", "pattern_size",
+                 "feature_mask", "senders", "receivers", "edge_iface",
+                 "edge_rpctype", "edge_duration")
+_FEAT_FIELDS = ("pair_of_example", "feat_start", "x")
+_SPLIT_FIELDS = ("entry_ids", "ts_buckets", "ys")
+_LOOKUP_FIELDS = ("ts", "ms", "values")
+
+
+def arena_cache_key(cfg, fingerprint: dict) -> tuple[str, dict]:
+    """(hex key, components) for one dataset's arenas.
+
+    Only the Config subtrees that shape the ARENAS are keyed: the whole
+    IngestConfig, the dataset-shaping DataConfig fields (NOT
+    shuffle_seed — epoch order is applied at pack time — and NOT
+    arena_cache_dir itself), graph_type, and the three model fields
+    baked into arena/feature content. Keying more would invalidate the
+    cache on knobs the arenas never see (lr, epochs, serve tuning)."""
+    from pertgnn_tpu import aot
+
+    data = cfg.data
+    config = {
+        "ingest": cfg.ingest,
+        "data": {k: getattr(data, k)
+                 for k in ("max_traces", "split", "batch_size",
+                           "max_nodes_per_batch", "max_edges_per_batch",
+                           "budget_headroom")},
+        "graph_type": cfg.graph_type,
+        "model": {k: getattr(cfg.model, k)
+                  for k in ("use_node_depth", "feature_all_stage_copies",
+                            "missing_indicator_is_one")},
+    }
+    # env={}: arenas are host artifacts — a jax upgrade or device change
+    # must NOT orphan them (contrast aot executables, which are welded
+    # to the lowering environment)
+    return aot.cache_key(fn_id=_FN_ID, config=config,
+                         args_sig=fingerprint, env={})
+
+
+def _slot_id(fingerprint: dict) -> str:
+    """The logical-input identity a key belongs to — the arena twin of
+    the aot store's per-program `name` slot. Invalidation diagnostics
+    only compare entries WITHIN a slot: two different corpora (bench
+    workloads at different sizes, two artifact dirs) coexisting in one
+    store are not 'invalidation', and warning about them would teach
+    operators to ignore the one log line that matters. For file-backed
+    inputs the identity is (kind, dir) — edited files stay in-slot and
+    diff loudly; for synthetic specs the spec IS the input, so any spec
+    change is a different workload, not a drifted ingredient."""
+    import hashlib
+    import json as _json
+
+    from pertgnn_tpu.aot.keys import _canonical
+
+    if fingerprint.get("kind") in ("artifacts", "raw_csvs"):
+        ident: dict = {"kind": fingerprint["kind"],
+                       "dir": fingerprint.get("dir")}
+    else:
+        ident = fingerprint
+    blob = _json.dumps(_canonical(ident), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def mixtures_from_arena(arena: MixtureArena) -> dict[int, Mixture]:
+    """Reconstruct the per-entry Mixture dict from the flat arenas —
+    what the serving engine's request path (``pack_single``,
+    ``request_size``) needs from a warm cache without re-running graph
+    construction. Arrays are views (zero-copy over the mmap).
+
+    Edge order within each reconstructed mixture is the arena's
+    receiver-sorted order, not the construction order — packing is
+    bit-identical either way: the packer's stable receiver sort maps
+    both to the same final batch order (pinned by
+    tests/test_arena_store.py)."""
+    out: dict[int, Mixture] = {}
+    for e in range(len(arena.node_start)):
+        ns, nc = int(arena.node_start[e]), int(arena.node_count[e])
+        if ns < 0:
+            continue
+        es, ec = int(arena.edge_start[e]), int(arena.edge_count[e])
+        out[e] = Mixture(
+            entry_id=e,
+            senders=arena.senders[es:es + ec],
+            receivers=arena.receivers[es:es + ec],
+            edge_iface=arena.edge_iface[es:es + ec],
+            edge_rpctype=arena.edge_rpctype[es:es + ec],
+            edge_duration=arena.edge_duration[es:es + ec],
+            ms_id=arena.ms_id[ns:ns + nc],
+            node_depth=arena.node_depth[ns:ns + nc],
+            pattern_prob=arena.pattern_prob[ns:ns + nc],
+            pattern_size=arena.pattern_size[ns:ns + nc],
+            feature_mask=arena.feature_mask[ns:ns + nc],
+            num_nodes=nc, num_edges=ec)
+    return out
+
+
+class ArenaStore:
+    """Content-addressed dataset arenas under ``root``.
+
+    Layout: ``<root>/<key>/meta.json`` (key components + scalars +
+    array manifest) and one ``.npy`` per array, loaded with
+    ``np.load(mmap_mode="r")`` so a warm process pages in only what an
+    epoch actually gathers."""
+
+    def __init__(self, root: str, bus=None):
+        self.root = root
+        self._injected_bus = bus
+        os.makedirs(root, exist_ok=True)
+
+    @property
+    def _bus(self):
+        return (self._injected_bus if self._injected_bus is not None
+                else telemetry.get_bus())
+
+    def _entry_dir(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    # -- the one-stop entry point ---------------------------------------
+
+    def load_or_build(self, cfg, fingerprint: dict, build_fn):
+        """The Dataset for (cfg, fingerprint): a hit reconstructs it
+        from mmap'd arrays (zero ingest / graph / featurize work), a
+        miss calls ``build_fn()`` (the full ingest path) and persists
+        the result for the next process."""
+        key, components = arena_cache_key(cfg, fingerprint)
+        slot = _slot_id(fingerprint)
+        ds = self.load(key, components, cfg, slot=slot)
+        if ds is not None:
+            return ds
+        bus = self._bus
+        t0 = time.perf_counter()
+        with bus.span("arena.build", key=key[:12]):
+            ds = build_fn()
+        bus.histogram("arena.build_seconds", time.perf_counter() - t0)
+        self.save(key, components, ds, slot=slot)
+        return ds
+
+    # -- load ------------------------------------------------------------
+
+    def load(self, key: str, components: dict, cfg, *,
+             slot: str | None = None):
+        """The cached Dataset for ``key``, or None (miss/corrupt — the
+        caller builds fresh and saves). ``slot`` scopes the miss
+        diagnostics to entries of the same logical input."""
+        bus = self._bus
+        d = self._entry_dir(key)
+        meta_path = os.path.join(d, "meta.json")
+        if not os.path.exists(meta_path):
+            self._log_invalidation(key, components, slot)
+            bus.counter("arena.cache_miss", reason="absent")
+            return None
+        t0 = time.perf_counter()
+        try:
+            with bus.span("arena.load", key=key[:12]):
+                ds, mmap_bytes = self._load_dataset(d, cfg)
+        except Exception as e:
+            # corrupt/truncated/stale entry: NEVER crash the caller —
+            # rebuild fresh (the save overwrites this entry)
+            log.warning("corrupt arena store entry %s (%s: %s) — falling "
+                        "back to a fresh build", key, type(e).__name__, e)
+            bus.counter("arena.cache_miss", reason="corrupt")
+            return None
+        dt = time.perf_counter() - t0
+        bus.counter("arena.cache_hit")
+        bus.histogram("arena.load_seconds", dt)
+        bus.gauge("arena.mmap_bytes", mmap_bytes)
+        log.info("arena store: hit %s (%.1f MiB mmap'd in %.3fs) — ingest "
+                 "+ graph construction + featurization skipped",
+                 key, mmap_bytes / 2**20, dt)
+        return ds
+
+    def _load_dataset(self, d: str, cfg):
+        from pertgnn_tpu.batching.dataset import Dataset, Split
+
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        if meta.get("store_version") != _STORE_VERSION:
+            raise ValueError(f"store version {meta.get('store_version')!r}"
+                             f" != {_STORE_VERSION}")
+        mmap_bytes = 0
+
+        def arr(name: str):
+            nonlocal mmap_bytes
+            path = os.path.join(d, f"{name}.npy")
+            a = np.load(path, mmap_mode="r")
+            mmap_bytes += a.nbytes
+            return a
+
+        arena = MixtureArena(**{f: arr(f"arena_{f}")
+                                for f in _ARENA_FIELDS})
+        feats = FeatureArena(**{f: arr(f"feat_{f}") for f in _FEAT_FIELDS})
+        lookup = ResourceLookup.from_arrays(
+            arr("lookup_ts"), arr("lookup_ms"), arr("lookup_values"),
+            missing_indicator_is_one=cfg.model.missing_indicator_is_one)
+        splits, feat_slices = {}, {}
+        off = 0
+        for name in meta["split_names"]:
+            splits[name] = Split(**{f: arr(f"split_{name}_{f}")
+                                    for f in _SPLIT_FIELDS})
+            feat_slices[name] = slice(off, off + len(splits[name]))
+            off += len(splits[name])
+        if off != len(feats.pair_of_example):
+            raise ValueError(
+                f"split rows ({off}) do not cover the feature arena's "
+                f"examples ({len(feats.pair_of_example)})")
+        s = meta["scalars"]
+        return Dataset(
+            mixtures=mixtures_from_arena(arena), lookup=lookup,
+            budget=BatchBudget(**meta["budget"]), splits=splits,
+            num_ms=s["num_ms"], num_entries=s["num_entries"],
+            num_interfaces=s["num_interfaces"],
+            num_rpctypes=s["num_rpctypes"],
+            node_feature_dim=s["node_feature_dim"], config=cfg,
+            _arena=arena, _feat_all=feats,
+            _feat_slices=feat_slices), mmap_bytes
+
+    # -- save ------------------------------------------------------------
+
+    def save(self, key: str, components: dict, dataset, *,
+             slot: str | None = None) -> str | None:
+        """Persist a freshly built Dataset's arenas under ``key``.
+        Atomic: arrays land in a tmp dir renamed into place, so a kill
+        mid-write never leaves a torn entry (a torn entry would only
+        cost a rebuild anyway — the load path treats it as corrupt)."""
+        bus = self._bus
+        t0 = time.perf_counter()
+        final = self._entry_dir(key)
+        tmp = os.path.join(self.root, f".tmp.{key}.{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            arena = dataset.arena()
+            feats = dataset.feat_arena()  # also fixes the split slices
+            total = 0
+
+            def put(name: str, a) -> None:
+                nonlocal total
+                a = np.ascontiguousarray(np.asarray(a))
+                np.save(os.path.join(tmp, f"{name}.npy"), a)
+                total += a.nbytes
+
+            for f in _ARENA_FIELDS:
+                put(f"arena_{f}", getattr(arena, f))
+            for f in _FEAT_FIELDS:
+                put(f"feat_{f}", getattr(feats, f))
+            ts, ms, values = dataset.lookup.to_arrays()
+            put("lookup_ts", ts)
+            put("lookup_ms", ms)
+            put("lookup_values", values)
+            for name, split in dataset.splits.items():
+                for f in _SPLIT_FIELDS:
+                    put(f"split_{name}_{f}", getattr(split, f))
+            meta = {
+                "key": key, "slot": slot,
+                "store_version": _STORE_VERSION,
+                "created_unix_time": time.time(),
+                "split_names": list(dataset.splits),
+                "budget": {"max_graphs": dataset.budget.max_graphs,
+                           "max_nodes": dataset.budget.max_nodes,
+                           "max_edges": dataset.budget.max_edges},
+                "scalars": {
+                    "num_ms": dataset.num_ms,
+                    "num_entries": dataset.num_entries,
+                    "num_interfaces": dataset.num_interfaces,
+                    "num_rpctypes": dataset.num_rpctypes,
+                    "node_feature_dim": dataset.node_feature_dim,
+                },
+                **components,
+            }
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=1, sort_keys=True, default=str)
+            if os.path.isdir(final):
+                # an entry already exists: a racing writer's (entries
+                # are content-addressed and deterministic, so either
+                # copy is valid) or the corrupt one this build replaces
+                # — swap it out
+                old = f"{final}.old.{os.getpid()}"
+                os.replace(final, old)
+                os.replace(tmp, final)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.replace(tmp, final)
+        except Exception as e:
+            # a failed save must not fail the run the dataset was built
+            # FOR — next process rebuilds
+            log.warning("arena store: could not persist %s (%s: %s)",
+                        key, type(e).__name__, e)
+            shutil.rmtree(tmp, ignore_errors=True)
+            return None
+        dt = time.perf_counter() - t0
+        bus.histogram("arena.save_seconds", dt)
+        log.info("arena store: saved %s (%.1f MiB) in %.2fs", key,
+                 total / 2**20, dt)
+        return final
+
+    # -- invalidation diagnostics ---------------------------------------
+
+    def _log_invalidation(self, key: str, components: dict,
+                          slot: str | None) -> None:
+        """A miss while OTHER entries of the SAME logical input exist
+        means an ingredient changed since they were saved — name it
+        instead of rebuilding silently (same discipline and diff
+        machinery as aot/store.py, whose per-program `name` is this
+        store's `slot`). Entries of OTHER slots — different corpora
+        legitimately sharing the store, e.g. bench workloads at several
+        sizes — are not invalidation and stay silent."""
+        from pertgnn_tpu.aot import diff_components
+
+        prev = None
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return
+        for name in entries:
+            meta_path = os.path.join(self.root, name, "meta.json")
+            try:
+                with open(meta_path) as f:
+                    m = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if slot is not None and m.get("slot") != slot:
+                continue
+            if (prev is None or m.get("created_unix_time", 0)
+                    > prev.get("created_unix_time", 0)):
+                prev = m
+        if prev is None:
+            return
+        # file-stat fingerprints diff as one enormous list repr — keep
+        # each changed-ingredient line readable
+        changed = [c if len(c) <= 400 else c[:400] + "...<truncated>"
+                   for c in diff_components(prev, components)]
+        log.warning(
+            "arena store: invalidating (saved key %s != wanted %s); "
+            "changed: %s — rebuilding the arenas fresh",
+            prev.get("key", "?")[:12], key[:12],
+            "; ".join(changed) if changed else "unknown (metadata "
+            "predates these components)")
+        self._bus.counter("arena.invalidated")

@@ -47,9 +47,37 @@ class ResourceLookup:
             raise ValueError(
                 f"expected {NUM_RESOURCE_FEATURES} feature columns, got "
                 f"{feat_cols}")
-        self._values = resource_df[feat_cols].to_numpy(dtype=np.float32)
-        ts = resource_df["timestamp"].to_numpy(dtype=np.int64)
-        ms = resource_df["msname"].to_numpy(dtype=np.int64)
+        self._init_arrays(
+            resource_df["timestamp"].to_numpy(dtype=np.int64),
+            resource_df["msname"].to_numpy(dtype=np.int64),
+            resource_df[feat_cols].to_numpy(dtype=np.float32),
+            missing_indicator_is_one)
+
+    @classmethod
+    def from_arrays(cls, ts: np.ndarray, ms: np.ndarray,
+                    values: np.ndarray,
+                    missing_indicator_is_one: bool = True
+                    ) -> "ResourceLookup":
+        """Rebuild a lookup from `to_arrays()` output — the arena
+        store's persistence path (batching/arena_store.py): a warm
+        process reconstructs the table without the resource DataFrame
+        (and therefore without running ingest at all)."""
+        self = cls.__new__(cls)
+        self._init_arrays(np.asarray(ts, dtype=np.int64),
+                          np.asarray(ms, dtype=np.int64),
+                          np.asarray(values, dtype=np.float32),
+                          missing_indicator_is_one)
+        return self
+
+    def _init_arrays(self, ts: np.ndarray, ms: np.ndarray,
+                     values: np.ndarray,
+                     missing_indicator_is_one: bool) -> None:
+        if values.ndim != 2 or values.shape[1] != NUM_RESOURCE_FEATURES:
+            raise ValueError(
+                f"expected (rows, {NUM_RESOURCE_FEATURES}) feature "
+                f"values, got shape {values.shape}")
+        self._values = values
+        self._ts, self._ms = ts, ms
         self._packed = bool(np.all(self._in_bounds(ts, ms)))
         if self._packed:
             self._index = pd.Index(self._key(ts, ms))
@@ -57,6 +85,11 @@ class ResourceLookup:
             self._index = pd.MultiIndex.from_arrays([ts, ms])
         self.missing_indicator_is_one = missing_indicator_is_one
         self.num_features = NUM_RESOURCE_FEATURES + 1
+
+    def to_arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(ts_bucket, ms_id, values) — everything `from_arrays` needs
+        to reconstruct this table bit-identically."""
+        return self._ts, self._ms, self._values
 
     @staticmethod
     def _in_bounds(ts: np.ndarray, ms: np.ndarray) -> np.ndarray:

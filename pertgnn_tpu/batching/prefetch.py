@@ -1,0 +1,137 @@
+"""Bounded double-buffered prefetch: overlap host work with device compute.
+
+The staged-epoch path ships a whole epoch's recipes in one transfer, but
+past its MiB cap it used to degrade to FULLY SYNCHRONOUS per-chunk
+transfers — the host packs chunk i+1 only after the device finished
+chunk i, serializing the two halves of the pipeline exactly at the
+production scale where the corpus no longer fits the cap.
+PyTorch-Direct (arXiv:2101.07956) and DGL's async dataloader
+(arXiv:1909.01315) both treat this overlap as a first-class subsystem;
+this module is that subsystem for the repo's input path.
+
+``prefetch_iter(items, fn, depth)`` runs ``fn`` (host pack + the async
+``device_put``) over ``items`` on ONE background thread, ``depth``
+results ahead of the consumer, through a bounded queue:
+
+- **bit-identical** to the eager ``(fn(x) for x in items)`` — same
+  items, same order, same single-threaded ``fn`` call sequence (pinned
+  by tests/test_prefetch.py hypothesis properties);
+- an upstream/``fn`` exception is re-raised AT THE CONSUMER, after every
+  earlier item was yielded (never silently truncates an epoch);
+- closing the consumer early (``break`` out of an epoch, an interrupt)
+  stops the producer promptly and joins it — no thread leak, no
+  unbounded queue growth;
+- starvation accounting lands on the telemetry bus when the iterator
+  finishes (``prefetch.device_starved_s``: the consumer sat waiting for
+  the next batch — the HOST is the bottleneck; ``prefetch.host_starved_s``:
+  the producer sat blocked on a full queue — the DEVICE is the
+  bottleneck; plus ``prefetch.wall_s``), so a bench can attribute the
+  remaining fit-vs-ceiling gap to the correct side.
+
+``depth <= 0`` degrades to the eager synchronous loop (the A/B control
+benchmarks/pipeline_bench.py measures against).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Iterator
+
+from pertgnn_tpu import telemetry
+
+# producer-side poll period while blocked on a full queue / handing over
+# the sentinel: bounds how long a closed consumer leaves the thread alive
+_POLL_S = 0.05
+
+
+class _Raised:
+    """Envelope carrying a producer-side exception to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def prefetch_iter(items: Iterable, fn: Callable | None = None,
+                  depth: int = 2, *, source: str = "prefetch",
+                  bus=None) -> Iterator:
+    """Yield ``fn(item)`` for each item, computed up to ``depth`` ahead
+    on a background thread. ``fn=None`` is the identity. ``depth<=0``
+    is the eager synchronous path (no thread, no queue) — the oracle
+    the property tests compare against."""
+    if fn is None:
+        fn = lambda x: x  # noqa: E731
+    if depth <= 0:
+        for it in items:
+            yield fn(it)
+        return
+
+    bus = bus if bus is not None else telemetry.get_bus()
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END = object()
+    # producer-side starvation total, read by the consumer only after
+    # join() — no lock needed
+    host_starved = [0.0]
+
+    def _put(item) -> bool:
+        """Blocking put that aborts when the consumer closed early;
+        returns False on abort. Time blocked counts as host starvation
+        (the queue is full: the device side is the bottleneck)."""
+        t0 = time.perf_counter()
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=_POLL_S)
+                host_starved[0] += time.perf_counter() - t0
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce() -> None:
+        try:
+            for it in items:
+                if stop.is_set():
+                    return
+                if not _put(fn(it)):
+                    return
+        except BaseException as exc:  # lint: allow-silent-except — re-raised at the consumer
+            _put(_Raised(exc))
+            return
+        _put(_END)
+
+    t = threading.Thread(target=produce, daemon=True,
+                         name=f"prefetch-{source}")
+    t_start = time.perf_counter()
+    device_starved = 0.0
+    t.start()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            item = q.get()
+            device_starved += time.perf_counter() - t0
+            if item is _END:
+                return
+            if isinstance(item, _Raised):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()
+        # release a producer blocked on a full queue, then join it so no
+        # thread outlives the iterator
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=10.0)
+        if bus.enabled:
+            wall = time.perf_counter() - t_start
+            bus.gauge("prefetch.device_starved_s", device_starved,
+                      source=source, depth=depth)
+            bus.gauge("prefetch.host_starved_s", host_starved[0],
+                      source=source, depth=depth)
+            bus.gauge("prefetch.wall_s", wall, source=source, depth=depth)

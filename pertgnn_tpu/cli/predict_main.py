@@ -22,12 +22,11 @@ import argparse
 import numpy as np
 import pandas as pd
 
-from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.batching.dataset import split_indices
 from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
                                     add_model_train_flags, add_serve_flags,
                                     add_telemetry_flags, apply_platform_env,
-                                    config_from_args,
+                                    build_dataset_cached, config_from_args,
                                     load_or_ingest_artifacts,
                                     setup_compile_cache, setup_telemetry)
 from pertgnn_tpu.train.loop import restore_target_state
@@ -132,8 +131,12 @@ def main(argv=None) -> None:
         p.error(f"no checkpoint steps in {args.checkpoint_dir!r}")
     _check_train_config(p, ckpt, cfg, args.allow_config_mismatch)
 
+    # the trace table is needed for the output rows (traceid/runtime_id)
+    # regardless, so prediction loads the L0-L2 artifacts either way;
+    # --arena_cache_dir still skips graph construction + mixture
+    # collation + featurization on a warm hit
     pre, table = load_or_ingest_artifacts(args, cfg.ingest)
-    dataset = build_dataset(pre, cfg, table)
+    dataset = build_dataset_cached(args, cfg, pre_table=(pre, table))
 
     model, state = restore_target_state(dataset, cfg)
     state, start_epoch = ckpt.maybe_restore(state)

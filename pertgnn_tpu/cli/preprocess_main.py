@@ -30,6 +30,14 @@ def main(argv=None) -> None:
     # ingest itself never compiles, but a shared --compile_cache_dir in a
     # pipeline script must not be a parse error on this CLI
     setup_compile_cache(args)
+    if args.arena_cache_dir:
+        # the arena store keys on model/graph fields this CLI does not
+        # parse; the dataset-building CLIs populate it on their first
+        # (cold) run — accepting the flag here keeps one shared flag set
+        # valid across a whole pipeline script
+        print("note: --arena_cache_dir is populated by the first "
+              "train/serve/predict run over these artifacts (this CLI "
+              "only produces the L0-L2 artifacts)")
     cfg = IngestConfig(min_traces_per_entry=args.min_traces_per_entry,
                        min_resource_coverage=args.min_resource_coverage)
     if artifacts_present(args.artifact_dir):

@@ -42,12 +42,10 @@ import time
 
 import numpy as np
 
-from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
                                     add_model_train_flags, add_serve_flags,
                                     add_telemetry_flags, apply_platform_env,
-                                    config_from_args,
-                                    load_or_ingest_artifacts,
+                                    build_dataset_cached, config_from_args,
                                     setup_compile_cache, setup_telemetry)
 from pertgnn_tpu.train.loop import restore_target_state
 from pertgnn_tpu.utils.logging import setup_logging
@@ -170,8 +168,10 @@ def main(argv=None) -> None:
             p.error(f"no checkpoint steps in {args.checkpoint_dir!r}")
         _check_train_config(p, ckpt, cfg, args.allow_config_mismatch)
 
-    pre, table = load_or_ingest_artifacts(args, cfg.ingest)
-    dataset = build_dataset(pre, cfg, table)
+    # --arena_cache_dir: a warm serve process reconstructs mixtures,
+    # lookup, budget and splits from the mmap'd arena store — zero
+    # ingest before the first request
+    dataset = build_dataset_cached(args, cfg)
     _model, state = restore_target_state(dataset, cfg)
     start_epoch = 0
     if ckpt is not None:

@@ -16,12 +16,10 @@ import argparse
 import os
 import sys
 
-from pertgnn_tpu.batching import build_dataset
 from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
                                     add_model_train_flags,
                                     add_telemetry_flags, apply_platform_env,
-                                    config_from_args,
-                                    load_or_ingest_artifacts,
+                                    build_dataset_cached, config_from_args,
                                     setup_compile_cache, setup_telemetry)
 from pertgnn_tpu.train import supervisor
 from pertgnn_tpu.train.loop import fit
@@ -107,8 +105,9 @@ def main(argv=None) -> None:
     print(args)
     cfg = config_from_args(args)
 
-    pre, table = load_or_ingest_artifacts(args, cfg.ingest)
-    dataset = build_dataset(pre, cfg, table)
+    # --arena_cache_dir: a warm process reconstructs the dataset from
+    # the mmap'd arena store and skips ingest entirely
+    dataset = build_dataset_cached(args, cfg)
 
     mesh = None
     if args.data_parallel > 1 or args.model_parallel > 1:

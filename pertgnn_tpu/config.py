@@ -70,6 +70,17 @@ class DataConfig:
     budget_headroom: float = 1.1
     # Shuffle seed for the train split.
     shuffle_seed: int = 0
+    # Persistent arena store (batching/arena_store.py): memory-mapped
+    # .npy persistence of the MixtureArena / FeatureArena + pack
+    # metadata, keyed by a content hash over the ingest/data/graph
+    # config subtree and the raw-input fingerprint. A warm process
+    # reconstructs the dataset from mmap and skips ingest + graph
+    # construction + featurization entirely — the data-path twin of
+    # CompileCacheConfig.cache_dir. Empty = off.
+    # TRUST: entries are plain arrays (no pickle), but they ARE the
+    # training data — whoever can write this directory controls every
+    # later run's features/labels; keep it as private as checkpoints.
+    arena_cache_dir: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -193,7 +204,20 @@ class TrainConfig:
     # compact paths: single-device, and single-process mesh (sharded
     # staging with the epoch axis replicated); multi-host keeps per-chunk
     # assembly because each host owns only its slab.
-    stage_epoch_recipes: bool = True
+    # Tri-state: None = AUTO — staged on accelerator backends, DISABLED
+    # on the CPU backend where whole-epoch staging measured SLOWER than
+    # streaming (staged_over_unstaged 0.956, BENCH_r05: there is no
+    # transfer latency to amortize, only an extra epoch-sized copy).
+    # True/False (CLI --staged_epochs on|off) force it either way; the
+    # resolved decision is logged and counted (train.staging_decision).
+    stage_epoch_recipes: bool | None = None
+    # Depth of the bounded double-buffered prefetch
+    # (batching/prefetch.py) used where the input path streams per-chunk
+    # — today the over-cap staging fallback: the host packs + device_puts
+    # chunk i+1 on a background thread while the device computes chunk i.
+    # 0 = fully synchronous per-chunk transfers (the A/B control
+    # benchmarks/pipeline_bench.py measures against).
+    prefetch_depth: int = 2
     # Cap (MiB) on the host bytes staged per epoch by stage_epoch_recipes;
     # past it fit() falls back to per-chunk transfers so staging can never
     # blow HBM outside the arena budget accounting (ADVICE r4). Recipes
@@ -248,6 +272,16 @@ class ServeConfig:
     # microbatches (bisect-retry, serve/queue.py) is rejected at submit
     # with RequestQuarantined (counter serve.quarantined).
     quarantine_threshold: int = 3
+    # Overlapped dispatch (serve/queue.py): the queue worker packs the
+    # NEXT microbatch on the host while the device computes the current
+    # one (one batch in flight; result resolution deferred to a
+    # completion step). Every fault-tolerance invariant above holds
+    # unchanged — the fault hooks fire at the same sites, a failed
+    # completion bisects exactly like a failed synchronous dispatch
+    # (benchmarks/pipeline_bench.py re-runs the chaos scenarios under
+    # overlap). False = dispatch-and-wait (the pre-overlap behavior,
+    # the bench's throughput control).
+    overlap_dispatch: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

@@ -96,6 +96,40 @@ class _BucketStats:
     padded_edges: int = 0
 
 
+@dataclasses.dataclass
+class PackedMicrobatch:
+    """A host-packed request microbatch awaiting dispatch — the output
+    of ``pack_microbatch`` and the input of ``dispatch_packed``. Pure
+    host arrays: building one is safe on any thread while the engine's
+    single device thread computes a previous batch (the overlapped
+    queue's pipeline, serve/queue.py)."""
+
+    entry_ids: np.ndarray
+    idx: int              # ladder rung
+    batch: PackedBatch
+    n: int                # real nodes
+    e_tot: int            # real edges
+    # engine-attributed seconds so far (pack, then + dispatch). The
+    # aggregate `latency` recorder sums the three phase durations
+    # rather than anchoring on wall time: under overlapped dispatch the
+    # completion is DEFERRED past the next coalesce window, and that
+    # queue idle must not masquerade as engine latency in stats_dict.
+    engine_s: float
+
+
+@dataclasses.dataclass
+class InFlightBatch:
+    """A dispatched microbatch whose device result has NOT been waited
+    on yet — ``dispatch_packed``'s handle, resolved by
+    ``complete_microbatch``. ``out`` is the engine's (async) device
+    output; ``injected`` carries a fault-plan verdict for the completion
+    step to enact."""
+
+    packed: PackedMicrobatch
+    out: object
+    injected: str | None
+
+
 class InferenceEngine:
     """Bucketed AOT inference over one trained state.
 
@@ -317,10 +351,12 @@ class InferenceEngine:
         m = self._mixtures[int(entry_id)]
         return m.num_nodes, m.num_edges
 
-    def predict_microbatch(self, entry_ids, ts_buckets) -> np.ndarray:
-        """One bucket-shaped dispatch for a coalesced microbatch.
+    def pack_microbatch(self, entry_ids, ts_buckets) -> PackedMicrobatch:
+        """Host half of a dispatch: bucket selection + ``pack_single``
+        into the smallest fitting rung. Pure host work over read-only
+        state — the overlapped queue runs this on its worker thread
+        while the device computes the previous batch.
 
-        Returns per-request predictions in request order (label units).
         Raises RequestTooLarge if the microbatch exceeds the top rung —
         callers that cannot pre-size (predict_many, the queue) split
         instead."""
@@ -333,65 +369,98 @@ class InferenceEngine:
             raise RequestTooLarge(
                 f"microbatch of {g} graphs ({n} nodes, {e_tot} edges) "
                 f"exceeds the top bucket {self.ladder[-1]}")
+        t0 = time.perf_counter()
+        with self.stage_latency["pack"].time(), \
+                self._bus.span("serve.pack", level=2, bucket=idx,
+                               graphs=g):
+            batch = pack_single(self._mixtures, entry_ids,
+                                np.asarray(ts_buckets), self.ladder[idx],
+                                self._lookup,
+                                node_depth_in_x=self._node_depth_in_x)
+        return PackedMicrobatch(entry_ids=entry_ids, idx=idx, batch=batch,
+                                n=n, e_tot=e_tot,
+                                engine_s=time.perf_counter() - t0)
+
+    def dispatch_packed(self, packed: PackedMicrobatch) -> InFlightBatch:
+        """Device half, part 1: resolve the rung executable and launch
+        it (async — the returned handle's ``out`` is an in-flight device
+        computation). Single-threaded like every engine device call:
+        exactly one dispatch/complete runs at a time (the queue's worker
+        or its watchdog dispatcher owns the order)."""
         bus = self._bus
+        idx = packed.idx
         # fault-injection hook (pertgnn_tpu/testing/faults.py): "error"
         # raises here, "wedge" stalls here (mid-dispatch, where a real
-        # device-transport hang lives), "nan" corrupts the output below
-        # so the finite guard must catch it
+        # device-transport hang lives), "nan" marks the handle so the
+        # completion step corrupts the output and the finite guard must
+        # catch it
         plan = faults.active()
-        injected = (plan.fire("serve.dispatch", entry_ids=entry_ids)
+        injected = (plan.fire("serve.dispatch",
+                              entry_ids=packed.entry_ids)
                     if plan is not None else None)
-        with self.latency.time():
-            if idx in self._exe:
-                self.cache_hits += 1
-                bus.counter("serve.cache_hit", bucket=idx, level=2)
-                exe = self._exe[idx]
-            else:
-                self.cache_misses += 1
-                bus.counter("serve.cache_miss", bucket=idx,
-                            after_warmup=self._warmed)
-                if self._warmed:
-                    log.warning(
-                        "executable cache miss AFTER warmup for bucket %s "
-                        "— the ladder no longer covers the request range",
-                        self.ladder[idx])
-                exe = self._compile(idx)
-            bucket = self.ladder[idx]
-            # stage breakdown: pack (host featurize+copy) -> dispatch
-            # (program launch, async) -> compute (the block until the
-            # device result is host-readable: execution + D2H)
-            with self.stage_latency["pack"].time(), \
-                    bus.span("serve.pack", level=2, bucket=idx, graphs=g):
-                batch = pack_single(self._mixtures, entry_ids,
-                                    np.asarray(ts_buckets), bucket,
-                                    self._lookup,
-                                    node_depth_in_x=self._node_depth_in_x)
-            with self.stage_latency["dispatch"].time(), \
-                    bus.span("serve.dispatch", level=2, bucket=idx):
-                out = exe(self._variables, batch)
-            with self.stage_latency["compute"].time(), \
-                    bus.span("serve.compute", level=2, bucket=idx):
-                pred = np.asarray(out)[:g]
-            if injected == "nan":
-                pred = np.full_like(pred, np.nan)
-            # output guard: NEVER hand garbage to a caller. A non-finite
-            # prediction fails the batch (the queue's bisect then
-            # isolates the offending request; direct callers see the
-            # typed error instead of silently propagating NaN).
-            if not np.isfinite(pred).all():
-                bad = entry_ids[~np.isfinite(pred)]
-                self.nan_outputs += 1
-                bus.counter("serve.nan_outputs", bucket=idx,
-                            graphs=int(g))
-                log.error("non-finite model output for %d/%d requests "
-                          "(entries %s) — quarantining the batch",
-                          int((~np.isfinite(pred)).sum()), g,
-                          bad[:8].tolist())
-                raise NonFiniteOutput(
-                    f"model returned non-finite predictions for entries "
-                    f"{bad[:8].tolist()}")
+        # engine_s accounting starts BEFORE executable resolution: a
+        # post-warmup cache miss compiles on the serve path, and that
+        # multi-second stall must show up in the engine latency
+        # percentiles (as it did when predict_microbatch was one piece)
+        t0 = time.perf_counter()
+        if idx in self._exe:
+            self.cache_hits += 1
+            bus.counter("serve.cache_hit", bucket=idx, level=2)
+            exe = self._exe[idx]
+        else:
+            self.cache_misses += 1
+            bus.counter("serve.cache_miss", bucket=idx,
+                        after_warmup=self._warmed)
+            if self._warmed:
+                log.warning(
+                    "executable cache miss AFTER warmup for bucket %s "
+                    "— the ladder no longer covers the request range",
+                    self.ladder[idx])
+            exe = self._compile(idx)
+        with self.stage_latency["dispatch"].time(), \
+                bus.span("serve.dispatch", level=2, bucket=idx):
+            out = exe(self._variables, packed.batch)
+        packed.engine_s += time.perf_counter() - t0
+        return InFlightBatch(packed=packed, out=out, injected=injected)
+
+    def complete_microbatch(self, inflight: InFlightBatch) -> np.ndarray:
+        """Device half, part 2: block until the in-flight result is
+        host-readable, run the finite-output guard, account the batch.
+        Returns per-request predictions in request order (label units)."""
+        bus = self._bus
+        packed = inflight.packed
+        idx, g = packed.idx, len(packed.entry_ids)
+        entry_ids, n, e_tot = packed.entry_ids, packed.n, packed.e_tot
+        t0 = time.perf_counter()
+        with self.stage_latency["compute"].time(), \
+                bus.span("serve.compute", level=2, bucket=idx):
+            pred = np.asarray(inflight.out)[:g]
+        packed.engine_s += time.perf_counter() - t0
+        if inflight.injected == "nan":
+            pred = np.full_like(pred, np.nan)
+        # output guard: NEVER hand garbage to a caller. A non-finite
+        # prediction fails the batch (the queue's bisect then isolates
+        # the offending request; direct callers see the typed error
+        # instead of silently propagating NaN).
+        if not np.isfinite(pred).all():
+            bad = entry_ids[~np.isfinite(pred)]
+            self.nan_outputs += 1
+            bus.counter("serve.nan_outputs", bucket=idx, graphs=int(g))
+            log.error("non-finite model output for %d/%d requests "
+                      "(entries %s) — quarantining the batch",
+                      int((~np.isfinite(pred)).sum()), g,
+                      bad[:8].tolist())
+            raise NonFiniteOutput(
+                f"model returned non-finite predictions for entries "
+                f"{bad[:8].tolist()}")
+        # pack + dispatch + compute phase durations, NOT wall since pack
+        # start: an overlapped completion is deferred past the next
+        # coalesce window, and that queue idle belongs to
+        # serve.request_total_ms (the queue's metric), not here
+        self.latency.record_s(packed.engine_s)
         self.requests += g
         self.batches += 1
+        bucket = self.ladder[idx]
         bs = self._bucket_stats[idx]
         bs.dispatches += 1
         bs.real_nodes += n
@@ -401,6 +470,15 @@ class InferenceEngine:
         bus.histogram("serve.pad_waste", pad_waste(bucket, n, e_tot),
                       bucket=idx, level=2)
         return pred
+
+    def predict_microbatch(self, entry_ids, ts_buckets) -> np.ndarray:
+        """One bucket-shaped dispatch for a coalesced microbatch —
+        pack → dispatch → complete, synchronously. The overlapped queue
+        calls the three phases itself so the pack of batch k+1 runs
+        while the device computes batch k."""
+        return self.complete_microbatch(
+            self.dispatch_packed(self.pack_microbatch(entry_ids,
+                                                      ts_buckets)))
 
     def predict_many(self, entry_ids, ts_buckets) -> np.ndarray:
         """Predictions for an arbitrary request list, split greedily into

@@ -19,6 +19,18 @@ Semantics:
   preserved by construction: each flush packs its requests in submission
   order and fans the engine's per-request outputs back to the matching
   futures.
+- **Overlapped dispatch** (ServeConfig.overlap_dispatch, default on):
+  the worker packs microbatch k+1 on the host while the device computes
+  k — one batch in flight, its result resolution deferred to a
+  completion step (engine.pack_microbatch / dispatch_packed /
+  complete_microbatch). An in-flight batch is always completed before
+  the worker would block on an empty queue, before the next dispatch,
+  and at close — a future never waits on traffic that may never arrive.
+  Failure handling of a deferred completion routes through exactly the
+  synchronous handlers below (watchdog trip -> recover -> sync retry;
+  any other error -> sync bisect), so every fault invariant holds
+  unchanged under overlap (benchmarks/pipeline_bench.py re-asserts the
+  chaos scenarios on the overlapped path).
 
 Failure semantics (docs/RELIABILITY.md) — a submitted Future ALWAYS
 resolves, to a prediction or to a typed serve error (serve/errors.py):
@@ -96,14 +108,18 @@ def _call_abandonable(fn, timeout: float, name: str):
 
 class _Dispatcher:
     """One persistent daemon thread owning engine calls so the queue
-    worker can TIME OUT a wedged dispatch and abandon it (a blocked
-    device call raises nothing, ever — join is not an option). After a
-    timeout the dispatcher is dead: its thread may still be inside the
-    engine; the queue builds a fresh one for the next call.
+    worker can TIME OUT a wedged call and abandon it (a blocked device
+    call raises nothing, ever — join is not an option). After a timeout
+    the dispatcher is dead: its thread may still be inside the engine;
+    the queue builds a fresh one for the next call.
 
-    A PERSISTENT daemon thread, unlike ``_call_abandonable``'s per-call
-    spawn, so steady-state dispatches pay no thread start; the
-    why-not-ThreadPoolExecutor rationale lives on _call_abandonable."""
+    Calls are arbitrary thunks (`fn`) so the overlapped-dispatch path
+    can run its two device phases — launch (`dispatch_packed`) and
+    completion (`complete_microbatch`) — through the same single thread
+    that owns the engine-call ORDER. A PERSISTENT daemon thread, unlike
+    ``_call_abandonable``'s per-call spawn, so steady-state dispatches
+    pay no thread start; the why-not-ThreadPoolExecutor rationale lives
+    on _call_abandonable."""
 
     def __init__(self, engine: InferenceEngine):
         self._engine = engine
@@ -120,26 +136,24 @@ class _Dispatcher:
             item = self._calls.pop(0)
             if item is None:
                 return
-            box, entries, buckets = item
+            box, fn = item
             try:
-                box["value"] = self._engine.predict_microbatch(entries,
-                                                               buckets)
+                box["value"] = fn()
             except BaseException as exc:  # lint: allow-silent-except
                 box["error"] = exc  # re-raised by call() on the worker
             box["done"].set()
             if self.dead:
                 return
 
-    def call(self, entries, buckets, timeout: float):
+    def call(self, fn, timeout: float, what: str):
         box: dict = {"done": threading.Event()}
-        self._calls.append((box, entries, buckets))
+        self._calls.append((box, fn))
         self._have_call.release()
         if not box["done"].wait(timeout):
             self.dead = True
             raise DispatchTimeout(
-                f"engine dispatch of {len(entries)} request(s) exceeded "
-                f"{timeout:g}s (wedge signature); abandoning the dispatch "
-                f"thread")
+                f"{what} exceeded {timeout:g}s (wedge signature); "
+                f"abandoning the dispatch thread")
         if "error" in box:
             raise box["error"]
         return box["value"]
@@ -158,7 +172,8 @@ class MicrobatchQueue:
                  max_pending: int | None = None,
                  request_deadline_ms: float | None = None,
                  dispatch_timeout_s: float | None = None,
-                 quarantine_threshold: int | None = None):
+                 quarantine_threshold: int | None = None,
+                 overlap_dispatch: bool | None = None):
         cfg = engine._cfg.serve
         self._engine = engine
         self._deadline_s = (cfg.flush_deadline_ms
@@ -179,6 +194,13 @@ class MicrobatchQueue:
         self._quarantine_threshold = (cfg.quarantine_threshold
                                       if quarantine_threshold is None
                                       else quarantine_threshold)
+        # overlapped dispatch: pack microbatch k+1 while the device
+        # computes k (one batch in flight, completion deferred)
+        self._overlap = (cfg.overlap_dispatch if overlap_dispatch is None
+                         else overlap_dispatch)
+        # (batch, InFlightBatch) dispatched but not yet completed —
+        # worker-thread-only state
+        self._inflight: tuple[list, object] | None = None
         # fail-fast window after a watchdog trip whose recovery failed
         self._cooldown_s = max(1.0, self._dispatch_timeout_s)
         self._cooldown_until = 0.0
@@ -195,6 +217,7 @@ class MicrobatchQueue:
         self.quarantine_rejected = 0
         self.watchdog_trips = 0
         self.recovered = 0
+        self.overlapped = 0
         self._pending: list[tuple[int, int, float, float, Future]] = []
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -321,6 +344,8 @@ class MicrobatchQueue:
                 "quarantine_rejected": self.quarantine_rejected,
                 "watchdog_trips": self.watchdog_trips,
                 "recovered": self.recovered,
+                "overlap_dispatch": self._overlap,
+                "overlapped": self.overlapped,
                 "pending": len(self._pending),
             }
 
@@ -386,16 +411,20 @@ class MicrobatchQueue:
             expired: list = []
             batch: list = []
             with self._wake:
-                while not self._pending and not self._closed:
+                # an in-flight overlapped batch must be completed before
+                # the worker blocks indefinitely — a future must never
+                # wait on traffic that may never arrive
+                while (not self._pending and not self._closed
+                       and self._inflight is None):
                     self._wake.wait()
                 if not self._pending and self._closed:
-                    return
+                    break
                 # coalesce until the flush deadline (anchored at the
                 # OLDEST queued request's ARRIVAL — a request that
                 # queued while the worker was dispatching has already
                 # been waiting), capacity saturation, a request-deadline
                 # expiry, or close — whichever comes first
-                while not self._closed:
+                while self._pending and not self._closed:
                     now = time.perf_counter()
                     expired += self._pop_expired_locked(now)
                     if expired:
@@ -421,6 +450,9 @@ class MicrobatchQueue:
                 self._engine.bus.counter("serve.drain_begin")
             self._fail_expired(expired)
             if not batch:
+                # nothing flushed this turn: resolve the in-flight batch
+                # instead of holding its callers' futures hostage
+                self._finish_inflight()
                 continue
             # queue-wait stage of the request lifecycle: submit -> the
             # moment its microbatch leaves the queue for the engine
@@ -429,11 +461,16 @@ class MicrobatchQueue:
                 self._engine.record_queue_wait(t_now - t_arrival,
                                                coalesced=len(batch))
             try:
-                self._resolve(batch)
+                if self._overlap:
+                    self._pump_overlap(batch)
+                else:
+                    self._resolve(batch)
             except BaseException as exc:  # never kill the worker thread
                 log.exception("unexpected worker-side failure; failing "
                               "the batch's futures")
                 self._fail(batch, exc)
+        # closed + drained: the final in-flight batch still resolves
+        self._finish_inflight()
 
     # -- failure handling ------------------------------------------------
 
@@ -443,50 +480,119 @@ class MicrobatchQueue:
             if not fut.done():
                 fut.set_exception(exc)
 
+    def _health_gate(self, batch) -> bool:
+        """THE unhealthy-engine gate, shared by the synchronous and
+        overlapped dispatch paths so the recovery policy cannot
+        diverge between them: inside the fail-fast cooldown (or if
+        recovery fails) the batch is failed fast and dispatch must not
+        proceed. Returns True when dispatch may go ahead."""
+        if self._engine.healthy:
+            return True
+        if (time.perf_counter() < self._cooldown_until
+                or not self._try_recover()):
+            self._failfast(batch)
+            return False
+        return True
+
     def _resolve(self, batch, retried: bool = False) -> None:
-        """Dispatch one capacity-respecting batch and resolve its
-        futures — through the watchdog, the unhealthy fail-fast window,
-        and the poisoned-batch bisect."""
-        bus = self._engine.bus
-        if not self._engine.healthy:
-            if (time.perf_counter() < self._cooldown_until
-                    or not self._try_recover()):
-                bus.counter("serve.failfast", requests=len(batch))
-                self._fail(batch, EngineUnhealthy(
-                    f"engine unhealthy "
-                    f"({self._engine.unhealthy_reason}); failing fast "
-                    f"during cooldown"))
-                return
+        """Dispatch one capacity-respecting batch SYNCHRONOUSLY and
+        resolve its futures — through the watchdog, the unhealthy
+        fail-fast window, and the poisoned-batch bisect. Also the
+        overlapped path's error-recovery fallback: a bisect or a
+        post-recovery retry always runs synchronous, so the fault
+        invariants cannot depend on pipeline state."""
+        if not self._health_gate(batch):
+            return
         entries = [b[0] for b in batch]
         ts_buckets = [b[1] for b in batch]
         try:
             preds = self._dispatch(entries, ts_buckets)
         except DispatchTimeout as exc:
-            self._trip_watchdog(exc)
-            # a transient wedge must not cost innocent requests their
-            # predictions: one rebuild-from-store recovery, one retry
-            if not retried and self._try_recover():
-                self._resolve(batch, retried=True)
-            else:
-                self._fail(batch, exc)
+            self._recover_or_fail(batch, exc, retried=retried)
             return
-        except Exception as exc:
-            if len(batch) == 1:
-                self._record_offender(batch[0][0], exc)
-                self._fail(batch, exc)
-                return
-            # poisoned batch: bisect-retry so only the offending
-            # request(s) fail while innocent co-batched callers still
-            # get predictions (alignment is per-sub-batch, so surviving
-            # futures resolve to exactly their own outputs)
-            bus.counter("serve.bisect", graphs=len(batch))
-            log.warning("microbatch of %d failed (%s: %s); bisecting to "
-                        "isolate the poisoned request", len(batch),
-                        type(exc).__name__, exc)
-            mid = len(batch) // 2
-            self._resolve(batch[:mid], retried=retried)
-            self._resolve(batch[mid:], retried=retried)
+        except Exception as exc:  # lint: allow-silent-except — _fail_or_bisect logs/counts per sub-batch
+            self._fail_or_bisect(batch, exc, retried=retried)
             return
+        self._settle(batch, preds)
+
+    def _recover_or_fail(self, batch, exc: DispatchTimeout,
+                         retried: bool = False) -> None:
+        """THE watchdog recovery policy, in one place: trip, attempt
+        ONE rebuild-from-store recovery, retry the batch synchronously
+        once — a transient wedge must not cost innocent requests their
+        predictions; a second wedge (or failed recovery) fails them
+        with the timeout."""
+        self._trip_watchdog(exc)
+        if not retried and self._try_recover():
+            self._resolve(batch, retried=True)
+        else:
+            self._fail(batch, exc)
+
+    def _pump_overlap(self, batch) -> None:
+        """Overlapped dispatch: pack batch k+1 on THIS worker thread
+        while the device computes batch k (the in-flight batch), then
+        complete k, then launch k+1 — one batch in flight, result
+        resolution deferred to the completion step. Every failure path
+        routes through the same handlers as the synchronous _resolve,
+        so the PR-4 invariants (bisect quarantine, watchdog recovery,
+        fail-fast cooldown) hold unchanged."""
+        packed = pack_exc = None
+        try:
+            # host-only work (bucket select + pack_single over read-only
+            # state): safe while the single engine device thread still
+            # owns the in-flight batch — THE overlap this path exists for
+            packed = self._engine.pack_microbatch(
+                [b[0] for b in batch], [b[1] for b in batch])
+        except Exception as exc:  # lint: allow-silent-except — handed to _fail_or_bisect below
+            pack_exc = exc
+        self._finish_inflight()
+        if pack_exc is not None:
+            self._fail_or_bisect(batch, pack_exc, retried=False)
+            return
+        # completion may have tripped the watchdog; the packed batch
+        # follows the same fail-fast/recover gate as a sync dispatch
+        if not self._health_gate(batch):
+            return
+        try:
+            handle = self._engine_call(
+                lambda: self._engine.dispatch_packed(packed),
+                what=f"engine dispatch of {len(batch)} request(s)")
+        except DispatchTimeout as exc:
+            self._recover_or_fail(batch, exc)
+            return
+        except Exception as exc:  # lint: allow-silent-except — _fail_or_bisect logs/counts per sub-batch
+            self._fail_or_bisect(batch, exc, retried=False)
+            return
+        self._inflight = (batch, handle)
+        self.overlapped += 1
+        self._engine.bus.counter("serve.overlapped", level=2,
+                                 graphs=len(batch))
+
+    def _finish_inflight(self) -> None:
+        """Resolve the in-flight overlapped batch (if any): block for
+        its device result under the watchdog and settle its futures —
+        the deferred completion step. Failure handling mirrors a
+        synchronous dispatch exactly."""
+        if self._inflight is None:
+            return
+        batch, handle = self._inflight
+        self._inflight = None
+        try:
+            preds = self._engine_call(
+                lambda: self._engine.complete_microbatch(handle),
+                what=f"engine completion of {len(batch)} request(s)")
+        except DispatchTimeout as exc:
+            self._recover_or_fail(batch, exc)
+            return
+        except Exception as exc:  # lint: allow-silent-except — _fail_or_bisect logs/counts per sub-batch
+            self._fail_or_bisect(batch, exc, retried=False)
+            return
+        self._settle(batch, preds)
+
+    def _settle(self, batch, preds) -> None:
+        """Resolve a served batch's futures to their own predictions
+        (submission-order alignment) + per-request total latency."""
+        bus = self._engine.bus
         t_done = time.perf_counter()
         for _e, _ts, t_arrival, _dl, _f in batch:
             bus.histogram("serve.request_total_ms",
@@ -494,13 +600,58 @@ class MicrobatchQueue:
         for (*_rest, fut), p in zip(batch, preds):
             fut.set_result(float(p))
 
-    def _dispatch(self, entries, ts_buckets):
+    def _fail_or_bisect(self, batch, exc: Exception,
+                        retried: bool) -> None:
+        """A failed microbatch: a multi-request batch is bisect-retried
+        SYNCHRONOUSLY so only the poisoned request(s) fail while
+        innocent co-batched callers still get predictions (alignment is
+        per-sub-batch, so surviving futures resolve to exactly their
+        own outputs); a single request gets ONE fresh dispatch before
+        offender bookkeeping — the bisect halves of a multi-batch are
+        re-dispatched anyway, so without this a TRANSIENT fault (an
+        occurrence-addressed nan/error that has already been consumed)
+        would cost exactly the caller who happened to ride alone its
+        prediction, purely by coalescing luck."""
+        if len(batch) == 1:
+            if not retried:
+                self._engine.bus.counter("serve.retry_single",
+                                         entry_id=batch[0][0],
+                                         error=type(exc).__name__)
+                log.warning("single-request batch failed (%s: %s); one "
+                            "fresh dispatch before recording the "
+                            "offender", type(exc).__name__, exc)
+                self._resolve(batch, retried=True)
+                return
+            self._record_offender(batch[0][0], exc)
+            self._fail(batch, exc)
+            return
+        self._engine.bus.counter("serve.bisect", graphs=len(batch))
+        log.warning("microbatch of %d failed (%s: %s); bisecting to "
+                    "isolate the poisoned request", len(batch),
+                    type(exc).__name__, exc)
+        mid = len(batch) // 2
+        self._resolve(batch[:mid], retried=retried)
+        self._resolve(batch[mid:], retried=retried)
+
+    def _failfast(self, batch) -> None:
+        self._engine.bus.counter("serve.failfast", requests=len(batch))
+        self._fail(batch, EngineUnhealthy(
+            f"engine unhealthy ({self._engine.unhealthy_reason}); "
+            f"failing fast during cooldown"))
+
+    def _engine_call(self, fn, what: str):
+        """Run one engine device call: inline without a watchdog,
+        through the abandonable dispatcher thread with one."""
         if self._dispatch_timeout_s <= 0:
-            return self._engine.predict_microbatch(entries, ts_buckets)
+            return fn()
         if self._dispatcher is None or self._dispatcher.dead:
             self._dispatcher = _Dispatcher(self._engine)
-        return self._dispatcher.call(entries, ts_buckets,
-                                     self._dispatch_timeout_s)
+        return self._dispatcher.call(fn, self._dispatch_timeout_s, what)
+
+    def _dispatch(self, entries, ts_buckets):
+        return self._engine_call(
+            lambda: self._engine.predict_microbatch(entries, ts_buckets),
+            what=f"engine dispatch of {len(entries)} request(s)")
 
     def _trip_watchdog(self, exc: DispatchTimeout) -> None:
         self.watchdog_trips += 1

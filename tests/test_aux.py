@@ -301,6 +301,93 @@ class TestCLI:
         assert c.train.scan_chunk == 2
         assert c.data.budget_headroom == 1.3
 
+    def test_pipeline_flags_map_to_config(self):
+        """ISSUE 5 knobs: staging tri-state, prefetch depth, arena
+        cache dir, serve overlap — flags -> Config, including the
+        legacy --no_stage_epoch_recipes alias."""
+        import argparse
+
+        from pertgnn_tpu.cli.common import (add_ingest_flags,
+                                            add_model_train_flags,
+                                            add_serve_flags,
+                                            config_from_args)
+
+        def parse(argv):
+            p = argparse.ArgumentParser()
+            add_ingest_flags(p)
+            add_model_train_flags(p)
+            add_serve_flags(p)
+            return config_from_args(p.parse_args(argv))
+
+        c = parse([])
+        assert c.train.stage_epoch_recipes is None  # auto
+        assert c.train.prefetch_depth == 2
+        assert c.serve.overlap_dispatch is True
+        assert c.data.arena_cache_dir == ""
+        c = parse(["--staged_epochs", "on", "--prefetch_depth", "4",
+                   "--arena_cache_dir", "/tmp/ac",
+                   "--no_overlap_dispatch"])
+        assert c.train.stage_epoch_recipes is True
+        assert c.train.prefetch_depth == 4
+        assert c.data.arena_cache_dir == "/tmp/ac"
+        assert c.serve.overlap_dispatch is False
+        assert parse(["--staged_epochs", "off"]
+                     ).train.stage_epoch_recipes is False
+        # legacy alias forces off even at the auto default
+        assert parse(["--no_stage_epoch_recipes"]
+                     ).train.stage_epoch_recipes is False
+
+    def test_probe_verdict_cache_reused(self, tmp_path, monkeypatch,
+                                        capsys):
+        """A fresh cached verdict short-circuits the (minutes-long)
+        backend probe; a cached fallback also re-applies the CPU
+        platform env. BENCH_r05 burned 4x75 s per fallback run on
+        identical dead-relay probes."""
+        import json
+        import time as _time
+
+        from pertgnn_tpu.cli.common import probe_backend_or_fallback
+
+        cache = tmp_path / "probe.json"
+        cache.write_text(json.dumps(
+            {"fallback": True, "probed_unix_time": _time.time()}))
+        calls: list = []
+
+        def fake_run(*a, **k):
+            calls.append(1)
+            raise RuntimeError("probe subprocess failed")
+
+        monkeypatch.setattr("subprocess.run", fake_run)
+        monkeypatch.setenv("BENCH_PROBE_TRIES", "1")
+        monkeypatch.setenv("BENCH_PROBE_PAUSE", "0")
+        # JAX_PLATFORMS="" = probe-eligible; the cached verdict must
+        # answer WITHOUT spawning a probe subprocess
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        assert probe_backend_or_fallback(cache_path=str(cache)) is True
+        assert not calls  # fresh verdict: no probe ran
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+        assert "reused" in capsys.readouterr().err
+        # a STALE verdict is ignored: the (failing) probe actually runs
+        # and its fresh fallback verdict overwrites the cache
+        cache.write_text(json.dumps(
+            {"fallback": True,
+             "probed_unix_time": _time.time() - 10_000}))
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        assert probe_backend_or_fallback(cache_path=str(cache)) is True
+        assert calls  # stale cache re-probed
+        fresh = json.loads(cache.read_text())
+        assert fresh["fallback"] is True
+        assert _time.time() - fresh["probed_unix_time"] < 60
+        # a fresh HEALTHY verdict never short-circuits: the relay flaps
+        # on minute timescales, so only fallback verdicts are reusable —
+        # trusting a cached success would reopen the first-touch hang
+        cache.write_text(json.dumps(
+            {"fallback": False, "probed_unix_time": _time.time()}))
+        calls.clear()
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        assert probe_backend_or_fallback(cache_path=str(cache)) is True
+        assert calls  # healthy cache ignored: the probe ran (and failed)
+
     def test_train_cli_with_mesh_and_checkpoint(self, tmp_path, capsys):
         import jax
 

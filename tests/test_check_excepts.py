@@ -26,6 +26,27 @@ def test_package_is_clean():
     assert violations == []
 
 
+def test_benchmarks_and_bench_are_clean():
+    """The benchmarks are EXIT-CODE ORACLES (pipeline/chaos/coldstart
+    assert invariants in the return code) — a swallowed exception there
+    forges a green result, so they are lint scope too (ISSUE 5)."""
+    violations = []
+    for root in check_excepts.default_roots(REPO):
+        violations.extend(check_excepts.check_tree(root)
+                          if os.path.isdir(root)
+                          else check_excepts.check_file(root))
+    assert violations == []
+
+
+def test_default_scope_covers_benchmark_oracles():
+    roots = check_excepts.default_roots(REPO)
+    names = {os.path.basename(r) for r in roots}
+    assert "pertgnn_tpu" in names and "bench.py" in names
+    assert "pipeline_bench.py" in names and "chaos_bench.py" in names
+    # the vendored parity shim mimics a third-party API — out of scope
+    assert not any("parity" in r for r in roots)
+
+
 def test_bare_except_is_flagged(tmp_path):
     out = _lint(tmp_path, """
         try:

@@ -267,6 +267,89 @@ class TestMicrobatchQueue:
                 q.submit(10_000_000, 0)
 
 
+class TestOverlappedDispatch:
+    """ServeConfig.overlap_dispatch: pack batch k+1 while the device
+    computes k. The contract: bit-identical predictions, futures never
+    held hostage (idle completion, close flush), and the phase-split
+    engine API composes to exactly the synchronous call."""
+
+    def test_engine_phases_compose_to_predict_microbatch(self, served):
+        ds, _cfg, _state, engine = served
+        s = ds.splits["test"]
+        e = np.asarray(s.entry_ids[:3], np.int64)
+        t = np.asarray(s.ts_buckets[:3], np.int64)
+        whole = engine.predict_microbatch(e, t)
+        packed = engine.pack_microbatch(e, t)
+        phased = engine.complete_microbatch(engine.dispatch_packed(packed))
+        np.testing.assert_array_equal(whole, phased)
+
+    def test_overlap_bit_identical_to_sync(self, served):
+        import threading
+
+        ds, _cfg, _state, engine = served
+        s = ds.splits["test"]
+        k = min(24, len(s.entry_ids))
+        solo = np.concatenate([
+            engine.predict_microbatch(s.entry_ids[i:i + 1],
+                                      s.ts_buckets[i:i + 1])
+            for i in range(k)])
+
+        def drive(q):
+            preds = np.full(k, np.nan, np.float32)
+
+            def client(idx):
+                for i in idx:
+                    preds[i] = q.predict(int(s.entry_ids[i]),
+                                         int(s.ts_buckets[i]),
+                                         timeout=60)
+            threads = [threading.Thread(target=client,
+                                        args=(range(c, k, 4),))
+                       for c in range(4)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            return preds
+
+        with MicrobatchQueue(engine, flush_deadline_ms=5,
+                             overlap_dispatch=True) as q:
+            over = drive(q)
+            stats_over = q.stats_dict()
+        with MicrobatchQueue(engine, flush_deadline_ms=5,
+                             overlap_dispatch=False) as q:
+            sync = drive(q)
+            stats_sync = q.stats_dict()
+        np.testing.assert_array_equal(over, solo)
+        np.testing.assert_array_equal(sync, solo)
+        assert stats_over["overlap_dispatch"] is True
+        assert stats_over["overlapped"] >= 1
+        assert stats_sync["overlapped"] == 0
+
+    def test_inflight_completes_without_followup_traffic(self, served):
+        """A dispatched-in-overlap batch must resolve promptly when NO
+        further request ever arrives — the worker completes the
+        in-flight batch before blocking on an empty queue."""
+        ds, _cfg, _state, engine = served
+        s = ds.splits["test"]
+        with MicrobatchQueue(engine, flush_deadline_ms=1,
+                             overlap_dispatch=True) as q:
+            fut = q.submit(int(s.entry_ids[0]), int(s.ts_buckets[0]))
+            v = fut.result(timeout=30)
+        assert np.isfinite(v)
+
+    def test_close_flushes_inflight(self, served):
+        ds, _cfg, _state, engine = served
+        s = ds.splits["test"]
+        k = min(6, len(s.entry_ids))
+        q = MicrobatchQueue(engine, flush_deadline_ms=1,
+                            overlap_dispatch=True)
+        futs = [q.submit(int(s.entry_ids[i]), int(s.ts_buckets[i]))
+                for i in range(k)]
+        q.close()
+        for f in futs:
+            assert np.isfinite(f.result(timeout=1))
+
+
 def test_serve_cli_round_trip(tmp_path):
     """train_main writes a checkpoint; serve_main restores it and serves
     a split replay through the full queue+engine stack, emitting aligned
