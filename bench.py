@@ -178,7 +178,12 @@ def build_workload(traces_per_entry: int = _TRACES_PER_ENTRY):
         # the fused kernel runs compiled only on TPU; off-TPU it would
         # fall to (very slow) interpret mode. Keep the default segment
         # path either way: bench measures the flagship configuration.
-        model=ModelConfig(hidden_channels=32, num_layers=3),
+        # BENCH_ATTENTION_IMPL selects a kernel variant for capture A/Bs
+        # (segment | pallas | pallas_fused | blocked_dense); the result
+        # JSON stamps whichever ran (attention_impl + roofline fields).
+        model=ModelConfig(hidden_channels=32, num_layers=3,
+                          attention_impl=os.environ.get(
+                              "BENCH_ATTENTION_IMPL", "segment")),
         train=TrainConfig(lr=3e-4, label_scale=1000.0, scan_chunk=16),
         aot=CompileCacheConfig(cache_dir=_CACHE_DIR),
         graph_type="pert",
@@ -603,7 +608,9 @@ def _persist_last_good_tpu(result: dict, commit: str | None = None,
 def _assemble_result(*, fit_w, ceil_w, cceil_w, unstaged_w, flops_per_graph,
                      bytes_per_graph, baseline, backend, fallback,
                      train_graphs, partial_capture=False,
-                     peak_flops=None, peak_bw=None, device_kind=None):
+                     peak_flops=None, peak_bw=None, device_kind=None,
+                     attention_impl="segment", serve_dtype="f32",
+                     kernel_fallbacks=0):
     """Build the official result JSON from measured windows. Shared by the
     live path (main) and --finalize-partial (a wedge-killed capture with
     >=_MIN_FIT_WINDOWS usable fit windows); ceiling/A-B fields degrade to
@@ -680,6 +687,18 @@ def _assemble_result(*, fit_w, ceil_w, cceil_w, unstaged_w, flops_per_graph,
         "peak_flops_per_chip": peak_flops,
         "peak_hbm_bytes_per_s": peak_bw,
         "device_kind": device_kind,
+        # kernel-variant attribution (ISSUE 6): WHICH hot-path
+        # implementation and serve tier produced these numbers, so
+        # cross-round comparisons never mix variants silently. The
+        # training dtype is f32; serve_dtype only matters for serve
+        # captures but rides here for a uniform schema.
+        "attention_impl": attention_impl,
+        "serve_dtype": serve_dtype,
+        # trace-time fallbacks observed during the measured programs: a
+        # nonzero count means the numbers above (partly) ran the segment
+        # path regardless of what attention_impl claims — --gate refuses
+        # such a capture as a witness for its variant
+        "kernel_fallbacks": int(kernel_fallbacks or 0),
         "baseline_torch_cpu_graphs_per_s": round(baseline, 1),
         "backend": backend,
         "backend_fallback": fallback,
@@ -749,13 +768,153 @@ def finalize_partial() -> int:
         partial_capture=True,
         peak_flops=p.get("peak_flops_per_chip"),
         peak_bw=p.get("peak_hbm_bytes_per_s"),
-        device_kind=p.get("device_kind"))
+        device_kind=p.get("device_kind"),
+        attention_impl=p.get("attention_impl", "segment"),
+        serve_dtype=p.get("serve_dtype", "f32"),
+        kernel_fallbacks=p.get("kernel_fallbacks", 0))
     if result["backend"] == "tpu":
         _persist_last_good_tpu(result, commit=p.get("commit"),
                                dirty=p.get("dirty_worktree"))
     _discard_partials()
     print(json.dumps(result))
     return 0
+
+
+def _history_records(root: str | None = None) -> list[dict]:
+    """BENCH_r*.json round artifacts next to this file — the recorded
+    throughput history `--gate` checks a finished run against. Rounds
+    whose capture failed (rc != 0, no parsed record, no headline value)
+    are skipped: they recorded an outage, not a throughput."""
+    import glob
+
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r[0-9]*.json"))):
+        d = _read_json(path)
+        if not d or d.get("rc") not in (0, None):
+            continue
+        parsed = d.get("parsed")
+        if isinstance(parsed, dict) and parsed.get("value"):
+            parsed = dict(parsed)
+            parsed["_round"] = os.path.basename(path)
+            out.append(parsed)
+    return out
+
+
+def gate_check(result: dict, history: list[dict]) -> tuple[bool, dict]:
+    """Throughput-regression verdict for one finished run against the
+    BENCH_r* history (pure function; tested in tests/test_bench_gate.py).
+
+    Comparable = same backend AND same kernel variant — a blocked_dense
+    capture is not a regression witness for a segment history row. The
+    reference is the MOST RECENT comparable round (the trajectory's
+    current state — older rounds ran on differently-loaded hosts; r03 vs
+    r05 differ 33% on identical code, so gating on the historical max
+    would flag host variance, not code). The floor is that round's
+    headline minus ITS recorded fit-window spread: the noise band the
+    capture itself measured. Below the floor = a real drop, not window
+    jitter — exit nonzero, so this and every future perf PR is
+    falsifiable. No comparable history passes vacuously (a first capture
+    on a new backend/variant records a baseline, it cannot regress
+    one)."""
+    backend = result.get("backend")
+    impl = result.get("attention_impl", "segment")
+    metric = result.get("metric")
+    comparable = [h for h in history
+                  if h.get("backend") == backend
+                  and h.get("attention_impl", "segment") == impl
+                  # early rounds measured a DIFFERENT metric (r01/r02:
+                  # per-call graphs/s, no backend field) — a row is only
+                  # a witness for the same headline metric. Wildcard
+                  # when either side predates the metric stamp.
+                  and (metric is None or h.get("metric") is None
+                       or h.get("metric") == metric)]
+    detail = {"backend": backend, "attention_impl": impl,
+              "comparable_rounds": [h["_round"] for h in comparable
+                                    if "_round" in h]}
+    nfall = int(result.get("kernel_fallbacks") or 0)
+    if impl != "segment" and nfall:
+        # the capture CLAIMS a kernel variant but its programs (partly)
+        # traced the segment fallback — it is not a witness for this
+        # variant's history, and passing it would launder segment numbers
+        detail["kernel_fallbacks"] = nfall
+        detail["verdict"] = (
+            f"FAIL: {nfall} trace-time kernel fallback(s) — the capture "
+            f"claims attention_impl={impl} but ran the segment path")
+        return False, detail
+    if not comparable:
+        detail["verdict"] = "pass (no comparable history)"
+        return True, detail
+    ref = comparable[-1]  # rounds sort by filename = chronology
+    spread_pct = float(ref.get("fit_spread_pct") or 0.0)
+    value = float(result.get("value") or 0.0)
+    # headline direction: latency metrics regress UPWARD — gate against
+    # the reference plus its spread, not minus (a serve_bench p50 row in
+    # the history must fail on a doubling, not on an improvement)
+    lower_is_better = (result.get("unit") == "ms"
+                       or str(metric or "").endswith("_ms")
+                       or "latency" in str(metric or ""))
+    if lower_is_better:
+        bound = ref["value"] * (1.0 + spread_pct / 100.0)
+        ok = value <= bound
+        detail.update(
+            reference_round=ref.get("_round"),
+            reference_value=ref["value"],
+            reference_spread_pct=spread_pct,
+            ceiling_ms=round(bound, 3),
+            value=value,
+            verdict=("pass" if ok else
+                     f"FAIL: {value} > ceiling {round(bound, 3)} "
+                     f"(latest comparable {ref['value']} plus its "
+                     f"{spread_pct}% window spread)"))
+        return ok, detail
+    floor = ref["value"] * (1.0 - spread_pct / 100.0)
+    ok = value >= floor
+    detail.update(
+        reference_round=ref.get("_round"),
+        reference_value=ref["value"],
+        reference_spread_pct=spread_pct,
+        floor_graphs_per_s=round(floor, 1),
+        value=value,
+        verdict=("pass" if ok else
+                 f"FAIL: {value} < floor {round(floor, 1)} "
+                 f"(latest comparable {ref['value']} minus its "
+                 f"{spread_pct}% window spread)"))
+    return ok, detail
+
+
+def gate_main(argv: list[str]) -> int:
+    """`bench.py --gate [result.json]`: exit 1 when a finished run's
+    headline throughput fell beyond the history's recorded window
+    spread. The result record comes from the given path (a saved bench
+    stdout line, or a BENCH_r-style wrapper whose `parsed` field holds
+    it) or from stdin when piped."""
+    import sys
+
+    paths = [a for a in argv if not a.startswith("-")]
+    usage = "--gate needs a result JSON path (or one piped on stdin)"
+    if paths:
+        with open(paths[0]) as f:
+            result = json.load(f)
+    elif not sys.stdin.isatty():
+        raw = sys.stdin.read().strip()
+        if not raw:
+            print(usage, file=sys.stderr)
+            return 2
+        try:
+            result = json.loads(raw)
+        except json.JSONDecodeError as e:
+            print(f"--gate: stdin is not a result JSON ({e})",
+                  file=sys.stderr)
+            return 2
+    else:
+        print(usage, file=sys.stderr)
+        return 2
+    if isinstance(result.get("parsed"), dict):
+        result = result["parsed"]
+    ok, detail = gate_check(result, _history_records())
+    print(json.dumps({"gate": detail}))
+    return 0 if ok else 1
 
 
 def precompile() -> int:
@@ -833,11 +992,15 @@ def main():
     ds, cfg = build_workload(tpe)
     commit, dirty = _git_state()
     device_kind = getattr(jax.devices()[0], "device_kind", "") or ""
+    from pertgnn_tpu.config import resolve_attention_impl
+    impl = resolve_attention_impl(cfg.model)
     _update_partial(phase="workload_built", commit=commit,
                     dirty_worktree=dirty, traces_per_entry=tpe,
                     backend=jax.default_backend(),
                     device_kind=device_kind,
                     backend_fallback=fallback,
+                    attention_impl=impl,
+                    serve_dtype=cfg.serve.serve_dtype,
                     train_graphs_per_epoch=len(ds.splits["train"]))
     fit_w, ceil_w, cceil_w, flops_per_graph, bytes_per_graph = \
         bench_interleaved(ds, cfg, windows=_WINDOWS)
@@ -869,11 +1032,16 @@ def main():
         print(f"WARNING: unstaged A/B fit failed ({type(e).__name__}: "
               f"{e}); emitting nulls for the A/B fields")
         unstaged_w = []
+    from pertgnn_tpu.models import layers as _layers
+    nfall = sum(_layers.FALLBACK_COUNTS.values())
+    _update_partial(kernel_fallbacks=nfall)
     result = _assemble_result(
         fit_w=fit_w, ceil_w=ceil_w, cceil_w=cceil_w, unstaged_w=unstaged_w,
         flops_per_graph=flops_per_graph, bytes_per_graph=bytes_per_graph,
         baseline=baseline, backend=jax.default_backend(), fallback=fallback,
-        train_graphs=len(ds.splits["train"]), device_kind=device_kind)
+        train_graphs=len(ds.splits["train"]), device_kind=device_kind,
+        attention_impl=impl, serve_dtype=cfg.serve.serve_dtype,
+        kernel_fallbacks=nfall)
     result["compile_cache"] = {
         "dir": _CACHE_DIR or None,
         "xla_cache_hits": cache_counts["hits"],
@@ -908,4 +1076,7 @@ if __name__ == "__main__":
         raise SystemExit(finalize_partial())
     if "--precompile" in sys.argv[1:]:
         raise SystemExit(precompile())
+    if "--gate" in sys.argv[1:]:
+        raise SystemExit(
+            gate_main([a for a in sys.argv[1:] if a != "--gate"]))
     main()

@@ -1,0 +1,235 @@
+"""Kernel microbenchmark: segment vs pallas vs blocked-dense, per bucket.
+
+Exit-code oracle for the conv hot-op implementations (ISSUE 6): for each
+shape bucket it runs every `attention_impl` variant forward AND
+backward, asserts numerical parity against the segment reference within
+the dtype tolerance, and emits one JSON row per (bucket, variant) —
+JSONL on stdout, one final summary line last. A parity failure exits
+nonzero: a kernel that is fast but wrong must turn the bench red, never
+land in a capture.
+
+Timed numbers are honest about the backend: off-TPU the Pallas variants
+run in INTERPRET mode (orders of magnitude slower — correctness rows,
+not performance rows; `interpreted: true` marks them), while segment and
+blocked_dense compile natively everywhere, so CPU timings for those two
+ARE meaningful A/Bs. Each row carries the XLA cost-analysis FLOPs/bytes
+and the roofline attribution schema shared with bench.py/serve_bench.py
+(utils/flops.variant_attribution) so per-variant mfu/mbu appear the
+moment this runs on a chip.
+
+Shape buckets mirror the serve ladder's discipline: small per-topology
+graphs padded to 128-aligned (nodes, edges) tiles — exactly the regime
+where arXiv:1906.11786's blocked-dense recast should win on systolic
+hardware and where `ModelConfig.blocked_dense_max_cells` admits it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# (nodes, edges) per bucket — spanning sub-tile, one-tile and multi-tile
+# shapes so block-boundary handling is exercised, not just the happy path
+BUCKETS = ((48, 160), (128, 512), (260, 1024))
+HEADS, HEAD_DIM, F_IN = 2, 16, 32
+
+# parity tolerance: all variants take f32 inputs and accumulate f32
+# internally, so fwd must agree to float rounding (grads get 10x slack
+# for the longer reduction chains)
+TOL = dict(rtol=1e-4, atol=1e-4)
+
+VARIANTS = ("segment", "pallas", "pallas_fused", "blocked_dense")
+
+
+def make_case(n, e, seed):
+    """One receiver-sorted masked attention case + epilogue operands."""
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(n, HEADS, HEAD_DIM)).astype(np.float32)
+    k = rng.normal(size=(e, HEADS, HEAD_DIM)).astype(np.float32)
+    v = rng.normal(size=(e, HEADS, HEAD_DIM)).astype(np.float32)
+    rcv = rng.integers(0, n, e)
+    mask = rng.random(e) > 0.15
+    order = np.argsort(np.where(mask, rcv, n), kind="stable")
+    x = rng.normal(size=(n, F_IN)).astype(np.float32)
+    w = rng.normal(size=(F_IN, HEADS * HEAD_DIM)).astype(np.float32)
+    b = rng.normal(size=(HEADS * HEAD_DIM,)).astype(np.float32)
+    node_mask = rng.random(n) > 0.1
+    return (q, k[order], v[order], rcv[order].astype(np.int32),
+            mask[order], x, w, b, node_mask)
+
+
+def build_fns(variant, n, e):
+    """(fwd, loss) for one variant at one shape bucket. fwd returns the
+    layer-epilogue output y = attn + x @ w + b for EVERY variant so the
+    parity claim covers the full fused surface, not just the attention
+    core; loss is a scalar for grad parity."""
+    import jax.numpy as jnp
+
+    from pertgnn_tpu.ops import blocked_dense as bd
+    from pertgnn_tpu.ops.pallas_attention import edge_attention, fused_epilogue
+    from pertgnn_tpu.ops.segment import segment_edge_attention
+
+    def attn(q, k, v, rcv, mask):
+        if variant == "segment":
+            return segment_edge_attention(q, k, v, rcv, mask, n)
+        if variant in ("pallas", "pallas_fused"):
+            return edge_attention(q, k, v, rcv, mask, n, assume_sorted=True)
+        return bd.blocked_dense_edge_attention(q, k, v, rcv, mask, n)
+
+    def fwd(q, k, v, rcv, mask, x, w, b, node_mask):
+        out = attn(q, k, v, rcv, mask)
+        if variant == "pallas_fused":
+            y, _stats = fused_epilogue(out, x, w, b, node_mask)
+            return y
+        return out + x @ w + b[None, :]
+
+    def loss(q, k, v, x, w, rcv, mask, b, node_mask):
+        return (fwd(q, k, v, rcv, mask, x, w, b, node_mask) ** 2).sum()
+
+    return fwd, loss
+
+
+def reference_outputs(bucket, case):
+    """Segment-reference (fwd, grads) for one bucket — computed ONCE per
+    bucket and shared by every variant's parity check."""
+    import jax
+
+    n, e = bucket
+    q, k, v, rcv, mask, x, w, b, node_mask = case
+    ref_fwd, ref_loss = build_fns("segment", n, e)
+    ref_y = np.asarray(jax.jit(ref_fwd)(*case))
+    ref_g = jax.jit(jax.grad(ref_loss, argnums=tuple(range(5))))(
+        q, k, v, x, w, rcv, mask, b, node_mask)
+    return ref_y, [np.asarray(g) for g in ref_g]
+
+
+def bench_variant(variant, bucket, case, ref, reps):
+    """One JSON row: parity (fwd + grads wrt q/k/v/x/w) vs the segment
+    reference, wall times, cost analysis, roofline attribution."""
+    import jax
+
+    from pertgnn_tpu.utils import flops as flops_util
+
+    n, e = bucket
+    q, k, v, rcv, mask, x, w, b, node_mask = case
+    ref_y, ref_g = ref
+    var_fwd, var_loss = build_fns(variant, n, e)
+
+    args_f = (q, k, v, rcv, mask, x, w, b, node_mask)
+    jf = jax.jit(var_fwd)  # the ONE wrapper: compile timing + timed loop
+    t_fwd = time.perf_counter()
+    got_y = np.asarray(jf(*args_f))
+    compile_fwd_s = time.perf_counter() - t_fwd
+    err_fwd = float(np.abs(got_y - ref_y).max())
+
+    grad_args = (q, k, v, x, w)
+    gfn_var = jax.jit(jax.grad(var_loss, argnums=tuple(range(5))))
+    got_g = gfn_var(*grad_args, rcv, mask, b, node_mask)
+    err_bwd = float(max(np.abs(np.asarray(a) - r).max()
+                        for a, r in zip(got_g, ref_g)))
+
+    scale = float(np.abs(ref_y).max())
+    gscale = float(max(np.abs(r).max() for r in ref_g))
+    ok = (err_fwd <= TOL["atol"] + TOL["rtol"] * scale
+          and err_bwd <= 10 * TOL["atol"] + 10 * TOL["rtol"] * gscale)
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jf(*args_f)
+    jax.block_until_ready(out)
+    fwd_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        g = gfn_var(*grad_args, rcv, mask, b, node_mask)
+    jax.block_until_ready(g)
+    fwdbwd_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    f_cost, b_cost = flops_util.compiled_cost(jf, *args_f)
+    interpreted = (variant in ("pallas", "pallas_fused")
+                   and jax.default_backend() != "tpu")
+    row = {
+        "metric": "pert_kernel_fwd_ms",
+        "variant": variant,
+        "bucket": {"nodes": n, "edges": e, "heads": HEADS,
+                   "head_dim": HEAD_DIM},
+        "value": fwd_ms,
+        "unit": "ms",
+        "fwd_ms": fwd_ms,
+        "fwdbwd_ms": fwdbwd_ms,
+        "compile_fwd_s": compile_fwd_s,
+        "max_abs_err_fwd": err_fwd,
+        "max_abs_err_grad": err_bwd,
+        "parity_ok": ok,
+        "interpreted": interpreted,
+        "reps": reps,
+        "roofline": flops_util.variant_attribution(
+            attention_impl=variant, dtype="f32",
+            graphs_per_s=(1e3 / fwd_ms) if fwd_ms else None,
+            flops_per_graph=f_cost, bytes_per_graph=b_cost),
+    }
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--reps", type=int,
+                    default=int(os.environ.get("KERNEL_BENCH_REPS", "3")),
+                    help="timed repetitions per variant (post-warmup)")
+    ap.add_argument("--out", default="",
+                    help="also write the JSONL rows here")
+    args = ap.parse_args()
+
+    from pertgnn_tpu.cli.common import (apply_platform_env,
+                                        probe_backend_or_fallback)
+    fallback = probe_backend_or_fallback()
+    apply_platform_env()
+
+    import jax
+
+    rows, failures = [], []
+    for bi, bucket in enumerate(BUCKETS):
+        case = make_case(*bucket, seed=100 + bi)
+        ref = reference_outputs(bucket, case)
+        for variant in VARIANTS:
+            row = bench_variant(variant, bucket, case, ref, args.reps)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+            if not row["parity_ok"]:
+                failures.append((variant, bucket,
+                                 row["max_abs_err_fwd"],
+                                 row["max_abs_err_grad"]))
+    summary = {
+        "metric": "pert_kernel_bench_summary",
+        "rows": len(rows),
+        "buckets": len(BUCKETS),
+        "variants": list(VARIANTS),
+        "parity_failures": len(failures),
+        "backend": jax.default_backend(),
+        "backend_fallback": fallback,
+        "captured_unix_time": time.time(),
+    }
+    print(json.dumps(summary), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            for row in rows + [summary]:
+                f.write(json.dumps(row) + "\n")
+    if failures:
+        for variant, bucket, ef, eg in failures:
+            print(f"PARITY FAIL: {variant} at {bucket}: fwd err {ef:.3e} "
+                  f"grad err {eg:.3e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,6 +23,17 @@ What is measured:
   the identical stream (means, not medians: the naive path's damage IS
   its compile tail, and a median would hide exactly that).
 
+Quantized serve tier (ISSUE 6): `--serve_dtype {f32,bf16,int8}` runs the
+SAME stream through an engine whose rung executables bake in the
+requested tier (bf16 activations; int8 adds in-graph-dequantized int8
+weights, ops/quantize.py). Quality is exit-code-gated, never assumed:
+the test-split quantile-loss delta vs an f32 reference engine must stay
+inside the PRE-REGISTERED per-dtype threshold below — a quantization
+scheme that moves the served quality metric beyond its budget turns the
+bench red, it does not ship quietly. The JSON stamps `serve_dtype`,
+`attention_impl`, and a roofline-attribution row (mfu/mbu per variant,
+utils/flops.py; honestly null off-chip).
+
 Run off-TPU it auto-falls back to CPU like bench.py (the engine is
 backend-agnostic; bucket discipline matters on any backend with compiled
 static shapes).
@@ -42,8 +53,16 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+# Pre-registered quality budgets for the quantized serve tiers: max
+# allowed RELATIVE test-split quantile-loss delta vs the f32 reference
+# engine. Registered HERE, before any capture — the gate is only honest
+# if the threshold cannot chase a measured regression. f32's budget is
+# numerical-noise-only (same graph, same dtype, different dispatch path).
+QLOSS_DELTA_BUDGET = {"f32": 1e-6, "bf16": 0.02, "int8": 0.05}
 
-def build_serve_workload(traces_per_entry: int = 300):
+
+def build_serve_workload(traces_per_entry: int = 300,
+                         serve_dtype: str = "f32"):
     """A synthetic corpus with deliberately heterogeneous mixture shapes
     (wide pattern_size_range) so single-request node/edge totals land in
     different ladder rungs."""
@@ -59,7 +78,8 @@ def build_serve_workload(traces_per_entry: int = 300):
         model=ModelConfig(hidden_channels=32, num_layers=3),
         train=TrainConfig(label_scale=1000.0),
         serve=ServeConfig(bucket_growth=2.0, max_graphs_per_batch=8,
-                          min_bucket_nodes=128, min_bucket_edges=128),
+                          min_bucket_nodes=128, min_bucket_edges=128,
+                          serve_dtype=serve_dtype),
         graph_type="pert",
     )
     data = synthetic.generate(synthetic.SyntheticSpec(
@@ -133,6 +153,81 @@ def run_naive(ds, cfg, state, stream):
     return np.asarray(lat), preds, len(shapes)
 
 
+def quality_gate(ds, cfg, state, engine):
+    """The quantized tier's exit-code oracle: test-split quantile loss of
+    the dtype engine vs an f32 reference over the SAME rows. For bf16/int8
+    the reference is a fresh f32 engine through the real per-rung AOT
+    request path (isolates the dtype); for f32 the reference is the
+    OFFLINE forward (same dtype, different dispatch path — comparing the
+    engine to itself would make the gate vacuous). Returns the JSON
+    fields; raises AssertionError when the relative worsening exceeds the
+    pre-registered QLOSS_DELTA_BUDGET for this dtype."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.train.metrics import quantile_loss
+    from pertgnn_tpu.train.predict import predict_split, predict_split_served
+
+    dtype = cfg.serve.serve_dtype
+    ys = np.asarray(ds.splits["test"].ys, np.float32)
+    pred_d = predict_split_served(ds, cfg, state, "test", engine=engine)
+    if dtype == "f32":
+        pred_f = predict_split(ds, cfg, state, "test")
+    else:
+        cfg_f = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                      serve_dtype="f32"))
+        # no warmup(): only the rungs the test split actually hits get
+        # compiled (lazily, on the miss path) — a full-ladder warmup of a
+        # throwaway reference engine is pure added wall clock
+        eng_f = InferenceEngine.from_dataset(ds, cfg_f, state)
+        pred_f = predict_split_served(ds, cfg_f, state, "test",
+                                      engine=eng_f)
+    tau = cfg.train.tau
+    q_d = float(quantile_loss(jnp.asarray(ys), jnp.asarray(pred_d), tau))
+    q_f = float(quantile_loss(jnp.asarray(ys), jnp.asarray(pred_f), tau))
+    delta = (q_d - q_f) / max(abs(q_f), 1e-12)
+    budget = QLOSS_DELTA_BUDGET[dtype]
+    fields = {
+        "qloss_f32": q_f,
+        "qloss_served": q_d,
+        "qloss_delta_rel": delta,
+        "qloss_delta_budget": budget,
+        "qloss_rows": int(len(ys)),
+    }
+    if delta > budget:
+        raise AssertionError(
+            f"serve_dtype={dtype} quantile-loss delta {delta:.4%} exceeds "
+            f"the pre-registered budget {budget:.2%} "
+            f"(f32 {q_f:.6g} -> {dtype} {q_d:.6g})")
+    return fields
+
+
+def rung_attribution(engine, stats, throughput_rps):
+    """Roofline-attribution row for the most-dispatched rung: FLOPs and
+    bytes per graph from the rung executable's own XLA cost analysis
+    (utils/flops.executable_cost), utilization against chip peaks
+    (honestly null off-TPU)."""
+    from pertgnn_tpu.config import resolve_attention_impl
+    from pertgnn_tpu.utils import flops as flops_util
+
+    impl = resolve_attention_impl(engine._cfg.model)
+    hot = max(range(len(engine.ladder)),
+              key=lambda i: stats["buckets"][i]["dispatches"])
+    f = b = None
+    exe = engine._exe.get(hot)
+    if exe is not None:
+        per_dispatch = flops_util.executable_cost(exe)
+        g = engine.ladder[hot].max_graphs
+        f = per_dispatch[0] / g if per_dispatch[0] else None
+        b = per_dispatch[1] / g if per_dispatch[1] else None
+    return flops_util.variant_attribution(
+        attention_impl=impl, dtype=engine.serve_dtype,
+        graphs_per_s=throughput_rps, flops_per_graph=f,
+        bytes_per_graph=b)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--batches", type=int,
@@ -140,6 +235,10 @@ def main() -> int:
                                                "120")),
                     help="microbatches in the randomized request stream")
     ap.add_argument("--traces_per_entry", type=int, default=300)
+    ap.add_argument("--serve_dtype", choices=("f32", "bf16", "int8"),
+                    default=os.environ.get("SERVE_BENCH_DTYPE", "f32"),
+                    help="quantized serve tier under test; quality is "
+                         "exit-code-gated vs an f32 reference engine")
     ap.add_argument("--out", default="",
                     help="also write the JSON record here")
     args = ap.parse_args()
@@ -154,7 +253,8 @@ def main() -> int:
     from pertgnn_tpu.serve.engine import InferenceEngine
     from pertgnn_tpu.train.loop import restore_target_state
 
-    ds, cfg = build_serve_workload(args.traces_per_entry)
+    ds, cfg = build_serve_workload(args.traces_per_entry,
+                                   serve_dtype=args.serve_dtype)
     # serving perf is independent of the weights; a fresh init (the
     # checkpoint restore target) keeps the bench self-contained
     _model, state = restore_target_state(ds, cfg)
@@ -177,11 +277,27 @@ def main() -> int:
             "pattern_size_range or the microbatch size range")
 
     lat_n, preds_n, naive_shapes = run_naive(ds, cfg, state, stream)
-    for pb, pn in zip(preds_b, preds_n):
-        np.testing.assert_allclose(pb, pn, rtol=1e-4, atol=1e-5)
+    if args.serve_dtype == "f32":
+        # bit-level stream parity holds only dtype-to-dtype: the naive
+        # oracle is f32, quantized tiers are instead gated on the
+        # quantile-loss delta below
+        for pb, pn in zip(preds_b, preds_n):
+            np.testing.assert_allclose(pb, pn, rtol=1e-4, atol=1e-5)
+
+    quality = quality_gate(ds, cfg, state, engine)
 
     n_requests = sum(len(e) for e, _ in stream)
     speedup = float(lat_n.mean() / lat_b.mean())
+    roofline = rung_attribution(engine, stats,
+                                float(n_requests / lat_b.sum()))
+    # the same row lands on the telemetry bus as serve.roofline.* gauges
+    # (tagged impl/dtype) when --telemetry_dir is configured, so capture
+    # JSONLs carry per-variant utilization next to the serve counters
+    from pertgnn_tpu import telemetry
+    from pertgnn_tpu.utils.flops import publish_attribution
+
+    publish_attribution(telemetry.get_bus(), roofline,
+                        prefix="serve.roofline")
     record = {
         "metric": "pert_serve_request_latency_ms_p50",
         "value": float(np.percentile(lat_b * 1e3, 50)),
@@ -209,6 +325,14 @@ def main() -> int:
         "naive_mean_ms": float(lat_n.mean() * 1e3),
         "naive_distinct_shapes": naive_shapes,
         "speedup_vs_naive": speedup,
+        # quantized-tier attribution + quality gate (ISSUE 6): which
+        # kernel variant and serve dtype produced these latencies, their
+        # roofline utilization, and the exit-code-gated quantile-loss
+        # delta vs the f32 reference engine
+        "serve_dtype": args.serve_dtype,
+        "attention_impl": roofline["attention_impl"],
+        "roofline": roofline,
+        **quality,
         "backend": jax.default_backend(),
         "backend_fallback": fallback,
         "captured_unix_time": time.time(),

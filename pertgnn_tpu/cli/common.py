@@ -11,7 +11,8 @@ from __future__ import annotations
 import argparse
 import os
 
-from pertgnn_tpu.config import (CompileCacheConfig, Config, DataConfig,
+from pertgnn_tpu.config import (ATTENTION_IMPLS, SERVE_DTYPES,
+                                CompileCacheConfig, Config, DataConfig,
                                 IngestConfig, ModelConfig, ParallelConfig,
                                 ServeConfig, TelemetryConfig, TrainConfig)
 
@@ -184,7 +185,27 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
                    help="Linear-kernel init: torch kaiming-uniform "
                         "(reference-faithful, default) or flax defaults")
     p.add_argument("--use_pallas_attention", action="store_true",
-                   help="fused Pallas edge-attention kernel (TPU only)")
+                   help="DEPRECATED alias for --attention_impl pallas")
+    p.add_argument("--attention_impl", choices=ATTENTION_IMPLS,
+                   default=ModelConfig.attention_impl,
+                   help="conv hot-op implementation: segment (XLA "
+                        "reference), pallas (fused flash-style kernel), "
+                        "pallas_fused (+ fused skip/residual/BN-stats "
+                        "epilogue), blocked_dense (masked dense matmuls "
+                        "for small shape buckets; docs/GUIDE.md)")
+    p.add_argument("--kernel_block_n", type=int,
+                   default=ModelConfig.kernel_block_n,
+                   help="Pallas kernel node-block tile size (128 = MXU "
+                        "lane width; baked into compiled programs)")
+    p.add_argument("--kernel_block_e", type=int,
+                   default=ModelConfig.kernel_block_e,
+                   help="Pallas kernel edge-block tile size")
+    p.add_argument("--blocked_dense_max_cells", type=int,
+                   default=ModelConfig.blocked_dense_max_cells,
+                   help="blocked_dense admissibility: max (padded nodes x "
+                        "padded edges) incidence cells per head before "
+                        "the layer falls back to the segment path "
+                        "(logged + counted)")
     p.add_argument("--missing_indicator_is_zero", action="store_true",
                    help="preprocess-time indicator convention (1=present) "
                         "instead of the live get_x convention (1=missing)")
@@ -291,6 +312,13 @@ def add_serve_flags(p: argparse.ArgumentParser) -> None:
                         "next microbatch while the device computes the "
                         "current one, one batch in flight); dispatches "
                         "then wait synchronously")
+    p.add_argument("--serve_dtype", choices=SERVE_DTYPES,
+                   default=ServeConfig.serve_dtype,
+                   help="quantized serve tier: f32 (as trained), bf16 "
+                        "(bf16 activations), int8 (bf16 activations + "
+                        "int8 weights dequantized in-graph); quality "
+                        "exit-code-gated by benchmarks/serve_bench.py "
+                        "(docs/GUIDE.md)")
 
 
 def add_aot_flags(p: argparse.ArgumentParser) -> None:
@@ -440,6 +468,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
             missing_indicator_is_one=not args.missing_indicator_is_zero,
             feature_all_stage_copies=args.feature_all_stage_copies,
             use_pallas_attention=args.use_pallas_attention,
+            attention_impl=args.attention_impl,
+            kernel_block_n=args.kernel_block_n,
+            kernel_block_e=args.kernel_block_e,
+            blocked_dense_max_cells=args.blocked_dense_max_cells,
             bf16_activations=args.bf16),
         train=TrainConfig(
             lr=args.lr, tau=args.tau, epochs=args.epochs,
@@ -475,7 +507,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 args, "quarantine_threshold",
                 ServeConfig.quarantine_threshold),
             overlap_dispatch=not getattr(args, "no_overlap_dispatch",
-                                         False)),
+                                         False),
+            serve_dtype=getattr(args, "serve_dtype",
+                                ServeConfig.serve_dtype)),
         telemetry=telemetry_config_from_args(args),
         aot=aot_config_from_args(args),
         graph_type=args.graph_type,

@@ -131,7 +131,41 @@ class ModelConfig:
     # dead output. True = the live get_x convention.
     missing_indicator_is_one: bool = True
     # Use the Pallas fused edge-attention kernel for the conv hot op.
+    # DEPRECATED alias: equivalent to attention_impl="pallas"; kept so
+    # existing flags/configs keep working (resolve_attention_impl maps
+    # it). attention_impl wins when both are set non-default.
     use_pallas_attention: bool = False
+    # The conv hot-op implementation (ops/; docs/GUIDE.md "Choosing
+    # attention_impl"):
+    #   "segment"       — XLA sorted-segment ops (ops/segment.py), the
+    #                     reference-parity default; works everywhere.
+    #   "pallas"        — flash-style fused fwd/bwd Pallas kernels
+    #                     (ops/pallas_attention.py); compiled on TPU,
+    #                     interpret mode elsewhere (slow — tests only).
+    #   "pallas_fused"  — "pallas" plus the fused per-node EPILOGUE: the
+    #                     skip projection + residual (and the masked
+    #                     BatchNorm statistics pass in training) run in
+    #                     one Pallas pass over node blocks instead of
+    #                     round-tripping HBM between the attention
+    #                     kernel and the rest of the layer.
+    #   "blocked_dense" — the small-graph segment ops recast as MASKED
+    #                     DENSE matmuls over (node, edge) blocks
+    #                     (ops/blocked_dense.py; arXiv:1906.11786's
+    #                     systolic-hardware formulation), gated by
+    #                     blocked_dense_max_cells with a logged+counted
+    #                     segment fallback above it.
+    attention_impl: str = "segment"
+    # Pallas kernel tile sizes (node-block x edge-block). 128 matches
+    # the MXU lane width; these are BAKED INTO compiled programs, so the
+    # AOT store keys cover them (they ride in ModelConfig).
+    kernel_block_n: int = 128
+    kernel_block_e: int = 128
+    # blocked_dense guard: the dense incidence mask is (N_pad x E_pad)
+    # CELLS per head — above this the quadratic materialization loses to
+    # the segment formulation (and can blow VMEM/HBM), so the layer
+    # falls back to "segment" with a logged warning + a
+    # model.kernel_fallback counter (never silently).
+    blocked_dense_max_cells: int = 1 << 22
     # Feed span edge durations |rt| (log1p-compressed) as an extra edge
     # feature. The reference computes these but never persists or uses them
     # (misc.py:183-186 vs preprocess.py:333-340) — exposed here as the
@@ -152,6 +186,29 @@ class ModelConfig:
     # — the remaining init difference, A/B'd for the span 20-epoch gap
     # (benchmarks/span_gap_r4.py).
     init_scheme: str = "torch"
+
+
+ATTENTION_IMPLS = ("segment", "pallas", "pallas_fused", "blocked_dense")
+SERVE_DTYPES = ("f32", "bf16", "int8")
+
+
+def resolve_attention_impl(model: "ModelConfig") -> str:
+    """The effective conv hot-op implementation: a non-default
+    `attention_impl` wins; the deprecated `use_pallas_attention` bool
+    maps to "pallas" when attention_impl is left at "segment". NOTE: an
+    explicit "segment" is indistinguishable from the default, so it
+    cannot override the legacy bool — to get the segment path, drop
+    `use_pallas_attention` (it is deprecated; that is the migration).
+    The ONE resolution point — models, benches, and AOT keys all go
+    through it so a legacy flag cannot mean different impls in
+    different layers."""
+    if model.attention_impl not in ATTENTION_IMPLS:
+        raise ValueError(
+            f"unknown attention_impl {model.attention_impl!r} "
+            f"(choose from {ATTENTION_IMPLS})")
+    if model.attention_impl != "segment":
+        return model.attention_impl
+    return "pallas" if model.use_pallas_attention else "segment"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -272,6 +329,20 @@ class ServeConfig:
     # microbatches (bisect-retry, serve/queue.py) is rejected at submit
     # with RequestQuarantined (counter serve.quarantined).
     quarantine_threshold: int = 3
+    # Quantized serve tier (docs/GUIDE.md "Choosing serve_dtype"):
+    #   "f32"  — serve with the training dtype (default; bit-identical
+    #            to offline predict).
+    #   "bf16" — bf16 activations through the MXU (params stay f32);
+    #            halves activation HBM traffic.
+    #   "int8" — bf16 activations + per-output-channel symmetric int8
+    #            WEIGHT quantization (ops/quantize.py), dequantized
+    #            in-graph: weight HBM traffic drops 4x, matmuls run
+    #            bf16 on dequantized operands.
+    # Quality is exit-code-gated: benchmarks/serve_bench.py asserts the
+    # quantile-loss delta vs the f32 engine stays inside the
+    # pre-registered per-dtype threshold. The serve engine's AOT rung
+    # keys cover this knob (a dtype change invalidates executables).
+    serve_dtype: str = "f32"
     # Overlapped dispatch (serve/queue.py): the queue worker packs the
     # NEXT microbatch on the host while the device computes the current
     # one (one batch in flight; result resolution deferred to a

@@ -26,6 +26,7 @@ stats used at eval.
 
 from __future__ import annotations
 
+import logging
 from typing import Any
 
 import jax
@@ -33,6 +34,28 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pertgnn_tpu.ops.segment import segment_edge_attention
+
+log = logging.getLogger(__name__)
+
+# In-process mirror of the model.kernel_fallback telemetry counter, keyed
+# by the REQUESTED impl. Same-process harnesses (bench.py) read it to
+# stamp whether the claimed attention_impl actually ran, so a trace-time
+# fallback can never attribute segment-path numbers to a kernel variant.
+FALLBACK_COUNTS: dict[str, int] = {}
+
+
+def _count_kernel_fallback(impl: str, reason: str, **tags) -> None:
+    """A requested kernel impl fell back to the segment path. NEVER
+    silent (tools/check_excepts.py discipline): logged + counted on the
+    telemetry bus. Fires at TRACE time — once per compiled program, not
+    per step."""
+    from pertgnn_tpu import telemetry
+
+    FALLBACK_COUNTS[impl] = FALLBACK_COUNTS.get(impl, 0) + 1
+    log.warning("attention_impl=%s fell back to the segment path (%s %s)",
+                impl, reason, tags or "")
+    telemetry.get_bus().counter("model.kernel_fallback", impl=impl,
+                                reason=reason, **tags)
 
 
 def kernel_initializer(scheme: str, role: str = "attn"):
@@ -69,12 +92,44 @@ def bias_initializer(scheme: str, fan_in: int):
     return nn.initializers.zeros
 
 
+class _SkipParams(nn.Module):
+    """Declares the skip projection's (kernel, bias) with EXACTLY the
+    names/shapes nn.Dense(name="skip") would create, without applying the
+    GEMM — the fused-epilogue path runs that matmul inside the Pallas
+    kernel (ops/pallas_attention.fused_epilogue) but must stay
+    checkpoint-compatible with every other attention_impl."""
+
+    features: int
+    kernel_init: Any
+    bias_init: Any
+
+    @nn.compact
+    def __call__(self, in_features: int):
+        kernel = self.param("kernel", self.kernel_init,
+                            (in_features, self.features), jnp.float32)
+        bias = self.param("bias", self.bias_init, (self.features,),
+                          jnp.float32)
+        return kernel, bias
+
+
 class GraphTransformerLayer(nn.Module):
     out_channels: int          # total output width (= heads * per-head dim)
     heads: int = 1
     attn_dropout: float = 0.0  # PyG TransformerConv drops attention weights
     init_scheme: str = "torch"  # keep aligned with ModelConfig.init_scheme
-    use_pallas: bool = False   # fused edge-attention kernel for the hot op
+    use_pallas: bool = False   # DEPRECATED alias for attention_impl="pallas"
+    # Conv hot-op implementation (config.ATTENTION_IMPLS; the model passes
+    # the RESOLVED impl via config.resolve_attention_impl). "segment"
+    # honors the legacy use_pallas bool for direct constructors.
+    attention_impl: str = "segment"
+    # pallas_fused: also return the masked (Σy, Σy²) per-feature partials
+    # the following MaskedBatchNorm needs (call gains a second return
+    # value) — set only by PertGNN for non-final convs.
+    emit_bn_stats: bool = False
+    # Pallas tile sizes / blocked-dense admissibility (ModelConfig twins).
+    kernel_block_n: int = 128
+    kernel_block_e: int = 128
+    blocked_dense_max_cells: int = 1 << 22
     # jax.sharding.Mesh: shard the EDGE set over the mesh's `data` axis
     # inside the layer (parallel/graph_shard.py) — the giant-graph /
     # "sequence parallel" path for DAGs whose edge set exceeds one chip
@@ -85,7 +140,7 @@ class GraphTransformerLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, edge_embeds, senders, receivers, edge_mask,
-                 *, training: bool = False):
+                 *, training: bool = False, node_mask=None):
         if self.out_channels % self.heads:
             raise ValueError(
                 f"out_channels {self.out_channels} not divisible by heads "
@@ -102,7 +157,48 @@ class GraphTransformerLayer(nn.Module):
 
         num_nodes = x.shape[0]
         attn_drop = self.attn_dropout > 0.0 and training
+        impl = self.attention_impl
+        if impl == "segment" and self.use_pallas:
+            impl = "pallas"  # deprecated-bool alias
+
+        mask_or_ones = (node_mask if node_mask is not None
+                        else jnp.ones(num_nodes, bool))
+        # The fused-epilogue path runs the skip GEMM inside the Pallas
+        # kernel, so it declares the params WITHOUT applying nn.Dense —
+        # created up front so a kernel fallback below reuses the same
+        # params (flax forbids two modules named "skip" in one trace).
+        skip_params = None
+        if (impl == "pallas_fused" and not attn_drop
+                and self.edge_shard_mesh is None):
+            skip_params = _SkipParams(
+                features=H * C, name="skip",
+                kernel_init=kernel_initializer(self.init_scheme),
+                bias_init=bias_initializer(self.init_scheme,
+                                           x.shape[-1]))(x.shape[-1])
+
+        def finish(out):
+            """Unfused epilogue: skip projection + residual, plus the
+            masked BN stat partials when the caller asked for them."""
+            if skip_params is not None:
+                w_s, b_s = skip_params
+                y = out + (x.astype(self.dtype) @ w_s.astype(self.dtype)
+                           + b_s.astype(self.dtype))
+            else:
+                y = out + dense("skip", True)(x)
+            if not self.emit_bn_stats:
+                return y
+            m = mask_or_ones.astype(jnp.float32)[:, None]
+            ym = y.astype(jnp.float32) * m
+            stats = jnp.stack([ym.sum(0),
+                               (ym * y.astype(jnp.float32)).sum(0)])
+            return y, stats
+
         if self.edge_shard_mesh is not None and not attn_drop:
+            if impl != "segment":
+                # the edge-sharded formulation only exists for the
+                # segment math — a mesh run of another impl is a
+                # fallback and must say so
+                _count_kernel_fallback(impl, "edge_shard_mesh")
             # k[senders] + e happens inside the shard_map, on each device's
             # edge shard; attn_dropout falls through to the segment path
             # (dropout on a sharded alpha would need per-shard rng plumbing)
@@ -113,27 +209,67 @@ class GraphTransformerLayer(nn.Module):
                 v.reshape(-1, H, C), e.reshape(-1, H, C),
                 senders, receivers, edge_mask,
                 self.edge_shard_mesh).astype(self.dtype)
-            return out + dense("skip", True)(x)
+            return finish(out)
 
         k_e = k[senders].reshape(-1, H, C) + e.reshape(-1, H, C)
         v_e = v[senders].reshape(-1, H, C) + e.reshape(-1, H, C)
 
-        if self.use_pallas and not attn_drop:
-            from pertgnn_tpu.ops.pallas_attention import edge_attention
-            out = edge_attention(q.reshape(-1, H, C), k_e, v_e, receivers,
-                                 edge_mask, num_nodes,
-                                 assume_sorted=True).astype(self.dtype)
-        else:
-            alpha_fn = None
-            if self.attn_dropout > 0.0 and training:
-                drop = nn.Dropout(rate=self.attn_dropout,
-                                  deterministic=False)
-                alpha_fn = lambda a: drop(a)
-            out = segment_edge_attention(
-                q.reshape(-1, H, C), k_e, v_e, receivers, edge_mask,
-                num_nodes, alpha_fn=alpha_fn)
-        out = out + dense("skip", True)(x)
-        return out
+        if impl in ("pallas", "pallas_fused") and not attn_drop:
+            try:
+                from pertgnn_tpu.ops.pallas_attention import (
+                    edge_attention, fused_epilogue)
+                attn = edge_attention(q.reshape(-1, H, C), k_e, v_e,
+                                      receivers, edge_mask, num_nodes,
+                                      block_n=self.kernel_block_n,
+                                      block_e=self.kernel_block_e,
+                                      assume_sorted=True)
+                if impl == "pallas_fused" and self.emit_bn_stats:
+                    w_s, b_s = skip_params
+                    y, stats = fused_epilogue(attn, x, w_s, b_s,
+                                              mask_or_ones,
+                                              block_n=self.kernel_block_n)
+                    return y.astype(self.dtype), stats
+                # pallas_fused with no stats consumer (final conv, eval /
+                # serve): the epilogue is just attn + skip GEMM + bias —
+                # XLA fuses that on its own, and skipping the Pallas
+                # stats kernel avoids paying for a (2, HD) masked
+                # accumulation nobody reads (a pallas_call output can
+                # never be DCE'd)
+                return finish(attn.astype(self.dtype))
+            except Exception as err:  # Pallas unavailable on this stack
+                _count_kernel_fallback(impl, "pallas_unavailable",
+                                       error=type(err).__name__)
+        elif impl == "blocked_dense" and not attn_drop:
+            from pertgnn_tpu.ops import blocked_dense as bd
+            num_edges = int(k_e.shape[0])
+            if bd.fits(num_nodes, num_edges, self.blocked_dense_max_cells,
+                       self.kernel_block_n, self.kernel_block_e):
+                out = bd.blocked_dense_edge_attention(
+                    q.reshape(-1, H, C), k_e, v_e, receivers, edge_mask,
+                    num_nodes, block_n=self.kernel_block_n,
+                    block_e=self.kernel_block_e)
+                return finish(out.astype(self.dtype))
+            _count_kernel_fallback(
+                "blocked_dense", "max_cells", nodes=num_nodes,
+                edges=num_edges,
+                cells=bd.dense_cells(num_nodes, num_edges,
+                                     self.kernel_block_n,
+                                     self.kernel_block_e),
+                max_cells=self.blocked_dense_max_cells)
+        elif impl != "segment" and attn_drop:
+            # attention-weight dropout needs the segment formulation's
+            # alpha hook — fall back, visibly
+            _count_kernel_fallback(impl, "attn_dropout")
+
+        alpha_fn = None
+        if attn_drop:
+            drop = nn.Dropout(rate=self.attn_dropout,
+                              deterministic=False)
+            alpha_fn = lambda a: drop(a)
+        out = segment_edge_attention(
+            q.reshape(-1, H, C), k_e, v_e, receivers, edge_mask,
+            num_nodes, alpha_fn=alpha_fn)
+        return finish(out)
 
 
 class MaskedBatchNorm(nn.Module):
@@ -142,7 +278,15 @@ class MaskedBatchNorm(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, mask, *, training: bool = False):
+    def __call__(self, x, mask, *, training: bool = False,
+                 precomputed_sums=None):
+        """`precomputed_sums` — a (2, features) array of masked (Σx, Σx²)
+        per-feature partials, e.g. from the fused Pallas epilogue
+        (ops/pallas_attention.fused_epilogue) — replaces the training
+        statistics reduction (mean = Σx/n, biased var = Σx²/n − mean²,
+        clamped ≥ 0) so this module never re-reads x from HBM for stats;
+        the normalize + affine remain here and fuse with the following
+        relu. Ignored at eval (running stats)."""
         features = x.shape[-1]
         ra_mean = self.variable("batch_stats", "mean",
                                 lambda: jnp.zeros(features, jnp.float32))
@@ -156,9 +300,16 @@ class MaskedBatchNorm(nn.Module):
         if training:
             w = mask.astype(jnp.float32)[:, None]
             n = jnp.maximum(w.sum(), 1.0)
-            mean = (x * w).sum(0) / n
-            # biased variance for normalization (torch semantics) ...
-            var = ((x - mean) ** 2 * w).sum(0) / n
+            if precomputed_sums is not None:
+                s, ss = precomputed_sums[0], precomputed_sums[1]
+                mean = s / n
+                # E[x²] − E[x]² == the masked biased variance below,
+                # up to rounding; clamp the cancellation residue
+                var = jnp.maximum(ss / n - mean * mean, 0.0)
+            else:
+                mean = (x * w).sum(0) / n
+                # biased variance for normalization (torch semantics) ...
+                var = ((x - mean) ** 2 * w).sum(0) / n
             if not self.is_initializing():
                 # ... but unbiased variance tracked in running stats
                 unbiased = var * n / jnp.maximum(n - 1.0, 1.0)

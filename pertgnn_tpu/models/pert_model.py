@@ -30,7 +30,7 @@ from typing import Any
 import jax.numpy as jnp
 from flax import linen as nn
 
-from pertgnn_tpu.config import ModelConfig
+from pertgnn_tpu.config import ModelConfig, resolve_attention_impl
 from pertgnn_tpu.models.layers import (GraphTransformerLayer,
                                        MaskedBatchNorm, bias_initializer,
                                        kernel_initializer)
@@ -68,18 +68,37 @@ class PertGNN(nn.Module):
                 jnp.log1p(batch.edge_duration).astype(dtype)[:, None])
         edge_embeds = jnp.concatenate(edge_parts, axis=1)
 
+        impl = resolve_attention_impl(cfg)
         conv_kwargs = dict(out_channels=hidden, heads=cfg.num_heads,
                            dtype=dtype, attn_dropout=cfg.attn_dropout,
                            init_scheme=cfg.init_scheme,
-                           use_pallas=cfg.use_pallas_attention,
+                           attention_impl=impl,
+                           kernel_block_n=cfg.kernel_block_n,
+                           kernel_block_e=cfg.kernel_block_e,
+                           blocked_dense_max_cells=cfg.blocked_dense_max_cells,
                            edge_shard_mesh=self.edge_shard_mesh)
+        # pallas_fused: the conv's Pallas epilogue also emits the masked
+        # (Σy, Σy²) partials the following MaskedBatchNorm consumes, so
+        # the BN statistics pass never re-reads the conv output from HBM.
+        # Training only — at eval/serve MaskedBatchNorm normalizes with
+        # running stats and would discard the partials, so the conv
+        # skips the stats kernel entirely there.
+        fused_bn = (impl == "pallas_fused"
+                    and self.edge_shard_mesh is None and training)
         num_convs = max(2, cfg.num_layers)
         for i in range(num_convs - 1):
-            x = GraphTransformerLayer(name=f"conv_{i}", **conv_kwargs)(
+            x = GraphTransformerLayer(name=f"conv_{i}",
+                                      emit_bn_stats=fused_bn,
+                                      **conv_kwargs)(
                 x, edge_embeds, batch.senders, batch.receivers,
-                batch.edge_mask, training=training)
+                batch.edge_mask, training=training,
+                node_mask=batch.node_mask)
+            sums = None
+            if fused_bn:
+                x, sums = x
             x = MaskedBatchNorm(name=f"bn_{i}", dtype=dtype)(
-                x, batch.node_mask, training=training)
+                x, batch.node_mask, training=training,
+                precomputed_sums=sums)
             x = nn.relu(x)
             if cfg.dropout > 0.0:
                 x = nn.Dropout(rate=cfg.dropout,
@@ -87,7 +106,8 @@ class PertGNN(nn.Module):
         x = GraphTransformerLayer(name=f"conv_{num_convs - 1}",
                                   **conv_kwargs)(
             x, edge_embeds, batch.senders, batch.receivers,
-            batch.edge_mask, training=training)
+            batch.edge_mask, training=training,
+            node_mask=batch.node_mask)
 
         head_init = kernel_initializer(cfg.init_scheme, role="head")
         local_pred = nn.Dense(

@@ -1,0 +1,103 @@
+"""Blocked-dense edge attention — segment ops as masked dense matmuls.
+
+"Fast Training of Sparse Graph Neural Networks on Dense Hardware"
+(arXiv:1906.11786, PAPERS.md) observes that on systolic hardware the
+sparse gather / segment-softmax / scatter formulation of message passing
+should be recast as DENSE matmuls against an explicit (node, edge)
+incidence mask: the MXU runs a masked `q @ k_edgeᵀ` at full tile
+utilization, while a sorted-segment reduction serializes through the
+VPU. For this workload's SMALL per-topology graphs (packed-batch node /
+edge counts in the hundreds), the quadratic incidence matrix is tiny —
+a few 128-aligned tiles — so the dense recast is a straight win; for
+large batches it loses quadratically, which is why the layer gates this
+impl on `ModelConfig.blocked_dense_max_cells` and falls back to the
+segment path loudly above it.
+
+Everything here is plain XLA (no Pallas): the point IS that dense
+einsums + masks lower to stock MXU GEMMs, differentiable by autodiff
+for free, with one compiled program per 128-aligned shape bucket
+(`_pad_up` rounds node/edge counts so nearby request shapes share an
+executable — the serve ladder's discipline applied to the op).
+
+Numerics match `ops.segment.segment_edge_attention` exactly in
+formulation: masked lanes get -inf scores, empty destinations produce
+zeros (an isolated node never appears in the scatter), and padding can
+never alias a real row (masked edges get receiver id -1, below any real
+node id).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _pad_up(v: int, m: int) -> int:
+    return ((max(v, 1) + m - 1) // m) * m
+
+
+def dense_cells(num_nodes: int, num_edges: int, block_n: int = 128,
+                block_e: int = 128) -> int:
+    """Incidence-matrix cells (per head) the dense formulation would
+    materialize for this shape bucket — the quantity
+    `ModelConfig.blocked_dense_max_cells` bounds."""
+    return _pad_up(num_nodes, block_n) * _pad_up(num_edges, block_e)
+
+
+def fits(num_nodes: int, num_edges: int, max_cells: int,
+         block_n: int = 128, block_e: int = 128) -> bool:
+    """Whether the blocked-dense recast is admissible for this (static)
+    shape bucket. The caller owns the fallback (log + count — never a
+    silent swallow; tools/check_excepts.py discipline)."""
+    return dense_cells(num_nodes, num_edges, block_n, block_e) <= max_cells
+
+
+def blocked_dense_edge_attention(q: jax.Array, k_e: jax.Array,
+                                 v_e: jax.Array, receivers: jax.Array,
+                                 edge_mask: jax.Array, num_nodes: int,
+                                 *, block_n: int = 128,
+                                 block_e: int = 128) -> jax.Array:
+    """Edge attention as masked dense matmuls over one shape bucket.
+
+    q: (N, H, C); k_e, v_e: (E, H, C) edge-level (source-gathered +
+    edge-projected); receivers (E,) int; edge_mask (E,) bool. Returns
+    (N, H*C) float32 — the same contract as `segment_edge_attention`
+    (the single source of truth for the math) and the fused Pallas
+    kernel, asserted by tests/test_pallas_attention.py parity and
+    benchmarks/kernel_bench.py.
+    """
+    n, heads, head_dim = q.shape
+    e = k_e.shape[0]
+    n_pad = _pad_up(n, block_n)
+    e_pad = _pad_up(e, block_e)
+
+    qf = jnp.zeros((n_pad, heads, head_dim), jnp.float32).at[:n].set(
+        q.astype(jnp.float32))
+    kf = jnp.zeros((e_pad, heads, head_dim), jnp.float32).at[:e].set(
+        k_e.astype(jnp.float32))
+    vf = jnp.zeros((e_pad, heads, head_dim), jnp.float32).at[:e].set(
+        v_e.astype(jnp.float32))
+    # masked/padding edges get receiver -1: no node id (0..n_pad-1) can
+    # match, so they are unobservable by construction
+    rcv = jnp.full((e_pad,), -1, jnp.int32).at[:e].set(
+        jnp.where(edge_mask, receivers, -1).astype(jnp.int32))
+    incidence = (jnp.arange(n_pad, dtype=jnp.int32)[:, None]
+                 == rcv[None, :])  # (N_pad, E_pad)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    # the dense recast: scores are ONE batched GEMM against every edge,
+    # masked by incidence — gather/scatter becomes matmul + where
+    scores = jnp.einsum("nhc,ehc->hne", qf, kf,
+                        precision=jax.lax.Precision.HIGHEST) * scale
+    scores = jnp.where(incidence[None], scores, _NEG)
+    smax = jnp.max(scores, axis=2, keepdims=True)
+    # empty destinations (all -inf/_NEG row): clamp like segment_softmax
+    smax = jnp.where(smax > 0.5 * _NEG, smax, 0.0)
+    p = jnp.where(incidence[None], jnp.exp(scores - smax), 0.0)
+    denom = jnp.sum(p, axis=2, keepdims=True)
+    alpha = p / jnp.where(denom > 0, denom, 1.0)  # (H, N_pad, E_pad)
+    out = jnp.einsum("hne,ehc->nhc", alpha, vf,
+                     precision=jax.lax.Precision.HIGHEST)
+    return out[:n].reshape(n, heads * head_dim)

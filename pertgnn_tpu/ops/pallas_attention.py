@@ -468,6 +468,127 @@ def _fused_bwd(num_nodes, n, e, heads, head_dim, block_n, block_e,
 _fused_sorted.defvjp(_fused_fwd, _fused_bwd)
 
 
+# ---------------------------------------------------------------------------
+# fused per-node epilogue: skip projection + residual + BN statistics
+# ---------------------------------------------------------------------------
+
+
+def _epilogue_kernel(attn_ref, x_ref, w_ref, b_ref, mask_ref, y_ref,
+                     stats_ref):
+    """One node block: y = attn + x @ W_skip + b_skip, plus the masked
+    per-feature (Σy, Σy²) partials MaskedBatchNorm's training pass needs
+    — the per-node ops that otherwise round-trip HBM between the
+    attention kernel and the rest of GraphTransformerLayer, done in ONE
+    read of (attn, x) and one write of y. stats accumulate across the
+    sequential TPU grid into a single revisited (2, HD) block."""
+    t = pl.program_id(0)
+    y = (attn_ref[:]
+         + jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32,
+                   precision=_HI)
+         + b_ref[0, :][None, :])
+    y_ref[:] = y
+
+    @pl.when(t == 0)
+    def _init():
+        stats_ref[:] = jnp.zeros_like(stats_ref)
+
+    m = mask_ref[0, :].astype(jnp.float32)[:, None]  # (BN, 1)
+    ym = y * m
+    stats_ref[0:1, :] += jnp.sum(ym, axis=0, keepdims=True)
+    stats_ref[1:2, :] += jnp.sum(ym * y, axis=0, keepdims=True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _epilogue_padded(block_n, interpret, attn2, x2, w, b, mask_row):
+    """Fused epilogue over PADDED inputs: attn2 (Np, HD), x2 (Np, F),
+    w (F, HD), b (HD,), mask_row (1, Np) int32. Returns (y (Np, HD),
+    stats (2, HD)) with stats = masked (Σy, Σy²) — feed them to
+    MaskedBatchNorm(precomputed_sums=...) so its statistics reduction
+    never re-reads y from HBM."""
+    out, _ = _epilogue_fwd(block_n, interpret, attn2, x2, w, b, mask_row)
+    return out
+
+
+def _epilogue_run(block_n, interpret, attn2, x2, w, b, mask_row):
+    n_pad, hd = attn2.shape
+    f_in = x2.shape[1]
+    grid = (n_pad // block_n,)
+    y, stats = pl.pallas_call(
+        _epilogue_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, hd), lambda t: (t, 0)),
+            pl.BlockSpec((block_n, f_in), lambda t: (t, 0)),
+            pl.BlockSpec((f_in, hd), lambda t: (0, 0)),
+            pl.BlockSpec((1, hd), lambda t: (0, 0)),
+            pl.BlockSpec((1, block_n), lambda t: (0, t)),
+        ],
+        out_specs=(
+            pl.BlockSpec((block_n, hd), lambda t: (t, 0)),
+            pl.BlockSpec((2, hd), lambda t: (0, 0)),
+        ),
+        out_shape=(jax.ShapeDtypeStruct((n_pad, hd), jnp.float32),
+                   jax.ShapeDtypeStruct((2, hd), jnp.float32)),
+        interpret=interpret,
+    )(attn2, x2, w, b[None, :], mask_row)
+    return y, stats
+
+
+def _epilogue_fwd(block_n, interpret, attn2, x2, w, b, mask_row):
+    y, stats = _epilogue_run(block_n, interpret, attn2, x2, w, b, mask_row)
+    return (y, stats), (x2, w, y, mask_row)
+
+
+def _epilogue_bwd(block_n, interpret, res, cts):
+    """Plain-XLA backward (dense MXU math — nothing here needs a custom
+    kernel): with (gy, gs) the cotangents of (y, stats),
+        dy_total = gy + mask · (gs₀ + 2 y gs₁)      [stats are Σ my, Σ my²]
+        dattn = dy_total;  dx = dy_total Wᵀ;  dW = xᵀ dy_total;
+        db = Σ dy_total."""
+    x2, w, y, mask_row = res
+    gy, gs = cts
+    m = mask_row[0].astype(jnp.float32)[:, None]
+    dy = gy + m * (gs[0][None, :] + 2.0 * y * gs[1][None, :])
+    dattn = dy
+    dx = jnp.dot(dy, w.T, precision=_HI)
+    dw = jnp.dot(x2.T, dy, precision=_HI)
+    db = dy.sum(0)
+    dmask = np.zeros(mask_row.shape, dtype=jax.dtypes.float0)
+    return dattn, dx, dw, db, dmask
+
+
+_epilogue_padded.defvjp(_epilogue_fwd, _epilogue_bwd)
+
+
+def fused_epilogue(attn_out, x, w_skip, b_skip, node_mask, *,
+                   block_n: int = 128, interpret: bool | None = None):
+    """Fused per-node epilogue of a GraphTransformerLayer:
+    y = attn_out + x @ w_skip + b_skip, plus the masked per-feature
+    (Σy, Σy²) partials for the following MaskedBatchNorm — one fused
+    pass over node blocks instead of separate skip-GEMM / residual /
+    statistics HBM round-trips.
+
+    attn_out (N, HD) from `edge_attention`; x (N, F) the layer input;
+    w_skip (F, HD), b_skip (HD,) the skip-projection parameters;
+    node_mask (N,) bool. Returns (y (N, HD) float32, stats (2, HD)).
+    Fully differentiable (custom_vjp; backward is dense XLA math).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, hd = attn_out.shape
+    n_pad = _round_up(max(n, block_n), block_n)
+    attn2 = jnp.zeros((n_pad, hd), jnp.float32).at[:n].set(
+        attn_out.astype(jnp.float32))
+    x2 = jnp.zeros((n_pad, x.shape[1]), jnp.float32).at[:n].set(
+        x.astype(jnp.float32))
+    mask_row = jnp.zeros((1, n_pad), jnp.int32).at[0, :n].set(
+        node_mask.astype(jnp.int32))
+    y, stats = _epilogue_padded(block_n, interpret, attn2, x2,
+                                w_skip.astype(jnp.float32),
+                                b_skip.astype(jnp.float32), mask_row)
+    return y[:n], stats
+
+
 def _reference(q, k_e, v_e, receivers, edge_mask, num_nodes: int):
     """Float32 view of the segment path (the differentiable fallback)."""
     return segment_edge_attention(q, k_e, v_e, receivers, edge_mask,

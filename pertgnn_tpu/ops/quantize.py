@@ -1,0 +1,106 @@
+"""Weight-only int8 quantization for the serve tier.
+
+`ServeConfig.serve_dtype="int8"` serves with per-output-channel
+symmetric int8 WEIGHT quantization: every 2-D float parameter (Dense
+kernels, embedding tables) is stored on device as an int8 matrix plus a
+float32 per-column scale, and dequantized IN-GRAPH to bf16 right before
+its matmul — XLA fuses the `q.astype(bf16) * scale` into the consumer,
+so the executable reads a quarter of the weight bytes from HBM while the
+MXU still runs a dense bf16 GEMM. For a memory-bound workload (MBU is
+the honest utilization number here — utils/flops.py) weight bytes are
+exactly what the roofline charges for.
+
+1-D parameters (biases, BatchNorm scale/bias) and the running statistics
+stay float32: they are O(features) bytes — quantizing them saves nothing
+and costs accuracy.
+
+Quality is never assumed: benchmarks/serve_bench.py exit-code-asserts
+the quantile-loss delta vs the f32 engine against a pre-registered
+per-dtype threshold, and the serve engine's AOT store keys cover
+`serve_dtype` so a quantized executable can never be replayed for an f32
+config (tests/test_aot.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# The two leaves of one quantized parameter. Kept as a plain dict so the
+# quantized tree is an ordinary pytree: the AOT store's abstract
+# signature sees the int8 leaves + treedef and keys the executables
+# accordingly for free.
+_QKEYS = frozenset(("int8", "scale"))
+
+
+def quantize_array(w, *, axis: int = 0):
+    """(int8 q, float32 scale) with symmetric per-output-channel scales:
+    `scale` has w's shape with `axis` reduced (kept as size 1), chosen so
+    q = round(w / scale) ∈ [-127, 127]. All-zero channels get scale 1 so
+    dequantization stays exact (0 * 1 = 0)."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_array(q, scale, dtype=jnp.bfloat16):
+    """In-graph dequantize: the int8 matrix is the HBM-resident form;
+    the cast+scale fuses into the consuming matmul."""
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+def quantize_tree(params):
+    """Quantize every 2-D float leaf of a (nested-dict) param tree to
+    {"int8": ..., "scale": ...}; everything else passes through
+    unchanged. The result is a valid pytree with the same nesting."""
+    def rec(node):
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        a = jnp.asarray(node)
+        if a.ndim == 2 and jnp.issubdtype(a.dtype, jnp.floating):
+            q, scale = quantize_array(a)
+            return {"int8": q, "scale": scale}
+        return node
+    return rec(params)
+
+
+def dequantize_tree(params, dtype=jnp.bfloat16):
+    """Inverse of `quantize_tree` as traced graph ops: quantized leaves
+    come back as `dtype` (bf16) matrices, pass-through leaves unchanged.
+    Runs INSIDE the serve step program (serve/engine.py) so the compiled
+    executable's parameter inputs stay int8."""
+    def rec(node):
+        if isinstance(node, dict):
+            if set(node) == _QKEYS:
+                return dequantize_array(node["int8"], node["scale"], dtype)
+            return {k: rec(v) for k, v in node.items()}
+        return node
+    return rec(params)
+
+
+def quantization_error(params) -> dict:
+    """Max relative round-trip error per quantized leaf count — a cheap
+    sanity probe for tests/benches (not a quality gate; the REAL gate is
+    serve_bench's quantile-loss delta)."""
+    import numpy as np
+
+    errs = []
+    # round-trip error needs the original; computed by comparing against
+    # dequantized-from-quantized of the caller's tree
+    q = quantize_tree(params)
+
+    def walk(orig, quant):
+        if isinstance(quant, dict) and set(quant) == _QKEYS:
+            w0 = np.asarray(orig, np.float32)
+            w1 = np.asarray(dequantize_array(quant["int8"], quant["scale"],
+                                             jnp.float32))
+            denom = max(float(np.abs(w0).max()), 1e-12)
+            errs.append(float(np.abs(w1 - w0).max()) / denom)
+        elif isinstance(quant, dict):
+            for k in quant:
+                walk(orig[k], quant[k])
+
+    walk(params, q)
+    return {"quantized_leaves": len(errs),
+            "max_rel_error": max(errs) if errs else 0.0}

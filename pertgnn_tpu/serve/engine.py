@@ -44,7 +44,7 @@ from pertgnn_tpu import telemetry
 from pertgnn_tpu.batching.featurize import ResourceLookup
 from pertgnn_tpu.batching.mixture import Mixture
 from pertgnn_tpu.batching.pack import BatchBudget, PackedBatch, pack_single
-from pertgnn_tpu.config import Config
+from pertgnn_tpu.config import SERVE_DTYPES, Config, resolve_attention_impl
 from pertgnn_tpu.models.pert_model import make_model
 from pertgnn_tpu.serve.buckets import (make_bucket_ladder, pad_waste,
                                        select_bucket)
@@ -157,15 +157,40 @@ class InferenceEngine:
         self._n_feat = lookup.num_features + (
             1 if self._node_depth_in_x else 0)
         self.ladder = make_bucket_ladder(budget, cfg.serve)
+        # --- quantized serve tier (ServeConfig.serve_dtype) ---
+        # f32: params as trained. bf16: the model runs bf16 activations
+        # (from_dataset builds it that way); params stay f32. int8: 2-D
+        # weights live on device as int8 + per-channel scales
+        # (ops/quantize.py) and dequantize IN-GRAPH to bf16 — the
+        # compiled executable reads a quarter of the weight bytes.
+        self.serve_dtype = cfg.serve.serve_dtype
+        if self.serve_dtype not in SERVE_DTYPES:
+            raise ValueError(
+                f"unknown serve_dtype {self.serve_dtype!r} "
+                f"(choose from {SERVE_DTYPES})")
+        params = state.params
+        if self.serve_dtype == "int8":
+            from pertgnn_tpu.ops.quantize import quantize_tree
+            params = quantize_tree(params)
         # device-resident once: per-dispatch H2D is then only the batch
         self._variables = jax.tree.map(
-            jnp.asarray, {"params": state.params,
+            jnp.asarray, {"params": params,
                           "batch_stats": state.batch_stats})
         label_scale = cfg.train.label_scale
 
-        def step(variables, batch):
-            global_pred, _ = model.apply(variables, batch, training=False)
-            return global_pred * label_scale
+        if self.serve_dtype == "int8":
+            from pertgnn_tpu.ops.quantize import dequantize_tree
+
+            def step(variables, batch):
+                deq = {"params": dequantize_tree(variables["params"]),
+                       "batch_stats": variables["batch_stats"]}
+                global_pred, _ = model.apply(deq, batch, training=False)
+                return global_pred * label_scale
+        else:
+            def step(variables, batch):
+                global_pred, _ = model.apply(variables, batch,
+                                             training=False)
+                return global_pred * label_scale
 
         self._step = step
         self._exe: dict[int, object] = {}
@@ -195,7 +220,14 @@ class InferenceEngine:
     @classmethod
     def from_dataset(cls, dataset, cfg: Config, state, bus=None,
                      store=None) -> "InferenceEngine":
-        model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
+        model_cfg = cfg.model
+        if cfg.serve.serve_dtype in ("bf16", "int8"):
+            # the quantized tiers run bf16 activations through the MXU;
+            # the param TREE is unchanged (bf16_activations only sets
+            # compute dtype), so the trained state binds as-is
+            model_cfg = dataclasses.replace(cfg.model,
+                                            bf16_activations=True)
+        model = make_model(model_cfg, dataset.num_ms, dataset.num_entries,
                            dataset.num_interfaces, dataset.num_rpctypes)
         if store is None and cfg.aot.enabled:
             from pertgnn_tpu import aot
@@ -227,9 +259,17 @@ class InferenceEngine:
         # reach the compiled program. Keying the whole dataclass would
         # spuriously invalidate every rung on a queue-tuning change —
         # the same restraint _stored_train_eval applies to TrainConfig.
+        # serve_dtype is the ONE ServeConfig field baked into the step
+        # program (bf16 model dtype / int8 dequantize graph): it must
+        # invalidate rung executables. int8 also changes the abstract
+        # signature (int8 param leaves), but bf16 does not — hence the
+        # explicit key component. cfg.model rides whole, which covers
+        # attention_impl / use_pallas_attention / kernel block sizes /
+        # blocked_dense_max_cells by construction (dataclass fields).
         key, components = aot.cache_key(
             fn_id="serve.engine.step.v1",
             config={"model": cfg.model,
+                    "serve_dtype": cfg.serve.serve_dtype,
                     "label_scale": cfg.train.label_scale,
                     "graph_type": cfg.graph_type},
             args_sig=aot.abstract_signature(abstract_args))
@@ -267,6 +307,10 @@ class InferenceEngine:
         """AOT-compile every ladder rung so steady-state serving never
         compiles. Idempotent; returns self for chaining."""
         t0 = time.perf_counter()
+        # attribution: which quantized tier + kernel variant the rung
+        # executables bake in (docs/OBSERVABILITY.md)
+        self._bus.counter("serve.dtype", dtype=self.serve_dtype,
+                          impl=resolve_attention_impl(self._cfg.model))
         with self._bus.span("serve.warmup", buckets=len(self.ladder)):
             for i in range(len(self.ladder)):
                 if i not in self._exe:

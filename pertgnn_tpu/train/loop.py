@@ -33,7 +33,7 @@ from pertgnn_tpu.batching.materialize import (
     DeviceArenas, arena_nbytes, build_device_arenas, materialize_compact,
     zero_masked_idx)
 from pertgnn_tpu.batching.pack import PackedBatch, zero_masked
-from pertgnn_tpu.config import Config
+from pertgnn_tpu.config import Config, resolve_attention_impl
 from pertgnn_tpu.models.pert_model import PertGNN, make_model
 from pertgnn_tpu.train.metrics import masked_metric_sums, quantile_loss
 
@@ -1023,6 +1023,13 @@ def fit(dataset: Dataset, cfg: Config,
         # scope the injected bus process-wide so the global-bus call
         # sites below fit (packer, staging, checkpoints) see it too
         restore_bus = telemetry.set_bus(bus)
+    # which conv hot-op implementation this run's programs bake in —
+    # capture JSONLs must attribute every throughput number to its
+    # kernel variant (docs/OBSERVABILITY.md)
+    bus.counter("model.kernel_variant",
+                impl=resolve_attention_impl(cfg.model),
+                block_n=cfg.model.kernel_block_n,
+                block_e=cfg.model.kernel_block_e)
     try:
         return _fit_epochs(dataset, cfg, epochs, checkpoint_manager,
                            profile_hook, state, train_step, eval_step,

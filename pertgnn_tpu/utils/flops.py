@@ -109,12 +109,12 @@ def peak_hbm_bw_per_chip() -> float | None:
     return peak_hbm_bw_for_kind(kind if "tpu" in kind else f"tpu {kind}")
 
 
-def compiled_cost(jitted, *args) -> tuple[float | None, float | None]:
-    """(flops, bytes_accessed) of ONE invocation of an already-jitted
-    callable on `args`, from XLA's cost analysis (None fields when the
-    backend doesn't report them)."""
+def executable_cost(compiled) -> tuple[float | None, float | None]:
+    """(flops, bytes_accessed) of an ALREADY-compiled executable (e.g. a
+    serve-engine AOT rung) from XLA's cost analysis — None fields when
+    the backend/serialization path doesn't report them (deserialized
+    executables may not carry an HLO cost model)."""
     try:
-        compiled = jitted.lower(*args).compile()
         cost = compiled.cost_analysis()
         if isinstance(cost, list):  # older jax: one dict per program
             cost = cost[0]
@@ -125,6 +125,18 @@ def compiled_cost(jitted, *args) -> tuple[float | None, float | None]:
     except Exception as e:  # pragma: no cover — backend-dependent
         log.info("cost_analysis unavailable: %s", e)
         return None, None
+
+
+def compiled_cost(jitted, *args) -> tuple[float | None, float | None]:
+    """(flops, bytes_accessed) of ONE invocation of an already-jitted
+    callable on `args`, from XLA's cost analysis (None fields when the
+    backend doesn't report them)."""
+    try:
+        compiled = jitted.lower(*args).compile()
+    except Exception as e:  # pragma: no cover — backend-dependent
+        log.info("cost_analysis unavailable: %s", e)
+        return None, None
+    return executable_cost(compiled)
 
 
 def compiled_flops(jitted, *args) -> float | None:
@@ -155,6 +167,54 @@ def mbu(graphs_per_s: float, bytes_per_graph: float | None,
     if bw is None or bytes_per_graph is None:
         return None
     return graphs_per_s * bytes_per_graph / bw
+
+
+def variant_attribution(*, attention_impl: str, dtype: str,
+                        graphs_per_s: float | None,
+                        flops_per_graph: float | None,
+                        bytes_per_graph: float | None,
+                        peak_f: float | None = None,
+                        peak_b: float | None = None) -> dict:
+    """One roofline-attribution row for a (kernel variant, dtype) pair —
+    the shared schema bench.py / serve_bench.py / kernel_bench.py emit so
+    every measured number says WHICH hot-path implementation produced it
+    (segment / pallas / pallas_fused / blocked_dense x f32/bf16/int8).
+    mfu/mbu/roofline degrade to None off-chip (no peak published for a
+    host CPU) while flops/bytes stay — a CPU row is still attributable,
+    just not utilization-scored."""
+    row = {
+        "attention_impl": attention_impl,
+        "dtype": dtype,
+        "flops_per_graph": (round(flops_per_graph)
+                            if flops_per_graph is not None else None),
+        "bytes_per_graph": (round(bytes_per_graph)
+                            if bytes_per_graph is not None else None),
+        "mfu_pct": None, "mbu_pct": None, "roofline_graphs_per_s": None,
+    }
+    if graphs_per_s is not None:
+        eff = mfu(graphs_per_s, flops_per_graph, peak=peak_f)
+        bw_eff = mbu(graphs_per_s, bytes_per_graph, bw=peak_b)
+        if eff is not None:
+            row["mfu_pct"] = round(100 * eff, 2)
+        if bw_eff is not None:
+            row["mbu_pct"] = round(100 * bw_eff, 2)
+    ceiling = roofline_graphs_per_s(flops_per_graph, bytes_per_graph,
+                                    peak_f=peak_f, peak_b=peak_b)
+    if ceiling is not None:
+        row["roofline_graphs_per_s"] = round(ceiling, 1)
+    return row
+
+
+def publish_attribution(bus, row: dict, *, prefix: str = "roofline") -> None:
+    """Emit a variant_attribution row's numeric fields as telemetry
+    gauges (`<prefix>.mfu_pct` etc), tagged with the variant and dtype so
+    capture JSONLs carry per-variant utilization next to the counters
+    (docs/OBSERVABILITY.md)."""
+    tags = {"impl": row["attention_impl"], "dtype": row["dtype"]}
+    for field in ("mfu_pct", "mbu_pct", "roofline_graphs_per_s",
+                  "flops_per_graph", "bytes_per_graph"):
+        if row.get(field) is not None:
+            bus.gauge(f"{prefix}.{field}", row[field], **tags)
 
 
 def roofline_graphs_per_s(flops_per_graph: float | None,

@@ -163,6 +163,42 @@ class TestKeys:
         assert any(c.startswith("config.budget")
                    for c in aot.diff_components(c1, c2))
 
+    def test_kernel_variant_knobs_change_train_eval_key(self,
+                                                        preprocessed):
+        """attention_impl / kernel block sizes / blocked_dense_max_cells
+        (ISSUE 6) are ModelConfig fields baked into compiled programs as
+        constants — the shape-identical abstract signature cannot see
+        them, so each must land a different train/eval key (the same
+        hardening the PR-3 review applied to budget/vocab). The legacy
+        use_pallas_attention bool is key-relevant for the same reason."""
+        import dataclasses
+
+        from pertgnn_tpu.train.loop import _train_eval_key_config
+
+        cfg = _cfg("")
+        ds = build_dataset(preprocessed, cfg)
+        env = {"jax": "1"}
+        sig = {"leaves": ["(5,):int32"], "treedef": "*"}
+        base_key, base_c = aot.cache_key(
+            fn_id="f", config=_train_eval_key_config(ds, cfg,
+                                                     compact=False),
+            args_sig=sig, env=env)
+        for field, value in (("attention_impl", "pallas_fused"),
+                             ("attention_impl", "blocked_dense"),
+                             ("kernel_block_n", 256),
+                             ("kernel_block_e", 64),
+                             ("blocked_dense_max_cells", 4096),
+                             ("use_pallas_attention", True)):
+            cfg2 = cfg.replace(model=dataclasses.replace(
+                cfg.model, **{field: value}))
+            k2, c2 = aot.cache_key(
+                fn_id="f", config=_train_eval_key_config(ds, cfg2,
+                                                         compact=False),
+                args_sig=sig, env=env)
+            assert k2 != base_key, field
+            assert any(f"config.model.{field}" in c
+                       for c in aot.diff_components(base_c, c2)), field
+
     def test_model_init_key_covers_vocab_sizes(self):
         """make_model bakes the dataset vocab sizes into embedding
         table shapes; same packed-sample signature + different vocab
@@ -255,6 +291,26 @@ class TestStoreRoundTrip:
             name_a, key_a, _c, _a = engine._rung_entry(i)
             name_b, key_b, _c2, _a2 = other._rung_entry(i)
             assert (name_a, key_a) == (name_b, key_b)
+
+    def test_serve_dtype_changes_rung_key(self, warmed):
+        """serve_dtype is the ONE ServeConfig field baked into the rung
+        step program (bf16 model dtype / int8 dequantize graph). bf16
+        does not change the params signature at all — only the explicit
+        key component can carry the invalidation, so a quantized
+        executable can never replay for an f32 config."""
+        import dataclasses
+
+        _root, ds, cfg, state, engine, _bus = warmed
+        name_a, key_a, comp_a, _args = engine._rung_entry(0)
+        for dtype in ("bf16", "int8"):
+            cfg2 = cfg.replace(serve=dataclasses.replace(
+                cfg.serve, serve_dtype=dtype))
+            other = InferenceEngine.from_dataset(ds, cfg2, state)
+            name_b, key_b, comp_b, _args_b = other._rung_entry(0)
+            assert name_a == name_b, dtype  # same shape slot
+            assert key_a != key_b, dtype
+            assert any("serve_dtype" in c
+                       for c in aot.diff_components(comp_a, comp_b)), dtype
 
     def test_corrupt_entry_falls_back_to_fresh_compile(
             self, warmed, tmp_path, caplog):

@@ -8,6 +8,7 @@ no-downgrade / orphan-fallback rules are pinned here against tmp paths.
 """
 
 import json
+import os
 import time
 
 import pytest
@@ -173,3 +174,101 @@ def test_assemble_result_uses_peak_overrides():
     assert r["roofline_graphs_per_s"] == pytest.approx(1000.0)
     assert r["fit_over_ceiling"] == 0.5
     assert r["staged_over_unstaged"] == 1.25
+
+
+# --- bench.py --gate: throughput-regression gate vs BENCH_r* history ----
+
+
+def _hist(round_name, value, spread, backend="cpu", impl="segment"):
+    return {"_round": round_name, "value": value,
+            "fit_spread_pct": spread, "backend": backend,
+            "attention_impl": impl}
+
+
+class TestGate:
+    def test_pass_within_spread(self):
+        ok, d = bench.gate_check(
+            {"value": 950.0, "backend": "cpu"},
+            [_hist("BENCH_r01.json", 1000.0, 10.0)])
+        assert ok and d["verdict"] == "pass"
+        assert d["floor_graphs_per_s"] == 900.0
+
+    def test_fail_beyond_spread(self):
+        ok, d = bench.gate_check(
+            {"value": 850.0, "backend": "cpu"},
+            [_hist("BENCH_r01.json", 1000.0, 10.0)])
+        assert not ok and "FAIL" in d["verdict"]
+
+    def test_reference_is_most_recent_comparable(self):
+        """r03 measured 4299 on a fast host, r05 measured 2868 on a slow
+        one — the gate must reference the LATEST round, not the
+        historical max, or host variance reads as a code regression."""
+        hist = [_hist("BENCH_r03.json", 4299.3, 4.7),
+                _hist("BENCH_r05.json", 2868.4, 17.1)]
+        ok, d = bench.gate_check({"value": 2800.0, "backend": "cpu"},
+                                 hist)
+        assert ok and d["reference_round"] == "BENCH_r05.json"
+
+    def test_backend_and_variant_scope_comparability(self):
+        hist = [_hist("BENCH_r01.json", 9000.0, 1.0, backend="tpu"),
+                _hist("BENCH_r02.json", 1000.0, 1.0,
+                      impl="blocked_dense")]
+        ok, d = bench.gate_check({"value": 5.0, "backend": "cpu"}, hist)
+        assert ok and "no comparable history" in d["verdict"]
+        ok2, d2 = bench.gate_check(
+            {"value": 995.0, "backend": "cpu",
+             "attention_impl": "blocked_dense"}, hist)
+        assert ok2 and d2["reference_round"] == "BENCH_r02.json"
+
+    def test_latency_metric_gates_upward(self):
+        """A latency headline regresses by RISING: the gate must fail a
+        doubling and pass an improvement, not the other way around."""
+        hist = [{"_round": "BENCH_r09.json", "value": 5.0,
+                 "fit_spread_pct": 20.0, "backend": "cpu",
+                 "metric": "pert_serve_request_latency_ms_p50",
+                 "unit": "ms"}]
+        run = {"backend": "cpu", "unit": "ms",
+               "metric": "pert_serve_request_latency_ms_p50"}
+        ok, d = bench.gate_check({**run, "value": 10.0}, hist)
+        assert not ok and "ceiling" in d["verdict"]
+        ok, d = bench.gate_check({**run, "value": 4.0}, hist)
+        assert ok and d["ceiling_ms"] == 6.0
+
+    def test_fallback_capture_is_refused_as_variant_witness(self):
+        """kernel_fallbacks > 0 means the programs (partly) traced the
+        segment path — the gate must refuse the capture outright rather
+        than compare segment numbers against the claimed variant."""
+        ok, d = bench.gate_check(
+            {"value": 9999.0, "backend": "cpu",
+             "attention_impl": "pallas_fused", "kernel_fallbacks": 2},
+            [])
+        assert not ok and "fallback" in d["verdict"]
+        # a segment run with the (vacuous) zero stamp still gates
+        ok, _ = bench.gate_check(
+            {"value": 100.0, "backend": "cpu", "kernel_fallbacks": 0},
+            [_hist("BENCH_r01.json", 100.0, 5.0)])
+        assert ok
+
+    def test_history_loader_skips_failed_rounds(self, tmp_path):
+        good = {"n": 1, "rc": 0,
+                "parsed": {"value": 100.0, "backend": "cpu",
+                           "fit_spread_pct": 5.0}}
+        bad_rc = {"n": 2, "rc": 1, "parsed": {"value": 1.0}}
+        no_parse = {"n": 3, "rc": 0, "tail": "exploded"}
+        for name, payload in (("BENCH_r01.json", good),
+                              ("BENCH_r02.json", bad_rc),
+                              ("BENCH_r03.json", no_parse)):
+            (tmp_path / name).write_text(json.dumps(payload))
+        recs = bench._history_records(str(tmp_path))
+        assert [r["_round"] for r in recs] == ["BENCH_r01.json"]
+
+    def test_gate_main_round_trip(self, tmp_path, capsys):
+        res = tmp_path / "result.json"
+        res.write_text(json.dumps({"value": 2800.0, "backend": "cpu",
+                                   "attention_impl": "segment"}))
+        rc = bench.gate_main([str(res)])
+        out = json.loads(capsys.readouterr().out)
+        assert "gate" in out and isinstance(rc, int)
+        # against the REAL repo history: a value inside r05's spread
+        # window passes (the acceptance criterion's CPU check)
+        assert rc == 0

@@ -312,6 +312,97 @@ def test_model_reference_stack_arithmetic():
     assert np.isfinite(np.asarray(gp)).all()
 
 
+class TestAttentionImplGrid:
+    """The kernel variants (ISSUE 6) are IMPLEMENTATIONS of one layer:
+    forward, gradient, and full fit() trajectories must match the
+    segment reference on CPU (Pallas variants in interpret mode)."""
+
+    # Plain "pallas" parity is already tier-1 via the kernel-level tests
+    # and test_model_forward_with_pallas_flag; its interpret-mode grid
+    # runs are the slowest, so they ride in the slow lane.
+    IMPLS = (pytest.param("pallas", marks=pytest.mark.slow),
+             "pallas_fused", "blocked_dense")
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_model_forward_matches_segment(self, impl):
+        b = jax.tree.map(jnp.asarray, _tiny_batch())
+        outs = {}
+        for which in ("segment", impl):
+            cfg = ModelConfig(hidden_channels=16, num_layers=2,
+                              attention_impl=which)
+            model = make_model(cfg, num_ms=5, num_entries=4,
+                               num_interfaces=4, num_rpctypes=3)
+            vars_ = model.init(jax.random.PRNGKey(0), b, training=False)
+            outs[which] = model.apply(vars_, b, training=False)
+        np.testing.assert_allclose(np.asarray(outs["segment"][0]),
+                                   np.asarray(outs[impl][0]),
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.fixture(scope="class")
+    def grid_ds_and_segment_hist(self, preprocessed):
+        from pertgnn_tpu.batching import build_dataset
+        from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                        TrainConfig)
+        from pertgnn_tpu.train.loop import fit
+
+        base = Config(
+            ingest=IngestConfig(min_traces_per_entry=10),
+            data=DataConfig(max_traces=96, batch_size=8),
+            model=ModelConfig(hidden_channels=8, num_layers=2),
+            train=TrainConfig(lr=1e-2, epochs=2, label_scale=1000.0),
+        )
+        ds = build_dataset(preprocessed, base)
+        _, hist = fit(ds, base)
+        return base, ds, hist
+
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_fit_grid_twin(self, grid_ds_and_segment_hist, impl):
+        """The grid twin: two epochs of fit() under each attention_impl
+        land on the segment trajectory within float tolerance — training
+        numerics, not just a single forward. (pallas_fused's BN
+        statistics use the E[y²]−E[y]² formulation, so equality is
+        float-tolerant, not bitwise.)"""
+        import dataclasses
+
+        from pertgnn_tpu.train.loop import fit
+
+        base, ds, hist_seg = grid_ds_and_segment_hist
+        cfg = base.replace(model=dataclasses.replace(
+            base.model, attention_impl=impl))
+        _, hist_var = fit(ds, cfg)
+        assert len(hist_var) == len(hist_seg)
+        for rs, rv in zip(hist_seg, hist_var):
+            for k in ("train_qloss", "train_mae", "valid_mae",
+                      "test_mae"):
+                np.testing.assert_allclose(
+                    rv[k], rs[k], rtol=5e-3,
+                    err_msg=f"{impl}: history field {k}")
+
+    def test_blocked_dense_over_cells_falls_back_loudly(self, caplog):
+        """blocked_dense above max_cells must take the segment path AND
+        leave a trace (log + model.kernel_fallback counter) — identical
+        output, never a silent formulation switch."""
+        import logging
+
+        b = jax.tree.map(jnp.asarray, _tiny_batch())
+        out = {}
+        for cells in (1 << 22, 1):  # admissible, then inadmissible
+            cfg = ModelConfig(hidden_channels=16, num_layers=2,
+                              attention_impl="blocked_dense",
+                              blocked_dense_max_cells=cells)
+            model = make_model(cfg, num_ms=5, num_entries=4,
+                               num_interfaces=4, num_rpctypes=3)
+            vars_ = model.init(jax.random.PRNGKey(0), b, training=False)
+            with caplog.at_level(logging.WARNING,
+                                 logger="pertgnn_tpu.models.layers"):
+                out[cells] = np.asarray(model.apply(
+                    vars_, b, training=False)[0])
+        np.testing.assert_allclose(out[1], out[1 << 22],
+                                   rtol=1e-5, atol=1e-6)
+        assert any("fell back to the segment path" in r.message
+                   for r in caplog.records)
+
+
 def test_nonnegative_option():
     cfg = ModelConfig(hidden_channels=8, nonnegative_pred=True)
     model = make_model(cfg, 5, 4, 4, 3)

@@ -11,7 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from pertgnn_tpu.ops.pallas_attention import _reference, edge_attention
+from pertgnn_tpu.ops.pallas_attention import (_reference, edge_attention,
+                                              fused_epilogue)
 
 
 def _case(rng, n, e, heads, dim, mask_frac=0.2, sort=False):
@@ -110,6 +111,146 @@ def test_stack_batches_preserves_sorted_invariant():
     got = sorted([(int(r), int(s)) for r, s, m in
                   zip(glob.receivers, glob.senders, glob.edge_mask) if m])
     assert want == got
+
+
+def _epilogue_case(rng, n, f_in, hd, mask_frac=0.3):
+    attn = jnp.asarray(rng.normal(size=(n, hd)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(n, f_in)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(f_in, hd)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(hd,)), jnp.float32)
+    node_mask = jnp.asarray(rng.random(n) > mask_frac)
+    return attn, x, w, b, node_mask
+
+
+def _epilogue_ref(attn, x, w, b, node_mask):
+    y = attn + x @ w + b[None, :]
+    m = node_mask.astype(jnp.float32)[:, None]
+    ym = y * m
+    return y, jnp.stack([ym.sum(0), (ym * y).sum(0)])
+
+
+class TestFusedEpilogue:
+    """fused_epilogue = skip GEMM + residual + masked BN-stat partials in
+    one Pallas pass (interpret mode on CPU). Oracle: the plain-XLA
+    formulation the unfused layer path computes."""
+
+    @pytest.mark.parametrize("n,f_in,hd", [
+        (37, 12, 16),    # sub-block node count
+        (128, 9, 32),    # exactly one node block
+        (300, 33, 8),    # multi-block, lane-unaligned feature widths
+    ])
+    def test_forward_matches_unfused(self, n, f_in, hd):
+        rng = np.random.default_rng(n)
+        attn, x, w, b, node_mask = _epilogue_case(rng, n, f_in, hd)
+        y, stats = fused_epilogue(attn, x, w, b, node_mask)
+        y_ref, stats_ref = _epilogue_ref(attn, x, w, b, node_mask)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(stats), np.asarray(stats_ref),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_all_nodes_masked_zero_stats(self):
+        """Empty mask: y is still computed for every row (pad rows are
+        dropped later by the caller), but the stat partials are zero."""
+        rng = np.random.default_rng(5)
+        attn, x, w, b, _ = _epilogue_case(rng, 50, 8, 16)
+        y, stats = fused_epilogue(attn, x, w, b, jnp.zeros(50, bool))
+        y_ref, _ = _epilogue_ref(attn, x, w, b, jnp.zeros(50, bool))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-5)
+        assert np.abs(np.asarray(stats)).max() == 0.0
+
+    def test_gradients_match_unfused(self):
+        """Full cotangent surface: a loss that consumes BOTH outputs (y
+        and the stat partials) so the custom bwd's stats term is
+        exercised, wrt every differentiable operand."""
+        rng = np.random.default_rng(6)
+        attn, x, w, b, node_mask = _epilogue_case(rng, 90, 10, 16)
+
+        def loss_fused(attn, x, w, b):
+            y, stats = fused_epilogue(attn, x, w, b, node_mask)
+            return (y ** 2).sum() + (stats * 0.1).sum()
+
+        def loss_ref(attn, x, w, b):
+            y, stats = _epilogue_ref(attn, x, w, b, node_mask)
+            return (y ** 2).sum() + (stats * 0.1).sum()
+
+        g1 = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(attn, x, w, b)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(attn, x, w, b)
+        for a, r in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=1e-4, atol=1e-3)
+
+    def test_jit_path(self):
+        rng = np.random.default_rng(7)
+        attn, x, w, b, node_mask = _epilogue_case(rng, 70, 8, 8)
+        y, stats = jax.jit(fused_epilogue)(attn, x, w, b, node_mask)
+        y_ref, stats_ref = _epilogue_ref(attn, x, w, b, node_mask)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(stats),
+                                   np.asarray(stats_ref),
+                                   rtol=1e-4, atol=1e-3)
+
+
+class TestBlockedDense:
+    """ops/blocked_dense: the segment ops as masked dense matmuls.
+    Oracle: the segment reference — same contract as the Pallas kernel
+    (tests above), asserted over the same corner cases."""
+
+    @pytest.mark.parametrize("n,e,heads,dim", [
+        (50, 200, 1, 32),
+        (300, 700, 4, 16),
+        (5, 3, 2, 8),      # fewer edges than nodes; empty receivers
+        (130, 1, 1, 8),
+    ])
+    def test_matches_segment_path(self, n, e, heads, dim):
+        from pertgnn_tpu.ops.blocked_dense import blocked_dense_edge_attention
+
+        rng = np.random.default_rng(n + e + 1)
+        q, k, v, rcv, mask = _case(rng, n, e, heads, dim)
+        out = blocked_dense_edge_attention(q, k, v, rcv, mask, n)
+        ref = _reference(q, k, v, rcv, mask, n)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_all_edges_masked_gives_zeros(self):
+        from pertgnn_tpu.ops.blocked_dense import blocked_dense_edge_attention
+
+        rng = np.random.default_rng(11)
+        q, k, v, rcv, _ = _case(rng, 40, 60, 1, 8)
+        out = blocked_dense_edge_attention(q, k, v, rcv,
+                                           jnp.zeros(60, bool), 40)
+        assert np.abs(np.asarray(out)).max() == 0.0
+
+    def test_gradients_match_segment_path(self):
+        from pertgnn_tpu.ops.blocked_dense import blocked_dense_edge_attention
+
+        rng = np.random.default_rng(12)
+        q, k, v, rcv, mask = _case(rng, 60, 150, 2, 8)
+
+        def loss_bd(q, k, v):
+            return (blocked_dense_edge_attention(q, k, v, rcv, mask,
+                                                 60) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_reference(q, k, v, rcv, mask, 60) ** 2).sum()
+
+        g1 = jax.jit(jax.grad(loss_bd, argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_admissibility_gate(self):
+        """fits()/dense_cells: the max_cells guard the layer consults
+        before materializing the quadratic incidence mask."""
+        from pertgnn_tpu.ops.blocked_dense import dense_cells, fits
+
+        assert dense_cells(100, 500) == 128 * 512
+        assert dense_cells(1, 1, block_n=64, block_e=64) == 64 * 64
+        assert fits(100, 500, max_cells=128 * 512)
+        assert not fits(100, 513, max_cells=128 * 512)
 
 
 def test_model_forward_with_pallas_flag():

@@ -1,0 +1,62 @@
+"""ops/quantize: weight-only int8 quantization for the serve tier.
+
+The REAL quality gate is benchmarks/serve_bench.py's quantile-loss
+delta; these tests pin the mechanics — shape/dtype contracts, round-trip
+error bounds, pytree structure, and the all-zero-channel edge case.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from pertgnn_tpu.ops.quantize import (dequantize_array, dequantize_tree,
+                                      quantization_error, quantize_array,
+                                      quantize_tree)
+
+
+def test_roundtrip_error_bounded_by_one_step():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    q, scale = quantize_array(w)
+    assert q.dtype == jnp.int8 and scale.shape == (1, 32)
+    back = np.asarray(dequantize_array(q, scale, jnp.float32))
+    # symmetric rounding: error <= scale/2 per element, per channel
+    err = np.abs(back - np.asarray(w))
+    assert (err <= np.asarray(scale)[0][None, :] * 0.5 + 1e-7).all()
+
+
+def test_zero_channel_is_exact():
+    w = jnp.zeros((8, 3), jnp.float32).at[:, 1].set(2.0)
+    q, scale = quantize_array(w)
+    back = np.asarray(dequantize_array(q, scale, jnp.float32))
+    np.testing.assert_array_equal(back[:, 0], 0.0)
+    np.testing.assert_allclose(back[:, 1], 2.0, rtol=1e-2)
+
+
+def test_tree_structure_and_selective_quantization():
+    """Only 2-D float leaves quantize; biases/stats/ints pass through,
+    and dequantize_tree restores the original nesting."""
+    params = {
+        "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))},
+        "bn": {"scale": jnp.ones((4,)), "mean": jnp.zeros((4,))},
+        "step": jnp.zeros((), jnp.int32),
+    }
+    q = quantize_tree(params)
+    assert set(q["dense"]["kernel"]) == {"int8", "scale"}
+    assert q["dense"]["bias"] is params["dense"]["bias"]
+    assert q["step"] is params["step"]
+    back = dequantize_tree(q, jnp.float32)
+    assert back["dense"]["kernel"].shape == (4, 4)
+    np.testing.assert_allclose(np.asarray(back["dense"]["kernel"]), 1.0,
+                               rtol=1e-2)
+    assert back["bn"]["mean"] is params["bn"]["mean"]
+
+
+def test_quantization_error_probe():
+    rng = np.random.default_rng(1)
+    params = {"a": {"kernel": jnp.asarray(rng.normal(size=(16, 8)),
+                                          jnp.float32)},
+              "b": jnp.ones((8,))}
+    report = quantization_error(params)
+    assert report["quantized_leaves"] == 1
+    # int8 symmetric: worst-case relative error ~ 1/(2*127)
+    assert 0.0 < report["max_rel_error"] < 1.0 / 64

@@ -222,6 +222,80 @@ class TestExecutableCache:
         assert len(stats["buckets"]) == len(engine.ladder)
 
 
+class TestQuantizedServeTier:
+    """ServeConfig.serve_dtype (ISSUE 6): the bf16/int8 engines serve
+    through the same per-rung AOT path with predictions close to the f32
+    engine — the HARD quality gate lives in benchmarks/serve_bench.py
+    (quantile-loss delta, exit-code-asserted); these pin the mechanics
+    and closeness on the shared corpus."""
+
+    @pytest.fixture(scope="class", params=["bf16", "int8"])
+    def quantized(self, request, served):
+        import dataclasses
+
+        ds, cfg, state, _engine = served
+        cfg_q = cfg.replace(serve=dataclasses.replace(
+            cfg.serve, serve_dtype=request.param))
+        return request.param, ds, cfg_q, state, InferenceEngine.from_dataset(
+            ds, cfg_q, state).warmup()
+
+    def test_predictions_close_to_f32(self, served, quantized):
+        ds, _cfg, _state, engine_f = served
+        dtype, _ds, _cfg_q, _state_q, engine_q = quantized
+        s = ds.splits["test"]
+        n = min(len(s.entry_ids), 24)
+        pf = engine_f.predict_many(s.entry_ids[:n], s.ts_buckets[:n])
+        pq = engine_q.predict_many(s.entry_ids[:n], s.ts_buckets[:n])
+        assert pq.shape == pf.shape
+        assert np.isfinite(np.asarray(pq, np.float32)).all()
+        # bf16 mantissa ~ 3 decimal digits; int8 weights add quant noise
+        tol = 0.02 if dtype == "bf16" else 0.06
+        scale = max(float(np.abs(np.asarray(pf)).max()), 1e-6)
+        assert float(np.abs(np.asarray(pq, np.float32)
+                            - np.asarray(pf, np.float32)).max()) <= \
+            tol * scale, dtype
+
+    def test_zero_cache_misses_after_warmup(self, quantized):
+        dtype, ds, _cfg, _state, engine = quantized
+        s = ds.splits["test"]
+        engine.predict_many(s.entry_ids[:16], s.ts_buckets[:16])
+        stats = engine.stats_dict()
+        assert stats["cache_misses"] == 0, dtype
+
+    def test_int8_params_live_as_int8_on_device(self, quantized):
+        """The int8 engine's device-resident 2-D weights must BE int8
+        (the HBM saving is the point) with per-channel f32 scales."""
+        import jax.numpy as jnp
+
+        dtype, _ds, _cfg, _state, engine = quantized
+        if dtype != "int8":
+            pytest.skip("int8-specific")
+        leaves = []
+
+        def walk(node):
+            if isinstance(node, dict):
+                if set(node) == {"int8", "scale"}:
+                    leaves.append(node)
+                else:
+                    for v in node.values():
+                        walk(v)
+
+        walk(engine._variables["params"])
+        assert leaves, "no quantized leaves on the int8 engine"
+        for q in leaves:
+            assert q["int8"].dtype == jnp.int8
+            assert q["scale"].dtype == jnp.float32
+
+    def test_unknown_dtype_rejected(self, served):
+        import dataclasses
+
+        ds, cfg, state, _engine = served
+        bad = cfg.replace(serve=dataclasses.replace(cfg.serve,
+                                                    serve_dtype="fp8"))
+        with pytest.raises(ValueError, match="serve_dtype"):
+            InferenceEngine.from_dataset(ds, bad, state)
+
+
 class TestMicrobatchQueue:
     def test_coalescing_preserves_alignment(self, served):
         """Requests submitted concurrently and coalesced into shared

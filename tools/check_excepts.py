@@ -14,6 +14,13 @@ tests/test_check_excepts.py:
    or a telemetry counter/gauge/event — failures may be survivable, but
    never invisible.
 
+A handler may also delegate its trace to a HELPER defined in the same
+file (e.g. ``models/layers._count_kernel_fallback``, the log+count
+helper every ops/ kernel-fallback path routes through): a call to a
+same-module function whose own body leaves a trace counts as leaving a
+trace. One level only, resolved statically — a helper that itself
+delegates must be exempted explicitly.
+
 A deliberate, documented swallow that genuinely needs silence can carry
 ``# lint: allow-silent-except`` on its ``except`` line; the escape is
 greppable, so every exemption stays reviewable.
@@ -60,14 +67,10 @@ def _is_broad(handler: ast.ExceptHandler) -> bool:
     return False
 
 
-def _leaves_trace(handler: ast.ExceptHandler) -> bool:
-    for node in ast.walk(handler):
-        if isinstance(node, ast.Raise):
-            return True  # not a swallow: it propagates
-        if isinstance(node, ast.Return) and node.value is not None:
-            # `return some_call(...)` style fallbacks still swallow —
-            # only an explicit trace call below counts
-            pass
+def _has_trace_call(root: ast.AST) -> bool:
+    """Whether any call under `root` is a direct trace (logger method,
+    warnings.warn, telemetry bus, loud print)."""
+    for node in ast.walk(root):
         if isinstance(node, ast.Call):
             fn = node.func
             if isinstance(fn, ast.Attribute) and fn.attr in _TRACE_ATTRS:
@@ -78,6 +81,33 @@ def _leaves_trace(handler: ast.ExceptHandler) -> bool:
     return False
 
 
+def _trace_helpers(tree: ast.AST) -> set[str]:
+    """Names of functions defined in THIS file whose body leaves a
+    trace — a handler calling one of them is logging/counting by
+    delegation (the ops/ kernel-fallback pattern: one helper owns the
+    log+counter so every fallback site stays consistent). Static,
+    same-module, one level deep."""
+    return {node.name for node in ast.walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and _has_trace_call(node)}
+
+
+def _leaves_trace(handler: ast.ExceptHandler,
+                  helpers: set[str] | None = None) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True  # not a swallow: it propagates
+        if isinstance(node, ast.Return) and node.value is not None:
+            # `return some_call(...)` style fallbacks still swallow —
+            # only an explicit trace call below counts
+            pass
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and helpers and fn.id in helpers:
+                return True  # same-module helper that itself traces
+    return _has_trace_call(handler)
+
+
 def check_file(path: str) -> list[str]:
     with open(path, encoding="utf-8") as f:
         source = f.read()
@@ -86,6 +116,7 @@ def check_file(path: str) -> list[str]:
     except SyntaxError as exc:
         return [f"{path}:{exc.lineno}: unparseable ({exc.msg})"]
     lines = source.splitlines()
+    helpers = _trace_helpers(tree)
     out = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.ExceptHandler):
@@ -98,7 +129,7 @@ def check_file(path: str) -> list[str]:
                        f"forbidden (catch a specific type, or at widest "
                        f"`Exception`)")
             continue
-        if _is_broad(node) and not _leaves_trace(node):
+        if _is_broad(node) and not _leaves_trace(node, helpers):
             out.append(
                 f"{path}:{node.lineno}: `except "
                 f"{ast.unparse(node.type)}` swallows silently — log it, "
