@@ -13,8 +13,9 @@ import os
 
 from pertgnn_tpu.config import (ATTENTION_IMPLS, SERVE_DTYPES,
                                 CompileCacheConfig, Config, DataConfig,
-                                IngestConfig, ModelConfig, ParallelConfig,
-                                ServeConfig, TelemetryConfig, TrainConfig)
+                                FleetConfig, IngestConfig, ModelConfig,
+                                ParallelConfig, ServeConfig,
+                                TelemetryConfig, TrainConfig)
 
 
 def apply_platform_env() -> None:
@@ -321,6 +322,89 @@ def add_serve_flags(p: argparse.ArgumentParser) -> None:
                         "(docs/GUIDE.md)")
 
 
+def add_fleet_flags(p: argparse.ArgumentParser) -> None:
+    """Replicated-fleet knobs (FleetConfig, pertgnn_tpu/fleet/) —
+    cli/fleet_main.py's router/launcher surface."""
+    p.add_argument("--num_workers", type=int,
+                   default=FleetConfig.num_workers,
+                   help="serve workers the launcher spawns (one "
+                        "engine+queue stack each, warm from the shared "
+                        "--compile_cache_dir/--arena_cache_dir)")
+    p.add_argument("--worker_base_port", type=int,
+                   default=FleetConfig.worker_base_port,
+                   help="first worker HTTP port (worker i listens on "
+                        "base+i); 0 = pick free ephemeral ports")
+    p.add_argument("--router_flush_deadline_ms", type=float,
+                   default=FleetConfig.router_flush_deadline_ms,
+                   help="router-side microbatch coalescing window "
+                        "(fleet twin of --flush_deadline_ms)")
+    p.add_argument("--router_max_pending", type=int,
+                   default=FleetConfig.max_pending,
+                   help="front-door admission control: max queued "
+                        "requests before submit fast-fails with "
+                        "QueueFull (router.shed)")
+    p.add_argument("--router_request_deadline_ms", type=float,
+                   default=FleetConfig.request_deadline_ms,
+                   help="per-request deadline at the door: shed at "
+                        "submit when no worker's predicted completion "
+                        "can meet it (router.shed_infeasible); 0 = off")
+    p.add_argument("--router_dispatch_timeout_s", type=float,
+                   default=FleetConfig.dispatch_timeout_s,
+                   help="per-dispatch worker-call timeout; past it the "
+                        "worker counts as lost and its batch requeues")
+    p.add_argument("--worker_slots", type=int,
+                   default=FleetConfig.worker_slots,
+                   help="outstanding microbatches per worker before the "
+                        "router stops assigning it more")
+    p.add_argument("--health_poll_interval_s", type=float,
+                   default=FleetConfig.health_poll_interval_s,
+                   help="membership: worker /healthz poll cadence")
+    p.add_argument("--probe_lost_after", type=int,
+                   default=FleetConfig.probe_lost_after,
+                   help="consecutive failed probes before a member is "
+                        "excluded (transport failures exclude "
+                        "immediately)")
+    p.add_argument("--latency_ewma_alpha", type=float,
+                   default=FleetConfig.latency_ewma_alpha,
+                   help="EWMA smoothing of the per-worker batch-latency "
+                        "estimate feeding least-loaded dispatch")
+    p.add_argument("--max_requeues", type=int,
+                   default=FleetConfig.max_requeues,
+                   help="times one request may requeue (worker loss) "
+                        "before the router fails it with the last error")
+
+
+def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
+    """The ONE flags -> FleetConfig mapping (same pattern as
+    telemetry_config_from_args); config_from_args embeds it so the
+    sidecar provenance and the live router cannot drift."""
+    return FleetConfig(
+        num_workers=getattr(args, "num_workers",
+                            FleetConfig.num_workers),
+        worker_base_port=getattr(args, "worker_base_port",
+                                 FleetConfig.worker_base_port),
+        router_flush_deadline_ms=getattr(
+            args, "router_flush_deadline_ms",
+            FleetConfig.router_flush_deadline_ms),
+        max_pending=getattr(args, "router_max_pending",
+                            FleetConfig.max_pending),
+        request_deadline_ms=getattr(args, "router_request_deadline_ms",
+                                    FleetConfig.request_deadline_ms),
+        dispatch_timeout_s=getattr(args, "router_dispatch_timeout_s",
+                                   FleetConfig.dispatch_timeout_s),
+        worker_slots=getattr(args, "worker_slots",
+                             FleetConfig.worker_slots),
+        health_poll_interval_s=getattr(
+            args, "health_poll_interval_s",
+            FleetConfig.health_poll_interval_s),
+        probe_lost_after=getattr(args, "probe_lost_after",
+                                 FleetConfig.probe_lost_after),
+        latency_ewma_alpha=getattr(args, "latency_ewma_alpha",
+                                   FleetConfig.latency_ewma_alpha),
+        max_requeues=getattr(args, "max_requeues",
+                             FleetConfig.max_requeues))
+
+
 def add_aot_flags(p: argparse.ArgumentParser) -> None:
     """Cold-start / compile-cache knobs (CompileCacheConfig,
     pertgnn_tpu/aot/) — shared by ALL CLIs and bench.py: any entry point
@@ -510,6 +594,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                                          False),
             serve_dtype=getattr(args, "serve_dtype",
                                 ServeConfig.serve_dtype)),
+        fleet=fleet_config_from_args(args),
         telemetry=telemetry_config_from_args(args),
         aot=aot_config_from_args(args),
         graph_type=args.graph_type,

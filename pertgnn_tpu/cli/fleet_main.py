@@ -1,0 +1,450 @@
+"""Fleet CLI: spawn N warm serve workers and route traffic to them.
+
+    # one command: launcher spawns the workers, routes the stream,
+    # writes the CSV + one stats JSON line (serve_main's schema family)
+    python -m pertgnn_tpu.cli.fleet_main --artifact_dir processed \
+        --checkpoint_dir ckpts --compile_cache_dir cache/aot \
+        --arena_cache_dir cache/arena --num_workers 4 \
+        --from_split test --out served.csv
+
+The launcher builds the dataset once (warm from --arena_cache_dir),
+spawns ``--num_workers`` worker processes — each a full serve stack
+(engine + PR-4-hardened microbatch queue) behind an HTTP transport
+(fleet/transport.py) — waits for every /healthz readiness probe, then
+drives the request stream through the front-door router
+(fleet/router.py): deadline-aware least-loaded dispatch, requeue on
+worker loss, probe-driven membership.
+
+Warm start is the point: with shared ``--compile_cache_dir`` and
+``--arena_cache_dir`` a worker goes cold-to-ready in seconds — zero
+compiles (rung executables deserialize from the AOT store, PR 3) and
+zero ingest (the dataset reconstructs from the mmap'd arena store,
+PR 5). Each worker's probe body carries the evidence (``compiles``,
+``deserialized``, ``arena_warm``), which benchmarks/fleet_bench.py
+exit-code-asserts. TRUST: workers deserialize executables from the
+compile cache and load training data from the arena cache — every
+fleet member must trust whoever can write those directories exactly
+as it trusts its checkpoints (docs/GUIDE.md).
+
+Worker role (spawned internally; also usable standalone for one
+worker per host): ``--role worker --worker_port P`` serves POST
+/predict + GET /healthz until SIGTERM, then drains FAST — admissions
+stop, the undispatched backlog is handed back via
+``MicrobatchQueue.requeue()`` and answered with retryable QueueClosed
+rows (the router re-dispatches them to surviving workers), in-flight
+batches flush, exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from pertgnn_tpu.cli.common import (add_aot_flags, add_fleet_flags,
+                                    add_ingest_flags,
+                                    add_model_train_flags, add_serve_flags,
+                                    add_telemetry_flags,
+                                    apply_platform_env,
+                                    build_dataset_cached, config_from_args,
+                                    setup_compile_cache, setup_telemetry)
+from pertgnn_tpu.utils.logging import setup_logging
+from pertgnn_tpu.utils.profiling import LatencyRecorder
+
+# launcher-only flags (value-taking unless noted) stripped from the
+# argv forwarded to workers; everything else — ingest, model, serve,
+# telemetry, aot, fleet tuning — forwards VERBATIM so a worker can
+# never serve under a different config than the router believes
+_LAUNCHER_ONLY = {"--role": 1, "--worker_port": 1, "--worker_id": 1,
+                  "--worker_cpu": 1}
+
+
+def _worker_argv(argv: list[str], worker_id: str, port: int) -> list[str]:
+    out = []
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        key = tok.split("=", 1)[0]
+        if key in _LAUNCHER_ONLY:
+            i += 1 + (_LAUNCHER_ONLY[key] if "=" not in tok else 0)
+            continue
+        out.append(tok)
+        i += 1
+    return [*out, "--role", "worker", "--worker_id", worker_id,
+            "--worker_port", str(port)]
+
+
+def _free_port() -> int:
+    """An ephemeral port that was free a moment ago (bind-and-release;
+    the classic small race, acceptable for a single-host fleet — a
+    collision fails the worker's bind loudly and the launcher reports
+    it)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_ingest_flags(p)
+    add_model_train_flags(p)
+    add_serve_flags(p)
+    add_fleet_flags(p)
+    add_telemetry_flags(p)
+    add_aot_flags(p)
+    p.add_argument("--role", choices=("launch", "worker"),
+                   default="launch",
+                   help="launch (default): spawn workers + route a "
+                        "request stream; worker: one serve worker "
+                        "(spawned by the launcher, or standalone for "
+                        "one-worker-per-host fleets)")
+    p.add_argument("--worker_port", type=int, default=0,
+                   help="worker role: HTTP port to bind (0 = ephemeral, "
+                        "printed in the ready line)")
+    p.add_argument("--worker_id", default="",
+                   help="worker role: identity stamped into the probe "
+                        "body and telemetry")
+    p.add_argument("--worker_cpu", type=int, default=-1,
+                   help="worker role: pin this worker (and its XLA "
+                        "threadpool) to one CPU core via "
+                        "sched_setaffinity; -1 = unpinned")
+    p.add_argument("--pin_worker_cpus", action="store_true",
+                   help="launcher: pin worker i to core i %% cpu_count "
+                        "— the CPU emulation of the fleet's real "
+                        "topology (one DEVICE per worker), and what "
+                        "makes N-worker-vs-1 scaling measurements "
+                        "honest on a shared-core host (fleet_bench)")
+    p.add_argument("--fresh_init", action="store_true",
+                   help="serve from a deterministic fresh-init state "
+                        "instead of a checkpoint (seeded — every worker "
+                        "inits bit-identically). For benches/tests "
+                        "where fleet mechanics, not weights, are under "
+                        "test; production fleets serve checkpoints")
+    p.add_argument("--requests", default="",
+                   help="CSV of requests (entry_id, ts_bucket columns); "
+                        "default: replay --from_split")
+    p.add_argument("--from_split", default="test",
+                   choices=("train", "valid", "test"))
+    p.add_argument("--num_requests", type=int, default=0,
+                   help="cap the request stream (0 = all)")
+    p.add_argument("--concurrency", type=int, default=16,
+                   help="client threads submitting to the router")
+    p.add_argument("--out", default="served.csv",
+                   help="per-request prediction CSV path")
+    p.add_argument("--ready_timeout_s", type=float, default=600.0,
+                   help="max seconds to wait for every worker's "
+                        "readiness probe before aborting the launch")
+    return p
+
+
+# -- worker role ---------------------------------------------------------
+
+def _run_worker(args, p: argparse.ArgumentParser) -> None:
+    if not args.checkpoint_dir and not args.fresh_init:
+        p.error("worker role needs --checkpoint_dir (or --fresh_init "
+                "for weight-independent bench/test fleets)")
+    if args.worker_cpu >= 0:
+        # BEFORE the jax backend initializes: the XLA CPU threadpool
+        # inherits this affinity mask, so the worker really is bounded
+        # by one core — the CPU stand-in for one-device-per-worker
+        if hasattr(os, "sched_setaffinity"):
+            ncpu = os.cpu_count() or 1
+            os.sched_setaffinity(0, {args.worker_cpu % ncpu})
+        else:  # non-Linux: run unpinned rather than die
+            print("WARNING: --worker_cpu needs sched_setaffinity; "
+                  "running unpinned", file=sys.stderr)
+    setup_telemetry(args, "fleet_worker")
+    setup_compile_cache(args)
+    cfg = config_from_args(args)
+
+    # warm-start evidence for the probe body: is the arena entry this
+    # exact (cfg, raw input) resolves to already on disk? (The answer
+    # the bench asserts — computed with the store's own key so it
+    # cannot drift from what load_or_build will actually hit.)
+    arena_warm = False
+    if cfg.data.arena_cache_dir:
+        try:
+            from pertgnn_tpu.batching.arena_store import arena_cache_key
+            from pertgnn_tpu.cli.common import raw_input_fingerprint
+            key, _ = arena_cache_key(cfg, raw_input_fingerprint(args))
+            arena_warm = os.path.exists(os.path.join(
+                cfg.data.arena_cache_dir, key, "meta.json"))
+        except Exception as exc:  # evidence, not control flow
+            print(f"WARNING: arena_warm probe failed: {exc}",
+                  file=sys.stderr)
+
+    dataset = build_dataset_cached(args, cfg)
+    from pertgnn_tpu.train.loop import restore_target_state
+    _model, state = restore_target_state(dataset, cfg)
+    if args.checkpoint_dir:
+        from pertgnn_tpu.train.checkpoint import CheckpointManager
+        ckpt = CheckpointManager(args.checkpoint_dir,
+                                 keep=args.checkpoint_keep)
+        state, epoch = ckpt.maybe_restore(state)
+        if epoch == 0:
+            p.error(f"no checkpoint found in {args.checkpoint_dir}")
+
+    from pertgnn_tpu.fleet.transport import WorkerServer
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.serve.errors import QueueClosed
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+    engine = InferenceEngine.from_dataset(dataset, cfg, state)
+    if cfg.serve.warmup:
+        engine.warmup()
+    worker_id = args.worker_id or f"w{os.getpid()}"
+
+    stop = threading.Event()
+    queue = MicrobatchQueue(engine)
+
+    def extra():
+        return {"worker_id": worker_id, "pid": os.getpid(),
+                "compiles": engine.compiles,
+                "deserialized": engine.deserialized,
+                "arena_warm": arena_warm,
+                "warmup_s": engine.warmup_s,
+                "serve_dtype": engine.serve_dtype}
+
+    server = WorkerServer(engine, queue, port=args.worker_port,
+                          extra_fn=extra)
+
+    def _on_term(signum, frame):
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+    except ValueError:  # not the main thread (embedded use)
+        pass
+    # ready marker on STDERR: the launcher scrapes the probe, humans
+    # scrape logs; launcher stdout stays machine-parseable
+    print(json.dumps({"worker_ready": True, "worker_id": worker_id,
+                      "port": server.port, **extra()}),
+          file=sys.stderr, flush=True)
+    stop.wait()
+    # FAST drain: stop admissions, hand the undispatched backlog back
+    # (queue.requeue) and answer it with retryable QueueClosed rows so
+    # the router moves it to surviving workers NOW instead of waiting
+    # for this worker to serve a deep backlog; in-flight work flushes
+    queue.begin_drain()
+    handed_back = queue.requeue()
+    for _eid, _ts, fut in handed_back:
+        if not fut.done():
+            fut.set_exception(QueueClosed(
+                "worker draining (SIGTERM); requeue elsewhere"))
+    queue.close()
+    server.close()
+    print(json.dumps({"worker_drained": True, "worker_id": worker_id,
+                      "requeued_back": len(handed_back),
+                      "queue": queue.stats_dict(),
+                      "engine": engine.stats_dict()}),
+          file=sys.stderr, flush=True)
+
+
+# -- launcher role -------------------------------------------------------
+
+def _spawn_workers(args, argv: list[str]):
+    """[(worker_id, url, Popen)]; workers inherit stderr (their logs
+    and ready lines interleave there) and this process's environment."""
+    workers = []
+    ncpu = os.cpu_count() or 1
+    for i in range(args.num_workers):
+        port = (args.worker_base_port + i if args.worker_base_port
+                else _free_port())
+        wid = f"w{i}"
+        wargv = _worker_argv(argv, wid, port)
+        if args.pin_worker_cpus:
+            wargv += ["--worker_cpu", str(i % ncpu)]
+        cmd = [sys.executable, "-m", "pertgnn_tpu.cli.fleet_main", *wargv]
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL)
+        workers.append((wid, f"http://127.0.0.1:{port}", proc))
+    return workers
+
+
+def _await_ready(workers, timeout_s: float):
+    """Poll every worker's /healthz until 200; returns the probe bodies
+    (warm-start evidence). Aborts loudly if a worker process dies or
+    the timeout lapses."""
+    from pertgnn_tpu.fleet.transport import WorkerTransportError, get_probe
+
+    deadline = time.monotonic() + timeout_s
+    ready: dict[str, dict] = {}
+    while len(ready) < len(workers):
+        for wid, url, proc in workers:
+            if wid in ready:
+                continue
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"worker {wid} exited rc={proc.returncode} before "
+                    f"becoming ready (its logs are on stderr above)")
+            try:
+                status, body = get_probe(url, timeout_s=2.0)
+            except WorkerTransportError:
+                continue
+            if status == 200:
+                ready[wid] = body
+        if len(ready) < len(workers):
+            if time.monotonic() > deadline:
+                missing = [w for w, _u, _p in workers if w not in ready]
+                raise SystemExit(
+                    f"workers {missing} not ready after "
+                    f"{timeout_s:.0f}s")
+            time.sleep(0.25)
+    return ready
+
+
+def _stop_workers(workers) -> None:
+    for _wid, _url, proc in workers:
+        if proc.poll() is None:
+            proc.terminate()
+    deadline = time.monotonic() + 60
+    for wid, _url, proc in workers:
+        try:
+            proc.wait(timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            print(f"WARNING: worker {wid} ignored SIGTERM; killing",
+                  file=sys.stderr)
+            proc.kill()
+            proc.wait()
+
+
+def _run_launcher(args, p: argparse.ArgumentParser,
+                  argv: list[str]) -> None:
+    if not args.checkpoint_dir and not args.fresh_init:
+        p.error("--checkpoint_dir is required (or --fresh_init for "
+                "weight-independent bench/test fleets)")
+    if args.num_workers < 1:
+        p.error("--num_workers must be >= 1")
+    bus = setup_telemetry(args, "fleet_main")
+    cfg = config_from_args(args)
+    dataset = build_dataset_cached(args, cfg)
+    from pertgnn_tpu.cli.serve_main import _load_requests
+    entries, buckets = _load_requests(args, dataset)
+    if len(entries) == 0:
+        raise SystemExit("no requests to serve")
+
+    workers = _spawn_workers(args, argv)
+    # machine-readable membership on stdout BEFORE traffic: the chaos
+    # bench SIGKILLs a pid from this line mid-stream
+    print(json.dumps({"fleet_workers": [
+        {"worker_id": wid, "url": url, "pid": proc.pid}
+        for wid, url, proc in workers]}), flush=True)
+    try:
+        t_spawn0 = time.perf_counter()
+        ready = _await_ready(workers, args.ready_timeout_s)
+        ready_s = time.perf_counter() - t_spawn0
+
+        from pertgnn_tpu.fleet.router import FleetRouter
+        from pertgnn_tpu.serve.buckets import make_bucket_ladder
+        from pertgnn_tpu.serve.errors import ServeError
+
+        top = make_bucket_ladder(dataset.budget, cfg.serve)[-1]
+
+        def request_size(eid: int):
+            m = dataset.mixtures[int(eid)]
+            return m.num_nodes, m.num_edges
+
+        client_latency = LatencyRecorder()
+        preds = np.full(len(entries), np.nan, np.float32)
+        served = np.zeros(len(entries), np.bool_)
+        import collections
+        request_errors: collections.Counter = collections.Counter()
+        errors_lock = threading.Lock()
+        failures: list[tuple[int, BaseException]] = []
+
+        def client(router, indices):
+            for i in indices:
+                t0 = time.perf_counter()
+                try:
+                    preds[i] = router.predict(int(entries[i]),
+                                              int(buckets[i]))
+                except ServeError as exc:
+                    with errors_lock:
+                        request_errors[type(exc).__name__] += 1
+                    continue
+                except BaseException as exc:  # lint: allow-silent-except — surfaced via SystemExit below
+                    with errors_lock:
+                        request_errors[type(exc).__name__] += 1
+                        failures.append((i, exc))
+                    continue
+                served[i] = True
+                client_latency.record_s(time.perf_counter() - t0)
+
+        t_serve0 = time.perf_counter()
+        with FleetRouter({wid: url for wid, url, _p in workers},
+                         request_size,
+                         (top.max_graphs, top.max_nodes, top.max_edges),
+                         cfg=cfg.fleet, bus=bus) as router:
+            threads = [threading.Thread(
+                target=client,
+                args=(router, range(t, len(entries),
+                                    max(1, args.concurrency))))
+                for t in range(max(1, args.concurrency))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            router_stats = router.stats_dict()
+        serve_wall_s = time.perf_counter() - t_serve0
+    finally:
+        _stop_workers(workers)
+
+    import pandas as pd
+
+    pd.DataFrame({"entry_id": entries, "ts_bucket": buckets,
+                  "y_pred": preds}).to_csv(args.out, index=False)
+    stats = {
+        "metric": "fleet_request_latency_ms",
+        "unit": "ms",
+        "num_workers": args.num_workers,
+        "requests": len(entries),
+        "served": int(served.sum()),
+        "request_errors": dict(request_errors),
+        "concurrency": args.concurrency,
+        "ready_s": round(ready_s, 3),
+        "throughput_rps": int(served.sum()) / max(serve_wall_s, 1e-9),
+        "serve_wall_s": round(serve_wall_s, 3),
+        "client_latency": client_latency.summary_dict(),
+        "router": router_stats,
+        "workers_ready": ready,
+        "captured_unix_time": time.time(),
+    }
+    bus.flush()
+    print(f"wrote {len(entries)} predictions ({int(served.sum())} "
+          f"served by {args.num_workers} worker(s)) to {args.out}",
+          file=sys.stderr)
+    print(json.dumps(stats), flush=True)
+    if failures:
+        i, exc = failures[0]
+        raise SystemExit(
+            f"{len(failures)} request(s) failed with non-serve errors; "
+            f"first: request {i} (entry_id={int(entries[i])}) -> "
+            f"{type(exc).__name__}: {exc}")
+    if not served.any():
+        raise SystemExit(
+            f"no request was served: all {len(entries)} failed "
+            f"({dict(request_errors) or 'no typed errors recorded'})")
+
+
+def main(argv=None) -> None:
+    setup_logging()
+    apply_platform_env()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = _parser()
+    args = p.parse_args(argv)
+    if args.role == "worker":
+        _run_worker(args, p)
+    else:
+        _run_launcher(args, p, argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -79,37 +79,6 @@ def _load_requests(args, dataset) -> tuple[np.ndarray, np.ndarray]:
     return entries, buckets
 
 
-def _start_health_server(port: int, engine, queue):
-    """A readiness probe on 127.0.0.1:<port>: 200 + engine.health() JSON
-    while the engine is healthy and admissions are open, 503 while
-    unhealthy or draining — what a load balancer polls to pull a
-    wedged/draining replica out of rotation. Daemon-threaded stdlib
-    http.server: the probe must never compete with the request path."""
-    import json as _json
-    import threading
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-    class Handler(BaseHTTPRequestHandler):
-        def do_GET(self):
-            health = engine.health()
-            ready = bool(health["healthy"]) and not queue.draining
-            body = _json.dumps({**health, "draining": queue.draining,
-                                "ready": ready}).encode()
-            self.send_response(200 if ready else 503)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *a):  # probes are periodic; don't spam
-            pass
-
-    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    threading.Thread(target=server.serve_forever, daemon=True,
-                     name="serve-healthz").start()
-    return server
-
-
 def main(argv=None) -> None:
     setup_logging()
     apply_platform_env()
@@ -137,7 +106,9 @@ def main(argv=None) -> None:
                    help="serve a readiness probe on 127.0.0.1:<port> "
                         "(GET /healthz: 200 while the engine is healthy "
                         "and admissions are open, 503 while unhealthy or "
-                        "draining, body = engine.health() JSON); 0 = off")
+                        "draining; body = engine health + live load: "
+                        "queue depth, in-flight count, per-class error "
+                        "counts — serve/health.py); 0 = off")
     p.add_argument("--precompile_only", action="store_true",
                    help="populate the compile cache (--compile_cache_dir) "
                         "with every ladder-rung executable and exit "
@@ -269,8 +240,9 @@ def main(argv=None) -> None:
             except ValueError:  # not the main thread (embedded use)
                 pass
             if args.health_port:
-                health_server = _start_health_server(args.health_port,
-                                                     engine, queue)
+                from pertgnn_tpu.serve.health import start_health_server
+                health_server = start_health_server(args.health_port,
+                                                    engine, queue)
             # round-robin so concurrent clients interleave distinct
             # requests (each index is served exactly once; preds/latency
             # cells are disjoint per thread, so no locking beyond the

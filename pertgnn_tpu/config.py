@@ -356,6 +356,67 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Replicated serve fleet knobs (pertgnn_tpu/fleet/).
+
+    One front-door ROUTER process owns the client-facing request queue
+    and dispatches microbatches to N serve WORKERS (cli/fleet_main.py
+    spawns them; each is a full PR-4-hardened engine+queue stack behind
+    an HTTP transport). Dispatch is deadline-aware least-loaded: the
+    router tracks per-worker in-flight depth and recent batch latency,
+    routes each microbatch to the worker with the earliest predicted
+    completion (fleet/policy.py — a pure function, unit-tested without
+    subprocesses), sheds at the door when no worker could meet a
+    request's deadline, and drives membership from the workers'
+    /healthz readiness probes. Every PR-4 invariant holds fleet-wide: a
+    submitted Future ALWAYS resolves, and a lost worker's undispatched
+    work is requeued to the survivors (surviving predictions stay
+    bit-identical to a single-engine reference —
+    benchmarks/fleet_bench.py exit-code-asserts it)."""
+
+    # Serve workers the launcher spawns (one engine per worker; on a
+    # multi-device host, one worker per device).
+    num_workers: int = 2
+    # First worker HTTP port; worker i listens on base+i. 0 = the
+    # launcher picks free ephemeral ports.
+    worker_base_port: int = 0
+    # Router-side microbatch coalescing window (the fleet twin of
+    # ServeConfig.flush_deadline_ms): a request waits at most this long
+    # for co-arriving requests before its microbatch is dispatched.
+    router_flush_deadline_ms: float = 2.0
+    # Router admission control: max requests queued at the front door;
+    # submit past it fast-fails with QueueFull (counter router.shed).
+    max_pending: int = 4096
+    # Door deadline (ms): a request whose deadline no worker's
+    # predicted completion can meet is shed AT SUBMIT with
+    # DeadlineExceeded (counter router.shed_infeasible), and a queued
+    # request expires if still undispatched past it. 0 = no deadlines.
+    request_deadline_ms: float = 0.0
+    # Per-dispatch HTTP timeout (seconds): a worker call exceeding it
+    # counts as a lost worker — its batch requeues to the survivors.
+    dispatch_timeout_s: float = 60.0
+    # Outstanding microbatches per worker before the router stops
+    # assigning it more (keeps each worker's overlap pipeline full
+    # without queue-stacking behind a slow one).
+    worker_slots: int = 2
+    # Readiness-probe poll cadence (seconds) driving membership.
+    health_poll_interval_s: float = 1.0
+    # Consecutive failed probes before a member is excluded (a single
+    # in-flight transport failure excludes immediately — the probe
+    # threshold only governs the polling path, so one dropped probe
+    # packet cannot flap an otherwise healthy worker).
+    probe_lost_after: int = 2
+    # EWMA smoothing for the per-worker batch-latency estimate feeding
+    # predicted completion (higher = reacts faster to load shifts).
+    latency_ewma_alpha: float = 0.3
+    # Times a single request may be requeued (worker loss / drain)
+    # before the router gives up and fails it with the last error —
+    # bounds the worst case where every dispatch lands on a dying
+    # worker; requeue-on-loss is otherwise invisible to the caller.
+    max_requeues: int = 3
+
+
+@dataclasses.dataclass(frozen=True)
 class CompileCacheConfig:
     """Cold-start elimination knobs (pertgnn_tpu/aot/).
 
@@ -436,6 +497,7 @@ class Config:
     train: TrainConfig = TrainConfig()
     parallel: ParallelConfig = ParallelConfig()
     serve: ServeConfig = ServeConfig()
+    fleet: FleetConfig = FleetConfig()
     telemetry: TelemetryConfig = TelemetryConfig()
     aot: CompileCacheConfig = CompileCacheConfig()
     # span | pert (reference: pert_gnn.py:32).

@@ -1,0 +1,38 @@
+"""Replicated serve fleet: front-door router + N warm serve workers.
+
+PRs 1-6 made ONE serve process fast (bucketed AOT engine), observable
+(telemetry bus), crash-tolerant (typed failures, watchdog, drain), and
+instantly warm (AOT executable store + arena store). This package
+scales that process out without weakening any of it:
+
+- ``policy``    — the dispatch brain as PURE FUNCTIONS: least-loaded =
+  earliest predicted completion, deadline feasibility at the door,
+  submission-order requeue merging, probe-driven membership
+  transitions (unit-tested with no subprocesses);
+- ``transport`` — the boring wire: stdlib HTTP on 127.0.0.1, JSON
+  microbatches, typed errors by class name, plus the worker-side
+  server wrapping a full PR-4-hardened engine+queue stack;
+- ``router``    — the front door: owns the client-facing request
+  queue, coalesces microbatches, dispatches to the
+  predicted-earliest-completion worker, requeues a lost worker's
+  custody to the survivors, and drives membership from /healthz.
+
+``cli/fleet_main.py`` is the launcher (spawns N workers warm from the
+shared --compile_cache_dir/--arena_cache_dir, then routes a request
+stream); ``benchmarks/fleet_bench.py`` exit-code-asserts scaling,
+warm start, and the SIGKILL-a-worker chaos invariants.
+"""
+
+from pertgnn_tpu.fleet.policy import (WorkerView, choose_worker,
+                                      deadline_infeasible, merge_requeue,
+                                      predicted_completion_s,
+                                      probe_transition)
+from pertgnn_tpu.fleet.router import FleetRouter
+from pertgnn_tpu.fleet.transport import (WorkerServer,
+                                         WorkerTransportError, get_probe,
+                                         post_predict)
+
+__all__ = ["FleetRouter", "WorkerServer", "WorkerTransportError",
+           "WorkerView", "choose_worker", "deadline_infeasible",
+           "merge_requeue", "predicted_completion_s", "probe_transition",
+           "get_probe", "post_predict"]

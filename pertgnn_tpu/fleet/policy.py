@@ -1,0 +1,127 @@
+"""Fleet dispatch policy — PURE FUNCTIONS over immutable worker views.
+
+The router's three decisions (which worker takes the next microbatch,
+whether a deadline is feasible at the door, how lost work re-enters the
+queue) are load-bearing claims about the fleet's behavior under load
+and failure, so they live here as pure functions of explicit inputs —
+unit-testable with no subprocesses, no sockets, no clocks
+(tests/test_fleet.py). The router (fleet/router.py) owns the mutable
+state and calls these at each decision point.
+
+**Least-loaded = earliest predicted completion.** A worker's predicted
+completion for a NEW batch is ``(inflight_batches + 1) * ewma_batch_s``
+— queue-depth-times-service-time, the classic M/M/1-ish estimate. It
+deliberately folds BOTH signals the ISSUE names: in-flight depth (how
+much is queued there) and recent latency (how fast this worker drains).
+A uniformly fast fleet degenerates to join-the-shortest-queue; a
+straggler (hot device, noisy neighbor) organically receives less work
+without any explicit weight knob.
+
+**Deadline feasibility at the door.** With per-request deadlines on,
+a request whose deadline even the BEST worker's predicted completion
+cannot meet is shed at submit — failing in microseconds instead of
+occupying a pending slot for milliseconds and failing anyway. This is
+an estimate, not a guarantee: an admitted request can still expire in
+the queue (the router resolves it with the same DeadlineExceeded).
+
+**Requeue ordering.** Requests carry a monotone submission sequence
+number. Work recovered from a lost worker re-enters AT THE FRONT of
+the pending queue in submission order: a requeued request is by
+construction older than everything still pending (batches are taken
+in prefix order), so sorting the recovered set by sequence and
+prepending restores the global submission order exactly — the
+invariant tests/test_fleet.py pins across multi-loss interleavings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Conservative prior for a worker that has never reported a batch
+# latency (fresh member): pessimistic enough that the first few
+# dispatches spread across fresh workers rather than pile on one.
+DEFAULT_BATCH_S = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerView:
+    """One worker as the policy sees it — an immutable snapshot the
+    router builds under its lock and hands to the pure functions."""
+
+    worker_id: str
+    healthy: bool = True
+    # Microbatches dispatched to this worker and not yet completed.
+    inflight_batches: int = 0
+    # Requests inside those batches (tie-break refinement only).
+    inflight_requests: int = 0
+    # EWMA of this worker's recent per-batch wall latency (seconds).
+    ewma_batch_s: float = DEFAULT_BATCH_S
+    # Outstanding-batch slots (FleetConfig.worker_slots): at capacity
+    # the worker is skipped even if it predicts earliest completion —
+    # stacking a queue behind one worker defeats the fleet.
+    slots: int = 2
+
+
+def predicted_completion_s(w: WorkerView) -> float:
+    """Seconds until a new batch handed to `w` would complete: its
+    backlog plus the new batch, each at its recent service time."""
+    return (w.inflight_batches + 1) * max(w.ewma_batch_s, 1e-6)
+
+
+def choose_worker(workers) -> WorkerView | None:
+    """The healthy, non-saturated worker with the earliest predicted
+    completion; ties break on fewer in-flight requests then worker_id
+    (total order — dispatch is deterministic given the same views).
+    None when every healthy worker is at its slot capacity (the router
+    waits for a completion) or no worker is healthy (the router waits
+    for membership to recover, or drains on close)."""
+    eligible = [w for w in workers
+                if w.healthy and w.inflight_batches < w.slots]
+    if not eligible:
+        return None
+    return min(eligible, key=lambda w: (predicted_completion_s(w),
+                                        w.inflight_requests, w.worker_id))
+
+
+def deadline_infeasible(workers, now: float, deadline_abs: float) -> bool:
+    """True when NO healthy worker's predicted completion meets the
+    deadline — the door-shed test. Saturated-but-healthy workers still
+    count (their backlog is in the prediction); an empty healthy set is
+    infeasible by definition (nobody could ever serve it)."""
+    candidates = [w for w in workers if w.healthy]
+    if not candidates:
+        return True
+    return now + min(predicted_completion_s(w)
+                     for w in candidates) > deadline_abs
+
+
+def merge_requeue(pending, recovered, seq=lambda r: r.seq):
+    """Work recovered from a lost worker, merged back into the pending
+    queue in GLOBAL SUBMISSION ORDER: every request carries a monotone
+    submission seq, so one sort restores exactly the order callers
+    submitted in. Recovered requests predate everything still pending
+    (batches dispatch in prefix order), so they land in front; and two
+    workers lost back-to-back interleave their recovered batches
+    correctly — an earlier-dispatched batch recovered SECOND still
+    re-enters ahead of a later-dispatched one recovered first (a
+    naive prepend would let the younger batch cut the line). Returns a
+    new list; both inputs untouched (pure)."""
+    return sorted([*recovered, *pending], key=seq)
+
+
+def probe_transition(healthy: bool, consecutive_failures: int,
+                     probe_ok: bool, lost_after: int
+                     ) -> tuple[bool, int, str | None]:
+    """Membership state machine for ONE probe result, as a pure
+    function: (healthy', consecutive_failures', event) where event is
+    "lost" | "recovered" | None. A healthy member is excluded after
+    `lost_after` CONSECUTIVE probe failures (one dropped poll must not
+    flap a live worker); an excluded member is re-admitted on the
+    first successful probe (it answered its readiness probe — by the
+    PR-4 contract that means warm and admitting)."""
+    if probe_ok:
+        return True, 0, (None if healthy else "recovered")
+    failures = consecutive_failures + 1
+    if healthy and failures >= lost_after:
+        return False, failures, "lost"
+    return healthy and failures < lost_after, failures, None

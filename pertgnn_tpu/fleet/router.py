@@ -1,0 +1,612 @@
+"""The fleet front door: one router, N warm serve workers.
+
+``FleetRouter`` owns the client-facing request queue (the same
+submit-a-Future contract as serve/queue.MicrobatchQueue — a submitted
+Future ALWAYS resolves, to a prediction or a typed serve error) and
+dispatches capacity-respecting microbatches over the HTTP transport
+(fleet/transport.py) to whichever worker the pure policy
+(fleet/policy.py) predicts will finish first. The design lesson is the
+one DGL and PyTorch-Direct teach for single-process GNN systems —
+treat the data/dispatch path as a first-class concurrent subsystem,
+not a loop around the model — applied one level up, across processes.
+
+Threads (all daemon, all owned by the router):
+
+- **dispatcher** — coalesces pending requests under the router flush
+  deadline into microbatches (submission-order prefix, same capacity
+  discipline as the single-process queue), picks a worker via
+  ``policy.choose_worker``, and hands the batch to that worker's
+  sender. Blocks — never drops — when every healthy worker is at its
+  slot capacity.
+- **one sender per worker** — performs the blocking HTTP dispatch and
+  settles futures. A transport-level failure is the lost-worker
+  signature: the batch (plus anything still queued for that worker)
+  REQUEUES to the front of the pending queue in submission order
+  (``policy.merge_requeue``) and the worker leaves the membership.
+- **prober** — polls each worker's /healthz on a fixed cadence and
+  drives membership through ``policy.probe_transition``: consecutive
+  probe failures exclude, the first success re-admits. Recovery is
+  symmetric with loss — a re-admitted worker starts taking traffic on
+  the next dispatch decision.
+
+Deadline awareness happens at three points: AT THE DOOR (a request no
+worker's predicted completion could meet is shed immediately with
+DeadlineExceeded — counter ``router.shed_infeasible``), IN THE QUEUE
+(an undispatched request expires at its deadline), and implicitly in
+dispatch (least-loaded = earliest predicted completion).
+
+Requeue safety: requests carry a bounded requeue budget
+(FleetConfig.max_requeues) so a fleet of dying workers degrades to
+typed failures, not an infinite requeue loop; and because every worker
+serves the same checkpoint through the same padding-invariant engine,
+a requeued request's prediction is bit-identical wherever it lands —
+benchmarks/fleet_bench.py exit-code-asserts exactly that under a
+mid-traffic SIGKILL.
+
+Telemetry (docs/OBSERVABILITY.md): counters ``router.dispatch`` /
+``router.requeue`` / ``router.worker_lost`` / ``router.worker_recovered``
+/ ``router.shed`` / ``router.shed_infeasible`` /
+``router.deadline_exceeded``, gauge ``router.members``, histograms
+``router.batch_ms`` / ``router.request_total_ms``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import queue as stdlib_queue
+import threading
+import time
+from concurrent.futures import Future
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.config import FleetConfig
+from pertgnn_tpu.fleet import policy
+from pertgnn_tpu.fleet.transport import (WorkerTransportError,
+                                         error_from_row, get_probe,
+                                         post_predict)
+from pertgnn_tpu.serve.errors import (DeadlineExceeded, QueueClosed,
+                                      QueueFull)
+
+log = logging.getLogger(__name__)
+
+# Worker-reported per-request failures the router retries ELSEWHERE
+# instead of propagating: all three mean "this worker cannot take it
+# right now", none of them is a verdict about the request itself.
+RETRYABLE_ROWS = ("QueueClosed", "QueueFull", "EngineUnhealthy")
+
+
+@dataclasses.dataclass
+class _Request:
+    """One admitted request in the router's custody."""
+
+    seq: int
+    entry_id: int
+    ts_bucket: int
+    arrival: float
+    deadline_abs: float
+    future: Future
+    requeues: int = 0
+
+
+class _Worker:
+    """Mutable router-side state for one fleet member (guarded by the
+    router lock; snapshotted into an immutable policy.WorkerView at
+    each decision point)."""
+
+    def __init__(self, worker_id: str, base_url: str, slots: int):
+        self.worker_id = worker_id
+        self.base_url = base_url
+        self.slots = slots
+        self.healthy = True
+        self.inflight_batches = 0
+        self.inflight_requests = 0
+        self.ewma_batch_s = policy.DEFAULT_BATCH_S
+        self.ewma_seen = False
+        self.probe_failures = 0
+        self.dispatches = 0
+        self.lost_count = 0
+        # assigned-but-not-yet-sent batches; the sender thread blocks
+        # on this queue (None = shut down)
+        self.sender_q: stdlib_queue.SimpleQueue = stdlib_queue.SimpleQueue()
+
+    def view(self) -> policy.WorkerView:
+        return policy.WorkerView(
+            worker_id=self.worker_id, healthy=self.healthy,
+            inflight_batches=self.inflight_batches,
+            inflight_requests=self.inflight_requests,
+            ewma_batch_s=self.ewma_batch_s, slots=self.slots)
+
+
+class FleetRouter:
+    """Deadline-aware least-loaded dispatch over N serve workers.
+
+    ``workers`` maps worker_id -> base_url (e.g. "http://127.0.0.1:8101");
+    ``request_size`` is entry_id -> (nodes, edges) (the launcher passes
+    the dataset's mixture sizes — the same capacity accounting the
+    single-process queue uses); ``capacity`` is the per-microbatch
+    (max_graphs, max_nodes, max_edges) ceiling, normally the workers'
+    top ladder rung."""
+
+    def __init__(self, workers: dict[str, str], request_size,
+                 capacity: tuple[int, int, int],
+                 cfg: FleetConfig | None = None, bus=None):
+        self._cfg = cfg = cfg or FleetConfig()
+        self._injected_bus = bus
+        self._request_size = request_size
+        self._max_graphs, self._max_nodes, self._max_edges = capacity
+        self._flush_s = cfg.router_flush_deadline_ms / 1e3
+        self._deadline_s = cfg.request_deadline_ms / 1e3
+        self._timeout_s = cfg.dispatch_timeout_s
+        self._max_requeues = cfg.max_requeues
+        self._workers = {wid: _Worker(wid, url, cfg.worker_slots)
+                         for wid, url in sorted(workers.items())}
+        if not self._workers:
+            raise ValueError("FleetRouter needs at least one worker")
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._pending: list[_Request] = []
+        self._seq = 0
+        self._closed = False
+        self._stop_probe = threading.Event()
+        # counters mirrored to the bus (router.* names)
+        self.dispatched_batches = 0
+        self.dispatched_requests = 0
+        self.requeues = 0
+        self.worker_lost = 0
+        self.worker_recovered = 0
+        self.shed = 0
+        self.shed_infeasible = 0
+        self.deadline_exceeded = 0
+        self.served = 0
+        self.failed = 0
+        self._senders = [
+            threading.Thread(target=self._sender_loop, args=(w,),
+                             daemon=True, name=f"router-send-{wid}")
+            for wid, w in self._workers.items()]
+        for t in self._senders:
+            t.start()
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            daemon=True,
+                                            name="router-dispatch")
+        self._dispatcher.start()
+        self._prober = threading.Thread(target=self._probe_loop,
+                                        daemon=True, name="router-probe")
+        self._prober.start()
+        self.bus.gauge("router.members", len(self._workers),
+                       total=len(self._workers))
+
+    # -- client side -----------------------------------------------------
+
+    @property
+    def bus(self):
+        if self._injected_bus is not None:
+            return self._injected_bus
+        return telemetry.get_bus()
+
+    def submit(self, entry_id: int, ts_bucket: int) -> Future:
+        """Enqueue one request; the Future resolves to its prediction
+        or a typed serve error. Raises QueueClosed / QueueFull /
+        DeadlineExceeded (door shed) at admission."""
+        eid = int(entry_id)
+        # size it NOW so an unknown entry fails the caller, not the
+        # dispatcher (same placement as the single-process queue)
+        self._request_size(eid)
+        fut: Future = Future()
+        counter = reject = None
+        with self._wake:
+            if self._closed:
+                reject = QueueClosed("FleetRouter is closed")
+            elif len(self._pending) >= self._cfg.max_pending:
+                self.shed += 1
+                counter = "router.shed"
+                reject = QueueFull(
+                    f"router pending set is at "
+                    f"max_pending={self._cfg.max_pending}; request shed")
+            else:
+                now = time.perf_counter()
+                deadline = (now + self._deadline_s
+                            if self._deadline_s > 0 else math.inf)
+                if self._deadline_s > 0 and policy.deadline_infeasible(
+                        [w.view() for w in self._workers.values()],
+                        now, deadline):
+                    self.shed_infeasible += 1
+                    counter = "router.shed_infeasible"
+                    reject = DeadlineExceeded(
+                        f"shed at the door: no worker's predicted "
+                        f"completion meets the "
+                        f"{self._cfg.request_deadline_ms:g}ms deadline")
+                else:
+                    self._pending.append(_Request(
+                        seq=self._seq, entry_id=eid,
+                        ts_bucket=int(ts_bucket), arrival=now,
+                        deadline_abs=deadline, future=fut))
+                    self._seq += 1
+                    self._wake.notify_all()
+        if reject is not None:
+            # bus emission outside the lock — the shed fast path fires
+            # exactly when everything contends for this lock
+            if counter is not None:
+                self.bus.counter(counter, entry_id=eid)
+            raise reject
+        return fut
+
+    def predict(self, entry_id: int, ts_bucket: int,
+                timeout: float | None = None) -> float:
+        """Blocking convenience (same shape as MicrobatchQueue.predict)."""
+        return float(self.submit(entry_id, ts_bucket).result(timeout))
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            return {
+                "workers": {
+                    w.worker_id: {
+                        "healthy": w.healthy,
+                        "dispatches": w.dispatches,
+                        "inflight_batches": w.inflight_batches,
+                        "ewma_batch_ms": round(w.ewma_batch_s * 1e3, 3),
+                        "lost_count": w.lost_count,
+                    } for w in self._workers.values()},
+                "members": sum(w.healthy
+                               for w in self._workers.values()),
+                "dispatched_batches": self.dispatched_batches,
+                "dispatched_requests": self.dispatched_requests,
+                "requeues": self.requeues,
+                "worker_lost": self.worker_lost,
+                "worker_recovered": self.worker_recovered,
+                "shed": self.shed,
+                "shed_infeasible": self.shed_infeasible,
+                "deadline_exceeded": self.deadline_exceeded,
+                "served": self.served,
+                "failed": self.failed,
+                "pending": len(self._pending),
+            }
+
+    def close(self) -> None:
+        """Stop admissions, dispatch everything already admitted (the
+        dispatcher exits only once the pending set AND every in-flight
+        batch have settled), then stop the threads. Any future the
+        drain could not place (e.g. the whole fleet died) resolves
+        with QueueClosed — never a hang. Idempotent."""
+        with self._wake:
+            if self._closed:
+                self._wake.notify_all()
+            self._closed = True
+            self._wake.notify_all()
+        self._dispatcher.join()
+        self._stop_probe.set()
+        for w in self._workers.values():
+            w.sender_q.put(None)
+        for t in self._senders:
+            t.join(timeout=self._timeout_s + 10.0)
+        self._prober.join(timeout=5.0)
+        # backstop for the ALWAYS-resolves invariant: nothing should be
+        # left, but a future must never outlive the router unresolved
+        with self._lock:
+            leftovers = self._pending[:]
+            self._pending.clear()
+        for r in leftovers:
+            self._resolve_error(r, QueueClosed(
+                "router closed before this request could be dispatched "
+                "(no live worker took it)"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- dispatcher ------------------------------------------------------
+
+    def _total_inflight_locked(self) -> int:
+        return sum(w.inflight_batches for w in self._workers.values())
+
+    def _full_locked(self) -> bool:
+        g = n = e = 0
+        for r in self._pending:
+            dn, de = self._request_size(r.entry_id)
+            if (g + 1 > self._max_graphs or n + dn > self._max_nodes
+                    or e + de > self._max_edges):
+                return True
+            g, n, e = g + 1, n + dn, e + de
+        return False
+
+    def _take_batch_locked(self) -> list[_Request]:
+        g = n = e = 0
+        take = 0
+        for r in self._pending:
+            dn, de = self._request_size(r.entry_id)
+            if take and (g + 1 > self._max_graphs
+                         or n + dn > self._max_nodes
+                         or e + de > self._max_edges):
+                break
+            g, n, e = g + 1, n + dn, e + de
+            take += 1
+        batch = self._pending[:take]
+        del self._pending[:take]
+        return batch
+
+    def _pop_expired_locked(self, now: float) -> list[_Request]:
+        if self._deadline_s <= 0:
+            return []
+        expired = [r for r in self._pending if r.deadline_abs <= now]
+        if expired:
+            self._pending[:] = [r for r in self._pending
+                                if r.deadline_abs > now]
+        return expired
+
+    def _fail_expired(self, expired: list[_Request]) -> None:
+        for r in expired:
+            self.deadline_exceeded += 1
+            self.bus.counter("router.deadline_exceeded",
+                             entry_id=r.entry_id)
+            self._resolve_error(r, DeadlineExceeded(
+                f"request for entry {r.entry_id} waited past its "
+                f"{self._cfg.request_deadline_ms:g}ms deadline without "
+                f"being dispatched"))
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            expired: list[_Request] = []
+            batch: list[_Request] = []
+            with self._wake:
+                while not self._pending and not (
+                        self._closed
+                        and self._total_inflight_locked() == 0):
+                    self._wake.wait(timeout=1.0)
+                if not self._pending:
+                    if self._closed and self._total_inflight_locked() == 0:
+                        break
+                    continue
+                # coalesce under the flush deadline (anchored at the
+                # oldest pending arrival, same as the serve queue)
+                while self._pending and not self._closed:
+                    now = time.perf_counter()
+                    expired += self._pop_expired_locked(now)
+                    if expired:
+                        break
+                    if not self._pending or self._full_locked():
+                        break
+                    t_flush = self._pending[0].arrival + self._flush_s
+                    if now >= t_flush:
+                        break
+                    t_wake = min([t_flush]
+                                 + [r.deadline_abs for r in self._pending
+                                    if r.deadline_abs < math.inf])
+                    self._wake.wait(timeout=max(t_wake - now, 0.0))
+                now = time.perf_counter()
+                expired += self._pop_expired_locked(now)
+                if self._pending and (
+                        self._closed or self._full_locked()
+                        or now >= self._pending[0].arrival + self._flush_s):
+                    batch = self._take_batch_locked()
+            self._fail_expired(expired)
+            if batch:
+                self._assign(batch)
+        log.debug("router dispatcher drained and exited")
+
+    def _assign(self, batch: list[_Request]) -> None:
+        """Place one microbatch on the least-loaded worker; blocks while
+        every healthy worker is slot-saturated (senders notify on
+        completion). Requests can still expire while waiting — a
+        deadline is a dispatch deadline."""
+        target: _Worker | None = None
+        while True:
+            expired: list[_Request] = []
+            fleet_dead = False
+            with self._wake:
+                now = time.perf_counter()
+                if self._deadline_s > 0:
+                    expired = [r for r in batch if r.deadline_abs <= now]
+                    batch = [r for r in batch if r.deadline_abs > now]
+                if batch:
+                    view = policy.choose_worker(
+                        [w.view() for w in self._workers.values()])
+                    if view is not None:
+                        target = self._workers[view.worker_id]
+                        target.inflight_batches += 1
+                        target.inflight_requests += len(batch)
+                        target.dispatches += 1
+                        self.dispatched_batches += 1
+                        self.dispatched_requests += len(batch)
+                    elif (self._closed and not any(
+                            w.healthy for w in self._workers.values())):
+                        # close-drain with a fully dead fleet: there is
+                        # nobody left to take this work, ever (futures
+                        # resolve OUTSIDE the lock — a done-callback
+                        # must not deadlock on re-entry)
+                        fleet_dead = True
+                    else:
+                        self._wake.wait(timeout=0.05)
+            self._fail_expired(expired)
+            if fleet_dead:
+                self._fail_batch(batch, QueueClosed(
+                    "router draining with no live workers"))
+                return
+            if not batch:
+                return
+            if target is not None:
+                self.bus.counter("router.dispatch", level=2,
+                                 worker=target.worker_id,
+                                 graphs=len(batch))
+                target.sender_q.put(batch)
+                return
+
+    # -- senders ---------------------------------------------------------
+
+    def _sender_loop(self, w: _Worker) -> None:
+        while True:
+            item = w.sender_q.get()
+            if item is None:
+                return
+            batch: list[_Request] = item
+            t0 = time.perf_counter()
+            try:
+                rows = post_predict(
+                    w.base_url, [r.entry_id for r in batch],
+                    [r.ts_bucket for r in batch], self._timeout_s)
+            except WorkerTransportError as exc:
+                self._on_worker_lost(w, batch, exc)
+                continue
+            self._on_batch_done(w, batch, rows,
+                                time.perf_counter() - t0)
+
+    def _on_batch_done(self, w: _Worker, batch: list[_Request],
+                       rows: list[dict], dt: float) -> None:
+        alpha = self._cfg.latency_ewma_alpha
+        retry: list[_Request] = []
+        give_up: list[tuple[_Request, Exception]] = []
+        with self._wake:
+            w.inflight_batches -= 1
+            w.inflight_requests -= len(batch)
+            w.ewma_batch_s = (dt if not w.ewma_seen else
+                              alpha * dt + (1 - alpha) * w.ewma_batch_s)
+            w.ewma_seen = True
+            for r, row in zip(batch, rows):
+                if row.get("error") in RETRYABLE_ROWS:
+                    r.requeues += 1
+                    if r.requeues > self._max_requeues:
+                        give_up.append((r, error_from_row(row)))
+                    else:
+                        retry.append(r)
+            if retry:
+                self.requeues += len(retry)
+                self._pending[:] = policy.merge_requeue(self._pending,
+                                                        retry)
+            self._wake.notify_all()
+        self.bus.histogram("router.batch_ms", dt * 1e3, level=2,
+                           worker=w.worker_id, graphs=len(batch))
+        if retry:
+            self.bus.counter("router.requeue", len(retry),
+                             worker=w.worker_id, reason="worker_busy")
+        t_done = time.perf_counter()
+        retry_set = {id(r) for r in retry}
+        n_served = 0
+        for r, row in zip(batch, rows):
+            if id(r) in retry_set:
+                continue
+            if "pred" in row:
+                n_served += 1
+                self.bus.histogram("router.request_total_ms",
+                                   (t_done - r.arrival) * 1e3, level=2)
+                r.future.set_result(float(row["pred"]))
+            else:
+                self._resolve_error(r, error_from_row(row))
+        if n_served:
+            with self._lock:
+                self.served += n_served
+        for r, exc in give_up:
+            self._resolve_error(r, exc)
+
+    def _on_worker_lost(self, w: _Worker, batch: list[_Request],
+                        exc: WorkerTransportError) -> None:
+        """Transport-level failure: exclude the worker NOW and move its
+        entire custody — the failed batch plus anything still queued
+        for it — back into the pending queue in submission order.
+        Requests over their requeue budget fail with the transport
+        error instead of looping forever."""
+        recovered: list[_Request] = [*batch]
+        give_up: list[_Request] = []
+        with self._wake:
+            was_healthy = w.healthy
+            w.healthy = False
+            w.probe_failures = 0
+            w.lost_count += 1
+            w.inflight_batches -= 1
+            w.inflight_requests -= len(batch)
+            while True:
+                try:
+                    queued = w.sender_q.get_nowait()
+                except stdlib_queue.Empty:
+                    break
+                if queued is None:
+                    # close() raced the loss; put the sentinel back so
+                    # this sender still terminates
+                    w.sender_q.put(None)
+                    break
+                w.inflight_batches -= 1
+                w.inflight_requests -= len(queued)
+                recovered.extend(queued)
+            keep: list[_Request] = []
+            for r in recovered:
+                r.requeues += 1
+                if r.requeues > self._max_requeues:
+                    give_up.append(r)
+                else:
+                    keep.append(r)
+            if keep:
+                self.requeues += len(keep)
+                self._pending[:] = policy.merge_requeue(self._pending,
+                                                        keep)
+            self.worker_lost += 1
+            members = sum(x.healthy for x in self._workers.values())
+            self._wake.notify_all()
+        log.error("router: worker %s lost (%s); requeued %d request(s), "
+                  "%d member(s) remain", w.worker_id, exc, len(keep),
+                  members)
+        self.bus.counter("router.worker_lost", worker=w.worker_id,
+                         was_healthy=was_healthy)
+        if keep:
+            self.bus.counter("router.requeue", len(keep),
+                             worker=w.worker_id, reason="worker_lost")
+        self.bus.gauge("router.members", members,
+                       total=len(self._workers))
+        for r in give_up:
+            self._resolve_error(r, WorkerTransportError(
+                f"request for entry {r.entry_id} exceeded its requeue "
+                f"budget ({self._max_requeues}); last worker failure: "
+                f"{exc}"))
+
+    # -- membership ------------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        interval = max(self._cfg.health_poll_interval_s, 0.05)
+        timeout = max(1.0, interval)
+        while not self._stop_probe.wait(interval):
+            for w in list(self._workers.values()):
+                try:
+                    status, _body = get_probe(w.base_url, timeout)
+                    ok = status == 200
+                except WorkerTransportError:
+                    ok = False
+                self._apply_probe(w, ok)
+
+    def _apply_probe(self, w: _Worker, ok: bool) -> None:
+        with self._wake:
+            healthy, fails, event = policy.probe_transition(
+                w.healthy, w.probe_failures, ok,
+                self._cfg.probe_lost_after)
+            w.healthy, w.probe_failures = healthy, fails
+            if event == "lost":
+                w.lost_count += 1
+                self.worker_lost += 1
+            elif event == "recovered":
+                self.worker_recovered += 1
+            members = sum(x.healthy for x in self._workers.values())
+            if event is not None:
+                self._wake.notify_all()
+        if event is None:
+            return
+        log.warning("router: worker %s %s via probe (%d/%d members)",
+                    w.worker_id, event, members, len(self._workers))
+        self.bus.counter(f"router.worker_{event}", worker=w.worker_id,
+                         via="probe")
+        self.bus.gauge("router.members", members,
+                       total=len(self._workers))
+
+    # -- shared ----------------------------------------------------------
+
+    def _resolve_error(self, r: _Request, exc: Exception) -> None:
+        """Settle one request with a typed failure. ALWAYS called
+        without the router lock held (senders, dispatcher, close) —
+        Future done-callbacks run inline and may re-enter submit."""
+        if not r.future.done():
+            with self._lock:
+                self.failed += 1
+            r.future.set_exception(exc)
+
+    def _fail_batch(self, batch: list[_Request], exc: Exception) -> None:
+        for r in batch:
+            self._resolve_error(r, exc)

@@ -1,0 +1,222 @@
+"""HTTP transport between the fleet router and its serve workers.
+
+The engineered parts of the fleet are the policy (fleet/policy.py) and
+the router's recovery machinery (fleet/router.py); the wire is
+deliberately boring — stdlib HTTP on 127.0.0.1, JSON microbatches — so
+there is nothing to install, nothing to configure, and nothing that can
+hold a connection's state hostage (every dispatch is one independent
+POST the router can time out and retry elsewhere). One worker = one
+``WorkerServer`` wrapping the PR-4-hardened engine+queue stack:
+
+- ``GET  /healthz`` — the shared readiness probe (serve/health.py) plus
+  worker identity and warm-start evidence (compiles / deserialized /
+  arena_warm), which is how fleet_bench proves workers started warm
+  without scraping their telemetry;
+- ``POST /predict`` — one microbatch ``{"entries": [...], "ts_buckets":
+  [...]}`` in, per-request rows out: ``{"pred": <float>}`` or
+  ``{"error": "<serve/errors.py class>", "message": ...}``. The handler
+  submits each request to the worker's own MicrobatchQueue and waits,
+  so EVERY PR-4 invariant (admission control, quarantine, watchdog,
+  NaN guard) applies per worker unchanged; typed failures travel by
+  CLASS NAME and are re-raised as the same types router-side.
+
+Failure mapping (the contract fleet/router.py relies on):
+
+- transport-level failure — connection refused/reset, timeout, non-200
+  — means THE WORKER is unusable (``WorkerTransportError``): the
+  router marks it lost and requeues the batch to survivors;
+- a 200 with per-request ``error`` rows means the WORKER is fine and
+  those REQUESTS failed: ``QueueClosed`` rows (a draining worker) are
+  retryable elsewhere, everything else is the request's own typed
+  outcome and propagates to the caller.
+
+The ``fleet.worker`` fault-injection site fires per handled microbatch
+(pertgnn_tpu/testing/faults.py): ``error`` fails the call at transport
+level, ``wedge`` stalls it into the router's dispatch timeout, and
+``kill`` enacts ``os._exit(137)`` — the deterministic worker-death
+drill behind the chaos scenario in benchmarks/fleet_bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from pertgnn_tpu.serve import errors as serve_errors
+from pertgnn_tpu.serve.health import probe_payload
+from pertgnn_tpu.testing import faults
+
+log = logging.getLogger(__name__)
+
+
+class WorkerTransportError(RuntimeError):
+    """The worker call failed at TRANSPORT level (refused, reset, timed
+    out, non-200): the router cannot tell whether the worker is dead,
+    wedged, or gone — it marks the worker lost and requeues the batch
+    to the survivors. Request-level failures never raise this; they
+    ride the 200 response as typed per-request rows."""
+
+
+def error_from_row(row: dict) -> Exception:
+    """Rehydrate a per-request error row into the SAME typed exception
+    the worker's queue raised, so a fleet caller handles shed/deadline/
+    quarantine identically to a single-process caller. Unknown names
+    (version skew) degrade to ServeError, never to a silent drop."""
+    cls = getattr(serve_errors, str(row.get("error", "")), None)
+    if cls is None or not (isinstance(cls, type)
+                           and issubclass(cls, serve_errors.ServeError)):
+        cls = serve_errors.ServeError
+    return cls(row.get("message", "worker-reported failure"))
+
+
+class WorkerServer:
+    """One serve worker's wire surface over its engine + queue."""
+
+    def __init__(self, engine, queue, port: int = 0, extra_fn=None):
+        self._engine = engine
+        self._queue = queue
+        self._extra_fn = extra_fn
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                ready, body = probe_payload(
+                    outer._engine, outer._queue,
+                    outer._extra_fn() if outer._extra_fn else None)
+                self._reply(200 if ready else 503, body)
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length))
+                    results = outer._predict(req["entries"],
+                                             req["ts_buckets"])
+                except faults.InjectedFault as exc:
+                    # the armed chaos plan asked for a transport-level
+                    # failure: the router must see this worker as lost
+                    log.warning("worker: injected transport failure: %s",
+                                exc)
+                    self._reply(500, {"error": "InjectedFault",
+                                      "message": str(exc)})
+                    return
+                except Exception as exc:
+                    # an unexpected handler bug must not strand the
+                    # router's futures: answer 500 (router requeues)
+                    log.exception("worker: request handler failed")
+                    self._reply(500, {"error": type(exc).__name__,
+                                      "message": str(exc)})
+                    return
+                self._reply(200, {"results": results})
+
+            def _reply(self, status: int, body: dict):
+                payload = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):  # the router polls; don't spam
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="fleet-worker")
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def _predict(self, entries, ts_buckets) -> list[dict]:
+        """Submit one router microbatch to the local queue and wait —
+        per-request rows in request order, every row present (a
+        submitted Future ALWAYS resolves; a rejected submit IS the
+        row's outcome)."""
+        plan = faults.active()
+        if plan is not None:
+            verdict = plan.fire("fleet.worker", entry_ids=entries)
+            if verdict == "kill":
+                # the worker-death drill: indistinguishable from
+                # SIGKILL to the router (connection dies mid-call)
+                log.error("fault injection: fleet.worker kill — exiting")
+                os._exit(137)
+        futures = []
+        for eid, tsb in zip(entries, ts_buckets):
+            try:
+                futures.append(self._queue.submit(int(eid), int(tsb)))
+            except serve_errors.ServeError as exc:
+                futures.append(exc)  # admission outcome, row below
+        rows: list[dict] = []
+        for fut in futures:
+            if isinstance(fut, Exception):
+                rows.append({"error": type(fut).__name__,
+                             "message": str(fut)})
+                continue
+            try:
+                rows.append({"pred": float(fut.result())})
+            except Exception as exc:  # lint: allow-silent-except — the row IS the record; the router rehydrates it
+                rows.append({"error": type(exc).__name__,
+                             "message": str(exc)})
+        return rows
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# -- router-side client ---------------------------------------------------
+
+def post_predict(base_url: str, entries, ts_buckets,
+                 timeout_s: float) -> list[dict]:
+    """One microbatch dispatch; returns per-request rows. Raises
+    WorkerTransportError on ANY transport-level failure (the lost-worker
+    signature)."""
+    body = json.dumps({"entries": [int(e) for e in entries],
+                       "ts_buckets": [int(t) for t in ts_buckets]}
+                      ).encode()
+    req = urllib.request.Request(
+        f"{base_url}/predict", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            payload = json.loads(resp.read())
+    except Exception as exc:
+        # urllib raises HTTPError on non-200 and URLError/socket
+        # timeouts on dead transports — all the same verdict here
+        raise WorkerTransportError(
+            f"worker {base_url} dispatch failed: "
+            f"{type(exc).__name__}: {exc}") from exc
+    results = payload.get("results")
+    if not isinstance(results, list) or len(results) != len(entries):
+        got = len(results) if isinstance(results, list) else "no"
+        raise WorkerTransportError(
+            f"worker {base_url} answered {got} rows for a "
+            f"{len(entries)}-request batch")
+    return results
+
+
+def get_probe(base_url: str, timeout_s: float) -> tuple[int, dict]:
+    """(status, body) of one readiness probe. Raises
+    WorkerTransportError when nothing answers (a 503 ANSWERS — a
+    draining worker is reachable-but-not-ready, which membership
+    treats differently from gone)."""
+    try:
+        with urllib.request.urlopen(f"{base_url}/healthz",
+                                    timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        try:
+            body = json.loads(exc.read())
+        except ValueError:
+            body = {}
+        return exc.code, body
+    except Exception as exc:
+        raise WorkerTransportError(
+            f"worker {base_url} probe failed: "
+            f"{type(exc).__name__}: {exc}") from exc

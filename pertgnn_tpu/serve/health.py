@@ -1,0 +1,78 @@
+"""The readiness probe, shared by serve_main and the fleet worker.
+
+One probe, two consumers: a load balancer (or the fleet router's
+membership poller) reads the STATUS CODE — 200 while the engine is
+healthy and admissions are open, 503 while unhealthy or draining — and
+humans (and the router's least-loaded policy, and autoscalers) read the
+BODY, which since PR 7 carries live load alongside engine health:
+
+    {
+      "healthy": true, "reason": null, "warmed": true,
+      "executables": 4, "buckets": 4, "rebuilds": 0, "nan_outputs": 0,
+      "draining": false, "ready": true,
+      "queue": {"depth": 3, "inflight": 8,
+                "errors": {"QueueFull": 2, "DeadlineExceeded": 1}}
+    }
+
+``queue.depth`` is requests admitted but not yet dispatched,
+``queue.inflight`` requests dispatched but not yet resolved, and
+``queue.errors`` per-class typed-failure counts since process start
+(serve/errors.py names) — load is readable from one GET without
+scraping telemetry JSONL. The status-code contract predates the body
+extension and is unchanged; nothing may key off body fields to decide
+routability (that is what the code is for).
+
+The server is a daemon-threaded stdlib ``ThreadingHTTPServer`` bound to
+127.0.0.1: the probe must never compete with the request path for the
+queue worker, and must never be reachable off-host by accident (the
+fleet is a single-host co-process topology; see docs/GUIDE.md on the
+shared-cache trust boundary).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def probe_payload(engine, queue, extra: dict | None = None
+                  ) -> tuple[bool, dict]:
+    """(ready, body) for one probe answer. `extra` lets a caller stamp
+    identity fields (the fleet worker adds worker_id/port and its
+    warm-start evidence) without forking the schema."""
+    health = engine.health()
+    draining = bool(queue.draining)
+    ready = bool(health["healthy"]) and not draining
+    body = {**health, "draining": draining, "ready": ready,
+            "queue": queue.probe_dict()}
+    if extra:
+        body.update(extra)
+    return ready, body
+
+
+def start_health_server(port: int, engine, queue,
+                        extra_fn=None) -> ThreadingHTTPServer:
+    """Serve GET /healthz (any path answers — probes are not routed)
+    on 127.0.0.1:`port` from a daemon thread; returns the server (call
+    ``shutdown()`` on exit). `extra_fn` () -> dict is evaluated per
+    probe so its fields stay live."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            ready, body = probe_payload(
+                engine, queue, extra_fn() if extra_fn else None)
+            payload = json.dumps(body).encode()
+            self.send_response(200 if ready else 503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):  # probes are periodic; don't spam
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="serve-healthz").start()
+    return server

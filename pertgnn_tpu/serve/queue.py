@@ -64,6 +64,7 @@ resolves, to a prediction or to a typed serve error (serve/errors.py):
 
 from __future__ import annotations
 
+import collections
 import logging
 import math
 import threading
@@ -218,6 +219,16 @@ class MicrobatchQueue:
         self.watchdog_trips = 0
         self.recovered = 0
         self.overlapped = 0
+        # requests taken from the pending set whose futures have not
+        # resolved yet — the "in flight" half of the probe body (the
+        # pending set is the other); maintained via done-callbacks so
+        # bisect splits / retries cannot double-count. (Distinct from
+        # _inflight, the overlapped-dispatch batch slot below.)
+        self._inflight_reqs = 0
+        # per-class counts of typed request failures (resolved futures
+        # AND admission rejects) — what the extended health probe and
+        # the fleet router read without scraping telemetry JSONL
+        self.error_counts: collections.Counter = collections.Counter()
         self._pending: list[tuple[int, int, float, float, Future]] = []
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -268,6 +279,8 @@ class MicrobatchQueue:
                 self._pending.append((eid, int(ts_bucket),
                                       time.perf_counter(), deadline, fut))
                 self._wake.notify()
+            if reject is not None:
+                self.error_counts[type(reject).__name__] += 1
         if reject is not None:
             # counter emission OUTSIDE the lock: a telemetry disk write
             # must not serialize the admission path — under overload the
@@ -306,6 +319,34 @@ class MicrobatchQueue:
                 self._wake.notify()
             finally:
                 self._lock.release()
+
+    def requeue(self) -> list[tuple[int, int, Future]]:
+        """Atomically remove every NOT-YET-DISPATCHED request from the
+        pending set and hand it back as (entry_id, ts_bucket, future)
+        triples — futures UNRESOLVED; the caller now owns them. The
+        fleet router uses this for worker-loss recovery (undispatched
+        work moves to a surviving worker instead of riding the sync
+        drain), and a draining worker uses it to answer a deep backlog
+        with a fast retryable error instead of serving it out
+        (cli/fleet_main.py) — which is what makes SIGTERM drain fast
+        under load. In-flight work is untouched: it resolves through
+        the normal dispatch path. Safe alongside submit/close; a
+        post-requeue close simply finds the pending set empty."""
+        with self._wake:
+            taken = self._pending[:]
+            self._pending.clear()
+        return [(eid, ts, fut) for eid, ts, _t, _dl, fut in taken]
+
+    def probe_dict(self) -> dict:
+        """The queue half of the health-probe body (serve/health.py):
+        load + per-class failure counts, cheap enough to answer on
+        every poll (no engine call, no telemetry scrape)."""
+        with self._lock:
+            return {
+                "depth": len(self._pending),
+                "inflight": self._inflight_reqs,
+                "errors": dict(self.error_counts),
+            }
 
     def close(self) -> None:
         """Drain pending requests, then stop the worker. Idempotent."""
@@ -347,6 +388,8 @@ class MicrobatchQueue:
                 "overlap_dispatch": self._overlap,
                 "overlapped": self.overlapped,
                 "pending": len(self._pending),
+                "inflight": self._inflight_reqs,
+                "errors": dict(self.error_counts),
             }
 
     # -- worker side -----------------------------------------------------
@@ -367,7 +410,16 @@ class MicrobatchQueue:
             take += 1
         batch = self._pending[:take]
         del self._pending[:take]
+        self._inflight_reqs += take  # caller holds the lock
         return batch
+
+    def _dec_inflight(self, _fut) -> None:
+        """Done-callback on every taken request's future: one resolution
+        (result, typed error, bisect sub-batch — whatever path) is one
+        in-flight departure, so splits and retries cannot skew the
+        probe's in-flight count."""
+        with self._lock:
+            self._inflight_reqs -= 1
 
     def _full_locked(self) -> bool:
         """Would waiting longer be pointless? True once the pending
@@ -399,6 +451,8 @@ class MicrobatchQueue:
         forever. Called WITHOUT the lock held."""
         for item in expired:
             self.deadline_exceeded += 1
+            with self._lock:
+                self.error_counts["DeadlineExceeded"] += 1
             self._engine.bus.counter("serve.deadline_exceeded",
                                      entry_id=item[0])
             item[4].set_exception(DeadlineExceeded(
@@ -454,6 +508,12 @@ class MicrobatchQueue:
                 # instead of holding its callers' futures hostage
                 self._finish_inflight()
                 continue
+            # registered OUTSIDE the lock: a callback fires on whatever
+            # thread resolves the future, and _dec_inflight retakes the
+            # lock — every taken future resolves exactly once (the
+            # queue's core invariant), so the count cannot drift
+            for *_rest, fut in batch:
+                fut.add_done_callback(self._dec_inflight)
             # queue-wait stage of the request lifecycle: submit -> the
             # moment its microbatch leaves the queue for the engine
             t_now = time.perf_counter()
@@ -474,11 +534,15 @@ class MicrobatchQueue:
 
     # -- failure handling ------------------------------------------------
 
-    @staticmethod
-    def _fail(batch, exc: BaseException) -> None:
+    def _fail(self, batch, exc: BaseException) -> None:
+        failed = 0
         for *_rest, fut in batch:
             if not fut.done():
                 fut.set_exception(exc)
+                failed += 1
+        if failed:
+            with self._lock:
+                self.error_counts[type(exc).__name__] += failed
 
     def _health_gate(self, batch) -> bool:
         """THE unhealthy-engine gate, shared by the synchronous and

@@ -14,6 +14,16 @@ site                 where it fires
 ``serve.compile``    InferenceEngine._compile (``error`` fails the rung)
 ``checkpoint.save``  CheckpointManager.save (``corrupt`` garbles the
                      just-committed step on disk)
+``fleet.worker``     the fleet worker's request handler
+                     (fleet/transport.py WorkerServer), per dispatched
+                     microbatch: ``error`` fails the call (the router
+                     sees a transport failure), ``wedge`` stalls it
+                     (the router's dispatch timeout must fire), and
+                     ``kill`` is returned for the handler to enact
+                     ``os._exit(137)`` — a deterministic,
+                     occurrence-addressed stand-in for SIGKILLing the
+                     worker mid-traffic (benchmarks/fleet_bench.py
+                     also sends the real signal)
 ===================  =====================================================
 
 Faults address occurrences deterministically: ``nth=(3,)`` fires on the
@@ -49,7 +59,7 @@ log = logging.getLogger(__name__)
 
 ENV_VAR = "PERTGNN_FAULT_PLAN"
 
-KINDS = ("error", "wedge", "nan", "corrupt")
+KINDS = ("error", "wedge", "nan", "corrupt", "kill")
 
 
 class InjectedFault(RuntimeError):
@@ -109,8 +119,10 @@ class FaultPlan:
 
         ``error`` raises InjectedFault here; ``wedge`` sleeps wedge_s
         here (the call site is mid-dispatch, so the sleep IS the stall);
-        ``nan`` / ``corrupt`` are returned as strings for the call site
-        to enact (it owns the output buffer / the checkpoint files).
+        ``nan`` / ``corrupt`` / ``kill`` are returned as strings for the
+        call site to enact (it owns the output buffer / the checkpoint
+        files / the process — ``kill`` means ``os._exit(137)``, the
+        fleet worker-death drill).
         Returns None when nothing fires. At most one spec fires per
         occurrence (first match in plan order)."""
         with self._lock:

@@ -43,8 +43,24 @@ def test_default_scope_covers_benchmark_oracles():
     names = {os.path.basename(r) for r in roots}
     assert "pertgnn_tpu" in names and "bench.py" in names
     assert "pipeline_bench.py" in names and "chaos_bench.py" in names
+    # fleet_bench is an exit-code oracle too (ISSUE 7)
+    assert "fleet_bench.py" in names
     # the vendored parity shim mimics a third-party API — out of scope
     assert not any("parity" in r for r in roots)
+
+
+def test_default_scope_covers_fleet():
+    """ISSUE 7: the fleet package (router/transport/policy — the
+    zero-lost-Futures invariant lives there) rides the pertgnn_tpu/
+    default root, and is itself clean. Pinned explicitly so a future
+    scope regression (e.g. an exclusion list) cannot silently drop
+    it."""
+    fleet = os.path.join(REPO, "pertgnn_tpu", "fleet")
+    assert os.path.isdir(fleet)
+    in_scope = any(os.path.basename(r) == "pertgnn_tpu"
+                   for r in check_excepts.default_roots(REPO))
+    assert in_scope
+    assert check_excepts.check_tree(fleet) == []
 
 
 def test_bare_except_is_flagged(tmp_path):

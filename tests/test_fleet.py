@@ -1,0 +1,399 @@
+"""The serve fleet (pertgnn_tpu/fleet/ — ISSUE 7).
+
+Three layers, cheapest first:
+
+1. the DISPATCH POLICY as pure functions — least-loaded choice,
+   deadline-infeasible shed, membership add/remove/flap, and
+   requeue-on-worker-loss ordering, with no subprocesses, sockets, or
+   clocks (the unit-testability the policy module exists for);
+2. the serve/queue TRANSPORT SEAM — ``requeue()`` hands unstarted work
+   back with futures unresolved, and the probe body carries queue
+   depth / in-flight / per-class error counts;
+3. ONE in-process fleet — a real FleetRouter over real WorkerServer
+   HTTP transports (sharing one engine, so the test pays one warmup)
+   including a worker-loss drill, plus the tier-1 wiring of
+   ``benchmarks/fleet_bench.py --smoke`` (a real multi-process fleet
+   with a SIGKILL chaos pass — the exit code IS the assertion).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import (Config, DataConfig, FleetConfig,
+                                IngestConfig, ModelConfig, ServeConfig,
+                                TrainConfig)
+from pertgnn_tpu.fleet import policy
+from pertgnn_tpu.fleet.policy import WorkerView
+from pertgnn_tpu.serve.errors import (DeadlineExceeded, QueueClosed,
+                                      QueueFull)
+from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- 1. pure policy ------------------------------------------------------
+
+class TestChooseWorker:
+    def test_least_loaded_wins(self):
+        ws = [WorkerView("a", inflight_batches=2, ewma_batch_s=0.01),
+              WorkerView("b", inflight_batches=0, ewma_batch_s=0.01),
+              WorkerView("c", inflight_batches=1, ewma_batch_s=0.01)]
+        assert policy.choose_worker(ws).worker_id == "b"
+
+    def test_latency_weighs_against_depth(self):
+        # a has the shorter queue but is 10x slower per batch: the
+        # earliest PREDICTED COMPLETION is b's, depth notwithstanding
+        ws = [WorkerView("a", inflight_batches=0, ewma_batch_s=0.5),
+              WorkerView("b", inflight_batches=1, ewma_batch_s=0.05)]
+        assert policy.choose_worker(ws).worker_id == "b"
+
+    def test_unhealthy_excluded(self):
+        ws = [WorkerView("a", healthy=False),
+              WorkerView("b", inflight_batches=1)]
+        assert policy.choose_worker(ws).worker_id == "b"
+
+    def test_saturated_excluded_and_none_when_all_full(self):
+        ws = [WorkerView("a", inflight_batches=2, slots=2),
+              WorkerView("b", inflight_batches=2, slots=2)]
+        assert policy.choose_worker(ws) is None
+        ws[1] = WorkerView("b", inflight_batches=1, slots=2)
+        assert policy.choose_worker(ws).worker_id == "b"
+
+    def test_no_healthy_workers_is_none(self):
+        assert policy.choose_worker(
+            [WorkerView("a", healthy=False)]) is None
+        assert policy.choose_worker([]) is None
+
+    def test_deterministic_tie_break(self):
+        ws = [WorkerView("b"), WorkerView("a")]
+        assert policy.choose_worker(ws).worker_id == "a"
+        assert policy.choose_worker(list(reversed(ws))).worker_id == "a"
+
+
+class TestDeadlineFeasibility:
+    def test_feasible_when_a_worker_can_make_it(self):
+        ws = [WorkerView("a", inflight_batches=4, ewma_batch_s=1.0),
+              WorkerView("b", inflight_batches=0, ewma_batch_s=0.01)]
+        assert not policy.deadline_infeasible(ws, now=100.0,
+                                              deadline_abs=100.1)
+
+    def test_infeasible_when_every_worker_is_too_deep(self):
+        ws = [WorkerView("a", inflight_batches=4, ewma_batch_s=1.0),
+              WorkerView("b", inflight_batches=3, ewma_batch_s=1.0)]
+        assert policy.deadline_infeasible(ws, now=100.0,
+                                          deadline_abs=100.5)
+
+    def test_saturated_workers_still_count_as_capacity(self):
+        # at slot capacity but fast: the request can wait for a slot
+        # and still meet its deadline — not a door shed
+        ws = [WorkerView("a", inflight_batches=2, slots=2,
+                         ewma_batch_s=0.001)]
+        assert not policy.deadline_infeasible(ws, now=0.0,
+                                              deadline_abs=1.0)
+
+    def test_empty_membership_is_infeasible(self):
+        assert policy.deadline_infeasible(
+            [WorkerView("a", healthy=False)], now=0.0, deadline_abs=1e9)
+
+
+class _R:
+    def __init__(self, seq):
+        self.seq = seq
+
+    def __repr__(self):
+        return f"R{self.seq}"
+
+
+class TestRequeueOrdering:
+    def test_recovered_work_goes_in_front_in_submission_order(self):
+        pending = [_R(7), _R(8)]
+        lost = [_R(4), _R(2)]  # one lost batch, arbitrary order
+        merged = policy.merge_requeue(pending, lost)
+        assert [r.seq for r in merged] == [2, 4, 7, 8]
+
+    def test_two_losses_interleave_by_submission_seq(self):
+        # worker A lost batch [1, 3]; its requeue lands, then worker B
+        # loses [2, 5]: the final order is the GLOBAL submission order
+        # — the later-recovered-but-older batch does not get cut in
+        # line by the younger one
+        pending = [_R(9)]
+        after_a = policy.merge_requeue(pending, [_R(3), _R(1)])
+        assert [r.seq for r in after_a] == [1, 3, 9]
+        after_b = policy.merge_requeue(after_a, [_R(5), _R(2)])
+        assert [r.seq for r in after_b] == [1, 2, 3, 5, 9]
+
+    def test_empty_cases(self):
+        assert policy.merge_requeue([], []) == []
+        p = [_R(1)]
+        assert [r.seq for r in policy.merge_requeue(p, [])] == [1]
+        assert [r.seq for r in policy.merge_requeue([], p)] == [1]
+
+    def test_pure_inputs_untouched(self):
+        pending, lost = [_R(5)], [_R(1)]
+        out = policy.merge_requeue(pending, lost)
+        assert len(pending) == 1 and len(lost) == 1 and len(out) == 2
+
+
+class TestMembership:
+    def test_one_dropped_probe_does_not_flap(self):
+        healthy, fails, event = policy.probe_transition(
+            True, 0, probe_ok=False, lost_after=2)
+        assert healthy and fails == 1 and event is None
+
+    def test_consecutive_failures_exclude(self):
+        healthy, fails, event = policy.probe_transition(
+            True, 1, probe_ok=False, lost_after=2)
+        assert not healthy and fails == 2 and event == "lost"
+
+    def test_success_resets_the_streak(self):
+        healthy, fails, event = policy.probe_transition(
+            True, 1, probe_ok=True, lost_after=2)
+        assert healthy and fails == 0 and event is None
+
+    def test_readmitted_on_first_success(self):
+        healthy, fails, event = policy.probe_transition(
+            False, 5, probe_ok=True, lost_after=2)
+        assert healthy and fails == 0 and event == "recovered"
+
+    def test_excluded_member_stays_excluded_on_failure(self):
+        healthy, fails, event = policy.probe_transition(
+            False, 3, probe_ok=False, lost_after=2)
+        assert not healthy and event is None
+
+    def test_full_flap_cycle(self):
+        state = (True, 0)
+        events = []
+        for ok in (False, False, True, False, False):
+            h, f, ev = policy.probe_transition(*state, ok, lost_after=2)
+            state = (h, f)
+            events.append(ev)
+        assert events == [None, "lost", "recovered", None, "lost"]
+
+
+# -- 2. the serve/queue transport seam -----------------------------------
+
+@pytest.fixture(scope="module")
+def served(preprocessed):
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.train.loop import restore_target_state
+
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=200, batch_size=16),
+        model=ModelConfig(hidden_channels=8, num_layers=1),
+        train=TrainConfig(label_scale=1000.0),
+        serve=ServeConfig(bucket_growth=2.0, min_bucket_nodes=256,
+                          min_bucket_edges=256, max_graphs_per_batch=8),
+        graph_type="pert",
+    )
+    ds = build_dataset(preprocessed, cfg)
+    _model, state = restore_target_state(ds, cfg)
+    engine = InferenceEngine.from_dataset(ds, cfg, state).warmup()
+    return ds, cfg, state, engine
+
+
+def test_requeue_hands_back_unstarted_work_unresolved(served):
+    ds, _cfg, _state, engine = served
+    s = ds.splits["test"]
+    # a flush deadline far in the future: submissions stay PENDING
+    with MicrobatchQueue(engine, flush_deadline_ms=60_000) as q:
+        futs = [q.submit(int(s.entry_ids[i]), int(s.ts_buckets[i]))
+                for i in range(3)]
+        handed = q.requeue()
+        assert len(handed) == 3
+        assert [e for e, _t, _f in handed] == \
+            [int(s.entry_ids[i]) for i in range(3)]
+        # futures are UNRESOLVED — the caller owns them now
+        assert all(not f.done() for _e, _t, f in handed)
+        assert q.probe_dict()["depth"] == 0
+        # the caller can settle them (a router re-dispatches; here we
+        # fail them the way a draining fleet worker does)
+        for _e, _t, f in handed:
+            f.set_exception(QueueClosed("requeued elsewhere"))
+        assert all(f.done() for f in futs)
+    # close() after requeue finds nothing pending — no hang, no error
+
+
+def test_requeue_empty_queue_is_empty(served):
+    _ds, _cfg, _state, engine = served
+    with MicrobatchQueue(engine) as q:
+        assert q.requeue() == []
+
+
+def test_probe_dict_counts_errors_and_depth(served):
+    ds, _cfg, _state, engine = served
+    s = ds.splits["test"]
+    eid, tsb = int(s.entry_ids[0]), int(s.ts_buckets[0])
+    with MicrobatchQueue(engine, flush_deadline_ms=60_000,
+                         max_pending=1) as q:
+        fut = q.submit(eid, tsb)
+        probe = q.probe_dict()
+        assert probe["depth"] == 1 and probe["inflight"] == 0
+        with pytest.raises(QueueFull):
+            q.submit(eid, tsb)
+        assert q.probe_dict()["errors"].get("QueueFull") == 1
+        handed = q.requeue()
+        handed[0][2].set_exception(QueueClosed("test cleanup"))
+        assert fut.done()
+
+
+def test_probe_payload_shape(served):
+    ds, _cfg, _state, engine = served
+    from pertgnn_tpu.serve.health import probe_payload
+
+    with MicrobatchQueue(engine) as q:
+        ready, body = probe_payload(engine, q, extra={"worker_id": "w9"})
+        assert ready is True
+        # the PR-4 contract fields survive unchanged...
+        assert body["healthy"] is True and body["ready"] is True
+        assert body["draining"] is False
+        # ...and the PR-7 load extension is present
+        assert set(body["queue"]) == {"depth", "inflight", "errors"}
+        assert body["worker_id"] == "w9"
+        q.begin_drain()
+        ready2, body2 = probe_payload(engine, q)
+        assert ready2 is False and body2["draining"] is True
+
+
+def test_queue_stats_include_error_classes(served):
+    ds, _cfg, _state, engine = served
+    s = ds.splits["test"]
+    with MicrobatchQueue(engine, flush_deadline_ms=5,
+                         request_deadline_ms=0.01) as q:
+        with pytest.raises(DeadlineExceeded):
+            q.predict(int(s.entry_ids[0]), int(s.ts_buckets[0]),
+                      timeout=30)
+    stats = q.stats_dict()
+    assert stats["errors"].get("DeadlineExceeded", 0) >= 1
+    assert stats["inflight"] == 0
+
+
+# -- 3. one in-process fleet (real router, real HTTP transport) ----------
+
+def test_router_over_worker_servers_end_to_end(served):
+    import threading
+
+    from pertgnn_tpu.fleet.router import FleetRouter
+    from pertgnn_tpu.fleet.transport import WorkerServer, get_probe
+    from pertgnn_tpu.serve.buckets import make_bucket_ladder
+
+    ds, cfg, _state, engine = served
+    s = ds.splits["test"]
+    n = min(32, len(s.entry_ids))
+    ent = np.asarray(s.entry_ids[:n])
+    tsb = np.asarray(s.ts_buckets[:n])
+    ref = np.concatenate([engine.predict_microbatch(ent[i:i + 1],
+                                                    tsb[i:i + 1])
+                          for i in range(n)])
+
+    # two HTTP fronts over ONE engine+queue (one warmup): the router
+    # sees two members; padding invariance keeps answers bit-identical
+    # regardless of which front a batch rides through
+    q = MicrobatchQueue(engine)
+    w1, w2 = WorkerServer(engine, q), WorkerServer(engine, q)
+    top = make_bucket_ladder(ds.budget, cfg.serve)[-1]
+
+    def size(eid):
+        m = ds.mixtures[int(eid)]
+        return m.num_nodes, m.num_edges
+
+    try:
+        status, body = get_probe(f"http://127.0.0.1:{w1.port}", 2.0)
+        assert status == 200 and body["ready"]
+        fcfg = FleetConfig(health_poll_interval_s=0.2,
+                           dispatch_timeout_s=30.0)
+        with FleetRouter(
+                {"w1": f"http://127.0.0.1:{w1.port}",
+                 "w2": f"http://127.0.0.1:{w2.port}"},
+                size, (top.max_graphs, top.max_nodes, top.max_edges),
+                cfg=fcfg) as router:
+            preds = np.full(n, np.nan, np.float32)
+            lost = threading.Event()
+
+            def client(idx):
+                for i in idx:
+                    if i >= n // 2 and not lost.is_set():
+                        lost.set()
+                        w2.close()  # mid-stream worker loss
+                    preds[i] = router.predict(int(ent[i]), int(tsb[i]))
+
+            threads = [threading.Thread(target=client,
+                                        args=(range(t, n, 4),))
+                       for t in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            stats = router.stats_dict()
+        # zero lost futures, bit-identical, dispatch spread + loss seen
+        assert not np.isnan(preds).any()
+        np.testing.assert_array_equal(preds, np.asarray(ref, np.float32))
+        assert stats["served"] == n and stats["failed"] == 0
+        assert stats["dispatched_requests"] >= n
+        assert stats["worker_lost"] >= 1 or stats["workers"]["w2"][
+            "dispatches"] == 0  # loss may precede any w2 dispatch
+    finally:
+        w1.close()
+        q.close()
+
+
+def test_router_door_shed_when_infeasible(served):
+    from pertgnn_tpu.fleet.router import FleetRouter
+    from pertgnn_tpu.fleet.transport import WorkerServer
+    from pertgnn_tpu.serve.buckets import make_bucket_ladder
+
+    ds, cfg, _state, engine = served
+    s = ds.splits["test"]
+    q = MicrobatchQueue(engine)
+    w = WorkerServer(engine, q)
+    top = make_bucket_ladder(ds.budget, cfg.serve)[-1]
+
+    def size(eid):
+        m = ds.mixtures[int(eid)]
+        return m.num_nodes, m.num_edges
+
+    try:
+        # a deadline no predicted completion can meet (the policy floor
+        # is DEFAULT_BATCH_S=50ms against a 1ms deadline): shed AT THE
+        # DOOR, before the request occupies a pending slot
+        fcfg = FleetConfig(request_deadline_ms=1e-3)
+        with FleetRouter({"w": f"http://127.0.0.1:{w.port}"}, size,
+                         (top.max_graphs, top.max_nodes, top.max_edges),
+                         cfg=fcfg) as router:
+            with pytest.raises(DeadlineExceeded, match="door"):
+                router.submit(int(s.entry_ids[0]), int(s.ts_buckets[0]))
+            assert router.stats_dict()["shed_infeasible"] == 1
+            assert router.stats_dict()["pending"] == 0
+    finally:
+        w.close()
+        q.close()
+
+
+def test_fleet_bench_smoke():
+    """The tier-1 wiring (ISSUE 7 satellite): a REAL two-worker fleet —
+    spawn warm from shared caches, route traffic, SIGKILL one worker
+    mid-stream — exit-code-asserted by benchmarks/fleet_bench.py
+    --smoke. Keeps the fleet path from silently rotting."""
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "fleet_bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (
+        f"fleet_bench --smoke failed (rc={proc.returncode})\n"
+        f"stdout: {proc.stdout[-2000:]}\nstderr: {proc.stderr[-2000:]}")
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["value"] == 1 and verdict["violations"] == []
+    assert verdict["results"]["chaos"]["served"] == \
+        verdict["results"]["chaos"]["requests"]
+    # "warm in seconds": generous CI bound, tight enough to catch a
+    # fleet that silently re-ingests or recompiles (minutes)
+    assert time.monotonic() - t0 < 300
