@@ -883,14 +883,46 @@ def gate_check(result: dict, history: list[dict]) -> tuple[bool, dict]:
     return ok, detail
 
 
+def _graftlint_refusal() -> list[str]:
+    """New graftlint violations in this working tree, as strings —
+    nonempty means --gate must refuse the capture: a tree that fails
+    static analysis is not a valid perf witness, the same loud-refusal
+    contract as the kernel-fallback check (a capture from a known-buggy
+    tree would launder its numbers into the history).
+    BENCH_GATE_SKIP_LINT=1 is the explicit, greppable escape hatch."""
+    import sys
+
+    if os.environ.get("BENCH_GATE_SKIP_LINT", "") not in ("", "0"):
+        print("WARNING: BENCH_GATE_SKIP_LINT set — gating WITHOUT the "
+              "graftlint check", file=sys.stderr)
+        return []
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from tools.graftlint import run_repo
+        result = run_repo(repo)
+    except Exception as e:
+        # a broken lint harness must fail the gate LOUDLY, not pass it
+        print(f"WARNING: graftlint could not run "
+              f"({type(e).__name__}: {e}); refusing the gate",
+              file=sys.stderr)
+        return [f"graftlint could not run: {type(e).__name__}: {e}"]
+    return [str(v) for v in result.new]
+
+
 def gate_main(argv: list[str]) -> int:
     """`bench.py --gate [result.json]`: exit 1 when a finished run's
     headline throughput fell beyond the history's recorded window
-    spread. The result record comes from the given path (a saved bench
-    stdout line, or a BENCH_r-style wrapper whose `parsed` field holds
-    it) or from stdin when piped."""
+    spread — or when the working tree fails `python -m tools.graftlint`
+    (a capture from a lint-failing tree is refused outright, same
+    pattern as the kernel-fallback refusal). The result record comes
+    from the given path (a saved bench stdout line, or a BENCH_r-style
+    wrapper whose `parsed` field holds it) or from stdin when piped."""
     import sys
 
+    # usage validation FIRST: a mistyped invocation must exit 2 with
+    # the one-line usage, not pay the ~3s lint and report a gate FAIL
     paths = [a for a in argv if not a.startswith("-")]
     usage = "--gate needs a result JSON path (or one piped on stdin)"
     if paths:
@@ -912,6 +944,17 @@ def gate_main(argv: list[str]) -> int:
         return 2
     if isinstance(result.get("parsed"), dict):
         result = result["parsed"]
+    lint = _graftlint_refusal()
+    if lint:
+        print(json.dumps({"gate": {
+            "verdict": (f"FAIL: graftlint reports {len(lint)} "
+                        f"violation(s) in this working tree — a capture "
+                        f"from a tree that fails static analysis is not "
+                        f"a valid perf witness (fix or baseline them: "
+                        f"python -m tools.graftlint)"),
+            "graftlint": lint[:20],
+        }}))
+        return 1
     ok, detail = gate_check(result, _history_records())
     print(json.dumps({"gate": detail}))
     return 0 if ok else 1

@@ -277,6 +277,15 @@ def add_serve_flags(p: argparse.ArgumentParser) -> None:
                    default=ServeConfig.bucket_growth,
                    help="geometric growth of the serving bucket ladder "
                         "(serve/buckets.py); 2.0 = powers-of-two rungs")
+    p.add_argument("--min_bucket_nodes", type=int,
+                   default=ServeConfig.min_bucket_nodes,
+                   help="smallest ladder rung's node capacity (rounded "
+                        "up to multiples of 128 for TPU lane alignment; "
+                        "was config-only until the graftlint "
+                        "flag-config-drift pass flagged it)")
+    p.add_argument("--min_bucket_edges", type=int,
+                   default=ServeConfig.min_bucket_edges,
+                   help="smallest ladder rung's edge capacity")
     p.add_argument("--max_graphs_per_batch", type=int,
                    default=ServeConfig.max_graphs_per_batch,
                    help="graph slots per serving microbatch")
@@ -576,6 +585,10 @@ def config_from_args(args: argparse.Namespace) -> Config:
         serve=ServeConfig(
             bucket_growth=getattr(args, "bucket_growth",
                                   ServeConfig.bucket_growth),
+            min_bucket_nodes=getattr(args, "min_bucket_nodes",
+                                     ServeConfig.min_bucket_nodes),
+            min_bucket_edges=getattr(args, "min_bucket_edges",
+                                     ServeConfig.min_bucket_edges),
             max_graphs_per_batch=getattr(args, "max_graphs_per_batch",
                                          ServeConfig.max_graphs_per_batch),
             flush_deadline_ms=getattr(args, "flush_deadline_ms",

@@ -338,8 +338,13 @@ class FleetRouter:
         return expired
 
     def _fail_expired(self, expired: list[_Request]) -> None:
+        if not expired:
+            return
+        # one lock round-trip per sweep (stats_dict snapshots this
+        # counter); resolution + bus emission stay outside the lock
+        with self._lock:
+            self.deadline_exceeded += len(expired)
         for r in expired:
-            self.deadline_exceeded += 1
             self.bus.counter("router.deadline_exceeded",
                              entry_id=r.entry_id)
             self._resolve_error(r, DeadlineExceeded(
@@ -591,8 +596,13 @@ class FleetRouter:
             return
         log.warning("router: worker %s %s via probe (%d/%d members)",
                     w.worker_id, event, members, len(self._workers))
-        self.bus.counter(f"router.worker_{event}", worker=w.worker_id,
-                         via="probe")
+        # literal names, not f"router.worker_{event}": the telemetry
+        # contract is greppable (graftlint telemetry-drift) — a dynamic
+        # name is invisible to the docs check and to anyone auditing
+        # docs/OBSERVABILITY.md against the source
+        counter = ("router.worker_lost" if event == "lost"
+                   else "router.worker_recovered")
+        self.bus.counter(counter, worker=w.worker_id, via="probe")
         self.bus.gauge("router.members", members,
                        total=len(self._workers))
 

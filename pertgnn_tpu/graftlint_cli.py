@@ -1,0 +1,36 @@
+"""Console-script launcher for graftlint (docs/LINTS.md).
+
+graftlint lints a SOURCE TREE, so it only makes sense where one exists:
+an editable (in-repo) install, where this package sits inside the repo
+checkout and `tools/graftlint/` is its sibling. This launcher lives
+inside `pertgnn_tpu` so the wheel never ships a generic top-level
+`tools` package (namespace squatting), while the `graftlint` entry
+point still works in the install mode where the tool is usable — and
+fails with a clear message, not a ModuleNotFoundError, everywhere else.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(repo, "tools", "graftlint")):
+        print(
+            "graftlint: no tools/graftlint next to this package — the "
+            "linter analyzes a repo working tree, which only an "
+            "editable (in-repo) install has. From a checkout, run "
+            "`python -m tools.graftlint` (docs/LINTS.md).",
+            file=sys.stderr)
+        return 2
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.graftlint.cli import main as graftlint_main
+
+    return graftlint_main(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

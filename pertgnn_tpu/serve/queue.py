@@ -308,8 +308,13 @@ class MicrobatchQueue:
         from a signal handler: it never blocks on the queue lock — the
         flag write is enough (submit reads it under the lock), and the
         worker wake-up is best-effort. `close()` completes the drain."""
-        self._draining = True
-        self._drain_requested = True
+        # lock-free BY DESIGN — one-way flag writes from a SIGNAL
+        # HANDLER: taking the queue lock here could deadlock on a
+        # thread interrupted mid-critical-section; atomic bool stores
+        # are the only safe operation, and submit() reads them under
+        # the lock so the close is never torn
+        self._draining = True  # graftlint: allow-lock-discipline
+        self._drain_requested = True  # graftlint: allow-lock-discipline
         # the serve.drain_begin counter is emitted by the WORKER thread
         # (next loop turn), not here: bus.counter takes the writer's
         # non-reentrant lock and does file I/O — poison for a handler
@@ -449,10 +454,15 @@ class MicrobatchQueue:
     def _fail_expired(self, expired: list) -> None:
         """Resolve deadline-overdue requests — a future must never wait
         forever. Called WITHOUT the lock held."""
+        if not expired:
+            return
+        # one lock round-trip for the whole sweep: a deadline storm can
+        # expire hundreds of requests at once, and submit() contends on
+        # this same lock (future resolution + bus stay outside it)
+        with self._lock:
+            self.deadline_exceeded += len(expired)
+            self.error_counts["DeadlineExceeded"] += len(expired)
         for item in expired:
-            self.deadline_exceeded += 1
-            with self._lock:
-                self.error_counts["DeadlineExceeded"] += 1
             self._engine.bus.counter("serve.deadline_exceeded",
                                      entry_id=item[0])
             item[4].set_exception(DeadlineExceeded(
@@ -628,7 +638,8 @@ class MicrobatchQueue:
             self._fail_or_bisect(batch, exc, retried=False)
             return
         self._inflight = (batch, handle)
-        self.overlapped += 1
+        with self._lock:  # stats_dict snapshots this counter
+            self.overlapped += 1
         self._engine.bus.counter("serve.overlapped", level=2,
                                  graphs=len(batch))
 
@@ -718,7 +729,8 @@ class MicrobatchQueue:
             what=f"engine dispatch of {len(entries)} request(s)")
 
     def _trip_watchdog(self, exc: DispatchTimeout) -> None:
-        self.watchdog_trips += 1
+        with self._lock:  # stats_dict snapshots this counter
+            self.watchdog_trips += 1
         self._engine.bus.counter("serve.watchdog_trip")
         self._engine.mark_unhealthy(str(exc))
         self._cooldown_until = time.perf_counter() + self._cooldown_s
@@ -741,7 +753,8 @@ class MicrobatchQueue:
             self._cooldown_until = time.perf_counter() + self._cooldown_s
             return False
         self._engine.mark_recovered()
-        self.recovered += 1
+        with self._lock:  # stats_dict snapshots this counter
+            self.recovered += 1
         bus.counter("serve.recovered")
         self._cooldown_until = 0.0
         # quarantine evidence predates the rebuild: failures during an

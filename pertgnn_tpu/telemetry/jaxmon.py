@@ -78,11 +78,15 @@ def install_jax_monitoring(bus):
 
     def on_event(event, **kw):
         if alive["on"]:
-            bus.counter("jax" + str(event))
+            # dynamic by nature: jax.monitoring enumerates the event
+            # names upstream (docs/OBSERVABILITY.md "jax internals" —
+            # all land under the `jax/...` prefix)
+            bus.counter("jax" + str(event))  # graftlint: allow-telemetry-drift
 
     def on_duration(event, duration_secs, **kw):
         if alive["on"]:
-            bus.histogram("jax" + str(event), float(duration_secs))
+            bus.histogram("jax" + str(event),  # graftlint: allow-telemetry-drift
+                          float(duration_secs))
 
     mon.register_event_listener(on_event)
     mon.register_event_duration_secs_listener(on_duration)

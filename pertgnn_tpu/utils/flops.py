@@ -214,7 +214,10 @@ def publish_attribution(bus, row: dict, *, prefix: str = "roofline") -> None:
     for field in ("mfu_pct", "mbu_pct", "roofline_graphs_per_s",
                   "flops_per_graph", "bytes_per_graph"):
         if row.get(field) is not None:
-            bus.gauge(f"{prefix}.{field}", row[field], **tags)
+            # names enumerated by the tuple above under the caller's
+            # prefix — serve_bench passes "serve.roofline", documented
+            # as docs/OBSERVABILITY.md's roofline table
+            bus.gauge(f"{prefix}.{field}", row[field], **tags)  # graftlint: allow-telemetry-drift
 
 
 def roofline_graphs_per_s(flops_per_graph: float | None,

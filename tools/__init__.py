@@ -1,0 +1,2 @@
+"""Repo tooling: the graftlint static-analysis framework lives in
+tools/graftlint/; tools/check_excepts.py is its back-compat shim."""

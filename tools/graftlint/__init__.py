@@ -1,0 +1,33 @@
+"""graftlint: multi-pass static analysis for this repo's real bug classes.
+
+Every review in PRs 3-7 caught a recurrence of the same few bug classes
+by hand; each pass here mechanizes one of them (provenance table in
+docs/LINTS.md):
+
+- ``excepts``            silently-swallowed exceptions (PR 4's lint,
+                         formerly tools/check_excepts.py — a shim there
+                         preserves the old CLI and import surface)
+- ``aot-key-coverage``   Config fields baked into compiled programs but
+                         missing from the aot/keys.py cache-key
+                         derivation (the PR-3 stale-replay bug class)
+- ``trace-hazard``       host syncs / Python side effects inside
+                         jitted / pjit'd / Pallas functions
+- ``telemetry-drift``    counter/gauge/span names emitted by the code
+                         vs docs/OBSERVABILITY.md's tables (and back)
+- ``lock-discipline``    instance attributes of threaded classes in the
+                         serve/fleet/prefetch paths mutated outside the
+                         owning lock
+- ``flag-config-drift``  config.py dataclass fields vs cli/common.py
+                         flags, both directions
+
+Run: ``python -m tools.graftlint [pass ...] [--json] [--baseline P]``.
+The whole suite is a tier-1 gate (tests/test_graftlint.py) and
+``bench.py --gate`` refuses captures from a tree where it fails.
+"""
+
+from __future__ import annotations
+
+from tools.graftlint.driver import (Context, LintResult, Violation,
+                                    run_passes, run_repo)
+
+__all__ = ["Context", "LintResult", "Violation", "run_passes", "run_repo"]

@@ -1,0 +1,175 @@
+"""graftlint CLI.
+
+    python -m tools.graftlint [PASS ...] [options]
+    python -m tools.graftlint telemetry --emit-table
+
+Options:
+    --json             machine-readable result (one JSON object)
+    --baseline PATH    baseline file (default tools/graftlint/
+                       baseline.json when it exists)
+    --no-baseline      ignore any baseline
+    --write-baseline   accept today's findings into the baseline file
+                       and exit 0 (reviewable: the file is in-tree)
+    --root DIR         repo root (default: this file's repo)
+    --list             list passes and exit
+
+Exit codes: 0 clean (or all findings baselined), 1 new violations,
+2 usage / internal error. The same contract tests/test_graftlint.py
+enforces in tier-1 and bench.py --gate piggybacks on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _repo_default() -> str:
+    # tools/graftlint/cli.py -> repo root
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    from tools.graftlint import driver
+    from tools.graftlint.passes import get_passes, registry
+
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="multi-pass static analysis for this repo's real "
+                    "bug classes (docs/LINTS.md)")
+    p.add_argument("passes", nargs="*",
+                   help="pass names to run (default: all); "
+                        f"canonical: {', '.join(registry())}")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--root", default=None)
+    p.add_argument("--list", action="store_true")
+    p.add_argument("--emit-table", action="store_true",
+                   help="telemetry pass only: regenerate "
+                        "docs/OBSERVABILITY.md's metric tables from "
+                        "source instead of checking them")
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    if args.list:
+        for name, mod in registry().items():
+            doc = next(iter((mod.__doc__ or "").strip().splitlines()),
+                       "")
+            print(f"{name:20s} {doc}")
+        return 0
+
+    repo = os.path.abspath(args.root or _repo_default())
+    if not os.path.isdir(repo):
+        # a typo'd --root would otherwise discover zero files and
+        # "pass" vacuously
+        print(f"graftlint: root is not a directory: {repo}",
+              file=sys.stderr)
+        return 2
+    try:
+        get_passes(args.passes or None)
+    except KeyError as e:
+        print(f"graftlint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.emit_table:
+        if args.passes not in (["telemetry"], ["telemetry-drift"]):
+            print("graftlint: --emit-table belongs to the telemetry "
+                  "pass: `python -m tools.graftlint telemetry "
+                  "--emit-table`", file=sys.stderr)
+            return 2
+        from tools.graftlint.passes import telemetry_drift
+
+        ctx = driver.Context(repo)
+        try:
+            content, summary = telemetry_drift.emit_table(ctx)
+        except OSError as e:
+            # no docs/OBSERVABILITY.md to regenerate = usage error
+            # (exit 2), not "lint findings" (exit 1)
+            print(f"graftlint: cannot regenerate "
+                  f"{telemetry_drift.DOC}: {e}", file=sys.stderr)
+            return 2
+        doc_path = ctx.abspath(telemetry_drift.DOC)
+        with open(doc_path, "w", encoding="utf-8") as f:
+            f.write(content)
+        print(json.dumps({"emit_table": summary, "wrote": doc_path}))
+        if summary["unplaced"]:
+            print(f"graftlint: {len(summary['unplaced'])} new metric(s) "
+                  f"had no table to land in — add a table section for "
+                  f"them: {summary['unplaced']}", file=sys.stderr)
+            return 1
+        return 0
+
+    baseline = ("" if args.no_baseline else args.baseline)
+    if (baseline and not args.write_baseline
+            and not os.path.exists(baseline)):
+        # an EXPLICIT baseline path that does not exist is a usage
+        # error, not an empty baseline: a typo'd path in CI would
+        # silently resurface all accepted debt (and --write-baseline
+        # would fork a second file while the real one goes stale)
+        print(f"graftlint: baseline file not found: {baseline} "
+              f"(--write-baseline creates one; --no-baseline ignores "
+              f"baselines)", file=sys.stderr)
+        return 2
+    try:
+        result = driver.run_passes(repo, args.passes or None,
+                                   baseline_path=baseline)
+    except FileNotFoundError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    except (json.JSONDecodeError, KeyError, TypeError) as e:
+        # a corrupt baseline is a USAGE error (exit 2), not "new
+        # violations" (exit 1) — CI reads the exit-code contract
+        print(f"graftlint: unreadable baseline file "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = args.baseline or driver.DEFAULT_BASELINE
+        fresh = result.new + result.baselined
+        # writing from a PASS SUBSET must not clobber other passes'
+        # accepted entries: carry over every existing entry whose rule
+        # did not run (entries for rules that DID run are replaced by
+        # today's findings — that is the accept/retire semantics)
+        # "driver" (parse-error) entries refresh only on a FULL run:
+        # parse errors are discovered lazily per file a pass asks to
+        # parse, so a pass subset may simply not have touched the file
+        # an accepted entry covers — dropping it would resurface the
+        # debt on the next full run (write_baseline dedupes the
+        # overlap when the subset DID re-report an entry)
+        ran = set(result.passes)
+        if not args.passes:
+            ran |= {"driver"}
+        keep = [driver.Violation(rule=r, path=p, line=0, message=k,
+                                 key=k)
+                for (r, p, k) in driver.load_baseline(path)
+                if r not in ran]
+        driver.write_baseline(path, fresh + keep)
+        print(f"graftlint: wrote {len(fresh) + len(keep)} baseline "
+              f"entr(ies) to {path}"
+              + (f" ({len(keep)} carried over from passes that did "
+                 f"not run)" if keep else ""))
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.as_dict()))
+    else:
+        for v in result.new:
+            print(v)
+        tail = (f"{len(result.new)} violation(s)"
+                + (f", {len(result.baselined)} baselined"
+                   if result.baselined else "")
+                + f" [{', '.join(result.passes)};"
+                  f" {result.elapsed_s:.2f}s]")
+        print(tail, file=sys.stderr)
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

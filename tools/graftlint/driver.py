@@ -1,0 +1,250 @@
+"""The shared graftlint driver: file discovery, AST cache, per-line
+suppression pragmas, baseline file, JSON + human output, exit codes.
+
+Contract every pass plugs into (tools/graftlint/passes/__init__.py):
+
+- a pass module exposes ``RULE`` (its kebab-case name) and
+  ``run(ctx) -> list[Violation]``;
+- the driver parses each in-scope file ONCE (shared AST cache) — a pass
+  never re-reads source it can get from the Context;
+- a violation on a line carrying ``# graftlint: allow-<rule>`` is
+  suppressed at the driver level (the ``excepts`` pass additionally
+  honors its historical ``# lint: allow-silent-except`` pragma);
+- a violation whose ``(rule, path, key)`` triple appears in the baseline
+  file is reported as *baselined* (visible in --json, excluded from the
+  exit code) — the escape hatch for accepted debt, reviewable because
+  the file lives in-tree (tools/graftlint/baseline.json by default);
+- exit codes: 0 clean (or everything baselined), 1 new violations,
+  2 usage / internal error.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import time
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+PRAGMA_PREFIX = "graftlint: allow-"
+
+
+@dataclasses.dataclass
+class Violation:
+    """One finding. ``key`` is the violation's stable identity for the
+    baseline file (line numbers drift; keys should not) — it defaults
+    to the message."""
+
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based; 0 = file-level finding
+    message: str
+    key: str = ""
+
+    def __post_init__(self):
+        if not self.key:
+            self.key = self.message
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "key": self.key}
+
+
+class Context:
+    """Per-run shared state: the repo root, the discovered file list,
+    and a parse cache. Paths are repo-relative with forward slashes."""
+
+    # the lint scope, mirroring check_excepts' historical default: the
+    # package, bench.py, and the top-level benchmark oracles. The
+    # vendored parity shim mimics a third-party API — out of scope.
+    # Glob semantics are pathlib-style: `*` stays within one path
+    # segment, `**/` crosses directories — so "benchmarks/*.py" is
+    # top-level only, exactly the legacy default_roots contract.
+    INCLUDE = ("pertgnn_tpu/**/*.py", "bench.py", "benchmarks/*.py")
+    EXCLUDE = ("benchmarks/parity/**",)
+
+    def __init__(self, repo: str):
+        self.repo = os.path.abspath(repo)
+        self.files = self._discover()
+        self._source: dict[str, str] = {}
+        self._tree: dict[str, ast.AST | None] = {}
+        self.parse_errors: list[Violation] = []
+
+    def _discover(self) -> list[str]:
+        include = [_compile_glob(p) for p in self.INCLUDE]
+        exclude = [_compile_glob(p) for p in self.EXCLUDE]
+        out: list[str] = []
+        for dirpath, dirnames, filenames in os.walk(self.repo):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            rel_dir = os.path.relpath(dirpath, self.repo).replace(os.sep,
+                                                                  "/")
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                rel = name if rel_dir == "." else f"{rel_dir}/{name}"
+                if any(pat.match(rel) for pat in exclude):
+                    continue
+                if any(pat.match(rel) for pat in include):
+                    out.append(rel)
+        return out
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.repo, rel.replace("/", os.sep))
+
+    def source(self, rel: str) -> str:
+        if rel not in self._source:
+            with open(self.abspath(rel), encoding="utf-8") as f:
+                self._source[rel] = f.read()
+        return self._source[rel]
+
+    def lines(self, rel: str) -> list[str]:
+        return self.source(rel).splitlines()
+
+    def tree(self, rel: str) -> ast.AST | None:
+        """Parsed module, or None when the file does not parse — the
+        driver reports the SyntaxError once; passes just skip None."""
+        if rel not in self._tree:
+            try:
+                self._tree[rel] = ast.parse(self.source(rel), filename=rel)
+            except SyntaxError as exc:
+                self._tree[rel] = None
+                self.parse_errors.append(Violation(
+                    rule="driver", path=rel, line=exc.lineno or 0,
+                    message=f"unparseable ({exc.msg})"))
+        return self._tree[rel]
+
+    def files_under(self, *prefixes: str) -> list[str]:
+        """In-scope files whose repo-relative path starts with any of
+        the given prefixes (or equals one exactly)."""
+        return [f for f in self.files
+                if any(f == p or f.startswith(p.rstrip("/") + "/")
+                       for p in prefixes)]
+
+
+def _compile_glob(pat: str):
+    """Pathlib-style glob -> compiled regex: ``**/`` crosses any number
+    of directories (including zero), ``**`` crosses everything, ``*``
+    and ``?`` stay within one segment — fnmatch's slash-crossing ``*``
+    would silently widen "benchmarks/*.py" to nested files."""
+    out = []
+    i = 0
+    while i < len(pat):
+        if pat.startswith("**/", i):
+            out.append(r"(?:.*/)?")
+            i += 3
+        elif pat.startswith("**", i):
+            out.append(r".*")
+            i += 2
+        elif pat[i] == "*":
+            out.append(r"[^/]*")
+            i += 1
+        elif pat[i] == "?":
+            out.append(r"[^/]")
+            i += 1
+        else:
+            out.append(re.escape(pat[i]))
+            i += 1
+    return re.compile("".join(out) + r"\Z")
+
+
+def _suppressed(ctx: Context, v: Violation) -> bool:
+    if not v.line:
+        return False
+    try:
+        line = ctx.lines(v.path)[v.line - 1]
+    except (OSError, IndexError):
+        return False
+    return f"{PRAGMA_PREFIX}{v.rule}" in line
+
+
+def load_baseline(path: str) -> set[tuple[str, str, str]]:
+    """(rule, path, key) triples accepted as known debt. A missing file
+    is an empty baseline; a corrupt one is a usage error (raises)."""
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return {(e["rule"], e["path"], e["key"]) for e in doc.get("entries", [])}
+
+
+def write_baseline(path: str, violations: list[Violation]) -> None:
+    entries = sorted(
+        {(v.rule, v.path, v.key) for v in violations})
+    entries = [{"rule": r, "path": p, "key": k} for r, p, k in entries]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1,
+                  sort_keys=False)
+        f.write("\n")
+
+
+@dataclasses.dataclass
+class LintResult:
+    new: list[Violation]
+    baselined: list[Violation]
+    elapsed_s: float
+    passes: list[str]
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+    def as_dict(self) -> dict:
+        return {
+            "version": 1,
+            "passes": self.passes,
+            "ok": self.ok,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "violations": [v.as_dict() for v in self.new],
+            "baselined": [v.as_dict() for v in self.baselined],
+        }
+
+
+def run_passes(repo: str, pass_names: list[str] | None = None,
+               baseline_path: str | None = None) -> LintResult:
+    """Run the named passes (default: all, in registry order) over the
+    repo and split the findings against the baseline."""
+    from tools.graftlint.passes import get_passes
+
+    t0 = time.perf_counter()
+    ctx = Context(repo)
+    baseline = load_baseline(
+        DEFAULT_BASELINE if baseline_path is None else baseline_path)
+    new: list[Violation] = []
+    baselined: list[Violation] = []
+    modules = get_passes(pass_names)
+    for mod in modules:
+        for v in mod.run(ctx):
+            if _suppressed(ctx, v):
+                continue
+            if (v.rule, v.path, v.key) in baseline:
+                baselined.append(v)
+            else:
+                new.append(v)
+    # parse errors (rule "driver", reported once per unparseable file)
+    # go through the same baseline split — --write-baseline must leave
+    # a tree that lints clean, parse errors included
+    for v in ctx.parse_errors:
+        if (v.rule, v.path, v.key) in baseline:
+            baselined.append(v)
+        else:
+            new.append(v)
+    new.sort(key=lambda v: (v.path, v.line, v.rule))
+    baselined.sort(key=lambda v: (v.path, v.line, v.rule))
+    return LintResult(new=new, baselined=baselined,
+                      elapsed_s=time.perf_counter() - t0,
+                      passes=[m.RULE for m in modules])
+
+
+def run_repo(repo: str) -> LintResult:
+    """The full suite with the default baseline — what
+    tests/test_graftlint.py and bench.py --gate call."""
+    return run_passes(repo)

@@ -1,0 +1,40 @@
+"""Pass registry. Order matters only for output stability: excepts
+first (pass 0, the historical lint), then the five PR-8 passes."""
+
+from __future__ import annotations
+
+from tools.graftlint.passes import (aot_keys, excepts, flag_config,
+                                    lock_discipline, telemetry_drift,
+                                    trace_hazard)
+
+_ORDER = (excepts, aot_keys, trace_hazard, telemetry_drift,
+          lock_discipline, flag_config)
+
+# short aliases accepted on the CLI next to the canonical RULE names
+ALIASES = {
+    "aot": aot_keys, "aot-keys": aot_keys,
+    "trace": trace_hazard,
+    "telemetry": telemetry_drift,
+    "locks": lock_discipline, "lock": lock_discipline,
+    "flags": flag_config, "flag": flag_config,
+}
+
+
+def registry() -> dict[str, object]:
+    return {m.RULE: m for m in _ORDER}
+
+
+def get_passes(names: list[str] | None = None) -> list:
+    if not names:
+        return list(_ORDER)
+    reg = registry()
+    out = []
+    for n in names:
+        mod = reg.get(n) or ALIASES.get(n)
+        if mod is None:
+            raise KeyError(
+                f"unknown pass {n!r} (choose from {sorted(reg)} "
+                f"or aliases {sorted(ALIASES)})")
+        if mod not in out:
+            out.append(mod)
+    return out

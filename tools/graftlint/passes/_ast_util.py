@@ -1,0 +1,310 @@
+"""Shared AST helpers for the graftlint passes.
+
+Everything here is STATIC and same-file: attribute-chain flattening,
+constant-string resolution (one level of local assignment), and the
+traced-scope resolver that several passes share — which local functions
+end up inside a jitted / pjit'd / Pallas program. Cross-module
+resolution is deliberately out of scope (docs/LINTS.md "Limits"): each
+pass states what it can see, and what it cannot is covered by the pass
+that CAN see it (e.g. model code is keyed wholesale by ``cfg.model``
+riding every cache key).
+"""
+
+from __future__ import annotations
+
+import ast
+
+
+def inner_attr_nodes(root: ast.AST) -> set[ast.AST]:
+    """The ``.value`` children of every Attribute under `root` — walking
+    with these skipped matches only MAXIMAL attribute chains
+    (``cfg.train.tau`` without also matching its ``cfg.train`` child)."""
+    out: set[ast.AST] = set()
+    for n in ast.walk(root):
+        if isinstance(n, ast.Attribute):
+            out.add(n.value)
+    return out
+
+
+def attr_chain(node: ast.AST) -> list[str] | None:
+    """``a.b.c`` -> ["a", "b", "c"]; None when the expression is not a
+    pure Name/Attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def const_str_tuple(node: ast.AST) -> list[str] | None:
+    """("a", "b", ...) / ["a", ...] of string constants, else None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            s = const_str(elt)
+            if s is None:
+                return None
+            out.append(s)
+        return out
+    return None
+
+
+def resolve_str_values(node: ast.AST,
+                       scope: ast.AST | None = None) -> set[str] | None:
+    """The set of string constants an expression can evaluate to,
+    resolved statically: constants, IfExp over constants, and — given
+    ``scope`` (the enclosing function) — a Name assigned only constant
+    strings anywhere in that scope. None = not statically resolvable
+    (dynamic name)."""
+    s = const_str(node)
+    if s is not None:
+        return {s}
+    if isinstance(node, ast.IfExp):
+        a = resolve_str_values(node.body, scope)
+        b = resolve_str_values(node.orelse, scope)
+        if a is not None and b is not None:
+            return a | b
+        return None
+    if isinstance(node, ast.Name) and scope is not None:
+        values: set[str] = set()
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign):
+                targets = [t.id for t in n.targets
+                           if isinstance(t, ast.Name)]
+                if node.id in targets:
+                    # `x = reject = None` sentinel inits contribute
+                    # nothing; only a non-None unresolvable value makes
+                    # the name dynamic
+                    if (isinstance(n.value, ast.Constant)
+                            and n.value.value is None):
+                        continue
+                    got = resolve_str_values(n.value)
+                    if got is None:
+                        return None
+                    values |= got
+            elif (isinstance(n, ast.AnnAssign) and n.value is not None
+                  and isinstance(n.target, ast.Name)
+                  and n.target.id == node.id):
+                if (isinstance(n.value, ast.Constant)
+                        and n.value.value is None):
+                    continue
+                got = resolve_str_values(n.value)
+                if got is None:
+                    return None
+                values |= got
+        return values or None
+    return None
+
+
+def functions(tree: ast.AST) -> list[ast.FunctionDef | ast.AsyncFunctionDef]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def enclosing_map(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    """child function/lambda -> nearest enclosing function (for closure
+    reasoning)."""
+    out: dict[ast.AST, ast.AST] = {}
+
+    def visit(node, current):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                if current is not None:
+                    out[child] = current
+                visit(child, child)
+            else:
+                visit(child, current)
+
+    visit(tree, None)
+    return out
+
+
+_JIT_CHAINS = {
+    ("jax", "jit"), ("jax", "pmap"), ("pjit",), ("jit",),
+    ("pl", "pallas_call"), ("pallas", "pallas_call"),
+    ("jax", "experimental", "pjit", "pjit"),
+}
+_VJP_CHAINS = {("jax", "custom_vjp"), ("custom_vjp",),
+               ("jax", "custom_jvp"), ("custom_jvp",)}
+_MODULE_BASES = {("nn", "Module"), ("linen", "Module"),
+                 ("flax", "linen", "Module")}
+
+
+def _is_partial(call: ast.Call) -> bool:
+    c = attr_chain(call.func)
+    return c is not None and c[-1] == "partial"
+
+
+def traced_functions(tree: ast.AST) -> dict[ast.AST, set[str]]:
+    """Function/lambda nodes of THIS module whose bodies are traced into
+    compiled programs -> the subset of their parameter names known to be
+    HOST-STATIC at trace time (partial-bound keywords, keyword-only
+    params of partial(**kw)-wrapped kernels, custom_vjp nondiff args).
+    Resolution, all static and same-file:
+
+    - arguments of jax.jit / jax.pmap / pjit / pl.pallas_call calls
+      (Name -> the local def; a call to a local factory -> the factory
+      itself, whose body builds+returns the traced closure; a lambda ->
+      the lambda node; ``self.X`` -> the method that assigns
+      ``self.X = <local fn>``);
+    - functions decorated @jax.custom_vjp/@custom_jvp (also via
+      functools.partial), plus fwd/bwd registered through ``.defvjp`` —
+      ``nondiff_argnums`` positions are static on all three;
+    - ``__call__`` of flax ``nn.Module`` subclasses (model code always
+      runs under jit in this repo);
+    - fixpoint over same-module calls: a local function called by name
+      (or ``self.<method>``) from a traced body is traced too.
+    """
+    by_name: dict[str, list[ast.AST]] = {}
+    for fn in functions(tree):
+        by_name.setdefault(fn.name, []).append(fn)
+    self_assign: dict[str, list[tuple[str, ast.AST]]] = {}
+    # self.X = <name>  ->  X: [(name, enclosing method)]
+    for fn in functions(tree):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Name):
+                for t in node.targets:
+                    ch = attr_chain(t)
+                    if ch and len(ch) == 2 and ch[0] == "self":
+                        self_assign.setdefault(ch[1], []).append(
+                            (node.value.id, fn))
+
+    roots: dict[ast.AST, set[str]] = {}
+
+    def _params(fn: ast.AST) -> list[str]:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+            a = fn.args
+            return [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+        return []
+
+    def _kwonly(fn: ast.AST) -> set[str]:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return {x.arg for x in fn.args.kwonlyargs}
+        return set()
+
+    def mark(fn: ast.AST, static: set[str]) -> None:
+        roots.setdefault(fn, set()).update(static)
+
+    def mark_expr(arg: ast.AST, static: set[str] = frozenset()) -> None:
+        if isinstance(arg, ast.Lambda):
+            mark(arg, static)
+            return
+        if isinstance(arg, ast.Name):
+            for fn in by_name.get(arg.id, []):
+                mark(fn, static)
+            return
+        if isinstance(arg, ast.Call):
+            if _is_partial(arg) and arg.args:
+                # partial-bound keywords are host values -> static on
+                # the wrapped fn; partial(**kw) binds by keyword too,
+                # so the wrapped fn's keyword-only params are static
+                bound = {kw.arg for kw in arg.keywords
+                         if kw.arg is not None}
+                if any(kw.arg is None for kw in arg.keywords):
+                    inner = arg.args[0]
+                    if isinstance(inner, ast.Name):
+                        for fn in by_name.get(inner.id, []):
+                            bound |= _kwonly(fn)
+                mark_expr(arg.args[0], static | bound)
+            elif isinstance(arg.func, ast.Name):
+                # factory call: the factory's body (incl. its nested
+                # defs and closure reads) produces the traced fn
+                for fn in by_name.get(arg.func.id, []):
+                    mark(fn, static)
+            return
+        ch = attr_chain(arg)
+        if ch and len(ch) == 2 and ch[0] == "self":
+            for name, method in self_assign.get(ch[1], []):
+                mark(method, set())
+                for fn in by_name.get(name, []):
+                    mark(fn, static)
+
+    def _nondiff_names(fn: ast.AST, dec: ast.AST) -> set[str]:
+        """param names at custom_vjp/jvp nondiff_argnums positions."""
+        if not (isinstance(dec, ast.Call) and _is_partial(dec)):
+            return set()
+        for kw in dec.keywords:
+            if kw.arg == "nondiff_argnums":
+                idx = []
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    for e in kw.value.elts:
+                        if isinstance(e, ast.Constant):
+                            idx.append(int(e.value))
+                params = _params(fn)
+                return {params[i] for i in idx if i < len(params)}
+        return set()
+
+    vjp_nondiff: dict[str, set[str]] = {}  # decorated fn name -> names
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            ch = attr_chain(node.func)
+            if ch and tuple(ch) in _JIT_CHAINS and node.args:
+                mark_expr(node.args[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dec.args[0] if (isinstance(dec, ast.Call)
+                                    and _is_partial(dec)
+                                    and dec.args) else dec
+                dch = attr_chain(d)
+                if dch and tuple(dch) in _VJP_CHAINS:
+                    static = _nondiff_names(node, dec)
+                    mark(node, static)
+                    vjp_nondiff[node.name] = static
+        elif isinstance(node, ast.ClassDef):
+            bases = [tuple(attr_chain(b) or ()) for b in node.bases]
+            if any(b in _MODULE_BASES for b in bases):
+                for item in node.body:
+                    if (isinstance(item, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                            and item.name == "__call__"):
+                        mark(item, set())
+
+    # f.defvjp(fwd, bwd): fwd/bwd share f's nondiff-leading convention —
+    # the same PARAM NAMES are static where they reappear
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("defvjp", "defjvp")):
+            base = attr_chain(node.func.value) or []
+            inherited = vjp_nondiff.get(base[-1] if base else "", set())
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    for fn in by_name.get(arg.id, []):
+                        mark(fn, inherited & set(_params(fn)))
+                else:
+                    mark_expr(arg)
+
+    # fixpoint: same-module callees of traced bodies are traced
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(roots):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee: list[ast.AST] = []
+                if isinstance(node.func, ast.Name):
+                    callee = by_name.get(node.func.id, [])
+                else:
+                    ch = attr_chain(node.func)
+                    if ch and len(ch) == 2 and ch[0] == "self":
+                        callee = by_name.get(ch[1], [])
+                for c in callee:
+                    if c not in roots:
+                        roots[c] = set()
+                        changed = True
+    # (callers needing closure context — aot-key-coverage — build
+    # enclosing_map themselves)
+    return roots

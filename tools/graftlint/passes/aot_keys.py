@@ -1,0 +1,374 @@
+"""graftlint pass — aot-key-coverage: every Config field read inside
+program-building code must be reachable from an aot/keys.cache_key
+derivation. Bug-class provenance: the PR-3 review found THREE stale-
+replay bugs of exactly this shape (the packer budget, the embedding
+vocab sizes, and spurious ServeConfig invalidation — CHANGES.md "PR 3
+review fixes"); a config field baked into a compiled program as a
+constant but absent from the cache key replays yesterday's executable
+with today's config, silently.
+
+Static model:
+
+- KEY COVERAGE: every ``aot.cache_key(...)`` / ``cache_key(...)`` call
+  site's ``config=`` argument is analyzed (dict literals, one level of
+  same-file helper-function indirection — the ``_train_eval_key_config``
+  pattern). An attribute chain ``cfg.model`` covers the WHOLE model
+  subtree; ``cfg.train.label_scale`` covers one field;
+  ``getattr(cfg.train, k) for k in ("lr", ...)`` covers the listed
+  fields; ``cfg.graph_type`` covers a top-level scalar. Coverage is the
+  UNION over all key sites in the repo: per-program precision would
+  need the fn_id -> program mapping, which is runtime information —
+  the union still kills the bug class (a field NO key mentions cannot
+  be baked into ANY program safely).
+- PROGRAM READS: inside the traced scope of the program-building files
+  (SCOPE below) — the jitted/pallas'd functions themselves PLUS their
+  lexically enclosing functions, because closure captures
+  (``label_scale = cfg.train.label_scale`` before the ``def step``) are
+  baked into the program exactly like direct reads. A read is an
+  attribute chain rooted at a Config value: a parameter annotated
+  ``Config`` (or named ``cfg``/``config``), ``self._cfg``/``self.cfg``,
+  or a local alias of either. Parameters annotated with a SUBTREE
+  config class (``ModelConfig``) read with that subtree as implicit
+  prefix — which is how model code is covered: ``cfg.model`` rides
+  every key whole, so ModelConfig fields can never drift out.
+- a read of a whole subtree (``cfg.serve`` passed to a ladder builder)
+  counts as reading every field of it and must be wholly covered or
+  explicitly exempted.
+
+Exemptions: the SIGNATURE_VISIBLE allowlist below — fields whose effect
+on the program is fully visible in the abstract calling signature or
+the store slot name (shape knobs), which the key already hashes; each
+entry states why. Plus the line pragma
+``# graftlint: allow-aot-key-coverage`` and the baseline file.
+
+Known blind spots (docs/LINTS.md "Limits"): host-side reads whose
+VALUE is baked via an object built outside the traced scope (the optax
+transform carries ``train.lr``) — those fields must ride the key by
+review; the key-side list in _train_eval_key_config carries them today
+and this pass verifies they stay covered if the read ever moves into
+traced scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import (attr_chain,
+                                              const_str_tuple,
+                                              enclosing_map, functions,
+                                              inner_attr_nodes,
+                                              traced_functions)
+
+RULE = "aot-key-coverage"
+
+SUBTREES = ("ingest", "data", "model", "train", "parallel", "serve",
+            "fleet", "telemetry", "aot")
+_SUBTREE_CLASSES = {
+    "IngestConfig": "ingest", "DataConfig": "data",
+    "ModelConfig": "model", "TrainConfig": "train",
+    "ParallelConfig": "parallel", "ServeConfig": "serve",
+    "FleetConfig": "fleet", "TelemetryConfig": "telemetry",
+    "CompileCacheConfig": "aot",
+}
+
+# files whose code builds compiled programs (the ISSUE-8 scope)
+SCOPE = ("pertgnn_tpu/aot/", "pertgnn_tpu/serve/engine.py",
+         "pertgnn_tpu/train/loop.py", "pertgnn_tpu/train/predict.py",
+         "pertgnn_tpu/models/", "pertgnn_tpu/parallel/")
+
+# (file suffix, dotted pattern) -> reason. "sub.*" exempts a whole
+# subtree in that file.
+SIGNATURE_VISIBLE: dict[tuple[str, str], str] = {
+    ("pertgnn_tpu/serve/engine.py", "serve.*"):
+        "ladder knobs (bucket_growth/min_bucket_*/max_graphs_per_batch) "
+        "only select WHICH rung shapes exist — the shapes ride the "
+        "abstract signature and the store slot name, both hashed by the "
+        "key; queue/transport knobs never reach the compiled program "
+        "(serve/engine.py _rung_entry documents the same restraint). "
+        "serve_dtype, the ONE baked field, is keyed explicitly and "
+        "verified covered by tests/test_aot.py.",
+}
+
+
+def _covered_from_expr(node: ast.AST, roots: dict[str, tuple[str, ...]],
+                       covered: set[str]) -> None:
+    """Walk a key-config expression collecting covered dotted paths
+    into `covered` ("model.*" for whole subtrees)."""
+    getattr_bases: set[ast.AST] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            # getattr(cfg.<sub>, k) with k ranging over a const tuple;
+            # the BASE chain must not count as whole-subtree coverage
+            fch = attr_chain(n.func)
+            if fch == ["getattr"] and len(n.args) >= 2:
+                getattr_bases.add(n.args[0])
+                base = attr_chain(n.args[0])
+                if base and base[0] in roots:
+                    prefix = roots[base[0]] + tuple(base[1:])
+                    comp = _enclosing_comprehension_consts(node, n)
+                    for field in comp:
+                        covered.add(".".join(prefix + (field,)))
+    inner = inner_attr_nodes(node)
+    for n in ast.walk(node):
+        if n in getattr_bases or n in inner:
+            continue
+        ch = attr_chain(n)
+        if not ch or ch[0] not in roots:
+            continue
+        path = roots[ch[0]] + tuple(ch[1:])
+        if not path:
+            continue
+        if len(path) == 1:
+            if path[0] in SUBTREES:
+                covered.add(f"{path[0]}.*")
+            else:
+                covered.add(path[0])  # top-level scalar (graph_type)
+        else:
+            covered.add(".".join(path[:2]))
+
+
+def _enclosing_comprehension_consts(scope: ast.AST,
+                                    call: ast.Call) -> list[str]:
+    """For a getattr(...) inside a dict/list comprehension, the constant
+    strings its loop variable ranges over."""
+    for n in ast.walk(scope):
+        if isinstance(n, (ast.DictComp, ast.ListComp, ast.SetComp,
+                          ast.GeneratorExp)):
+            if any(c is call for c in ast.walk(n)):
+                for gen in n.generators:
+                    consts = const_str_tuple(gen.iter)
+                    if consts:
+                        return consts
+    return []
+
+
+def _class_attr_prefixes(tree: ast.AST) -> dict[ast.AST,
+                                                dict[str, tuple[str, ...]]]:
+    """ClassDef -> {attr: prefix} for class-level annotated config
+    attributes (the flax-module pattern ``cfg: ModelConfig`` — reads
+    through ``self.cfg`` then carry the ``model.`` prefix)."""
+    out: dict[ast.AST, dict[str, tuple[str, ...]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs: dict[str, tuple[str, ...]] = {}
+        for item in node.body:
+            if (isinstance(item, ast.AnnAssign)
+                    and isinstance(item.target, ast.Name)):
+                ann = attr_chain(item.annotation) or []
+                cls = ann[-1] if ann else None
+                if cls == "Config":
+                    attrs[item.target.id] = ()
+                elif cls in _SUBTREE_CLASSES:
+                    attrs[item.target.id] = (_SUBTREE_CLASSES[cls],)
+        if attrs:
+            out[node] = attrs
+    return out
+
+
+def _config_roots(fn: ast.AST,
+                  self_attrs: dict[str, tuple[str, ...]] | None = None
+                  ) -> dict[str, tuple[str, ...]]:
+    """name -> dotted prefix for Config-rooted values visible in `fn`:
+    full-Config params map to (), subtree-annotated params map to
+    (subtree,), and simple local aliases of self._cfg / self.cfg map
+    to the enclosing class's annotation when it has one, else ()."""
+    self_attrs = self_attrs or {}
+    roots: dict[str, tuple[str, ...]] = {}
+    args = []
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn.args
+        args = (a.posonlyargs + a.args + a.kwonlyargs
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else []))
+    for arg in args:
+        ann = attr_chain(arg.annotation) if arg.annotation else None
+        cls = ann[-1] if ann else None
+        if cls == "Config":
+            roots[arg.arg] = ()
+        elif cls in _SUBTREE_CLASSES:
+            roots[arg.arg] = (_SUBTREE_CLASSES[cls],)
+        elif arg.arg in ("cfg", "config") and arg.annotation is None:
+            roots[arg.arg] = ()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t = n.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            vch = attr_chain(n.value)
+            if vch in (["self", "_cfg"], ["self", "cfg"]):
+                roots[t.id] = self_attrs.get(vch[1], ())
+            elif vch and vch[0] in roots and len(vch) == 1:
+                roots[t.id] = roots[vch[0]]
+    return roots
+
+
+def _self_cfg_reads(fn: ast.AST) -> list[tuple[int, tuple[str, ...]]]:
+    """(line, dotted path) for reads through self._cfg / self.cfg."""
+    out = []
+    for n in ast.walk(fn):
+        ch = attr_chain(n)
+        if ch and len(ch) >= 3 and ch[0] == "self" and ch[1] in ("_cfg",
+                                                                 "cfg"):
+            out.append((n.lineno, tuple(ch[2:])))
+    return out
+
+
+def collect_coverage(ctx) -> set[str]:
+    """Union of key-covered dotted paths over every cache_key call site
+    in the repo (one level of same-file helper indirection)."""
+    covered: set[str] = set()
+    for rel in ctx.files:
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        by_name = {}
+        for fn in functions(tree):
+            by_name.setdefault(fn.name, fn)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ch = attr_chain(node.func) or []
+            if not ch or ch[-1] != "cache_key":
+                continue
+            cfg_arg = None
+            for kw in node.keywords:
+                if kw.arg == "config":
+                    cfg_arg = kw.value
+            if cfg_arg is None:
+                continue
+            # `config=X` where X is a local assigned earlier in the
+            # enclosing function: resolve one assignment level
+            if isinstance(cfg_arg, ast.Name):
+                encl = _enclosing_fn(tree, node)
+                if encl is not None:
+                    for n2 in ast.walk(encl):
+                        if (isinstance(n2, ast.Assign)
+                                and any(isinstance(t, ast.Name)
+                                        and t.id == cfg_arg.id
+                                        for t in n2.targets)):
+                            cfg_arg = n2.value
+                            break
+            exprs = [cfg_arg]
+            # one level of helper indirection: config=_helper(...)
+            if (isinstance(cfg_arg, ast.Call)
+                    and isinstance(cfg_arg.func, ast.Name)
+                    and cfg_arg.func.id in by_name):
+                exprs.append(by_name[cfg_arg.func.id])
+            for expr in exprs:
+                roots = _config_roots(expr) if isinstance(
+                    expr, (ast.FunctionDef,
+                           ast.AsyncFunctionDef)) else _enclosing_roots(
+                               tree, node)
+                _covered_from_expr(expr, roots, covered)
+                for _line, path in ([] if not isinstance(
+                        expr, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        else _self_cfg_reads(expr)):
+                    _add_path(path, covered)
+    return covered
+
+
+def _enclosing_fn(tree: ast.AST, node: ast.AST) -> ast.AST | None:
+    best = None
+    for fn in functions(tree):
+        if any(n is node for n in ast.walk(fn)):
+            best = fn  # later (nested) matches are narrower
+    return best
+
+
+def _enclosing_roots(tree: ast.AST,
+                     node: ast.AST) -> dict[str, tuple[str, ...]]:
+    fn = _enclosing_fn(tree, node)
+    if fn is not None:
+        return _config_roots(fn)
+    return {"cfg": (), "config": ()}
+
+
+def _add_path(path: tuple[str, ...], into: set[str]) -> None:
+    if not path:
+        return
+    if len(path) == 1:
+        into.add(f"{path[0]}.*" if path[0] in SUBTREES else path[0])
+    else:
+        into.add(".".join(path[:2]))
+
+
+def _exempt(rel: str, dotted: str) -> str | None:
+    for (suffix, pat), reason in SIGNATURE_VISIBLE.items():
+        if not rel.endswith(suffix):
+            continue
+        if pat == dotted:
+            return reason
+        if pat.endswith(".*") and (dotted == pat[:-2] + ".*"
+                                   or dotted.startswith(pat[:-2] + ".")):
+            return reason
+    return None
+
+
+def run(ctx) -> list[Violation]:
+    covered = collect_coverage(ctx)
+    out: list[Violation] = []
+    for rel in ctx.files_under(*SCOPE):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        traced = traced_functions(tree)
+        if not traced:
+            continue
+        class_attrs = _class_attr_prefixes(tree)
+        fn_attrs: dict[ast.AST, dict[str, tuple[str, ...]]] = {}
+        for cls, attrs in class_attrs.items():
+            for n in ast.walk(cls):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    fn_attrs[n] = attrs
+        enc = enclosing_map(tree)
+        scope_fns: set[ast.AST] = set()
+        for fn in traced:
+            scope_fns.add(fn)
+            cur = fn
+            while cur in enc:  # closure captures come from enclosers
+                cur = enc[cur]
+                scope_fns.add(cur)
+        seen: set[tuple[int, str]] = set()
+        for fn in scope_fns:
+            attrs = fn_attrs.get(fn, {})
+            roots = _config_roots(fn, attrs)
+            reads: list[tuple[int, tuple[str, ...]]] = []
+            inner = inner_attr_nodes(fn)
+            for n in ast.walk(fn):
+                if n in inner:
+                    continue
+                ch = attr_chain(n)
+                if ch and ch[0] in roots and len(ch) > 1:
+                    reads.append((n.lineno, roots[ch[0]] + tuple(ch[1:])))
+                elif ch and len(ch) >= 2 and ch[0] == "self":
+                    if ch[1] in attrs:
+                        reads.append((n.lineno,
+                                      attrs[ch[1]] + tuple(ch[2:])))
+                    elif ch[1] in ("_cfg", "cfg") and len(ch) >= 3:
+                        reads.append((n.lineno, tuple(ch[2:])))
+            for line, path in reads:
+                dotted = (".".join(path[:2]) if len(path) >= 2 else
+                          (f"{path[0]}.*" if path[0] in SUBTREES
+                           else path[0]))
+                if (line, dotted) in seen:
+                    continue
+                seen.add((line, dotted))
+                sub = dotted.split(".", 1)[0]
+                if (dotted in covered or f"{sub}.*" in covered
+                        or _exempt(rel, dotted)):
+                    continue
+                out.append(Violation(
+                    rule=RULE, path=rel, line=line,
+                    message=(f"config field `{dotted}` is read in "
+                             f"program-building scope but no "
+                             f"aot/keys.cache_key derivation covers it "
+                             f"— a compiled program baking it would "
+                             f"replay stale under a config change "
+                             f"(the PR-3 bug class); add it to the key "
+                             f"config, or exempt it as signature-"
+                             f"visible in passes/aot_keys.py"),
+                    key=f"uncovered:{dotted}"))
+    return out

@@ -1,0 +1,266 @@
+"""graftlint pass — flag-config-drift: every dataclass field in
+config.py maps to a CLI flag in cli/common.py, and every flag maps back
+to a field, across the CLIs. Bug-class provenance: the PR-6/7 reviews
+hand-checked that each new config knob (kernel blocks, serve_dtype, the
+whole FleetConfig) grew flags on all CLIs; PR 8's first run of this
+pass found `ServeConfig.min_bucket_nodes` / `min_bucket_edges` had
+never been CLI-reachable (fixed in this PR).
+
+Mapping rules, in order:
+
+1. exact name: field ``X`` <-> flag ``--X`` (any subtree; collisions
+   resolve to the serve-side field for the serve flags by virtue of
+   exactness — fleet twins carry the ``router_`` prefix);
+2. the ALIASES table below (inverted booleans like ``--no_serve_warmup``
+   -> ``serve.warmup``, renames like ``--bf16`` ->
+   ``model.bf16_activations``, prefixed fleet twins);
+3. the NOT_CLI allowlist: fields deliberately config-only (reference-
+   parity constants like ``ingest.ts_bucket_ms`` that exist to be
+   pinned, not tuned per run) — each with the reason;
+4. the NOT_CONFIG allowlist: flags that are operational inputs, not
+   Config fields (``--data_dir``, ``--synthetic``, multihost wiring).
+
+Additionally, the "shared by ALL CLIs" contract: every CLI main under
+cli/ must install the telemetry and AOT flag groups (docs claim any
+entry point can produce telemetry and replay executables — a CLI that
+forgets one silently breaks that).
+
+Violations carry the field/flag name as the baseline key, so accepted
+debt survives line drift.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import attr_chain, const_str
+
+RULE = "flag-config-drift"
+
+CONFIG = "pertgnn_tpu/config.py"
+COMMON = "pertgnn_tpu/cli/common.py"
+CLI_DIR = "pertgnn_tpu/cli/"
+
+# flag name (no --) -> "subtree.field" it sets (inverted/renamed/
+# prefixed forms rule 1 cannot see)
+ALIASES: dict[str, str] = {
+    "bf16": "model.bf16_activations",
+    "missing_indicator_is_zero": "model.missing_indicator_is_one",
+    "no_device_materialize": "train.device_materialize",
+    "staged_epochs": "train.stage_epoch_recipes",
+    "no_stage_epoch_recipes": "train.stage_epoch_recipes",
+    "no_serve_warmup": "serve.warmup",
+    "no_overlap_dispatch": "serve.overlap_dispatch",
+    "compile_cache_dir": "aot.cache_dir",
+    "aot_min_compile_time_s": "aot.min_compile_time_s",
+    "no_serialize_executables": "aot.serialize_executables",
+    "router_flush_deadline_ms": "fleet.router_flush_deadline_ms",
+    "router_max_pending": "fleet.max_pending",
+    "router_request_deadline_ms": "fleet.request_deadline_ms",
+    "router_dispatch_timeout_s": "fleet.dispatch_timeout_s",
+}
+
+# "subtree.field" -> why it deliberately has no flag
+NOT_CLI: dict[str, str] = {
+    "ingest.ts_bucket_ms":
+        "reference-parity constant (preprocess.py:39); changing it "
+        "invalidates every artifact — config-file-only by design",
+    "ingest.entry_tiebreak_um":
+        "raw-string domain constant of the reference dataset",
+    "ingest.resource_aggs":
+        "feature-schema constant; the feature width is baked into "
+        "checkpoints",
+    "ingest.entry_rpctype":
+        "reference dataset constant (preprocess.py:113)",
+    "data.split":
+        "positional split fractions are reference parity "
+        "(pert_gnn.py:198-200); not a per-run tunable",
+    "data.shuffle_seed":
+        "train-split shuffle is keyed off --seed; a separate knob "
+        "would double the provenance surface",
+    "train.log_every":
+        "cosmetic cadence; PERTGNN_LOG_LEVEL covers the use case",
+    "train.checkpoint_every":
+        "checkpoint cadence rides checkpoint_dir defaults; exposed "
+        "via config files for the supervisor",
+    "train.stage_recipes_max_mb":
+        "a safety cap that should never bind (recipes are O(graphs) "
+        "int32s); tuning it per-run would hide the real bug",
+    "parallel.data_axis":
+        "mesh axis NAMES are API constants shared with the sharding "
+        "rules; renaming per-run would break pjit specs",
+    "parallel.model_axis": "same as parallel.data_axis",
+}
+
+# flag -> why it is not a Config field (operational input)
+NOT_CONFIG: dict[str, str] = {
+    "synthetic": "input-source selector, not pipeline semantics",
+    "synthetic_entries": "synthetic-generator spec (ingest input)",
+    "synthetic_traces_per_entry": "synthetic-generator spec",
+    "data_dir": "filesystem location of the raw input",
+    "artifact_dir": "filesystem location of the L0-L2 cache",
+    "stream_factorize": "ingest execution strategy (ids isomorphic, "
+                        "not semantic — ingest/io.py)",
+    "ingest_workers": "ingest execution parallelism, result-identical",
+    "coordinator_address": "multihost process wiring",
+    "num_processes": "multihost process wiring",
+    "process_id": "multihost process wiring",
+    "allow_config_mismatch": "checkpoint cross-check severity switch",
+    "profile_dir": "profiler output location",
+    "log_level": "stderr logging verbosity (TelemetryConfig covers "
+                 "the bus; this is the human stream)",
+}
+
+
+def _config_fields(ctx) -> dict[str, int]:
+    """"subtree.field" (plus top-level Config scalars like graph_type)
+    -> definition line, from config.py's dataclasses (statically:
+    AnnAssign targets). The `Config` class's own annotations name the
+    subtrees (ingest: IngestConfig, ...)."""
+    tree = ctx.tree(CONFIG)
+    classes: dict[str, list[tuple[str, int]]] = {}
+    cfg_class: ast.ClassDef | None = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            classes[node.name] = [
+                (item.target.id, item.lineno) for item in node.body
+                if isinstance(item, ast.AnnAssign)
+                and isinstance(item.target, ast.Name)]
+            if node.name == "Config":
+                cfg_class = node
+    subtree_of: dict[str, str] = {}
+    if cfg_class is not None:
+        for item in cfg_class.body:
+            if (isinstance(item, ast.AnnAssign)
+                    and isinstance(item.target, ast.Name)):
+                ann = attr_chain(item.annotation) or []
+                if ann and ann[-1] in classes:
+                    subtree_of[item.target.id] = ann[-1]
+    out: dict[str, int] = {}
+    for sub, cls in subtree_of.items():
+        for name, lineno in classes[cls]:
+            out[f"{sub}.{name}"] = lineno
+    for name, lineno in classes.get("Config", []):
+        if name not in subtree_of:
+            out[name] = lineno  # top-level scalar (graph_type)
+    return out
+
+
+def _flags(ctx, rel: str) -> dict[str, int]:
+    """flag name (no --) -> line, from add_argument calls in `rel`."""
+    tree = ctx.tree(rel)
+    out: dict[str, int] = {}
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument" and node.args):
+            s = const_str(node.args[0])
+            if s and s.startswith("--"):
+                out.setdefault(s[2:], node.lineno)
+    return out
+
+
+def _consumed_flags(ctx) -> set[str]:
+    """Flag names READ from the parsed namespace anywhere under cli/ or
+    bench.py: ``args.X`` attribute reads and ``getattr(args, "X", ...)``
+    — a flag that is parsed but never consumed is silently ignored at
+    runtime (exactly half of this PR's min_bucket_nodes fix: adding the
+    add_argument without the config_from_args getattr would have linted
+    clean under a name-match-only check)."""
+    consumed: set[str] = set()
+    for rel in ctx.files_under(CLI_DIR, "bench.py"):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute):
+                ch = attr_chain(node)
+                if ch and len(ch) == 2 and ch[0] == "args":
+                    consumed.add(ch[1])
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Name)
+                  and node.func.id in ("getattr", "hasattr")
+                  and len(node.args) >= 2):
+                base = attr_chain(node.args[0]) or []
+                s = const_str(node.args[1])
+                if base == ["args"] and s:
+                    consumed.add(s)
+    return consumed
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+    if CONFIG not in ctx.files or COMMON not in ctx.files:
+        return out  # fixture trees without the pair have no contract
+    if ctx.tree(CONFIG) is None or ctx.tree(COMMON) is None:
+        return out  # the driver reports the SyntaxError itself
+    fields = _config_fields(ctx)
+    flags = _flags(ctx, COMMON)
+    consumed = _consumed_flags(ctx)
+
+    alias_targets = set(ALIASES.values())
+    field_names_by_sub = {}  # bare field name -> dotted
+    for dotted in fields:
+        bare = dotted.split(".")[-1]
+        field_names_by_sub.setdefault(bare, []).append(dotted)
+
+    # fields -> flags
+    for dotted, lineno in sorted(fields.items()):
+        bare = dotted.split(".")[-1]
+        if bare in flags or dotted in alias_targets or dotted in NOT_CLI:
+            continue
+        out.append(Violation(
+            rule=RULE, path=CONFIG, line=lineno,
+            message=(f"config field `{dotted}` has no CLI flag in "
+                     f"{COMMON} — add one (or an ALIASES/NOT_CLI entry "
+                     f"in passes/flag_config.py with the reason)"),
+            key=f"field:{dotted}"))
+
+    # flags -> fields
+    for flag, lineno in sorted(flags.items()):
+        if flag not in consumed:
+            # parsed but never read: the flag is accepted and silently
+            # discarded — worse than missing, it LOOKS wired
+            out.append(Violation(
+                rule=RULE, path=COMMON, line=lineno,
+                message=(f"flag `--{flag}` is parsed but never read "
+                         f"from the namespace (no `args.{flag}` / "
+                         f"getattr under cli/ or bench.py) — it is "
+                         f"silently ignored at runtime; wire it "
+                         f"through config_from_args or drop it"),
+                key=f"unconsumed:{flag}"))
+        if flag in ALIASES or flag in NOT_CONFIG:
+            continue
+        if flag in field_names_by_sub or flag in fields:
+            continue
+        out.append(Violation(
+            rule=RULE, path=COMMON, line=lineno,
+            message=(f"flag `--{flag}` maps to no config.py dataclass "
+                     f"field — rename it, add the field, or record it "
+                     f"in NOT_CONFIG (passes/flag_config.py) with the "
+                     f"reason"),
+            key=f"flag:{flag}"))
+
+    # every CLI installs the shared telemetry + AOT flag groups
+    for rel in ctx.files_under(CLI_DIR):
+        name = rel.rsplit("/", 1)[-1]
+        if not name.endswith("_main.py"):
+            continue
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        called = {(attr_chain(n.func) or [""])[-1]
+                  for n in ast.walk(tree) if isinstance(n, ast.Call)}
+        for group in ("add_telemetry_flags", "add_aot_flags"):
+            if group not in called:
+                out.append(Violation(
+                    rule=RULE, path=rel, line=0,
+                    message=(f"CLI {name} does not install {group}() — "
+                             f"docs promise telemetry and the compile "
+                             f"cache on EVERY entry point "
+                             f"(docs/OBSERVABILITY.md, docs/GUIDE.md)"),
+                    key=f"cli:{name}:{group}"))
+    return out

@@ -1,0 +1,223 @@
+"""graftlint pass — lock-discipline: in the threaded serve / fleet /
+prefetch / supervisor code, instance attributes of a THREADED class
+(one that owns both a lock and a thread) must be mutated under the
+owning lock. Bug-class provenance: PR 4/5/7 reviews each hand-audited
+the serve queue's and fleet router's counter mutations against their
+lock; PR 8 found `deadline_exceeded` incremented outside the lock in
+BOTH (fixed in this PR) — exactly the drift a hand audit misses once
+the class grows past a screenful.
+
+Static model (one class at a time, resolved lexically):
+
+- a class is THREADED when its body both constructs a
+  ``threading.Thread`` (or subclasses Thread) and assigns an instance
+  lock: ``self.X = threading.Lock()/RLock()/Condition(...)``. A
+  Condition wrapping a lock makes both names locks (``with self._wake``
+  and ``with self._lock`` guard the same state).
+- every mutation of ``self.<attr>`` outside ``__init__`` — assignment,
+  augmented assignment, or a call to a known container mutator
+  (``self.pending.append(...)``) — must be lexically inside a
+  ``with self.<lock>`` block. Methods named ``*_locked`` are exempt BY
+  CONVENTION: the suffix asserts that every caller already holds the
+  lock — and the pass ENFORCES the caller side: a
+  ``self.<x>_locked(...)`` call outside a ``with self.<lock>`` block
+  (from a method not itself ``*_locked``) is a violation.
+- exemptions, in reviewability order: the per-class ALLOWLIST below
+  (attributes owned by exactly one thread, with the reason stated), a
+  line pragma ``# graftlint: allow-lock-discipline`` for single sites
+  (e.g. the SIGTERM drain flag that deliberately avoids taking the
+  lock from a signal handler), or the baseline file.
+
+The model is deliberately conservative: it does not chase aliasing,
+cross-object mutation (``worker.inflight -= 1`` guarded by the ROUTER's
+lock), or reads. Reads of drifting counters are benign-stale in
+CPython; unlocked WRITES are the lost-update bug class this pass kills.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import attr_chain
+
+RULE = "lock-discipline"
+
+SCOPE = ("pertgnn_tpu/serve/", "pertgnn_tpu/fleet/",
+         "pertgnn_tpu/batching/prefetch.py",
+         "pertgnn_tpu/train/supervisor.py",
+         "pertgnn_tpu/cli/fleet_main.py",
+         "pertgnn_tpu/telemetry/")
+
+_MUTATORS = {"append", "extend", "insert", "pop", "remove", "clear",
+             "add", "discard", "update", "setdefault", "popitem"}
+
+# (class name, attribute) pairs exempt because exactly ONE thread ever
+# writes them — the explicit shared-state allowlist the pass contract
+# requires (docs/LINTS.md). Keep the reasons current: an entry whose
+# reason stops being true is a data race with a permission slip.
+ALLOWLIST: dict[tuple[str, str], str] = {
+    # serve/queue.py MicrobatchQueue — worker-thread-only pipeline
+    # state: written exclusively by the single `_run` worker (and by
+    # close() only AFTER joining it); never read by another thread.
+    ("MicrobatchQueue", "_inflight"):
+        "overlapped-dispatch slot; worker-thread-only by design "
+        "(documented on the attribute)",
+    ("MicrobatchQueue", "_dispatcher"):
+        "abandonable dispatcher handle; worker-thread-only, rebuilt "
+        "by the worker after a watchdog trip",
+    ("MicrobatchQueue", "_cooldown_until"):
+        "fail-fast window bound; read and written by the worker only",
+    ("MicrobatchQueue", "_drain_announced"):
+        "drain-marker latch; worker-only, except close() which reads "
+        "AND writes it only after joining the worker (single-threaded "
+        "by then)",
+}
+# (serve/queue.py's _Dispatcher owns a Thread but synchronizes via a
+# Semaphore, not a lock, so the lock-owning-class criterion skips it —
+# its handoff ordering is documented on the class.)
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.lock_attrs: set[str] = set()
+        self.makes_thread = any(
+            (attr_chain(b) or [""])[-1] == "Thread" for b in node.bases)
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                ch = attr_chain(n.func) or []
+                if ch and ch[-1] == "Thread":
+                    self.makes_thread = True
+            if isinstance(n, (ast.Assign, ast.AnnAssign)):
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                value = n.value
+                if value is None or not isinstance(value, ast.Call):
+                    continue
+                vch = attr_chain(value.func) or []
+                if vch and vch[-1] in ("Lock", "RLock", "Condition"):
+                    for t in targets:
+                        tch = attr_chain(t)
+                        if tch and len(tch) == 2 and tch[0] == "self":
+                            self.lock_attrs.add(tch[1])
+                    # Condition(self._lock): the wrapped lock guards
+                    # the same state under either name
+                    if vch[-1] == "Condition":
+                        for arg in value.args:
+                            ach = attr_chain(arg)
+                            if ach and len(ach) == 2 and ach[0] == "self":
+                                self.lock_attrs.add(ach[1])
+
+    @property
+    def threaded(self) -> bool:
+        return self.makes_thread and bool(self.lock_attrs)
+
+
+def _mutations(method: ast.AST, lock_attrs: set[str]):
+    """(line, attr, desc) for every self-attribute mutation in `method`
+    that is NOT inside a `with self.<lock>` block. Nested defs are
+    walked too (a closure runs on whatever thread calls it, so it needs
+    the same discipline as its method)."""
+
+    out: list[tuple[int, str, str]] = []
+
+    def locked_by(withitem: ast.withitem) -> bool:
+        ch = attr_chain(withitem.context_expr)
+        return bool(ch and len(ch) == 2 and ch[0] == "self"
+                    and ch[1] in lock_attrs)
+
+    def visit(node, locked: bool):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a closure DEFINED under the lock still EXECUTES later,
+            # on whatever thread calls it, with no lock held — its
+            # body restarts unlocked
+            locked = False
+        if isinstance(node, ast.With):
+            locked = locked or any(locked_by(i) for i in node.items)
+        if (isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+                and not locked):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                # `self.x: int = v` mutates exactly like `self.x = v`
+                targets = [] if node.value is None else [node.target]
+            else:
+                targets = [node.target]
+            flat: list[ast.AST] = []
+            for t in targets:
+                # tuple/list unpacking: `self.a, self.b = ...`
+                flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t])
+            for t in flat:
+                base = t
+                sub = ""
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                    sub = "[...]"
+                ch = attr_chain(base)
+                if ch and len(ch) == 2 and ch[0] == "self":
+                    op = ("augmented assignment"
+                          if isinstance(node, ast.AugAssign)
+                          else "assignment")
+                    out.append((node.lineno, ch[1], f"{op}{sub}"))
+        if isinstance(node, ast.Call) and not locked:
+            ch = attr_chain(node.func)
+            if (ch and len(ch) == 3 and ch[0] == "self"
+                    and ch[2] in _MUTATORS):
+                out.append((node.lineno, ch[1], f".{ch[2]}() call"))
+            elif (ch and len(ch) == 2 and ch[0] == "self"
+                    and ch[1].endswith("_locked")):
+                # the other half of the *_locked convention: the suffix
+                # PROMISES the caller holds the lock — an unlocked call
+                # breaks the contract the method's exemption rests on
+                out.append((node.lineno, ch[1],
+                            "caller-must-hold-the-lock `*_locked` call"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    for stmt in method.body:
+        visit(stmt, False)
+    return out
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+    for rel in ctx.files_under(*SCOPE):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _ClassInfo(node)
+            if not info.threaded:
+                continue
+            for method in node.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name == "__init__":
+                    continue  # construction precedes thread start
+                if method.name.endswith("_locked"):
+                    continue  # caller-holds-the-lock naming contract
+                for line, attr, desc in _mutations(method,
+                                                   info.lock_attrs):
+                    if attr in info.lock_attrs:
+                        continue
+                    reason = ALLOWLIST.get((node.name, attr))
+                    if reason is not None:
+                        continue
+                    locks = "/".join(f"self.{a}"
+                                     for a in sorted(info.lock_attrs))
+                    out.append(Violation(
+                        rule=RULE, path=rel, line=line,
+                        message=(f"{node.name}.{method.name}: {desc} to "
+                                 f"self.{attr} outside `with {locks}` — "
+                                 f"this class runs threads; move the "
+                                 f"mutation under the lock, allowlist "
+                                 f"the attribute with its single-writer "
+                                 f"reason, or pragma the line"),
+                        key=f"{node.name}.{attr}@{method.name}"))
+    return out

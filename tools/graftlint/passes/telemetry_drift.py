@@ -1,0 +1,379 @@
+"""graftlint pass — telemetry-drift: every counter/gauge/histogram/span
+name the package emits must appear in docs/OBSERVABILITY.md's metric
+tables, and every table row must still correspond to something the code
+emits. Bug-class provenance: the PR-6 review found `serve.compiles`
+counting rung compiles with no documentation row, and PR 2's
+observability contract ("the tables below are the schema") rots
+silently without a mechanical check.
+
+What counts as an EMISSION: a call ``<recv>.counter/gauge/histogram/
+span/wrap("name", ...)`` anywhere under pertgnn_tpu/ whose name argument
+resolves statically — a string constant, a constant-armed conditional
+expression, or a local variable assigned only string constants in the
+same function (the ``counter = "serve.shed"; ... bus.counter(counter)``
+pattern the admission fast paths use). A name argument that does NOT
+resolve (f-string, concatenation over runtime values) is itself flagged:
+dynamic names are invisible to this check and to anyone grepping the
+docs, so they need either a literal spelling or an explicit pragma
+(``# graftlint: allow-telemetry-drift``) explaining where the names are
+enumerated. ``event`` names are out of scope (meta events carry
+free-form payloads; the tables document the numeric schema).
+
+What counts as DOCUMENTED: a backticked dotted name in the first cell
+of any table row in docs/OBSERVABILITY.md. Relative rows (`` `.h2d` ``
+after `` `train.stage_epoch.pack` ``) expand against the previous full
+name. The reverse check accepts a documented name when the code carries
+the full name as a literal anywhere, or its final dotted segment as a
+literal/dict key (names assembled from schema dicts:
+``serve.roofline.mfu_pct`` is built by utils/flops.publish_attribution
+from the attribution row's keys).
+
+``python -m tools.graftlint telemetry --emit-table`` doubles as a docs
+generator: it rewrites the metric tables in place — dropping rows whose
+names no longer exist anywhere in the source and appending rows for
+undocumented emissions (kind inferred from the call, note left as a
+placeholder) — so the observability contract can be re-synced
+mechanically instead of rotting.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import resolve_str_values
+
+RULE = "telemetry-drift"
+
+DOC = "docs/OBSERVABILITY.md"
+_BUS_METHODS = {"counter", "gauge", "histogram", "span", "wrap"}
+# receivers that are NOT the telemetry bus but share method names
+# (none today — time.perf_counter is an attr of a different name).
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_./]*$")
+
+
+def _method_calls(tree: ast.AST):
+    """(call, method, name_expr, enclosing_function_stack) for every
+    bus-method call — innermost enclosing function LAST; the whole
+    stack matters because a forwarded name param may belong to an outer
+    def (the bus's wrap() closes over `name` inside its nested
+    `timed`)."""
+
+    def name_expr(call: ast.Call) -> ast.AST | None:
+        """The metric-name argument: positional first, or the `name=`
+        keyword (bus methods declare `name` as a regular param, so
+        keyword spelling is legal and must not be invisible)."""
+        if call.args:
+            return call.args[0]
+        for kw in call.keywords:
+            if kw.arg == "name":
+                return kw.value
+        return None
+
+    def visit(node, fns):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            fns = fns + [node]
+        for child in ast.iter_child_nodes(node):
+            visit(child, fns)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BUS_METHODS):
+            arg = name_expr(node)
+            if arg is not None:
+                calls.append((node, node.func.attr, arg, fns))
+
+    calls: list[tuple[ast.Call, str, ast.AST, list[ast.AST]]] = []
+    visit(tree, [])
+    return calls
+
+
+def _forwards_param(arg: ast.AST, fns: list[ast.AST]) -> bool:
+    if not isinstance(arg, ast.Name):
+        return False
+    for fn in fns:
+        a = fn.args
+        if arg.id in {x.arg for x in a.posonlyargs + a.args
+                      + a.kwonlyargs}:
+            return True
+    return False
+
+
+def collect_emissions(ctx) -> tuple[dict[str, list[tuple[str, int, str]]],
+                                    list[Violation]]:
+    """name -> [(path, line, kind)] over the package, plus violations
+    for dynamic (unresolvable) names."""
+    emitted: dict[str, list[tuple[str, int, str]]] = {}
+    dynamic: list[Violation] = []
+    for rel in ctx.files_under("pertgnn_tpu"):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for call, method, arg, fns in _method_calls(tree):
+            # forwarding plumbing (the bus's own span()/wrap(), the
+            # module-level telemetry.span helper): the name argument is
+            # a PARAMETER of an enclosing function passed through —
+            # not an emission site; the real call sites are checked
+            if _forwards_param(arg, fns):
+                continue
+            names = resolve_str_values(arg, fns[-1] if fns else None)
+            if names is None:
+                # key carries the enclosing function so baselining one
+                # dynamic site cannot silently accept a future one
+                # elsewhere in the file (same-function repeats sharing
+                # an entry is the deliberate granularity)
+                fn_name = next(
+                    (f.name for f in reversed(fns)
+                     if isinstance(f, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))),
+                    "<module>")
+                dynamic.append(Violation(
+                    rule=RULE, path=rel, line=call.lineno,
+                    message=(f"dynamic {method}() metric name — not "
+                             f"statically resolvable, so neither this "
+                             f"check nor {DOC} can see it; spell the "
+                             f"name(s) as literals or pragma with a "
+                             f"pointer to where they are enumerated"),
+                    key=f"dynamic-name@{method}:{fn_name}"))
+                continue
+            for name in names:
+                if _NAME_RE.match(name):
+                    emitted.setdefault(name, []).append(
+                        (rel, call.lineno, method))
+                else:
+                    # a constant name the schema regex rejects would be
+                    # silently invisible to the contract check — the
+                    # same hole dynamic names are flagged for
+                    dynamic.append(Violation(
+                        rule=RULE, path=rel, line=call.lineno,
+                        message=(f"metric name {name!r} does not match "
+                                 f"the dotted lower_snake schema "
+                                 f"({_NAME_RE.pattern}) — rename it so "
+                                 f"the {DOC} contract check can see "
+                                 f"it"),
+                        key=f"bad-name:{name}"))
+    return emitted, dynamic
+
+
+_ROW_RE = re.compile(r"^\|\s*(?P<cell>[^|]*)\|")
+_TICK_RE = re.compile(r"`([^`]+)`")
+
+
+def _expand_tokens(cell: str) -> list[tuple[str, str]]:
+    """(full_name, raw_backticked_token) pairs in one table cell,
+    expanding `.suffix` relative tokens against the previous full
+    name. The raw token is kept so emit_table can surgically remove a
+    dead name from a multi-name row."""
+    names: list[tuple[str, str]] = []
+    prev_full: str | None = None
+    for raw in _TICK_RE.findall(cell):
+        tok = raw.strip()
+        if tok.startswith("."):
+            if prev_full is None:
+                continue
+            suffix = tok
+            nseg = suffix.count(".")
+            base = prev_full.rsplit(".", nseg)[0]
+            tok = base + suffix
+        if _NAME_RE.match(tok) and "." in tok:
+            names.append((tok, raw))
+            prev_full = tok
+    return names
+
+
+def parse_doc_tables(lines: list[str]
+                     ) -> list[tuple[int, list[tuple[str, str]]]]:
+    """(line_number_1based, [(name, raw_token)]) per metric-table row.
+    Only tables whose header is `| name | kind | ... |` count — prose
+    tables (the JSONL field schema) do not document metric names."""
+    out: list[tuple[int, list[tuple[str, str]]]] = []
+    in_metric_table = False
+    for i, line in enumerate(lines):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_metric_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if cells and cells[0].lower() == "name":
+            in_metric_table = True
+            continue
+        if cells and set(cells[0]) <= {"-", " ", ":"}:
+            continue
+        if not in_metric_table:
+            continue
+        names = _expand_tokens(cells[0])
+        if names:
+            out.append((i + 1, names))
+    return out
+
+
+def _package_literals(ctx) -> set[str]:
+    """Every string constant in the package source, plus dict-literal
+    keys — the reverse check's evidence that a documented name (or its
+    final segment) still exists somewhere in code."""
+    out: set[str] = set()
+    for rel in ctx.files_under("pertgnn_tpu"):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             str):
+                out.add(node.value)
+    return out
+
+
+def run(ctx) -> list[Violation]:
+    emitted, violations = collect_emissions(ctx)
+    try:
+        doc_lines = ctx.lines(DOC)
+    except OSError:
+        if not emitted and not violations:
+            return []  # nothing emitted, nothing to document
+        return violations + [Violation(
+            rule=RULE, path=DOC, line=0,
+            message="docs/OBSERVABILITY.md is missing — the telemetry "
+                    "contract has nowhere to live", key="missing-doc")]
+    rows = parse_doc_tables(doc_lines)
+    documented: dict[str, int] = {}
+    for line_no, pairs in rows:
+        for n, _raw in pairs:
+            documented.setdefault(n, line_no)
+
+    # forward: emitted but undocumented
+    for name in sorted(emitted):
+        if name in documented:
+            continue
+        path, line, kind = emitted[name][0]
+        violations.append(Violation(
+            rule=RULE, path=path, line=line,
+            message=(f"telemetry {kind} `{name}` is emitted but has no "
+                     f"row in {DOC} — document it (or run `python -m "
+                     f"tools.graftlint telemetry --emit-table`)"),
+            key=f"undocumented:{name}"))
+
+    # reverse: documented but gone from the source
+    literals = _package_literals(ctx)
+    for name, line_no in sorted(documented.items()):
+        last_seg = name.rsplit(".", 1)[-1]
+        if name in emitted or name in literals or last_seg in literals:
+            continue
+        violations.append(Violation(
+            rule=RULE, path=DOC, line=line_no,
+            message=(f"documented metric `{name}` no longer appears "
+                     f"anywhere in pertgnn_tpu/ — drop the row or "
+                     f"restore the emission"),
+            key=f"stale-doc:{name}"))
+    return violations
+
+
+# -- docs generator (`python -m tools.graftlint telemetry --emit-table`)
+
+
+def _strip_dead_tokens(line: str, raws: list[str]) -> str:
+    """Remove dead backticked name tokens (plus an adjacent `/` or `,`
+    separator and any `(trace)`-style annotation) from a table row's
+    FIRST cell, leaving the rest of the row untouched."""
+    parts = line.split("|")
+    if len(parts) < 2:
+        return line
+    cell = parts[1]
+    ann = r"(?:\s*\([a-z ]+\))?"
+    for raw in raws:
+        tok = re.escape(f"`{raw}`")
+        for pat in (tok + ann + r"\s*[/,]\s*",
+                    r"\s*[/,]\s*" + tok + ann,
+                    tok + ann):
+            new = re.sub(pat, " ", cell, count=1)
+            if new != cell:
+                cell = new
+                break
+    parts[1] = " " + cell.strip() + " "
+    return "|".join(parts)
+
+
+def emit_table(ctx) -> tuple[str, dict]:
+    """Regenerated docs/OBSERVABILITY.md content + a summary dict.
+
+    Conservative rewrite: hand-written rows and prose are preserved;
+    rows whose every name vanished from the source are dropped; new
+    emissions are appended to the metric table sharing the longest
+    dotted-prefix with them (kind inferred from the emitting call, note
+    a placeholder for a human to fill)."""
+    emitted, _ = collect_emissions(ctx)
+    literals = _package_literals(ctx)
+    lines = ctx.lines(DOC)
+    rows = {ln: pairs for ln, pairs in parse_doc_tables(lines)}
+    documented = {n for pairs in rows.values() for n, _raw in pairs}
+
+    def alive(name: str) -> bool:
+        seg = name.rsplit(".", 1)[-1]
+        return name in emitted or name in literals or seg in literals
+
+    dropped: list[str] = []
+    out: list[str] = []
+    table_rows = sorted(rows.items())
+    drop_lines = set()
+    # partially-dead multi-name rows: strip only the dead tokens so the
+    # stale-doc remediation the run() violation recommends actually
+    # converges (a row is dropped whole only when EVERY name is dead)
+    partial: dict[int, list[str]] = {}
+    for ln, pairs in table_rows:
+        dead = [(n, raw) for n, raw in pairs if not alive(n)]
+        if not dead:
+            continue
+        if len(dead) == len(pairs):
+            drop_lines.add(ln)
+        else:
+            partial[ln] = [raw for _n, raw in dead]
+        dropped.extend(n for n, _raw in dead)
+
+    missing = [n for n in sorted(emitted) if n not in documented]
+    # best insertion table per missing name: the table containing the
+    # documented name with the longest shared dotted prefix
+    def prefix_len(a: str, b: str) -> int:
+        pa, pb = a.split("."), b.split(".")
+        n = 0
+        while n < len(pa) and n < len(pb) and pa[n] == pb[n]:
+            n += 1
+        return n
+
+    inserts: dict[int, list[str]] = {}
+    leftovers: list[str] = []
+    for name in missing:
+        best_ln, best_score = None, 0
+        for ln, pairs in table_rows:
+            if ln in drop_lines:
+                continue
+            score = max((prefix_len(name, n) for n, _raw in pairs),
+                        default=0)
+            # later rows win ties so appends land at a table's end
+            if score > best_score or (score == best_score and score
+                                      and best_ln is not None
+                                      and ln > best_ln):
+                best_ln, best_score = ln, score
+        if best_ln is None or best_score == 0:
+            leftovers.append(name)
+            continue
+        kind = emitted[name][0][2]
+        kind = {"wrap": "span"}.get(kind, kind)
+        inserts.setdefault(best_ln, []).append(
+            f"| `{name}` | {kind} | _auto-added by `graftlint telemetry "
+            f"--emit-table`; describe me_ |")
+
+    for i, line in enumerate(lines):
+        ln = i + 1
+        if ln in drop_lines:
+            continue
+        if ln in partial:
+            line = _strip_dead_tokens(line, partial[ln])
+        out.append(line)
+        for row in inserts.get(ln, []):
+            out.append(row)
+    summary = {"dropped_rows": dropped,
+               # only names that actually landed in a table — an
+               # unplaced name is reported as such, never as "added"
+               "added": [n for n in missing if n not in leftovers],
+               "unplaced": leftovers}
+    return "\n".join(out) + "\n", summary

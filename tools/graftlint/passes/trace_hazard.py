@@ -1,0 +1,211 @@
+"""graftlint pass — trace-hazard: host synchronization and Python side
+effects inside functions that are traced into compiled programs
+(jitted / pjit'd / pallas_call'd / custom_vjp'd, plus flax Module
+``__call__``s — resolved statically by passes/_ast_util.traced_functions
+with a same-file call fixpoint). Bug-class provenance: the reference
+codebase's per-batch ``float()`` metric syncs were the original perf
+sin the train loop exists to kill (train/loop.py docstring), and the
+PR-5/6 reviews hand-checked every new kernel and overlap path for
+accidental ``.item()`` / ``np.asarray`` syncs and trace-time clocks.
+
+Hazards flagged inside a traced body:
+
+- H1 ``x.item()`` — a device->host sync per call;
+- H2 ``np.<fn>(...)`` on a non-static argument — numpy forces
+  concretization of a tracer (``jnp`` is what belongs inside traces);
+  also ``jax.device_get`` and ``.block_until_ready()``;
+- H3 ``bool()/float()/int()`` on a non-static argument — implicit
+  concretization (a TracerBoolConversionError at best, a silent sync
+  on concrete re-execution paths at worst);
+- H4 an ``if``/``while`` test that CALLS into ``jnp``/``jax.numpy`` —
+  Python control flow on a traced value (use ``lax.cond``/``select``);
+- H5 trace-time side effects that silently desynchronize from
+  execution: ``print`` and wall-clock reads (``time.time`` /
+  ``perf_counter``) run ONCE at trace time, not per step. (Logging
+  calls are deliberately exempt: the kernel-fallback pattern logs+counts
+  once per compiled program ON PURPOSE — docs/OBSERVABILITY.md
+  `model.kernel_fallback`.)
+
+"Static" arguments that defuse H2/H3: constants, ``x.shape`` /
+``x.ndim`` / ``.dtype`` expressions (shapes are compile-time in jax),
+``len(...)``, ``math.*`` and ``np.*`` math over static values,
+config-rooted attribute chains, parameters KNOWN static (partial-bound
+keywords of a pallas kernel, custom_vjp nondiff args — resolved by
+_ast_util.traced_functions), and free variables (a name the traced
+function neither takes nor assigns is a closure/global — a host value
+at trace time). False positives carry the line pragma
+``# graftlint: allow-trace-hazard`` with a why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import attr_chain, traced_functions
+
+RULE = "trace-hazard"
+
+_CONFIG_ROOTS = {"cfg", "config", "self"}
+_STATIC_TAILS = {"shape", "ndim", "dtype", "size"}
+_NP_ROOTS = {"np", "onp", "numpy"}
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Names BOUND inside the traced function (params of it and its
+    nested defs/lambdas/comprehensions, assignment targets, loop vars):
+    potentially tracers. Anything else is free = host-static."""
+    bound: set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            a = n.args
+            for x in (a.posonlyargs + a.args + a.kwonlyargs
+                      + ([a.vararg] if a.vararg else [])
+                      + ([a.kwarg] if a.kwarg else [])):
+                bound.add(x.arg)
+        elif isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (n.targets if isinstance(n, ast.Assign)
+                       else [n.target])
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        bound.add(sub.id)
+        elif isinstance(n, ast.For):
+            for sub in ast.walk(n.target):
+                if isinstance(sub, ast.Name):
+                    bound.add(sub.id)
+        elif isinstance(n, ast.comprehension):
+            for sub in ast.walk(n.target):
+                if isinstance(sub, ast.Name):
+                    bound.add(sub.id)
+        elif isinstance(n, ast.withitem) and n.optional_vars:
+            for sub in ast.walk(n.optional_vars):
+                if isinstance(sub, ast.Name):
+                    bound.add(sub.id)
+    return bound
+
+
+class _Env:
+    def __init__(self, static: set[str], bound: set[str]):
+        self.static = static
+        self.bound = bound
+
+
+def _is_static(node: ast.AST, env: _Env) -> bool:
+    """Whether an expression is knowably host-static at trace time."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in env.static or node.id not in env.bound
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_static(e, env) for e in node.elts)
+    if isinstance(node, (ast.UnaryOp,)):
+        return _is_static(node.operand, env)
+    if isinstance(node, ast.BinOp):
+        return _is_static(node.left, env) and _is_static(node.right, env)
+    if isinstance(node, ast.Subscript):
+        return _is_static(node.value, env)
+    if isinstance(node, ast.Call):
+        ch = attr_chain(node.func) or []
+        if ch and (ch[0] == "math" or ch[0] in _NP_ROOTS
+                   or ch[-1] == "len"):
+            return all(_is_static(a, env) for a in node.args)
+        return False
+    ch = attr_chain(node)
+    if ch:
+        if ch[-1] in _STATIC_TAILS:
+            return True
+        if ch[0] in _CONFIG_ROOTS and len(ch) >= 2:
+            return True
+        if ch[0] in env.static or (ch[0] not in env.bound
+                                   and ch[0] != "self"):
+            return True
+    return False
+
+
+def _hazards(fn: ast.AST, static_params: set[str]):
+    env = _Env(static=set(static_params), bound=_bound_names(fn))
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            ch = attr_chain(node.func) or []
+            # attr-name checks, not chains: `.item()` on a CALL result
+            # (x.sum().item()) has no resolvable chain but is the same
+            # sync
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute) else "")
+            if attr == "item" and not node.args:
+                out.append((node.lineno,
+                            "H1 `.item()` inside a traced function — a "
+                            "device->host sync per call; keep metrics "
+                            "on device and sync once per log interval"))
+            elif attr == "block_until_ready":
+                out.append((node.lineno,
+                            "H2 `.block_until_ready()` inside a traced "
+                            "function — host sync"))
+            elif (len(ch) == 2 and ch[0] in _NP_ROOTS
+                  and node.args
+                  and not all(_is_static(a, env) for a in node.args)):
+                out.append((node.lineno,
+                            f"H2 `{'.'.join(ch)}(...)` on a non-static "
+                            f"argument inside a traced function — numpy "
+                            f"concretizes tracers; use jnp"))
+            elif ch in (["jax", "device_get"],):
+                out.append((node.lineno,
+                            "H2 `jax.device_get` inside a traced "
+                            "function — host transfer"))
+            elif (isinstance(node.func, ast.Name)
+                  and node.func.id in ("bool", "float", "int")
+                  and node.args
+                  and not all(_is_static(a, env) for a in node.args)):
+                out.append((node.lineno,
+                            f"H3 `{node.func.id}(...)` on a non-static "
+                            f"argument inside a traced function — "
+                            f"implicit tracer concretization"))
+            elif isinstance(node.func, ast.Name) and node.func.id == "print":
+                out.append((node.lineno,
+                            "H5 `print` inside a traced function — runs "
+                            "once at trace time, not per step (use "
+                            "jax.debug.print for runtime values)"))
+            elif ch in (["time", "time"], ["time", "perf_counter"],
+                        ["time", "monotonic"]):
+                out.append((node.lineno,
+                            "H5 wall-clock read inside a traced "
+                            "function — evaluates ONCE at trace time "
+                            "and is baked into the program"))
+        elif isinstance(node, (ast.If, ast.While)):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Call):
+                    sch = attr_chain(sub.func) or []
+                    if sch and sch[0] in ("jnp", "jax") and len(sch) >= 2:
+                        out.append((
+                            node.lineno,
+                            "H4 Python control flow on a traced value "
+                            "(`if`/`while` over a jnp expression) — "
+                            "this concretizes the tracer or silently "
+                            "retraces; use lax.cond / jnp.where"))
+                        break
+    return out
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+    for rel in ctx.files_under("pertgnn_tpu"):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        seen: set[tuple[int, str]] = set()
+        for fn, static_params in traced_functions(tree).items():
+            fn_name = getattr(fn, "name", "<lambda>")
+            for line, msg in _hazards(fn, static_params):
+                if (line, msg) in seen:
+                    continue  # nested traced fns overlap lexically
+                seen.add((line, msg))
+                # baseline key is LINE-INDEPENDENT (driver contract:
+                # keys survive drift): hazard class + traced function;
+                # same-class repeats in one function share the entry
+                out.append(Violation(
+                    rule=RULE, path=rel, line=line, message=msg,
+                    key=f"{msg.split(' ', 1)[0]}@{fn_name}"))
+    return out
