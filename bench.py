@@ -911,12 +911,82 @@ def _graftlint_refusal() -> list[str]:
     return [str(v) for v in result.new]
 
 
+def _graftaudit_refusal() -> list[str]:
+    """New graftaudit violations over the stack's traced programs —
+    nonempty means --gate must refuse the capture, exactly like the
+    graftlint refusal: numbers captured from a tree whose compiled
+    programs fail the IR audit (padding taint, silent f32 upcasts,
+    lost donation, host callbacks) are not a valid perf witness.
+    Runs in-process when this process already holds a multi-device CPU
+    jax (the tier-1 path — the audit's toy programs are then built once
+    per process and cached), and in a subprocess otherwise so the
+    audit's CPU-backend tracing never contends with the bench process's
+    own (possibly TPU) jax runtime.
+    BENCH_GATE_SKIP_AUDIT=1 is the explicit, greppable escape hatch."""
+    import subprocess
+    import sys
+
+    if os.environ.get("BENCH_GATE_SKIP_AUDIT", "") not in ("", "0"):
+        print("WARNING: BENCH_GATE_SKIP_AUDIT set — gating WITHOUT the "
+              "graftaudit check", file=sys.stderr)
+        return []
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    if "jax" in sys.modules:
+        import jax
+
+        try:
+            cpu_ready = (jax.default_backend() == "cpu"
+                         and len(jax.devices()) >= 2)
+        except RuntimeError:
+            cpu_ready = False
+        if cpu_ready:
+            try:
+                from tools.graftaudit import run_repo as audit_repo
+                result = audit_repo()
+            except Exception as e:
+                print(f"WARNING: graftaudit could not run "
+                      f"({type(e).__name__}: {e}); refusing the gate",
+                      file=sys.stderr)
+                return [f"graftaudit could not run: "
+                        f"{type(e).__name__}: {e}"]
+            return [str(v) for v in result.new]
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the audit CLI forces CPU itself
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftaudit", "--json"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=600)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        # a broken audit harness must fail the gate LOUDLY, not pass it
+        print(f"WARNING: graftaudit could not run "
+              f"({type(e).__name__}: {e}); refusing the gate",
+              file=sys.stderr)
+        return [f"graftaudit could not run: {type(e).__name__}: {e}"]
+    if proc.returncode == 0:
+        return []
+    try:
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
+        return [f"{v['path']}: [{v['rule']}] {v['message']}"
+                for v in doc.get("violations", [])] or [
+                    f"graftaudit exited {proc.returncode} with no "
+                    f"violation list"]
+    except (json.JSONDecodeError, IndexError, KeyError, TypeError):
+        tail = (proc.stderr or proc.stdout or "").strip()[-500:]
+        return [f"graftaudit exited {proc.returncode}: {tail}"]
+
+
 def gate_main(argv: list[str]) -> int:
     """`bench.py --gate [result.json]`: exit 1 when a finished run's
     headline throughput fell beyond the history's recorded window
     spread — or when the working tree fails `python -m tools.graftlint`
-    (a capture from a lint-failing tree is refused outright, same
-    pattern as the kernel-fallback refusal). The result record comes
+    or `python -m tools.graftaudit` (a capture from a tree that fails
+    static analysis — source-level lint or traced-program audit — is
+    refused outright, same pattern as the kernel-fallback refusal;
+    BENCH_GATE_SKIP_LINT=1 / BENCH_GATE_SKIP_AUDIT=1 are the explicit
+    hatches). The result record comes
     from the given path (a saved bench stdout line, or a BENCH_r-style
     wrapper whose `parsed` field holds it) or from stdin when piped."""
     import sys
@@ -953,6 +1023,18 @@ def gate_main(argv: list[str]) -> int:
                         f"a valid perf witness (fix or baseline them: "
                         f"python -m tools.graftlint)"),
             "graftlint": lint[:20],
+        }}))
+        return 1
+    audit = _graftaudit_refusal()
+    if audit:
+        print(json.dumps({"gate": {
+            "verdict": (f"FAIL: graftaudit reports {len(audit)} "
+                        f"violation(s) over this tree's traced programs "
+                        f"— a capture from a tree whose compiled "
+                        f"programs fail the IR audit is not a valid "
+                        f"perf witness (fix them: python -m "
+                        f"tools.graftaudit)"),
+            "graftaudit": audit[:20],
         }}))
         return 1
     ok, detail = gate_check(result, _history_records())

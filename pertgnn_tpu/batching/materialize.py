@@ -24,6 +24,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # 0.4.x keeps it in jax.experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from pertgnn_tpu.batching.arena import FeatureArena, IndexBatch, MixtureArena
 from pertgnn_tpu.batching.pack import PackedBatch
 
@@ -204,7 +209,7 @@ def expand_compact_sharded(dev: DeviceArenas, cb, max_nodes: int,
     dev_specs = type(dev)(*([P()] * len(dev)))
     cb_specs = jax.tree.map(lambda _: P(axis), cb)
     out_specs = IndexBatch(*([P(axis)] * len(IndexBatch._fields)))
-    return jax.shard_map(local, mesh=mesh,
+    return _shard_map(local, mesh=mesh,
                          in_specs=(dev_specs, cb_specs),
                          out_specs=out_specs)(dev, cb)
 

@@ -1,0 +1,37 @@
+"""Console-script launcher for graftaudit (docs/LINTS.md).
+
+Same pattern as graftlint_cli.py: graftaudit traces the programs of a
+SOURCE TREE, so it only makes sense where one exists — an editable
+(in-repo) install, where this package sits inside the repo checkout
+and `tools/graftaudit/` is its sibling. The launcher lives inside
+`pertgnn_tpu` so the wheel never ships a generic top-level `tools`
+package (namespace squatting), while the `graftaudit` entry point
+still works in the install mode where the tool is usable — and fails
+with a clear message, not a ModuleNotFoundError, everywhere else.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(repo, "tools", "graftaudit")):
+        print(
+            "graftaudit: no tools/graftaudit next to this package — the "
+            "auditor traces a repo working tree's programs, which only "
+            "an editable (in-repo) install has. From a checkout, run "
+            "`python -m tools.graftaudit` (docs/LINTS.md).",
+            file=sys.stderr)
+        return 2
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.graftaudit.cli import main as graftaudit_main
+
+    return graftaudit_main(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

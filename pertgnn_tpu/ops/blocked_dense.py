@@ -63,22 +63,30 @@ def blocked_dense_edge_attention(q: jax.Array, k_e: jax.Array,
 
     q: (N, H, C); k_e, v_e: (E, H, C) edge-level (source-gathered +
     edge-projected); receivers (E,) int; edge_mask (E,) bool. Returns
-    (N, H*C) float32 — the same contract as `segment_edge_attention`
-    (the single source of truth for the math) and the fused Pallas
-    kernel, asserted by tests/test_pallas_attention.py parity and
-    benchmarks/kernel_bench.py.
+    (N, H*C) in the COMPUTE dtype — f32 for f32 inputs (the same
+    contract as `segment_edge_attention`, the single source of truth
+    for the math, asserted by tests/test_pallas_attention.py parity
+    and benchmarks/kernel_bench.py), bf16 for bf16 inputs: the
+    quantized serve tiers run bf16 GEMMs through the MXU, and
+    force-casting here would silently serve f32 matmuls at bf16's
+    advertised cost (caught by graftaudit's dtype-flow pass — the
+    first repo-wide run found exactly that).
     """
     n, heads, head_dim = q.shape
     e = k_e.shape[0]
     n_pad = _pad_up(n, block_n)
     e_pad = _pad_up(e, block_e)
+    # bf16 stays bf16 (MXU-native); everything else computes f32 as
+    # before — the segment path makes the same dtype choice via the
+    # layer's Dense(dtype=...) projections
+    cdt = q.dtype if q.dtype == jnp.bfloat16 else jnp.float32
 
-    qf = jnp.zeros((n_pad, heads, head_dim), jnp.float32).at[:n].set(
-        q.astype(jnp.float32))
-    kf = jnp.zeros((e_pad, heads, head_dim), jnp.float32).at[:e].set(
-        k_e.astype(jnp.float32))
-    vf = jnp.zeros((e_pad, heads, head_dim), jnp.float32).at[:e].set(
-        v_e.astype(jnp.float32))
+    qf = jnp.zeros((n_pad, heads, head_dim), cdt).at[:n].set(
+        q.astype(cdt))
+    kf = jnp.zeros((e_pad, heads, head_dim), cdt).at[:e].set(
+        k_e.astype(cdt))
+    vf = jnp.zeros((e_pad, heads, head_dim), cdt).at[:e].set(
+        v_e.astype(cdt))
     # masked/padding edges get receiver -1: no node id (0..n_pad-1) can
     # match, so they are unobservable by construction
     rcv = jnp.full((e_pad,), -1, jnp.int32).at[:e].set(
@@ -86,7 +94,9 @@ def blocked_dense_edge_attention(q: jax.Array, k_e: jax.Array,
     incidence = (jnp.arange(n_pad, dtype=jnp.int32)[:, None]
                  == rcv[None, :])  # (N_pad, E_pad)
 
-    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+    # in cdt: an f32 scale would re-promote the whole bf16 chain (and
+    # with it the second GEMM) right after the bf16 score GEMM
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, cdt))
     # the dense recast: scores are ONE batched GEMM against every edge,
     # masked by incidence — gather/scatter becomes matmul + where
     scores = jnp.einsum("nhc,ehc->hne", qf, kf,

@@ -17,6 +17,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # 0.4.x keeps it in jax.experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from pertgnn_tpu.ops.segment import segment_max, segment_sum
 from pertgnn_tpu.parallel.mesh import DATA_AXIS
 
@@ -55,7 +60,7 @@ def sharded_edge_attention(q, k, v, e, senders, receivers, edge_mask,
         return (num.reshape(num_nodes, H, C)
                 / den[..., None]).reshape(num_nodes, H * C)
 
-    return jax.shard_map(
+    return _shard_map(
         local, mesh=mesh,
         in_specs=(P(), P(), P(), P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(),

@@ -664,3 +664,64 @@ def test_bench_gate_skip_env_is_loud(monkeypatch, capsys):
     monkeypatch.setenv("BENCH_GATE_SKIP_LINT", "1")
     assert bench._graftlint_refusal() == []
     assert "WITHOUT the graftlint check" in capsys.readouterr().err
+
+
+# --- --changed-only (git-diff-scoped pre-commit runs) ---------------------
+
+
+def test_pass_scopes_declared():
+    """Every pass declares whether it is sound on a file subset — an
+    undeclared pass would silently default to file scope and a future
+    repo-contract pass could fabricate drift under --changed-only."""
+    from tools.graftlint.passes import registry
+
+    for name, mod in registry().items():
+        assert getattr(mod, "PASS_SCOPE", None) in ("file", "repo"), name
+
+
+def _git(repo, *args):
+    import subprocess
+
+    subprocess.run(["git", *args], cwd=repo, check=True,
+                   capture_output=True,
+                   env={**os.environ,
+                        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                        "GIT_COMMITTER_NAME": "t",
+                        "GIT_COMMITTER_EMAIL": "t@t"})
+
+
+def test_changed_only_scopes_to_the_diff(tmp_path, capsys):
+    """Committed debt stays invisible; the CHANGED file's violation is
+    caught — exactly the pre-commit contract."""
+    bare = "try:\n    pass\nexcept:\n    pass\n"
+    repo = _mini_repo(tmp_path, {"pertgnn_tpu/old.py": bare})
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    (tmp_path / "pertgnn_tpu" / "new.py").write_text(bare)
+    # full run sees both files
+    rc = cli_main(["--root", repo, "--no-baseline", "--json"])
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and len(doc["violations"]) == 2
+    # --changed-only sees only the untracked file
+    rc = cli_main(["--root", repo, "--no-baseline", "--json",
+                   "--changed-only"])
+    out = capsys.readouterr()
+    doc = json.loads(out.out.strip().splitlines()[-1])
+    assert rc == 1
+    assert [v["path"] for v in doc["violations"]] == ["pertgnn_tpu/new.py"]
+    assert "skips repo-contract" in out.err
+
+
+def test_changed_only_refuses_explicit_repo_pass(capsys):
+    rc = cli_main(["telemetry", "--changed-only", "--no-baseline"])
+    assert rc == 2
+    assert "cannot run under --changed-only" in capsys.readouterr().err
+
+
+def test_changed_only_on_live_tree_is_clean_and_fast(capsys):
+    t0 = time.perf_counter()
+    rc = cli_main(["--changed-only", "--no-baseline"])
+    assert rc == 0
+    assert time.perf_counter() - t0 < 30
+    capsys.readouterr()

@@ -222,6 +222,60 @@ class TestExecutableCache:
         assert len(stats["buckets"]) == len(engine.ladder)
 
 
+class TestPaddingInvarianceMatrix:
+    """Padding invariance across EVERY attention_impl x serve_dtype
+    combination graftaudit enumerates (ISSUE 10): the static
+    padding-taint pass proves lane-independence for segment and
+    blocked_dense and stops at the pallas_call boundary
+    (docs/LINTS.md), so this dynamic grid is the matching coverage —
+    one bit-identical pad check per compiled serve program family, on
+    CPU (Pallas in interpret mode). Plain "pallas" rides the `slow`
+    marker like test_model's grid: its interpret-mode kernels are
+    already parity-pinned at the kernel level in tier-1."""
+
+    IMPLS = (pytest.param("pallas", marks=pytest.mark.slow),
+             "segment", "pallas_fused", "blocked_dense")
+
+    @pytest.mark.parametrize("serve_dtype", ["f32", "bf16", "int8"])
+    @pytest.mark.parametrize("impl", IMPLS)
+    def test_bucket_padding_bit_identical(self, served, impl,
+                                          serve_dtype):
+        import dataclasses
+
+        import jax
+
+        ds, cfg, state, _engine = served
+        c = dataclasses.replace(
+            cfg,
+            serve=dataclasses.replace(cfg.serve,
+                                      serve_dtype=serve_dtype),
+            model=dataclasses.replace(cfg.model, attention_impl=impl))
+        engine = InferenceEngine.from_dataset(ds, c, state)
+        step = jax.jit(engine._step)
+        s = ds.splits["test"]
+        entries, buckets = s.entry_ids[:1], s.ts_buckets[:1]
+        n = ds.mixtures[int(entries[0])].num_nodes
+        e_tot = ds.mixtures[int(entries[0])].num_edges
+        exact = BatchBudget(max_graphs=1, max_nodes=n, max_edges=e_tot)
+        outs = []
+        for budget in [exact, *engine.ladder[:2]]:
+            if (n > budget.max_nodes or e_tot > budget.max_edges
+                    or budget.max_graphs < 1):
+                continue
+            batch = pack_single(ds.mixtures, entries, buckets, budget,
+                                ds.lookup)
+            outs.append((budget,
+                         np.asarray(step(engine._variables, batch))[:1]))
+        assert len(outs) >= 2  # exact + at least one rung
+        ref_budget, ref = outs[0]
+        assert ref_budget is exact
+        for budget, out in outs[1:]:
+            np.testing.assert_array_equal(
+                out, ref,
+                err_msg=(f"{impl}/{serve_dtype}: padding to {budget} "
+                         f"changed the prediction"))
+
+
 class TestQuantizedServeTier:
     """ServeConfig.serve_dtype (ISSUE 6): the bf16/int8 engines serve
     through the same per-rung AOT path with predictions close to the f32

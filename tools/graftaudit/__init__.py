@@ -1,0 +1,29 @@
+"""graftaudit — static analysis over TRACED programs (jaxpr/StableHLO).
+
+graftlint (tools/graftlint/) reads source text; this suite reads the IR
+of the stack's real compiled programs — the ground truth for the three
+invariants the dynamic tests can only spot-check: padded lanes never
+influence real outputs, the quantized serve tiers never silently upcast
+their matmuls, and compiled programs never smuggle in host syncs. The
+driver enumerates the programs the stack actually runs (every serve
+ladder rung x serve_dtype x attention_impl, the train/eval/init
+programs, the sharded variants) at a toy config on CPU, lowers each to
+its jaxpr, and runs five IR passes (docs/LINTS.md):
+
+- padding-taint   dataflow proof of pad-lane independence
+- dtype-flow      no f32 matmuls in bf16/int8 serve programs; int8
+                  leaves enter as int8 with exactly one dequantize
+- donation        train-step state buffers are donated (StableHLO)
+- host-interop    zero callbacks/infeed/outfeed in serve+train programs
+- collective-audit collective axis names match the mesh spec; no
+                  collectives in single-device programs
+
+Same contract as graftlint: exit 0 clean / 1 new violations / 2 usage
+error, JSON + human output, an in-tree baseline file, and (instead of
+per-line pragmas — traced IR has no comment lines) a per-program
+ALLOWLIST in driver.py whose entries carry their justification.
+"""
+
+from tools.graftaudit.driver import run_passes, run_repo
+
+__all__ = ["run_passes", "run_repo"]

@@ -1,0 +1,6 @@
+"""``python -m tools.graftaudit`` — see tools/graftaudit/cli.py."""
+
+from tools.graftaudit.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,101 @@
+"""Shared jaxpr utilities for the graftaudit passes: recursive eqn
+walking across every call-like primitive, a mark-and-sweep DCE (traced
+jaxprs keep dead eqns — e.g. the serve program's unused local head —
+and a pass must not report on code XLA will delete), and source-line
+extraction so IR findings point back at pertgnn_tpu source."""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+# Primitives whose body the eqn walk does NOT descend into by default:
+# Pallas kernel bodies are audited at the call boundary (docs/LINTS.md
+# "known limits") — their internal f32 accumulators and device-side
+# debug prints are kernel implementation details, not program contract.
+KERNEL_BOUNDARY = frozenset({"pallas_call"})
+
+
+def sub_jaxprs(params: dict):
+    """Every jaxpr nested in an eqn's params — handles the bare Jaxpr,
+    ClosedJaxpr, and tuple-of-branches (cond) spellings."""
+    for v in params.values():
+        for j in _as_jaxprs(v):
+            yield j
+
+
+def _as_jaxprs(v):
+    if hasattr(v, "eqns"):
+        return [v]
+    if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+        return [v.jaxpr]
+    if isinstance(v, (tuple, list)):
+        out = []
+        for w in v:
+            out.extend(_as_jaxprs(w))
+        return out
+    return []
+
+
+def walk_eqns(jaxpr, *, into_kernels: bool = False) -> Iterator:
+    """Depth-first over every eqn, descending through pjit / cond /
+    scan / custom_* / shard_map bodies. `jaxpr` may be a ClosedJaxpr."""
+    jx = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in jx.eqns:
+        yield eqn
+        if eqn.primitive.name in KERNEL_BOUNDARY and not into_kernels:
+            continue
+        for sub in sub_jaxprs(eqn.params):
+            yield from walk_eqns(sub, into_kernels=into_kernels)
+
+
+def dce(jaxpr):
+    """Live eqns of a (Closed)Jaxpr in original order — reverse sweep
+    from the outvars, keeping effectful eqns. Top level only: a live
+    call eqn keeps its whole body (the walk descends into it)."""
+    jx = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    live = {v for v in jx.outvars
+            if not _is_drop(v) and not hasattr(v, "val")}
+    keep = []
+    for eqn in reversed(jx.eqns):
+        if (getattr(eqn, "effects", None)
+                or any(v in live for v in eqn.outvars)):
+            keep.append(eqn)
+            live.update(v for v in eqn.invars
+                        if not hasattr(v, "val"))  # skip Literals
+    keep.reverse()
+    return keep
+
+
+def _is_drop(v) -> bool:
+    return type(v).__name__ == "DropVar"
+
+
+def src_line(eqn, repo_hint: str = "pertgnn_tpu") -> str:
+    """"path:line" of the innermost user frame that produced this eqn
+    (first frame whose filename mentions `repo_hint`), or "<ir>" when
+    the traceback carries no user frame — diagnostics only, never
+    load-bearing."""
+    tb = getattr(getattr(eqn, "source_info", None), "traceback", None)
+    if tb is None:
+        return "<ir>"
+    try:
+        frames = list(tb.frames)
+    except AttributeError:
+        return "<ir>"
+    for fr in frames:
+        fname = getattr(fr, "file_name", "") or ""
+        if repo_hint in fname:
+            short = fname[fname.index(repo_hint):]
+            return f"{short}:{getattr(fr, 'start_line', 0)}"
+    for fr in frames:
+        fname = getattr(fr, "file_name", "") or ""
+        if "site-packages" not in fname and fname:
+            return f"{fname.rsplit('/', 1)[-1]}:{getattr(fr, 'start_line', 0)}"
+    return "<ir>"
+
+
+def aval_bytes(aval) -> int:
+    size = 1
+    for d in getattr(aval, "shape", ()):
+        size *= int(d)
+    return size * getattr(getattr(aval, "dtype", None), "itemsize", 4)

@@ -1,0 +1,126 @@
+"""graftaudit CLI.
+
+    python -m tools.graftaudit [PASS ...] [options]
+
+Options:
+    --json             machine-readable result (one JSON object)
+    --baseline PATH    baseline file (default tools/graftaudit/
+                       baseline.json when it exists)
+    --no-baseline      ignore any baseline
+    --write-baseline   accept today's findings into the baseline file
+                       and exit 0 (the file is in-tree and reviewable;
+                       prefer FIXING findings — docs/LINTS.md)
+    --programs GLOB    audit only programs matching the glob (e.g.
+                       'serve/int8/*')
+    --list             list passes and exit
+
+Exit codes: 0 clean (or all findings baselined), 1 new violations,
+2 usage / internal error — graftlint's contract, which
+tests/test_graftaudit.py pins in tier-1 and bench.py --gate refuses
+captures on.
+
+The audit builds and traces the stack's real programs, so it needs
+the repo's package importable (editable install or repo-root cwd) and
+forces the CPU backend with 8 virtual devices when it owns the jax
+import (tools/graftaudit/programs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    from tools.graftaudit.passes import get_passes, registry
+
+    p = argparse.ArgumentParser(
+        prog="graftaudit",
+        description="jaxpr/StableHLO-level auditor for the stack's "
+                    "real compiled programs (docs/LINTS.md)")
+    p.add_argument("passes", nargs="*",
+                   help="pass names to run (default: all); "
+                        f"canonical: {', '.join(registry())}")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--programs", default=None, metavar="GLOB")
+    p.add_argument("--list", action="store_true")
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    if args.list:
+        for name, mod in registry().items():
+            doc = next(iter((mod.__doc__ or "").strip().splitlines()),
+                       "")
+            print(f"{name:18s} {doc}")
+        return 0
+
+    try:
+        get_passes(args.passes or None)
+    except KeyError as e:
+        print(f"graftaudit: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    from tools.graftaudit import driver
+    from tools.graftaudit.programs import force_cpu_env
+
+    baseline_path = "" if args.no_baseline else args.baseline
+    if (baseline_path and not args.write_baseline
+            and not os.path.exists(baseline_path)):
+        # same contract as graftlint: a typo'd explicit baseline path
+        # must not silently resurface (or fork) accepted debt
+        print(f"graftaudit: baseline file not found: {baseline_path} "
+              f"(--write-baseline creates one; --no-baseline ignores "
+              f"baselines)", file=sys.stderr)
+        return 2
+    force_cpu_env()
+    try:
+        result = driver.run_repo(args.passes or None,
+                                 baseline_path=baseline_path,
+                                 program_glob=args.programs)
+    except (json.JSONDecodeError, KeyError, TypeError) as e:
+        print(f"graftaudit: unreadable baseline file "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if args.programs:
+            # writing from a program subset would drop every OTHER
+            # program's accepted entries (graftlint's --changed-only
+            # guard, applied to the analogous combination here)
+            print("graftaudit: --write-baseline over a --programs "
+                  "subset would drop every other program's accepted "
+                  "entries — write from a full run", file=sys.stderr)
+            return 2
+        path = args.baseline or driver.DEFAULT_BASELINE
+        fresh = result.new + result.baselined
+        driver.write_baseline(path, fresh)
+        print(f"graftaudit: wrote {len(fresh)} baseline entr(ies) to "
+              f"{path}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.as_dict()))
+    else:
+        for v in result.new:
+            print(v)
+        tail = (f"{len(result.new)} violation(s) over "
+                f"{len(result.programs)} program(s)"
+                + (f", {len(result.baselined)} baselined"
+                   if result.baselined else "")
+                + (f", {len(result.allowed)} allowlisted"
+                   if result.allowed else "")
+                + f" [{', '.join(result.passes)};"
+                  f" {result.elapsed_s:.2f}s]")
+        print(tail, file=sys.stderr)
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,38 @@
+"""Pass registry — graftlint's conventions (tools/graftlint/passes/)."""
+
+from __future__ import annotations
+
+from tools.graftaudit.passes import (collective_audit, donation,
+                                     dtype_flow, host_interop,
+                                     padding_taint)
+
+_ORDER = (padding_taint, dtype_flow, donation, host_interop,
+          collective_audit)
+
+ALIASES = {
+    "padding": padding_taint, "taint": padding_taint,
+    "dtype": dtype_flow,
+    "donate": donation,
+    "host": host_interop, "interop": host_interop,
+    "collective": collective_audit, "collectives": collective_audit,
+}
+
+
+def registry() -> dict[str, object]:
+    return {m.RULE: m for m in _ORDER}
+
+
+def get_passes(names: list[str] | None = None) -> list:
+    if not names:
+        return list(_ORDER)
+    reg = registry()
+    out = []
+    for n in names:
+        mod = reg.get(n) or ALIASES.get(n)
+        if mod is None:
+            raise KeyError(
+                f"unknown pass {n!r} (choose from {sorted(reg)} "
+                f"or aliases {sorted(ALIASES)})")
+        if mod not in out:
+            out.append(mod)
+    return out

@@ -1,0 +1,102 @@
+"""graftaudit pass — collective-audit: named-axis collectives in the
+traced IR match the program's declared mesh.
+
+The edge-sharded attention (parallel/graph_shard.py) writes its
+psum/pmax collectives by hand under shard_map; a renamed mesh axis or
+a shard_map whose mesh disagrees with the trainer's mesh fails at
+runtime on a real slice — hours into a TPU reservation — while
+tracing on CPU happily succeeds. This pass checks, per program:
+
+- every collective's axis name (``psum``/``pmax``/``all_gather``/
+  ``ppermute``/``axis_index``/...) is an axis of the declared mesh;
+- every ``shard_map`` body binds a mesh whose axis names are a subset
+  of the declared mesh's;
+- a program NOT declared sharded contains no collectives or shard_map
+  at all (a single-device serve/train program that traps a collective
+  would deadlock the moment it runs on a multi-device mesh).
+
+Implicit-SPMD data parallelism (jit + in_shardings) inserts its
+collectives inside XLA, after this IR — those are the partitioner's
+to get right; what this pass owns is every axis name WE wrote.
+"""
+
+from __future__ import annotations
+
+from tools.graftaudit._ir import src_line, walk_eqns
+from tools.graftlint.driver import Violation
+
+RULE = "collective-audit"
+
+COLLECTIVES = frozenset({
+    "psum", "pmax", "pmin", "pmean", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "reduce_scatter", "psum_scatter",
+    "axis_index", "pgather", "psum_invariant",
+})
+
+
+def _axis_names(eqn) -> list[str]:
+    names = []
+    for key in ("axes", "axis_name", "axis_index_groups_axis"):
+        v = eqn.params.get(key)
+        if v is None:
+            continue
+        if isinstance(v, (tuple, list)):
+            names.extend(str(a) for a in v)
+        else:
+            names.append(str(v))
+    return names
+
+
+def run(programs) -> list[Violation]:
+    found: list[Violation] = []
+    for spec in programs:
+        mesh_axes = set(spec.mesh_axes or ())
+        sharded = spec.mesh_axes is not None
+        for eqn in walk_eqns(spec.jaxpr):
+            name = eqn.primitive.name
+            if name == "shard_map":
+                mesh = eqn.params.get("mesh")
+                axes = [str(a) for a in
+                        getattr(mesh, "axis_names", ())]
+                if not sharded:
+                    found.append(Violation(
+                        rule=RULE, path=spec.name, line=0,
+                        message=(f"shard_map at {src_line(eqn)} in a "
+                                 f"program with no declared mesh"),
+                        key=f"shard_map@{src_line(eqn)}"))
+                else:
+                    for a in axes:
+                        if a not in mesh_axes:
+                            found.append(Violation(
+                                rule=RULE, path=spec.name, line=0,
+                                message=(f"shard_map at {src_line(eqn)} "
+                                         f"binds mesh axis {a!r}, not "
+                                         f"an axis of the program's "
+                                         f"mesh {sorted(mesh_axes)}"),
+                                key=f"shard_map-axis:{a}"))
+                continue
+            if name not in COLLECTIVES:
+                continue
+            axes = _axis_names(eqn)
+            if not sharded:
+                found.append(Violation(
+                    rule=RULE, path=spec.name, line=0,
+                    message=(f"collective `{name}` over "
+                             f"{axes or 'unknown axes'} at "
+                             f"{src_line(eqn)} in a single-device "
+                             f"program — this deadlocks the moment the "
+                             f"program runs on a mesh"),
+                    key=f"{name}@{src_line(eqn)}"))
+                continue
+            for a in axes:
+                if a not in mesh_axes:
+                    found.append(Violation(
+                        rule=RULE, path=spec.name, line=0,
+                        message=(f"collective `{name}` at "
+                                 f"{src_line(eqn)} names axis {a!r}, "
+                                 f"which is not an axis of the "
+                                 f"program's mesh "
+                                 f"{sorted(mesh_axes)} — this fails "
+                                 f"only at runtime on a real slice"),
+                        key=f"{name}-axis:{a}"))
+    return found

@@ -1,0 +1,81 @@
+"""graftaudit pass — donation: train-step state buffers must be
+donated, checked on the LOWERED program (the IR where donation is
+ground truth: the lowering's per-argument aliasing table, surfaced as
+``Lowered.args_info``, is what becomes ``tf.aliasing_output`` in the
+StableHLO module).
+
+Every ``make_train_*`` jits with ``donate_argnums=0`` so each step
+updates the state in place instead of holding old+new copies — at real
+scale that is the difference between fitting in HBM and not. The flag
+is one refactor away from silently vanishing (a wrapper that re-jits,
+a new step maker that forgets it), and nothing fails when it does: the
+program is still correct, just 2x the state footprint. This pass reads
+the lowered aliasing table and reports when the undonated share of the
+state exceeds a threshold — a handful of scalar counters legitimately
+stay undonated (XLA refuses to alias buffers it repacks), but
+params/opt_state must alias through.
+"""
+
+from __future__ import annotations
+
+from tools.graftaudit._ir import aval_bytes
+from tools.graftlint.driver import Violation
+
+RULE = "donation"
+
+# undonated state bytes above this fail the audit. The toy programs'
+# whole state is tens of KiB, so a dropped donate_argnums blows far
+# past it while XLA's refusal to alias a couple of odd scalars stays
+# under.
+THRESHOLD_BYTES = 4096
+
+
+def donated_flags(lowered) -> list | None:
+    """Per-flat-argument (donated, aval) from the lowering's aliasing
+    table, aligned with the traced program's flat inputs."""
+    import jax
+
+    info = getattr(lowered, "args_info", None)
+    if info is None:
+        return None
+    leaves = jax.tree.leaves(info,
+                             is_leaf=lambda x: hasattr(x, "donated"))
+    if not all(hasattr(a, "donated") for a in leaves):
+        return None
+    return [(bool(a.donated), getattr(a, "aval", None) or a._aval)
+            for a in leaves]
+
+
+def run(programs) -> list[Violation]:
+    found: list[Violation] = []
+    for spec in programs:
+        if not spec.expect_donated_state:
+            continue
+        lowered = spec.lowered_text()
+        flags = donated_flags(lowered) if lowered is not None else None
+        if flags is None or len(flags) < spec.state_flat_count:
+            found.append(Violation(
+                rule=RULE, path=spec.name, line=0,
+                message=(f"cannot read the lowering's aliasing table "
+                         f"for {spec.state_flat_count} state inputs — "
+                         f"the donation check needs Lowered.args_info"),
+                key="unreadable-aliasing-table"))
+            continue
+        undonated = [(spec.state_paths[i] if i < len(spec.state_paths)
+                      else f"state[{i}]", aval_bytes(flags[i][1]))
+                     for i in range(spec.state_flat_count)
+                     if not flags[i][0]]
+        total = sum(b for _p, b in undonated)
+        if total >= THRESHOLD_BYTES:
+            worst = sorted(undonated, key=lambda x: -x[1])[:5]
+            listing = ", ".join(f"{p} ({b}B)" for p, b in worst)
+            found.append(Violation(
+                rule=RULE, path=spec.name, line=0,
+                message=(f"{total} bytes of train state are NOT "
+                         f"donated ({len(undonated)} of "
+                         f"{spec.state_flat_count} leaves; worst: "
+                         f"{listing}) — the step should alias its "
+                         f"state in place (donate_argnums=0 in "
+                         f"train/loop.py make_train_*)"),
+                key="undonated-state"))
+    return found

@@ -1,0 +1,148 @@
+"""graftaudit pass — dtype-flow: the quantized serve tiers keep their
+promises at the IR level.
+
+``serve_dtype=bf16`` promises the hot path runs bf16 through the MXU;
+``int8`` additionally promises weights ENTER the compiled program as
+int8 (quarter HBM bytes — the whole point, ops/quantize.py) and are
+dequantized in-graph exactly once. Both rot silently: one
+``.astype(jnp.float32)`` upstream of a matmul and the tier quietly
+serves f32 GEMMs at bf16's advertised cost. This pass checks the
+traced serve programs directly:
+
+- no float32/float64 ``dot_general`` / ``conv_general_dilated`` in a
+  bf16 or int8 serve program (live code only — dead eqns are DCE'd
+  first). Pallas kernel bodies are exempt at the call boundary: their
+  f32 accumulators are deliberate flash-attention practice, and the
+  kernels' cost model is pinned by benchmarks/kernel_bench.py instead;
+- an int8 program must have at least one int8 input leaf, and every
+  int8 input must be consumed by EXACTLY ONE ``convert_element_type``
+  (through any number of structural reshapes/broadcasts) whose target
+  is bf16 — zero converts means a dead quantized leaf, two means a
+  double dequantize, an f32 target means the dequantize itself
+  upcasts.
+"""
+
+from __future__ import annotations
+
+from tools.graftaudit._ir import dce, src_line, sub_jaxprs
+from tools.graftlint.driver import Violation
+
+RULE = "dtype-flow"
+
+_MATMULS = {"dot_general", "conv_general_dilated"}
+_WIDE = {"float32", "float64"}
+_STRUCTURAL = {"reshape", "broadcast_in_dim", "transpose", "squeeze",
+               "slice", "copy"}
+
+
+def _wide_matmuls(jaxpr, found, prog):
+    """Flag wide matmuls in live eqns, recursing through calls but not
+    kernels (tools/graftaudit/_ir.py KERNEL_BOUNDARY)."""
+    for eqn in dce(jaxpr):
+        name = eqn.primitive.name
+        if name in _MATMULS:
+            dts = {str(v.aval.dtype) for v in eqn.invars
+                   if hasattr(v, "aval")}
+            dts.add(str(eqn.outvars[0].aval.dtype))
+            wide = sorted(dts & _WIDE)
+            if wide:
+                found.append(Violation(
+                    rule=RULE, path=prog, line=0,
+                    message=(f"{wide[0]} `{name}` at {src_line(eqn)} in "
+                             f"a quantized serve program — the hot-path "
+                             f"GEMMs must stay bf16/int8 (a silent "
+                             f"upcast serves f32 at bf16's advertised "
+                             f"cost)"),
+                    key=f"wide-matmul@{src_line(eqn)}"))
+        if name == "pallas_call":
+            continue
+        for sub in sub_jaxprs(eqn.params):
+            _wide_matmuls(sub, found, prog)
+
+
+def _trace_int8_converts(jaxpr, var, out):
+    """Append (target_dtype, eqn) for every convert consuming `var`,
+    following structural pass-through ops and call boundaries."""
+    jx = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in jx.eqns:
+        if var not in eqn.invars:
+            continue
+        name = eqn.primitive.name
+        if name == "convert_element_type":
+            out.append((str(eqn.outvars[0].aval.dtype), eqn))
+        elif name in _STRUCTURAL:
+            _trace_int8_converts(jx, eqn.outvars[0], out)
+        else:
+            sub = None
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                sub = eqn.params.get(key)
+                if sub is not None:
+                    break
+            if sub is not None and hasattr(sub, "jaxpr"):
+                if len(sub.jaxpr.invars) == len(eqn.invars):
+                    inner = sub.jaxpr.invars[eqn.invars.index(var)]
+                    _trace_int8_converts(sub.jaxpr, inner, out)
+                else:
+                    # soundness direction: a call we cannot map into
+                    # must surface as a finding, never vanish
+                    out.append((f"<unresolvable `{name}` call "
+                                f"(const-carrying arity)>", eqn))
+            else:
+                out.append(("<non-convert use: %s>" % name, eqn))
+
+
+def _check_int8_leaves(spec, found):
+    jx = spec.jaxpr.jaxpr
+    int8_vars = [v for v in jx.invars if str(v.aval.dtype) == "int8"]
+    if not int8_vars:
+        found.append(Violation(
+            rule=RULE, path=spec.name, line=0,
+            message="int8 serve program has NO int8 input leaves — "
+                    "quantization happened outside the compiled "
+                    "program, so the executable reads full-width "
+                    "weights from HBM (ops/quantize.py contract)",
+            key="no-int8-leaves"))
+        return
+    for i, v in enumerate(int8_vars):
+        uses: list = []
+        _trace_int8_converts(jx, v, uses)
+        converts = [(dt, e) for dt, e in uses
+                    if not dt.startswith("<")]
+        odd = [(dt, e) for dt, e in uses if dt.startswith("<")]
+        if odd:
+            dt, eqn = odd[0]
+            found.append(Violation(
+                rule=RULE, path=spec.name, line=0,
+                message=(f"int8 leaf #{i} feeds {dt} at "
+                         f"{src_line(eqn)} — int8 weights may only be "
+                         f"dequantized (convert + scale)"),
+                key=f"int8-nonconvert-use@{i}"))
+        if len(converts) != 1:
+            found.append(Violation(
+                rule=RULE, path=spec.name, line=0,
+                message=(f"int8 leaf #{i} has {len(converts)} in-graph "
+                         f"dequantize converts (contract: exactly one "
+                         f"— zero is a dead leaf, several re-read the "
+                         f"leaf and defeat the HBM saving)"),
+                key=f"int8-convert-count@{i}"))
+        for dt, eqn in converts:
+            if dt in _WIDE:
+                found.append(Violation(
+                    rule=RULE, path=spec.name, line=0,
+                    message=(f"int8 leaf #{i} dequantizes to {dt} at "
+                             f"{src_line(eqn)} — the dequantize target "
+                             f"is bf16 (ops/quantize.dequantize_array)"),
+                    key=f"int8-wide-dequant@{i}"))
+
+
+def run(programs) -> list[Violation]:
+    found: list[Violation] = []
+    for spec in programs:
+        if "serve" not in spec.tags:
+            continue
+        if not ({"bf16", "int8"} & spec.tags):
+            continue
+        _wide_matmuls(spec.jaxpr, found, spec.name)
+        if "int8" in spec.tags:
+            _check_int8_leaves(spec, found)
+    return found

@@ -1,0 +1,53 @@
+"""graftaudit pass — host-interop: compiled serve/train/eval/init
+programs carry ZERO host callbacks, infeed, or outfeed.
+
+graftlint's ``trace-hazard`` pass catches the SOURCE patterns that
+create host round-trips (``.item()``, ``print`` under jit, np-on-
+tracer) — heuristically, in the files it can see. This pass closes the
+loop at the IR: whatever the source looked like, if a host callback
+made it into the traced program, it is a per-dispatch host sync on the
+serve path / a per-step stall on the train path, and it shows up here
+as a ``pure_callback`` / ``io_callback`` / ``debug_callback`` /
+``infeed`` / ``outfeed`` eqn. Deliberately NO dead-code elimination
+here: a value-dead ``pure_callback`` traces with empty effects on
+this jax, DCE would drop it, and whether XLA also drops the custom
+call is backend detail — it should not be in the program at all.
+Pallas kernel bodies are exempt (``pl.debug_print`` is device-side).
+"""
+
+from __future__ import annotations
+
+from tools.graftaudit._ir import src_line, sub_jaxprs
+from tools.graftlint.driver import Violation
+
+RULE = "host-interop"
+
+HOST_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "infeed",
+    "outfeed", "host_callback", "outside_call",
+})
+
+
+def _scan(jaxpr, found, prog):
+    jx = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in jx.eqns:
+        name = eqn.primitive.name
+        if name in HOST_PRIMS:
+            cb = eqn.params.get("callback", "")
+            found.append(Violation(
+                rule=RULE, path=prog, line=0,
+                message=(f"`{name}` at {src_line(eqn)} — a compiled "
+                         f"program with a host round-trip stalls every "
+                         f"dispatch on the host (callback: {cb!r:.80})"),
+                key=f"{name}@{src_line(eqn)}"))
+        if name == "pallas_call":
+            continue
+        for sub in sub_jaxprs(eqn.params):
+            _scan(sub, found, prog)
+
+
+def run(programs) -> list[Violation]:
+    found: list[Violation] = []
+    for spec in programs:
+        _scan(spec.jaxpr, found, spec.name)
+    return found

@@ -1,0 +1,994 @@
+"""graftaudit pass — padding-taint: a dataflow PROOF, on the jaxpr,
+that padded node/edge/graph lanes cannot influence the real outputs of
+a serve program.
+
+The dynamic padding-invariance tests (tests/test_serve.py,
+tests/test_model.py) re-pack one request at several pad shapes and
+assert bit-identical predictions — strong evidence, but per-shape and
+per-config. This pass proves the property for EVERY enumerated serve
+program by abstract interpretation over a taint domain:
+
+per variable, per lane class (node / edge / graph), the dependence on
+that class's PADDED input values is either absent, *confined* to a set
+of (axis, class) pad-lane regions, or unconfined (dirty). Masking
+idioms discharge confinements:
+
+- ``select_n`` over a mask (False on every pad lane) replaces pad
+  lanes with the constant branch;
+- multiplication by a mask-zeroed array pins pad lanes to 0, which
+  reductions / dot contractions / scatter-adds then ignore;
+- scatter combiners drop pad-lane updates pinned to their identity
+  (0 for add, -inf for max), so data-dependent ROUTING of pad rows
+  (senders/receivers/node_graph are themselves padded data) is a
+  no-op;
+- gathers whose indices are the packer's routing arrays leak an
+  operand's pad-lane dependence only into the gather's own pad rows —
+  sound because real routing values index only real lanes, a PACKER
+  invariant this analysis assumes and the packing tests pin
+  dynamically (docs/LINTS.md "assumptions").
+
+A program is clean when every output's remaining dependence is
+confined to pad-lane regions the caller discards (the serve engine
+slices predictions to the real graph count). Anything the rule table
+cannot discharge — an unmodeled primitive, an unmasked reduction, a
+``pallas_call`` boundary — degrades to dirty and is reported with the
+source line from the eqn traceback; soundness direction: the pass can
+cry wolf, it cannot certify a leak away.
+
+Known modeling assumptions (shared by the fp semantics of the masking
+idioms themselves, and documented in docs/LINTS.md): ``0 * x == 0``
+and ``0 / x == 0`` — non-finite pad-lane values would break both, and
+those are caught at runtime by the engine's NonFiniteOutput guard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from tools.graftaudit._ir import src_line
+from tools.graftlint.driver import Violation
+
+RULE = "padding-taint"
+
+DIRTY = "DIRTY"
+_NEG_INF = float("-inf")
+
+
+@dataclasses.dataclass
+class Abs:
+    """Abstract value of one jaxpr var.
+
+    deps: lane class -> DIRTY or set of (axis, lane_class) confinement
+      regions (dependence on the class's padded inputs lives only in
+      the union of those regions' pad lanes).
+    padv: (axis, lane_class) -> scalar pinned on every pad lane of
+      that region (masks after cast, masked products, -inf scores).
+    const: scalar when the whole array is that constant.
+    routes / routes_like: packer routing class of the var's REAL-lane
+      values (routes_like: an arithmetic shift of a routing array, the
+      negative-index wrap idiom).
+    rng: (lo, hi) value bounds (iota / int consts) for the
+      mask-vs-iota comparison rule.
+    ident_axis: value along this axis equals the position (iota).
+    why: lane class -> first reason the class went dirty.
+    """
+
+    deps: dict = dataclasses.field(default_factory=dict)
+    padv: dict = dataclasses.field(default_factory=dict)
+    const: object = None
+    routes: str | None = None
+    routes_like: str | None = None
+    rng: tuple | None = None
+    ident_axis: int | None = None
+    why: dict = dataclasses.field(default_factory=dict)
+
+    def copy(self) -> "Abs":
+        return Abs(deps={c: (d if d is DIRTY else set(d))
+                         for c, d in self.deps.items()},
+                   padv=dict(self.padv), const=self.const,
+                   routes=self.routes, routes_like=self.routes_like,
+                   rng=self.rng, ident_axis=self.ident_axis,
+                   why=dict(self.why))
+
+    def route_class(self) -> str | None:
+        return self.routes or self.routes_like
+
+    def dep_members(self) -> set:
+        out = set()
+        for d in self.deps.values():
+            if d is not DIRTY:
+                out |= d
+        return out
+
+    def has_dirty(self) -> bool:
+        return any(d is DIRTY for d in self.deps.values())
+
+    def normalize(self) -> "Abs":
+        """padv implies the region's lanes are constant — drop dep
+        members covered by a pinned region."""
+        for cls in list(self.deps):
+            d = self.deps[cls]
+            if d is DIRTY:
+                continue
+            d -= set(self.padv)
+            if not d:
+                del self.deps[cls]
+        return self
+
+
+def _clean(const=None, **kw) -> Abs:
+    return Abs(const=const, **kw)
+
+
+def _taint(why_map) -> Abs:
+    a = Abs()
+    for cls, why in why_map.items():
+        a.deps[cls] = DIRTY
+        a.why[cls] = why
+    return a
+
+
+def _join_deps(ins, out_rank=None):
+    """Union of operand deps (+ why), the default elementwise rule —
+    sound because lanes align positionally for same-rank broadcasting
+    ops. Confinements on axes beyond the output rank degrade to
+    dirty."""
+    deps, why = {}, {}
+    for a in ins:
+        for cls, d in a.deps.items():
+            if d is DIRTY or deps.get(cls) is DIRTY:
+                deps[cls] = DIRTY
+                why.setdefault(cls, a.why.get(cls, "joined dirty input"))
+                continue
+            members = set(d)
+            if out_rank is not None and any(ax >= out_rank
+                                            for ax, _ in members):
+                deps[cls] = DIRTY
+                why.setdefault(cls, "confinement axis lost in join")
+                continue
+            deps.setdefault(cls, set()).update(members)
+    return deps, why
+
+
+_PADV_FNS = {
+    "add": lambda a, b: a + b, "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b if b else math.nan,
+    "max": max, "min": min,
+    "lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+    "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b,
+    "eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+    "and": lambda a, b: bool(a) and bool(b),
+    "or": lambda a, b: bool(a) or bool(b),
+    "xor": lambda a, b: bool(a) ^ bool(b),
+    "not": lambda a: not a,
+    "exp": math.exp, "neg": lambda a: -a, "abs": abs,
+    "is_finite": lambda a: math.isfinite(a),
+    "sign": lambda a: (a > 0) - (a < 0),
+    "convert_element_type": lambda a: a,
+    "reduce_precision": lambda a: a,
+    "square": lambda a: a * a,
+    "integer_pow": None,  # exponent rides eqn.params["y"]; _ew builds
+    #                       the concrete fn per eqn
+}
+
+_ELEMENTWISE = frozenset(_PADV_FNS) | frozenset({
+    "rsqrt", "sqrt", "log", "log1p", "expm1", "logistic", "tanh",
+    "sin", "cos", "erf", "erf_inv", "floor", "ceil", "round", "pow",
+    "rem", "atan2", "clamp", "nextafter", "copy", "real", "imag",
+    "stop_gradient", "cbrt", "sinh", "cosh", "asin", "acos", "atan",
+    "exp2",
+})
+
+# kills: pinning any operand's region to the absorbing element pins
+# the result region regardless of the other operands
+_ABSORBING = {"mul": 0, "and": False, "or": True}
+
+_REDUCE_VAL = {
+    "reduce_sum": lambda v, n: v * n, "reduce_prod": lambda v, n: v**n,
+    "reduce_max": lambda v, n: v, "reduce_min": lambda v, n: v,
+    "reduce_and": lambda v, n: v, "reduce_or": lambda v, n: v,
+}
+
+_SCATTER_IDENTITY = {"scatter-add": 0.0, "scatter-max": _NEG_INF,
+                     "scatter-min": float("inf")}
+
+_CALL_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+
+class _Interp:
+    def __init__(self, spec):
+        self.spec = spec
+
+    # -- environment ------------------------------------------------------
+
+    def read(self, env, v) -> Abs:
+        if hasattr(v, "val"):  # Literal
+            val = v.val
+            if getattr(val, "ndim", 0) == 0:
+                try:
+                    return _clean(const=val.item()
+                                  if hasattr(val, "item") else val)
+                except (ValueError, TypeError):
+                    return _clean()
+            return _clean()
+        return env.get(v, _clean())
+
+    def eval_closed(self, closed, in_abs) -> list[Abs]:
+        jx = closed.jaxpr
+        env = {}
+        for var, const in zip(jx.constvars, closed.consts):
+            c = None
+            if getattr(const, "ndim", 0) == 0:
+                try:
+                    c = const.item()
+                except (ValueError, TypeError):
+                    c = None
+            env[var] = _clean(const=c)
+        if len(jx.invars) != len(in_abs):
+            raise ValueError("arity mismatch")
+        for var, a in zip(jx.invars, in_abs):
+            env[var] = a
+        for eqn in jx.eqns:
+            outs = self.eval_eqn(eqn, [self.read(env, v)
+                                       for v in eqn.invars])
+            for var, a in zip(eqn.outvars, outs):
+                if type(var).__name__ != "DropVar":
+                    env[var] = a.normalize()
+        return [self.read(env, v) for v in jx.outvars]
+
+    # -- dispatch ---------------------------------------------------------
+
+    def eval_eqn(self, eqn, ins) -> list[Abs]:
+        name = eqn.primitive.name
+        handler = getattr(self, "_p_" + name.replace("-", "_"), None)
+        if handler is not None:
+            return handler(eqn, ins)
+        if name == "select_n":
+            return self._select_n(eqn, ins)
+        if name in _ELEMENTWISE:
+            return [self._ew(eqn, ins, name)]
+        for key in _CALL_JAXPR_KEYS:
+            sub = eqn.params.get(key)
+            if sub is not None and hasattr(sub, "jaxpr"):
+                return self._call(eqn, ins, sub)
+        return self._unknown(eqn, ins, f"unmodeled primitive `{name}`")
+
+    def _unknown(self, eqn, ins, reason) -> list[Abs]:
+        """Sound default: a pure function of clean inputs is clean;
+        any input dependence becomes unconfined."""
+        why_map = {}
+        for a in ins:
+            for cls in a.deps:
+                why_map.setdefault(
+                    cls, f"{reason} at {src_line(eqn)}")
+        out = _taint(why_map)
+        return [out.copy() for _ in eqn.outvars]
+
+    # -- elementwise ------------------------------------------------------
+
+    def _ew(self, eqn, ins, name) -> Abs:
+        out_aval = eqn.outvars[0].aval
+        out_rank = len(out_aval.shape)
+        deps, why = _join_deps(ins, out_rank)
+        res = Abs(deps=deps, why=why)
+
+        # absorbing element (mul by a mask-zeroed array): pins the
+        # region AND discharges every confinement on it
+        absorb = _ABSORBING.get(name)
+        if name == "div":
+            absorb = None  # only the numerator absorbs for div
+        keys = set()
+        for a in ins:
+            keys |= set(a.padv)
+        if absorb is not None:
+            for k in keys:
+                if any(a.padv.get(k, a.const) == absorb
+                       and _axis_ok(a, k, out_aval) for a in ins):
+                    res.padv[k] = absorb
+        if name == "div" and ins and ins[0].padv:
+            for k, v in ins[0].padv.items():
+                if v == 0 and _axis_ok(ins[0], k, out_aval):
+                    # 0 / x == 0 (documented fp assumption)
+                    res.padv[k] = 0
+        # constant propagation across a pinned region
+        fn = _PADV_FNS.get(name)
+        if name == "integer_pow":
+            fn = lambda a, _y=eqn.params["y"]: a ** _y  # noqa: E731
+        if fn is not None:
+            for k in keys:
+                if k in res.padv:
+                    continue
+                vals = []
+                for a in ins:
+                    v = a.padv.get(k, a.const)
+                    if v is None:
+                        break
+                    vals.append(v)
+                else:
+                    try:
+                        res.padv[k] = fn(*vals)
+                    except (TypeError, ValueError, OverflowError,
+                            ZeroDivisionError):
+                        pass
+        # eq/ne of a pinned region against a bounded-range operand
+        # (the blocked-dense incidence: receivers pinned to -1 vs an
+        # iota that is always >= 0)
+        if name in ("eq", "ne") and len(ins) == 2:
+            for a, b in ((ins[0], ins[1]), (ins[1], ins[0])):
+                if b.rng is None:
+                    continue
+                lo, hi = b.rng
+                for k, v in a.padv.items():
+                    if k in res.padv or not isinstance(v, (int, float)):
+                        continue
+                    if v < lo or v > hi:
+                        res.padv[k] = (name == "ne")
+        if all(a.const is not None for a in ins) and fn is not None:
+            try:
+                res.const = fn(*[a.const for a in ins])
+            except (TypeError, ValueError, OverflowError,
+                    ZeroDivisionError):
+                pass
+        if name == "convert_element_type":
+            src = ins[0]
+            res.routes, res.routes_like = src.routes, src.routes_like
+            res.rng, res.ident_axis = src.rng, src.ident_axis
+        elif name in ("add", "sub"):
+            routed = [a for a in ins if a.route_class() is not None]
+            consts = [a for a in ins if a.const is not None]
+            if len(routed) == 1 and len(routed) + len(consts) == len(ins):
+                res.routes_like = routed[0].route_class()
+        return res
+
+    def _select_n(self, eqn, ins) -> list[Abs]:
+        pred, *cases = ins
+        out_aval = eqn.outvars[0].aval
+        out_rank = len(out_aval.shape)
+        if len(cases) != 2:
+            return [self._ew(eqn, ins, "select_n_generic")]
+        res = Abs()
+        res.deps, res.why = _join_deps([pred], out_rank)
+        # which case each pinned predicate region selects
+        pinned = {k: v for k, v in pred.padv.items()
+                  if isinstance(v, bool)}
+        if pred.const is not None and isinstance(pred.const, bool):
+            chosen_all = cases[int(pred.const)]
+            res = chosen_all.copy()
+            d, w = _join_deps([pred], out_rank)
+            _merge(res, d, w)
+            return [res.normalize()]
+        for i, case in enumerate(cases):
+            d, w = _join_deps([case], out_rank)
+            for cls, members in d.items():
+                if members is DIRTY:
+                    res.deps[cls] = DIRTY
+                    res.why.setdefault(cls, w.get(cls, ""))
+                    continue
+                kept = {m for m in members
+                        if not (m in pinned and pinned[m] != bool(i))}
+                if kept:
+                    cur = res.deps.get(cls)
+                    if cur is not DIRTY:
+                        res.deps.setdefault(cls, set()).update(kept)
+        for k, sel in pinned.items():
+            chosen = cases[int(sel)]
+            v = chosen.padv.get(k, chosen.const)
+            if v is not None and _axis_ok(chosen, k, out_aval):
+                res.padv[k] = v
+        # the negative-index wrap idiom keeps routing through a select
+        rc = {c.route_class() for c in cases}
+        if len(rc) == 1 and None not in rc and not pred.has_dirty():
+            res.routes = rc.pop()
+        return [res.normalize()]
+
+    # -- structural -------------------------------------------------------
+
+    def _p_broadcast_in_dim(self, eqn, ins) -> list[Abs]:
+        src = ins[0]
+        bd = eqn.params["broadcast_dimensions"]
+        res = Abs(const=src.const, rng=src.rng, routes=src.routes,
+                  routes_like=src.routes_like)
+        amap = {i: bd[i] for i in range(len(bd))}
+        _remap(src, res, amap)
+        if src.ident_axis is not None and src.ident_axis in amap:
+            res.ident_axis = amap[src.ident_axis]
+        return [res]
+
+    def _p_reshape(self, eqn, ins) -> list[Abs]:
+        src = ins[0]
+        in_shape = eqn.invars[0].aval.shape
+        out_shape = eqn.outvars[0].aval.shape
+        amap = _reshape_axis_map(in_shape, out_shape)
+        res = Abs(const=src.const, rng=src.rng, routes=src.routes,
+                  routes_like=src.routes_like)
+        _remap(src, res, amap,
+               lost=f"reshape {in_shape}->{out_shape} at {src_line(eqn)}")
+        if src.ident_axis is not None and src.ident_axis in amap:
+            res.ident_axis = amap[src.ident_axis]
+        return [res]
+
+    def _p_transpose(self, eqn, ins) -> list[Abs]:
+        src = ins[0]
+        perm = eqn.params["permutation"]
+        amap = {old: new for new, old in enumerate(perm)}
+        res = Abs(const=src.const, rng=src.rng, routes=src.routes,
+                  routes_like=src.routes_like)
+        _remap(src, res, amap)
+        if src.ident_axis is not None:
+            res.ident_axis = amap.get(src.ident_axis)
+        return [res]
+
+    def _p_squeeze(self, eqn, ins) -> list[Abs]:
+        src = ins[0]
+        dropped = set(eqn.params["dimensions"])
+        rank = len(eqn.invars[0].aval.shape)
+        amap, j = {}, 0
+        for i in range(rank):
+            if i not in dropped:
+                amap[i] = j
+                j += 1
+        res = Abs(const=src.const, rng=src.rng, routes=src.routes,
+                  routes_like=src.routes_like)
+        _remap(src, res, amap)
+        if src.ident_axis is not None:
+            res.ident_axis = amap.get(src.ident_axis)
+        return [res]
+
+    def _p_slice(self, eqn, ins) -> list[Abs]:
+        src = ins[0]
+        starts = eqn.params["start_indices"]
+        strides = eqn.params["strides"] or [1] * len(starts)
+        res = Abs(const=src.const, routes=src.routes,
+                  routes_like=src.routes_like)
+        # a from-0 unit-stride prefix keeps lane positions; anything
+        # else shifts them out from under the confinement
+        amap = {a: a for a in range(len(starts))
+                if starts[a] == 0 and strides[a] == 1}
+        _remap(src, res, amap,
+               lost=f"offset slice at {src_line(eqn)}")
+        return [res]
+
+    def _p_concatenate(self, eqn, ins) -> list[Abs]:
+        dim = eqn.params["dimension"]
+        out_rank = len(eqn.outvars[0].aval.shape)
+        deps, why = {}, {}
+        for a in ins:
+            for cls, d in a.deps.items():
+                if d is DIRTY or deps.get(cls) is DIRTY:
+                    deps[cls] = DIRTY
+                    why.setdefault(cls, a.why.get(cls, ""))
+                    continue
+                for m in d:
+                    if m[0] == dim or m[0] >= out_rank:
+                        deps[cls] = DIRTY
+                        why.setdefault(
+                            cls, f"concatenate along confined axis at "
+                                 f"{src_line(eqn)}")
+                        break
+                else:
+                    deps.setdefault(cls, set()).update(d)
+        res = Abs(deps=deps, why=why)
+        # conservative padv: keep a region only when every operand pins
+        # the same value on it (axis != concat dim)
+        keys = set()
+        for a in ins:
+            keys |= set(a.padv)
+        for k in keys:
+            if k[0] == dim:
+                continue
+            vals = {a.padv.get(k, a.const) for a in ins}
+            if len(vals) == 1 and None not in vals:
+                res.padv[k] = vals.pop()
+        return [res.normalize()]
+
+    def _p_iota(self, eqn, ins) -> list[Abs]:
+        dim = eqn.params["dimension"]
+        size = eqn.outvars[0].aval.shape[dim]
+        return [Abs(rng=(0, max(size - 1, 0)), ident_axis=dim)]
+
+    def _p_pad(self, eqn, ins) -> list[Abs]:
+        return self._unknown(eqn, ins, "lax.pad over confined lanes")
+
+    # -- reductions -------------------------------------------------------
+
+    def _reduce(self, eqn, ins, name) -> list[Abs]:
+        src = ins[0]
+        axes = set(eqn.params["axes"])
+        in_shape = eqn.invars[0].aval.shape
+        amap, j = {}, 0
+        for i in range(len(in_shape)):
+            if i not in axes:
+                amap[i] = j
+                j += 1
+        res = Abs()
+        for cls, d in src.deps.items():
+            if d is DIRTY:
+                res.deps[cls] = DIRTY
+                res.why[cls] = src.why.get(cls, "")
+                continue
+            members = set()
+            for ax, mcls in d:
+                if ax in axes:
+                    res.deps[cls] = DIRTY
+                    res.why[cls] = (
+                        f"`{name}` over unmasked {mcls}-pad lanes at "
+                        f"{src_line(eqn)} — mask (select_n / multiply "
+                        f"by the {mcls} mask) before reducing")
+                    break
+                members.add((amap[ax], mcls))
+            else:
+                if members:
+                    res.deps[cls] = members
+        valfn = _REDUCE_VAL.get(name)
+        if valfn is not None:
+            n_red = 1
+            for a in axes:
+                n_red *= in_shape[a]
+            for (ax, mcls), v in src.padv.items():
+                if ax not in axes and isinstance(v, (int, float, bool)):
+                    try:
+                        res.padv[(amap[ax], mcls)] = valfn(v, n_red)
+                    except (TypeError, OverflowError):
+                        pass
+        res.normalize()
+        return [res.copy() for _ in eqn.outvars]
+
+    def _p_reduce_sum(self, eqn, ins):
+        return self._reduce(eqn, ins, "reduce_sum")
+
+    def _p_reduce_max(self, eqn, ins):
+        return self._reduce(eqn, ins, "reduce_max")
+
+    def _p_reduce_min(self, eqn, ins):
+        return self._reduce(eqn, ins, "reduce_min")
+
+    def _p_reduce_prod(self, eqn, ins):
+        return self._reduce(eqn, ins, "reduce_prod")
+
+    def _p_reduce_and(self, eqn, ins):
+        return self._reduce(eqn, ins, "reduce_and")
+
+    def _p_reduce_or(self, eqn, ins):
+        return self._reduce(eqn, ins, "reduce_or")
+
+    def _p_argmax(self, eqn, ins):
+        return self._reduce(eqn, ins, "argmax")
+
+    def _p_argmin(self, eqn, ins):
+        return self._reduce(eqn, ins, "argmin")
+
+    def _p_cumsum(self, eqn, ins):
+        return self._unknown(eqn, ins, "cumulative op over confined "
+                                       "lanes")
+
+    def _p_cumlogsumexp(self, eqn, ins):
+        return self._p_cumsum(eqn, ins)
+
+    def _p_cummax(self, eqn, ins):
+        return self._p_cumsum(eqn, ins)
+
+    def _p_sort(self, eqn, ins):
+        return self._unknown(eqn, ins, "sort over confined lanes")
+
+    # -- contraction ------------------------------------------------------
+
+    def _p_dot_general(self, eqn, ins) -> list[Abs]:
+        lhs, rhs = ins
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lshape = eqn.invars[0].aval.shape
+        rshape = eqn.invars[1].aval.shape
+        lfree = [a for a in range(len(lshape))
+                 if a not in lc and a not in lb]
+        rfree = [a for a in range(len(rshape))
+                 if a not in rc and a not in rb]
+        lmap = {a: i for i, a in enumerate(lb)}
+        lmap.update({a: len(lb) + i for i, a in enumerate(lfree)})
+        rmap = {a: i for i, a in enumerate(rb)}
+        rmap.update({a: len(lb) + len(lfree) + i
+                     for i, a in enumerate(rfree)})
+        pair = dict(zip(lc, rc))
+        pair_r = dict(zip(rc, lc))
+
+        res = Abs()
+
+        def side(a, other, amap, contracted, opair):
+            for cls, d in a.deps.items():
+                if d is DIRTY or res.deps.get(cls) is DIRTY:
+                    res.deps[cls] = DIRTY
+                    res.why.setdefault(cls, a.why.get(cls, ""))
+                    continue
+                for ax, mcls in d:
+                    if ax in contracted:
+                        # discharged when the other operand pins its
+                        # paired contracted region to 0 (masked)
+                        ok = (other.padv.get((opair[ax], mcls)) == 0
+                              or other.const == 0)
+                        if not ok:
+                            res.deps[cls] = DIRTY
+                            res.why[cls] = (
+                                f"dot_general contracts unmasked "
+                                f"{mcls}-pad lanes at {src_line(eqn)}")
+                            break
+                    else:
+                        res.deps.setdefault(cls, set()).add(
+                            (amap[ax], mcls))
+            for (ax, mcls), v in a.padv.items():
+                if ax not in contracted and v == 0:
+                    res.padv[(amap[ax], mcls)] = 0
+
+        side(lhs, rhs, lmap, set(lc), pair)
+        side(rhs, lhs, rmap, set(rc), pair_r)
+        return [res.normalize()]
+
+    # -- gather / scatter -------------------------------------------------
+
+    def _p_gather(self, eqn, ins) -> list[Abs]:
+        operand, indices = ins
+        dn = eqn.params["dimension_numbers"]
+        op_shape = eqn.invars[0].aval.shape
+        idx_shape = eqn.invars[1].aval.shape
+        out_rank = len(eqn.outvars[0].aval.shape)
+        if (tuple(dn.start_index_map) != (0,)
+                or tuple(dn.collapsed_slice_dims) != (0,)
+                or getattr(dn, "operand_batching_dims", ())):
+            return self._unknown(eqn, ins, "unmodeled gather shape")
+        offset = list(dn.offset_dims)
+        batch_out = [d for d in range(out_rank) if d not in offset]
+        idx_batch_map = {i: batch_out[i] for i in range(len(batch_out))}
+        op_map = {}
+        for k, a in enumerate(range(1, len(op_shape))):
+            if k < len(offset):
+                op_map[a] = offset[k]
+        res = Abs()
+        # indices' own dependence lands on the gather's batch axes
+        idx_leak = set()
+        for cls, d in indices.deps.items():
+            if d is DIRTY:
+                res.deps[cls] = DIRTY
+                res.why[cls] = indices.why.get(cls, "")
+                continue
+            mapped = set()
+            for ax, mcls in d:
+                if ax not in idx_batch_map:
+                    res.deps[cls] = DIRTY
+                    res.why[cls] = (f"gather index confinement lost at "
+                                    f"{src_line(eqn)}")
+                    break
+                mapped.add((idx_batch_map[ax], mcls))
+            else:
+                if mapped:
+                    res.deps.setdefault(cls, set()).update(mapped)
+                idx_leak |= mapped
+        # operand dependence on non-indexed axes maps through offsets
+        for cls, d in operand.deps.items():
+            if d is DIRTY or res.deps.get(cls) is DIRTY:
+                res.deps[cls] = DIRTY
+                res.why.setdefault(cls, operand.why.get(cls, ""))
+                continue
+            for ax, mcls in d:
+                if ax == 0:
+                    # rows are selected by data: sound only when real
+                    # index values stay inside real lanes — the packer
+                    # routing invariant
+                    if indices.route_class() == mcls:
+                        if indices.has_dirty():
+                            res.deps[cls] = DIRTY
+                            res.why[cls] = ("routing indices are "
+                                            "unconfined at "
+                                            + src_line(eqn))
+                            break
+                        res.deps.setdefault(cls, set()).update(
+                            idx_leak)
+                    elif indices.ident_axis == 0:
+                        res.deps.setdefault(cls, set()).add(
+                            (idx_batch_map.get(0, 0), mcls))
+                    else:
+                        res.deps[cls] = DIRTY
+                        res.why[cls] = (
+                            f"gather selects {mcls}-pad rows with "
+                            f"non-routing indices at {src_line(eqn)}")
+                        break
+                elif ax in op_map:
+                    res.deps.setdefault(cls, set()).add(
+                        (op_map[ax], mcls))
+                else:
+                    res.deps[cls] = DIRTY
+                    res.why[cls] = (f"gather drops a confined operand "
+                                    f"axis at {src_line(eqn)}")
+                    break
+        for (ax, mcls), v in operand.padv.items():
+            if ax in op_map:
+                res.padv[(op_map[ax], mcls)] = v
+        if operand.const is not None and not indices.deps:
+            res.const = operand.const
+        return [res.normalize()]
+
+    def _scatter(self, eqn, ins, name) -> list[Abs]:
+        operand, indices, updates = ins
+        dn = eqn.params["dimension_numbers"]
+        if (not dn.scatter_dims_to_operand_dims
+                and not dn.inserted_window_dims
+                and eqn.invars[1].aval.size == 0
+                and name == "scatter"):
+            # degenerate full-array overwrite (`.at[:n].set(u)` with
+            # n == the padded size, which 128-aligned serve rungs
+            # always hit): the result IS the updates
+            return [updates.copy()]
+        if (tuple(dn.scatter_dims_to_operand_dims) != (0,)
+                or tuple(dn.inserted_window_dims) != (0,)
+                or getattr(dn, "operand_batching_dims", ())):
+            return self._unknown(eqn, ins, "unmodeled scatter shape")
+        up_rank = len(eqn.invars[2].aval.shape)
+        window = list(dn.update_window_dims)
+        batch = [d for d in range(up_rank) if d not in window]
+        win_map = {w: 1 + k for k, w in enumerate(window)}
+        identity = _SCATTER_IDENTITY.get(name)
+        res = Abs()
+        ident_updates_regions = {
+            (ax, c) for (ax, c), v in updates.padv.items()
+            if ax in batch and v == identity}
+        if name == "scatter" and indices.ident_axis == 0:
+            # .at[:n].set(x): position-identity embed of the updates
+            emb_map = {b: 0 for b in batch}
+            emb_map.update(win_map)
+            for src_abs in (operand, updates):
+                amap = (emb_map if src_abs is updates
+                        else {i: i for i in range(
+                            len(eqn.invars[0].aval.shape))})
+                for cls, d in src_abs.deps.items():
+                    if d is DIRTY or res.deps.get(cls) is DIRTY:
+                        res.deps[cls] = DIRTY
+                        res.why.setdefault(cls,
+                                           src_abs.why.get(cls, ""))
+                        continue
+                    for ax, mcls in d:
+                        if ax in amap:
+                            res.deps.setdefault(cls, set()).add(
+                                (amap[ax], mcls))
+                        else:
+                            res.deps[cls] = DIRTY
+                            res.why[cls] = ("scatter embed lost a "
+                                            "confined axis at "
+                                            + src_line(eqn))
+                            break
+            for (ax, mcls), v in updates.padv.items():
+                if ax in batch:
+                    res.padv[(0, mcls)] = v
+                elif ax in win_map:
+                    res.padv[(win_map[ax], mcls)] = v
+            return [res.normalize()]
+        if identity is None:
+            return self._unknown(
+                eqn, ins, "overwrite-scatter with data-dependent "
+                          "routing")
+        # combining scatter: identity-pinned pad updates are no-ops,
+        # so both their values and their (data-dependent) routing die
+        for cls, d in updates.deps.items():
+            if d is DIRTY or res.deps.get(cls) is DIRTY:
+                res.deps[cls] = DIRTY
+                res.why.setdefault(cls, updates.why.get(cls, ""))
+                continue
+            for ax, mcls in d:
+                if ax in batch:
+                    res.deps[cls] = DIRTY
+                    res.why[cls] = (
+                        f"`{name}` scatters unmasked {mcls}-pad rows "
+                        f"at {src_line(eqn)} — pin pad updates to the "
+                        f"combiner identity ({identity}) first")
+                    break
+                res.deps.setdefault(cls, set()).add(
+                    (win_map[ax], mcls))
+        idx_member_classes = set()
+        for cls, d in indices.deps.items():
+            if d is DIRTY or res.deps.get(cls) is DIRTY:
+                res.deps[cls] = DIRTY
+                res.why.setdefault(cls, indices.why.get(cls, ""))
+                continue
+            for ax, mcls in d:
+                idx_member_classes.add(mcls)
+                if (ax, mcls) not in ident_updates_regions:
+                    res.deps[cls] = DIRTY
+                    res.why[cls] = (
+                        f"`{name}` routes non-identity values by "
+                        f"{mcls}-padded indices at {src_line(eqn)}")
+                    break
+        for cls, d in operand.deps.items():
+            if d is DIRTY or res.deps.get(cls) is DIRTY:
+                res.deps[cls] = DIRTY
+                res.why.setdefault(cls, operand.why.get(cls, ""))
+            else:
+                res.deps.setdefault(cls, set()).update(d)
+        # pad slots of the target stay at the operand's constant when
+        # real rows route real (packer invariant) and pad rows are
+        # identity no-ops
+        target = indices.route_class()
+        if (target is not None and operand.const is not None
+                and all((0, c) in ident_updates_regions
+                        or updates.padv.get((0, c)) == identity
+                        for c in idx_member_classes)):
+            res.padv[(0, target)] = operand.const
+        return [res.normalize()]
+
+    def _p_scatter_add(self, eqn, ins):
+        return self._scatter(eqn, ins, "scatter-add")
+
+    def _p_scatter_max(self, eqn, ins):
+        return self._scatter(eqn, ins, "scatter-max")
+
+    def _p_scatter_min(self, eqn, ins):
+        return self._scatter(eqn, ins, "scatter-min")
+
+    def _p_scatter(self, eqn, ins):
+        return self._scatter(eqn, ins, "scatter")
+
+    def _p_scatter_mul(self, eqn, ins):
+        return self._unknown(eqn, ins, "scatter-mul routing")
+
+    # -- calls / control flow --------------------------------------------
+
+    def _call(self, eqn, ins, closed) -> list[Abs]:
+        try:
+            return self.eval_closed(closed, ins)
+        except ValueError:
+            return self._unknown(eqn, ins,
+                                 f"call arity mismatch in "
+                                 f"`{eqn.primitive.name}`")
+
+    def _p_cond(self, eqn, ins) -> list[Abs]:
+        pred, *args = ins
+        branches = eqn.params["branches"]
+        try:
+            branch_outs = [self.eval_closed(b, [a.copy() for a in args])
+                           for b in branches]
+        except ValueError:
+            return self._unknown(eqn, ins, "cond arity mismatch")
+        res_list = []
+        for outs in zip(*branch_outs):
+            res = outs[0].copy()
+            for other in outs[1:]:
+                d, w = _join_deps([other])
+                _merge(res, d, w)
+                res.padv = {k: v for k, v in res.padv.items()
+                            if other.padv.get(k, other.const) == v}
+                if res.const != other.const:
+                    res.const = None
+                if res.routes != other.routes:
+                    res.routes = None
+            if pred.deps:
+                d, w = _join_deps([pred])
+                for cls in d:
+                    res.deps[cls] = DIRTY
+                    res.why.setdefault(
+                        cls, f"branch selected by {cls}-padded data "
+                             f"at {src_line(eqn)}")
+            res_list.append(res.normalize())
+        return res_list
+
+    def _p_while(self, eqn, ins):
+        return self._unknown(eqn, ins, "while loop over confined data")
+
+    def _p_scan(self, eqn, ins):
+        return self._unknown(eqn, ins, "scan over confined data")
+
+    def _p_pallas_call(self, eqn, ins):
+        return self._unknown(
+            eqn, ins, "pallas_call boundary (kernel bodies are not "
+                      "modeled — docs/LINTS.md)")
+
+
+def _axis_ok(a: Abs, key, out_aval) -> bool:
+    """A padv claim transfers to the output only when the claiming
+    operand actually spans that output axis (a size-1 broadcast axis
+    holds ONE value for all lanes — its padv key could not exist)."""
+    return key[0] < len(out_aval.shape)
+
+
+def _merge(res: Abs, deps: dict, why: dict) -> None:
+    for cls, d in deps.items():
+        cur = res.deps.get(cls)
+        if d is DIRTY or cur is DIRTY:
+            res.deps[cls] = DIRTY
+            res.why.setdefault(cls, why.get(cls, ""))
+        else:
+            res.deps.setdefault(cls, set()).update(d)
+
+
+def _reshape_axis_map(in_shape, out_shape) -> dict:
+    """in-axis -> out-axis where the axis keeps its row-major digit
+    (equal size and equal suffix product) — lane positions along it
+    are preserved exactly."""
+
+    def suffix(shape, i):
+        p = 1
+        for d in shape[i + 1:]:
+            p *= d
+        return p
+
+    amap = {}
+    for i in range(len(in_shape)):
+        for j in range(len(out_shape)):
+            if (in_shape[i] == out_shape[j]
+                    and suffix(in_shape, i) == suffix(out_shape, j)):
+                amap[i] = j
+                break
+    return amap
+
+
+def _remap(src: Abs, res: Abs, amap: dict, lost: str = "") -> None:
+    for cls, d in src.deps.items():
+        if d is DIRTY:
+            res.deps[cls] = DIRTY
+            res.why[cls] = src.why.get(cls, "")
+            continue
+        for ax, mcls in d:
+            if ax in amap:
+                res.deps.setdefault(cls, set()).add((amap[ax], mcls))
+            else:
+                res.deps[cls] = DIRTY
+                res.why[cls] = lost or "confined axis dropped"
+                break
+    for (ax, mcls), v in src.padv.items():
+        if ax in amap:
+            res.padv[(amap[ax], mcls)] = v
+    res.normalize()
+
+
+def seed_inputs(spec) -> list[Abs]:
+    """Input Abs values from the program's declared invar roles."""
+    seeds = []
+    for role in spec.invar_roles:
+        if role.kind == "param":
+            seeds.append(_clean())
+        elif role.kind == "mask":
+            seeds.append(Abs(padv={(0, role.cls): False}))
+        elif role.kind == "route":
+            seeds.append(Abs(deps={role.cls: {(0, role.cls)}},
+                             routes=role.target))
+        else:  # data
+            seeds.append(Abs(deps={role.cls: {(0, role.cls)}}))
+    return seeds
+
+
+def audit_program(spec) -> list[Violation]:
+    interp = _Interp(spec)
+    try:
+        outs = interp.eval_closed(spec.jaxpr, seed_inputs(spec))
+    except RecursionError:
+        return [Violation(rule=RULE, path=spec.name, line=0,
+                          message="interpreter recursion limit — "
+                                  "program too deeply nested to prove",
+                          key="interp-recursion")]
+    found = []
+    for oi, a in enumerate(outs):
+        for cls in sorted(a.deps):
+            d = a.deps[cls]
+            if d is DIRTY:
+                why = a.why.get(cls, "unproven dataflow")
+                found.append(Violation(
+                    rule=RULE, path=spec.name, line=0,
+                    message=(f"output {oi} may depend on {cls}-padded "
+                             f"input lanes: {why}"),
+                    key=f"{cls}-pad@out{oi}"))
+                continue
+            leaked = sorted({mcls for _ax, mcls in d
+                             if mcls not in spec.out_discard})
+            if leaked:
+                found.append(Violation(
+                    rule=RULE, path=spec.name, line=0,
+                    message=(f"output {oi} carries {cls}-padded data "
+                             f"in {', '.join(leaked)}-pad lanes, which "
+                             f"the caller does NOT discard (discarded: "
+                             f"{sorted(spec.out_discard) or 'none'})"),
+                    key=f"{cls}-pad-leak@out{oi}"))
+    return found
+
+
+def run(programs) -> list[Violation]:
+    out = []
+    for spec in programs:
+        if "serve" not in spec.tags or spec.invar_roles is None:
+            continue
+        out.extend(audit_program(spec))
+    return out

@@ -1,0 +1,371 @@
+"""Enumerate the stack's REAL compiled programs at a toy config on CPU.
+
+The audit's subject is not synthetic example code — it is the programs
+the stack actually dispatches: one serve step per ladder rung, per
+``serve_dtype`` tier, per ``attention_impl`` (serve/engine.py builds
+them exactly this way), the train/eval/init programs fit() runs
+(train/loop.py's own makers), and the sharded variants from parallel/.
+Everything here TRACES (jaxpr) and at most LOWERS (StableHLO, for the
+donation pass) — nothing is XLA-compiled, which is what keeps a full
+repo-wide audit inside its tier-1 budget on CPU.
+
+Program names are stable audit identities (baseline / allowlist keys):
+
+    serve/<dtype>/<impl>/rung<i>_g<G>n<N>e<E>
+    train/<step|chunk>_<packed|compact>       eval/...
+    init/model_init
+    sharded/train_step_dp   sharded/train_step_edge_shard
+
+The per-invar role table drives the padding-taint seed: which flat
+inputs are padded lane data, which are the masks, which are routing
+index arrays (senders/receivers/node_graph). The routing arrays' "real
+lanes index only real lanes" property is a PACKER invariant the
+analysis assumes — it is asserted dynamically by
+tests/test_serve.py::test_matches_epoch_packer_invariants and the
+packing property tests, and documented in docs/LINTS.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import sys
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+# (lane class, kind[, routing target]) per PackedBatch field — the
+# padding-taint seed. kind: "data" = pad lanes hold padded values;
+# "mask" = boolean, False on every pad lane; "route" = data whose
+# real-lane values are valid REAL indices of the target class.
+BATCH_ROLES = {
+    "x": ("node", "data"), "ms_id": ("node", "data"),
+    "node_depth": ("node", "data"),
+    "node_graph": ("node", "route", "graph"),
+    "node_mask": ("node", "mask"),
+    "pattern_prob": ("node", "data"), "pattern_size": ("node", "data"),
+    "senders": ("edge", "route", "node"),
+    "receivers": ("edge", "route", "node"),
+    "edge_iface": ("edge", "data"), "edge_rpctype": ("edge", "data"),
+    "edge_duration": ("edge", "data"), "edge_mask": ("edge", "mask"),
+    "entry_id": ("graph", "data"), "y": ("graph", "data"),
+    "graph_mask": ("graph", "mask"),
+}
+
+
+@dataclasses.dataclass
+class Role:
+    kind: str                # "param" | "data" | "mask" | "route"
+    cls: str | None = None   # lane class: "node" | "edge" | "graph"
+    target: str | None = None  # routing target class (kind == "route")
+    path: str = ""
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One traced program plus the contract metadata the passes need."""
+
+    name: str
+    tags: frozenset            # subset of {"serve","train","eval","init",
+    #                            "sharded"} + dtype + impl tags
+    jaxpr: Any                 # ClosedJaxpr
+    invar_roles: list | None = None   # aligned with jaxpr.jaxpr.invars
+    # output contract: classes whose output pad lanes the caller
+    # discards (the serve engine slices [:g] — graph-pad lanes of the
+    # prediction vector never reach a caller)
+    out_discard: frozenset = frozenset()
+    mesh_axes: tuple | None = None
+    # donation contract: the first `state_flat_count` flat invars are
+    # the train state and must be donated (checked on the StableHLO)
+    expect_donated_state: bool = False
+    state_flat_count: int = 0
+    state_paths: tuple = ()
+    lower: Callable | None = None     # () -> jax.stages.Lowered (lazy)
+
+    def lowered_text(self):
+        if self.lower is None:
+            return None
+        if not hasattr(self, "_lowered"):
+            self._lowered = self.lower()
+        return self._lowered
+
+
+def force_cpu_env() -> None:
+    """Point an un-imported jax at CPU with enough fake devices for the
+    sharded programs — same recipe as tests/conftest.py. A no-op when
+    jax is already imported (the importer owns the platform then)."""
+    if "jax" in sys.modules:
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _toy_config():
+    from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                    ModelConfig, ServeConfig, TrainConfig)
+
+    return Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(max_traces=60, batch_size=4),
+        model=ModelConfig(hidden_channels=16, num_layers=2),
+        train=TrainConfig(label_scale=1000.0),
+        serve=ServeConfig(max_graphs_per_batch=4),
+        graph_type="pert",
+    )
+
+
+_CACHE: dict = {}
+
+
+def _toy_stack():
+    """(dataset, cfg, model, state) shared by every program build —
+    cached per process (tier-1 and the bench gate both audit once)."""
+    if "stack" in _CACHE:
+        return _CACHE["stack"]
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.train.loop import restore_target_state
+
+    cfg = _toy_config()
+    synth = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=12, num_entries=2, patterns_per_entry=2,
+        traces_per_entry=12, seed=7))
+    pre = preprocess(synth.spans, synth.resources, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+    model, state = restore_target_state(ds, cfg)
+    _CACHE["stack"] = (ds, cfg, model, state)
+    return _CACHE["stack"]
+
+
+def _serve_roles(variables_abs, n_feat: int) -> list:
+    import jax
+
+    roles = [Role(kind="param", path="variables")
+             for _ in jax.tree.leaves(variables_abs)]
+    from pertgnn_tpu.batching.pack import PackedBatch
+
+    for field in PackedBatch._fields:
+        spec = BATCH_ROLES[field]
+        roles.append(Role(kind=spec[1], cls=spec[0],
+                          target=spec[2] if len(spec) > 2 else None,
+                          path=f"batch.{field}"))
+    return roles
+
+
+def _serve_specs(ds, cfg, state, out: list, errors: list) -> None:
+    import jax
+
+    from pertgnn_tpu.batching.pack import BatchBudget
+    from pertgnn_tpu.config import ATTENTION_IMPLS, SERVE_DTYPES
+    from pertgnn_tpu.serve.engine import InferenceEngine, abstract_batch
+
+    # a widened budget gives the toy ladder >= 2 rungs, so the audit
+    # exercises the rung enumeration, not just a single shape
+    budget = BatchBudget(max_graphs=cfg.serve.max_graphs_per_batch,
+                         max_nodes=max(ds.budget.max_nodes, 256),
+                         max_edges=max(ds.budget.max_edges, 256))
+    for dtype in SERVE_DTYPES:
+        for impl in ATTENTION_IMPLS:
+            name_prefix = f"serve/{dtype}/{impl}"
+            try:
+                c = dataclasses.replace(
+                    cfg,
+                    serve=dataclasses.replace(cfg.serve,
+                                              serve_dtype=dtype),
+                    model=dataclasses.replace(cfg.model,
+                                              attention_impl=impl))
+                model_cfg = c.model
+                if dtype in ("bf16", "int8"):
+                    model_cfg = dataclasses.replace(
+                        c.model, bf16_activations=True)
+                from pertgnn_tpu.models.pert_model import make_model
+
+                model = make_model(model_cfg, ds.num_ms, ds.num_entries,
+                                   ds.num_interfaces, ds.num_rpctypes)
+                eng = InferenceEngine(model, state, c, ds.mixtures,
+                                      ds.lookup, budget)
+                var_abs = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    eng._variables)
+                roles = _serve_roles(var_abs, eng._n_feat)
+                for i, rung in enumerate(eng.ladder):
+                    abs_args = (var_abs,
+                                abstract_batch(rung, eng._n_feat))
+                    traced = jax.jit(eng._step).trace(*abs_args)
+                    out.append(ProgramSpec(
+                        name=(f"{name_prefix}/rung{i}_g{rung.max_graphs}"
+                              f"n{rung.max_nodes}e{rung.max_edges}"),
+                        tags=frozenset({"serve", dtype, impl}),
+                        jaxpr=traced.jaxpr,
+                        invar_roles=roles,
+                        out_discard=frozenset({"graph"})))
+            except Exception as e:  # noqa: BLE001 — a variant that no
+                # longer traces is itself an audit finding, never a skip
+                log.exception("graftaudit: building %s failed",
+                              name_prefix)
+                errors.append((name_prefix,
+                               f"{type(e).__name__}: {e}"))
+
+
+def _train_specs(ds, cfg, model, state, out: list, errors: list) -> None:
+    from pertgnn_tpu.train.loop import (_abstract_tree,
+                                        _resolve_device_materialize,
+                                        _train_eval_abstract,
+                                        make_eval_chunk,
+                                        make_eval_chunk_compact,
+                                        make_eval_step,
+                                        make_eval_step_compact,
+                                        make_train_chunk,
+                                        make_train_chunk_compact,
+                                        make_train_step,
+                                        make_train_step_compact, make_tx)
+    import jax
+
+    tx = make_tx(cfg)
+    chunked = cfg.train.scan_chunk > 1
+    suffix = "chunk" if chunked else "step"
+    for compact in (False, True):
+        if compact and not _resolve_device_materialize(ds, cfg):
+            continue
+        kind = "compact" if compact else "packed"
+        try:
+            if compact:
+                dev = ds.device_arenas()
+                mn, me = ds.budget.max_nodes, ds.budget.max_edges
+                train_jit = (make_train_chunk_compact(model, cfg, tx, dev,
+                                                      mn, me) if chunked
+                             else make_train_step_compact(model, cfg, tx,
+                                                          dev, mn, me))
+                eval_jit = (make_eval_chunk_compact(model, cfg, dev, mn,
+                                                    me) if chunked
+                            else make_eval_step_compact(model, cfg, dev,
+                                                        mn, me))
+            else:
+                train_jit = (make_train_chunk(model, cfg, tx) if chunked
+                             else make_train_step(model, cfg, tx))
+                eval_jit = (make_eval_chunk(model, cfg) if chunked
+                            else make_eval_step(model, cfg))
+            abs_args = _train_eval_abstract(ds, cfg, state, compact)
+            state_leaves = jax.tree_util.tree_flatten_with_path(
+                abs_args[0])[0]
+            n_state = len(state_leaves)
+            paths = tuple(jax.tree_util.keystr(p)
+                          for p, _ in state_leaves)
+            for tag, jit_fn, donated in (("train", train_jit, True),
+                                         ("eval", eval_jit, False)):
+                traced = jit_fn.trace(*abs_args)
+                out.append(ProgramSpec(
+                    name=f"{tag}/{suffix}_{kind}",
+                    tags=frozenset({tag}),
+                    jaxpr=traced.jaxpr,
+                    expect_donated_state=donated,
+                    state_flat_count=n_state,
+                    state_paths=paths,
+                    lower=(lambda t=traced: t.lower())
+                    if donated else None))
+        except Exception as e:  # noqa: BLE001 — see _serve_specs
+            log.exception("graftaudit: building train/%s failed", kind)
+            errors.append((f"train/{suffix}_{kind}",
+                           f"{type(e).__name__}: {e}"))
+
+
+def _init_spec(ds, cfg, model, state, out: list, errors: list) -> None:
+    import jax
+
+    from pertgnn_tpu.train.loop import (_abstract_tree, _jitted_model_init,
+                                        _train_sample)
+
+    try:
+        init_jit = _jitted_model_init(model)
+        sample = _train_sample(ds)
+        rng = jax.random.PRNGKey(cfg.train.seed)
+        traced = init_jit.trace(_abstract_tree(rng),
+                                _abstract_tree(sample))
+        out.append(ProgramSpec(name="init/model_init",
+                               tags=frozenset({"init"}),
+                               jaxpr=traced.jaxpr))
+    except Exception as e:  # noqa: BLE001 — see _serve_specs
+        log.exception("graftaudit: building init/model_init failed")
+        errors.append(("init/model_init", f"{type(e).__name__}: {e}"))
+
+
+def _sharded_specs(ds, cfg, model, state, out: list,
+                   errors: list) -> None:
+    import jax
+
+    if len(jax.devices()) < 2:
+        errors.append(("sharded",
+                       "fewer than 2 devices — cannot trace the sharded "
+                       "programs (run under the CPU test platform: "
+                       "XLA_FLAGS=--xla_force_host_platform_device_count"
+                       "=8 before jax import)"))
+        return
+    from pertgnn_tpu.parallel import data_parallel as dp
+    from pertgnn_tpu.parallel.mesh import make_mesh
+    from pertgnn_tpu.train.loop import _abstract_tree, make_tx
+
+    tx = make_tx(cfg)
+    mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    axes = tuple(str(a) for a in mesh.axis_names)
+    try:
+        sstep, sstate = dp.make_sharded_train_step(model, cfg, tx, mesh,
+                                                   state)
+        gb = next(dp.grouped_batches(ds.batches("train"), 2))
+        traced = sstep.trace(_abstract_tree(sstate), _abstract_tree(gb))
+        n_state = len(jax.tree.leaves(sstate))
+        out.append(ProgramSpec(
+            name="sharded/train_step_dp",
+            tags=frozenset({"train", "sharded"}),
+            jaxpr=traced.jaxpr, mesh_axes=axes,
+            expect_donated_state=True, state_flat_count=n_state,
+            state_paths=tuple(
+                jax.tree_util.keystr(p) for p, _ in
+                jax.tree_util.tree_flatten_with_path(sstate)[0]),
+            lower=lambda t=traced: t.lower()))
+    except Exception as e:  # noqa: BLE001 — see _serve_specs
+        log.exception("graftaudit: building sharded/train_step_dp failed")
+        errors.append(("sharded/train_step_dp",
+                       f"{type(e).__name__}: {e}"))
+    try:
+        from pertgnn_tpu.models.pert_model import make_model
+
+        es_model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                              ds.num_interfaces, ds.num_rpctypes,
+                              edge_shard_mesh=mesh)
+        estep, estate = dp.make_edge_sharded_train_step(
+            es_model, cfg, tx, mesh, state)
+        b = next(ds.batches("train"))
+        traced = estep.trace(_abstract_tree(estate), _abstract_tree(b))
+        out.append(ProgramSpec(
+            name="sharded/train_step_edge_shard",
+            tags=frozenset({"train", "sharded"}),
+            jaxpr=traced.jaxpr, mesh_axes=axes))
+    except Exception as e:  # noqa: BLE001 — see _serve_specs
+        log.exception(
+            "graftaudit: building sharded/train_step_edge_shard failed")
+        errors.append(("sharded/train_step_edge_shard",
+                       f"{type(e).__name__}: {e}"))
+
+
+def build_programs() -> tuple[list[ProgramSpec], list[tuple[str, str]]]:
+    """(specs, build_errors). Build errors are audit findings (rule
+    "driver"), not skips — a program variant that stopped tracing is
+    exactly the kind of rot the audit exists to catch. Cached per
+    process; the underlying toy dataset/model are shared."""
+    if "programs" in _CACHE:
+        return _CACHE["programs"]
+    force_cpu_env()
+    ds, cfg, model, state = _toy_stack()
+    specs: list[ProgramSpec] = []
+    errors: list[tuple[str, str]] = []
+    _serve_specs(ds, cfg, state, specs, errors)
+    _train_specs(ds, cfg, model, state, specs, errors)
+    _init_spec(ds, cfg, model, state, specs, errors)
+    _sharded_specs(ds, cfg, model, state, specs, errors)
+    _CACHE["programs"] = (specs, errors)
+    return _CACHE["programs"]

@@ -11,6 +11,17 @@ Options:
     --write-baseline   accept today's findings into the baseline file
                        and exit 0 (reviewable: the file is in-tree)
     --root DIR         repo root (default: this file's repo)
+    --changed-only     lint only files changed vs --changed-base
+                       (default HEAD) plus untracked files — the
+                       pre-commit fast path. FILE-scoped passes only:
+                       repo-contract passes (telemetry-drift,
+                       flag-config-drift, aot-key-coverage) are
+                       skipped with a notice (naming one explicitly
+                       together with the flag is a usage error),
+                       because they compare the WHOLE tree against a
+                       contract and a partial file set would fabricate
+                       drift (docs/LINTS.md)
+    --changed-base REF git ref to diff against (default HEAD)
     --list             list passes and exit
 
 Exit codes: 0 clean (or all findings baselined), 1 new violations,
@@ -32,6 +43,28 @@ def _repo_default() -> str:
         os.path.abspath(__file__))))
 
 
+def _changed_files(repo: str, base: str) -> list[str]:
+    """Repo-relative paths changed vs `base` (tracked, staged or not)
+    plus untracked files — what a pre-commit run should look at.
+    Raises OSError when git cannot answer (not a checkout, bad ref)."""
+    import subprocess
+
+    out: list[str] = []
+    for cmd in (["git", "diff", "--name-only", base, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=repo, capture_output=True,
+                                  text=True, timeout=30)
+        except subprocess.TimeoutExpired as e:
+            raise OSError(f"git timed out: {e}") from e
+        if proc.returncode != 0:
+            raise OSError(proc.stderr.strip()
+                          or f"`{' '.join(cmd)}` failed")
+        out.extend(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return sorted(set(out))
+
+
 def main(argv: list[str] | None = None) -> int:
     from tools.graftlint import driver
     from tools.graftlint.passes import get_passes, registry
@@ -48,6 +81,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--no-baseline", action="store_true")
     p.add_argument("--write-baseline", action="store_true")
     p.add_argument("--root", default=None)
+    p.add_argument("--changed-only", action="store_true")
+    p.add_argument("--changed-base", default="HEAD", metavar="REF")
     p.add_argument("--list", action="store_true")
     p.add_argument("--emit-table", action="store_true",
                    help="telemetry pass only: regenerate "
@@ -117,9 +152,50 @@ def main(argv: list[str] | None = None) -> int:
               f"(--write-baseline creates one; --no-baseline ignores "
               f"baselines)", file=sys.stderr)
         return 2
+    only_files = None
+    skipped_repo_passes: list[str] = []
+    if args.changed_only:
+        if args.write_baseline:
+            print("graftlint: --write-baseline over a --changed-only "
+                  "subset would drop every other file's accepted "
+                  "entries — run them separately", file=sys.stderr)
+            return 2
+        try:
+            only_files = _changed_files(repo, args.changed_base)
+        except OSError as e:
+            print(f"graftlint: cannot resolve changed files ({e}) — "
+                  f"is this a git checkout?", file=sys.stderr)
+            return 2
+        requested = get_passes(args.passes or None)
+        repo_scoped = [m.RULE for m in requested
+                       if getattr(m, "PASS_SCOPE", "file") == "repo"]
+        if args.passes and repo_scoped:
+            # an explicitly-named repo-contract pass cannot run on a
+            # file subset without fabricating drift — refuse rather
+            # than silently widen or silently skip what was asked for
+            print(f"graftlint: {', '.join(repo_scoped)} compare(s) the "
+                  f"WHOLE tree against a contract and cannot run under "
+                  f"--changed-only — drop the flag for these",
+                  file=sys.stderr)
+            return 2
+        skipped_repo_passes = repo_scoped
+        args_passes = [m.RULE for m in requested
+                       if getattr(m, "PASS_SCOPE", "file") == "file"]
+        if skipped_repo_passes:
+            print("graftlint: --changed-only skips repo-contract "
+                  f"pass(es) {', '.join(skipped_repo_passes)} (a "
+                  f"partial file set would fabricate drift) — run the "
+                  f"full suite before pushing", file=sys.stderr)
+        if not args_passes:
+            print("graftlint: no file-scoped passes selected under "
+                  "--changed-only", file=sys.stderr)
+            return 0
+    else:
+        args_passes = args.passes or None
     try:
-        result = driver.run_passes(repo, args.passes or None,
-                                   baseline_path=baseline)
+        result = driver.run_passes(repo, args_passes,
+                                   baseline_path=baseline,
+                                   only_files=only_files)
     except FileNotFoundError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2

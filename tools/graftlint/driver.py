@@ -62,17 +62,26 @@ class Context:
     and a parse cache. Paths are repo-relative with forward slashes."""
 
     # the lint scope, mirroring check_excepts' historical default: the
-    # package, bench.py, and the top-level benchmark oracles. The
-    # vendored parity shim mimics a third-party API — out of scope.
+    # package, bench.py, and the top-level benchmark oracles — plus
+    # tools/graftaudit (the auditor emits audit.* telemetry, so the
+    # telemetry-drift contract must see it; it gets the excepts/
+    # trace-hazard discipline for free). The vendored parity shim
+    # mimics a third-party API — out of scope.
     # Glob semantics are pathlib-style: `*` stays within one path
     # segment, `**/` crosses directories — so "benchmarks/*.py" is
     # top-level only, exactly the legacy default_roots contract.
-    INCLUDE = ("pertgnn_tpu/**/*.py", "bench.py", "benchmarks/*.py")
+    INCLUDE = ("pertgnn_tpu/**/*.py", "bench.py", "benchmarks/*.py",
+               "tools/graftaudit/**/*.py")
     EXCLUDE = ("benchmarks/parity/**",)
 
-    def __init__(self, repo: str):
+    def __init__(self, repo: str, only: list[str] | None = None):
         self.repo = os.path.abspath(repo)
         self.files = self._discover()
+        if only is not None:
+            # --changed-only: restrict the in-scope set to the given
+            # repo-relative paths (files outside INCLUDE stay out)
+            wanted = {p.replace(os.sep, "/") for p in only}
+            self.files = [f for f in self.files if f in wanted]
         self._source: dict[str, str] = {}
         self._tree: dict[str, ast.AST | None] = {}
         self.parse_errors: list[Violation] = []
@@ -209,13 +218,17 @@ class LintResult:
 
 
 def run_passes(repo: str, pass_names: list[str] | None = None,
-               baseline_path: str | None = None) -> LintResult:
+               baseline_path: str | None = None,
+               only_files: list[str] | None = None) -> LintResult:
     """Run the named passes (default: all, in registry order) over the
-    repo and split the findings against the baseline."""
+    repo and split the findings against the baseline. `only_files`
+    restricts the Context's file set (the --changed-only path — the
+    CLI only sends FILE-scoped passes down it; a repo-contract pass on
+    a partial file set would fabricate drift violations)."""
     from tools.graftlint.passes import get_passes
 
     t0 = time.perf_counter()
-    ctx = Context(repo)
+    ctx = Context(repo, only=only_files)
     baseline = load_baseline(
         DEFAULT_BASELINE if baseline_path is None else baseline_path)
     new: list[Violation] = []

@@ -61,6 +61,9 @@ from tools.graftlint.passes._ast_util import (attr_chain,
                                               traced_functions)
 
 RULE = "aot-key-coverage"
+# repo-wide contract: needs the FULL file set (a subset would
+# fabricate drift) — skipped under --changed-only
+PASS_SCOPE = "repo"
 
 SUBTREES = ("ingest", "data", "model", "train", "parallel", "serve",
             "fleet", "telemetry", "aot")

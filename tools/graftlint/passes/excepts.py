@@ -46,6 +46,8 @@ import os
 import sys
 
 RULE = "excepts"
+# per-file findings: sound on any file subset (--changed-only)
+PASS_SCOPE = "file"
 PRAGMA = "lint: allow-silent-except"
 # the generic driver-level pragma must work on BOTH tier-1 entry points
 # (tests/test_check_excepts.py runs this module's legacy surface

@@ -37,6 +37,9 @@ from tools.graftlint.driver import Violation
 from tools.graftlint.passes._ast_util import attr_chain, const_str
 
 RULE = "flag-config-drift"
+# repo-wide contract: needs the FULL file set (a subset would
+# fabricate drift) — skipped under --changed-only
+PASS_SCOPE = "repo"
 
 CONFIG = "pertgnn_tpu/config.py"
 COMMON = "pertgnn_tpu/cli/common.py"

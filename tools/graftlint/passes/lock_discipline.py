@@ -42,6 +42,8 @@ from tools.graftlint.driver import Violation
 from tools.graftlint.passes._ast_util import attr_chain
 
 RULE = "lock-discipline"
+# per-file findings: sound on any file subset (--changed-only)
+PASS_SCOPE = "file"
 
 SCOPE = ("pertgnn_tpu/serve/", "pertgnn_tpu/fleet/",
          "pertgnn_tpu/batching/prefetch.py",

@@ -7,7 +7,8 @@ observability contract ("the tables below are the schema") rots
 silently without a mechanical check.
 
 What counts as an EMISSION: a call ``<recv>.counter/gauge/histogram/
-span/wrap("name", ...)`` anywhere under pertgnn_tpu/ whose name argument
+span/wrap("name", ...)`` anywhere under pertgnn_tpu/ or
+tools/graftaudit/ (the auditor emits audit.*) whose name argument
 resolves statically — a string constant, a constant-armed conditional
 expression, or a local variable assigned only string constants in the
 same function (the ``counter = "serve.shed"; ... bus.counter(counter)``
@@ -45,6 +46,9 @@ from tools.graftlint.driver import Violation
 from tools.graftlint.passes._ast_util import resolve_str_values
 
 RULE = "telemetry-drift"
+# repo-wide contract: needs the FULL file set (a subset would
+# fabricate drift) — skipped under --changed-only
+PASS_SCOPE = "repo"
 
 DOC = "docs/OBSERVABILITY.md"
 _BUS_METHODS = {"counter", "gauge", "histogram", "span", "wrap"}
@@ -106,7 +110,7 @@ def collect_emissions(ctx) -> tuple[dict[str, list[tuple[str, int, str]]],
     for dynamic (unresolvable) names."""
     emitted: dict[str, list[tuple[str, int, str]]] = {}
     dynamic: list[Violation] = []
-    for rel in ctx.files_under("pertgnn_tpu"):
+    for rel in ctx.files_under("pertgnn_tpu", "tools/graftaudit"):
         tree = ctx.tree(rel)
         if tree is None:
             continue
@@ -213,7 +217,7 @@ def _package_literals(ctx) -> set[str]:
     keys — the reverse check's evidence that a documented name (or its
     final segment) still exists somewhere in code."""
     out: set[str] = set()
-    for rel in ctx.files_under("pertgnn_tpu"):
+    for rel in ctx.files_under("pertgnn_tpu", "tools/graftaudit"):
         tree = ctx.tree(rel)
         if tree is None:
             continue
@@ -262,8 +266,8 @@ def run(ctx) -> list[Violation]:
         violations.append(Violation(
             rule=RULE, path=DOC, line=line_no,
             message=(f"documented metric `{name}` no longer appears "
-                     f"anywhere in pertgnn_tpu/ — drop the row or "
-                     f"restore the emission"),
+                     f"anywhere in pertgnn_tpu/ or tools/graftaudit/ — "
+                     f"drop the row or restore the emission"),
             key=f"stale-doc:{name}"))
     return violations
 

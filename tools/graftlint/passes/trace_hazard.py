@@ -45,6 +45,8 @@ from tools.graftlint.driver import Violation
 from tools.graftlint.passes._ast_util import attr_chain, traced_functions
 
 RULE = "trace-hazard"
+# per-file findings: sound on any file subset (--changed-only)
+PASS_SCOPE = "file"
 
 _CONFIG_ROOTS = {"cfg", "config", "self"}
 _STATIC_TAILS = {"shape", "ndim", "dtype", "size"}
