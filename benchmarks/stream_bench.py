@@ -1,0 +1,710 @@
+"""Streaming benchmark: delta merge, warm-restart fine-tune, rollout.
+
+Exit-code-asserts the ISSUE-11 invariants in ONE run (wall-clock numbers
+ride the JSON, the verdict lives in the return code — the
+fleet_bench/chaos_bench split):
+
+- **delta merge** — a corpus sliced into base + 2 time-window shards,
+  ingested through the delta arena store (stream/store.py) and merged
+  (stream/merge.py), must pack BIT-IDENTICAL batches to a from-scratch
+  batch build over the concatenated raw shards — in EITHER delta order.
+- **warm restart** — a FRESH process runs one continual fine-tune round
+  (stream/continual.py) and must reach its first train step with ZERO
+  shard ingests (every shard a `stream.shard_cache_hit`; the shard
+  frame callbacks are armed to raise) and ZERO AOT store misses
+  (`aot.cache_miss` absent from its telemetry) — restart-to-first-step
+  rides the ttfs_s it reports.
+- **rollout** — a 2-worker fleet (cli/fleet_main.py worker role + the
+  in-process FleetRouter) serves live closed-loop traffic while
+  fleet/rollout.py swaps each worker from the base checkpoint to the
+  fine-tuned one: ZERO lost Futures (every request resolves to a
+  prediction), p99 bounded, every prediction bit-identical to the v1 or
+  v2 single-engine reference, and every post-rollout prediction
+  bit-identical to v2.
+- **telemetry** — the `stream.*` and `rollout.*` counters land in the
+  JSONL (docs/OBSERVABILITY.md).
+
+CPU by default. One JSON line on stdout.
+
+    python benchmarks/stream_bench.py [--dryrun] [--skip_rollout]
+
+``--dryrun`` is the CI smoke (tiny corpus, short streams, all four
+assertions).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+class Check:
+    def __init__(self):
+        self.failures: list[str] = []
+
+    def expect(self, cond: bool, what: str):
+        if not cond:
+            self.failures.append(what)
+            print(f"STREAM FAIL: {what}", file=sys.stderr)
+
+
+# -- shared corpus / config construction ----------------------------------
+
+def corpus_spec(dryrun: bool) -> dict:
+    span = 9 * 60 * 1000
+    return {"num_microservices": 14, "num_entries": 3,
+            "patterns_per_entry": 3,
+            "traces_per_entry": 30 if dryrun else 90,
+            "seed": 11, "time_span_ms": span,
+            "missing_resource_frac": 0.0,
+            "ensure_pattern_coverage_before_ms": span // 3,
+            "bounds": [span // 3, 2 * span // 3]}
+
+
+def make_corpus(spec: dict):
+    """(shards, spans, resources): the raw corpus sliced into base + 2
+    time-window delta shards (boundary-crossing traces dropped by the
+    slicer, so the union IS the concatenation)."""
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.stream import shard_frames_by_window
+
+    gen_spec = {k: v for k, v in spec.items() if k != "bounds"}
+    synth = synthetic.generate(synthetic.SyntheticSpec(**gen_spec))
+    shards = shard_frames_by_window(synth.spans, synth.resources,
+                                    spec["bounds"])
+    import pandas as pd
+
+    spans = pd.concat([s[0] for s in shards], ignore_index=True)
+    resources = pd.concat([s[1] for s in shards], ignore_index=True)
+    return shards, spans, resources
+
+
+def make_cfg(tmp: str, budget=None):
+    """The ONE Config both processes and every phase share. The packer
+    budget is pinned once the base derives it, so the fine-tune and
+    rollout programs keep the base's abstract signature (AOT replay
+    instead of recompile — the point of the warm-restart phase)."""
+    import dataclasses
+
+    from pertgnn_tpu.config import (CompileCacheConfig, Config, DataConfig,
+                                    IngestConfig, ModelConfig, StreamConfig,
+                                    TrainConfig)
+
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(max_traces=100_000, batch_size=8),
+        model=ModelConfig(hidden_channels=16, num_layers=2),
+        train=TrainConfig(label_scale=1000.0, epochs=2,
+                          device_materialize=False,
+                          checkpoint_dir=os.path.join(tmp, "ckpt_v1")),
+        stream=StreamConfig(delta_store_dir=os.path.join(tmp, "delta"),
+                            window_shards=2, finetune_epochs=2),
+        aot=CompileCacheConfig(cache_dir=os.path.join(tmp, "aot")),
+        graph_type="pert",
+    )
+    if budget is not None:
+        cfg = cfg.replace(data=dataclasses.replace(
+            cfg.data, max_nodes_per_batch=budget[0],
+            max_edges_per_batch=budget[1]))
+    return cfg
+
+
+def shard_fingerprint(spec: dict, i: int) -> dict:
+    """Deterministic per-shard fingerprint both processes agree on (the
+    CLI path would use cli/common.raw_input_fingerprint over the
+    shard's files; the bench keys the generator spec + window index)."""
+    return {"kind": "stream_bench", "spec": {k: spec[k] for k in
+            sorted(spec) if k != "bounds"},
+            "bounds": list(spec["bounds"]), "window": i}
+
+
+def ingest_all(tmp: str, spec: dict, cfg, shards):
+    """(base, deltas, (pre, table)) through the delta store."""
+    from pertgnn_tpu.ingest.assemble import assemble
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.stream import DeltaArenaStore
+
+    store = DeltaArenaStore(cfg.stream.delta_store_dir)
+    holder: dict = {}
+
+    def pre_table():
+        pre = preprocess(shards[0][0], shards[0][1], cfg.ingest)
+        table = assemble(pre, cfg.ingest)
+        holder["pre_table"] = (pre, table)
+        return pre, table
+
+    base = store.load_or_ingest_base(cfg, shard_fingerprint(spec, 0),
+                                     pre_table)
+    deltas = [store.load_or_ingest_delta(
+        cfg, shard_fingerprint(spec, i),
+        (lambda i=i: (shards[i][0], shards[i][1])), base)
+        for i in (1, 2)]
+    if "pre_table" not in holder:
+        # re-run against a warm --workdir: the store answered, rebuild
+        # the base artifacts in-process for the oracle/dataset phases
+        from pertgnn_tpu.ingest.assemble import assemble
+        from pertgnn_tpu.ingest.preprocess import preprocess
+
+        pre = preprocess(shards[0][0], shards[0][1], cfg.ingest)
+        holder["pre_table"] = (pre, assemble(pre, cfg.ingest))
+    return base, deltas, holder["pre_table"]
+
+
+# -- phase: merge equality -------------------------------------------------
+
+def check_merge_equality(check: Check, cfg, base, deltas, shards) -> dict:
+    import pandas as pd
+
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.stream import merge_shards
+
+    spans_u = pd.concat([s[0] for s in shards], ignore_index=True)
+    res_u = pd.concat([s[1] for s in shards], ignore_index=True)
+    t0 = time.perf_counter()
+    pre_u = preprocess(spans_u, res_u, cfg.ingest)
+    oracle = build_dataset(pre_u, cfg)
+    rebuild_s = time.perf_counter() - t0
+
+    def equal(a, b, tag):
+        ok = True
+        if a.budget != b.budget:
+            check.expect(False, f"{tag}: budget {a.budget} != {b.budget}")
+            return False
+        for name in a.splits:
+            for i, (ba, bb) in enumerate(zip(a.batches(name),
+                                             b.batches(name))):
+                for f in ba._fields:
+                    if not np.array_equal(getattr(ba, f), getattr(bb, f)):
+                        check.expect(False, f"{tag}: {name} batch {i} "
+                                            f"field {f} differs")
+                        ok = False
+        vocab_a = (a.num_ms, a.num_entries, a.num_interfaces,
+                   a.num_rpctypes)
+        vocab_b = (b.num_ms, b.num_entries, b.num_interfaces,
+                   b.num_rpctypes)
+        check.expect(vocab_a == vocab_b,
+                     f"{tag}: vocab sizes {vocab_a} != {vocab_b}")
+        return ok and vocab_a == vocab_b
+
+    merges = {}
+    for tag, order in (("merge_fwd", deltas), ("merge_rev", deltas[::-1])):
+        t0 = time.perf_counter()
+        merged, info = merge_shards(base, list(order), cfg)
+        merges[tag] = time.perf_counter() - t0
+        equal(merged, oracle, tag)
+    return {"rebuild_s": round(rebuild_s, 3),
+            "merge_s": {k: round(v, 3) for k, v in merges.items()},
+            "oracle_traces": sum(len(s) for s in oracle.splits.values())}
+
+
+# -- phase: warm-restart fine-tune (fresh process) -------------------------
+
+def run_finetune_child(args) -> None:
+    """--finetune_child entry: the FRESH process proving warm restart.
+    Shard frame callbacks are armed to raise — any delta-store miss is
+    a loud failure, not a silent re-ingest."""
+    from pertgnn_tpu import telemetry
+    from pertgnn_tpu.config import TelemetryConfig
+    from pertgnn_tpu.stream import (DeltaArenaStore, finetune_round,
+                                    merge_shards)
+
+    tmp = args.workdir
+    spec = corpus_spec(args.dryrun)
+    with open(os.path.join(tmp, "budget.json")) as f:
+        saved = json.load(f)
+    cfg = make_cfg(tmp, budget=(saved["max_nodes"], saved["max_edges"]))
+    cfg = cfg.replace(train=dataclasses.replace(
+        cfg.train, checkpoint_dir=os.path.join(tmp, "ckpt_v2")))
+    telemetry.configure_from_config(
+        TelemetryConfig(telemetry_dir=os.path.join(tmp, "tele_finetune"),
+                        telemetry_level="trace"),
+        run_meta={"cli": "stream_bench_finetune"})
+    from pertgnn_tpu.aot import enable_compile_cache
+    enable_compile_cache(cfg.aot)
+    store = DeltaArenaStore(cfg.stream.delta_store_dir)
+
+    def cold(_what):
+        raise AssertionError(
+            f"warm child hit a COLD delta-store path ({_what}) — the "
+            f"warm-restart contract is broken")
+
+    base = store.load_or_ingest_base(cfg, shard_fingerprint(spec, 0),
+                                     lambda: cold("base"))
+    deltas = [store.load_or_ingest_delta(
+        cfg, shard_fingerprint(spec, i), (lambda i=i: cold(f"delta{i}")),
+        base) for i in (1, 2)]
+    merged, info = merge_shards(base, deltas, cfg)
+    from pertgnn_tpu.batching.dataset import Split
+    frozen = {k: Split(entry_ids=np.asarray(v["entry_ids"], np.int64),
+                       ts_buckets=np.asarray(v["ts_buckets"], np.int64),
+                       ys=np.asarray(v["ys"], np.float32))
+              for k, v in (("valid", saved["frozen_valid"]),
+                           ("test", saved["frozen_test"]))}
+    window = info.window_split(cfg.stream.window_shards)
+    state, history = finetune_round(
+        merged, window, frozen, cfg,
+        cfg.train.checkpoint_dir,
+        baseline_qloss=saved["baseline_qloss"],
+        checkpoint_vocab=saved["checkpoint_vocab"])
+    telemetry.get_bus().flush()
+    print(json.dumps({
+        "finetune_ok": True,
+        "epochs": [h["epoch"] for h in history],
+        "ttfs_s": history[0].get("ttfs_s") if history else None,
+        "valid_qloss": history[-1]["valid_qloss"] if history else None,
+        "window_examples": len(window),
+    }), flush=True)
+
+
+def telemetry_names(tele_dir: str) -> dict:
+    from pertgnn_tpu.telemetry import load_events
+
+    counts: dict[str, int] = {}
+    if not os.path.isdir(tele_dir):
+        return counts
+    for fname in os.listdir(tele_dir):
+        if fname.endswith(".jsonl"):
+            for ev in load_events(os.path.join(tele_dir, fname)):
+                counts[ev["name"]] = counts.get(ev["name"], 0) + 1
+    return counts
+
+
+def check_finetune(check: Check, tmp: str, dryrun: bool) -> dict:
+    cmd = [sys.executable, os.path.abspath(__file__), "--finetune_child",
+           "--workdir", tmp] + (["--dryrun"] if dryrun else [])
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=900,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    if proc.returncode != 0:
+        check.expect(False, f"finetune child exited {proc.returncode}: "
+                            f"{proc.stderr[-2000:]}")
+        return {"rc": proc.returncode}
+    row = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("{") and "finetune_ok" in line:
+            row = json.loads(line)
+    check.expect(bool(row.get("finetune_ok")),
+                 "finetune child produced no result row")
+    names = telemetry_names(os.path.join(tmp, "tele_finetune"))
+    check.expect(names.get("stream.shard_cache_hit", 0) >= 3,
+                 f"finetune child: expected 3 shard cache hits, saw "
+                 f"{names.get('stream.shard_cache_hit', 0)}")
+    check.expect("stream.shard_cache_miss" not in names,
+                 "finetune child: a shard MISSED the delta store "
+                 "(fresh ingest in the warm path)")
+    check.expect("aot.cache_miss" not in names,
+                 "finetune child: an AOT store MISS — the fine-tune "
+                 "recompiled instead of replaying")
+    check.expect(names.get("aot.cache_hit", 0) >= 1,
+                 "finetune child: no AOT store hits recorded")
+    check.expect("stream.qloss_drift" in names,
+                 "finetune child: stream.qloss_drift gauge missing")
+    return {"rc": 0, **row,
+            "aot_hits": names.get("aot.cache_hit", 0),
+            "shard_hits": names.get("stream.shard_cache_hit", 0)}
+
+
+# -- phase: blue/green rollout under live traffic --------------------------
+
+def write_raw_csvs(spans, resources, out_dir: str) -> None:
+    cg = os.path.join(out_dir, "MSCallGraph")
+    rs = os.path.join(out_dir, "MSResource")
+    os.makedirs(cg, exist_ok=True)
+    os.makedirs(rs, exist_ok=True)
+    spans.to_csv(os.path.join(cg, "MSCallGraph_0.csv"))
+    resources.to_csv(os.path.join(rs, "MSResource_0.csv"), index=False)
+
+
+def worker_argv(tmp: str, budget, ckpt_dir: str, wid: str,
+                port: int) -> list[str]:
+    return [sys.executable, "-m", "pertgnn_tpu.cli.fleet_main",
+            "--role", "worker", "--worker_id", wid,
+            "--worker_port", str(port),
+            "--data_dir", os.path.join(tmp, "raw_base"),
+            "--artifact_dir", os.path.join(tmp, "art_base"),
+            "--arena_cache_dir", os.path.join(tmp, "arena"),
+            "--compile_cache_dir", os.path.join(tmp, "aot"),
+            "--checkpoint_dir", ckpt_dir,
+            "--min_traces_per_entry", "5", "--label_scale", "1000",
+            "--graph_type", "pert", "--hidden_channels", "16",
+            "--num_layers", "2", "--batch_size", "8",
+            "--max_nodes_per_batch", str(budget[0]),
+            "--max_edges_per_batch", str(budget[1]),
+            "--no_device_materialize",
+            "--max_graphs_per_batch", "8"]
+
+
+def _await_200(url: str, timeout_s: float) -> dict:
+    from pertgnn_tpu.fleet.transport import (WorkerTransportError,
+                                             get_probe)
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            status, body = get_probe(url, 1.5)
+            if status == 200:
+                return body
+        except WorkerTransportError:
+            pass
+        time.sleep(0.2)
+    raise SystemExit(f"worker at {url} not ready after {timeout_s:.0f}s")
+
+
+def check_rollout(check: Check, tmp: str, cfg, base_ds, budget,
+                  v1_epoch: int, v2_epoch: int, dryrun: bool) -> dict:
+    from pertgnn_tpu.fleet.rollout import (RolloutController, RolloutWorker)
+    from pertgnn_tpu.fleet.router import FleetRouter
+    from pertgnn_tpu.serve.buckets import make_bucket_ladder
+    from pertgnn_tpu.serve.errors import ServeError
+    from pertgnn_tpu.utils.profiling import LatencyRecorder
+
+    # reference predictions per checkpoint version, from in-process
+    # engines over the SAME base dataset the workers serve
+    refs = {}
+    for tag, ckpt in (("v1", "ckpt_v1"), ("v2", "ckpt_v2")):
+        from pertgnn_tpu.serve.engine import InferenceEngine
+        from pertgnn_tpu.train.checkpoint import CheckpointManager
+        from pertgnn_tpu.train.loop import restore_target_state
+
+        c = cfg.replace(train=dataclasses.replace(
+            cfg.train, checkpoint_dir=os.path.join(tmp, ckpt)))
+        _m, state = restore_target_state(base_ds, c)
+        state, _ = CheckpointManager(
+            os.path.join(tmp, ckpt)).maybe_restore(state)
+        eng = InferenceEngine.from_dataset(base_ds, c, state).warmup()
+        uniq: dict[tuple[int, int], float] = {}
+        for s in base_ds.splits.values():
+            for eid, tsb in zip(s.entry_ids, s.ts_buckets):
+                key = (int(eid), int(tsb))
+                if key not in uniq:
+                    uniq[key] = float(eng.predict_microbatch(
+                        [key[0]], [key[1]])[0])
+        refs[tag] = uniq
+    versions_differ = any(refs["v1"][k] != refs["v2"][k]
+                          for k in refs["v1"])
+    check.expect(versions_differ,
+                 "rollout: v1 and v2 predict identically — the "
+                 "fine-tune produced no observable new version, the "
+                 "rollout proves nothing")
+
+    # spawn the v1 fleet
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    ports = [free_port(), free_port()]
+    workers = []
+    for i, port in enumerate(ports):
+        argv = worker_argv(tmp, budget, os.path.join(tmp, "ckpt_v1"),
+                           f"w{i}", port)
+        proc = subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                env={**os.environ,
+                                     "JAX_PLATFORMS": "cpu"})
+        workers.append(RolloutWorker(worker_id=f"w{i}",
+                                     url=f"http://127.0.0.1:{port}",
+                                     handle=proc))
+    t_ready0 = time.perf_counter()
+    for w in workers:
+        body = _await_200(w.url, 300.0)
+        check.expect(body.get("checkpoint_epoch") == v1_epoch,
+                     f"rollout: {w.worker_id} starts at checkpoint "
+                     f"{body.get('checkpoint_epoch')}, wanted {v1_epoch}")
+    ready_s = time.perf_counter() - t_ready0
+
+    top = make_bucket_ladder(base_ds.budget, cfg.serve)[-1]
+
+    def request_size(eid: int):
+        m = base_ds.mixtures[int(eid)]
+        return m.num_nodes, m.num_edges
+
+    req_keys = sorted({(int(e), int(t))
+                       for s in base_ds.splits.values()
+                       for e, t in zip(s.entry_ids, s.ts_buckets)})
+    rng = np.random.default_rng(0)
+
+    stop = threading.Event()
+    lat = LatencyRecorder()
+    lock = threading.Lock()
+    bad: list[str] = []
+    n_served = [0]
+
+    def client(router, tid):
+        order = rng.permutation(len(req_keys))
+        i = 0
+        while not stop.is_set():
+            eid, tsb = req_keys[order[i % len(order)]]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                pred = router.predict(eid, tsb, timeout=120)
+            except ServeError as exc:
+                with lock:
+                    bad.append(f"typed error {type(exc).__name__}: {exc}")
+                continue
+            except BaseException as exc:  # lint: allow-silent-except — surfaced via the check below
+                with lock:
+                    bad.append(f"{type(exc).__name__}: {exc}")
+                continue
+            lat.record_s(time.perf_counter() - t0)
+            if pred not in (refs["v1"][(eid, tsb)],
+                            refs["v2"][(eid, tsb)]):
+                with lock:
+                    bad.append(f"prediction for {(eid, tsb)} matches "
+                               f"NEITHER version: {pred}")
+            with lock:
+                n_served[0] += 1
+
+    results: dict = {}
+    with FleetRouter({w.worker_id: w.url for w in workers}, request_size,
+                     (top.max_graphs, top.max_nodes, top.max_edges),
+                     cfg=cfg.fleet) as router:
+        threads = [threading.Thread(target=client, args=(router, t),
+                                    daemon=True) for t in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # traffic flowing before the first drain
+        pre_p99 = None
+
+        # -- the blue/green rollout, mid-traffic -----------------------
+        def stop_worker(w: RolloutWorker):
+            proc = w.handle
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+
+        def spawn(ckpt):
+            def _spawn(w: RolloutWorker):
+                port = int(w.url.rsplit(":", 1)[1])
+                return subprocess.Popen(
+                    worker_argv(tmp, budget, os.path.join(tmp, ckpt),
+                                w.worker_id, port),
+                    stdout=subprocess.DEVNULL,
+                    env={**os.environ, "JAX_PLATFORMS": "cpu"})
+            return _spawn
+
+        def verify(body: dict):
+            got = body.get("checkpoint_epoch")
+            if got != v2_epoch:
+                return f"checkpoint_epoch {got}, wanted {v2_epoch}"
+            if body.get("compiles", 1) != 0:
+                return f"replacement compiled {body.get('compiles')} " \
+                       f"rungs (AOT store cold?)"
+            return None
+
+        controller = RolloutController(
+            workers, stop_worker=stop_worker,
+            spawn_new=spawn("ckpt_v2"), spawn_old=spawn("ckpt_v1"),
+            verify=verify, ready_timeout_s=300.0)
+        t_roll0 = time.perf_counter()
+        summary = controller.run()
+        rollout_s = time.perf_counter() - t_roll0
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=180)
+
+        # post-rollout: everything must serve v2 now
+        post_bad = 0
+        n_post = min(len(req_keys), 40)
+        for eid, tsb in req_keys[:n_post]:
+            pred = router.predict(eid, tsb, timeout=120)
+            if pred != refs["v2"][(eid, tsb)]:
+                post_bad += 1
+        check.expect(post_bad == 0,
+                     f"rollout: {post_bad}/{n_post} post-rollout "
+                     f"predictions are not the v2 checkpoint's")
+        router_stats = router.stats_dict()
+    for w in workers:
+        stop_worker(w)
+
+    check.expect(not bad, f"rollout: {len(bad)} request failure(s)/"
+                          f"mismatch(es); first: {bad[0] if bad else ''}")
+    check.expect(n_served[0] > 0, "rollout: no requests served at all")
+    check.expect(router_stats["failed"] == 0,
+                 f"rollout: router failed {router_stats['failed']} "
+                 f"future(s) — lost work during the rollout")
+    summary_lat = lat.summary_dict()
+    p99 = summary_lat.get("p99_ms", float("inf"))
+    p50 = summary_lat.get("p50_ms", 0.0)
+    p99_bound = max(20.0 * max(p50, 1.0), 2000.0)
+    check.expect(p99 <= p99_bound,
+                 f"rollout: p99 {p99:.0f}ms not bounded (limit "
+                 f"{p99_bound:.0f}ms = max(20 x p50, 2000ms))")
+    return {"ready_s": round(ready_s, 1),
+            "rollout_s": round(rollout_s, 1),
+            "served_during": n_served[0],
+            "swapped": summary["swapped"],
+            "router": router_stats,
+            "client_latency": summary_lat,
+            "p99_bound_ms": p99_bound,
+            "versions_differ": versions_differ}
+
+
+# -- main ------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dryrun", action="store_true",
+                   help="CI smoke: tiny corpus, short streams, all "
+                        "assertions")
+    p.add_argument("--skip_rollout", action="store_true")
+    p.add_argument("--finetune_child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--workdir", default="")
+    args = p.parse_args(argv)
+
+    if args.finetune_child:
+        run_finetune_child(args)
+        return 0
+
+    from pertgnn_tpu import telemetry
+    from pertgnn_tpu.config import TelemetryConfig
+
+    check = Check()
+    t0 = time.perf_counter()
+    tmp = args.workdir or tempfile.mkdtemp(prefix="stream_bench_")
+    os.makedirs(tmp, exist_ok=True)
+    tele_dir = os.path.join(tmp, "tele_parent")
+    telemetry.configure_from_config(
+        TelemetryConfig(telemetry_dir=tele_dir, telemetry_level="trace"),
+        run_meta={"cli": "stream_bench"})
+    from pertgnn_tpu.aot import enable_compile_cache
+
+    spec = corpus_spec(args.dryrun)
+    shards, _spans_all, _res_all = make_corpus(spec)
+
+    # -- base build + base training (checkpoint v1) ---------------------
+    cfg0 = make_cfg(tmp)
+    enable_compile_cache(cfg0.aot)
+    base, deltas, pre_table = ingest_all(tmp, spec, cfg0, shards)
+    from pertgnn_tpu.batching import build_dataset
+
+    base_ds0 = build_dataset(pre_table[0], cfg0, pre_table[1])
+    budget = (base_ds0.budget.max_nodes, base_ds0.budget.max_edges)
+    cfg = make_cfg(tmp, budget=budget)
+    base_ds = build_dataset(pre_table[0], cfg, pre_table[1])
+
+    from pertgnn_tpu.train.checkpoint import CheckpointManager
+    from pertgnn_tpu.train.loop import fit
+
+    ckpt_v1 = CheckpointManager(os.path.join(tmp, "ckpt_v1"),
+                                keep=cfg.train.checkpoint_keep)
+    _state, history = fit(base_ds, cfg, epochs=cfg.train.epochs,
+                          checkpoint_manager=ckpt_v1)
+    ckpt_v1.wait()
+    v1_epoch = cfg.train.epochs - 1
+    baseline_qloss = history[-1]["valid_qloss"]
+    # v2 starts as a copy of v1; the fine-tune child advances it
+    shutil.copytree(os.path.join(tmp, "ckpt_v1"),
+                    os.path.join(tmp, "ckpt_v2"), dirs_exist_ok=True)
+
+    from pertgnn_tpu.models.pert_model import entry_capacity
+
+    with open(os.path.join(tmp, "budget.json"), "w") as f:
+        json.dump({
+            "max_nodes": budget[0], "max_edges": budget[1],
+            "baseline_qloss": baseline_qloss,
+            "checkpoint_vocab": {
+                "num_ms": base_ds.num_ms,
+                "num_entries": base_ds.num_entries,
+                "num_interfaces": base_ds.num_interfaces,
+                "num_rpctypes": base_ds.num_rpctypes},
+            "frozen_valid": {
+                "entry_ids": base_ds.splits["valid"].entry_ids.tolist(),
+                "ts_buckets": base_ds.splits["valid"].ts_buckets.tolist(),
+                "ys": base_ds.splits["valid"].ys.tolist()},
+            "frozen_test": {
+                "entry_ids": base_ds.splits["test"].entry_ids.tolist(),
+                "ts_buckets": base_ds.splits["test"].ts_buckets.tolist(),
+                "ys": base_ds.splits["test"].ys.tolist()},
+        }, f)
+    # raw CSVs + artifact/arena caches for the fleet workers
+    write_raw_csvs(shards[0][0], shards[0][1],
+                   os.path.join(tmp, "raw_base"))
+    from pertgnn_tpu.cli.common import (build_dataset_cached,
+                                        config_from_args)
+    from pertgnn_tpu.cli.fleet_main import _parser as fleet_parser
+
+    wargs = fleet_parser().parse_args(
+        worker_argv(tmp, budget, os.path.join(tmp, "ckpt_v1"), "seed",
+                    0)[3:])
+    worker_ds = build_dataset_cached(wargs, config_from_args(wargs))
+    check.expect(
+        len(worker_ds.splits["valid"]) == len(base_ds.splits["valid"]),
+        "worker-path dataset (CSV round-trip) differs from the "
+        "in-process base dataset")
+
+    results: dict = {"tmp": tmp,
+                     "base_epochs": cfg.train.epochs,
+                     "baseline_qloss": baseline_qloss}
+
+    # -- phase: merge equality ------------------------------------------
+    results["merge"] = check_merge_equality(check, cfg, base, deltas,
+                                            shards)
+
+    # -- phase: warm-restart fine-tune (fresh process) ------------------
+    results["finetune"] = check_finetune(check, tmp, args.dryrun)
+    v2_epoch = v1_epoch + cfg.stream.finetune_epochs
+
+    # -- phase: rollout under live traffic ------------------------------
+    if not args.skip_rollout:
+        results["rollout"] = check_rollout(check, tmp, cfg, base_ds,
+                                           budget, v1_epoch, v2_epoch,
+                                           args.dryrun)
+
+    telemetry.get_bus().flush()
+    names = telemetry_names(tele_dir)
+    for counter in ("stream.shard_new_entries",
+                    "stream.shard_new_topologies",
+                    "stream.merged_shards", "stream.merge_seconds",
+                    "stream.shard_ingest_seconds"):
+        check.expect(counter in names,
+                     f"telemetry: {counter} missing from the parent "
+                     f"JSONL")
+    if not args.skip_rollout:
+        for counter in ("rollout.started", "rollout.worker_drained",
+                        "rollout.worker_ready", "rollout.completed",
+                        "rollout.worker_swap_seconds"):
+            check.expect(counter in names,
+                         f"telemetry: {counter} missing from the "
+                         f"parent JSONL")
+
+    print(json.dumps({
+        "metric": "stream_invariants_ok",
+        "value": int(not check.failures),
+        "unit": "bool",
+        "dryrun": args.dryrun,
+        "results": results,
+        "violations": check.failures,
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "captured_unix_time": time.time(),
+    }))
+    return 1 if check.failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

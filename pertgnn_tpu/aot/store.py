@@ -61,7 +61,7 @@ log = logging.getLogger(__name__)
 # Bump to orphan every existing entry (layout/semantics change in the
 # store itself — entries are format-versioned independently of the
 # content key).
-_STORE_VERSION = 2
+_STORE_VERSION = 3
 
 _pjrt_support: bool | None = None
 _export_types_registered = False
@@ -150,13 +150,23 @@ class ExecutableStore:
     # -- the one-stop entry point ---------------------------------------
 
     def load_or_build(self, name: str, key: str, components: dict,
-                      jit_fn, abstract_args) -> tuple[object, str]:
+                      jit_fn, abstract_args, *,
+                      donate_argnums: tuple = ()) -> tuple[object, str]:
         """(executable, outcome) for (name, key): outcome is
         "deserialized" (store hit — zero fresh model compiles) or
         "compiled" (miss — built fresh, persisted for the next process).
         ``jit_fn`` must be the already-``jax.jit``-wrapped function
         (donation flags and all); ``abstract_args`` its
-        ShapeDtypeStruct calling signature."""
+        ShapeDtypeStruct calling signature.  ``donate_argnums`` must
+        MIRROR the jit's own donation: the stablehlo replay form wraps
+        the deserialized artifact in a fresh ``jax.jit``, and a
+        donating program replayed WITHOUT the flag leaves jax unaware
+        that XLA aliases the input buffers in place — the caller keeps
+        "live" arrays whose memory the executable reuses, which
+        corrupts the heap the first time anything (e.g. orbax's async
+        checkpoint serializer) still reads them (found by
+        benchmarks/stream_bench.py's warm-restart phase: restored
+        TrainState + replayed donating train step = SIGSEGV)."""
         exe = self.load(name, key, components, abstract_args=abstract_args)
         if exe is not None:
             return exe, "deserialized"
@@ -169,13 +179,14 @@ class ExecutableStore:
                           abstract_args=abstract_args)
             else:
                 exe = self._build_and_save_stablehlo(
-                    name, key, components, jit_fn, abstract_args)
+                    name, key, components, jit_fn, abstract_args,
+                    donate_argnums=donate_argnums)
         bus.histogram("aot.compile_seconds", time.perf_counter() - t0,
                       program=name)
         return exe, "compiled"
 
     def _build_and_save_stablehlo(self, name, key, components, jit_fn,
-                                  abstract_args):
+                                  abstract_args, donate_argnums=()):
         """Export first, then compile the REPLAYED form and make it the
         live executable — the warm path re-lowers the identical
         deserialized artifact, so its backend compile hits the
@@ -194,9 +205,10 @@ class ExecutableStore:
                 name, type(e).__name__, e)
             self._bus.counter("aot.serialize_failed", program=name)
             return jit_fn.lower(*abstract_args).compile()
-        exe = self._replay(blob, abstract_args)
+        exe = self._replay(blob, abstract_args, donate_argnums)
         self._save(name, key, components,
-                   {"format": "stablehlo", "payload": blob})
+                   {"format": "stablehlo", "payload": blob,
+                    "donate_argnums": list(donate_argnums)})
         return exe
 
     # -- load ------------------------------------------------------------
@@ -247,7 +259,8 @@ class ExecutableStore:
                 raise ValueError(
                     "stablehlo entry needs abstract_args to replay")
             with watch_xla_cache() as cache:
-                exe = self._replay(entry["payload"], abstract_args)
+                exe = self._replay(entry["payload"], abstract_args,
+                                   tuple(entry.get("donate_argnums", ())))
             if cache["misses"]:
                 # the save-time compile of this exact form should have
                 # landed in the persistent cache — a miss means that
@@ -262,11 +275,15 @@ class ExecutableStore:
         raise ValueError(f"unknown entry format {entry['format']!r}")
 
     @staticmethod
-    def _replay(blob: bytes, abstract_args):
+    def _replay(blob: bytes, abstract_args, donate_argnums: tuple = ()):
         from jax import export
 
         register_export_types()
-        return jax.jit(export.deserialize(blob).call).lower(
+        # donate_argnums MUST mirror the exported program's own
+        # donation (see load_or_build) — the exported module's
+        # input/output aliasing is invisible to this fresh jit wrapper
+        return jax.jit(export.deserialize(blob).call,
+                       donate_argnums=donate_argnums).lower(
             *abstract_args).compile()
 
     # -- save ------------------------------------------------------------

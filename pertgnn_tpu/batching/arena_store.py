@@ -357,6 +357,37 @@ class ArenaStore:
 
     # -- invalidation diagnostics ---------------------------------------
 
+    @staticmethod
+    def _diff_fingerprint_files(prev_args: dict, now_args: dict
+                                ) -> list[str]:
+        """Per-file diff of the raw-input fingerprint: the one log line
+        an operator actually needs is WHICH shard changed, not a
+        400-char list repr.  Works for both fingerprint modes (stat and
+        content — cli/common.raw_input_fingerprint)."""
+        def rows(args):
+            return {r[0]: tuple(r[1:]) for r in (args.get("files") or [])
+                    if isinstance(r, (list, tuple)) and r}
+
+        pf, nf = rows(prev_args), rows(now_args)
+        added = sorted(set(nf) - set(pf))
+        removed = sorted(set(pf) - set(nf))
+        changed = sorted(k for k in set(pf) & set(nf) if pf[k] != nf[k])
+        out: list[str] = []
+
+        def show(label, names, detail=False):
+            if not names:
+                return
+            shown = ", ".join(
+                (f"{n} ({pf[n]} -> {nf[n]})" if detail else n)
+                for n in names[:5])
+            more = f" (+{len(names) - 5} more)" if len(names) > 5 else ""
+            out.append(f"{label} file(s): {shown}{more}")
+
+        show("changed", changed, detail=True)
+        show("added", added)
+        show("removed", removed)
+        return out
+
     def _log_invalidation(self, key: str, components: dict,
                           slot: str | None) -> None:
         """A miss while OTHER entries of the SAME logical input exist
@@ -387,10 +418,21 @@ class ArenaStore:
                 prev = m
         if prev is None:
             return
-        # file-stat fingerprints diff as one enormous list repr — keep
-        # each changed-ingredient line readable
-        changed = [c if len(c) <= 400 else c[:400] + "...<truncated>"
-                   for c in diff_components(prev, components)]
+        raw = diff_components(prev, components)
+        # the raw-input fingerprint diffs as one enormous list repr —
+        # replace it with a per-file diff naming the exact shard that
+        # changed (the diagnostic the operator acts on)
+        file_msgs: list[str] = []
+        prev_args = prev.get("args")
+        now_args = components.get("args")
+        if isinstance(prev_args, dict) and isinstance(now_args, dict) \
+                and ("files" in prev_args or "files" in now_args):
+            file_msgs = self._diff_fingerprint_files(prev_args, now_args)
+            if file_msgs:
+                raw = [c for c in raw if not c.startswith("args.files")]
+        changed = file_msgs + [
+            c if len(c) <= 400 else c[:400] + "...<truncated>"
+            for c in raw]
         log.warning(
             "arena store: invalidating (saved key %s != wanted %s); "
             "changed: %s — rebuilding the arenas fresh",

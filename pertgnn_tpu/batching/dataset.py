@@ -229,14 +229,26 @@ def build_dataset(pre: PreprocessResult, cfg: Config,
     lookup = ResourceLookup(
         pre.resources,
         missing_indicator_is_one=cfg.model.missing_indicator_is_one)
-
-    meta = table.meta.iloc[:cfg.data.max_traces]
-    if len(meta) == 0:
+    if len(table.meta) == 0:
         raise ValueError(
             "no traces survived preprocessing — check the ingest filters "
             f"(min_traces_per_entry={cfg.ingest.min_traces_per_entry}, "
             f"min_resource_coverage={cfg.ingest.min_resource_coverage}) "
             f"against the input; stats: {pre.stats}")
+    return dataset_from_parts(mixtures, lookup, table.meta, cfg)
+
+
+def dataset_from_parts(mixtures: dict[int, Mixture], lookup: ResourceLookup,
+                       meta, cfg: Config) -> Dataset:
+    """The mixtures/lookup/meta -> Dataset tail of build_dataset, shared
+    with the stream subsystem: a delta-merged corpus
+    (pertgnn_tpu/stream/merge.py) derives its budget, splits, and vocab
+    sizes through the SAME code as a from-scratch rebuild, which is what
+    makes the bit-identical-packing contract provable rather than
+    maintained by hand."""
+    meta = meta.iloc[:cfg.data.max_traces]
+    if len(meta) == 0:
+        raise ValueError("dataset meta is empty — nothing to batch")
     entry_ids = meta["entry_id"].to_numpy(np.int64)
     ts_buckets = meta["ts_bucket"].to_numpy(np.int64)
     ys = meta["y"].to_numpy(np.float32)

@@ -14,7 +14,7 @@ import os
 from pertgnn_tpu.config import (ATTENTION_IMPLS, SERVE_DTYPES,
                                 CompileCacheConfig, Config, DataConfig,
                                 FleetConfig, IngestConfig, ModelConfig,
-                                ParallelConfig, ServeConfig,
+                                ParallelConfig, ServeConfig, StreamConfig,
                                 TelemetryConfig, TrainConfig)
 
 
@@ -207,6 +207,14 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
                         "padded edges) incidence cells per head before "
                         "the layer falls back to the segment path "
                         "(logged + counted)")
+    p.add_argument("--vocab_headroom_entries", type=int,
+                   default=ModelConfig.vocab_headroom_entries,
+                   help="round the entry-embedding capacity UP to the "
+                        "next multiple of this, so new entries arriving "
+                        "on the stream (pertgnn_tpu/stream/) fit the "
+                        "checkpointed embedding and continual training "
+                        "warm-restarts; 0 = exact sizing (reference "
+                        "parity)")
     p.add_argument("--missing_indicator_is_zero", action="store_true",
                    help="preprocess-time indicator convention (1=present) "
                         "instead of the live get_x convention (1=missing)")
@@ -527,6 +535,45 @@ def add_ingest_flags(p: argparse.ArgumentParser) -> None:
                         "entirely. Empty = off. TRUST: write access to "
                         "this dir controls every later run's "
                         "features/labels (docs/GUIDE.md §8)")
+    p.add_argument("--fingerprint_mode", choices=("stat", "content"),
+                   default=DataConfig.fingerprint_mode,
+                   help="how the arena/delta stores key raw input "
+                        "trees: stat = (path, size, mtime) — cheap but "
+                        "a touch-without-change rebuilds everything; "
+                        "content = (path, size, sha256) — immune to "
+                        "mtime churn at the cost of hashing the tree "
+                        "once per process")
+
+
+def add_stream_flags(p: argparse.ArgumentParser) -> None:
+    """Streaming-ingest / continual-training knobs (StreamConfig,
+    pertgnn_tpu/stream/) — train_main's continual surface."""
+    p.add_argument("--delta_store_dir", default="",
+                   help="append-only delta arena store root "
+                        "(stream/store.py): per-shard ingest results, "
+                        "content-keyed; empty = streaming off. TRUST: "
+                        "same boundary as --arena_cache_dir")
+    p.add_argument("--window_shards", type=int,
+                   default=StreamConfig.window_shards,
+                   help="sliding continual-training window: fine-tune "
+                        "on the examples of the last this-many shards "
+                        "(<= 0 = all shards)")
+    p.add_argument("--finetune_epochs", type=int,
+                   default=StreamConfig.finetune_epochs,
+                   help="epochs per warm-restart continual fine-tune "
+                        "round (stream/continual.py)")
+
+
+def stream_config_from_args(args: argparse.Namespace) -> StreamConfig:
+    """The ONE flags -> StreamConfig mapping (same pattern as
+    telemetry_config_from_args); config_from_args embeds it so the
+    sidecar provenance and the live stream cannot drift."""
+    return StreamConfig(
+        delta_store_dir=getattr(args, "delta_store_dir", ""),
+        window_shards=getattr(args, "window_shards",
+                              StreamConfig.window_shards),
+        finetune_epochs=getattr(args, "finetune_epochs",
+                                StreamConfig.finetune_epochs))
 
 
 def config_from_args(args: argparse.Namespace) -> Config:
@@ -546,7 +593,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
                         max_edges_per_batch=args.max_edges_per_batch or None,
                         budget_headroom=args.budget_headroom,
                         arena_cache_dir=getattr(args, "arena_cache_dir",
-                                                "")),
+                                                ""),
+                        fingerprint_mode=getattr(args, "fingerprint_mode",
+                                                 "stat")),
         model=ModelConfig(
             hidden_channels=args.hidden_channels,
             num_layers=args.num_layers,
@@ -562,6 +611,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             feature_all_stage_copies=args.feature_all_stage_copies,
             use_pallas_attention=args.use_pallas_attention,
             attention_impl=args.attention_impl,
+            vocab_headroom_entries=getattr(args, "vocab_headroom_entries",
+                                           0),
             kernel_block_n=args.kernel_block_n,
             kernel_block_e=args.kernel_block_e,
             blocked_dense_max_cells=args.blocked_dense_max_cells,
@@ -608,6 +659,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             serve_dtype=getattr(args, "serve_dtype",
                                 ServeConfig.serve_dtype)),
         fleet=fleet_config_from_args(args),
+        stream=stream_config_from_args(args),
         telemetry=telemetry_config_from_args(args),
         aot=aot_config_from_args(args),
         graph_type=args.graph_type,
@@ -647,13 +699,13 @@ def load_or_ingest_artifacts(args: argparse.Namespace, ingest_cfg):
                              cfg=ingest_cfg)
 
 
-def _stat_fingerprint(root: str, suffixes: tuple[str, ...]) -> list:
-    """(relpath, size, mtime) per matching file under `root`, sorted —
-    a cheap content proxy for multi-GB raw trees where hashing every
-    byte would cost more than the ingest the arena cache is skipping.
-    An edited/added/removed file changes the fingerprint; an in-place
-    same-size same-mtime rewrite is the accepted blind spot (same
-    trade artifact caches and build systems make)."""
+def _walk_fingerprint(root: str, suffixes: tuple[str, ...],
+                      measure) -> list:
+    """(relpath, *measure(path)) per matching file under `root` in
+    deterministic walk order — the ONE traversal both fingerprint
+    modes share, so a future skip rule or ordering tweak cannot apply
+    to one mode and not the other. Files that vanish or error mid-walk
+    are skipped (the next keying sees the change)."""
     out = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames.sort()
@@ -662,28 +714,73 @@ def _stat_fingerprint(root: str, suffixes: tuple[str, ...]) -> list:
                 continue
             path = os.path.join(dirpath, name)
             try:
-                st = os.stat(path)
+                row = measure(path)
             except OSError:
                 continue
-            out.append([os.path.relpath(path, root), st.st_size,
-                        round(st.st_mtime, 3)])
+            out.append([os.path.relpath(path, root), *row])
     return out
 
 
+def _stat_fingerprint(root: str, suffixes: tuple[str, ...]) -> list:
+    """(relpath, size, mtime) per matching file under `root`, sorted —
+    a cheap content proxy for multi-GB raw trees where hashing every
+    byte would cost more than the ingest the arena cache is skipping.
+    An edited/added/removed file changes the fingerprint; an in-place
+    same-size same-mtime rewrite is the accepted blind spot (same
+    trade artifact caches and build systems make)."""
+    def measure(path):
+        st = os.stat(path)
+        return st.st_size, round(st.st_mtime, 3)
+
+    return _walk_fingerprint(root, suffixes, measure)
+
+
+def _content_fingerprint(root: str, suffixes: tuple[str, ...]) -> list:
+    """(relpath, size, sha256-prefix) per matching file under `root`,
+    sorted — the --fingerprint_mode=content alternative to
+    `_stat_fingerprint`: immune to mtime churn (rsync, container image
+    layers, CI checkouts touch files without changing bytes, and under
+    stat keying every touch rebuilds the whole arena), at the cost of
+    reading the tree once per keying process."""
+    import hashlib
+
+    def measure(path):
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return os.stat(path).st_size, f"sha256:{h.hexdigest()[:20]}"
+
+    return _walk_fingerprint(root, suffixes, measure)
+
+
+def _tree_fingerprint(args: argparse.Namespace, root: str,
+                      suffixes: tuple[str, ...]) -> list:
+    mode = getattr(args, "fingerprint_mode", "stat")
+    if mode == "content":
+        return _content_fingerprint(root, suffixes)
+    if mode != "stat":
+        raise SystemExit(f"unknown --fingerprint_mode {mode!r} "
+                         f"(choose stat or content)")
+    return _stat_fingerprint(root, suffixes)
+
+
 def raw_input_fingerprint(args: argparse.Namespace) -> dict:
-    """What the arena store keys the RAW INPUT by (arena_cache_key's
+    """What the arena/delta stores key the RAW INPUT by (the stores'
     args component) — which must mirror `load_or_ingest_artifacts`'
     PRECEDENCE exactly: an existing artifact cache wins over everything
     (including --synthetic flags: the ingest loads the artifacts, so
     keying the spec would let a stale artifact dir be cached under a
     key that claims fresh synthetic data), then the synthetic spec,
-    then the raw CSV tree's file stats."""
+    then the raw CSV tree's files — stat-keyed by default,
+    content-hash-keyed under --fingerprint_mode=content (a
+    touch-without-change then changes nothing)."""
     from pertgnn_tpu.ingest.io import artifacts_present
 
     artifact_dir = getattr(args, "artifact_dir", "")
     if artifact_dir and artifacts_present(artifact_dir):
         return {"kind": "artifacts", "dir": os.path.abspath(artifact_dir),
-                "files": _stat_fingerprint(artifact_dir,
+                "files": _tree_fingerprint(args, artifact_dir,
                                            (".npz", ".parquet", ".json"))}
     if getattr(args, "synthetic", False):
         return {"kind": "synthetic",
@@ -693,7 +790,7 @@ def raw_input_fingerprint(args: argparse.Namespace) -> dict:
     data_dir = getattr(args, "data_dir", "data")
     return {"kind": "raw_csvs", "dir": os.path.abspath(data_dir),
             "stream_factorize": getattr(args, "stream_factorize", False),
-            "files": _stat_fingerprint(data_dir, (".csv",))}
+            "files": _tree_fingerprint(args, data_dir, (".csv",))}
 
 
 def build_dataset_cached(args: argparse.Namespace, cfg: Config,

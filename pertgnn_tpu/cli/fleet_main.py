@@ -184,6 +184,11 @@ def _run_worker(args, p: argparse.ArgumentParser) -> None:
     dataset = build_dataset_cached(args, cfg)
     from pertgnn_tpu.train.loop import restore_target_state
     _model, state = restore_target_state(dataset, cfg)
+    # the checkpoint epoch this worker serves, surfaced in the probe
+    # body: the blue/green rollout controller (fleet/rollout.py) reads
+    # it to VERIFY a replacement actually serves the refreshed
+    # checkpoint before moving to the next worker (-1 = fresh init)
+    ckpt_epoch = -1
     if args.checkpoint_dir:
         from pertgnn_tpu.train.checkpoint import CheckpointManager
         ckpt = CheckpointManager(args.checkpoint_dir,
@@ -191,6 +196,7 @@ def _run_worker(args, p: argparse.ArgumentParser) -> None:
         state, epoch = ckpt.maybe_restore(state)
         if epoch == 0:
             p.error(f"no checkpoint found in {args.checkpoint_dir}")
+        ckpt_epoch = epoch - 1  # maybe_restore returns one PAST the save
 
     from pertgnn_tpu.fleet.transport import WorkerServer
     from pertgnn_tpu.serve.engine import InferenceEngine
@@ -211,7 +217,8 @@ def _run_worker(args, p: argparse.ArgumentParser) -> None:
                 "deserialized": engine.deserialized,
                 "arena_warm": arena_warm,
                 "warmup_s": engine.warmup_s,
-                "serve_dtype": engine.serve_dtype}
+                "serve_dtype": engine.serve_dtype,
+                "checkpoint_epoch": ckpt_epoch}
 
     server = WorkerServer(engine, queue, port=args.worker_port,
                           extra_fn=extra)

@@ -81,6 +81,15 @@ class DataConfig:
     # training data — whoever can write this directory controls every
     # later run's features/labels; keep it as private as checkpoints.
     arena_cache_dir: str = ""
+    # How cli/common.raw_input_fingerprint keys raw input trees for the
+    # arena/delta stores: "stat" (relpath, size, mtime — cheap, but a
+    # touch-without-change rebuilds everything) or "content" (relpath,
+    # size, sha256 of the bytes — immune to mtime churn from rsync /
+    # container image layers / CI checkouts, at the cost of hashing the
+    # tree once per process). Switching modes re-keys the store once
+    # (the invalidation diagnostics name the fingerprint as the changed
+    # ingredient).
+    fingerprint_mode: str = "stat"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -176,6 +185,16 @@ class ModelConfig:
     # Parameter/activation dtype for the MXU. Params stay f32; activations in
     # bf16 when True.
     bf16_activations: bool = False
+    # Entry-embedding capacity headroom for the streaming path
+    # (pertgnn_tpu/stream/): round the entry-embedding table size UP to
+    # the next multiple of this, so a delta shard that introduces a NEW
+    # entry (a new dm_interface combination over existing strings) still
+    # fits the checkpointed embedding and the continual trainer can
+    # warm-restart instead of cold-retraining. 0 (default) = exact
+    # sizing, the reference-parity behavior; growth past the rounded
+    # capacity is a loud rebuild (stream/merge.py). Changes model
+    # shapes, so it rides checkpoints and every AOT key via cfg.model.
+    vocab_headroom_entries: int = 0
     # Weight-init scheme. "torch" (default): kaiming-uniform(a=sqrt5) for
     # every Linear kernel — what torch.nn.Linear (and therefore the
     # reference's PyG stack) trains with; measured 98.2+-5.5 train-fit MAE
@@ -417,6 +436,33 @@ class FleetConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Streaming ingest + continual training knobs (pertgnn_tpu/stream/).
+
+    The live-traffic subsystem: new trace shards ingest, featurize, and
+    persist INDEPENDENTLY into an append-only delta arena store keyed on
+    each shard's own fingerprint (stream/store.py), a mixture-merge
+    reconstitutes the serving/training corpus from base + deltas without
+    a full rebuild (stream/merge.py, bit-identical to a from-scratch
+    rebuild — benchmarks/stream_bench.py exit-code-asserts it), and a
+    sliding window of recent shards drives warm-restart fine-tuning from
+    the latest checkpoint (stream/continual.py). Paired with the
+    blue/green fleet rollout controller (fleet/rollout.py)."""
+
+    # Root directory of the append-only delta arena store. Empty = the
+    # streaming path is off. TRUST: same boundary as arena_cache_dir —
+    # entries are plain arrays + JSON, but they ARE the training data.
+    delta_store_dir: str = ""
+    # Sliding fine-tune window: warm-restart training sees the examples
+    # of the LAST this-many shards (the base corpus counts as shard 0);
+    # <= 0 = every shard (full-corpus fine-tune).
+    window_shards: int = 4
+    # Epochs per continual fine-tune round (short on purpose: the point
+    # is a fresh checkpoint in seconds-to-minutes, not convergence).
+    finetune_epochs: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
 class CompileCacheConfig:
     """Cold-start elimination knobs (pertgnn_tpu/aot/).
 
@@ -498,6 +544,7 @@ class Config:
     parallel: ParallelConfig = ParallelConfig()
     serve: ServeConfig = ServeConfig()
     fleet: FleetConfig = FleetConfig()
+    stream: StreamConfig = StreamConfig()
     telemetry: TelemetryConfig = TelemetryConfig()
     aot: CompileCacheConfig = CompileCacheConfig()
     # span | pert (reference: pert_gnn.py:32).

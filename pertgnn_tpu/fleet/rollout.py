@@ -1,0 +1,220 @@
+"""Zero-downtime blue/green fleet rollout: one worker at a time.
+
+The last arc of the streaming loop (ROADMAP item 1): continual training
+(stream/continual.py) refreshes the checkpoint; this controller rolls it
+across the PR-7 fleet without dropping a request.  Per worker, in
+sequence:
+
+1. **drain** — stop the old worker through the existing SIGTERM drain
+   machinery (cli/fleet_main.py worker role): admissions stop, the
+   undispatched backlog is handed back as retryable QueueClosed rows,
+   and the router requeues it to the survivors while its membership
+   prober excludes the draining member;
+2. **restart warm** — spawn the replacement on the SAME port with the
+   refreshed checkpoint; the shared AOT + arena/delta stores make
+   cold-to-ready seconds, which is the whole reason rolling one worker
+   at a time is cheap;
+3. **verify** — poll the replacement's /healthz until 200 and run the
+   caller's verification over the probe body (e.g. ``checkpoint_epoch``
+   equals the refreshed step: the probe carries warm-start AND version
+   evidence); the router re-admits the member on its next probe;
+4. **proceed or roll back** — on verified readiness, next worker; on
+   timeout/verification failure, kill the replacement, respawn the OLD
+   configuration, confirm IT is ready, and abort the rollout loudly
+   (counter ``rollout.rollback``) — a half-new fleet serving two
+   checkpoint versions indefinitely is the failure mode this exists to
+   prevent (docs/RELIABILITY.md).
+
+The controller is deliberately process-agnostic: the caller injects
+``stop_worker`` / ``spawn_new`` / ``spawn_old`` callables (subprocess
+SIGTERM+spawn in benchmarks/stream_bench.py and cli fleets; plain fakes
+in tests/test_stream.py), so the sequencing and rollback logic is
+unit-testable without a fleet.  It runs on the CALLER's thread — the
+fleet keeps serving because the router and the surviving workers are
+other processes/threads entirely.
+
+Invariant (exit-code-asserted by stream_bench under live closed-loop
+traffic): a rollout loses ZERO Futures — every request submitted before,
+during, and after resolves to a prediction or a typed error — and p99
+stays bounded, because at most one worker is ever out of membership.
+
+Telemetry (docs/OBSERVABILITY.md): counters ``rollout.started`` /
+``rollout.worker_drained`` / ``rollout.worker_ready`` /
+``rollout.rollback`` / ``rollout.failed`` / ``rollout.completed``,
+histogram ``rollout.worker_swap_seconds``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.fleet.transport import WorkerTransportError, get_probe
+
+log = logging.getLogger(__name__)
+
+
+class RolloutError(RuntimeError):
+    """The rollout aborted.  `rolled_back` tells the operator whether
+    the failing slot was restored to the OLD checkpoint (True: the
+    fleet is whole again, on mixed=no/old version) or is DOWN (False:
+    the fleet is degraded by one worker — page someone)."""
+
+    def __init__(self, message: str, *, worker_id: str,
+                 rolled_back: bool):
+        super().__init__(message)
+        self.worker_id = worker_id
+        self.rolled_back = rolled_back
+
+
+@dataclasses.dataclass
+class RolloutWorker:
+    """One fleet slot as the controller sees it: identity, probe URL,
+    and an opaque process handle the injected callables understand."""
+
+    worker_id: str
+    url: str
+    handle: Any = None
+
+
+class RolloutController:
+    """Sequential blue/green rollout over a fixed worker set.
+
+    `stop_worker(worker)` must stop the CURRENT process behind
+    `worker.handle` and return once it exited (the drain path);
+    `spawn_new(worker)` / `spawn_old(worker)` must start a replacement
+    on the worker's port serving the refreshed / previous checkpoint
+    and return the new handle.  `verify(body) -> str | None` inspects a
+    200 probe body and returns a human-readable complaint (or None) —
+    e.g. "checkpoint_epoch is 3, wanted 5"."""
+
+    def __init__(self, workers: list[RolloutWorker], *,
+                 stop_worker: Callable[[RolloutWorker], None],
+                 spawn_new: Callable[[RolloutWorker], Any],
+                 spawn_old: Callable[[RolloutWorker], Any],
+                 verify: Callable[[dict], str | None] | None = None,
+                 probe: Callable[..., tuple[int, dict]] = get_probe,
+                 ready_timeout_s: float = 300.0,
+                 poll_interval_s: float = 0.25,
+                 bus=None):
+        if not workers:
+            raise ValueError("rollout needs at least one worker")
+        self._workers = list(workers)
+        self._stop = stop_worker
+        self._spawn_new = spawn_new
+        self._spawn_old = spawn_old
+        self._verify = verify
+        self._probe = probe
+        self._ready_timeout_s = ready_timeout_s
+        self._poll_interval_s = poll_interval_s
+        self._injected_bus = bus
+
+    @property
+    def bus(self):
+        return (self._injected_bus if self._injected_bus is not None
+                else telemetry.get_bus())
+
+    # -- readiness -------------------------------------------------------
+
+    def _await_ready(self, w: RolloutWorker, *,
+                     use_verify: bool = True) -> tuple[bool, str]:
+        """(ready-and-verified, complaint).  Polls until a 200 whose
+        body passes `verify`, or the timeout.  A 200 that FAILS
+        verification keeps polling (warmup races can answer 200 before
+        identity fields settle) but reports the last complaint.
+        ``use_verify=False`` checks plain readiness only — the rollback
+        path respawns the OLD checkpoint, which the caller's
+        new-version verification would (correctly) never accept."""
+        deadline = time.monotonic() + self._ready_timeout_s
+        complaint = "never answered the readiness probe"
+        while time.monotonic() < deadline:
+            try:
+                status, body = self._probe(w.url, timeout_s=2.0)
+            except WorkerTransportError:
+                status, body = -1, {}
+            if status == 200:
+                bad = (self._verify(body)
+                       if (use_verify and self._verify) else None)
+                if bad is None:
+                    return True, ""
+                complaint = f"ready but failed verification: {bad}"
+            elif status >= 0:
+                complaint = f"probe answered {status} (not ready)"
+            time.sleep(self._poll_interval_s)
+        return False, complaint
+
+    # -- the rollout -----------------------------------------------------
+
+    def run(self) -> dict:
+        """Roll every worker; returns a summary dict.  Raises
+        RolloutError on the first worker that cannot be brought up on
+        the new checkpoint (after attempting rollback to the old)."""
+        bus = self.bus
+        bus.counter("rollout.started", workers=len(self._workers))
+        swapped: list[str] = []
+        for w in self._workers:
+            t0 = time.perf_counter()
+            log.info("rollout: draining worker %s", w.worker_id)
+            self._stop(w)
+            bus.counter("rollout.worker_drained", worker=w.worker_id)
+            try:
+                w.handle = self._spawn_new(w)
+                ok, complaint = self._await_ready(w)
+            except Exception as e:
+                # a replacement that never spawns (exec failure, port
+                # bind race) is the same failure as one that never
+                # answers 200 — it must reach the SAME rollback path,
+                # not escape with the slot empty and no telemetry
+                log.exception("rollout: spawning the replacement for "
+                              "%s failed", w.worker_id)
+                ok = False
+                complaint = f"spawn_new raised {type(e).__name__}: {e}"
+            if not ok:
+                self._rollback(w, complaint)
+            dt = time.perf_counter() - t0
+            bus.counter("rollout.worker_ready", worker=w.worker_id)
+            bus.histogram("rollout.worker_swap_seconds", dt,
+                          worker=w.worker_id)
+            swapped.append(w.worker_id)
+            log.info("rollout: worker %s swapped in %.1fs", w.worker_id,
+                     dt)
+        bus.counter("rollout.completed", workers=len(swapped))
+        return {"swapped": swapped, "workers": len(self._workers)}
+
+    def _rollback(self, w: RolloutWorker, complaint: str) -> None:
+        """The failing slot goes back to the OLD checkpoint; the
+        rollout aborts either way — loudly."""
+        bus = self.bus
+        log.error("rollout: worker %s failed readiness on the new "
+                  "checkpoint (%s) — rolling this slot back",
+                  w.worker_id, complaint)
+        bus.counter("rollout.rollback", worker=w.worker_id)
+        try:
+            self._stop(w)
+        except Exception as e:
+            log.warning("rollout: stopping the failed replacement for "
+                        "%s raised %s: %s (continuing to respawn)",
+                        w.worker_id, type(e).__name__, e)
+        w.handle = self._spawn_old(w)
+        # readiness only: the old checkpoint must not be judged by the
+        # NEW version's verification (it would always "fail", reporting
+        # every successful rollback as a degraded fleet)
+        ok, old_complaint = self._await_ready(w, use_verify=False)
+        bus.counter("rollout.failed", worker=w.worker_id,
+                    rolled_back=ok)
+        if not ok:
+            raise RolloutError(
+                f"worker {w.worker_id} failed readiness on the NEW "
+                f"checkpoint ({complaint}) AND its rollback to the old "
+                f"checkpoint failed ({old_complaint}) — the fleet is "
+                f"running degraded by one worker",
+                worker_id=w.worker_id, rolled_back=False)
+        raise RolloutError(
+            f"worker {w.worker_id} failed readiness on the new "
+            f"checkpoint ({complaint}); the slot was rolled back to the "
+            f"old checkpoint and the fleet is whole, still serving the "
+            f"previous version",
+            worker_id=w.worker_id, rolled_back=True)

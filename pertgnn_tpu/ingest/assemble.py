@@ -132,6 +132,15 @@ def _assemble(pre: PreprocessResult, cfg: IngestConfig) -> TraceTable:
         "ts_bucket": tr2bucket.loc[corpus.index].values,
         "y": tr2delay.loc[corpus.index].values.astype(np.float64),
     })
+    return table_from_meta(meta)
+
+
+def table_from_meta(meta: pd.DataFrame) -> TraceTable:
+    """The meta -> TraceTable tail of assemble, shared with the stream
+    subsystem (pertgnn_tpu/stream/merge.py builds a merged meta from
+    base + delta shard entries and must derive mixture weights and
+    representatives through the SAME code the batch path uses, so the
+    two cannot drift)."""
     # reference iteration order: sorted by entry, then by trace within entry
     meta = meta.sort_values(["entry_id", "traceid"],
                             kind="stable").reset_index(drop=True)

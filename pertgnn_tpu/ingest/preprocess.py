@@ -236,8 +236,17 @@ def _preprocess(spans: pd.DataFrame, resources: pd.DataFrame,
     n0 = df["traceid"].nunique()
     df = filter_by_resource_coverage(df, resource_df, cfg)
     n1 = df["traceid"].nunique()
+    num_coverage_dropped = n0 - n1
     log.info("resource-coverage filter (>= %.2f): %d -> %d traces (-%d)",
              cfg.min_resource_coverage, n0, n1, n0 - n1)
+    # per-entry occurrence among coverage survivors, BEFORE the
+    # occurrence filter — stream/merge.py's filter-drift guard compares
+    # these against cumulative delta counts to detect (loudly) when a
+    # batch rebuild of the grown corpus would resurrect traces this
+    # build dropped
+    occ_pre = df.groupby("entryid")["traceid"].nunique()
+    entry_occ_prefilter = {str(entryid_vocab[int(code)]): int(c)
+                           for code, c in occ_pre.items()}
     df = filter_by_entry_occurrence(df, cfg)
     n2, e2 = df["traceid"].nunique(), df["entryid"].nunique()
     log.info("entry-occurrence filter (> %d): %d -> %d traces (-%d), "
@@ -259,8 +268,18 @@ def _preprocess(spans: pd.DataFrame, resources: pd.DataFrame,
     df["endTimestamp"] = df["timestamp"] + df["rt"].abs()
 
     stats = dict(entry_stats)
+    stats["entry_occ_prefilter"] = entry_occ_prefilter
+    # stream/merge.py's coverage-drift guard: when this is 0, no later
+    # resource rows can resurrect a base trace (nothing was dropped)
+    stats["num_coverage_dropped"] = int(num_coverage_dropped)
     stats["num_traces_final"] = int(df["traceid"].nunique())
     stats["num_entries_final"] = int(df["entryid"].nunique())
+    # RAW span time range (pre-filter: dropped traces still occupied
+    # sort positions, so stream/merge.py's shard-ordering guard must see
+    # the full range, not the survivors')
+    if len(spans):
+        stats["span_ts_min"] = int(spans["timestamp"].min())
+        stats["span_ts_max"] = int(spans["timestamp"].max())
     return PreprocessResult(
         spans=df.reset_index(drop=True),
         resources=resource_df,

@@ -60,6 +60,13 @@ class SyntheticSpec:
     # Wall-clock span of trace start times (ms).
     time_span_ms: int = 10 * 60 * 1000
     ts_bucket_ms: int = 30_000
+    # Streaming-scenario knob (pertgnn_tpu/stream/): when set, the FIRST
+    # trace of every (entry, pattern) pair starts before this instant,
+    # so a base corpus sliced at/after it covers the full ms/interface/
+    # rpctype vocabulary and later time-window shards ingest vocab-
+    # stably (stream/delta.py).  None (default) leaves start times
+    # untouched — byte-identical output to previous versions.
+    ensure_pattern_coverage_before_ms: int | None = None
     seed: int = 0
 
 
@@ -168,12 +175,43 @@ def generate(spec: SyntheticSpec = SyntheticSpec()) -> SyntheticData:
     for e_idx, entry in enumerate(entries):
         choices = rng.choice(len(entry["patterns"]),
                              size=spec.traces_per_entry, p=entry["probs"])
+        if spec.ensure_pattern_coverage_before_ms is not None:
+            # every pattern must OCCUR in the stream of choices or the
+            # coverage promise is vacuous. Each missing pattern
+            # replaces the LAST occurrence of the currently most
+            # frequent one — never truncation, which could silently
+            # drop a pattern whose only occurrence sat in the tail
+            choices = choices.copy()
+            for p in range(len(entry["patterns"])):
+                if p in choices:
+                    continue
+                counts = np.bincount(choices,
+                                     minlength=len(entry["patterns"]))
+                donor = int(np.argmax(counts))
+                if counts[donor] <= 1:
+                    break  # traces_per_entry < patterns: cover what fits
+                choices[np.where(choices == donor)[0][-1]] = p
+        seen_patterns: set[int] = set()
         for p_idx in choices:
             pat = entry["patterns"][p_idx]
             traceid = f"tr_{trace_counter:06d}"
             trace_counter += 1
             trace_pattern[traceid] = (e_idx, int(p_idx))
             t0 = int(rng.integers(0, spec.time_span_ms))
+            if (spec.ensure_pattern_coverage_before_ms is not None
+                    and int(p_idx) not in seen_patterns):
+                # fold the first sight of each pattern into the early
+                # window WITHOUT extra rng draws (determinism of the
+                # remaining stream is preserved). The WHOLE trace must
+                # land before the boundary — span offsets reach 499 ms
+                # past t0, and stream slicers drop boundary-crossing
+                # traces (shard_frames_by_window), which would silently
+                # un-cover the pattern — so fold t0 with a margin
+                margin = 600
+                bound = max(spec.ensure_pattern_coverage_before_ms
+                            - margin, 1)
+                t0 = t0 % bound
+                seen_patterns.add(int(p_idx))
             bucket = t0 // spec.ts_bucket_ms * spec.ts_bucket_ms
             # latency signal: entry base * pattern multiplier, scaled by the
             # OBSERVABLE time-varying cpu load of the entry microservice

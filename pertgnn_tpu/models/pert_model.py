@@ -136,9 +136,26 @@ class PertGNN(nn.Module):
         return global_pred.astype(jnp.float32), local_pred.astype(jnp.float32)
 
 
+def entry_capacity(num_entries: int, headroom_multiple: int) -> int:
+    """The entry-embedding table size for a dataset with `num_entries`
+    entries under ModelConfig.vocab_headroom_entries: rounded UP to the
+    next multiple so the table size is stable while the live corpus
+    grows within the current capacity window (new entries land in
+    pre-allocated rows and the checkpoint keeps restoring) — and
+    changes LOUDLY (a different model shape) only when growth crosses
+    the window. 0 = exact sizing."""
+    if headroom_multiple <= 0:
+        return num_entries
+    return -(-num_entries // headroom_multiple) * headroom_multiple
+
+
 def make_model(cfg: ModelConfig, num_ms: int, num_entries: int,
                num_interfaces: int, num_rpctypes: int,
                edge_shard_mesh: Any = None) -> PertGNN:
+    # THE construction point: fit(), the serve engine, precompile, and
+    # graftaudit all come through here, so the entry-capacity headroom
+    # cannot apply in one layer and not another
+    num_entries = entry_capacity(num_entries, cfg.vocab_headroom_entries)
     return PertGNN(cfg=cfg, num_ms=num_ms, num_entries=num_entries,
                    num_interfaces=num_interfaces, num_rpctypes=num_rpctypes,
                    edge_shard_mesh=edge_shard_mesh)

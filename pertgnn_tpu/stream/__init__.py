@@ -1,0 +1,24 @@
+"""Streaming subsystem: append-only delta arenas, mixture merge,
+sliding-window continual training (ROADMAP item 1 — the live-traffic
+scenario).  See stream/delta.py for the vocab-stability contract,
+stream/merge.py for the bit-identical-merge contract and its loud
+rebuild guards, stream/continual.py for warm-restart fine-tuning, and
+fleet/rollout.py for the blue/green checkpoint rollout the stream
+feeds.  benchmarks/stream_bench.py exit-code-asserts the whole loop."""
+
+from pertgnn_tpu.stream.continual import (check_capacity, finetune_programs,
+                                          finetune_round, window_dataset)
+from pertgnn_tpu.stream.delta import (ShardDelta, VocabGrowth, base_shard,
+                                      ingest_delta, shard_frames_by_window,
+                                      vocab_hash)
+from pertgnn_tpu.stream.merge import (MergeInfo, StreamRebuildRequired,
+                                      merge_shards)
+from pertgnn_tpu.stream.store import DeltaArenaStore, shard_cache_key
+
+__all__ = [
+    "ShardDelta", "VocabGrowth", "base_shard", "ingest_delta",
+    "shard_frames_by_window", "vocab_hash", "MergeInfo",
+    "StreamRebuildRequired", "merge_shards", "DeltaArenaStore",
+    "shard_cache_key", "check_capacity", "finetune_programs",
+    "finetune_round", "window_dataset",
+]

@@ -1,0 +1,159 @@
+"""Sliding-window continual training: warm-restart fine-tuning.
+
+One continual round = (1) reconstitute the merged corpus from the delta
+arena store (zero ingest when the shards are cached), (2) swap the train
+split for the sliding window of recent shards (``MergeInfo.window_split``),
+(3) warm-restart ``fit()`` from the latest checkpoint for a few epochs.
+Because the programs resolve through the AOT executable store and the
+data through the delta store, restart-to-first-step is seconds — the
+``ttfs_s`` row fit() already emits is the metric, and
+benchmarks/stream_bench.py exit-code-asserts the structural evidence
+(zero shard ingests, zero AOT store misses) in a REAL fresh process.
+
+Drift gauges on the bus (docs/OBSERVABILITY.md): the merge emits
+``stream.shard_new_entries`` / ``stream.shard_new_topologies`` per
+shard; this module adds ``stream.finetune_window`` (examples in the
+window) and ``stream.qloss_drift`` — the relative quantile-loss drift of
+the refreshed model on the FROZEN base eval split, the one number an
+operator alarms on before rolling the checkpoint out
+(fleet/rollout.py).
+
+Model-capacity contract: the string vocabularies are pinned
+(stream/delta.py), so ``num_ms`` / ``num_interfaces`` / ``num_rpctypes``
+cannot outgrow the checkpoint; NEW ENTRIES can.  With
+``ModelConfig.vocab_headroom_entries`` the entry embedding is sized to a
+capacity window (models/pert_model.entry_capacity) and growth within it
+warm-restarts cleanly; growth past it — or any growth with headroom 0 —
+raises :class:`~pertgnn_tpu.stream.merge.StreamRebuildRequired` naming
+the grown dimension, because silently re-initializing embeddings under a
+serving fleet is the bug this check exists to prevent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.batching.dataset import Dataset, Split
+from pertgnn_tpu.config import Config
+from pertgnn_tpu.stream.merge import StreamRebuildRequired
+
+log = logging.getLogger(__name__)
+
+
+def window_dataset(dataset: Dataset, window: Split,
+                   frozen_eval: dict[str, Split]) -> Dataset:
+    """The merged dataset with the train split replaced by the sliding
+    window and valid/test pinned to the FROZEN base eval splits (drift
+    must be measured against a fixed yardstick — a positional split
+    over the grown corpus would move with every shard).  Feature-arena
+    and device-arena caches reset: they are split-shaped."""
+    return dataclasses.replace(
+        dataset,
+        splits={"train": window, "valid": frozen_eval["valid"],
+                "test": frozen_eval["test"]},
+        _feat_all=None, _feat_slices={}, _epoch_cache={},
+        _device_arenas=None)
+
+
+def check_capacity(dataset: Dataset, cfg: Config,
+                   checkpoint_vocab: dict | None) -> None:
+    """Refuse (loudly) to warm-restart onto embeddings the merged corpus
+    has outgrown.  `checkpoint_vocab` is the vocab-size dict the
+    checkpointed model was built with ({num_ms, num_entries,
+    num_interfaces, num_rpctypes}); None skips the check (orbax then
+    fails on the shape mismatch, just less helpfully)."""
+    if checkpoint_vocab is None:
+        return
+    from pertgnn_tpu.models.pert_model import entry_capacity
+
+    h = cfg.model.vocab_headroom_entries
+    grown = []
+    if (entry_capacity(dataset.num_entries, h)
+            != entry_capacity(int(checkpoint_vocab["num_entries"]), h)):
+        grown.append(
+            f"num_entries {checkpoint_vocab['num_entries']} -> "
+            f"{dataset.num_entries} (capacity multiple "
+            f"vocab_headroom_entries={h})")
+    for dim in ("num_ms", "num_interfaces", "num_rpctypes"):
+        if getattr(dataset, dim) > int(checkpoint_vocab[dim]):
+            grown.append(f"{dim} {checkpoint_vocab[dim]} -> "
+                         f"{getattr(dataset, dim)}")
+    if grown:
+        raise StreamRebuildRequired(
+            "model_capacity",
+            "merged corpus outgrew the checkpointed embeddings ("
+            + "; ".join(grown) + ") — cold-retrain on the merged corpus "
+            "(and consider --vocab_headroom_entries so future new "
+            "entries land in pre-allocated rows)")
+
+
+def finetune_round(dataset: Dataset, window: Split,
+                   frozen_eval: dict[str, Split], cfg: Config,
+                   checkpoint_dir: str, *, bus=None,
+                   baseline_qloss: float | None = None,
+                   checkpoint_vocab: dict | None = None):
+    """One warm-restart fine-tune round.  Returns (state, history).
+
+    Restores the LATEST checkpoint in `checkpoint_dir` (refusing to run
+    cold — a continual round without a checkpoint is a configuration
+    error, not a silent full train), trains
+    ``cfg.stream.finetune_epochs`` epochs on the window, checkpoints,
+    and emits the drift gauges."""
+    from pertgnn_tpu.train.checkpoint import CheckpointManager
+    from pertgnn_tpu.train.loop import fit
+
+    bus = bus if bus is not None else telemetry.get_bus()
+    check_capacity(dataset, cfg, checkpoint_vocab)
+    ds = window_dataset(dataset, window, frozen_eval)
+    ckpt = CheckpointManager(checkpoint_dir, keep=cfg.train.checkpoint_keep)
+    latest = ckpt.latest_step()
+    if latest is None:
+        raise ValueError(
+            f"no checkpoint in {checkpoint_dir!r} to warm-restart from — "
+            f"train the base model first (continual rounds fine-tune, "
+            f"they never cold-start)")
+    start = latest + 1
+    epochs = start + max(1, cfg.stream.finetune_epochs)
+    bus.gauge("stream.finetune_window", len(window))
+    log.info("continual round: warm restart from epoch %d, %d window "
+             "example(s), %d fine-tune epoch(s)", latest, len(window),
+             epochs - start)
+    state, history = fit(ds, cfg, epochs=epochs, checkpoint_manager=ckpt,
+                         bus=bus)
+    if history and baseline_qloss is not None and baseline_qloss > 0:
+        q = history[-1]["valid_qloss"]
+        drift = (q - baseline_qloss) / baseline_qloss
+        bus.gauge("stream.qloss_drift", drift, qloss=q,
+                  baseline=baseline_qloss)
+        log.info("continual round: frozen-eval qloss %.4f vs baseline "
+                 "%.4f (drift %+.2f%%)", q, baseline_qloss, drift * 100)
+    return state, history
+
+
+def finetune_programs(dataset: Dataset, cfg: Config):
+    """(model, state, train_jit, eval_jit, compact) — the programs one
+    continual round dispatches for `dataset` (the window dataset), built
+    through fit()'s OWN construction path (build_single_device_programs'
+    maker selection) with the AOT store side effects off.  Exposed so
+    tools/graftaudit/programs.py can trace the continual-training
+    program as a first-class audit subject (``continual/finetune_*``):
+    if continual training ever diverges from fit()'s construction, the
+    audit coverage pin in tests/test_graftaudit.py breaks."""
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.train.loop import (_resolve_device_materialize,
+                                        _train_sample,
+                                        build_single_device_programs,
+                                        make_tx)
+
+    cfg = cfg.replace(aot=dataclasses.replace(cfg.aot, cache_dir=""))
+    model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
+                       dataset.num_interfaces, dataset.num_rpctypes)
+    tx = make_tx(cfg)
+    sample = _train_sample(dataset)
+    compact = _resolve_device_materialize(dataset, cfg)
+    state, train_jit, eval_jit = build_single_device_programs(
+        dataset, cfg, model=model, tx=tx, sample=sample,
+        device_materialize=compact)
+    return model, state, train_jit, eval_jit, compact

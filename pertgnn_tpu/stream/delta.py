@@ -1,0 +1,368 @@
+"""Vocab-stable shard ingest: one trace shard -> one ``ShardDelta``.
+
+The live-traffic data path (ROADMAP item 1): the batch pipeline keys the
+whole corpus on one fingerprint, so one new trace shard invalidates
+everything and forces a full re-ingest.  This module makes the shard the
+unit of ingest instead.  Each shard runs the SAME preprocessing passes
+the batch path runs (dedupe -> sort -> factorize -> entry detection ->
+resource aggregation -> runtime-pattern dedup -> graph construction) but
+with the base corpus's string vocabularies PINNED, so the codes a delta
+shard produces are exactly the codes a from-scratch rebuild of the union
+corpus would produce — which is what lets stream/merge.py reconstitute
+the merged dataset from base + deltas with bit-identical packed batches
+(benchmarks/stream_bench.py exit-code-asserts it against the real batch
+path).
+
+Vocabulary contract (docs/GUIDE.md "Live traffic"):
+
+- ``ms`` / ``interface`` / ``rpctype`` are PINNED: their codes are baked
+  into graph node orderings (sorted-unique compaction), embedding rows,
+  and runtime-pattern identities, and the ms vocabulary is sorted so any
+  insertion relabels everything after it.  A delta shard containing an
+  unseen value raises :class:`VocabGrowth` — the LOUD signal that this
+  shard needs the full-rebuild path, not the delta path.
+- ``entryid`` is APPEND-ONLY: a new entry is a new combination of
+  existing strings; its code appends at the end exactly where the union
+  rebuild's first-appearance factorization would put it.  Delta shards
+  store entry strings locally; global codes are assigned at merge time,
+  which is what makes shard ingest order-independent.
+- ``traceid`` / ``rpcid`` are shard-local: trace codes are offset into
+  the global space at merge (shards are time-ordered, see merge.py);
+  rpcid codes only ever feed within-trace equality tests (edge
+  sanitizing), which any bijective relabeling preserves.
+
+A shard's expensive work — CSV parse, the vectorized preprocess passes,
+runtime-pattern dedup, and per-pattern graph construction — happens HERE,
+once, at ingest time; the merge only concatenates, filters, and re-derives
+the cheap global tails (mixture weights, splits, budget).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+import pandas as pd
+
+from pertgnn_tpu.config import IngestConfig
+from pertgnn_tpu.graphs.construct import GraphSpec, build_runtime_graphs
+from pertgnn_tpu.ingest.assemble import TraceTable, assemble
+from pertgnn_tpu.ingest.preprocess import (PreprocessResult,
+                                           build_resource_table,
+                                           detect_entries,
+                                           factorize_columns)
+
+log = logging.getLogger(__name__)
+
+
+class VocabGrowth(RuntimeError):
+    """A delta shard contains string values outside the base corpus's
+    pinned vocabulary (new microservice / interface / rpctype).  The
+    delta path CANNOT absorb these — ms codes are sorted (insertion
+    relabels every later code) and interface/rpctype sizes are baked
+    into embedding shapes beneath the serving checkpoint — so the caller
+    must route this shard through the loud full-rebuild path
+    (stream/merge.py docs; counter ``stream.rebuild``)."""
+
+    def __init__(self, column: str, values: list):
+        shown = ", ".join(repr(v) for v in values[:5])
+        more = f" (+{len(values) - 5} more)" if len(values) > 5 else ""
+        super().__init__(
+            f"vocab growth in {column!r}: {shown}{more} not in the base "
+            f"corpus's pinned vocabulary — this shard needs a full "
+            f"rebuild, not a delta ingest")
+        self.column = column
+        self.values = values
+
+
+@dataclasses.dataclass
+class ShardDelta:
+    """One ingested shard: everything the merge needs, as plain arrays.
+
+    For the BASE shard the trace rows are the batch build's survivors
+    (its filter decisions are final — the stream filters forward-only);
+    for DELTA shards they are the entry-detection survivors, with the
+    corpus-global filters (resource coverage, entry occurrence) deferred
+    to merge time where the cumulative statistics live."""
+
+    kind: str                    # "base" | "delta"
+    # -- per-trace rows (aligned arrays) --------------------------------
+    traceid: np.ndarray          # int64 shard-local codes
+    entry_local: np.ndarray      # int64 index into entry_vocab
+    runtime_local: np.ndarray    # int64 shard-local pattern ids
+    ts_bucket: np.ndarray        # int64
+    y: np.ndarray                # float64
+    # -- shard identity / ordering --------------------------------------
+    n_traces_total: int          # local traceid code space (incl. dropped)
+    span_ts_min: int             # RAW span time range (pre-filter)
+    span_ts_max: int
+    traceid_strings: np.ndarray  # raw trace ids (cross-shard disjointness)
+    entry_vocab: list            # entry strings, local first-appearance
+    # -- runtime patterns ------------------------------------------------
+    pat_tokens: np.ndarray       # (T, 3) int64 (um, dm, interface) rows
+    pat_offsets: np.ndarray      # (P+1,) int64 — pattern p = rows [p, p+1)
+    pat_rep_trace: np.ndarray    # (P,) int64 local rep trace per pattern
+    graphs: dict                 # local pattern id -> GraphSpec
+    # -- coverage incidence (delta: distinct (trace, ms); base: empty) --
+    inc_trace: np.ndarray
+    inc_ms: np.ndarray
+    # -- aggregated resources -------------------------------------------
+    res_ts: np.ndarray           # int64
+    res_ms: np.ndarray           # int64 (pinned codes)
+    res_values: np.ndarray       # (rows, 8) float32
+    # -- base-only -------------------------------------------------------
+    vocabs: dict | None = None   # {"ms","interface","rpctype","entryid"}
+    entry_occ_prefilter: dict | None = None  # entry string -> raw count
+    base_vocab_hash: str | None = None       # deltas: the base they bind to
+    # traces the base's resource-coverage filter dropped (None =
+    # unknown, pre-stats artifacts): when 0, no delta resource rows can
+    # resurrect a base trace and the merge's coverage-drift guard can
+    # safely admit first-time resource coverage of a vocab ms
+    coverage_dropped: int | None = None
+
+    @property
+    def num_patterns(self) -> int:
+        return len(self.pat_rep_trace)
+
+    def pattern_key(self, local_id: int) -> bytes:
+        """The shard-independent identity of one runtime pattern: its
+        (um, dm, interface) token sequence in trace row order — exactly
+        the equality ``ingest/assemble.py`` dedups traces by."""
+        s, e = self.pat_offsets[local_id], self.pat_offsets[local_id + 1]
+        return np.ascontiguousarray(self.pat_tokens[s:e]).tobytes()
+
+
+def vocab_hash(vocabs: dict) -> str:
+    """Content hash of the pinned vocabularies — a delta shard is only
+    mergeable against the exact base it was coded with."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for name in ("ms", "interface", "rpctype", "entryid"):
+        arr = np.asarray(vocabs[name])
+        h.update(name.encode())
+        for v in arr.tolist():
+            h.update(str(v).encode())
+            h.update(b"\x00")
+    return h.hexdigest()[:16]
+
+
+def _pattern_table(pre_spans: pd.DataFrame, table: TraceTable
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """(tokens (T,3), offsets (P+1,)) in local pattern-id order, taken
+    from each pattern's representative trace (every trace of a pattern
+    shares the sequence — that IS the pattern identity)."""
+    reps = table.runtime2trace
+    rep_rows = pre_spans[pre_spans["traceid"].isin(set(reps.values()))]
+    by_trace = {tid: grp for tid, grp in rep_rows.groupby("traceid")}
+    tokens: list[np.ndarray] = []
+    offsets = [0]
+    for rid in sorted(reps):
+        grp = by_trace[reps[rid]]
+        t = np.stack([grp["um"].to_numpy(np.int64),
+                      grp["dm"].to_numpy(np.int64),
+                      grp["interface"].to_numpy(np.int64)], axis=1)
+        tokens.append(t)
+        offsets.append(offsets[-1] + len(t))
+    flat = (np.concatenate(tokens) if tokens
+            else np.empty((0, 3), np.int64))
+    return flat, np.asarray(offsets, np.int64)
+
+
+def _meta_arrays(table: TraceTable) -> dict:
+    m = table.meta
+    return {
+        "traceid": m["traceid"].to_numpy(np.int64),
+        "runtime_local": m["runtime_id"].to_numpy(np.int64),
+        "ts_bucket": m["ts_bucket"].to_numpy(np.int64),
+        "y": m["y"].to_numpy(np.float64),
+    }
+
+
+def _resource_arrays(resource_df: pd.DataFrame
+                     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    feat_cols = [c for c in resource_df.columns
+                 if c not in ("timestamp", "msname")]
+    return (resource_df["timestamp"].to_numpy(np.int64),
+            resource_df["msname"].to_numpy(np.int64),
+            resource_df[feat_cols].to_numpy(np.float32))
+
+
+def base_shard(pre: PreprocessResult, table: TraceTable, graph_type: str,
+               cfg: IngestConfig = IngestConfig()) -> ShardDelta:
+    """Wrap a batch-built corpus as the stream's shard 0.
+
+    The base is exactly the artifact pair the batch path produced —
+    same filters, same codes — so a stream that never receives a delta
+    IS the batch build.  Its vocabularies become the pin every later
+    delta ingests against."""
+    graphs = build_runtime_graphs(pre, table, graph_type)
+    pat_tokens, pat_offsets = _pattern_table(pre.spans, table)
+    reps = np.asarray([table.runtime2trace[r]
+                       for r in sorted(table.runtime2trace)], np.int64)
+    res_ts, res_ms, res_values = _resource_arrays(pre.resources)
+    stats = pre.stats or {}
+    if "span_ts_min" in stats:
+        ts_min, ts_max = int(stats["span_ts_min"]), int(stats["span_ts_max"])
+    else:
+        # older artifact caches predate the raw-range stats: fall back to
+        # the survivors' range (dropped-trace rows can extend past it, so
+        # the merge-ordering guard is slightly laxer — say so once)
+        log.warning("base artifacts predate span_ts_min/max stats; the "
+                    "shard-ordering guard uses the filtered range")
+        ts_min = int(pre.spans["timestamp"].min())
+        ts_max = int(pre.spans["timestamp"].max())
+    # raw occurrence counts per entry string BEFORE the occurrence filter
+    # — lets the merge detect (loudly) when delta growth would have
+    # resurrected base traces the batch build dropped (filter drift)
+    occ = stats.get("entry_occ_prefilter")
+    vocabs = {"ms": np.asarray(pre.ms_vocab),
+              "interface": np.asarray(pre.interface_vocab),
+              "rpctype": np.asarray(pre.rpctype_vocab),
+              "entryid": np.asarray(pre.entryid_vocab)}
+    meta = _meta_arrays(table)
+    return ShardDelta(
+        kind="base",
+        entry_local=table.meta["entry_id"].to_numpy(np.int64),
+        n_traces_total=len(pre.traceid_vocab),
+        span_ts_min=ts_min, span_ts_max=ts_max,
+        traceid_strings=np.asarray(pre.traceid_vocab, dtype=object),
+        entry_vocab=[str(v) for v in np.asarray(pre.entryid_vocab)],
+        pat_tokens=pat_tokens, pat_offsets=pat_offsets,
+        pat_rep_trace=reps, graphs=graphs,
+        inc_trace=np.empty(0, np.int64), inc_ms=np.empty(0, np.int64),
+        res_ts=res_ts, res_ms=res_ms, res_values=res_values,
+        vocabs=vocabs, entry_occ_prefilter=occ,
+        base_vocab_hash=None,
+        coverage_dropped=(int(stats["num_coverage_dropped"])
+                          if "num_coverage_dropped" in stats else None),
+        **meta)
+
+
+def _pinned_codes(col: pd.Series, vocab: np.ndarray,
+                  column: str) -> np.ndarray:
+    """Map raw strings to the base vocabulary's codes (code = position);
+    any unseen value is VocabGrowth, never a silent -1."""
+    mapping = {v: i for i, v in enumerate(np.asarray(vocab).tolist())}
+    codes = col.map(mapping)
+    if codes.isna().any():
+        unknown = sorted(set(col[codes.isna()].astype(str).tolist()))
+        raise VocabGrowth(column, unknown)
+    return codes.to_numpy(np.int64)
+
+
+def ingest_delta(spans: pd.DataFrame, resources: pd.DataFrame,
+                 base: ShardDelta, graph_type: str,
+                 cfg: IngestConfig = IngestConfig()) -> ShardDelta:
+    """One raw trace shard -> ShardDelta, coded against `base`'s pinned
+    vocabularies.  Mirrors ``ingest.preprocess._preprocess`` pass for
+    pass (order matters: codes depend on it) with three deltas: string
+    vocabs are pinned (VocabGrowth on growth), entry ids stay shard-local
+    (globalized at merge), and the corpus-global filters are deferred to
+    the merge, which owns the cumulative statistics."""
+    from pertgnn_tpu import telemetry
+
+    if base.vocabs is None:
+        raise ValueError("ingest_delta needs the BASE shard (it carries "
+                         "the pinned vocabularies)")
+    with telemetry.get_bus().span("stream.shard_ingest", rows=len(spans)):
+        return _ingest_delta(spans, resources, base, graph_type, cfg)
+
+
+def _ingest_delta(spans: pd.DataFrame, resources: pd.DataFrame,
+                  base: ShardDelta, graph_type: str,
+                  cfg: IngestConfig) -> ShardDelta:
+    vocabs = base.vocabs
+    df = spans.drop_duplicates()
+    df = df.sort_values(by=["timestamp"], kind="stable")
+    if len(df) == 0:
+        raise ValueError("empty shard: no span rows after dedupe")
+    ts_min = int(df["timestamp"].min())
+    ts_max = int(df["timestamp"].max())
+
+    # the batch pipeline's exact pass order (codes depend on it):
+    # traceid -> interface -> entry detection -> entryid -> rpcid ->
+    # rpctype -> resources/filters -> ms mapping -> endTimestamp
+    df, traceid_vocab = factorize_columns(df, ["traceid"])
+    df = df.copy(deep=False)
+    df["interface"] = _pinned_codes(df["interface"], vocabs["interface"],
+                                    "interface")
+    df, _entry_stats = detect_entries(df, cfg)
+    df = df.copy(deep=False)
+    # entry strings stay LOCAL: the merge assigns global codes in
+    # canonical shard order, which keeps ingest order-independent
+    df, entry_vocab_local = factorize_columns(df, ["entryid"])
+    df, _ = factorize_columns(df, ["rpcid"])
+    df["rpctype"] = _pinned_codes(df["rpctype"], vocabs["rpctype"],
+                                  "rpctype")
+
+    resource_df = build_resource_table(resources, cfg)
+    resource_df = resource_df.copy(deep=False)
+    resource_df["msname"] = _pinned_codes(resource_df["msname"],
+                                          vocabs["ms"], "ms")
+    df["um"] = _pinned_codes(df["um"], vocabs["ms"], "ms")
+    df["dm"] = _pinned_codes(df["dm"], vocabs["ms"], "ms")
+    df["endTimestamp"] = df["timestamp"] + df["rt"].abs()
+    df = df.reset_index(drop=True)
+
+    # distinct (trace, ms) incidence — what the merge's deferred
+    # resource-coverage filter consumes (preprocess.py's packed-key
+    # idiom; ms codes < 2^32 by vocabulary construction)
+    t = df["traceid"].to_numpy(np.int64)
+    key = np.concatenate([(t << 32) | df["um"].to_numpy(np.int64),
+                          (t << 32) | df["dm"].to_numpy(np.int64)])
+    pairs = np.unique(key)
+    inc_trace = pairs >> 32
+    inc_ms = pairs & np.int64(0xFFFFFFFF)
+
+    pre_local = PreprocessResult(
+        spans=df, resources=resource_df,
+        traceid_vocab=np.asarray(traceid_vocab),
+        interface_vocab=np.asarray(vocabs["interface"]),
+        entryid_vocab=np.asarray(entry_vocab_local),
+        rpctype_vocab=np.asarray(vocabs["rpctype"]),
+        ms_vocab=np.asarray(vocabs["ms"]), stats={})
+    table = assemble(pre_local, cfg)
+    graphs = build_runtime_graphs(pre_local, table, graph_type)
+    pat_tokens, pat_offsets = _pattern_table(df, table)
+    reps = np.asarray([table.runtime2trace[r]
+                       for r in sorted(table.runtime2trace)], np.int64)
+    res_ts, res_ms, res_values = _resource_arrays(resource_df)
+    meta = _meta_arrays(table)
+    return ShardDelta(
+        kind="delta",
+        entry_local=table.meta["entry_id"].to_numpy(np.int64),
+        n_traces_total=len(traceid_vocab),
+        span_ts_min=ts_min, span_ts_max=ts_max,
+        traceid_strings=np.asarray(traceid_vocab, dtype=object),
+        entry_vocab=[str(v) for v in np.asarray(entry_vocab_local)],
+        pat_tokens=pat_tokens, pat_offsets=pat_offsets,
+        pat_rep_trace=reps, graphs=graphs,
+        inc_trace=inc_trace, inc_ms=inc_ms,
+        res_ts=res_ts, res_ms=res_ms, res_values=res_values,
+        base_vocab_hash=vocab_hash(vocabs), **meta)
+
+
+def shard_frames_by_window(spans: pd.DataFrame, resources: pd.DataFrame,
+                           boundaries_ms: list[int],
+                           ) -> list[tuple[pd.DataFrame, pd.DataFrame]]:
+    """Slice one raw corpus into time-window shards: a trace belongs to
+    the window of its FIRST span, and traces whose span range crosses a
+    boundary are DROPPED (so the shards' raw time ranges cannot
+    interleave and the merge-ordering guard holds by construction) —
+    the shard generator for tests and stream_bench, and the documented
+    recipe for slicing real feeds (docs/GUIDE.md "Live traffic")."""
+    bounds = sorted(boundaries_ms)
+    g = spans.groupby("traceid")["timestamp"]
+    t_lo, t_hi = g.min(), g.max()
+    edges = [-np.inf, *bounds, np.inf]
+    out = []
+    for i in range(len(edges) - 1):
+        lo, hi = edges[i], edges[i + 1]
+        keep = t_lo[(t_lo >= lo) & (t_lo < hi) & (t_hi < hi)].index
+        shard_spans = spans[spans["traceid"].isin(keep)]
+        rmask = (resources["timestamp"] >= lo) & (resources["timestamp"] < hi)
+        out.append((shard_spans.reset_index(drop=True),
+                    resources[rmask].reset_index(drop=True)))
+    return out

@@ -1,0 +1,416 @@
+"""Mixture-merge: base + delta shards -> one Dataset, no full rebuild.
+
+The merge concatenates what the shards already computed (per-trace meta
+rows, per-pattern graphs, aggregated resource rows) and re-derives only
+the corpus-global tails that are cheap and vectorized: the cumulative
+filters, runtime-pattern code assignment, mixture weights
+(``assemble.table_from_meta``), the resource lookup, and the dataset
+tail (``dataset.dataset_from_parts``).  Everything expensive — CSV
+parse, the preprocess passes, pattern dedup, graph construction — was
+paid once, at each shard's OWN ingest (stream/delta.py), so a merge over
+N cached shards is seconds where a rebuild is minutes.
+
+THE CONTRACT (exit-code-asserted by benchmarks/stream_bench.py and the
+order-independence property test in tests/test_stream.py): the merged
+dataset packs BIT-IDENTICAL batches to a from-scratch batch build over
+the concatenated raw shards.  The guards below exist to keep that claim
+honest rather than hopeful — every situation the delta algebra cannot
+reproduce exactly raises :class:`StreamRebuildRequired` (counter
+``stream.rebuild`` with the reason) instead of merging approximately:
+
+- ``shard_overlap``     — shard raw time ranges interleave: trace codes
+                          are assigned in global timestamp order, so
+                          out-of-order shards cannot be appended;
+- ``trace_overlap``     — a trace id appears in two shards (the batch
+                          path would cross-shard-dedupe, which per-shard
+                          ingest cannot see);
+- ``resource_overlap``  — two shards carry the same (ts_bucket, ms)
+                          resource group (the batch path would aggregate
+                          the union's raw rows);
+- ``base_changed``      — a delta was coded against a different base
+                          vocabulary than the one being merged;
+- ``filter_drift``      — delta growth would change a BASE filter
+                          verdict: an entry the base occurrence filter
+                          dropped crosses back over the threshold, or a
+                          delta carries the first resource rows for an
+                          ms the base never resourced while the base's
+                          coverage filter dropped traces — either way
+                          the batch rebuild would resurrect base traces
+                          the stream no longer has;
+- ``representative_drift`` — a pattern's globally-first surviving trace
+                          is not the trace its shard built the graph
+                          from (PERT edge event order and span durations
+                          are representative-trace-specific).
+
+Vocabulary growth (new ms/interface/rpctype strings) is refused earlier,
+at delta INGEST (stream/delta.VocabGrowth).  New ENTRIES and new
+TOPOLOGIES are the supported live cases and merge cleanly; the per-shard
+counts ride the bus as ``stream.shard_new_entries`` /
+``stream.shard_new_topologies`` — the drift gauges continual training
+watches (stream/continual.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+import numpy as np
+import pandas as pd
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.batching.dataset import Split, dataset_from_parts
+from pertgnn_tpu.batching.featurize import ResourceLookup
+from pertgnn_tpu.batching.mixture import build_mixtures
+from pertgnn_tpu.config import Config
+from pertgnn_tpu.ingest.assemble import table_from_meta
+from pertgnn_tpu.stream.delta import ShardDelta, vocab_hash
+
+log = logging.getLogger(__name__)
+
+
+class StreamRebuildRequired(RuntimeError):
+    """The delta algebra cannot reproduce the batch build for this shard
+    set — the caller must route through the full-rebuild path (and the
+    operator must see why: counter ``stream.rebuild`` with `reason`)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"stream merge requires a full rebuild "
+                         f"({reason}){': ' + detail if detail else ''}")
+        self.reason = reason
+
+
+@dataclasses.dataclass
+class MergeInfo:
+    """What the merge learned, for continual training and telemetry."""
+
+    # canonical shard order: [(kind, global trace offset, n_traces_total,
+    #                          admitted_rows)]
+    shards: list
+    new_entries: list        # per shard (base = 0)
+    new_topologies: list     # per shard (base = 0)
+    dropped_coverage: int    # delta traces dropped by the coverage filter
+    dropped_occurrence: int  # delta traces dropped by the occurrence filter
+    meta: pd.DataFrame       # merged, sorted, max_traces-truncated
+
+    def window_split(self, window_shards: int) -> Split:
+        """The sliding fine-tune window: every example of the LAST
+        `window_shards` shards (<= 0 = all shards) as a Split the
+        continual trainer swaps in as its train split."""
+        n = len(self.shards)
+        w = n if window_shards <= 0 else min(window_shards, n)
+        boundary = self.shards[n - w][1]  # first window shard's offset
+        m = self.meta[self.meta["traceid"] >= boundary]
+        return Split(entry_ids=m["entry_id"].to_numpy(np.int64),
+                     ts_buckets=m["ts_bucket"].to_numpy(np.int64),
+                     ys=m["y"].to_numpy(np.float32))
+
+
+def _canonical_order(deltas: list[ShardDelta]) -> list[ShardDelta]:
+    return sorted(deltas, key=lambda s: (s.span_ts_min, s.span_ts_max,
+                                         str(s.traceid_strings[0])
+                                         if len(s.traceid_strings) else ""))
+
+
+def _check_ordering(shards: list[ShardDelta]) -> None:
+    for prev, nxt in zip(shards, shards[1:]):
+        if nxt.span_ts_min < prev.span_ts_max:
+            raise StreamRebuildRequired(
+                "shard_overlap",
+                f"shard [{nxt.span_ts_min}, {nxt.span_ts_max}] interleaves "
+                f"[{prev.span_ts_min}, {prev.span_ts_max}] — trace codes "
+                f"are assigned in global timestamp order")
+
+
+def _check_trace_disjoint(shards: list[ShardDelta]) -> None:
+    seen: set = set()
+    for i, s in enumerate(shards):
+        ids = set(np.asarray(s.traceid_strings).tolist())
+        dup = seen & ids
+        if dup:
+            raise StreamRebuildRequired(
+                "trace_overlap",
+                f"shard #{i} repeats {len(dup)} trace id(s) from earlier "
+                f"shards (e.g. {sorted(dup)[:3]})")
+        seen |= ids
+
+
+def _coverage_mask(s: ShardDelta, covered_ms: np.ndarray,
+                   threshold: float) -> np.ndarray:
+    """Per-local-trace coverage verdict for one delta shard, from its
+    stored (trace, ms) incidence — the same >= threshold rule as
+    ingest.preprocess.filter_by_resource_coverage, against the UNION
+    resource table's microservice set."""
+    ok = np.zeros(s.n_traces_total, dtype=bool)
+    if len(s.inc_trace) == 0:
+        return ok
+    cov = np.isin(s.inc_ms, covered_ms)
+    uniq_tr, start = np.unique(s.inc_trace, return_index=True)
+    n_pairs = np.diff(np.concatenate([start, [len(s.inc_trace)]]))
+    n_cov = np.add.reduceat(cov.astype(np.int64), start)
+    ok[uniq_tr] = (n_cov / n_pairs) >= threshold
+    return ok
+
+
+def merge_shards(base: ShardDelta, deltas: list[ShardDelta],
+                 cfg: Config, bus=None):
+    """(Dataset, MergeInfo) for base + deltas, in any delta order."""
+    bus = bus if bus is not None else telemetry.get_bus()
+    t0 = time.perf_counter()
+    if base.kind != "base" or base.vocabs is None:
+        raise ValueError("merge_shards needs the BASE shard first")
+    base_hash = vocab_hash(base.vocabs)
+    try:
+        for d in deltas:
+            if d.base_vocab_hash != base_hash:
+                raise StreamRebuildRequired(
+                    "base_changed",
+                    f"delta coded against base {d.base_vocab_hash}, "
+                    f"merging against {base_hash}")
+        shards = [base, *_canonical_order(deltas)]
+        _check_ordering(shards)
+        _check_trace_disjoint(shards)
+    except StreamRebuildRequired as e:
+        # every refusal reason rides the SAME counter — the rebuild
+        # signal operators alarm on (docs/OBSERVABILITY.md)
+        bus.counter("stream.rebuild", reason=e.reason)
+        raise
+
+    # global trace-code offsets (the union build factorizes trace ids
+    # over the time-sorted concatenation, so shard k's codes are its
+    # local codes plus the earlier shards' PRE-FILTER trace counts)
+    offsets = np.concatenate(
+        [[0], np.cumsum([s.n_traces_total for s in shards])[:-1]])
+
+    # -- global entry vocabulary (append-only) --------------------------
+    entry_code: dict[str, int] = {s: i
+                                  for i, s in enumerate(base.entry_vocab)}
+    entry_maps: list[np.ndarray] = []
+    new_entries = [0]
+    occ_prefilter = base.entry_occ_prefilter or {}
+    thr = cfg.ingest.min_traces_per_entry
+    delta_count_by_string: dict[str, int] = {}
+    for s in shards[1:]:
+        remap = np.empty(len(s.entry_vocab), np.int64)
+        fresh = 0
+        for j, name in enumerate(s.entry_vocab):
+            if name not in entry_code:
+                entry_code[name] = len(entry_code)
+                fresh += 1
+            remap[j] = entry_code[name]
+        entry_maps.append(remap)
+        new_entries.append(fresh)
+        loc = np.bincount(s.entry_local, minlength=len(s.entry_vocab))
+        for j, name in enumerate(s.entry_vocab):
+            delta_count_by_string[name] = (
+                delta_count_by_string.get(name, 0) + int(loc[j]))
+
+    # filter-drift guard: an entry the BASE build dropped at its
+    # occurrence filter (prefilter count <= threshold — NB dropped
+    # entries still sit in the entryid vocabulary, which factorizes
+    # before the filters) that delta growth would push OVER the
+    # threshold — the batch rebuild would resurrect base traces the
+    # stream no longer has, so a bit-identical merge is impossible
+    if base.entry_occ_prefilter is None:
+        # legacy base (pre-stats artifacts): the counts are unknown, so
+        # fail CLOSED like the coverage twin below — refuse any delta
+        # entry the base KNEW (it is in the vocabulary) but dropped
+        # (no surviving rows); we cannot prove the rebuild would not
+        # resurrect it
+        base_live = set(np.unique(base.entry_local).tolist())
+        for name, n_delta in delta_count_by_string.items():
+            code = entry_code[name]
+            if code < len(base.entry_vocab) and code not in base_live:
+                bus.counter("stream.rebuild", reason="filter_drift")
+                raise StreamRebuildRequired(
+                    "filter_drift",
+                    f"entry {name!r} is in the base vocabulary but has "
+                    f"no surviving base traces, and the base predates "
+                    f"the prefilter occurrence stats — cannot prove a "
+                    f"batch rebuild would not resurrect it")
+    for name, n_delta in delta_count_by_string.items():
+        n_base = occ_prefilter.get(name, 0)
+        if 0 < n_base <= thr and n_base + n_delta > thr:
+            bus.counter("stream.rebuild", reason="filter_drift")
+            raise StreamRebuildRequired(
+                "filter_drift",
+                f"entry {name!r} was dropped by the base occurrence "
+                f"filter ({n_base} <= {thr}) but base+delta "
+                f"({n_base}+{n_delta}) now passes — a batch rebuild "
+                f"would resurrect base traces the stream dropped")
+
+    # -- universal pattern identity -------------------------------------
+    pat_uidx: dict[bytes, int] = {}
+    shard_uidx: list[np.ndarray] = []       # local pattern id -> uidx
+    shard_pid_by_uidx: list[dict] = []      # uidx -> local pattern id
+    new_topologies = []
+    for s in shards:
+        u = np.empty(s.num_patterns, np.int64)
+        fresh = 0
+        inv: dict[int, int] = {}
+        for pid in range(s.num_patterns):
+            key = s.pattern_key(pid)
+            if key not in pat_uidx:
+                pat_uidx[key] = len(pat_uidx)
+                fresh += 1
+            u[pid] = pat_uidx[key]
+            inv[int(u[pid])] = pid
+        shard_uidx.append(u)
+        shard_pid_by_uidx.append(inv)
+        new_topologies.append(fresh)
+    new_topologies[0] = 0  # the base defines the universe, it isn't "new"
+
+    # coverage-drift guard, the resource-side twin of the occurrence
+    # guard above: a delta carrying the FIRST resource rows for an ms
+    # the base never resourced changes base traces' coverage verdicts
+    # in a from-scratch rebuild (ms-with-resources is corpus-global).
+    # Safe exactly when the base's coverage filter dropped nothing —
+    # otherwise the batch rebuild could resurrect base traces the
+    # stream no longer has, so refuse loudly.
+    base_res_ms = np.unique(base.res_ms)
+    for i, s in enumerate(shards[1:], 1):
+        fresh_ms = np.setdiff1d(np.unique(s.res_ms), base_res_ms)
+        if len(fresh_ms) and (base.coverage_dropped is None
+                              or base.coverage_dropped > 0):
+            bus.counter("stream.rebuild", reason="filter_drift")
+            raise StreamRebuildRequired(
+                "filter_drift",
+                f"shard #{i} carries the first resource rows for "
+                f"{len(fresh_ms)} microservice(s) the base never "
+                f"resourced (e.g. ms code {int(fresh_ms[0])}) while the "
+                f"base's coverage filter dropped "
+                f"{base.coverage_dropped if base.coverage_dropped is not None else 'an unknown number of'} "
+                f"trace(s) — a batch rebuild could resurrect them")
+
+    # -- deferred corpus-global filters (delta rows only) ---------------
+    covered_ms = np.unique(np.concatenate([s.res_ms for s in shards]))
+    cov_masks = [None] + [
+        _coverage_mask(s, covered_ms, cfg.ingest.min_resource_coverage)
+        for s in shards[1:]]
+    occ = np.zeros(len(entry_code), np.int64)
+    np.add.at(occ, base.entry_local, 1)
+    for s, remap, cov in zip(shards[1:], entry_maps, cov_masks[1:]):
+        rows = cov[s.traceid]
+        np.add.at(occ, remap[s.entry_local[rows]], 1)
+    entry_ok = occ > thr
+
+    # -- merged meta rows ------------------------------------------------
+    tids, entries, uidxs, tsbs, ys = [], [], [], [], []
+    admitted = []
+    info_shards = []
+    dropped_cov = dropped_occ = 0
+    for i, s in enumerate(shards):
+        tid = s.traceid + offsets[i]
+        if i == 0:
+            ent = s.entry_local
+            ok = np.ones(len(tid), dtype=bool)
+        else:
+            ent = entry_maps[i - 1][s.entry_local]
+            cov_ok = cov_masks[i][s.traceid]
+            occ_ok = entry_ok[ent]
+            ok = cov_ok & occ_ok
+            dropped_cov += int((~cov_ok).sum())
+            dropped_occ += int((cov_ok & ~occ_ok).sum())
+        tids.append(tid)
+        entries.append(ent)
+        uidxs.append(shard_uidx[i][s.runtime_local])
+        tsbs.append(s.ts_bucket)
+        ys.append(s.y)
+        admitted.append(ok)
+        info_shards.append((s.kind, int(offsets[i]), s.n_traces_total,
+                            int(ok.sum())))
+    tid = np.concatenate(tids)
+    ent = np.concatenate(entries)
+    uidx = np.concatenate(uidxs)
+    tsb = np.concatenate(tsbs)
+    y = np.concatenate(ys)
+    ok = np.concatenate(admitted)
+
+    tid_a, ent_a, uidx_a = tid[ok], ent[ok], uidx[ok]
+    tsb_a, y_a = tsb[ok], y[ok]
+    order = np.argsort(tid_a, kind="stable")
+    # final runtime codes: first appearance over ascending global trace
+    # id among ADMITTED traces — the batch path's assignment exactly
+    # (base patterns keep their base ids because base traces come first)
+    codes_sorted, _ = pd.factorize(uidx_a[order])
+    runtime = np.empty(len(tid_a), np.int64)
+    runtime[order] = codes_sorted
+
+    # -- representatives + graphs ---------------------------------------
+    first_pos = np.full(int(codes_sorted.max(initial=-1)) + 1, -1, np.int64)
+    seen_first = np.unique(codes_sorted, return_index=True)
+    first_pos[seen_first[0]] = seen_first[1]
+    graphs: dict = {}
+    starts = offsets
+    ends = offsets + np.asarray([s.n_traces_total for s in shards])
+    # materialize the sorted views ONCE — inside the loop each fancy
+    # index would copy all N admitted rows per pattern (O(P*N))
+    tid_sorted = tid_a[order]
+    uidx_sorted = uidx_a[order]
+    for rid in range(len(first_pos)):
+        rep_tid = int(tid_sorted[first_pos[rid]])
+        si = int(np.searchsorted(ends, rep_tid, side="right"))
+        s = shards[si]
+        local = rep_tid - int(starts[si])
+        u = int(uidx_sorted[first_pos[rid]])
+        pid = shard_pid_by_uidx[si].get(u)
+        if pid is None or int(s.pat_rep_trace[pid]) != local:
+            bus.counter("stream.rebuild", reason="representative_drift")
+            raise StreamRebuildRequired(
+                "representative_drift",
+                f"runtime pattern {rid}: first surviving trace {rep_tid} "
+                f"is not the trace its shard built the graph from "
+                f"(filters moved the representative)")
+        graphs[rid] = s.graphs[pid]
+
+    # -- merged resource lookup -----------------------------------------
+    res_ts = np.concatenate([s.res_ts for s in shards])
+    res_ms = np.concatenate([s.res_ms for s in shards])
+    res_values = np.concatenate([s.res_values for s in shards])
+    dup = pd.MultiIndex.from_arrays([res_ts, res_ms]).duplicated()
+    if dup.any():
+        bus.counter("stream.rebuild", reason="resource_overlap")
+        raise StreamRebuildRequired(
+            "resource_overlap",
+            f"{int(dup.sum())} (ts_bucket, ms) resource group(s) appear "
+            f"in more than one shard — the batch path would aggregate "
+            f"the union's raw rows")
+    lookup = ResourceLookup.from_arrays(
+        res_ts, res_ms, res_values,
+        missing_indicator_is_one=cfg.model.missing_indicator_is_one)
+
+    meta = pd.DataFrame({"traceid": tid_a, "entry_id": ent_a,
+                         "runtime_id": runtime, "ts_bucket": tsb_a,
+                         "y": y_a})
+    table = table_from_meta(meta)
+    mixtures = build_mixtures(
+        graphs, table.entry2runtimes,
+        feature_all_stage_copies=cfg.model.feature_all_stage_copies)
+    dataset = dataset_from_parts(mixtures, lookup, table.meta, cfg)
+
+    dt = time.perf_counter() - t0
+    bus.histogram("stream.merge_seconds", dt)
+    bus.gauge("stream.merged_shards", len(shards))
+    bus.gauge("stream.merged_traces", len(meta))
+    for i in range(1, len(shards)):
+        bus.counter("stream.shard_new_entries", new_entries[i], shard=i)
+        bus.counter("stream.shard_new_topologies", new_topologies[i],
+                    shard=i)
+    if dropped_cov:
+        bus.counter("stream.dropped_traces", dropped_cov,
+                    reason="coverage")
+    if dropped_occ:
+        bus.counter("stream.dropped_traces", dropped_occ,
+                    reason="occurrence")
+    log.info(
+        "stream merge: %d shard(s), %d traces (%d dropped by filters), "
+        "%d entries, %d patterns in %.2fs",
+        len(shards), len(meta), dropped_cov + dropped_occ,
+        len(entry_code), len(first_pos), dt)
+    info = MergeInfo(shards=info_shards, new_entries=new_entries,
+                     new_topologies=new_topologies,
+                     dropped_coverage=dropped_cov,
+                     dropped_occurrence=dropped_occ,
+                     meta=table.meta.iloc[:cfg.data.max_traces])
+    return dataset, info

@@ -1,0 +1,322 @@
+"""Append-only delta arena store: one directory entry per trace shard.
+
+The persistence twin of ``batching/arena_store.py``, with the
+invalidation unit shrunk from the whole corpus to ONE SHARD: each entry
+is keyed on its own fingerprint (plus the ingest/graph config subtree
+that shapes shard content, plus — for deltas — the base vocabulary hash
+it was coded against), so a new shard ingests and persists without
+touching any existing entry, and a changed shard invalidates itself
+alone.  ``stream/merge.py`` then reconstitutes the serving/training
+corpus from base + deltas without a full rebuild.
+
+Layout: ``<root>/<key>/meta.json`` plus one ``.npy`` per array and one
+``.txt`` (newline-joined UTF-8) per string list.  TRUST BOUNDARY: the
+same as the arena store — entries are plain arrays, JSON, and text (no
+pickle, no code execution at load), but they ARE the training data;
+whoever can write this directory controls every later run's features
+and labels (docs/GUIDE.md §8).
+
+A corrupt or truncated entry logs a warning, counts a
+``stream.shard_cache_miss`` with reason ``corrupt``, and falls back to a
+fresh ingest OF THAT SHARD ONLY — the surviving entries stay warm
+(tests/test_stream.py pins it).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import time
+
+import numpy as np
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.graphs.construct import GraphSpec
+from pertgnn_tpu.stream.delta import ShardDelta
+
+log = logging.getLogger(__name__)
+
+# Bump to orphan every entry on a layout/semantics change (rides fn_id).
+_STORE_VERSION = 1
+_FN_ID = f"stream.delta_store.v{_STORE_VERSION}"
+
+_ARRAY_FIELDS = ("traceid", "entry_local", "runtime_local", "ts_bucket",
+                 "y", "pat_tokens", "pat_offsets", "pat_rep_trace",
+                 "inc_trace", "inc_ms", "res_ts", "res_ms", "res_values")
+_STRING_FIELDS = ("traceid_strings", "entry_vocab")
+_VOCAB_NAMES = ("ms", "interface", "rpctype", "entryid")
+
+
+def shard_cache_key(cfg, fingerprint: dict, *, kind: str,
+                    base_vocab_hash: str | None) -> tuple[str, dict]:
+    """(hex key, components) for one shard entry.  Only what shapes the
+    SHARD's content is keyed: the IngestConfig (filters, bucketing,
+    aggregations), graph_type (the stored GraphSpecs), the shard's own
+    raw-input fingerprint, and — for deltas — the base vocabulary they
+    were coded against.  Batch/budget/model knobs shape the MERGED
+    dataset, which is derived fresh per merge, never persisted here."""
+    from pertgnn_tpu import aot
+
+    config = {"ingest": cfg.ingest, "graph_type": cfg.graph_type}
+    args = {"kind": kind, "fingerprint": fingerprint,
+            "base_vocab_hash": base_vocab_hash}
+    # env={}: shard entries are host artifacts (see arena_cache_key)
+    return aot.cache_key(fn_id=_FN_ID, config=config, args_sig=args,
+                         env={})
+
+
+def _write_strings(path: str, values) -> None:
+    # one JSON string per line: raw ids can contain anything (newlines,
+    # backslash sequences a hand-rolled escape would round-trip wrong)
+    with open(path, "w", encoding="utf-8") as f:
+        for v in values:
+            f.write(json.dumps(str(v)) + "\n")
+
+
+def _read_strings(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f]
+
+
+class DeltaArenaStore:
+    """Content-addressed shard entries under ``root``."""
+
+    def __init__(self, root: str, bus=None):
+        self.root = root
+        self._injected_bus = bus
+        os.makedirs(root, exist_ok=True)
+
+    @property
+    def _bus(self):
+        return (self._injected_bus if self._injected_bus is not None
+                else telemetry.get_bus())
+
+    def _entry_dir(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    # -- entry points ----------------------------------------------------
+
+    def load_or_ingest_base(self, cfg, fingerprint: dict,
+                            pre_table_fn) -> ShardDelta:
+        """The base shard for (cfg, fingerprint): a hit reconstructs it
+        from disk; a miss calls ``pre_table_fn()`` (the full batch
+        ingest returning (pre, table)) and persists."""
+        from pertgnn_tpu.stream.delta import base_shard
+
+        key, components = shard_cache_key(cfg, fingerprint, kind="base",
+                                          base_vocab_hash=None)
+        shard = self._load(key)
+        if shard is not None:
+            return shard
+        t0 = time.perf_counter()
+        pre, table = pre_table_fn()
+        shard = base_shard(pre, table, cfg.graph_type, cfg.ingest)
+        self._bus.histogram("stream.shard_ingest_seconds",
+                            time.perf_counter() - t0)
+        self._save(key, components, shard)
+        return shard
+
+    def load_or_ingest_delta(self, cfg, fingerprint: dict, frames_fn,
+                             base: ShardDelta) -> ShardDelta:
+        """One delta shard for (cfg, fingerprint, base): a hit
+        reconstructs it from disk; a miss calls ``frames_fn()`` (raw
+        (spans, resources) frames for THIS shard only), runs the
+        vocab-stable ingest, and persists.  Raises
+        stream.delta.VocabGrowth when the shard cannot be coded against
+        the base — the caller routes to the rebuild path."""
+        from pertgnn_tpu.stream.delta import ingest_delta, vocab_hash
+
+        if base.vocabs is None:
+            raise ValueError("load_or_ingest_delta needs the base shard")
+        bh = vocab_hash(base.vocabs)
+        key, components = shard_cache_key(cfg, fingerprint, kind="delta",
+                                          base_vocab_hash=bh)
+        shard = self._load(key)
+        if shard is not None:
+            return shard
+        t0 = time.perf_counter()
+        spans, resources = frames_fn()
+        shard = ingest_delta(spans, resources, base, cfg.graph_type,
+                             cfg.ingest)
+        self._bus.histogram("stream.shard_ingest_seconds",
+                            time.perf_counter() - t0)
+        self._save(key, components, shard)
+        return shard
+
+    # -- load ------------------------------------------------------------
+
+    def _load(self, key: str) -> ShardDelta | None:
+        bus = self._bus
+        d = self._entry_dir(key)
+        meta_path = os.path.join(d, "meta.json")
+        if not os.path.exists(meta_path):
+            bus.counter("stream.shard_cache_miss", reason="absent")
+            return None
+        t0 = time.perf_counter()
+        try:
+            shard = self._load_entry(d)
+        except Exception as e:
+            # corrupt/truncated/stale entry: never crash the stream —
+            # THIS shard re-ingests, the others stay warm
+            log.warning("corrupt delta-store entry %s (%s: %s) — "
+                        "re-ingesting this shard fresh", key,
+                        type(e).__name__, e)
+            bus.counter("stream.shard_cache_miss", reason="corrupt")
+            return None
+        dt = time.perf_counter() - t0
+        bus.counter("stream.shard_cache_hit", kind=shard.kind)
+        bus.histogram("stream.shard_load_seconds", dt)
+        log.info("delta store: hit %s (%s, %d traces) in %.3fs — shard "
+                 "ingest skipped", key, shard.kind, len(shard.traceid), dt)
+        return shard
+
+    def _load_entry(self, d: str) -> ShardDelta:
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        if meta.get("store_version") != _STORE_VERSION:
+            raise ValueError(f"store version {meta.get('store_version')!r}"
+                             f" != {_STORE_VERSION}")
+
+        def arr(name: str):
+            return np.load(os.path.join(d, f"{name}.npy"),
+                           mmap_mode="r", allow_pickle=False)
+
+        fields = {f: np.asarray(arr(f)) for f in _ARRAY_FIELDS}
+        strings = {f: _read_strings(os.path.join(d, f"{f}.txt"))
+                   for f in _STRING_FIELDS}
+        g_noff = arr("g_node_offsets")
+        g_eoff = arr("g_edge_offsets")
+        g_send = arr("g_senders")
+        g_recv = arr("g_receivers")
+        g_attr = arr("g_edge_attr")
+        g_ms = arr("g_ms_id")
+        g_depth = arr("g_node_depth")
+        has_dur = bool(meta["has_edge_durations"])
+        g_dur = arr("g_edge_durations") if has_dur else None
+        graphs: dict[int, GraphSpec] = {}
+        for p in range(len(g_noff) - 1):
+            ns, ne = int(g_noff[p]), int(g_noff[p + 1])
+            es, ee = int(g_eoff[p]), int(g_eoff[p + 1])
+            graphs[p] = GraphSpec(
+                senders=np.asarray(g_send[es:ee]),
+                receivers=np.asarray(g_recv[es:ee]),
+                edge_attr=np.asarray(g_attr[es:ee]),
+                ms_id=np.asarray(g_ms[ns:ne]),
+                node_depth=np.asarray(g_depth[ns:ne]),
+                num_nodes=ne - ns,
+                edge_durations=(np.asarray(g_dur[es:ee]) if has_dur
+                                else None))
+        vocabs = None
+        if meta["kind"] == "base":
+            vocabs = {n: np.asarray(
+                _read_strings(os.path.join(d, f"vocab_{n}.txt")),
+                dtype=object) for n in _VOCAB_NAMES}
+        s = meta["scalars"]
+        return ShardDelta(
+            kind=meta["kind"], graphs=graphs,
+            traceid_strings=np.asarray(strings["traceid_strings"],
+                                       dtype=object),
+            entry_vocab=strings["entry_vocab"],
+            n_traces_total=s["n_traces_total"],
+            span_ts_min=s["span_ts_min"], span_ts_max=s["span_ts_max"],
+            vocabs=vocabs,
+            entry_occ_prefilter=meta.get("entry_occ_prefilter"),
+            base_vocab_hash=meta.get("base_vocab_hash"),
+            coverage_dropped=meta.get("coverage_dropped"), **fields)
+
+    # -- save ------------------------------------------------------------
+
+    def _save(self, key: str, components: dict,
+              shard: ShardDelta) -> str | None:
+        """Atomic tmp-dir + rename, like the arena store: a kill
+        mid-write costs one shard re-ingest, never a torn entry."""
+        bus = self._bus
+        t0 = time.perf_counter()
+        final = self._entry_dir(key)
+        tmp = os.path.join(self.root, f".tmp.{key}.{os.getpid()}")
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            def put(name: str, a) -> None:
+                np.save(os.path.join(tmp, f"{name}.npy"),
+                        np.ascontiguousarray(np.asarray(a)),
+                        allow_pickle=False)
+
+            for f in _ARRAY_FIELDS:
+                put(f, getattr(shard, f))
+            for f in _STRING_FIELDS:
+                _write_strings(os.path.join(tmp, f"{f}.txt"),
+                               getattr(shard, f))
+            P = shard.num_patterns
+            noff = [0]
+            eoff = [0]
+            send, recv, attr, ms, depth, dur = [], [], [], [], [], []
+            has_dur = any(shard.graphs[p].edge_durations is not None
+                          for p in range(P))
+            for p in range(P):
+                g = shard.graphs[p]
+                noff.append(noff[-1] + g.num_nodes)
+                eoff.append(eoff[-1] + g.num_edges)
+                send.append(g.senders)
+                recv.append(g.receivers)
+                attr.append(g.edge_attr)
+                ms.append(g.ms_id)
+                depth.append(g.node_depth)
+                if has_dur:
+                    dur.append(g.edge_durations
+                               if g.edge_durations is not None
+                               else np.zeros(g.num_edges, np.float32))
+            attr_w = shard.graphs[0].edge_attr.shape[1] if P else 2
+            put("g_node_offsets", np.asarray(noff, np.int64))
+            put("g_edge_offsets", np.asarray(eoff, np.int64))
+            put("g_senders", np.concatenate(send)
+                if P else np.empty(0, np.int32))
+            put("g_receivers", np.concatenate(recv)
+                if P else np.empty(0, np.int32))
+            put("g_edge_attr", np.concatenate(attr)
+                if P else np.empty((0, attr_w), np.int32))
+            put("g_ms_id", np.concatenate(ms)
+                if P else np.empty(0, np.int32))
+            put("g_node_depth", np.concatenate(depth)
+                if P else np.empty(0, np.float32))
+            if has_dur:
+                put("g_edge_durations", np.concatenate(dur))
+            if shard.vocabs is not None:
+                for n in _VOCAB_NAMES:
+                    _write_strings(os.path.join(tmp, f"vocab_{n}.txt"),
+                                   np.asarray(shard.vocabs[n]).tolist())
+            meta = {
+                "key": key, "kind": shard.kind,
+                "store_version": _STORE_VERSION,
+                "created_unix_time": time.time(),
+                "has_edge_durations": has_dur,
+                "scalars": {"n_traces_total": shard.n_traces_total,
+                            "span_ts_min": shard.span_ts_min,
+                            "span_ts_max": shard.span_ts_max},
+                "entry_occ_prefilter": shard.entry_occ_prefilter,
+                "base_vocab_hash": shard.base_vocab_hash,
+                "coverage_dropped": shard.coverage_dropped,
+                **components,
+            }
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=1, sort_keys=True, default=str)
+            if os.path.isdir(final):
+                old = f"{final}.old.{os.getpid()}"
+                os.replace(final, old)
+                os.replace(tmp, final)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.replace(tmp, final)
+        except Exception as e:
+            # a failed save must not fail the run the shard was built
+            # FOR — next process re-ingests
+            log.warning("delta store: could not persist %s (%s: %s)",
+                        key, type(e).__name__, e)
+            shutil.rmtree(tmp, ignore_errors=True)
+            return None
+        bus.histogram("stream.shard_save_seconds",
+                      time.perf_counter() - t0)
+        log.info("delta store: saved %s (%s, %d traces, %d patterns)",
+                 key, shard.kind, len(shard.traceid), shard.num_patterns)
+        return final

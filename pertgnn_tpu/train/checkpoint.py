@@ -21,6 +21,47 @@ from pertgnn_tpu.train.loop import TrainState
 log = logging.getLogger(__name__)
 
 
+def _rebuffer(state):
+    """Copy every restored leaf into an XLA-owned buffer (an eager
+    elementwise select; dtype- and sharding-preserving, so it is safe
+    under meshes and multihost).
+
+    WHY: orbax-restored arrays can be zero-copy views over the restore
+    read buffers, and XLA executables DESERIALIZED FROM THE PERSISTENT
+    COMPILATION CACHE mishandle buffer donation of such externally
+    backed inputs — the triple (restored state) + (cache-deserialized
+    executable) + (donate_argnums) intermittently corrupts the heap and
+    SIGSEGVs on this jax/jaxlib (reproduced minimally WITHOUT any of
+    this repo's code: plain jit + warm jax_compilation_cache_dir +
+    StandardRestore + donation; any two of the three are fine).  Found
+    by benchmarks/stream_bench.py's warm-restart phase — which is
+    exactly resume-from-checkpoint with a warm compile cache, the
+    combination every continual-training round hits.  Cost: one
+    elementwise pass over the state at restore time (transiently ~2x
+    state bytes until the old tree drops)."""
+    import jax.numpy as jnp
+
+    if jax.process_count() > 1:
+        # multi-process restore: eager global ops would have to be
+        # issued collectively and the copy's sharding identity must
+        # survive exactly (tests/multihost_worker.py pins it) — skip
+        # the workaround there; the crash triple needs the persistent
+        # cache, which multihost training runs configure per-host where
+        # the TPU pjrt serialization path (not stablehlo replay) serves
+        # warm starts anyway
+        return state
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            # device_put pins the ORIGINAL sharding object on the copy
+            # (a no-op when it already matches) so restore is
+            # bit-AND-sharding-identical to pre-workaround behavior
+            return jax.device_put(jnp.where(True, x, x), x.sharding)
+        return x
+
+    return jax.tree.map(leaf, state)
+
+
 class CheckpointManager:
     """Thin orbax wrapper keyed by epoch."""
 
@@ -115,7 +156,7 @@ class CheckpointManager:
                             step, steps[0])
             else:
                 log.info("restored checkpoint at epoch %d", step)
-            return restored["state"], step + 1
+            return _rebuffer(restored["state"]), step + 1
         raise last_err
 
     def wait(self) -> None:

@@ -647,8 +647,12 @@ def _stored_train_eval(store, dataset: Dataset, cfg: Config,
         name = f"{tag}_{suffix}_{kind}"
         key, components = aot.cache_key(
             fn_id=f"train.loop.{name}.v1", config=config, args_sig=sig)
-        exe, outcome = store.load_or_build(name, key, components, jit_fn,
-                                           abs_args)
+        # the train step jits with donate_argnums=0 (make_train_*);
+        # the store's stablehlo replay must mirror it or jax keeps the
+        # donated state arrays "live" over buffers XLA reuses in place
+        exe, outcome = store.load_or_build(
+            name, key, components, jit_fn, abs_args,
+            donate_argnums=(0,) if tag == "train" else ())
         log.info("AOT %s program: %s", name, outcome)
         out.append(exe)
     return out[0], out[1]

@@ -92,6 +92,12 @@ def test_repo_audits_clean_within_budget():
     assert any(n.startswith("eval/") for n in names)
     assert "sharded/train_step_dp" in names
     assert "sharded/train_step_edge_shard" in names
+    # the ISSUE-11 satellite: the warm-restart fine-tune program
+    # (stream/continual.py, traced through the continual module's own
+    # construction over a REAL base+delta window dataset) is a
+    # first-class audit subject — donation/dtype-flow/host-interop
+    # coverage extends to continual training mechanically
+    assert any(n.startswith("continual/finetune_") for n in names), names
 
 
 def test_no_baseline_file():

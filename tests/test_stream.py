@@ -1,0 +1,714 @@
+"""Streaming subsystem: delta arenas, mixture merge, continual training,
+blue/green rollout (pertgnn_tpu/stream/, fleet/rollout.py).
+
+The load-bearing guarantees:
+
+- appending delta shards IN ANY ORDER yields packed batches BIT-IDENTICAL
+  to a from-scratch batch build over the concatenated raw shards
+  (property-tested over shard permutations);
+- new entries and new topologies merge vocab-stably; true vocabulary
+  growth (new ms/interface/rpctype strings) is a LOUD VocabGrowth, and
+  every situation the delta algebra cannot reproduce exactly is a loud
+  StreamRebuildRequired, never an approximate merge;
+- a corrupt delta-store entry re-ingests THAT SHARD only (warning +
+  counter), the others stay warm, and the merged result is unchanged;
+- continual fine-tuning warm-restarts from the latest checkpoint over
+  the sliding window and refuses embeddings the corpus outgrew;
+- the rollout controller swaps workers one at a time and rolls the
+  failing slot back to the old checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                ModelConfig, StreamConfig, TrainConfig)
+from pertgnn_tpu.ingest import synthetic
+from pertgnn_tpu.ingest.assemble import assemble
+from pertgnn_tpu.ingest.preprocess import preprocess
+from pertgnn_tpu.ingest.schema import RESOURCE_COLUMNS, SPAN_COLUMNS
+from pertgnn_tpu.stream import (DeltaArenaStore, StreamRebuildRequired,
+                                VocabGrowth, base_shard, ingest_delta,
+                                merge_shards, shard_frames_by_window)
+
+SPAN_MS = 8 * 60 * 1000
+BOUNDS = [SPAN_MS // 4, SPAN_MS // 2, 3 * SPAN_MS // 4]
+
+
+def _cfg(**kw) -> Config:
+    base = dict(ingest=IngestConfig(min_traces_per_entry=3),
+                data=DataConfig(max_traces=10_000, batch_size=8),
+                model=ModelConfig(hidden_channels=8),
+                train=TrainConfig(label_scale=1000.0, epochs=1,
+                                  device_materialize=False, scan_chunk=4),
+                stream=StreamConfig(window_shards=2, finetune_epochs=1),
+                graph_type="pert")
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """(cfg, shards, base, deltas, oracle): one synthetic corpus sliced
+    into base + 3 time-window shards, ingested once per module."""
+    cfg = _cfg()
+    synth = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=12, num_entries=3, patterns_per_entry=3,
+        traces_per_entry=36, seed=5, time_span_ms=SPAN_MS,
+        missing_resource_frac=0.0,
+        ensure_pattern_coverage_before_ms=BOUNDS[0]))
+    shards = shard_frames_by_window(synth.spans, synth.resources, BOUNDS)
+    pre0 = preprocess(shards[0][0], shards[0][1], cfg.ingest)
+    table0 = assemble(pre0, cfg.ingest)
+    base = base_shard(pre0, table0, cfg.graph_type, cfg.ingest)
+    deltas = [ingest_delta(s, r, base, cfg.graph_type, cfg.ingest)
+              for s, r in shards[1:]]
+    spans_u = pd.concat([s[0] for s in shards], ignore_index=True)
+    res_u = pd.concat([s[1] for s in shards], ignore_index=True)
+    oracle = build_dataset(preprocess(spans_u, res_u, cfg.ingest), cfg)
+    return cfg, shards, base, deltas, oracle
+
+
+def assert_same_dataset(a, b) -> None:
+    assert a.budget == b.budget
+    assert (a.num_ms, a.num_entries, a.num_interfaces, a.num_rpctypes) \
+        == (b.num_ms, b.num_entries, b.num_interfaces, b.num_rpctypes)
+    assert set(a.splits) == set(b.splits)
+    for name in a.splits:
+        sa, sb = a.splits[name], b.splits[name]
+        for f in ("entry_ids", "ts_buckets", "ys"):
+            np.testing.assert_array_equal(getattr(sa, f), getattr(sb, f))
+        batches_a = list(a.batches(name))
+        batches_b = list(b.batches(name))
+        assert len(batches_a) == len(batches_b)
+        for ba, bb in zip(batches_a, batches_b):
+            for f in ba._fields:
+                np.testing.assert_array_equal(getattr(ba, f),
+                                              getattr(bb, f),
+                                              err_msg=f"{name}:{f}")
+
+
+# -- the bit-identical-merge contract --------------------------------------
+
+def test_merge_matches_full_rebuild(corpus):
+    cfg, _shards, base, deltas, oracle = corpus
+    merged, info = merge_shards(base, deltas, cfg)
+    assert_same_dataset(merged, oracle)
+    assert len(info.shards) == 4
+    assert info.dropped_coverage == 0 and info.dropped_occurrence == 0
+
+
+def test_merge_reversed_order_identical(corpus):
+    """Deterministic fallback for environments without hypothesis: the
+    fully reversed shard order must also reproduce the oracle."""
+    cfg, _shards, base, deltas, oracle = corpus
+    merged, _info = merge_shards(base, deltas[::-1], cfg)
+    assert_same_dataset(merged, oracle)
+
+
+def test_merge_order_independence_property(corpus):
+    """Appending shards in ANY order yields the SAME merged dataset —
+    the property that makes the delta store append-only rather than
+    sequence-sensitive."""
+    pytest.importorskip("hypothesis")
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    cfg, _shards, base, deltas, oracle = corpus
+
+    @settings(max_examples=6, deadline=None)
+    @given(perm=st.permutations(list(range(len(deltas)))))
+    def run(perm):
+        merged, _info = merge_shards(base, [deltas[i] for i in perm], cfg)
+        assert_same_dataset(merged, oracle)
+
+    run()
+
+
+# -- new entries / new topologies (the supported live cases) ---------------
+
+def _handmade_delta_frames(base, n_traces: int, *, new_entry: bool,
+                           t0: int):
+    """Raw frames for a delta window after `t0`: traces of either a NEW
+    entry (unused dm+interface string combination) or a NEW topology for
+    an existing entry — all over EXISTING ms/interface/rpctype strings,
+    so the shard ingests vocab-stably."""
+    ms = [str(v) for v in np.asarray(base.vocabs["ms"])
+          if str(v) != "(?)"]
+    ifaces = [str(v) for v in np.asarray(base.vocabs["interface"])]
+    existing_entries = set(base.entry_vocab)
+    if new_entry:
+        combo = None
+        for dm in ms:
+            for code, _s in enumerate(ifaces):
+                if f"{dm}_{code}" not in existing_entries:
+                    combo = (dm, ifaces[code])
+                    break
+            if combo:
+                break
+        assert combo is not None
+        entry_dm, entry_iface = combo
+    else:
+        name = base.entry_vocab[0]
+        entry_dm, code = name.rsplit("_", 1)
+        entry_iface = ifaces[int(code)]
+    rows = []
+    buckets = set()
+    for k in range(n_traces):
+        tid = f"hand_{'e' if new_entry else 't'}_{k:04d}"
+        start = t0 + 40_000 * k
+        buckets.add(start // 30_000 * 30_000)
+        rows.append((tid, start, "0", "(?)", "http", entry_dm,
+                     entry_iface, 900.0 + k))
+        # a 3-hop chain no synthetic pattern uses (novel topology)
+        chain = [entry_dm, ms[1], ms[2], ms[3]]
+        for h in range(3):
+            rows.append((tid, start + 10 * (h + 1), f"0.{h + 1}",
+                         chain[h], "rpc", chain[h + 1], ifaces[h],
+                         100.0 - h))
+    spans = pd.DataFrame(rows, columns=list(SPAN_COLUMNS))
+    res_rows = [(b, m, 0.5, 0.5) for b in sorted(buckets)
+                for m in (entry_dm, *ms[1:4])]
+    resources = pd.DataFrame(res_rows, columns=list(RESOURCE_COLUMNS))
+    return spans, resources
+
+
+@pytest.mark.parametrize("new_entry", [False, True])
+def test_new_topology_and_new_entry_merge(corpus, new_entry):
+    cfg, shards, base, _deltas, _oracle = corpus
+    t0 = SPAN_MS + 60_000
+    spans_d, res_d = _handmade_delta_frames(base, 6, new_entry=new_entry,
+                                            t0=t0)
+    delta = ingest_delta(spans_d, res_d, base, cfg.graph_type, cfg.ingest)
+    merged, info = merge_shards(base, [delta], cfg)
+    spans_u = pd.concat([shards[0][0], spans_d], ignore_index=True)
+    res_u = pd.concat([shards[0][1], res_d], ignore_index=True)
+    oracle = build_dataset(preprocess(spans_u, res_u, cfg.ingest), cfg)
+    assert_same_dataset(merged, oracle)
+    assert info.new_topologies[1] >= 1
+    assert info.new_entries[1] == (1 if new_entry else 0)
+    if new_entry:
+        assert merged.num_entries > len(base.entry_vocab)
+
+
+# -- the loud refusals -----------------------------------------------------
+
+def test_vocab_growth_is_loud(corpus):
+    cfg, _shards, base, _deltas, _oracle = corpus
+    spans_d, res_d = _handmade_delta_frames(base, 4, new_entry=False,
+                                            t0=SPAN_MS + 60_000)
+    spans_d.loc[1, "dm"] = "brand_new_microservice"
+    with pytest.raises(VocabGrowth) as e:
+        ingest_delta(spans_d, res_d, base, cfg.graph_type, cfg.ingest)
+    assert "ms" in str(e.value) and "brand_new_microservice" in str(e.value)
+
+
+def test_time_overlap_demands_rebuild(corpus):
+    cfg, shards, base, deltas, _oracle = corpus
+    back_in_time = shards[1][0].copy()
+    back_res = shards[1][1].copy()
+    back_in_time["traceid"] = "shift_" + back_in_time["traceid"]
+    back_in_time["timestamp"] -= BOUNDS[0]  # interleaves the base window
+    back_res["timestamp"] -= BOUNDS[0]
+    delta = ingest_delta(back_in_time, back_res, base,
+                         cfg.graph_type, cfg.ingest)
+    with pytest.raises(StreamRebuildRequired) as e:
+        merge_shards(base, [delta], cfg)
+    assert e.value.reason == "shard_overlap"
+
+
+def test_duplicate_traces_demand_rebuild(corpus):
+    cfg, shards, base, deltas, _oracle = corpus
+    fwd = shards[1][0].copy()
+    fwd_res = shards[1][1].copy()
+    fwd["timestamp"] += SPAN_MS  # ordering passes; trace ids collide
+    fwd_res["timestamp"] += SPAN_MS
+    dup = ingest_delta(fwd, fwd_res, base, cfg.graph_type, cfg.ingest)
+    with pytest.raises(StreamRebuildRequired) as e:
+        merge_shards(base, [deltas[0], dup], cfg)
+    assert e.value.reason == "trace_overlap"
+
+
+def test_resource_overlap_demands_rebuild(corpus):
+    cfg, shards, base, _deltas, _oracle = corpus
+    spans_d, res_d = _handmade_delta_frames(base, 4, new_entry=False,
+                                            t0=SPAN_MS + 60_000)
+    # repeat one of the BASE's (ts_bucket, ms) resource groups
+    clash = shards[0][1].iloc[:1]
+    res_d = pd.concat([res_d, clash], ignore_index=True)
+    delta = ingest_delta(spans_d, res_d, base, cfg.graph_type, cfg.ingest)
+    with pytest.raises(StreamRebuildRequired) as e:
+        merge_shards(base, [delta], cfg)
+    assert e.value.reason == "resource_overlap"
+
+
+def test_filter_drift_demands_rebuild():
+    """An entry the base occurrence filter DROPPED that delta growth
+    pushes back over the threshold: the batch rebuild would resurrect
+    base traces the stream no longer has — loud rebuild, not an
+    approximate merge."""
+    cfg = _cfg()
+    thr = cfg.ingest.min_traces_per_entry
+
+    def frames(prefix, n_traces, t0, entry_iface="if_a"):
+        rows = []
+        buckets = set()
+        for k in range(n_traces):
+            tid = f"{prefix}_{k:04d}"
+            start = t0 + 40_000 * k
+            buckets.add(start // 30_000 * 30_000)
+            rows.append((tid, start, "0", "(?)", "http", "svc_a",
+                         entry_iface, 500.0 + k))
+            rows.append((tid, start + 5, "0.1", "svc_a", "rpc", "svc_b",
+                         "if_b", 50.0))
+        spans = pd.DataFrame(rows, columns=list(SPAN_COLUMNS))
+        res = pd.DataFrame([(b, m, 0.4, 0.4) for b in sorted(buckets)
+                            for m in ("svc_a", "svc_b")],
+                           columns=list(RESOURCE_COLUMNS))
+        return spans, res
+
+    # base: entry "if_a" well over the threshold, entry "if_rare"
+    # UNDER it (dropped by the base build, recorded in the prefilter
+    # occurrence stats)
+    s1, r1 = frames("common", thr + 3, 0, entry_iface="if_a")
+    s2, r2 = frames("rare", 2, 2_000_000, entry_iface="if_rare")
+    pre = preprocess(pd.concat([s1, s2], ignore_index=True),
+                     pd.concat([r1, r2], ignore_index=True), cfg.ingest)
+    table = assemble(pre, cfg.ingest)
+    base = base_shard(pre, table, cfg.graph_type, cfg.ingest)
+    # delta: 2 more traces of the rare entry -> 2 + 2 > 3 would pass
+    s3, r3 = frames("rare2", 2, 4_000_000, entry_iface="if_rare")
+    delta = ingest_delta(s3, r3, base, cfg.graph_type, cfg.ingest)
+    with pytest.raises(StreamRebuildRequired) as e:
+        merge_shards(base, [delta], cfg)
+    assert e.value.reason == "filter_drift"
+
+    # a LEGACY base (no prefilter stats) must fail CLOSED: the counts
+    # are unknown, so any delta of an entry the base knew-but-dropped
+    # is refused even when the delta alone stays under the threshold
+    legacy = dataclasses.replace(base, entry_occ_prefilter=None)
+    s4, r4 = frames("rare3", 1, 6_000_000, entry_iface="if_rare")
+    delta1 = ingest_delta(s4, r4, legacy, cfg.graph_type, cfg.ingest)
+    with pytest.raises(StreamRebuildRequired) as e:
+        merge_shards(legacy, [delta1], cfg)
+    assert e.value.reason == "filter_drift"
+
+
+def test_coverage_drift_demands_rebuild():
+    """A delta carrying the FIRST resource rows for an ms the base
+    never resourced, while the base's coverage filter dropped traces:
+    the batch rebuild could resurrect them — loud rebuild."""
+    cfg = _cfg()
+
+    def trace(rows, tid, t0, children):
+        rows.append((tid, t0, "0", "(?)", "http", "svc_a", "if_a",
+                     500.0))
+        for h, dm in enumerate(children):
+            rows.append((tid, t0 + 5 * (h + 1), f"0.{h + 1}", "svc_a",
+                         "rpc", dm, "if_b", 50.0))
+
+    rows: list = []
+    buckets = set()
+    for k in range(6):  # survivors: {(?),a,d,e} -> 2/4 ... need >= 0.6
+        t0 = 40_000 * k
+        buckets.add(t0 // 30_000 * 30_000)
+        trace(rows, f"ok_{k}", t0, ["svc_d", "svc_e"])
+    for k in range(6):  # dropped by coverage: {(?),a,c} -> 1/3 < 0.6
+        t0 = 1_000_000 + 40_000 * k
+        buckets.add(t0 // 30_000 * 30_000)
+        trace(rows, f"cov_{k}", t0, ["svc_c"])
+    # keep svc_c in the VOCAB via a surviving trace that touches it:
+    # {(?),a,c,d,e} -> 3/5 = 0.6 covered
+    for k in range(6):
+        t0 = 2_000_000 + 40_000 * k
+        buckets.add(t0 // 30_000 * 30_000)
+        trace(rows, f"mix_{k}", t0, ["svc_c", "svc_d", "svc_e"])
+    spans = pd.DataFrame(rows, columns=list(SPAN_COLUMNS))
+    res = pd.DataFrame([(b, m, 0.4, 0.4) for b in sorted(buckets)
+                        for m in ("svc_a", "svc_d", "svc_e")],
+                       columns=list(RESOURCE_COLUMNS))
+    pre = preprocess(spans, res, cfg.ingest)
+    table = assemble(pre, cfg.ingest)
+    base = base_shard(pre, table, cfg.graph_type, cfg.ingest)
+    assert base.coverage_dropped == 6
+    assert "svc_c" in {str(v) for v in np.asarray(base.vocabs["ms"])}
+
+    rows2: list = []
+    b2 = set()
+    for k in range(4):
+        t0 = 4_000_000 + 40_000 * k
+        b2.add(t0 // 30_000 * 30_000)
+        trace(rows2, f"new_{k}", t0, ["svc_d"])
+    spans2 = pd.DataFrame(rows2, columns=list(SPAN_COLUMNS))
+    # the poison: first-ever resource rows for svc_c
+    res2 = pd.DataFrame([(b, m, 0.4, 0.4) for b in sorted(b2)
+                         for m in ("svc_a", "svc_d", "svc_c")],
+                        columns=list(RESOURCE_COLUMNS))
+    delta = ingest_delta(spans2, res2, base, cfg.graph_type, cfg.ingest)
+    with pytest.raises(StreamRebuildRequired) as e:
+        merge_shards(base, [delta], cfg)
+    assert e.value.reason == "filter_drift"
+    # without the poison row the same delta merges fine
+    res2_ok = res2[res2["msname"] != "svc_c"]
+    delta_ok = ingest_delta(spans2, res2_ok, base, cfg.graph_type,
+                            cfg.ingest)
+    merged, _ = merge_shards(base, [delta_ok], cfg)
+    assert merged.num_entries >= 1
+
+
+# -- the delta store -------------------------------------------------------
+
+def test_store_roundtrip_and_corrupt_fallback(corpus, tmp_path, caplog):
+    cfg, shards, base, deltas, oracle = corpus
+    cfg = dataclasses.replace(cfg, stream=dataclasses.replace(
+        cfg.stream, delta_store_dir=str(tmp_path / "delta")))
+    store = DeltaArenaStore(cfg.stream.delta_store_dir)
+    calls = {"base": 0, "delta": 0}
+
+    def pre_table():
+        calls["base"] += 1
+        pre = preprocess(shards[0][0], shards[0][1], cfg.ingest)
+        return pre, assemble(pre, cfg.ingest)
+
+    def frames(i):
+        def get():
+            calls["delta"] += 1
+            return shards[i]
+        return get
+
+    fp = lambda i: {"kind": "test_stream", "window": i}  # noqa: E731
+    b1 = store.load_or_ingest_base(cfg, fp(0), pre_table)
+    d1 = [store.load_or_ingest_delta(cfg, fp(i), frames(i), b1)
+          for i in (1, 2, 3)]
+    assert calls == {"base": 1, "delta": 3}
+    # second round: ALL warm, zero ingest work
+    b2 = store.load_or_ingest_base(cfg, fp(0), pre_table)
+    d2 = [store.load_or_ingest_delta(cfg, fp(i), frames(i), b2)
+          for i in (1, 2, 3)]
+    assert calls == {"base": 1, "delta": 3}
+    merged, _ = merge_shards(b2, d2, cfg)
+    assert_same_dataset(merged, oracle)
+
+    # corrupt ONE delta entry: only that shard re-ingests, loudly
+    import glob
+    victims = [p for p in glob.glob(str(tmp_path / "delta" / "*"))
+               if os.path.isdir(p)]
+    corrupted = 0
+    for p in victims:
+        import json as _json
+        with open(os.path.join(p, "meta.json")) as f:
+            if _json.load(f)["kind"] == "delta":
+                with open(os.path.join(p, "traceid.npy"), "wb") as f:
+                    f.write(b"garbage")
+                corrupted = 1
+                break
+    assert corrupted
+    with caplog.at_level("WARNING"):
+        b3 = store.load_or_ingest_base(cfg, fp(0), pre_table)
+        d3 = [store.load_or_ingest_delta(cfg, fp(i), frames(i), b3)
+              for i in (1, 2, 3)]
+    assert calls == {"base": 1, "delta": 4}  # exactly ONE re-ingest
+    assert any("corrupt delta-store entry" in r.message
+               for r in caplog.records)
+    merged3, _ = merge_shards(b3, d3, cfg)
+    assert_same_dataset(merged3, oracle)
+
+
+# -- continual training ----------------------------------------------------
+
+def test_window_split(corpus):
+    cfg, _shards, base, deltas, _oracle = corpus
+    merged, info = merge_shards(base, deltas, cfg)
+    full = info.window_split(0)
+    assert len(full) == len(info.meta)
+    last2 = info.window_split(2)
+    boundary = info.shards[-2][1]
+    expected = info.meta[info.meta["traceid"] >= boundary]
+    assert len(last2) == len(expected)
+    assert 0 < len(last2) < len(full)
+
+
+def test_check_capacity_refuses_growth(corpus):
+    from pertgnn_tpu.stream import check_capacity
+
+    cfg, _shards, _base, _deltas, oracle = corpus
+    vocab = {"num_ms": oracle.num_ms, "num_entries": oracle.num_entries,
+             "num_interfaces": oracle.num_interfaces,
+             "num_rpctypes": oracle.num_rpctypes}
+    check_capacity(oracle, cfg, vocab)  # no growth: fine
+    with pytest.raises(StreamRebuildRequired) as e:
+        check_capacity(oracle, cfg,
+                       {**vocab, "num_entries": oracle.num_entries - 1})
+    assert e.value.reason == "model_capacity"
+    # headroom absorbs small growth inside one capacity window
+    cfg_h = dataclasses.replace(cfg, model=dataclasses.replace(
+        cfg.model, vocab_headroom_entries=64))
+    check_capacity(oracle, cfg_h,
+                   {**vocab, "num_entries": oracle.num_entries - 1})
+
+
+def test_entry_capacity_rounding():
+    from pertgnn_tpu.models.pert_model import entry_capacity
+
+    assert entry_capacity(5, 0) == 5
+    assert entry_capacity(5, 64) == 64
+    assert entry_capacity(64, 64) == 64
+    assert entry_capacity(65, 64) == 128
+
+
+def test_finetune_round_warm_restarts(corpus, tmp_path):
+    """One continual round: restores the latest checkpoint, trains the
+    window for finetune_epochs, advances the checkpoint, and emits the
+    drift gauge — and refuses to run without a checkpoint."""
+    from pertgnn_tpu.stream import finetune_round
+    from pertgnn_tpu.train.checkpoint import CheckpointManager
+    from pertgnn_tpu.train.loop import fit
+
+    cfg, _shards, base, deltas, _oracle = corpus
+    cfg = dataclasses.replace(cfg, train=dataclasses.replace(
+        cfg.train, checkpoint_dir=str(tmp_path / "ckpt")))
+    merged, info = merge_shards(base, deltas, cfg)
+    frozen = {"valid": merged.splits["valid"],
+              "test": merged.splits["test"]}
+    window = info.window_split(cfg.stream.window_shards)
+
+    with pytest.raises(ValueError, match="warm-restart"):
+        finetune_round(merged, window, frozen, cfg,
+                       cfg.train.checkpoint_dir)
+
+    ckpt = CheckpointManager(cfg.train.checkpoint_dir)
+    _state, hist = fit(merged, cfg, epochs=1, checkpoint_manager=ckpt)
+    ckpt.wait()
+
+    class Cap:
+        def __init__(self):
+            self.gauges = {}
+
+        def gauge(self, name, value, **tags):
+            self.gauges[name] = value
+
+        def counter(self, *a, **k):
+            pass
+
+        def histogram(self, *a, **k):
+            pass
+
+        def span(self, *a, **k):
+            import contextlib
+            return contextlib.nullcontext()
+
+        enabled = True
+
+        def flush(self):
+            pass
+
+    cap = Cap()
+    _state2, hist2 = finetune_round(
+        merged, window, frozen, cfg, cfg.train.checkpoint_dir, bus=cap,
+        baseline_qloss=hist[-1]["valid_qloss"],
+        checkpoint_vocab={"num_ms": merged.num_ms,
+                          "num_entries": merged.num_entries,
+                          "num_interfaces": merged.num_interfaces,
+                          "num_rpctypes": merged.num_rpctypes})
+    assert [h["epoch"] for h in hist2] == [1]  # warm restart, not epoch 0
+    assert "stream.qloss_drift" in cap.gauges
+    assert cap.gauges["stream.finetune_window"] == len(window)
+    assert CheckpointManager(cfg.train.checkpoint_dir).latest_step() == 1
+
+
+# -- the rollout controller ------------------------------------------------
+
+class _FakeFleet:
+    """Injectable process fabric for RolloutController: spawn/stop/probe
+    are dict operations, readiness is scripted per worker."""
+
+    def __init__(self, fail_new=(), fail_old=()):
+        self.log: list[tuple[str, str]] = []
+        self.version: dict[str, str] = {}
+        self.fail_new = set(fail_new)
+        self.fail_old = set(fail_old)
+
+    def stop(self, w):
+        self.log.append(("stop", w.worker_id))
+
+    def spawn_new(self, w):
+        self.log.append(("spawn_new", w.worker_id))
+        self.version[w.worker_id] = ("broken" if w.worker_id
+                                     in self.fail_new else "v2")
+        return object()
+
+    def spawn_old(self, w):
+        self.log.append(("spawn_old", w.worker_id))
+        self.version[w.worker_id] = ("broken" if w.worker_id
+                                     in self.fail_old else "v1")
+        return object()
+
+    def probe(self, url, timeout_s):
+        wid = url.rsplit("/", 1)[-1]
+        v = self.version.get(wid)
+        if v == "broken":
+            return 503, {}
+        return 200, {"version": v}
+
+
+def _controller(fleet, workers, verify=None, **kw):
+    from pertgnn_tpu.fleet.rollout import (RolloutController, RolloutWorker)
+
+    ws = [RolloutWorker(worker_id=w, url=f"fake://{w}") for w in workers]
+    return RolloutController(
+        ws, stop_worker=fleet.stop, spawn_new=fleet.spawn_new,
+        spawn_old=fleet.spawn_old, verify=verify, probe=fleet.probe,
+        ready_timeout_s=1.0, poll_interval_s=0.01, **kw)
+
+
+def test_rollout_swaps_worker_by_worker():
+    fleet = _FakeFleet()
+    out = _controller(
+        fleet, ["w0", "w1"],
+        verify=lambda body: None if body.get("version") == "v2"
+        else f"version {body.get('version')}").run()
+    assert out["swapped"] == ["w0", "w1"]
+    assert fleet.log == [("stop", "w0"), ("spawn_new", "w0"),
+                         ("stop", "w1"), ("spawn_new", "w1")]
+    assert fleet.version == {"w0": "v2", "w1": "v2"}
+
+
+def test_rollout_rolls_back_failed_readiness():
+    from pertgnn_tpu.fleet.rollout import RolloutError
+
+    fleet = _FakeFleet(fail_new={"w1"})
+    with pytest.raises(RolloutError) as e:
+        _controller(fleet, ["w0", "w1"]).run()
+    assert e.value.rolled_back and e.value.worker_id == "w1"
+    # the failed slot went back to v1; w0 stays on v2; the fleet whole
+    assert fleet.version == {"w0": "v2", "w1": "v1"}
+    assert ("spawn_old", "w1") in fleet.log
+
+
+def test_rollback_not_judged_by_new_version_verify():
+    """The rollback respawns the OLD checkpoint — the new-version
+    verification must not apply to it, or every successful rollback
+    would be misreported as a degraded fleet."""
+    from pertgnn_tpu.fleet.rollout import RolloutError
+
+    fleet = _FakeFleet(fail_new={"w1"})
+    with pytest.raises(RolloutError) as e:
+        _controller(fleet, ["w0", "w1"],
+                    verify=lambda body: None if body.get("version") == "v2"
+                    else f"version {body.get('version')}").run()
+    assert e.value.rolled_back, str(e.value)  # v1 slot IS healthy
+    assert fleet.version == {"w0": "v2", "w1": "v1"}
+
+
+def test_rollout_reports_unrecovered_slot():
+    from pertgnn_tpu.fleet.rollout import RolloutError
+
+    fleet = _FakeFleet(fail_new={"w0"}, fail_old={"w0"})
+    with pytest.raises(RolloutError) as e:
+        _controller(fleet, ["w0", "w1"]).run()
+    assert not e.value.rolled_back
+    assert "degraded" in str(e.value)
+
+
+def test_rollout_spawn_failure_rolls_back():
+    """spawn_new RAISING (exec failure, bind race) must reach the same
+    rollback path as failed readiness — never escape with the slot
+    empty and no telemetry."""
+    from pertgnn_tpu.fleet.rollout import RolloutError
+
+    fleet = _FakeFleet()
+    real_spawn = fleet.spawn_new
+
+    def exploding(w):
+        if w.worker_id == "w1":
+            raise OSError("exec failed")
+        return real_spawn(w)
+
+    fleet.spawn_new = exploding
+    with pytest.raises(RolloutError) as e:
+        _controller(fleet, ["w0", "w1"]).run()
+    assert e.value.rolled_back and e.value.worker_id == "w1"
+    assert "spawn_new raised OSError" in str(e.value)
+    assert fleet.version == {"w0": "v2", "w1": "v1"}
+
+
+def test_rollout_verify_failure_counts_rollback():
+    from pertgnn_tpu.fleet.rollout import RolloutError
+
+    events = []
+
+    class Bus:
+        def counter(self, name, *a, **k):
+            events.append(name)
+
+        def histogram(self, *a, **k):
+            pass
+
+    fleet = _FakeFleet()
+    with pytest.raises(RolloutError):
+        _controller(fleet, ["w0"],
+                    verify=lambda body: "always wrong",
+                    bus=Bus()).run()
+    assert "rollout.rollback" in events and "rollout.failed" in events
+    assert "rollout.completed" not in events
+
+
+# -- fingerprint modes + invalidation diagnostics --------------------------
+
+def _fp_args(tmp_path, mode):
+    import argparse
+
+    return argparse.Namespace(artifact_dir="", synthetic=False,
+                              data_dir=str(tmp_path),
+                              stream_factorize=False,
+                              fingerprint_mode=mode)
+
+
+def test_content_fingerprint_survives_touch(tmp_path):
+    from pertgnn_tpu.cli.common import raw_input_fingerprint
+
+    f = tmp_path / "a.csv"
+    f.write_text("x,y\n1,2\n")
+    stat1 = raw_input_fingerprint(_fp_args(tmp_path, "stat"))
+    cont1 = raw_input_fingerprint(_fp_args(tmp_path, "content"))
+    os.utime(f, (1_000_000_000, 1_000_000_000))  # touch, same bytes
+    stat2 = raw_input_fingerprint(_fp_args(tmp_path, "stat"))
+    cont2 = raw_input_fingerprint(_fp_args(tmp_path, "content"))
+    assert stat1 != stat2          # mtime churn invalidates stat keying
+    assert cont1 == cont2          # ...but NOT content keying
+    f.write_text("x,y\n1,3\n")     # a real edit invalidates both
+    assert raw_input_fingerprint(_fp_args(tmp_path, "content")) != cont2
+    assert cont1["files"][0][2].startswith("sha256:")
+
+
+def test_invalidation_diff_names_exact_file():
+    from pertgnn_tpu.batching.arena_store import ArenaStore
+
+    prev = {"files": [["a.csv", 10, "sha256:aa"], ["b.csv", 5, "m1"]]}
+    now = {"files": [["a.csv", 12, "sha256:bb"], ["c.csv", 7, "m2"]]}
+    msgs = ArenaStore._diff_fingerprint_files(prev, now)
+    joined = " | ".join(msgs)
+    assert "a.csv" in joined and "changed" in joined
+    assert "b.csv" in joined and "removed" in joined
+    assert "c.csv" in joined and "added" in joined
+
+
+# -- analyzer scope pins ---------------------------------------------------
+
+def test_lock_discipline_scope_covers_stream():
+    """The satellite pin: graftlint's lock-discipline pass must scan
+    the streaming subsystem (and the fleet dir that holds rollout.py)
+    from day one — a thread+lock added there later is checked the
+    moment it appears."""
+    from tools.graftlint.passes import lock_discipline
+
+    assert "pertgnn_tpu/stream/" in lock_discipline.SCOPE
+    assert any(s.startswith("pertgnn_tpu/fleet")
+               for s in lock_discipline.SCOPE)

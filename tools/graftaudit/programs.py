@@ -352,6 +352,79 @@ def _sharded_specs(ds, cfg, model, state, out: list,
                        f"{type(e).__name__}: {e}"))
 
 
+def _toy_window_dataset():
+    """A window dataset built through the REAL stream path (base +
+    delta shards, vocab-stable ingest, mixture merge, sliding window) —
+    the continual fine-tune program's audit subject must be constructed
+    the way stream/continual.py constructs it, not simulated."""
+    if "window_ds" in _CACHE:
+        return _CACHE["window_ds"]
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.assemble import assemble
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.stream import (base_shard, ingest_delta, merge_shards,
+                                    shard_frames_by_window, window_dataset)
+
+    cfg = _toy_config()
+    span = 6 * 60 * 1000
+    synth = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=12, num_entries=2, patterns_per_entry=2,
+        traces_per_entry=16, seed=7, time_span_ms=span,
+        missing_resource_frac=0.0,
+        ensure_pattern_coverage_before_ms=span // 2))
+    shards = shard_frames_by_window(synth.spans, synth.resources,
+                                    [span // 2])
+    pre0 = preprocess(shards[0][0], shards[0][1], cfg.ingest)
+    table0 = assemble(pre0, cfg.ingest)
+    base_ds = build_dataset(pre0, cfg, table0)
+    base = base_shard(pre0, table0, cfg.graph_type, cfg.ingest)
+    delta = ingest_delta(shards[1][0], shards[1][1], base,
+                         cfg.graph_type, cfg.ingest)
+    merged, info = merge_shards(base, [delta], cfg)
+    win = window_dataset(merged, info.window_split(1),
+                         {"valid": base_ds.splits["valid"],
+                          "test": base_ds.splits["test"]})
+    _CACHE["window_ds"] = (win, cfg)
+    return _CACHE["window_ds"]
+
+
+def _continual_spec(out: list, errors: list) -> None:
+    """The warm-restart fine-tune program (stream/continual.py), traced
+    through the continual module's own construction path so the
+    donation / dtype-flow / host-interop / collective passes cover the
+    continual-training program as a first-class subject."""
+    import jax
+
+    from pertgnn_tpu.train.loop import _train_eval_abstract
+
+    try:
+        from pertgnn_tpu.stream import finetune_programs
+
+        win_ds, cfg = _toy_window_dataset()
+        _model, state, train_jit, _eval_jit, compact = finetune_programs(
+            win_ds, cfg)
+        abs_args = _train_eval_abstract(win_ds, cfg, state, compact)
+        state_leaves = jax.tree_util.tree_flatten_with_path(
+            abs_args[0])[0]
+        suffix = "chunk" if cfg.train.scan_chunk > 1 else "step"
+        kind = "compact" if compact else "packed"
+        traced = train_jit.trace(*abs_args)
+        out.append(ProgramSpec(
+            name=f"continual/finetune_{suffix}_{kind}",
+            tags=frozenset({"train", "continual"}),
+            jaxpr=traced.jaxpr,
+            expect_donated_state=True,
+            state_flat_count=len(state_leaves),
+            state_paths=tuple(jax.tree_util.keystr(p)
+                              for p, _ in state_leaves),
+            lower=lambda t=traced: t.lower()))
+    except Exception as e:  # noqa: BLE001 — see _serve_specs
+        log.exception("graftaudit: building continual/finetune failed")
+        errors.append(("continual/finetune",
+                       f"{type(e).__name__}: {e}"))
+
+
 def build_programs() -> tuple[list[ProgramSpec], list[tuple[str, str]]]:
     """(specs, build_errors). Build errors are audit findings (rule
     "driver"), not skips — a program variant that stopped tracing is
@@ -367,5 +440,6 @@ def build_programs() -> tuple[list[ProgramSpec], list[tuple[str, str]]]:
     _train_specs(ds, cfg, model, state, specs, errors)
     _init_spec(ds, cfg, model, state, specs, errors)
     _sharded_specs(ds, cfg, model, state, specs, errors)
+    _continual_spec(specs, errors)
     _CACHE["programs"] = (specs, errors)
     return _CACHE["programs"]

@@ -49,7 +49,11 @@ SCOPE = ("pertgnn_tpu/serve/", "pertgnn_tpu/fleet/",
          "pertgnn_tpu/batching/prefetch.py",
          "pertgnn_tpu/train/supervisor.py",
          "pertgnn_tpu/cli/fleet_main.py",
-         "pertgnn_tpu/telemetry/")
+         "pertgnn_tpu/telemetry/",
+         # the streaming subsystem: the rollout controller lives under
+         # fleet/ (covered above); stream/ is scoped from day one so a
+         # future thread + lock there is checked the moment it appears
+         "pertgnn_tpu/stream/")
 
 _MUTATORS = {"append", "extend", "insert", "pop", "remove", "clear",
              "add", "discard", "update", "setdefault", "popitem"}
