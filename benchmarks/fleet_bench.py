@@ -26,8 +26,15 @@ split):
   prediction must be BIT-IDENTICAL to a single-engine in-process
   reference (padding invariance + identical seeded state make the
   fleet's answers independent of which worker serves them).
-- **telemetry** — the router.* counters land in the JSONL
+- **telemetry** — the router.* counters (including the
+  `router.queue_wait` autoscale gauge) land in the JSONL
   (docs/OBSERVABILITY.md).
+- **tracing** — the chaos run serves at `--trace_sample_rate 1.0` and
+  tools/graftscope must collect EVERY successful Future into exactly
+  one root span with a complete stage chain (router queue → transport
+  → worker queue → pack → dispatch → compute → complete), zero
+  orphans, across the worker kill — plus the per-stage p99 critical-
+  path breakdown embedded in this bench's JSON and a Perfetto export.
 
 CPU by default. One JSON line on stdout.
 
@@ -141,16 +148,21 @@ def reference_preds(engine, entries, ts_buckets) -> np.ndarray:
 def run_fleet(tmp: str, tag: str, num_workers: int, req_csv: str,
               kill_one_after_s: float | None = None,
               timeout_s: float = 900.0,
-              telemetry_level: str = "basic") -> dict:
+              telemetry_level: str = "basic",
+              extra_flags: list[str] | None = None) -> dict:
     """One fleet_main run; returns {rc, stats, out_csv, killed_pid}.
     With kill_one_after_s, SIGKILLs the first worker that long after
-    every member reports ready — mid-traffic by construction (clients
-    start the moment readiness completes). Scaling runs keep
-    telemetry at "basic": per-request trace writes serialize the
+    the bench OBSERVES TRAFFIC on it (queue depth/inflight > 0 in its
+    probe body) — "mid-traffic" anchored on evidence, not on a sleep
+    racing the stream: on a fast host a fixed post-ready delay can
+    land after a short stream has already drained, and the chaos
+    phase then asserts against a death nobody witnessed. Scaling runs
+    keep telemetry at "basic": per-request trace writes serialize the
     router hot path (measured ~4x on 2 cores) and would gate the
     telemetry's overhead, not the fleet's scaling; the chaos run
-    flips to "trace" to assert counter coverage where no throughput
-    is being measured."""
+    flips to "trace" (+ --trace_sample_rate 1.0 via extra_flags) to
+    assert counter and TRACE coverage where no throughput is being
+    measured."""
     from pertgnn_tpu.fleet.transport import WorkerTransportError, get_probe
 
     out_csv = os.path.join(tmp, f"served_{tag}.csv")
@@ -167,6 +179,7 @@ def run_fleet(tmp: str, tag: str, num_workers: int, req_csv: str,
            "--router_dispatch_timeout_s", "30",
            "--telemetry_dir", os.path.join(tmp, f"tele_{tag}"),
            "--telemetry_level", telemetry_level,
+           *(extra_flags or []),
            "--out", out_csv]
     child = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
                              env={**os.environ, "JAX_PLATFORMS": "cpu"})
@@ -179,22 +192,24 @@ def run_fleet(tmp: str, tag: str, num_workers: int, req_csv: str,
             lines.append(first)
             members = json.loads(first)["fleet_workers"]
             deadline = time.monotonic() + timeout_s / 2
-            ready = set()
-            while len(ready) < len(members):
-                if time.monotonic() > deadline or child.poll() is not None:
-                    break
-                for m in members:
-                    if m["worker_id"] in ready:
-                        continue
-                    try:
-                        status, _ = get_probe(m["url"], 1.0)
-                        if status == 200:
-                            ready.add(m["worker_id"])
-                    except WorkerTransportError:
-                        pass
-                time.sleep(0.2)
-            time.sleep(kill_one_after_s)
             victim = members[0]
+            # watch the VICTIM until it is visibly serving (probe-body
+            # load counters — the launcher only opens traffic once the
+            # whole fleet is ready, so observed load implies readiness
+            # everywhere); a bench-side all-ready pass before watching
+            # would itself race a short stream on a fast host. Tight
+            # 20 ms polling: the smoke stream can drain in ~1 s
+            while time.monotonic() < deadline and child.poll() is None:
+                try:
+                    status, body = get_probe(victim["url"], 0.5)
+                    q = body.get("queue", {})
+                    if status == 200 and (q.get("depth", 0)
+                                          + q.get("inflight", 0)) > 0:
+                        break
+                except WorkerTransportError:
+                    pass
+                time.sleep(0.02)
+            time.sleep(kill_one_after_s)
             killed_pid = victim["pid"]
             print(f"fleet_bench: SIGKILL worker {victim['worker_id']} "
                   f"(pid {killed_pid}) mid-traffic", file=sys.stderr)
@@ -255,6 +270,31 @@ def check_bit_identical(check: Check, tag: str, out_csv: str,
     return n_served
 
 
+def run_graftscope(check: Check, tag: str, tele_dir: str,
+                   expect_ok: int, perfetto: str = "") -> dict:
+    """Run the trace collector CLI over a run's shared telemetry dir
+    and exit-code-assert trace completeness: zero orphans, one root per
+    trace, a full stage chain per successful Future (tools/graftscope).
+    Returns the report dict for embedding in the bench JSON."""
+    cmd = [sys.executable, "-m", "tools.graftscope",
+           "--telemetry_dir", tele_dir, "--assert_complete",
+           "--expect_ok", str(expect_ok), "--top_k", "3"]
+    if perfetto:
+        cmd += ["--perfetto", perfetto]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=300, cwd=_REPO,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    check.expect(proc.returncode == 0,
+                 f"{tag}: graftscope exited {proc.returncode} — "
+                 f"{proc.stderr[-1000:]}")
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        check.expect(False, f"{tag}: graftscope produced no report "
+                            f"JSON (stderr: {proc.stderr[-500:]})")
+        return {}
+
+
 def counters_in(tele_dir: str) -> set:
     from pertgnn_tpu.telemetry import load_events
 
@@ -273,6 +313,9 @@ def main(argv=None) -> int:
     p.add_argument("--smoke", action="store_true",
                    help="tier-1 mode: N=2, tiny stream, warm-start + "
                         "chaos only (no scaling phase)")
+    p.add_argument("--dryrun", action="store_true",
+                   help="alias for --smoke (the CI spelling, matching "
+                        "stream_bench)")
     p.add_argument("--skip_scaling", action="store_true",
                    help="skip the N=1 vs N=4 scaling phase")
     p.add_argument("--skip_chaos", action="store_true",
@@ -286,6 +329,7 @@ def main(argv=None) -> int:
                         "spread — max-over-repeats estimates capacity "
                         "with interference noise mostly removed)")
     args = p.parse_args(argv)
+    args.smoke = args.smoke or args.dryrun
 
     check = Check()
     t0 = time.perf_counter()
@@ -369,12 +413,18 @@ def main(argv=None) -> int:
         }
 
     if not args.skip_chaos:
-        n_chaos = 400 if args.smoke else 2000
+        # enough stream that the kill provably lands mid-traffic even
+        # on a fast host (the smoke stream used to be 400, which a
+        # 2-worker fleet can drain in under a second — the SIGKILL then
+        # raced past the end and the chaos gates asserted against a
+        # death nobody witnessed)
+        n_chaos = 1000 if args.smoke else 2000
         chaos_csv = os.path.join(tmp, "requests_chaos.csv")
         c_entries, c_tsb = request_stream(ds, n_chaos, chaos_csv)
         c_ref = reference_preds(engine, c_entries, c_tsb)
         rc_ = run_fleet(tmp, "chaos", 2, chaos_csv,
-                        kill_one_after_s=0.5, telemetry_level="trace")
+                        kill_one_after_s=0.15, telemetry_level="trace",
+                        extra_flags=["--trace_sample_rate", "1.0"])
         st = rc_["stats"]
         check.expect(rc_["rc"] == 0,
                      f"chaos: fleet run exited {rc_['rc']} after the "
@@ -392,16 +442,31 @@ def main(argv=None) -> int:
                                        c_ref, require_all=True)
         names = counters_in(os.path.join(tmp, "tele_chaos"))
         for counter in ("router.dispatch", "router.requeue",
-                        "router.worker_lost", "router.members"):
+                        "router.worker_lost", "router.members",
+                        "router.queue_wait"):
             check.expect(counter in names,
                          f"telemetry: {counter} missing from the chaos "
                          f"run's JSONL")
+        # graftscope over the chaos run's shared telemetry dir: every
+        # successful Future (all of them — served == n_chaos is gated
+        # above) must collect into EXACTLY one root with a complete
+        # stage chain, zero orphans, ACROSS the worker kill — the
+        # ISSUE-12 trace-completeness invariant, exit-code-asserted
+        scope = run_graftscope(check, "chaos",
+                               os.path.join(tmp, "tele_chaos"),
+                               expect_ok=n_served,
+                               perfetto=os.path.join(
+                                   tmp, "chaos.perfetto.json"))
         results["chaos"] = {
             "requests": n_chaos, "served": n_served,
             "killed_pid": rc_["killed_pid"],
             "worker_lost": router.get("worker_lost"),
             "requeues": router.get("requeues"),
             "ready_s": st.get("ready_s"),
+            "trace_attribution": scope.get("stage_ms"),
+            "trace_clock": scope.get("clock"),
+            "traces_ok": scope.get("traces_ok"),
+            "trace_orphans": scope.get("orphans"),
         }
     elif args.smoke:
         # smoke without chaos still needs one live fleet for the

@@ -23,6 +23,11 @@ fleet_bench/chaos_bench split):
   bit-identical to v2.
 - **telemetry** — the `stream.*` and `rollout.*` counters land in the
   JSONL (docs/OBSERVABILITY.md).
+- **tracing** — the rollout serves at `--trace_sample_rate 1.0`;
+  tools/graftscope merges the router's and every (v1 and replacement
+  v2) worker's telemetry files and must find EVERY successful Future
+  as exactly one root span with a complete stage chain, zero orphans —
+  trace completeness ACROSS a blue/green rollout (ISSUE 12).
 
 CPU by default. One JSON line on stdout.
 
@@ -337,6 +342,12 @@ def worker_argv(tmp: str, budget, ckpt_dir: str, wid: str,
     return [sys.executable, "-m", "pertgnn_tpu.cli.fleet_main",
             "--role", "worker", "--worker_id", wid,
             "--worker_port", str(port),
+            # same telemetry dir as the parent (which runs the router):
+            # graftscope merges router + worker files into one request
+            # tree per trace, across the v1 AND replacement v2 workers
+            "--telemetry_dir", os.path.join(tmp, "tele_parent"),
+            "--telemetry_level", "trace",
+            "--trace_sample_rate", "1.0",
             "--data_dir", os.path.join(tmp, "raw_base"),
             "--artifact_dir", os.path.join(tmp, "art_base"),
             "--arena_cache_dir", os.path.join(tmp, "arena"),
@@ -541,6 +552,32 @@ def check_rollout(check: Check, tmp: str, cfg, base_ds, budget,
     for w in workers:
         stop_worker(w)
 
+    # graftscope over the shared telemetry dir (the in-process router +
+    # every v1/v2 worker): EVERY successful Future across the rollout —
+    # drains, requeues, replacement workers — must collect into exactly
+    # one root with a complete stage chain, zero orphans (ISSUE 12)
+    from pertgnn_tpu import telemetry as _tele
+    _tele.get_bus().flush()
+    trace_report: dict = {}
+    n_expected = n_served[0] + n_post
+    from tools.graftscope import OrphanSpanError, build_report, collect
+    try:
+        trace_report = build_report(
+            collect(os.path.join(tmp, "tele_parent")), top_k=3)
+    except OrphanSpanError as exc:
+        check.expect(False, f"rollout traces: {exc}")
+    if trace_report:
+        check.expect(trace_report["incomplete"] == 0,
+                     f"rollout traces: {trace_report['incomplete']} "
+                     f"incomplete ok trace(s); first: "
+                     f"{trace_report['completeness_violations'][:1]}")
+        check.expect(trace_report["multi_root"] == 0,
+                     f"rollout traces: {trace_report['multi_root']} "
+                     f"multi-root trace(s)")
+        check.expect(trace_report["traces_ok"] == n_expected,
+                     f"rollout traces: {trace_report['traces_ok']} ok "
+                     f"roots for {n_expected} successful requests")
+
     check.expect(not bad, f"rollout: {len(bad)} request failure(s)/"
                           f"mismatch(es); first: {bad[0] if bad else ''}")
     check.expect(n_served[0] > 0, "rollout: no requests served at all")
@@ -561,7 +598,11 @@ def check_rollout(check: Check, tmp: str, cfg, base_ds, budget,
             "router": router_stats,
             "client_latency": summary_lat,
             "p99_bound_ms": p99_bound,
-            "versions_differ": versions_differ}
+            "versions_differ": versions_differ,
+            "trace_attribution": trace_report.get("stage_ms"),
+            "trace_clock": trace_report.get("clock"),
+            "traces_ok": trace_report.get("traces_ok"),
+            "trace_orphans": trace_report.get("orphans")}
 
 
 # -- main ------------------------------------------------------------------
@@ -590,7 +631,8 @@ def main(argv=None) -> int:
     os.makedirs(tmp, exist_ok=True)
     tele_dir = os.path.join(tmp, "tele_parent")
     telemetry.configure_from_config(
-        TelemetryConfig(telemetry_dir=tele_dir, telemetry_level="trace"),
+        TelemetryConfig(telemetry_dir=tele_dir, telemetry_level="trace",
+                        trace_sample_rate=1.0),
         run_meta={"cli": "stream_bench"})
     from pertgnn_tpu.aot import enable_compile_cache
 

@@ -1,4 +1,5 @@
-"""Micro-bench: the no-op telemetry bus must be free.
+"""Micro-bench: the no-op telemetry bus must be free — and sampled
+request tracing must fit the same budget.
 
 Instrumentation stays in the hot paths unconditionally (train chunk
 dispatch, serve request loop, the packer), so the disabled-bus cost is a
@@ -11,6 +12,15 @@ a real CPU train step and asserts the ratio stays under 1%:
   fit() actually emits it (one level-2 span enter/exit + the host/device
   perf_counter bookkeeping), measured on the NoopBus over many reps;
 - `overhead_pct` = 100 * noop_ms / step_ms — asserted < 1.0.
+
+Distributed tracing (ISSUE 12) adds a second budget line: the
+PER-REQUEST tracing bundle as the fleet front door emits it
+(start_trace head sampling + three stage spans + the root finish) is
+measured on a REAL trace-level bus at sample rates 0.0 / 0.1 (the
+TelemetryConfig default) / 1.0, and the default-rate bundle is asserted
+under the same 1% of a train step — so turning tracing on at the
+shipped rate cannot silently tax the serve path. Rate 0.0 exercises
+the None-context fast path; 1.0 prices a fully-written trace.
 
 Prints ONE JSON line in the BENCH_r0*.json schema family; exits 1 on a
 bound violation so CI can gate on it.
@@ -93,10 +103,42 @@ def time_noop_bundle(iters: int) -> float:
     return total / iters
 
 
+def time_trace_bundle(directory: str, rate: float, slow_ms: float,
+                      iters: int) -> float:
+    """Mean seconds of one traced-request lifecycle on a REAL
+    trace-level bus at the given head-sample rate: the router-side
+    bundle (start_trace + router_queue/transport/complete stage spans +
+    root finish). At rate 0 this is the None-context fast path; between
+    0 and 1 the unsampled majority pays buffer appends that the
+    under-slow-threshold finish drops; at 1 every span hits the
+    line-buffered writer."""
+    import time as _time
+
+    from pertgnn_tpu.telemetry import MetricsWriter, TelemetryBus
+
+    writer = MetricsWriter(os.path.join(directory, f"rate_{rate:g}"))
+    bus = TelemetryBus(writer, level="trace", trace_sample_rate=rate,
+                       trace_slow_ms=slow_ms)
+    tm = _time.monotonic()
+    t0 = _time.perf_counter()
+    for i in range(iters):
+        ctx = bus.start_trace()
+        bus.trace_span("trace.router_queue", ctx, tm, tm, worker="w0")
+        bus.trace_span("trace.transport", ctx, tm, tm, worker="w0",
+                       outcome="ok")
+        bus.trace_span("trace.complete", ctx, tm, tm)
+        bus.finish_trace("trace.request", ctx, tm, tm, outcome="ok",
+                         entry_id=i)
+    dt = (_time.perf_counter() - t0) / iters
+    bus.close()
+    return dt
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--step_iters", type=int, default=50)
     ap.add_argument("--noop_iters", type=int, default=200_000)
+    ap.add_argument("--trace_iters", type=int, default=20_000)
     ap.add_argument("--max_overhead_pct", type=float, default=1.0)
     ap.add_argument("--out", default="",
                     help="also write the JSON record here")
@@ -114,6 +156,23 @@ def main() -> int:
     step_s = time_step(step, state, batch, args.step_iters)
     noop_s = time_noop_bundle(args.noop_iters)
     overhead_pct = 100.0 * noop_s / step_s
+
+    # sampled request tracing against the same unit of work, at the
+    # config default rate plus the two extremes
+    import tempfile
+
+    from pertgnn_tpu.config import TelemetryConfig
+    default_rate = TelemetryConfig.trace_sample_rate
+    slow_ms = TelemetryConfig.trace_slow_ms
+    trace_us = {}
+    # the rate-1.0 pass writes ~5 span lines per iteration — scratch
+    # JSONL that must not accumulate across bench runs
+    with tempfile.TemporaryDirectory(prefix="tele_overhead_") as td:
+        for rate in (0.0, default_rate, 1.0):
+            trace_us[f"{rate:g}"] = time_trace_bundle(
+                td, rate, slow_ms, args.trace_iters) * 1e6
+    trace_overhead_pct = (trace_us[f"{default_rate:g}"] / 1e6 / step_s
+                          * 100.0)
     record = {
         "metric": "telemetry_noop_overhead_pct",
         "value": overhead_pct,
@@ -123,6 +182,11 @@ def main() -> int:
         "step_iters": args.step_iters,
         "noop_iters": args.noop_iters,
         "max_overhead_pct": args.max_overhead_pct,
+        "trace_bundle_us_by_rate": {k: round(v, 3)
+                                    for k, v in trace_us.items()},
+        "trace_default_rate": default_rate,
+        "trace_overhead_pct": trace_overhead_pct,
+        "trace_iters": args.trace_iters,
         "backend": jax.default_backend(),
         "captured_unix_time": time.time(),
     }
@@ -131,12 +195,18 @@ def main() -> int:
     if args.out:
         with open(args.out, "w") as f:
             f.write(out + "\n")
+    rc = 0
     if overhead_pct >= args.max_overhead_pct:
         print(f"FAIL: no-op telemetry bundle is {overhead_pct:.3f}% of a "
               f"CPU train step (bound {args.max_overhead_pct}%)",
               file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if trace_overhead_pct >= args.max_overhead_pct:
+        print(f"FAIL: default-rate ({default_rate:g}) tracing bundle is "
+              f"{trace_overhead_pct:.3f}% of a CPU train step (bound "
+              f"{args.max_overhead_pct}%)", file=sys.stderr)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

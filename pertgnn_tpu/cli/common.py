@@ -476,6 +476,19 @@ def add_telemetry_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--tensorboard", action="store_true",
                    help="mirror scalar telemetry to a TensorBoard sink "
                         "under <telemetry_dir>/tb (needs tensorboardX)")
+    p.add_argument("--trace_sample_rate", type=float, default=0.1,
+                   help="distributed request tracing: head-sampling "
+                        "probability per request (trace level only; "
+                        "tools/graftscope merges the spans)")
+    p.add_argument("--trace_slow_ms", type=float, default=250.0,
+                   help="always-keep override: an unsampled request "
+                        "slower than this flushes its spans anyway "
+                        "(tail exemplars survive low sample rates); "
+                        "<= 0 disables")
+    p.add_argument("--telemetry_rotate_mb", type=float, default=0.0,
+                   help="rotate the telemetry JSONL into .partN.jsonl "
+                        "siblings past this many MiB (long-lived "
+                        "fleet/stream runs); 0 = one unbounded file")
     p.add_argument("--log_level", default="",
                    help="logging level name (DEBUG/INFO/...); default: "
                         "$PERTGNN_LOG_LEVEL or INFO")
@@ -488,7 +501,10 @@ def telemetry_config_from_args(args: argparse.Namespace) -> TelemetryConfig:
     return TelemetryConfig(
         telemetry_dir=getattr(args, "telemetry_dir", ""),
         telemetry_level=getattr(args, "telemetry_level", "basic"),
-        tensorboard=getattr(args, "tensorboard", False))
+        tensorboard=getattr(args, "tensorboard", False),
+        trace_sample_rate=getattr(args, "trace_sample_rate", 0.1),
+        trace_slow_ms=getattr(args, "trace_slow_ms", 250.0),
+        telemetry_rotate_mb=getattr(args, "telemetry_rotate_mb", 0.0))
 
 
 def setup_telemetry(args: argparse.Namespace, cli: str):

@@ -209,7 +209,10 @@ def _run_worker(args, p: argparse.ArgumentParser) -> None:
     worker_id = args.worker_id or f"w{os.getpid()}"
 
     stop = threading.Event()
-    queue = MicrobatchQueue(engine)
+    # trace_roots=False: the ROUTER is the fleet's trace front door —
+    # a worker head-sampling its own roots would fork the sampling
+    # decision per process; propagated contexts still trace here
+    queue = MicrobatchQueue(engine, trace_roots=False)
 
     def extra():
         return {"worker_id": worker_id, "pid": os.getpid(),

@@ -514,6 +514,21 @@ class TelemetryConfig:
     # Mirror scalar events to a TensorBoard sink under telemetry_dir/tb
     # (requires tensorboardX; silently JSONL-only without it).
     tensorboard: bool = False
+    # Distributed request tracing (telemetry/tracing.py, effective at
+    # "trace" level only): head-sampling probability per request. The
+    # 0.1 default keeps tracing within the telemetry_overhead.py 1%
+    # budget; benches asserting trace completeness run at 1.0.
+    trace_sample_rate: float = 0.1
+    # Always-keep override: an UNSAMPLED request whose total latency
+    # crosses this many ms flushes its buffered spans anyway (tagged
+    # sampled="slow") — tail exemplars survive low sample rates.
+    # <= 0 disables the override.
+    trace_slow_ms: float = 250.0
+    # Size-based JSONL rotation: when the current telemetry file
+    # exceeds this many MiB the writer switches to a .partN.jsonl
+    # sibling (tools/graftscope and every glob-the-dir reader see all
+    # parts). 0 (default) = one unbounded file.
+    telemetry_rotate_mb: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

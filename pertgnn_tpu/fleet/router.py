@@ -46,8 +46,18 @@ mid-traffic SIGKILL.
 Telemetry (docs/OBSERVABILITY.md): counters ``router.dispatch`` /
 ``router.requeue`` / ``router.worker_lost`` / ``router.worker_recovered``
 / ``router.shed`` / ``router.shed_infeasible`` /
-``router.deadline_exceeded``, gauge ``router.members``, histograms
-``router.batch_ms`` / ``router.request_total_ms``.
+``router.deadline_exceeded``, gauges ``router.members`` /
+``router.queue_wait`` (admission->dispatch wait — the autoscale
+signal), histograms ``router.batch_ms`` / ``router.request_total_ms``.
+
+Distributed tracing: the router is the fleet's trace FRONT DOOR —
+submit head-samples a TraceContext per request (bus.start_trace), the
+dispatch path emits ``trace.router_queue`` / ``trace.transport`` /
+``trace.complete`` stage spans under a ``trace.request`` root, and the
+transport propagates sampled contexts so worker-side stage spans parent
+under the router's transport span (telemetry/tracing.py,
+tools/graftscope — docs/OBSERVABILITY.md "Distributed request
+tracing").
 """
 
 from __future__ import annotations
@@ -63,6 +73,7 @@ from concurrent.futures import Future
 from pertgnn_tpu import telemetry
 from pertgnn_tpu.config import FleetConfig
 from pertgnn_tpu.fleet import policy
+from pertgnn_tpu.telemetry.tracing import new_span_id
 from pertgnn_tpu.fleet.transport import (WorkerTransportError,
                                          error_from_row, get_probe,
                                          post_predict)
@@ -88,6 +99,15 @@ class _Request:
     deadline_abs: float
     future: Future
     requeues: int = 0
+    # distributed tracing (telemetry/tracing.py): the head-sampled
+    # TraceContext (None = untraced) and the submit stamp on the
+    # CLOCK_MONOTONIC clock graftscope aligns across processes
+    trace: object = None
+    tm_submit: float = 0.0
+    # start of the CURRENT queue residency (== tm_submit until a
+    # requeue resets it) — each dispatch attempt gets its own
+    # trace.router_queue span instead of overlapping the first
+    tm_queue_start: float = 0.0
 
 
 class _Worker:
@@ -194,6 +214,11 @@ class FleetRouter:
         # dispatcher (same placement as the single-process queue)
         self._request_size(eid)
         fut: Future = Future()
+        # head-sampling decision at the fleet's front door, BEFORE the
+        # lock (dice roll + urandom must not serialize admission); a
+        # rejected submit discards the context unemitted — no orphans
+        ctx = self.bus.start_trace()
+        tm_submit = time.monotonic() if ctx is not None else 0.0
         counter = reject = None
         with self._wake:
             if self._closed:
@@ -221,7 +246,9 @@ class FleetRouter:
                     self._pending.append(_Request(
                         seq=self._seq, entry_id=eid,
                         ts_bucket=int(ts_bucket), arrival=now,
-                        deadline_abs=deadline, future=fut))
+                        deadline_abs=deadline, future=fut,
+                        trace=ctx, tm_submit=tm_submit,
+                        tm_queue_start=tm_submit))
                     self._seq += 1
                     self._wake.notify_all()
         if reject is not None:
@@ -433,9 +460,29 @@ class FleetRouter:
             if not batch:
                 return
             if target is not None:
+                now = time.perf_counter()
+                # the queue-wait gauge ROADMAP item 3's autoscale
+                # threshold reads: admission -> dispatch of the oldest
+                # request in this batch, at BASIC level (one write per
+                # BATCH — an autoscaler must not need trace verbosity)
+                self.bus.gauge("router.queue_wait",
+                               (now - batch[0].arrival) * 1e3,
+                               worker=target.worker_id,
+                               graphs=len(batch))
                 self.bus.counter("router.dispatch", level=2,
                                  worker=target.worker_id,
                                  graphs=len(batch))
+                # per-request router-queue stage spans, emitted BEFORE
+                # the sender takes ownership (a buffered context must
+                # never be appended to after its finish flushes it)
+                tm_now = time.monotonic()
+                for r in batch:
+                    if r.trace is not None:
+                        self.bus.trace_span(
+                            "trace.router_queue", r.trace,
+                            r.tm_queue_start, tm_now,
+                            worker=target.worker_id,
+                            attempt=r.requeues)
                 target.sender_q.put(batch)
                 return
 
@@ -447,35 +494,76 @@ class FleetRouter:
             if item is None:
                 return
             batch: list[_Request] = item
+            # transport span ids are pre-allocated so the worker can
+            # parent its stage spans under them (the propagation);
+            # the span itself is emitted after the round trip settles
+            sids = [new_span_id() if r.trace is not None else None
+                    for r in batch]
+            trace_meta = [
+                {"tid": r.trace.trace_id, "psid": sid}
+                if r.trace is not None and r.trace.sampled else None
+                for r, sid in zip(batch, sids)]
             t0 = time.perf_counter()
+            tm0 = time.monotonic()
             try:
                 rows = post_predict(
                     w.base_url, [r.entry_id for r in batch],
-                    [r.ts_bucket for r in batch], self._timeout_s)
+                    [r.ts_bucket for r in batch], self._timeout_s,
+                    trace=trace_meta)
             except WorkerTransportError as exc:
+                tm1 = time.monotonic()
+                for r, sid in zip(batch, sids):
+                    if r.trace is not None:
+                        self.bus.trace_span(
+                            "trace.transport", r.trace, tm0, tm1,
+                            span_id=sid, worker=w.worker_id,
+                            outcome="lost")
                 self._on_worker_lost(w, batch, exc)
                 continue
             self._on_batch_done(w, batch, rows,
-                                time.perf_counter() - t0)
+                                time.perf_counter() - t0,
+                                tm0, time.monotonic(), sids)
 
     def _on_batch_done(self, w: _Worker, batch: list[_Request],
-                       rows: list[dict], dt: float) -> None:
+                       rows: list[dict], dt: float, tm0: float,
+                       tm1: float, sids: list) -> None:
         alpha = self._cfg.latency_ewma_alpha
         retry: list[_Request] = []
         give_up: list[tuple[_Request, Exception]] = []
+        tm_requeue = time.monotonic()
+        # retry triage BEFORE the lock: requeues/tm_queue_start are
+        # sender-custody state (the dispatcher only reads them after
+        # merge_requeue republishes the request, which happens-before
+        # via the lock below)
+        for r, row in zip(batch, rows):
+            if row.get("error") in RETRYABLE_ROWS:
+                r.requeues += 1
+                if r.requeues > self._max_requeues:
+                    give_up.append((r, error_from_row(row)))
+                else:
+                    r.tm_queue_start = tm_requeue
+                    retry.append(r)
+        retry_set = {id(r) for r in retry}
+        # transport stage spans: every attempt gets one, tagged with
+        # its verdict — a retried request's trace shows BOTH legs.
+        # Emitted BEFORE merge_requeue republishes the retries: a
+        # TraceContext's buffer is single-owner/no-lock, and the
+        # moment a retry is back in the pending queue another thread
+        # may emit on (or finish) its context
+        for r, row, sid in zip(batch, rows, sids):
+            if r.trace is None:
+                continue
+            outcome = ("retry" if id(r) in retry_set
+                       else "ok" if "pred" in row else "error")
+            self.bus.trace_span("trace.transport", r.trace, tm0, tm1,
+                                span_id=sid, worker=w.worker_id,
+                                outcome=outcome)
         with self._wake:
             w.inflight_batches -= 1
             w.inflight_requests -= len(batch)
             w.ewma_batch_s = (dt if not w.ewma_seen else
                               alpha * dt + (1 - alpha) * w.ewma_batch_s)
             w.ewma_seen = True
-            for r, row in zip(batch, rows):
-                if row.get("error") in RETRYABLE_ROWS:
-                    r.requeues += 1
-                    if r.requeues > self._max_requeues:
-                        give_up.append((r, error_from_row(row)))
-                    else:
-                        retry.append(r)
             if retry:
                 self.requeues += len(retry)
                 self._pending[:] = policy.merge_requeue(self._pending,
@@ -487,7 +575,6 @@ class FleetRouter:
             self.bus.counter("router.requeue", len(retry),
                              worker=w.worker_id, reason="worker_busy")
         t_done = time.perf_counter()
-        retry_set = {id(r) for r in retry}
         n_served = 0
         for r, row in zip(batch, rows):
             if id(r) in retry_set:
@@ -497,6 +584,14 @@ class FleetRouter:
                 self.bus.histogram("router.request_total_ms",
                                    (t_done - r.arrival) * 1e3, level=2)
                 r.future.set_result(float(row["pred"]))
+                if r.trace is not None:
+                    tm_settle = time.monotonic()
+                    self.bus.trace_span("trace.complete", r.trace, tm1,
+                                        tm_settle)
+                    self.bus.finish_trace("trace.request", r.trace,
+                                          r.tm_submit, tm_settle,
+                                          outcome="ok",
+                                          entry_id=r.entry_id)
             else:
                 self._resolve_error(r, error_from_row(row))
         if n_served:
@@ -535,11 +630,13 @@ class FleetRouter:
                 w.inflight_requests -= len(queued)
                 recovered.extend(queued)
             keep: list[_Request] = []
+            tm_requeue = time.monotonic()
             for r in recovered:
                 r.requeues += 1
                 if r.requeues > self._max_requeues:
                     give_up.append(r)
                 else:
+                    r.tm_queue_start = tm_requeue
                     keep.append(r)
             if keep:
                 self.requeues += len(keep)
@@ -616,6 +713,12 @@ class FleetRouter:
             with self._lock:
                 self.failed += 1
             r.future.set_exception(exc)
+            if r.trace is not None:
+                self.bus.finish_trace("trace.request", r.trace,
+                                      r.tm_submit, time.monotonic(),
+                                      outcome="error",
+                                      error=type(exc).__name__,
+                                      entry_id=r.entry_id)
 
     def _fail_batch(self, batch: list[_Request], exc: Exception) -> None:
         for r in batch:

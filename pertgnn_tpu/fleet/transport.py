@@ -95,7 +95,8 @@ class WorkerServer:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length))
                     results = outer._predict(req["entries"],
-                                             req["ts_buckets"])
+                                             req["ts_buckets"],
+                                             req.get("trace"))
                 except faults.InjectedFault as exc:
                     # the armed chaos plan asked for a transport-level
                     # failure: the router must see this worker as lost
@@ -133,11 +134,16 @@ class WorkerServer:
     def port(self) -> int:
         return self._server.server_address[1]
 
-    def _predict(self, entries, ts_buckets) -> list[dict]:
+    def _predict(self, entries, ts_buckets,
+                 trace: list | None = None) -> list[dict]:
         """Submit one router microbatch to the local queue and wait —
         per-request rows in request order, every row present (a
         submitted Future ALWAYS resolves; a rejected submit IS the
-        row's outcome)."""
+        row's outcome). ``trace`` is the router's per-request trace
+        propagation: None, or one ``{"tid", "psid"}``/null per request
+        — the worker's stage spans parent under the router's transport
+        span (``psid``), so graftscope can join the two processes'
+        JSONL files into one request tree."""
         plan = faults.active()
         if plan is not None:
             verdict = plan.fire("fleet.worker", entry_ids=entries)
@@ -146,10 +152,15 @@ class WorkerServer:
                 # SIGKILL to the router (connection dies mid-call)
                 log.error("fault injection: fleet.worker kill — exiting")
                 os._exit(137)
+        if trace is None or len(trace) != len(entries):
+            trace = [None] * len(entries)
         futures = []
-        for eid, tsb in zip(entries, ts_buckets):
+        for eid, tsb, t in zip(entries, ts_buckets, trace):
+            ctx = (self._engine.bus.adopt_trace(t["tid"], t["psid"])
+                   if isinstance(t, dict) else None)
             try:
-                futures.append(self._queue.submit(int(eid), int(tsb)))
+                futures.append(self._queue.submit(int(eid), int(tsb),
+                                                  trace=ctx))
             except serve_errors.ServeError as exc:
                 futures.append(exc)  # admission outcome, row below
         rows: list[dict] = []
@@ -173,13 +184,18 @@ class WorkerServer:
 # -- router-side client ---------------------------------------------------
 
 def post_predict(base_url: str, entries, ts_buckets,
-                 timeout_s: float) -> list[dict]:
+                 timeout_s: float, trace: list | None = None) -> list[dict]:
     """One microbatch dispatch; returns per-request rows. Raises
     WorkerTransportError on ANY transport-level failure (the lost-worker
-    signature)."""
-    body = json.dumps({"entries": [int(e) for e in entries],
-                       "ts_buckets": [int(t) for t in ts_buckets]}
-                      ).encode()
+    signature). ``trace`` propagates per-request trace contexts (one
+    ``{"tid", "psid"}`` or None per request); omitted entirely when no
+    request in the batch is head-sampled, so untraced traffic pays zero
+    wire bytes."""
+    payload = {"entries": [int(e) for e in entries],
+               "ts_buckets": [int(t) for t in ts_buckets]}
+    if trace is not None and any(t is not None for t in trace):
+        payload["trace"] = trace
+    body = json.dumps(payload).encode()
     req = urllib.request.Request(
         f"{base_url}/predict", data=body, method="POST",
         headers={"Content-Type": "application/json"})

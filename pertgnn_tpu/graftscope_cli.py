@@ -1,0 +1,37 @@
+"""Console-script launcher for graftscope (docs/OBSERVABILITY.md).
+
+Same pattern as graftlint_cli.py / graftaudit_cli.py: the launcher
+lives inside `pertgnn_tpu` so the wheel never ships a generic
+top-level `tools` package (namespace squatting), while the
+`graftscope` entry point still works in the install mode where the
+collector's sibling source exists — an editable (in-repo) install —
+and fails with a clear message, not a ModuleNotFoundError, everywhere
+else. Unlike the two analyzers, graftscope reads telemetry JSONL (not
+the source tree), but it ships with the repo the same way.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(repo, "tools", "graftscope")):
+        print(
+            "graftscope: no tools/graftscope next to this package — "
+            "the collector ships as a sibling of an editable (in-repo) "
+            "install. From a checkout, run "
+            "`python -m tools.graftscope` (docs/OBSERVABILITY.md).",
+            file=sys.stderr)
+        return 2
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.graftscope.cli import main as graftscope_main
+
+    return graftscope_main(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -115,6 +115,12 @@ class PackedMicrobatch:
     # completion is DEFERRED past the next coalesce window, and that
     # queue idle must not masquerade as engine latency in stats_dict.
     engine_s: float
+    # stage -> (tm0, tm1) CLOCK_MONOTONIC stamps of this batch's
+    # pack/dispatch/compute phases — what the microbatch queue turns
+    # into per-request trace spans (telemetry/tracing.py); monotonic,
+    # not perf_counter, because the graftscope collector aligns these
+    # stamps across processes
+    stage_tm: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -200,6 +206,9 @@ class InferenceEngine:
         # per-stage latency breakdown of the request lifecycle; "queue"
         # is fed by MicrobatchQueue (the engine itself never queues)
         self.stage_latency = {s: LatencyRecorder() for s in STAGES}
+        # monotonic (tm0, tm1) stamps per stage of the most recently
+        # COMPLETED batch (see complete_microbatch)
+        self.last_stage_tm: dict[str, tuple[float, float]] = {}
         self._bucket_stats = {i: _BucketStats()
                               for i in range(len(self.ladder))}
         self.requests = 0
@@ -414,6 +423,7 @@ class InferenceEngine:
                 f"microbatch of {g} graphs ({n} nodes, {e_tot} edges) "
                 f"exceeds the top bucket {self.ladder[-1]}")
         t0 = time.perf_counter()
+        tm0 = time.monotonic()
         with self.stage_latency["pack"].time(), \
                 self._bus.span("serve.pack", level=2, bucket=idx,
                                graphs=g):
@@ -423,7 +433,8 @@ class InferenceEngine:
                                 node_depth_in_x=self._node_depth_in_x)
         return PackedMicrobatch(entry_ids=entry_ids, idx=idx, batch=batch,
                                 n=n, e_tot=e_tot,
-                                engine_s=time.perf_counter() - t0)
+                                engine_s=time.perf_counter() - t0,
+                                stage_tm={"pack": (tm0, time.monotonic())})
 
     def dispatch_packed(self, packed: PackedMicrobatch) -> InFlightBatch:
         """Device half, part 1: resolve the rung executable and launch
@@ -461,9 +472,11 @@ class InferenceEngine:
                     "— the ladder no longer covers the request range",
                     self.ladder[idx])
             exe = self._compile(idx)
+        tm0 = time.monotonic()
         with self.stage_latency["dispatch"].time(), \
                 bus.span("serve.dispatch", level=2, bucket=idx):
             out = exe(self._variables, packed.batch)
+        packed.stage_tm["dispatch"] = (tm0, time.monotonic())
         packed.engine_s += time.perf_counter() - t0
         return InFlightBatch(packed=packed, out=out, injected=injected)
 
@@ -476,9 +489,11 @@ class InferenceEngine:
         idx, g = packed.idx, len(packed.entry_ids)
         entry_ids, n, e_tot = packed.entry_ids, packed.n, packed.e_tot
         t0 = time.perf_counter()
+        tm0 = time.monotonic()
         with self.stage_latency["compute"].time(), \
                 bus.span("serve.compute", level=2, bucket=idx):
             pred = np.asarray(inflight.out)[:g]
+        packed.stage_tm["compute"] = (tm0, time.monotonic())
         packed.engine_s += time.perf_counter() - t0
         if inflight.injected == "nan":
             pred = np.full_like(pred, np.nan)
@@ -497,6 +512,11 @@ class InferenceEngine:
             raise NonFiniteOutput(
                 f"model returned non-finite predictions for entries "
                 f"{bad[:8].tolist()}")
+        # stage stamps of the batch that JUST completed, for the queue's
+        # per-request trace spans: engine device calls are strictly
+        # serialized (one worker/dispatcher thread), so "last completed"
+        # is unambiguous when the queue reads it in its settle step
+        self.last_stage_tm = packed.stage_tm
         # pack + dispatch + compute phase durations, NOT wall since pack
         # start: an overlapped completion is deferred past the next
         # coalesce window, and that queue idle belongs to

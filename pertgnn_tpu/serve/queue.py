@@ -79,7 +79,24 @@ from pertgnn_tpu.serve.errors import (DeadlineExceeded, DispatchTimeout,
 log = logging.getLogger(__name__)
 
 # pending-entry tuple layout (submission order is load-bearing):
-# (entry_id, ts_bucket, arrival_time, deadline_abs, future)
+# (entry_id, ts_bucket, arrival_time, deadline_abs, future, trace)
+# trace is None (untraced) or a _ReqTrace
+
+
+class _ReqTrace:
+    """One traced request's context riding its pending tuple.
+
+    ``owns_root`` distinguishes a root the queue STARTED (standalone
+    serving — the queue finishes the trace at settle/fail) from a
+    context ADOPTED off the fleet transport (the router owns the root;
+    the worker-side queue only contributes stage spans)."""
+
+    __slots__ = ("ctx", "tm_submit", "owns_root")
+
+    def __init__(self, ctx, tm_submit: float, owns_root: bool):
+        self.ctx = ctx
+        self.tm_submit = tm_submit
+        self.owns_root = owns_root
 
 
 def _call_abandonable(fn, timeout: float, name: str):
@@ -174,9 +191,15 @@ class MicrobatchQueue:
                  request_deadline_ms: float | None = None,
                  dispatch_timeout_s: float | None = None,
                  quarantine_threshold: int | None = None,
-                 overlap_dispatch: bool | None = None):
+                 overlap_dispatch: bool | None = None,
+                 trace_roots: bool = True):
         cfg = engine._cfg.serve
         self._engine = engine
+        # whether THIS queue is a trace front door (standalone serving).
+        # A fleet worker's queue sets False: its requests arrive with a
+        # router-owned context over the transport, and head-sampling
+        # twice would fork the fleet's sampling decision per process.
+        self._trace_roots = trace_roots
         self._deadline_s = (cfg.flush_deadline_ms
                             if flush_deadline_ms is None
                             else flush_deadline_ms) / 1e3
@@ -244,17 +267,31 @@ class MicrobatchQueue:
 
     # -- client side -----------------------------------------------------
 
-    def submit(self, entry_id: int, ts_bucket: int) -> Future:
+    def submit(self, entry_id: int, ts_bucket: int,
+               trace=None) -> Future:
         """Enqueue one request; the Future resolves to its predicted
         latency (label units) once its microbatch is served, or to a
         typed serve error. Raises QueueClosed / QueueFull /
         RequestQuarantined at admission (fast-fail: a rejected request
-        never occupies a pending slot)."""
+        never occupies a pending slot). ``trace`` is an adopted
+        TraceContext propagated over the fleet transport; None lets the
+        queue head-sample its own root (standalone serving)."""
         eid = int(entry_id)
         # size it NOW so an entry the engine has never seen fails the
         # caller, not the shared worker
         self._engine.request_size(eid)
         fut: Future = Future()
+        # trace identity BEFORE the lock (a dice roll + urandom must not
+        # serialize the admission path); a rejected submit just discards
+        # the context — nothing was emitted, so no orphan root
+        if trace is not None:
+            tr = _ReqTrace(trace, time.monotonic(), owns_root=False)
+        elif self._trace_roots:
+            ctx = self._engine.bus.start_trace()
+            tr = (_ReqTrace(ctx, time.monotonic(), owns_root=True)
+                  if ctx is not None else None)
+        else:
+            tr = None
         reject = counter = None
         with self._wake:
             if self._closed or self._draining:
@@ -277,7 +314,8 @@ class MicrobatchQueue:
                 deadline = (time.perf_counter() + self._req_deadline_s
                             if self._req_deadline_s > 0 else math.inf)
                 self._pending.append((eid, int(ts_bucket),
-                                      time.perf_counter(), deadline, fut))
+                                      time.perf_counter(), deadline, fut,
+                                      tr))
                 self._wake.notify()
             if reject is not None:
                 self.error_counts[type(reject).__name__] += 1
@@ -340,7 +378,7 @@ class MicrobatchQueue:
         with self._wake:
             taken = self._pending[:]
             self._pending.clear()
-        return [(eid, ts, fut) for eid, ts, _t, _dl, fut in taken]
+        return [(eid, ts, fut) for eid, ts, _t, _dl, fut, _tr in taken]
 
     def probe_dict(self) -> dict:
         """The queue half of the health-probe body (serve/health.py):
@@ -399,13 +437,12 @@ class MicrobatchQueue:
 
     # -- worker side -----------------------------------------------------
 
-    def _take_batch_locked(self) -> list[tuple[int, int, float, float,
-                                               Future]]:
+    def _take_batch_locked(self) -> list[tuple]:
         """Pop the maximal capacity-respecting prefix of the pending list
         (submission order — alignment depends on it)."""
         g = n = e = 0
         take = 0
-        for entry_id, _ts, _t, _dl, _f in self._pending:
+        for entry_id, _ts, _t, _dl, _f, _tr in self._pending:
             dn, de = self._engine.request_size(entry_id)
             if take and (g + 1 > self._max_graphs
                          or n + dn > self._max_nodes
@@ -430,7 +467,7 @@ class MicrobatchQueue:
         """Would waiting longer be pointless? True once the pending
         prefix already saturates a top-bucket batch."""
         g = n = e = 0
-        for entry_id, _ts, _t, _dl, _f in self._pending:
+        for entry_id, _ts, _t, _dl, _f, _tr in self._pending:
             dn, de = self._engine.request_size(entry_id)
             if (g + 1 > self._max_graphs or n + dn > self._max_nodes
                     or e + de > self._max_edges):
@@ -462,6 +499,7 @@ class MicrobatchQueue:
         with self._lock:
             self.deadline_exceeded += len(expired)
             self.error_counts["DeadlineExceeded"] += len(expired)
+        tm_now = time.monotonic()
         for item in expired:
             self._engine.bus.counter("serve.deadline_exceeded",
                                      entry_id=item[0])
@@ -469,6 +507,12 @@ class MicrobatchQueue:
                 f"request for entry {item[0]} waited past its "
                 f"{self._req_deadline_s * 1e3:g}ms deadline without "
                 f"being dispatched"))
+            tr = item[5]
+            if tr is not None and tr.owns_root:
+                self._engine.bus.finish_trace(
+                    "trace.request", tr.ctx, tr.tm_submit, tm_now,
+                    outcome="error", error="DeadlineExceeded",
+                    entry_id=item[0])
 
     def _run(self) -> None:
         while True:
@@ -522,14 +566,19 @@ class MicrobatchQueue:
             # thread resolves the future, and _dec_inflight retakes the
             # lock — every taken future resolves exactly once (the
             # queue's core invariant), so the count cannot drift
-            for *_rest, fut in batch:
+            for _e, _ts, _t, _dl, fut, _tr in batch:
                 fut.add_done_callback(self._dec_inflight)
             # queue-wait stage of the request lifecycle: submit -> the
             # moment its microbatch leaves the queue for the engine
             t_now = time.perf_counter()
-            for _e, _ts, t_arrival, _dl, _f in batch:
+            tm_now = time.monotonic()
+            for _e, _ts, t_arrival, _dl, _f, tr in batch:
                 self._engine.record_queue_wait(t_now - t_arrival,
                                                coalesced=len(batch))
+                if tr is not None:
+                    self._engine.bus.trace_span(
+                        "trace.worker_queue", tr.ctx, tr.tm_submit,
+                        tm_now, coalesced=len(batch))
             try:
                 if self._overlap:
                     self._pump_overlap(batch)
@@ -546,10 +595,15 @@ class MicrobatchQueue:
 
     def _fail(self, batch, exc: BaseException) -> None:
         failed = 0
-        for *_rest, fut in batch:
+        tm_now = time.monotonic()
+        for _e, _ts, _t, _dl, fut, tr in batch:
             if not fut.done():
                 fut.set_exception(exc)
                 failed += 1
+                if tr is not None and tr.owns_root:
+                    self._engine.bus.finish_trace(
+                        "trace.request", tr.ctx, tr.tm_submit, tm_now,
+                        outcome="error", error=type(exc).__name__)
         if failed:
             with self._lock:
                 self.error_counts[type(exc).__name__] += failed
@@ -666,14 +720,34 @@ class MicrobatchQueue:
 
     def _settle(self, batch, preds) -> None:
         """Resolve a served batch's futures to their own predictions
-        (submission-order alignment) + per-request total latency."""
+        (submission-order alignment) + per-request total latency, and —
+        for traced requests — the engine-stage trace spans (the batch's
+        pack/dispatch/compute stamps, one span set per traced request:
+        trees are per REQUEST even though the work was per batch)."""
         bus = self._engine.bus
         t_done = time.perf_counter()
-        for _e, _ts, t_arrival, _dl, _f in batch:
+        stage_tm = self._engine.last_stage_tm
+        pk = stage_tm.get("pack")
+        dp = stage_tm.get("dispatch")
+        cp = stage_tm.get("compute")
+        tm_done = time.monotonic()
+        for _e, _ts, t_arrival, _dl, _f, tr in batch:
             bus.histogram("serve.request_total_ms",
                           (t_done - t_arrival) * 1e3, level=2)
-        for (*_rest, fut), p in zip(batch, preds):
+            if tr is not None:
+                if pk:
+                    bus.trace_span("trace.pack", tr.ctx, pk[0], pk[1])
+                if dp:
+                    bus.trace_span("trace.dispatch", tr.ctx, dp[0],
+                                   dp[1])
+                if cp:
+                    bus.trace_span("trace.compute", tr.ctx, cp[0],
+                                   cp[1])
+        for (_e, _ts, _t, _dl, fut, tr), p in zip(batch, preds):
             fut.set_result(float(p))
+            if tr is not None and tr.owns_root:
+                bus.finish_trace("trace.request", tr.ctx, tr.tm_submit,
+                                 tm_done, outcome="ok", entry_id=_e)
 
     def _fail_or_bisect(self, batch, exc: Exception,
                         retried: bool) -> None:

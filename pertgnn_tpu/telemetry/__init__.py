@@ -42,6 +42,8 @@ from pertgnn_tpu.telemetry.jaxmon import (install_jax_monitoring,
 from pertgnn_tpu.telemetry.schema import (SCHEMA_VERSION, SchemaError,
                                           iter_events, load_events,
                                           validate_event)
+from pertgnn_tpu.telemetry.tracing import (TraceContext, new_span_id,
+                                           new_trace_id)
 from pertgnn_tpu.telemetry.writer import MetricsWriter
 
 __all__ = [
@@ -49,7 +51,8 @@ __all__ = [
     "SCHEMA_VERSION", "SchemaError", "validate_event", "iter_events",
     "load_events", "parse_level", "install_jax_monitoring",
     "watch_xla_cache", "configure", "configure_from_config", "get_bus",
-    "set_bus", "span", "shutdown",
+    "set_bus", "span", "shutdown", "TraceContext", "new_trace_id",
+    "new_span_id",
 ]
 
 _bus: NoopBus = NOOP_BUS
@@ -76,19 +79,25 @@ def span(name: str, *, level: int = 1, **tags):
 
 def configure(telemetry_dir: str, level: int | str = "basic", *,
               tensorboard: bool = False, run_meta: dict | None = None,
-              jax_monitoring: bool = True):
+              jax_monitoring: bool = True, trace_sample_rate: float = 0.0,
+              trace_slow_ms: float = 0.0, rotate_mb: float = 0.0):
     """Build + install the process-wide bus from CLI/Config knobs.
 
     Empty ``telemetry_dir`` or level "off" installs the NoopBus (and
-    tears down any previous real bus). Returns the installed bus."""
+    tears down any previous real bus). Returns the installed bus.
+    ``trace_sample_rate`` / ``trace_slow_ms`` arm distributed request
+    tracing (telemetry/tracing.py — effective at "trace" level only);
+    ``rotate_mb`` > 0 rotates the JSONL into ``.partN`` siblings."""
     global _uninstall_jaxmon
     shutdown()
     lvl = parse_level(level)
     if not telemetry_dir or lvl <= 0:
         return _bus
     writer = MetricsWriter(telemetry_dir, tensorboard=tensorboard,
-                           run_meta=run_meta)
-    bus = TelemetryBus(writer, level=lvl)
+                           run_meta=run_meta, rotate_mb=rotate_mb)
+    bus = TelemetryBus(writer, level=lvl,
+                       trace_sample_rate=trace_sample_rate,
+                       trace_slow_ms=trace_slow_ms)
     set_bus(bus)
     if jax_monitoring:
         _uninstall_jaxmon = install_jax_monitoring(bus)
@@ -101,7 +110,11 @@ def configure_from_config(cfg, run_meta: dict | None = None):
     (cli/common.setup_telemetry) so the flag mapping lives in one place."""
     t = getattr(cfg, "telemetry", cfg)
     return configure(t.telemetry_dir, t.telemetry_level,
-                     tensorboard=t.tensorboard, run_meta=run_meta)
+                     tensorboard=t.tensorboard, run_meta=run_meta,
+                     trace_sample_rate=getattr(t, "trace_sample_rate",
+                                               0.0),
+                     trace_slow_ms=getattr(t, "trace_slow_ms", 0.0),
+                     rotate_mb=getattr(t, "telemetry_rotate_mb", 0.0))
 
 
 def shutdown() -> None:

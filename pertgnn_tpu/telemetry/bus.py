@@ -21,6 +21,8 @@ from __future__ import annotations
 import functools
 import time
 
+from pertgnn_tpu.telemetry.tracing import TraceContext, new_span_id
+
 LEVELS = {"off": 0, "basic": 1, "trace": 2}
 
 
@@ -56,6 +58,8 @@ class NoopBus:
 
     enabled = False
     level = 0
+    trace_sample_rate = 0.0
+    trace_slow_ms = 0.0
 
     def counter(self, name: str, value: float = 1, *, level: int = 1,
                 **tags) -> None:
@@ -81,6 +85,33 @@ class NoopBus:
         function. On the noop bus the function is returned UNCHANGED —
         zero per-call overhead, not even a frame."""
         return lambda fn: fn
+
+    # -- distributed request tracing (telemetry/tracing.py) --------------
+
+    def start_trace(self) -> TraceContext | None:
+        """Head-sampling decision for one request entering the stack.
+        None (tracing off) on the noop bus and below trace verbosity."""
+        return None
+
+    def adopt_trace(self, trace_id, parent_span_id) -> TraceContext | None:
+        """A context propagated over the transport (worker side)."""
+        return None
+
+    def trace_span(self, name: str, ctx: TraceContext | None,
+                   tm0: float, tm1: float, *, span_id: str | None = None,
+                   parent_id: str | None = None, **tags) -> str | None:
+        """One explicitly-timed stage span of a traced request
+        (monotonic stamps; the caller owns the clock reads so a span
+        can start on one thread and end on another). Returns the
+        span id used, for parenting children across the transport."""
+        return None
+
+    def finish_trace(self, name: str, ctx: TraceContext | None,
+                     tm0: float, tm1: float, **tags) -> None:
+        """Emit the trace's ROOT span and settle the sampling verdict:
+        a head-sampled trace writes the root; an unsampled one flushes
+        its buffered spans only if the total crossed trace_slow_ms
+        (the tail-exemplar always-keep), else drops them."""
 
     def flush(self) -> None:
         pass
@@ -116,9 +147,13 @@ class TelemetryBus(NoopBus):
 
     enabled = True
 
-    def __init__(self, writer, level: int | str = "basic"):
+    def __init__(self, writer, level: int | str = "basic", *,
+                 trace_sample_rate: float = 0.0,
+                 trace_slow_ms: float = 0.0):
         self._writer = writer
         self.level = parse_level(level)
+        self.trace_sample_rate = float(trace_sample_rate)
+        self.trace_slow_ms = float(trace_slow_ms)
 
     def counter(self, name, value=1, *, level=1, **tags):
         if level <= self.level:
@@ -152,6 +187,67 @@ class TelemetryBus(NoopBus):
                     return fn(*a, **kw)
             return timed
         return deco
+
+    # -- distributed request tracing -------------------------------------
+
+    def start_trace(self):
+        """Per-request head sampling. Request tracing is trace-level
+        instrumentation: below "trace" verbosity every request runs
+        untraced regardless of the sample rate (the same gate the
+        per-request histograms use)."""
+        if self.level < 2:
+            return None
+        ctx = TraceContext.start(self.trace_sample_rate)
+        if ctx is not None and not ctx.sampled and self.trace_slow_ms <= 0:
+            return None  # nothing could ever flush the buffer
+        return ctx
+
+    def adopt_trace(self, trace_id, parent_span_id):
+        if self.level < 2:
+            # a router tracing at "trace" against a worker at "basic":
+            # the worker contributes no spans (graftscope reports the
+            # transport leg as opaque) rather than half a chain per
+            # mismatched process
+            return None
+        return TraceContext.adopt(trace_id, parent_span_id)
+
+    def trace_span(self, name, ctx, tm0, tm1, *, span_id=None,
+                   parent_id=None, **tags):
+        if ctx is None:
+            return None
+        sid = span_id or new_span_id()
+        pid_ = parent_id or ctx.root_id
+        if ctx.sampled:
+            self._writer.write(
+                "span", name, dur_ms=(tm1 - tm0) * 1e3,
+                tags=tags or None,
+                trace={"trace_id": ctx.trace_id, "span_id": sid,
+                       "parent_span_id": pid_, "tm0": tm0})
+        elif ctx.buffer is not None:
+            ctx.buffer.append((name, tm0, tm1, sid, pid_, tags))
+        return sid
+
+    def finish_trace(self, name, ctx, tm0, tm1, **tags):
+        if ctx is None:
+            return
+        total_ms = (tm1 - tm0) * 1e3
+        if not ctx.sampled:
+            buffered, ctx.buffer = ctx.buffer, None
+            if self.trace_slow_ms <= 0 or total_ms < self.trace_slow_ms:
+                return  # the head said no and the tail agreed: drop
+            tags["sampled"] = "slow"
+            for b_name, b_tm0, b_tm1, b_sid, b_pid, b_tags in buffered:
+                self._writer.write(
+                    "span", b_name, dur_ms=(b_tm1 - b_tm0) * 1e3,
+                    tags=b_tags or None,
+                    trace={"trace_id": ctx.trace_id, "span_id": b_sid,
+                           "parent_span_id": b_pid, "tm0": b_tm0})
+        # the root: trace_id + span_id, NO parent — how graftscope
+        # recognizes a tree's anchor
+        self._writer.write(
+            "span", name, dur_ms=total_ms, tags=tags or None,
+            trace={"trace_id": ctx.trace_id, "span_id": ctx.root_id,
+                   "tm0": tm0})
 
     def flush(self):
         self._writer.flush()

@@ -18,6 +18,19 @@ Event kinds:
 ``tags`` is an optional flat dict of scalar dimensions (bucket index,
 epoch, split, ...). Loading into pandas is one call:
 ``pd.read_json(path, lines=True)`` — see docs/OBSERVABILITY.md.
+
+Schema v2 (additive — v1 files stay readable) is the distributed-tracing
+extension (telemetry/tracing.py, tools/graftscope/):
+
+- ``tm``   — per-event CLOCK_MONOTONIC stamp next to the wall ``t``:
+  wall clocks step (NTP) mid-run; cross-file span merging needs a clock
+  that only ever moves forward. Required on every v2 event.
+- spans may carry ``trace_id`` / ``span_id`` / ``parent_span_id`` (the
+  request-tree identity) and ``tm0`` (the span's START on the emitting
+  process's monotonic clock; the end is ``tm0 + dur_ms/1e3`` — NOT
+  ``tm``, which stamps the WRITE: a slow-kept trace's buffered spans
+  are all written at the flush, long after they ended). A span with
+  ``trace_id`` but no ``parent_span_id`` is a trace ROOT.
 """
 
 from __future__ import annotations
@@ -25,7 +38,10 @@ from __future__ import annotations
 import json
 from typing import Iterable, Iterator
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+# versions this reader accepts; writers always emit SCHEMA_VERSION
+READABLE_VERSIONS = (1, 2)
 
 KINDS = ("meta", "counter", "gauge", "histogram", "span")
 
@@ -33,6 +49,9 @@ KINDS = ("meta", "counter", "gauge", "histogram", "span")
 _VALUE_KINDS = ("counter", "gauge", "histogram")
 
 _TAG_SCALARS = (str, int, float, bool, type(None))
+
+# v2 trace-identity fields (optional; span events only for the ids)
+TRACE_FIELDS = ("trace_id", "span_id", "parent_span_id")
 
 
 class SchemaError(ValueError):
@@ -52,10 +71,16 @@ def validate_event(ev: dict) -> dict:
     event through this, so writer and schema cannot drift apart.
     """
     _require(isinstance(ev, dict), f"event is not an object: {type(ev)}")
-    _require(ev.get("v") == SCHEMA_VERSION,
-             f"schema version {ev.get('v')!r} != {SCHEMA_VERSION}")
+    v = ev.get("v")
+    _require(v in READABLE_VERSIONS,
+             f"schema version {v!r} not in {READABLE_VERSIONS}")
     _require(isinstance(ev.get("t"), (int, float)),
              f"missing/non-numeric timestamp 't': {ev.get('t')!r}")
+    if v >= 2:
+        _require(isinstance(ev.get("tm"), (int, float))
+                 and not isinstance(ev.get("tm"), bool),
+                 f"v2 event needs a numeric monotonic stamp 'tm': "
+                 f"{ev.get('tm')!r}")
     _require(isinstance(ev.get("pid"), int),
              f"missing/non-int 'pid': {ev.get('pid')!r}")
     _require(isinstance(ev.get("pi"), int),
@@ -78,6 +103,23 @@ def validate_event(ev: dict) -> dict:
     if kind == "meta":
         _require(isinstance(ev.get("fields"), dict),
                  f"meta {name!r} needs a 'fields' object")
+    for f in TRACE_FIELDS:
+        if f in ev:
+            _require(kind == "span",
+                     f"{kind} {name!r} carries {f!r} — trace identity "
+                     f"belongs to span events only")
+            _require(isinstance(ev[f], str) and ev[f] != "",
+                     f"span {name!r} has non-string/empty {f!r}: "
+                     f"{ev[f]!r}")
+    if "span_id" in ev or "parent_span_id" in ev:
+        _require("trace_id" in ev,
+                 f"span {name!r} has span ids but no 'trace_id'")
+    if "tm0" in ev:
+        _require(kind == "span"
+                 and isinstance(ev["tm0"], (int, float))
+                 and not isinstance(ev["tm0"], bool),
+                 f"{kind} {name!r}: 'tm0' must be a numeric span-start "
+                 f"monotonic stamp on a span event: {ev.get('tm0')!r}")
     tags = ev.get("tags")
     if tags is not None:
         _require(isinstance(tags, dict), f"'tags' is not an object: {tags!r}")

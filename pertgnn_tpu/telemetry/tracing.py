@@ -1,0 +1,95 @@
+"""Distributed request tracing: trace/span identity + head sampling.
+
+One request entering the serving stack (fleet/router.py submit, or a
+standalone serve/queue.py submit) gets ONE ``TraceContext``; every
+stage it passes through — router queue, transport, worker queue, pack,
+dispatch, compute, complete — emits a v2 span event carrying the
+context's ``trace_id`` and a parent/child ``span_id`` chain, so
+tools/graftscope can reassemble the request's life across the router
+and worker processes from their per-process JSONL files
+(docs/OBSERVABILITY.md "Distributed request tracing").
+
+Sampling is HEAD-based: the dice roll happens once, at the front door,
+and the verdict propagates (an unsampled request costs nothing
+downstream — the worker never even sees a trace id). The one exception
+is the ALWAYS-KEEP override for tail exemplars: an unsampled request's
+front-door process buffers its own spans in the context instead of
+writing them, and flushes them (tagged ``sampled="slow"``) only if the
+request's total latency crosses ``trace_slow_ms`` — so at a 1% sample
+rate the p99.9 stragglers still land in the stream with router-side
+stage attribution, while the 99% fast path pays list appends, not disk
+writes. Worker-side detail exists only for head-sampled requests
+(buffering across the transport would need a second round trip);
+graftscope marks slow-kept traces partial instead of calling them
+incomplete.
+
+Identity scheme: ``trace_id`` is 8 random bytes hex (globally unique
+across hosts/restarts — it names the request forever); ``span_id`` is
+``<pid hex>.<counter hex>`` (unique across the processes of one run at
+~100 ns per id — span ids only need to be unique within the files one
+graftscope invocation merges, and the pid prefix plus a per-process
+counter guarantees that without entropy reads on the hot path).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+
+__all__ = ["TraceContext", "new_trace_id", "new_span_id"]
+
+_counter = itertools.count(1)
+_counter_pid = os.getpid()
+
+
+def new_trace_id() -> str:
+    """8 random bytes, hex — the request's globally unique name."""
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    """``<pid>.<n>`` in hex — unique across this run's processes."""
+    global _counter, _counter_pid
+    pid = os.getpid()
+    if pid != _counter_pid:  # forked child: restart the counter stream
+        _counter, _counter_pid = itertools.count(1), pid
+    return f"{pid:x}.{next(_counter):x}"
+
+
+class TraceContext:
+    """One request's trace identity, threaded through its lifecycle.
+
+    ``sampled`` requests write spans straight to the bus's writer;
+    unsampled ones append pending spans to ``buffer`` for the
+    slow-exemplar flush decision at finish. A context lives in exactly
+    one stage owner at a time (submit thread -> dispatcher -> sender),
+    so the buffer needs no lock — emit spans BEFORE handing the request
+    to the next owner (fleet/router.py does).
+    """
+
+    __slots__ = ("trace_id", "root_id", "sampled", "buffer")
+
+    def __init__(self, trace_id: str, root_id: str, sampled: bool):
+        self.trace_id = trace_id
+        self.root_id = root_id
+        self.sampled = sampled
+        # (name, tm0, tm1, span_id, parent_id, tags) pending rows
+        self.buffer: list | None = None if sampled else []
+
+    @classmethod
+    def start(cls, sample_rate: float) -> "TraceContext | None":
+        """Head decision for a request entering the stack: a sampled
+        context, an unsampled (buffer-only) one, or None when tracing
+        is off entirely (rate <= 0)."""
+        if sample_rate <= 0.0:
+            return None
+        sampled = sample_rate >= 1.0 or random.random() < sample_rate
+        return cls(new_trace_id(), new_span_id(), sampled)
+
+    @classmethod
+    def adopt(cls, trace_id: str, parent_span_id: str) -> "TraceContext":
+        """A propagated context on the worker side of the transport:
+        always sampled (only head-sampled requests propagate), parented
+        under the router's transport span."""
+        return cls(str(trace_id), str(parent_span_id), True)

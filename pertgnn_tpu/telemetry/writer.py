@@ -6,6 +6,14 @@ SIGKILL'd run loses at most one partial final line (which the schema
 reader skips as the crash tail). No background flusher thread, no
 buffering policy to tune — crash-safety by construction.
 
+Size-based rotation (``rotate_mb`` > 0): once the current file exceeds
+the cap the writer switches to a fresh ``...partN.jsonl`` sibling —
+NEVER renaming the old one (tail -f readers and the append-only contract
+survive), keeping the ``.jsonl`` extension so every existing
+glob-the-dir consumer (benches, tools/graftscope) still sees all parts.
+Off by default: rotation trades one unbounded file for a part sequence,
+which only long-lived fleet/stream runs need.
+
 An optional TensorBoard sink mirrors scalar events (tensorboardX when
 importable; absent -> the option is a logged no-op, never an import
 error: the container may not ship it)."""
@@ -70,7 +78,7 @@ class MetricsWriter:
     are small — contention is negligible next to a device dispatch)."""
 
     def __init__(self, directory: str, *, tensorboard: bool = False,
-                 run_meta: dict | None = None):
+                 run_meta: dict | None = None, rotate_mb: float = 0.0):
         os.makedirs(directory, exist_ok=True)
         self.pid = os.getpid()
         self.process_index = _process_index()
@@ -82,9 +90,13 @@ class MetricsWriter:
         # get distinct files.
         import socket
         host = socket.gethostname().split(".")[0] or "host"
-        self.path = os.path.join(
+        self._stem = os.path.join(
             directory,
-            f"telemetry-p{self.process_index}-{host}-{self.pid}.jsonl")
+            f"telemetry-p{self.process_index}-{host}-{self.pid}")
+        self.path = f"{self._stem}.jsonl"
+        self._rotate_bytes = int(max(rotate_mb, 0.0) * 2 ** 20)
+        self._part = 0
+        self._bytes = 0
         self._f = open(self.path, "a", buffering=1)
         self._lock = threading.Lock()
         self._closed = False
@@ -111,13 +123,21 @@ class MetricsWriter:
 
     def write(self, kind: str, name: str, value: float | None = None,
               dur_ms: float | None = None, tags: dict | None = None,
-              fields: dict | None = None) -> None:
-        ev: dict = {"v": SCHEMA_VERSION, "t": time.time(), "pid": self.pid,
+              fields: dict | None = None,
+              trace: dict | None = None) -> None:
+        """One event. ``trace`` (spans only) carries the v2 trace
+        identity: ``trace_id`` / ``span_id`` / ``parent_span_id`` plus
+        the span-start monotonic stamp ``tm0`` (telemetry/tracing.py
+        builds it; graftscope consumes it)."""
+        ev: dict = {"v": SCHEMA_VERSION, "t": time.time(),
+                    "tm": time.monotonic(), "pid": self.pid,
                     "pi": self.process_index, "kind": kind, "name": name}
         if value is not None:
             ev["value"] = _num(name, value)
         if dur_ms is not None:
             ev["dur_ms"] = _num(name, dur_ms)
+        if trace:
+            ev.update(trace)
         if tags:
             ev["tags"] = {k: _tag(v) for k, v in tags.items()}
         if fields is not None:
@@ -127,8 +147,33 @@ class MetricsWriter:
             if self._closed:
                 return
             self._f.write(line + "\n")
+            if self._rotate_bytes:
+                self._bytes += len(line) + 1
+                if self._bytes >= self._rotate_bytes:
+                    self._rotate_locked()
             if self._tb is not None:
                 self._to_tensorboard(kind, name, value, dur_ms)
+
+    def _rotate_locked(self) -> None:
+        """Switch to the next ``.partN.jsonl`` sibling (caller holds the
+        lock). The closed part keeps its name — append-only means no
+        renames, ever — and the fresh part opens with a ``rotate`` meta
+        stamping its index so a reader can order a part sequence without
+        trusting filesystem mtimes."""
+        self._f.flush()
+        self._f.close()
+        self._part += 1
+        self._bytes = 0
+        self.path = f"{self._stem}.part{self._part}.jsonl"
+        self._f = open(self.path, "a", buffering=1)
+        ev = {"v": SCHEMA_VERSION, "t": time.time(),
+              "tm": time.monotonic(), "pid": self.pid,
+              "pi": self.process_index, "kind": "meta", "name": "rotate",
+              "fields": {"part": self._part,
+                         "schema_version": SCHEMA_VERSION}}
+        line = json.dumps(ev, default=str)
+        self._f.write(line + "\n")
+        self._bytes += len(line) + 1
 
     def _to_tensorboard(self, kind, name, value, dur_ms) -> None:
         scalar = dur_ms if kind == "span" else value

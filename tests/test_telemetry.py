@@ -37,10 +37,37 @@ def _names(path):
 
 class TestSchema:
     def _base(self, **kw):
-        ev = {"v": SCHEMA_VERSION, "t": 1.0, "pid": 1, "pi": 0,
-              "kind": "counter", "name": "x", "value": 1}
+        ev = {"v": SCHEMA_VERSION, "t": 1.0, "tm": 2.0, "pid": 1,
+              "pi": 0, "kind": "counter", "name": "x", "value": 1}
         ev.update(kw)
         return ev
+
+    def test_v1_events_stay_readable(self):
+        ev = self._base(v=1)
+        del ev["tm"]  # v1 predates the monotonic stamp
+        validate_event(ev)
+
+    def test_v2_requires_monotonic_stamp(self):
+        ev = self._base()
+        del ev["tm"]
+        with pytest.raises(SchemaError, match="tm"):
+            validate_event(ev)
+
+    def test_trace_fields_validate(self):
+        ev = self._base(kind="span", trace_id="ab", span_id="1.2",
+                        parent_span_id="1.1", tm0=1.5)
+        del ev["value"]
+        ev["dur_ms"] = 1.0
+        validate_event(ev)
+        # trace identity off a span event is a schema violation
+        with pytest.raises(SchemaError, match="span events only"):
+            validate_event(self._base(trace_id="ab"))
+        # span ids without a trace id are unanchorable
+        bad = self._base(kind="span", span_id="1.2")
+        del bad["value"]
+        bad["dur_ms"] = 1.0
+        with pytest.raises(SchemaError, match="trace_id"):
+            validate_event(bad)
 
     def test_valid_kinds(self):
         validate_event(self._base())

@@ -1,0 +1,452 @@
+"""Distributed request tracing + graftscope (ISSUE 12 acceptance):
+span nesting invariants as a property over generated request trees
+(one root per trace, parents resolve, no cycles), collector clock
+alignment on synthetically skewed files, loud orphan refusal, and the
+sampling semantics (head decision propagates; the always-keep override
+preserves slow exemplars)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.telemetry import (MetricsWriter, TelemetryBus,
+                                   load_events)
+from pertgnn_tpu.telemetry.tracing import TraceContext, new_span_id
+from tools.graftscope import (OrphanSpanError, build_report,
+                              chrome_trace_events, collect)
+from tools.graftscope.report import check_completeness, percentile
+
+
+def make_bus(tmp_path, name="tele", rate=1.0, slow_ms=0.0,
+             level="trace", **kw):
+    writer = MetricsWriter(str(tmp_path / name), **kw)
+    return TelemetryBus(writer, level=level, trace_sample_rate=rate,
+                        trace_slow_ms=slow_ms), writer
+
+
+def emit_fleet_trace(bus, t0, *, entry_id=0, requeues=0, worker="w0",
+                     outcome="ok", worker_bus=None, skew=0.0):
+    """One synthetic fleet-shaped request tree through the real bus
+    API, timeline anchored at monotonic t0. ``worker_bus`` (defaults to
+    ``bus``) writes the worker-side spans — a second bus stands in for
+    a second process; ``skew`` shifts the worker-side clock."""
+    worker_bus = worker_bus or bus
+    ctx = bus.start_trace()
+    t = t0
+    for attempt in range(requeues):
+        bus.trace_span("trace.router_queue", ctx, t, t + 0.001,
+                       worker=worker, attempt=attempt)
+        bus.trace_span("trace.transport", ctx, t + 0.001, t + 0.004,
+                       worker=worker, outcome="lost")
+        t += 0.004
+    bus.trace_span("trace.router_queue", ctx, t, t + 0.001,
+                   worker=worker, attempt=requeues)
+    tsid = bus.trace_span("trace.transport", ctx, t + 0.001, t + 0.009,
+                          worker=worker, outcome="ok")
+    wctx = worker_bus.adopt_trace(ctx.trace_id, tsid)
+    w = t + skew  # the worker stamps on ITS clock
+    worker_bus.trace_span("trace.worker_queue", wctx, w + 0.002,
+                          w + 0.003, coalesced=1)
+    worker_bus.trace_span("trace.pack", wctx, w + 0.003, w + 0.004)
+    worker_bus.trace_span("trace.dispatch", wctx, w + 0.004, w + 0.005)
+    worker_bus.trace_span("trace.compute", wctx, w + 0.005, w + 0.008)
+    bus.trace_span("trace.complete", ctx, t + 0.009, t + 0.010)
+    bus.finish_trace("trace.request", ctx, t0, t + 0.010,
+                     outcome=outcome, entry_id=entry_id)
+    return ctx
+
+
+class TestSamplingSemantics:
+    def test_rate_zero_means_off(self, tmp_path):
+        bus, _ = make_bus(tmp_path, rate=0.0)
+        assert bus.start_trace() is None
+
+    def test_basic_level_means_off(self, tmp_path):
+        bus, _ = make_bus(tmp_path, rate=1.0, level="basic")
+        assert bus.start_trace() is None
+        assert bus.adopt_trace("t", "p") is None
+
+    def test_rate_one_always_samples(self, tmp_path):
+        bus, _ = make_bus(tmp_path, rate=1.0)
+        assert all(bus.start_trace().sampled for _ in range(50))
+
+    def test_unsampled_without_slow_keep_is_free(self, tmp_path):
+        # nothing could ever flush the buffer -> no context at all
+        bus, _ = make_bus(tmp_path, rate=1e-12, slow_ms=0.0)
+        assert bus.start_trace() is None
+
+    def test_slow_exemplar_survives_low_sample_rate(self, tmp_path):
+        bus, writer = make_bus(tmp_path, rate=1e-12, slow_ms=100.0)
+        ctx = bus.start_trace()
+        assert ctx is not None and not ctx.sampled
+        tm = time.monotonic()
+        bus.trace_span("trace.router_queue", ctx, tm, tm + 0.001)
+        # 500 ms total >= the 100 ms threshold -> buffered spans flush
+        bus.finish_trace("trace.request", ctx, tm, tm + 0.5,
+                         outcome="ok", entry_id=1)
+        # a FAST unsampled request drops its buffer
+        ctx2 = bus.start_trace()
+        bus.trace_span("trace.router_queue", ctx2, tm, tm + 0.001)
+        bus.finish_trace("trace.request", ctx2, tm, tm + 0.002,
+                         outcome="ok", entry_id=2)
+        bus.close()
+        spans = [e for e in load_events(writer.path)
+                 if e["kind"] == "span"]
+        assert len(spans) == 2  # slow root + its buffered child only
+        root = next(e for e in spans if e["name"] == "trace.request")
+        assert root["tags"]["sampled"] == "slow"
+        assert root["tags"]["entry_id"] == 1
+        child = next(e for e in spans
+                     if e["name"] == "trace.router_queue")
+        assert child["trace_id"] == root["trace_id"]
+        assert child["parent_span_id"] == root["span_id"]
+
+    def test_propagation_only_for_sampled(self, tmp_path):
+        bus, _ = make_bus(tmp_path, rate=1e-12, slow_ms=100.0)
+        ctx = bus.start_trace()
+        assert not ctx.sampled  # the router would NOT propagate this
+
+
+class TestSpanNesting:
+    """Property: whatever mix of requests/requeues/outcomes the fleet
+    serves, collected traces have one root each, fully-resolving
+    parents, and no cycles."""
+
+    def _check_tree(self, spans):
+        by_id = {s.span_id: s for s in spans}
+        roots = [s for s in spans if s.parent_id is None]
+        assert len(roots) == 1
+        for s in spans:
+            if s.parent_id is not None:
+                assert s.parent_id in by_id, f"orphan {s.name}"
+            # walk to the root; a cycle would loop forever, so bound it
+            seen = set()
+            cur = s
+            while cur.parent_id is not None:
+                assert cur.span_id not in seen, "cycle"
+                seen.add(cur.span_id)
+                cur = by_id[cur.parent_id]
+            assert cur.parent_id is None
+
+    def test_generated_request_mix(self, tmp_path):
+        hyp = pytest.importorskip(
+            "hypothesis",
+            reason="property tests need the hypothesis dev extra "
+                   "(pip install -e .[dev])")
+        st = hyp.strategies
+
+        @hyp.settings(max_examples=25, deadline=None)
+        @hyp.given(st.lists(
+            st.tuples(st.integers(0, 3),            # requeues
+                      st.sampled_from(["ok", "error"]),
+                      st.booleans()),               # separate worker bus
+            min_size=1, max_size=8))
+        def run(requests):
+            import shutil
+            d = tmp_path / "prop"
+            shutil.rmtree(d, ignore_errors=True)
+            bus, writer = make_bus(d.parent, name="prop", rate=1.0)
+            wbus, wwriter = make_bus(d.parent, name="prop", rate=1.0)
+            tm = time.monotonic()
+            for i, (requeues, outcome, two_proc) in enumerate(requests):
+                emit_fleet_trace(
+                    bus, tm + i, entry_id=i, requeues=requeues,
+                    outcome=outcome,
+                    worker_bus=wbus if two_proc else bus)
+            bus.close()
+            wbus.close()
+            result = collect(str(d))
+            assert len(result.traces) == len(requests)
+            for spans in result.traces.values():
+                self._check_tree(spans)
+            report = build_report(result)
+            assert report["incomplete"] == 0
+            assert report["orphans"] == 0
+            n_ok = sum(1 for _r, o, _t in requests if o == "ok")
+            assert report["traces_ok"] == n_ok
+            assert report["traces_error"] == len(requests) - n_ok
+
+        run()
+
+
+class TestCollector:
+    def _write_jsonl(self, path, events):
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+
+    def _span(self, pid, name, tid, sid, psid, tm0, dur_ms, **tags):
+        ev = {"v": 2, "t": 1000.0 + tm0, "tm": tm0 + dur_ms / 1e3,
+              "pid": pid, "pi": 0, "kind": "span", "name": name,
+              "dur_ms": dur_ms, "trace_id": tid, "span_id": sid,
+              "tm0": tm0}
+        if psid is not None:
+            ev["parent_span_id"] = psid
+        if tags:
+            ev["tags"] = tags
+        return ev
+
+    def test_clock_alignment_recovers_synthetic_skew(self, tmp_path):
+        """Two hand-written files: the router stamps on one clock, the
+        worker on a clock 1000 s ahead. The bounding pairs pin the
+        offset; aligned worker spans must land INSIDE the router's
+        transport spans."""
+        d = tmp_path / "skew"
+        os.makedirs(d)
+        skew = 1000.0  # worker clock = router clock + 1000 s
+        router, worker = [], []
+        for i in range(10):
+            t = i * 1.0
+            tid = f"t{i:02d}"
+            router.append(self._span(
+                100, "trace.request", tid, f"64.{i}", None, t, 100.0,
+                outcome="ok", entry_id=i))
+            router.append(self._span(
+                100, "trace.router_queue", tid, f"64.q{i}", f"64.{i}",
+                t, 10.0))
+            router.append(self._span(
+                100, "trace.transport", tid, f"64.t{i}", f"64.{i}",
+                t + 0.010, 80.0, worker="w0", outcome="ok"))
+            w = t + skew + 0.020  # worker work inside the round trip
+            for j, stage in enumerate(("worker_queue", "pack",
+                                       "dispatch", "compute")):
+                worker.append(self._span(
+                    200, f"trace.{stage}", tid, f"c8.{i}{j}",
+                    f"64.t{i}", w + j * 0.010, 10.0))
+            router.append(self._span(
+                100, "trace.complete", tid, f"64.c{i}", f"64.{i}",
+                t + 0.090, 10.0))
+        self._write_jsonl(d / "telemetry-p0-hostA-100.jsonl", router)
+        self._write_jsonl(d / "telemetry-p0-hostB-200.jsonl", worker)
+        result = collect(str(d))
+        rep = result.clock[200]
+        # the true offset is -1000 s (worker stamps map DOWN onto the
+        # router clock); the pair bounds give +-~20ms slack
+        assert rep["offset_ms"] == pytest.approx(-1000e3, abs=50.0)
+        assert rep["consistent"] is True
+        assert rep["uncertainty_ms"] < 50.0
+        assert result.clock[100]["reference"] is True
+        for spans in result.traces.values():
+            tr = next(s for s in spans if s.name == "trace.transport")
+            for s in spans:
+                if s.pid == 200:
+                    assert s.atm0 >= tr.atm0 - 1e-6
+                    assert s.atm1 <= tr.atm1 + 1e-6
+        assert check_completeness(result) == []
+        report = build_report(result, top_k=2)
+        assert report["traces_ok"] == 10
+        assert report["stage_ms"]["compute"]["p99_ms"] == \
+            pytest.approx(10.0, rel=0.01)
+        # exclusive transport: 80 total - 40 worker = 40
+        assert report["stage_ms"]["transport"]["p50_ms"] == \
+            pytest.approx(40.0, rel=0.01)
+
+    def test_orphan_spans_refused_loudly(self, tmp_path):
+        d = tmp_path / "orphan"
+        os.makedirs(d)
+        evs = [self._span(100, "trace.request", "t0", "64.0", None,
+                          0.0, 10.0, outcome="ok"),
+               self._span(100, "trace.worker_queue", "t0", "64.1",
+                          "missing-parent", 0.0, 1.0)]
+        self._write_jsonl(d / "telemetry-p0-h-100.jsonl", evs)
+        with pytest.raises(OrphanSpanError, match="missing-parent"):
+            collect(str(d))
+        result = collect(str(d), allow_orphans=True)
+        assert len(result.orphans) == 1
+
+    def test_incomplete_chain_detected(self, tmp_path):
+        d = tmp_path / "inc"
+        os.makedirs(d)
+        evs = [self._span(100, "trace.request", "t0", "64.0", None,
+                          0.0, 10.0, outcome="ok"),
+               self._span(100, "trace.router_queue", "t0", "64.1",
+                          "64.0", 0.0, 1.0),
+               self._span(100, "trace.transport", "t0", "64.2", "64.0",
+                          1.0, 8.0, outcome="ok")]
+        self._write_jsonl(d / "telemetry-p0-h-100.jsonl", evs)
+        violations = check_completeness(collect(str(d)))
+        assert len(violations) == 1
+        assert "worker_queue" in violations[0]
+
+    def test_multi_root_detected(self, tmp_path):
+        d = tmp_path / "mr"
+        os.makedirs(d)
+        evs = [self._span(100, "trace.request", "t0", "64.0", None,
+                          0.0, 10.0, outcome="ok"),
+               self._span(100, "trace.request", "t0", "64.1", None,
+                          0.0, 10.0, outcome="ok")]
+        self._write_jsonl(d / "telemetry-p0-h-100.jsonl", evs)
+        result = collect(str(d))
+        assert result.multi_root == {"t0": 2}
+        assert any("2 roots" in v
+                   for v in check_completeness(result))
+
+    def test_cli_round_trip(self, tmp_path):
+        import subprocess
+        import sys
+        bus, writer = make_bus(tmp_path, name="cli", rate=1.0)
+        tm = time.monotonic()
+        for i in range(5):
+            emit_fleet_trace(bus, tm + i, entry_id=i)
+        bus.close()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        perfetto = str(tmp_path / "out.trace.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftscope",
+             "--telemetry_dir", str(tmp_path / "cli"),
+             "--assert_complete", "--expect_ok", "5",
+             "--perfetto", perfetto],
+            capture_output=True, text=True, cwd=repo, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert report["traces_ok"] == 5 and report["failures"] == []
+        with open(perfetto) as f:
+            exported = json.load(f)
+        assert len(exported["traceEvents"]) == report["spans"]
+        # wrong expectation -> nonzero exit, failure named
+        proc2 = subprocess.run(
+            [sys.executable, "-m", "tools.graftscope",
+             "--telemetry_dir", str(tmp_path / "cli"),
+             "--expect_ok", "6"],
+            capture_output=True, text=True, cwd=repo, timeout=120)
+        assert proc2.returncode == 1
+        assert "expected 6" in proc2.stdout or "expected 6" in proc2.stderr
+
+    def test_perfetto_events_well_formed(self, tmp_path):
+        bus, _ = make_bus(tmp_path, name="pf", rate=1.0)
+        tm = time.monotonic()
+        emit_fleet_trace(bus, tm)
+        bus.close()
+        events = chrome_trace_events(collect(str(tmp_path / "pf")))
+        assert events and all(e["ph"] == "X" and e["ts"] >= 0
+                              and e["dur"] >= 0 for e in events)
+
+
+class TestRotation:
+    def test_rotation_parts_carry_all_events(self, tmp_path):
+        writer = MetricsWriter(str(tmp_path / "rot"),
+                               rotate_mb=300 / 2 ** 20)  # ~300 bytes
+        bus = TelemetryBus(writer, level="trace")
+        for i in range(50):
+            bus.counter("rot.tick", 1, i=i)
+        bus.close()
+        files = sorted(os.listdir(tmp_path / "rot"))
+        assert len(files) > 1, "no rotation happened"
+        assert all(f.endswith(".jsonl") for f in files)
+        parts = [f for f in files if ".part" in f]
+        assert parts, f"no .partN files in {files}"
+        total = 0
+        for f in files:
+            evs = load_events(str(tmp_path / "rot" / f))
+            total += sum(1 for e in evs if e["name"] == "rot.tick")
+            if ".part" in f:
+                assert evs[0]["name"] == "rotate"
+        assert total == 50, "rotation lost events"
+
+    def test_collector_merges_rotated_parts(self, tmp_path):
+        writer = MetricsWriter(str(tmp_path / "rotc"),
+                               rotate_mb=2000 / 2 ** 20)
+        bus = TelemetryBus(writer, level="trace", trace_sample_rate=1.0)
+        tm = time.monotonic()
+        for i in range(30):
+            emit_fleet_trace(bus, tm + i, entry_id=i)
+        bus.close()
+        assert any(".part" in f
+                   for f in os.listdir(tmp_path / "rotc"))
+        result = collect(str(tmp_path / "rotc"))
+        assert len(result.traces) == 30
+        assert build_report(result)["incomplete"] == 0
+
+
+class TestPercentile:
+    def test_matches_linear_interpolation(self):
+        vals = sorted(float(v) for v in range(1, 101))
+        assert percentile(vals, 50) == pytest.approx(50.5)
+        assert percentile(vals, 99) == pytest.approx(99.01)
+        assert percentile(vals, 99.9) == pytest.approx(99.901)
+        assert percentile([], 50) is None
+        assert percentile([7.0], 99) == 7.0
+
+
+class TestQueueIntegration:
+    """The real MicrobatchQueue front door: standalone roots with the
+    engine-stage chain, through a live (tiny) engine."""
+
+    @pytest.fixture(scope="class")
+    def traced_engine(self, preprocessed, tmp_path_factory):
+        from pertgnn_tpu.batching import build_dataset
+        from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                        ServeConfig, TrainConfig)
+        from pertgnn_tpu.serve.engine import InferenceEngine
+        from pertgnn_tpu.train.loop import restore_target_state
+
+        cfg = Config(ingest=IngestConfig(min_traces_per_entry=10),
+                     data=DataConfig(max_traces=200, batch_size=16),
+                     train=TrainConfig(label_scale=1000.0),
+                     serve=ServeConfig(bucket_growth=4.0,
+                                       max_graphs_per_batch=4))
+        ds = build_dataset(preprocessed, cfg)
+        _, state = restore_target_state(ds, cfg)
+        writer = MetricsWriter(str(tmp_path_factory.mktemp("qtrace")))
+        bus = TelemetryBus(writer, level="trace",
+                           trace_sample_rate=1.0)
+        engine = InferenceEngine.from_dataset(ds, cfg, state,
+                                              bus=bus).warmup()
+        yield ds, engine, bus, writer.path
+        bus.close()
+
+    def test_standalone_queue_produces_complete_traces(self,
+                                                       traced_engine):
+        from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+        ds, engine, bus, path = traced_engine
+        s = ds.splits["test"]
+        with MicrobatchQueue(engine, flush_deadline_ms=5) as q:
+            futs = [q.submit(int(s.entry_ids[i]), int(s.ts_buckets[i]))
+                    for i in range(4)]
+            [f.result(timeout=60) for f in futs]
+        bus.flush()
+        result = collect(os.path.dirname(path))
+        assert len(result.traces) >= 4
+        report = build_report(result)
+        assert report["incomplete"] == 0, \
+            report["completeness_violations"]
+        assert report["traces_ok"] >= 4
+        # standalone chains: worker stages, no transport legs
+        for spans in result.traces.values():
+            stages = {sp.stage for sp in spans}
+            assert "transport" not in stages
+            assert {"worker_queue", "pack", "dispatch",
+                    "compute"} <= stages
+
+    def test_adopted_context_suppresses_root(self, traced_engine):
+        """A fleet worker's queue (trace_roots=False) must neither
+        start roots nor finish adopted ones — the router owns both."""
+        from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+        ds, engine, bus, path = traced_engine
+        s = ds.splits["test"]
+        n_before = sum(
+            1 for e in load_events(path)
+            if e["kind"] == "span" and e["name"] == "trace.request")
+        ctx = bus.adopt_trace("feedcafe00000000", "99.1")
+        with MicrobatchQueue(engine, flush_deadline_ms=0,
+                             trace_roots=False) as q:
+            q.submit(int(s.entry_ids[0]), int(s.ts_buckets[0]),
+                     trace=ctx).result(timeout=60)
+            # an untraced co-request on the same queue: no context
+            q.submit(int(s.entry_ids[1]),
+                     int(s.ts_buckets[1])).result(timeout=60)
+        bus.flush()
+        evs = [e for e in load_events(path) if e["kind"] == "span"]
+        n_roots = sum(1 for e in evs if e["name"] == "trace.request")
+        assert n_roots == n_before, "worker-side queue emitted a root"
+        adopted = [e for e in evs
+                   if e.get("trace_id") == "feedcafe00000000"]
+        stages = {e["name"] for e in adopted}
+        assert {"trace.worker_queue", "trace.pack", "trace.dispatch",
+                "trace.compute"} <= stages
+        assert all(e["parent_span_id"] == "99.1" for e in adopted)

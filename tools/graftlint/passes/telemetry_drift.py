@@ -7,8 +7,10 @@ observability contract ("the tables below are the schema") rots
 silently without a mechanical check.
 
 What counts as an EMISSION: a call ``<recv>.counter/gauge/histogram/
-span/wrap("name", ...)`` anywhere under pertgnn_tpu/ or
-tools/graftaudit/ (the auditor emits audit.*) whose name argument
+span/wrap/trace_span/finish_trace("name", ...)`` anywhere under
+pertgnn_tpu/, tools/graftaudit/ (the auditor emits audit.*), or
+tools/graftscope/ (the trace collector — in scope so its stage-name
+literals keep the trace.* doc rows honest) whose name argument
 resolves statically — a string constant, a constant-armed conditional
 expression, or a local variable assigned only string constants in the
 same function (the ``counter = "serve.shed"; ... bus.counter(counter)``
@@ -51,7 +53,11 @@ RULE = "telemetry-drift"
 PASS_SCOPE = "repo"
 
 DOC = "docs/OBSERVABILITY.md"
-_BUS_METHODS = {"counter", "gauge", "histogram", "span", "wrap"}
+# trace_span/finish_trace are the distributed-tracing emitters
+# (telemetry/bus.py) — name-first signatures precisely so this pass
+# can resolve them like any other bus call
+_BUS_METHODS = {"counter", "gauge", "histogram", "span", "wrap",
+                "trace_span", "finish_trace"}
 # receivers that are NOT the telemetry bus but share method names
 # (none today — time.perf_counter is an attr of a different name).
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_./]*$")
@@ -110,7 +116,8 @@ def collect_emissions(ctx) -> tuple[dict[str, list[tuple[str, int, str]]],
     for dynamic (unresolvable) names."""
     emitted: dict[str, list[tuple[str, int, str]]] = {}
     dynamic: list[Violation] = []
-    for rel in ctx.files_under("pertgnn_tpu", "tools/graftaudit"):
+    for rel in ctx.files_under("pertgnn_tpu", "tools/graftaudit",
+                               "tools/graftscope"):
         tree = ctx.tree(rel)
         if tree is None:
             continue
@@ -217,7 +224,8 @@ def _package_literals(ctx) -> set[str]:
     keys — the reverse check's evidence that a documented name (or its
     final segment) still exists somewhere in code."""
     out: set[str] = set()
-    for rel in ctx.files_under("pertgnn_tpu", "tools/graftaudit"):
+    for rel in ctx.files_under("pertgnn_tpu", "tools/graftaudit",
+                             "tools/graftscope"):
         tree = ctx.tree(rel)
         if tree is None:
             continue
@@ -266,7 +274,7 @@ def run(ctx) -> list[Violation]:
         violations.append(Violation(
             rule=RULE, path=DOC, line=line_no,
             message=(f"documented metric `{name}` no longer appears "
-                     f"anywhere in pertgnn_tpu/ or tools/graftaudit/ — "
+                     f"anywhere in pertgnn_tpu/, tools/graftaudit/ or tools/graftscope/ — "
                      f"drop the row or restore the emission"),
             key=f"stale-doc:{name}"))
     return violations

@@ -1,0 +1,41 @@
+"""graftscope — fleet-wide trace collection and tail attribution.
+
+The serving stack spreads one request's life across the router process
+and N worker processes, each appending v2 span events (trace_id /
+span_id / parent_span_id + monotonic stamps — telemetry/schema.py) to
+its own ``telemetry-p*-*.jsonl`` file. graftscope merges those files
+back into per-request span trees and answers the question ROADMAP
+item 3 (hedging, SLO classes, autoscale) is blocked on: *for one slow
+request, where did the time go?*
+
+Three layers, importable separately:
+
+- ``collect``  — merge every telemetry JSONL (rotation ``.partN``
+  parts included) under one ``--telemetry_dir`` into per-trace span
+  trees; align each worker's monotonic clock to the router's via the
+  request/response bounding pairs (a child span must lie inside its
+  cross-process parent — the intersection over pairs gives a bounded
+  skew estimate per process, reported, never assumed); REFUSE loudly
+  on orphan spans (a parent id that resolves nowhere) instead of
+  silently dropping them.
+- ``report``   — per-stage critical-path breakdown (router queue,
+  transport, worker queue, pack, dispatch, compute, complete) at
+  p50/p95/p99/p99.9, the top-k slowest exemplar traces inline, and
+  the completeness verdict fleet_bench/stream_bench exit-code-assert
+  (every ok root: exactly one root, a full stage chain).
+- ``export``   — Chrome/Perfetto trace-event JSON (load in
+  ui.perfetto.dev) on the aligned clock.
+
+CLI: ``python -m tools.graftscope --telemetry_dir DIR`` — exit 0 clean,
+1 on orphans / failed completeness assertion, 2 on usage errors.
+Schema + semantics: docs/OBSERVABILITY.md "Distributed request
+tracing".
+"""
+
+from tools.graftscope.collect import (CollectError, OrphanSpanError,
+                                      Span, collect)
+from tools.graftscope.export import chrome_trace_events
+from tools.graftscope.report import build_report
+
+__all__ = ["collect", "build_report", "chrome_trace_events", "Span",
+           "CollectError", "OrphanSpanError"]

@@ -1,0 +1,91 @@
+"""graftscope CLI — collect, attribute, assert, export.
+
+    python -m tools.graftscope --telemetry_dir runs/fleet1
+    python -m tools.graftscope --telemetry_dir runs/fleet1 \
+        --assert_complete --expect_ok 2000 --perfetto fleet1.trace.json
+
+Prints ONE JSON report line on stdout (the benches embed it in their
+own records). Exit codes, same contract as graftlint/graftaudit
+(docs/LINTS.md): 0 = collected clean (and assertions held), 1 = orphan
+spans, multi-root traces, or a failed ``--assert_complete`` /
+``--expect_ok``, 2 = usage/IO errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.graftscope.collect import (CollectError, OrphanSpanError,
+                                      collect)
+from tools.graftscope.export import write_chrome_trace
+from tools.graftscope.report import build_report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--telemetry_dir", required=True,
+                   help="the shared dir every fleet process wrote its "
+                        "telemetry-p*-*.jsonl into (rotation .partN "
+                        "files are merged automatically)")
+    p.add_argument("--top_k", type=int, default=5,
+                   help="slowest exemplar traces to inline in the "
+                        "report")
+    p.add_argument("--allow_orphans", action="store_true",
+                   help="report orphan spans instead of refusing — for "
+                        "inspecting a knowingly partial file set; the "
+                        "exit code still flags them")
+    p.add_argument("--assert_complete", action="store_true",
+                   help="exit 1 unless every ok-rooted trace has "
+                        "exactly one root and a complete stage chain "
+                        "(what fleet_bench/stream_bench gate on)")
+    p.add_argument("--expect_ok", type=int, default=-1,
+                   help="exit 1 unless exactly this many ok-rooted "
+                        "traces collected (-1 = don't check) — pins "
+                        "trace count to the bench's served count")
+    p.add_argument("--perfetto", default="",
+                   help="also write Chrome/Perfetto trace-event JSON "
+                        "here (load at ui.perfetto.dev)")
+    p.add_argument("--out", default="",
+                   help="also write the report JSON to this path")
+    args = p.parse_args(argv)
+
+    try:
+        result = collect(args.telemetry_dir,
+                         allow_orphans=args.allow_orphans)
+    except OrphanSpanError as exc:
+        print(f"graftscope: REFUSING: {exc}", file=sys.stderr)
+        return 1
+    except CollectError as exc:
+        print(f"graftscope: {exc}", file=sys.stderr)
+        return 2
+
+    report = build_report(result, top_k=args.top_k)
+    if args.perfetto:
+        report["perfetto_events"] = write_chrome_trace(result,
+                                                       args.perfetto)
+        report["perfetto_path"] = args.perfetto
+
+    failures: list[str] = []
+    if result.orphans:
+        failures.append(f"{len(result.orphans)} orphan span(s)")
+    if result.multi_root:
+        failures.append(f"{len(result.multi_root)} multi-root trace(s)")
+    if args.assert_complete and report["incomplete"]:
+        failures.append(
+            f"{report['incomplete']} incomplete ok trace(s); first: "
+            f"{report['completeness_violations'][0]}")
+    if args.expect_ok >= 0 and report["traces_ok"] != args.expect_ok:
+        failures.append(f"expected {args.expect_ok} ok traces, "
+                        f"collected {report['traces_ok']}")
+    report["failures"] = failures
+
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    for f_ in failures:
+        print(f"graftscope FAIL: {f_}", file=sys.stderr)
+    return 1 if failures else 0

@@ -1,0 +1,245 @@
+"""Trace collection: JSONL merge, tree assembly, clock alignment.
+
+Every process of a run writes spans on ITS OWN CLOCK_MONOTONIC clock
+(telemetry/writer.py stamps ``tm``/``tm0``); the clocks share no epoch
+across hosts and may drift. Alignment uses the only ground truth the
+stream carries: a cross-process parent/child pair is a request/response
+bounding — the worker's spans happened INSIDE the router's transport
+span. Each pair therefore bounds the worker->router clock offset d:
+
+    parent.tm0 <= child.tm0 + d      (the request left before work began)
+    child.tm1 + d <= parent.tm1      (the response landed after it ended)
+
+so d is in [parent.tm0 - child.tm0, parent.tm1 - child.tm1]; the
+intersection over all pairs of one process pair tightens it, the
+midpoint is the estimate and the half-width the reported uncertainty.
+An EMPTY intersection means the stamps are inconsistent (a broken
+clock, reused pids across hosts) — reported per process, never papered
+over. On one Linux host the offsets come out ~0 (CLOCK_MONOTONIC is
+system-wide), which is itself a useful self-check of the estimator.
+
+Orphans — spans whose parent id resolves nowhere in their trace — are
+collected and REFUSED by default (``OrphanSpanError``): an orphan means
+a writer lost its parent emission or files are missing from the merge,
+and attributing around a hole silently would corrupt the percentiles
+this tool exists to make trustworthy. A crash-killed worker's TRUNCATED
+final line is not an orphan source (the schema reader skips it), and a
+lost worker's spans still resolve: the router emits its transport span
+with ``outcome="lost"`` after the failure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+
+class CollectError(RuntimeError):
+    """The telemetry dir cannot be collected (missing, unreadable, or
+    schema-invalid beyond the tolerated crash tail)."""
+
+
+class OrphanSpanError(CollectError):
+    """Orphan spans found and not explicitly allowed."""
+
+
+# the writer's naming scheme, rotation parts included:
+#   telemetry-p<pi>-<host>-<pid>.jsonl
+#   telemetry-p<pi>-<host>-<pid>.part<N>.jsonl
+_FILE_RE = re.compile(
+    r"^telemetry-p\d+-.+?-\d+(\.part(?P<part>\d+))?\.jsonl$")
+
+
+@dataclasses.dataclass
+class Span:
+    """One v2 span event, trace identity resolved."""
+
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    name: str
+    pid: int
+    pi: int
+    t_wall: float
+    tm0: float
+    tm1: float
+    dur_ms: float
+    tags: dict
+    file: str
+    # alignment output: stamps on the reference process's clock
+    atm0: float = 0.0
+    atm1: float = 0.0
+
+    @property
+    def stage(self) -> str:
+        """'trace.router_queue' -> 'router_queue' (report stage key)."""
+        return self.name.split(".", 1)[-1]
+
+
+@dataclasses.dataclass
+class CollectResult:
+    traces: dict[str, list[Span]]
+    orphans: list[Span]
+    multi_root: dict[str, int]          # trace_id -> root count (> 1)
+    clock: dict[int, dict]              # pid -> alignment report
+    files: list[str]
+    n_events: int
+    n_spans: int
+
+
+def telemetry_files(telemetry_dir: str) -> list[str]:
+    """Every telemetry JSONL under the dir, rotation parts in order."""
+    if not os.path.isdir(telemetry_dir):
+        raise CollectError(f"not a directory: {telemetry_dir!r}")
+
+    def sort_key(fname: str):
+        m = _FILE_RE.match(fname)
+        part = int(m.group("part") or 0) if m else 0
+        return (fname.split(".part")[0], part)
+
+    out = [os.path.join(telemetry_dir, f)
+           for f in sorted(os.listdir(telemetry_dir), key=sort_key)
+           if _FILE_RE.match(f)]
+    return out
+
+
+def load_spans(telemetry_dir: str) -> tuple[list[Span], list[str], int]:
+    """(trace-carrying spans, files read, total event count)."""
+    from pertgnn_tpu.telemetry import SchemaError, iter_events
+
+    files = telemetry_files(telemetry_dir)
+    if not files:
+        raise CollectError(
+            f"no telemetry-*.jsonl files under {telemetry_dir!r}")
+    spans: list[Span] = []
+    n_events = 0
+    for path in files:
+        try:
+            with open(path) as f:
+                for ev in iter_events(f, strict=True):
+                    n_events += 1
+                    if ev["kind"] != "span" or "trace_id" not in ev:
+                        continue
+                    tm1 = ev.get("tm", 0.0)
+                    tm0 = ev.get("tm0", tm1 - ev["dur_ms"] / 1e3)
+                    spans.append(Span(
+                        trace_id=ev["trace_id"],
+                        span_id=ev.get("span_id", ""),
+                        parent_id=ev.get("parent_span_id"),
+                        name=ev["name"], pid=ev["pid"], pi=ev["pi"],
+                        t_wall=ev["t"], tm0=tm0, tm1=tm0 + ev["dur_ms"] / 1e3,
+                        dur_ms=ev["dur_ms"],
+                        tags=ev.get("tags") or {}, file=path))
+        except (OSError, SchemaError) as exc:
+            raise CollectError(f"cannot read {path}: {exc}") from exc
+    return spans, files, n_events
+
+
+def _align_clocks(traces: dict[str, list[Span]]) -> dict[int, dict]:
+    """Per-pid offset (seconds, added to that pid's stamps) onto the
+    reference process's clock + the bounded-skew report. Mutates spans'
+    atm0/atm1."""
+    # offset bounds per (parent_pid, child_pid) pair
+    bounds: dict[tuple[int, int], list[float]] = {}
+    n_pairs: dict[tuple[int, int], int] = {}
+    root_count: dict[int, int] = {}
+    pids: set[int] = set()
+    for spans in traces.values():
+        by_id = {s.span_id: s for s in spans}
+        for s in spans:
+            pids.add(s.pid)
+            if s.parent_id is None:
+                root_count[s.pid] = root_count.get(s.pid, 0) + 1
+                continue
+            parent = by_id.get(s.parent_id)
+            if parent is None or parent.pid == s.pid:
+                continue
+            key = (parent.pid, s.pid)
+            lo, hi = parent.tm0 - s.tm0, parent.tm1 - s.tm1
+            cur = bounds.get(key)
+            if cur is None:
+                bounds[key] = [lo, hi]
+            else:
+                cur[0] = max(cur[0], lo)
+                cur[1] = min(cur[1], hi)
+            n_pairs[key] = n_pairs.get(key, 0) + 1
+    # reference = the process owning the most roots (the front door);
+    # deterministic tie-break on pid
+    ref = (max(sorted(root_count), key=lambda p: root_count[p])
+           if root_count else (min(pids) if pids else 0))
+    offset: dict[int, float] = {ref: 0.0}
+    report: dict[int, dict] = {ref: {
+        "offset_ms": 0.0, "uncertainty_ms": 0.0, "pairs": 0,
+        "reference": True, "consistent": True}}
+    # BFS over the pair graph from the reference (fleet topology is a
+    # star router->workers; transitive hops compose offsets)
+    frontier = [ref]
+    edges: dict[int, list[tuple[int, tuple[int, int], int]]] = {}
+    for (a, b), _ in bounds.items():
+        edges.setdefault(a, []).append((b, (a, b), +1))
+        edges.setdefault(b, []).append((a, (a, b), -1))
+    while frontier:
+        cur = frontier.pop()
+        for nxt, key, sign in edges.get(cur, ()):
+            if nxt in offset:
+                continue
+            lo, hi = bounds[key]
+            mid = (lo + hi) / 2.0
+            consistent = lo <= hi
+            # child offset d maps CHILD clock onto PARENT clock; going
+            # parent->child applies +d to the child, child->parent -d
+            offset[nxt] = offset[cur] + sign * mid
+            report[nxt] = {
+                "offset_ms": round(offset[nxt] * 1e3, 6),
+                "uncertainty_ms": round(abs(hi - lo) / 2.0 * 1e3, 6),
+                "pairs": n_pairs[key],
+                "reference": False,
+                "consistent": consistent,
+            }
+            frontier.append(nxt)
+    for p in pids:
+        if p not in offset:
+            offset[p] = 0.0
+            report[p] = {"offset_ms": 0.0, "uncertainty_ms": None,
+                         "pairs": 0, "reference": False,
+                         "consistent": None, "unaligned": True}
+    for spans in traces.values():
+        for s in spans:
+            d = offset[s.pid]
+            s.atm0, s.atm1 = s.tm0 + d, s.tm1 + d
+    return report
+
+
+def collect(telemetry_dir: str,
+            allow_orphans: bool = False) -> CollectResult:
+    """Merge + assemble + align one telemetry dir. Raises
+    OrphanSpanError on orphan spans unless explicitly allowed — a hole
+    in the tree is a finding, not something to attribute around."""
+    spans, files, n_events = load_spans(telemetry_dir)
+    traces: dict[str, list[Span]] = {}
+    for s in spans:
+        traces.setdefault(s.trace_id, []).append(s)
+    orphans: list[Span] = []
+    multi_root: dict[str, int] = {}
+    for tid, tspans in traces.items():
+        ids = {s.span_id for s in tspans}
+        n_roots = sum(1 for s in tspans if s.parent_id is None)
+        if n_roots > 1:
+            multi_root[tid] = n_roots
+        orphans.extend(s for s in tspans
+                       if s.parent_id is not None
+                       and s.parent_id not in ids)
+    if orphans and not allow_orphans:
+        ex = orphans[0]
+        raise OrphanSpanError(
+            f"{len(orphans)} orphan span(s): e.g. {ex.name} "
+            f"(trace {ex.trace_id}, span {ex.span_id}) references "
+            f"parent {ex.parent_id!r} which no merged file contains — "
+            f"a missing file or a dropped parent emission; rerun with "
+            f"allow_orphans to inspect anyway")
+    clock = _align_clocks(traces)
+    return CollectResult(traces=traces, orphans=orphans,
+                         multi_root=multi_root, clock=clock,
+                         files=files, n_events=n_events,
+                         n_spans=len(spans))

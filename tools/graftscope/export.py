@@ -1,0 +1,60 @@
+"""Chrome/Perfetto trace-event export of collected request trees.
+
+The trace-event JSON format (load at ui.perfetto.dev or
+chrome://tracing): complete events (``"ph": "X"``) with microsecond
+stamps on the ALIGNED clock — after collect's bounded-skew estimate,
+one request's router and worker spans render on a shared timeline even
+though the processes stamped them on unrelated monotonic clocks.
+
+Layout choice: Perfetto rows are (pid, tid) pairs. Real pids keep the
+process split visible (one track group per fleet member); the tid is a
+stable per-trace hash so the spans of one request stack on one row
+inside each process, making a single request's hop pattern readable in
+a fleet serving thousands of concurrent requests.
+"""
+
+from __future__ import annotations
+
+from tools.graftscope.collect import CollectResult
+
+
+def _tid(trace_id: str) -> int:
+    return int(trace_id[:8], 16) % (2 ** 31 - 1) + 1
+
+
+def chrome_trace_events(result: CollectResult) -> list[dict]:
+    """Trace-event dicts, ready for ``json.dump({"traceEvents": ...})``."""
+    events: list[dict] = []
+    if not result.traces:
+        return events
+    # rebase to the earliest aligned stamp so timestamps start near 0
+    t_base = min(s.atm0 for spans in result.traces.values()
+                 for s in spans)
+    for tid_str, spans in sorted(result.traces.items()):
+        row = _tid(tid_str)
+        for s in sorted(spans, key=lambda s: s.atm0):
+            events.append({
+                "name": s.name,
+                "cat": "graftscope",
+                "ph": "X",
+                "ts": round((s.atm0 - t_base) * 1e6, 3),
+                "dur": round(s.dur_ms * 1e3, 3),
+                "pid": s.pid,
+                "tid": row,
+                "args": {"trace_id": s.trace_id,
+                         "span_id": s.span_id,
+                         "parent_span_id": s.parent_id,
+                         **s.tags},
+            })
+    return events
+
+
+def write_chrome_trace(result: CollectResult, path: str) -> int:
+    """Write the export; returns the event count."""
+    import json
+
+    events = chrome_trace_events(result)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return len(events)

@@ -1,0 +1,214 @@
+"""Tail attribution: per-stage critical-path percentiles + exemplars.
+
+The stage chain of one fleet request (standalone serving has no
+transport leg — the worker stages hang directly off the root):
+
+    trace.request                     (root — the future's whole life)
+      trace.router_queue              admission -> handed to a sender
+      trace.transport                 the HTTP round trip (per attempt)
+        trace.worker_queue            worker admission -> left the queue
+        trace.pack                    host pack into the rung shape
+        trace.dispatch                program launch
+        trace.compute                 block until host-readable
+      trace.complete                  rows back -> future resolved
+
+The breakdown reports EXCLUSIVE transport time (round trip minus the
+worker stages nested in it — i.e. wire + HTTP + worker-side handler
+overhead) so the stages sum toward the total instead of double
+counting; the remainder (``other``) is the unattributed slack
+(scheduling, GIL, clock noise) and is reported, not hidden.
+
+Completeness — the invariant fleet_bench/stream_bench exit-code-assert:
+every trace whose root settled ``outcome="ok"`` has EXACTLY one root
+and a full stage chain. Slow-kept partial traces (root tagged
+``sampled="slow"`` — the head said no, the always-keep override flushed
+the front-door spans anyway) are exempt from the worker-side chain by
+construction and are excluded from the stage percentiles; they still
+feed the exemplar list, which is their entire purpose.
+"""
+
+from __future__ import annotations
+
+from tools.graftscope.collect import CollectResult, Span
+
+STAGES = ("router_queue", "transport", "worker_queue", "pack",
+          "dispatch", "compute", "complete")
+
+WORKER_STAGES = ("worker_queue", "pack", "dispatch", "compute")
+
+PERCENTILES = (50.0, 95.0, 99.0, 99.9)
+
+
+def percentile(sorted_vals: list[float], q: float) -> float | None:
+    """Linear-interpolation percentile over pre-sorted values (numpy's
+    default method, stdlib-only so the collector stays dependency-free)."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _summary(vals: list[float]) -> dict:
+    vals = sorted(vals)
+    out = {"count": len(vals)}
+    for q in PERCENTILES:
+        key = f"p{q:g}".replace(".", "_")
+        out[f"{key}_ms"] = (round(percentile(vals, q), 3)
+                            if vals else None)
+    return out
+
+
+def _trace_breakdown(root: Span, spans: list[Span]) -> dict:
+    """Exclusive per-stage milliseconds of one trace."""
+    by_stage: dict[str, float] = {s: 0.0 for s in STAGES}
+    for s in spans:
+        if s.parent_id is not None and s.stage in by_stage:
+            by_stage[s.stage] += s.dur_ms
+    worker_ms = sum(by_stage[s] for s in WORKER_STAGES)
+    if by_stage["transport"]:
+        by_stage["transport"] = max(by_stage["transport"] - worker_ms,
+                                    0.0)
+    total = root.dur_ms
+    attributed = sum(by_stage.values())
+    by_stage["other"] = max(total - attributed, 0.0)
+    by_stage["total"] = total
+    return by_stage
+
+
+def _is_partial(root: Span) -> bool:
+    return root.tags.get("sampled") == "slow"
+
+
+def _chain_missing(spans: list[Span]) -> list[str]:
+    """Stage names missing from one trace's chain (empty = complete).
+    Fleet traces need an ok transport attempt + the worker stages +
+    router_queue + complete; standalone traces just the worker
+    stages."""
+    stages = {s.stage for s in spans if s.parent_id is not None}
+    transports = [s for s in spans if s.stage == "transport"]
+    if transports:
+        missing = [st for st in
+                   ("router_queue", *WORKER_STAGES, "complete")
+                   if st not in stages]
+        if not any(s.tags.get("outcome") == "ok" for s in transports):
+            missing.append("transport(outcome=ok)")
+    else:  # standalone serving: worker stages hang off the root
+        missing = [st for st in WORKER_STAGES if st not in stages]
+    return missing
+
+
+def check_completeness(result: CollectResult) -> list[str]:
+    """Violations of the one-root + full-stage-chain invariant over
+    every ok-rooted trace (partial slow-kept traces exempted from the
+    worker chain; see module docstring)."""
+    violations: list[str] = []
+    for tid, mr in sorted(result.multi_root.items()):
+        violations.append(f"trace {tid}: {mr} roots (want exactly 1)")
+    for tid, spans in sorted(result.traces.items()):
+        roots = [s for s in spans if s.parent_id is None]
+        if len(roots) != 1:
+            if not roots:
+                violations.append(f"trace {tid}: no root span")
+            continue  # multi-root already reported
+        root = roots[0]
+        if root.tags.get("outcome") != "ok":
+            continue  # failed requests may legitimately stop anywhere
+        if _is_partial(root):
+            # front-door spans only, by design: require the queue leg
+            # so even a partial exemplar attributes SOMETHING
+            stages = {s.stage for s in spans if s.parent_id is not None}
+            if "router_queue" not in stages \
+                    and "worker_queue" not in stages:
+                violations.append(
+                    f"trace {tid}: slow-kept partial trace carries no "
+                    f"queue stage span")
+            continue
+        missing = _chain_missing(spans)
+        if missing:
+            violations.append(
+                f"trace {tid}: ok root but incomplete stage chain — "
+                f"missing {', '.join(missing)}")
+    return violations
+
+
+def _exemplar(root: Span, spans: list[Span]) -> dict:
+    rel0 = root.atm0
+    return {
+        "trace_id": root.trace_id,
+        "total_ms": round(root.dur_ms, 3),
+        "entry_id": root.tags.get("entry_id"),
+        "partial": _is_partial(root),
+        "breakdown_ms": {k: round(v, 3) for k, v in
+                         _trace_breakdown(root, spans).items()},
+        "spans": [
+            {"name": s.name,
+             "start_ms": round((s.atm0 - rel0) * 1e3, 3),
+             "dur_ms": round(s.dur_ms, 3),
+             "pid": s.pid,
+             "parent": s.parent_id,
+             "span_id": s.span_id,
+             **({"tags": s.tags} if s.tags else {})}
+            for s in sorted(spans, key=lambda s: (s.atm0, s.span_id))],
+    }
+
+
+def build_report(result: CollectResult, top_k: int = 5) -> dict:
+    """The attribution report benches embed in their JSON: per-stage
+    p50/p95/p99/p99.9 over complete ok traces, top-k slowest exemplars
+    (partial ones included — tail exemplars are why they were kept),
+    the per-process clock report, and the completeness verdict."""
+    ok_complete: list[tuple[Span, list[Span]]] = []
+    ok_partial: list[tuple[Span, list[Span]]] = []
+    n_error = 0
+    for spans in result.traces.values():
+        roots = [s for s in spans if s.parent_id is None]
+        if len(roots) != 1:
+            continue
+        root = roots[0]
+        if root.tags.get("outcome") != "ok":
+            n_error += 1
+            continue
+        (ok_partial if _is_partial(root)
+         else ok_complete).append((root, spans))
+    per_stage: dict[str, list[float]] = {s: [] for s in STAGES}
+    per_stage["other"] = []
+    totals: list[float] = []
+    for root, spans in ok_complete:
+        if _chain_missing(spans):
+            # an ok trace with a hole in its chain (e.g. a worker at
+            # "basic" verbosity contributing no spans) must not feed
+            # the stage percentiles: its worker time would silently
+            # masquerade as transport time. It still counts in
+            # traces_ok and surfaces via `incomplete`.
+            continue
+        bd = _trace_breakdown(root, spans)
+        totals.append(bd["total"])
+        for stage in per_stage:
+            per_stage[stage].append(bd[stage])
+    slowest = sorted(ok_complete + ok_partial,
+                     key=lambda rs: -rs[0].dur_ms)[:max(top_k, 0)]
+    completeness = check_completeness(result)
+    return {
+        "traces": len(result.traces),
+        "traces_ok": len(ok_complete) + len(ok_partial),
+        "traces_ok_complete": len(ok_complete),
+        "traces_ok_partial": len(ok_partial),
+        "traces_error": n_error,
+        "spans": result.n_spans,
+        "events": result.n_events,
+        "files": len(result.files),
+        "orphans": len(result.orphans),
+        "multi_root": len(result.multi_root),
+        "incomplete": len(completeness),
+        "completeness_violations": completeness[:50],
+        "clock": {str(pid): rep
+                  for pid, rep in sorted(result.clock.items())},
+        "stage_ms": {"total": _summary(totals),
+                     **{s: _summary(v) for s, v in per_stage.items()}},
+        "slowest": [_exemplar(r, sp) for r, sp in slowest],
+    }
