@@ -295,6 +295,53 @@ def run_graftscope(check: Check, tag: str, tele_dir: str,
         return {}
 
 
+def check_queue_wait_consistency(check: Check, tag: str,
+                                 tele_dir: str) -> dict:
+    """ISSUE-13 satellite: the ``router.queue_wait`` gauge — THE
+    autoscale signal — must be sane against the traces that measure
+    the same interval independently. Every gauge value must be
+    non-negative, and max(gauge) must dominate the max
+    ``trace.router_queue`` span duration: the gauge is the OLDEST
+    request's admission->dispatch wait per batch measured since its
+    original arrival, while each span covers one request's wait for
+    ONE queue residency — so no span can (beyond clock slop) exceed
+    the biggest gauge. A violation means the signal the autoscaler
+    trusts has drifted from what requests actually experienced."""
+    from pertgnn_tpu.telemetry import load_events
+
+    gauges: list[float] = []
+    span_max = 0.0
+    n_spans = 0
+    for fname in os.listdir(tele_dir):
+        if not fname.endswith(".jsonl"):
+            continue
+        for ev in load_events(os.path.join(tele_dir, fname)):
+            if (ev["kind"] == "gauge"
+                    and ev["name"] == "router.queue_wait"):
+                gauges.append(float(ev["value"]))
+            elif (ev["kind"] == "span"
+                  and ev["name"] == "trace.router_queue"):
+                span_max = max(span_max, float(ev["dur_ms"]))
+                n_spans += 1
+    check.expect(len(gauges) >= 1,
+                 f"{tag}: no router.queue_wait gauges in the JSONL "
+                 f"(the autoscale signal is dark)")
+    check.expect(all(v >= 0.0 for v in gauges),
+                 f"{tag}: negative router.queue_wait gauge "
+                 f"(min {min(gauges, default=0.0):.3f}ms)")
+    if n_spans:
+        g_max = max(gauges, default=0.0)
+        check.expect(g_max + 1.0 >= 0.95 * span_max,
+                     f"{tag}: max router.queue_wait gauge {g_max:.1f}ms "
+                     f"inconsistent with max trace.router_queue span "
+                     f"{span_max:.1f}ms — the gauge under-reports the "
+                     f"wait requests actually saw")
+    return {"gauges": len(gauges),
+            "gauge_max_ms": round(max(gauges, default=0.0), 3),
+            "router_queue_spans": n_spans,
+            "span_max_ms": round(span_max, 3)}
+
+
 def counters_in(tele_dir: str) -> set:
     from pertgnn_tpu.telemetry import load_events
 
@@ -457,7 +504,12 @@ def main(argv=None) -> int:
                                expect_ok=n_served,
                                perfetto=os.path.join(
                                    tmp, "chaos.perfetto.json"))
+        # the autoscale-signal gauge vs the spans measuring the same
+        # interval (ISSUE-13 satellite; details on the checker)
+        qwait = check_queue_wait_consistency(
+            check, "chaos", os.path.join(tmp, "tele_chaos"))
         results["chaos"] = {
+            "queue_wait_consistency": qwait,
             "requests": n_chaos, "served": n_served,
             "killed_pid": rc_["killed_pid"],
             "worker_lost": router.get("worker_lost"),

@@ -1,0 +1,422 @@
+"""Tail benchmark: the chaos-storm gate for the shielded fleet.
+
+One run, every ISSUE-13 invariant, exit-code-asserted (the
+fleet_bench/chaos_bench split: numbers in the JSON, verdict in the
+return code). The scenario is the worst hour of a production day
+compressed to bench scale, driven OPEN-LOOP so queueing collapse is
+visible (fleet/loadgen.py — closed-loop clients would politely
+self-throttle and hide it):
+
+- **the storm** — a trace-replay arrival schedule with burst windows
+  (several x the base rate), a diurnal envelope, Zipf entry
+  popularity, and a mixed SLO population (critical / standard /
+  best_effort), deterministic per seed;
+- **the stragglers** — an injected `serve.dispatch` DELAY fault
+  (testing/faults.py: slow-without-failing) on a fraction of worker
+  dispatches, which is exactly what hedged dispatch defends against;
+- **the kill** — one base worker SIGKILLed mid-storm (the
+  fleet_bench drill, inside the burst);
+- **the relief** — the autoscale controller spawning a warm spare off
+  the `router.queue_wait` signal and retiring it on cooldown after
+  the storm passes.
+
+Gates (all in one run):
+
+1. rc == 0 and ZERO lost futures — every scheduled arrival resolved to
+   a prediction or a typed error (the launcher itself also
+   exit-asserts `lost_futures == 0`);
+2. every served prediction BIT-IDENTICAL to a single-engine in-process
+   reference — including hedge winners (first-answer-wins is safe
+   because both legs compute the same bits);
+3. hedging observed AND useful: `router.hedge_fired > 0`,
+   `router.hedge_won > 0`;
+4. lowest-class-first shedding only: best_effort sheds happened,
+   `critical` sheds did NOT (no top-class request shed while
+   best-effort traffic was being admitted);
+5. brownout observed: `router.brownout` fired and workers downgraded
+   (`serve.brownout_downgrade` in the JSONL);
+6. bounded tail for the top class: critical p99/p99.9 under the
+   scenario bound (reported either way);
+7. autoscale spawn AND cooldown-retire both observed, the spare WARM
+   (`compiles == 0`, `arena_warm`, from its own probe body);
+8. graftscope collects a complete stage chain for every successful
+   future at sample rate 1.0 — zero orphans, one root each, across
+   the kill, the hedges, and the spare.
+
+CPU by default. One JSON line on stdout.
+
+    python benchmarks/tail_bench.py [--dryrun]
+
+``--dryrun`` is the CI wiring: a shorter storm, same gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from benchmarks.fleet_bench import (Check, build_reference,  # noqa: E402
+                                    common_flags, counters_in,
+                                    run_graftscope)
+
+
+def population_csv(ds, tmp: str) -> tuple[str, np.ndarray, np.ndarray]:
+    """The loadgen POPULATION: every (entry, ts_bucket) pair of every
+    split, seeded-shuffled — the 'real corpus' the Zipf law skews."""
+    import pandas as pd
+
+    e = np.concatenate([np.asarray(s.entry_ids, np.int64)
+                        for s in ds.splits.values()])
+    t = np.concatenate([np.asarray(s.ts_buckets, np.int64)
+                        for s in ds.splits.values()])
+    perm = np.random.default_rng(0).permutation(len(e))
+    e, t = e[perm], t[perm]
+    path = os.path.join(tmp, "population.csv")
+    pd.DataFrame({"entry_id": e, "ts_bucket": t}).to_csv(path,
+                                                         index=False)
+    return path, e, t
+
+
+def straggler_plan() -> str:
+    """The armed chaos: a seeded DELAY fault on a fraction of worker
+    dispatches (slow-without-failing — the hedging target). Exported
+    via $PERTGNN_FAULT_PLAN so every worker (spares included) adopts
+    it; the bench parent's reference engine never sees it."""
+    from pertgnn_tpu.testing.faults import FaultPlan, FaultSpec
+
+    return FaultPlan([FaultSpec(site="serve.dispatch", kind="delay",
+                                delay_s=0.35, p=0.12)],
+                     seed=1234).to_json()
+
+
+def run_storm(tmp: str, pop_csv: str, args) -> dict:
+    """One fleet_main --loadgen chaos-storm run; SIGKILLs a base
+    worker inside the first burst window. Returns {rc, stats, out_csv,
+    killed_pid}."""
+    from pertgnn_tpu.fleet.transport import WorkerTransportError, get_probe
+
+    duration = 6.0 if args.dryrun else 20.0
+    base_rps = args.base_rps or (120.0 if args.dryrun else 200.0)
+    out_csv = os.path.join(tmp, "served_storm.csv")
+    tele = os.path.join(tmp, "tele_storm")
+    cmd = [sys.executable, "-m", "pertgnn_tpu.cli.fleet_main",
+           *common_flags(tmp), "--fresh_init",
+           "--num_workers", "2", "--pin_worker_cpus",
+           "--requests", pop_csv,
+           # the storm: open-loop bursts + diurnal + Zipf + SLO mix
+           "--loadgen",
+           "--loadgen_duration_s", str(duration),
+           "--loadgen_base_rps", str(base_rps),
+           "--loadgen_burst_factor", "6",
+           "--loadgen_burst_every_s", "2.0",
+           "--loadgen_burst_len_s", "0.8",
+           "--loadgen_diurnal_amp", "0.4",
+           "--loadgen_diurnal_period_s", str(duration),
+           "--loadgen_zipf_s", "1.1",
+           "--loadgen_slo_mix",
+           "critical:0.1,standard:0.3,best_effort:0.6",
+           "--seed", "0",
+           # hedging: fixed threshold well under the injected 350ms
+           # straggler delay, well over a healthy dispatch
+           "--hedge_quantile_ms", "120",
+           # SLO admission pressure: a pending cap the bursts overflow
+           "--router_max_pending", "48",
+           "--brownout_enter_ratio", "0.3",
+           # elastic warm spare off the queue-wait signal
+           "--autoscale_max_spares", "1",
+           "--autoscale_up_ms", "40", "--autoscale_down_ms", "15",
+           "--autoscale_hold_s", "0.3", "--autoscale_cooldown_s", "2",
+           "--health_poll_interval_s", "0.3",
+           "--router_dispatch_timeout_s", "30",
+           "--telemetry_dir", tele, "--telemetry_level", "trace",
+           "--trace_sample_rate", "1.0",
+           "--out", out_csv]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PERTGNN_FAULT_PLAN": straggler_plan()}
+    child = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                             env=env)
+    killed_pid = None
+    lines: list[str] = []
+    timeout_s = 900.0
+    try:
+        # line 1 is the machine-readable membership (pids + urls)
+        first = child.stdout.readline()
+        lines.append(first)
+        members = json.loads(first)["fleet_workers"]
+        victim = members[0]
+        deadline = time.monotonic() + timeout_s / 2
+        # kill INSIDE the storm: wait for observed traffic on the
+        # victim (the fleet_bench discipline — evidence, not a sleep)
+        while time.monotonic() < deadline and child.poll() is None:
+            try:
+                status, body = get_probe(victim["url"], 0.5)
+                q = body.get("queue", {})
+                if status == 200 and (q.get("depth", 0)
+                                      + q.get("inflight", 0)) > 0:
+                    break
+            except WorkerTransportError:
+                pass
+            time.sleep(0.02)
+        time.sleep(0.5)  # let the storm build before the kill
+        killed_pid = victim["pid"]
+        print(f"tail_bench: SIGKILL worker {victim['worker_id']} "
+              f"(pid {killed_pid}) mid-storm", file=sys.stderr)
+        try:
+            os.kill(killed_pid, signal.SIGKILL)
+        except ProcessLookupError:
+            print("tail_bench: victim already gone?!", file=sys.stderr)
+        out, _ = child.communicate(timeout=timeout_s)
+        lines += out.splitlines()
+    except subprocess.TimeoutExpired:
+        child.kill()
+        raise SystemExit(f"storm run hung past {timeout_s}s")
+    stats = {}
+    for line in lines:
+        if line.startswith("{") and '"metric"' in line:
+            stats = json.loads(line)
+    return {"rc": child.returncode, "stats": stats, "out_csv": out_csv,
+            "killed_pid": killed_pid, "tele": tele}
+
+
+def shed_events_violations(tele_dir: str) -> tuple[int, int, int]:
+    """(bad_rejects, bad_evicts, total shed events) over the run's
+    ``router.shed_by_class`` events. A REJECT of a critical request is
+    legitimate only when its ``lowest_queued`` tag says the queue held
+    nothing lower at that moment; an EVICT must never name a critical
+    victim at all."""
+    from pertgnn_tpu.telemetry import load_events
+
+    bad_rejects = bad_evicts = total = 0
+    for fname in os.listdir(tele_dir):
+        if not fname.endswith(".jsonl"):
+            continue
+        for ev in load_events(os.path.join(tele_dir, fname)):
+            if ev["name"] != "router.shed_by_class":
+                continue
+            total += 1
+            tags = ev.get("tags") or {}
+            if tags.get("slo") != "critical":
+                continue
+            if tags.get("mode") == "evict":
+                bad_evicts += 1
+            elif tags.get("lowest_queued") != "critical":
+                bad_rejects += 1
+    return bad_rejects, bad_evicts, total
+
+
+def cooldown_retires(tele_dir: str) -> int:
+    """autoscale.retired events whose reason is the NATURAL cooldown —
+    the stats total also counts close()-time force-retires, which must
+    not satisfy the 'cooldown-retire observed' acceptance gate."""
+    from pertgnn_tpu.telemetry import load_events
+
+    n = 0
+    for fname in os.listdir(tele_dir):
+        if not fname.endswith(".jsonl"):
+            continue
+        for ev in load_events(os.path.join(tele_dir, fname)):
+            if (ev["name"] == "autoscale.retired"
+                    and (ev.get("tags") or {}).get("reason")
+                    == "cooldown"):
+                n += 1
+    return n
+
+
+def check_bit_identical_served(check: Check, out_csv: str,
+                               engine) -> int:
+    """Every SERVED row (finite y_pred, no error) must match the
+    single-engine reference bit-for-bit — hedge winners, requeued
+    retries, downgraded rungs, and spare-served rows included (padding
+    invariance + identical seeded state make all of them the same
+    bits). Rows with a typed error are the shed/expired population and
+    carry no prediction to compare."""
+    import pandas as pd
+
+    df = pd.read_csv(out_csv)
+    served = df[np.isfinite(df["y_pred"].to_numpy(np.float32))]
+    uniq: dict[tuple[int, int], float] = {}
+    n_bad = 0
+    for eid, tsb, pred in zip(served["entry_id"], served["ts_bucket"],
+                              served["y_pred"].to_numpy(np.float32)):
+        key = (int(eid), int(tsb))
+        if key not in uniq:
+            uniq[key] = np.float32(engine.predict_microbatch(
+                [key[0]], [key[1]])[0])
+        if pred != uniq[key]:
+            n_bad += 1
+    check.expect(n_bad == 0,
+                 f"{n_bad}/{len(served)} served prediction(s) not "
+                 f"bit-identical to the single-engine reference")
+    # a row with neither prediction nor error is a lost future
+    if "error" in df.columns:
+        lost = int((~np.isfinite(df["y_pred"].to_numpy(np.float32))
+                    & df["error"].isna()).sum())
+        check.expect(lost == 0,
+                     f"{lost} row(s) with neither prediction nor typed "
+                     f"error — lost futures")
+    return len(served)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dryrun", action="store_true",
+                   help="CI mode: shorter storm, same gates")
+    p.add_argument("--base_rps", type=float, default=0.0,
+                   help="override the scenario's base offered rate")
+    args = p.parse_args(argv)
+
+    check = Check()
+    t0 = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="tail_bench_")
+    ds, engine = build_reference(tmp)
+    pop_csv, _e, _t = population_csv(ds, tmp)
+
+    r = run_storm(tmp, pop_csv, args)
+    st = r["stats"]
+    check.expect(r["rc"] == 0,
+                 f"storm run exited rc={r['rc']} (zero lost futures is "
+                 f"exit-asserted launcher-side)")
+    lg = st.get("loadgen", {})
+    router = st.get("router", {})
+    scale = st.get("autoscale", {})
+
+    # 1. zero lost futures, end to end
+    check.expect(lg.get("lost_futures", -1) == 0,
+                 f"loadgen reported {lg.get('lost_futures')} lost "
+                 f"future(s)")
+    check.expect(lg.get("unresolved", -1) == 0,
+                 f"{lg.get('unresolved')} future(s) unresolved at the "
+                 f"tail wait")
+    check.expect(st.get("served", 0) > 0, "nothing was served at all")
+
+    # 2. bit-identical served predictions (incl. hedge winners)
+    n_served = check_bit_identical_served(check, r["out_csv"], engine)
+
+    # 3. hedging fired and won
+    check.expect(router.get("hedge_fired", 0) > 0,
+                 "no hedge ever fired (stragglers were injected — the "
+                 "hedger is dead or the threshold never armed)")
+    check.expect(router.get("hedge_won", 0) > 0,
+                 "no hedge ever WON (wins are how hedging pays; the "
+                 "race may be broken)")
+
+    # 4. lowest-class-first shedding only: best_effort shed under the
+    # storm, and every critical shed happened ONLY when the queue held
+    # nothing lower (the per-event `lowest_queued` evidence tag) — no
+    # top-class request was shed while best-effort was being admitted.
+    # Eviction is lowest-class-by-construction; the gate also pins that
+    # no eviction ever chose a critical victim.
+    shed_by_class = router.get("shed_by_class", {})
+    check.expect(shed_by_class.get("best_effort", 0) > 0,
+                 f"the storm never shed best_effort traffic "
+                 f"(shed_by_class={shed_by_class}) — the overload "
+                 f"scenario is too gentle to gate on")
+    bad_rejects, bad_evicts, n_shed_events = shed_events_violations(
+        r["tele"])
+    check.expect(bad_rejects == 0,
+                 f"{bad_rejects} CRITICAL request(s) shed while "
+                 f"lower-class traffic was queued — lowest-class-first "
+                 f"is broken")
+    check.expect(bad_evicts == 0,
+                 f"{bad_evicts} CRITICAL request(s) EVICTED — eviction "
+                 f"must only ever pick a strictly lower class")
+    check.expect(n_shed_events > 0,
+                 "no shed_by_class events in the JSONL at all")
+
+    # 5. brownout + worker-side downgrade observed
+    names = counters_in(r["tele"])
+    check.expect("router.brownout" in names,
+                 "router.brownout never fired (occupancy never crossed "
+                 "the enter ratio?)")
+    check.expect("serve.brownout_downgrade" in names,
+                 "no worker ever served a downgraded best-effort batch")
+
+    # 6. bounded tail for the top class
+    crit = lg.get("latency_by_class", {}).get("critical", {})
+    p99_bound = 8000.0 if args.dryrun else 5000.0
+    check.expect(crit.get("count", 0) > 0,
+                 "no critical request was served — the mix is broken")
+    check.expect(crit.get("p99_ms", float("inf")) <= p99_bound,
+                 f"critical p99 {crit.get('p99_ms')}ms above the "
+                 f"{p99_bound:g}ms scenario bound")
+
+    # 7. autoscale up AND cooldown-retire, warm
+    check.expect(scale.get("spawned", 0) >= 1,
+                 "autoscale never spawned a spare (queue wait never "
+                 "crossed the up threshold?)")
+    n_cooldown = cooldown_retires(r["tele"])
+    check.expect(n_cooldown >= 1,
+                 f"no spare was retired on COOLDOWN (retired total "
+                 f"{scale.get('retired')} — a close()-time "
+                 f"force-retire does not count)")
+    check.expect(scale.get("spares") == [],
+                 f"spares still live at exit: {scale.get('spares')}")
+    for wid, body in st.get("autoscale_workers", {}).items():
+        check.expect(body.get("compiles") == 0,
+                     f"spare {wid} compiled {body.get('compiles')} "
+                     f"rungs (want 0 — it must start WARM)")
+        check.expect(bool(body.get("arena_warm")),
+                     f"spare {wid} arena store cold (ingest ran)")
+
+    # the base workers started warm too
+    for wid, body in st.get("workers_ready", {}).items():
+        check.expect(body.get("compiles") == 0,
+                     f"worker {wid} compiled {body.get('compiles')} "
+                     f"rungs (want 0)")
+
+    # the kill was observed
+    check.expect(router.get("worker_lost", 0) >= 1,
+                 "the router never noticed the SIGKILLed worker")
+
+    # 8. graftscope: complete stage chain per successful future at
+    # sample rate 1.0, across the kill + hedges + spare
+    scope = run_graftscope(check, "storm", r["tele"],
+                           expect_ok=n_served,
+                           perfetto=os.path.join(
+                               tmp, "storm.perfetto.json"))
+
+    print(json.dumps({
+        "metric": "tail_invariants_ok",
+        "value": int(not check.failures),
+        "unit": "bool",
+        "dryrun": args.dryrun,
+        "results": {
+            "tmp": tmp,
+            "offered": lg.get("offered"),
+            "served": n_served,
+            "shed_by_class": shed_by_class,
+            "hedge_fired": router.get("hedge_fired"),
+            "hedge_won": router.get("hedge_won"),
+            "requeues": router.get("requeues"),
+            "worker_lost": router.get("worker_lost"),
+            "killed_pid": r["killed_pid"],
+            "autoscale": scale,
+            "latency_by_class": lg.get("latency_by_class"),
+            "lag_ms_max": lg.get("lag_ms_max"),
+            "trace_attribution": scope.get("stage_ms"),
+            "traces_ok": scope.get("traces_ok"),
+            "trace_orphans": scope.get("orphans"),
+        },
+        "violations": check.failures,
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "captured_unix_time": time.time(),
+    }))
+    return 1 if check.failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -389,6 +389,50 @@ def add_fleet_flags(p: argparse.ArgumentParser) -> None:
                    default=FleetConfig.max_requeues,
                    help="times one request may requeue (worker loss) "
                         "before the router fails it with the last error")
+    p.add_argument("--hedge_quantile_ms", type=float,
+                   default=FleetConfig.hedge_quantile_ms,
+                   help="hedged dispatch: re-dispatch a microbatch "
+                        "still running past this many ms to a second "
+                        "worker (first answer wins — bit-safe); 0 "
+                        "defers to --hedge_quantile")
+    p.add_argument("--hedge_quantile", type=float,
+                   default=FleetConfig.hedge_quantile,
+                   help="adaptive hedge threshold: hedge past the "
+                        "rolling q-quantile of recent batch round "
+                        "trips (in (0,1); both hedge flags 0 = "
+                        "hedging off)")
+    p.add_argument("--brownout_enter_ratio", type=float,
+                   default=FleetConfig.brownout_enter_ratio,
+                   help="pending-occupancy ratio at which the router "
+                        "browns out best-effort traffic (downgraded "
+                        "to the cheapest ladder rung before anything "
+                        "is shed); <= 0 disables brownout")
+    p.add_argument("--brownout_exit_ratio", type=float,
+                   default=FleetConfig.brownout_exit_ratio,
+                   help="occupancy below which brownout exits "
+                        "(hysteresis); <= 0 = half the enter ratio")
+    p.add_argument("--autoscale_max_spares", type=int,
+                   default=FleetConfig.autoscale_max_spares,
+                   help="elastic warm spares: max spare workers the "
+                        "autoscale controller may spawn warm from the "
+                        "shared AOT/arena stores; 0 = autoscale off")
+    p.add_argument("--autoscale_up_ms", type=float,
+                   default=FleetConfig.autoscale_up_ms,
+                   help="router.queue_wait (ms) above which a spare "
+                        "spawns (after --autoscale_hold_s of signal)")
+    p.add_argument("--autoscale_down_ms", type=float,
+                   default=FleetConfig.autoscale_down_ms,
+                   help="router.queue_wait (ms) below which the newest "
+                        "spare retires after --autoscale_cooldown_s "
+                        "of sustained calm")
+    p.add_argument("--autoscale_hold_s", type=float,
+                   default=FleetConfig.autoscale_hold_s,
+                   help="seconds the up-signal must hold before a "
+                        "spare spawns")
+    p.add_argument("--autoscale_cooldown_s", type=float,
+                   default=FleetConfig.autoscale_cooldown_s,
+                   help="seconds of calm before the newest spare "
+                        "retires")
 
 
 def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
@@ -419,7 +463,25 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         latency_ewma_alpha=getattr(args, "latency_ewma_alpha",
                                    FleetConfig.latency_ewma_alpha),
         max_requeues=getattr(args, "max_requeues",
-                             FleetConfig.max_requeues))
+                             FleetConfig.max_requeues),
+        hedge_quantile_ms=getattr(args, "hedge_quantile_ms",
+                                  FleetConfig.hedge_quantile_ms),
+        hedge_quantile=getattr(args, "hedge_quantile",
+                               FleetConfig.hedge_quantile),
+        brownout_enter_ratio=getattr(args, "brownout_enter_ratio",
+                                     FleetConfig.brownout_enter_ratio),
+        brownout_exit_ratio=getattr(args, "brownout_exit_ratio",
+                                    FleetConfig.brownout_exit_ratio),
+        autoscale_max_spares=getattr(args, "autoscale_max_spares",
+                                     FleetConfig.autoscale_max_spares),
+        autoscale_up_ms=getattr(args, "autoscale_up_ms",
+                                FleetConfig.autoscale_up_ms),
+        autoscale_down_ms=getattr(args, "autoscale_down_ms",
+                                  FleetConfig.autoscale_down_ms),
+        autoscale_hold_s=getattr(args, "autoscale_hold_s",
+                                 FleetConfig.autoscale_hold_s),
+        autoscale_cooldown_s=getattr(args, "autoscale_cooldown_s",
+                                     FleetConfig.autoscale_cooldown_s))
 
 
 def add_aot_flags(p: argparse.ArgumentParser) -> None:

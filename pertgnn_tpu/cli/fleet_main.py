@@ -131,6 +131,33 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--requests", default="",
                    help="CSV of requests (entry_id, ts_bucket columns); "
                         "default: replay --from_split")
+    # open-loop trace-replay load generation (fleet/loadgen.py): these
+    # are bench/scenario inputs, not pipeline semantics, so they live
+    # here rather than in Config (like --requests/--concurrency)
+    p.add_argument("--loadgen", action="store_true",
+                   help="drive the fleet OPEN-LOOP from a generated "
+                        "arrival schedule (bursts, diurnal envelope, "
+                        "Zipf popularity, SLO mix — fleet/loadgen.py) "
+                        "instead of closed-loop client threads; "
+                        "deterministic per --seed")
+    p.add_argument("--loadgen_duration_s", type=float, default=10.0)
+    p.add_argument("--loadgen_base_rps", type=float, default=50.0)
+    p.add_argument("--loadgen_burst_factor", type=float, default=1.0,
+                   help="rate multiplier during burst windows "
+                        "(<= 1 = no bursts)")
+    p.add_argument("--loadgen_burst_every_s", type=float, default=0.0)
+    p.add_argument("--loadgen_burst_len_s", type=float, default=1.0)
+    p.add_argument("--loadgen_diurnal_amp", type=float, default=0.0,
+                   help="diurnal rate envelope amplitude in [0, 1)")
+    p.add_argument("--loadgen_diurnal_period_s", type=float,
+                   default=10.0)
+    p.add_argument("--loadgen_zipf_s", type=float, default=1.1,
+                   help="Zipf popularity exponent over the request "
+                        "population (0 = uniform)")
+    p.add_argument("--loadgen_slo_mix",
+                   default="critical:0.1,standard:0.3,best_effort:0.6",
+                   help="SLO class mix as class:weight[,class:weight...]"
+                        " (fleet/shield.py class names)")
     p.add_argument("--from_split", default="test",
                    choices=("train", "valid", "test"))
     p.add_argument("--num_requests", type=int, default=0,
@@ -326,6 +353,96 @@ def _stop_workers(workers) -> None:
             proc.wait()
 
 
+def _parse_slo_mix(text: str):
+    mix = []
+    for part in text.split(","):
+        name, _, w = part.strip().partition(":")
+        mix.append((name.strip(), float(w or 1.0)))
+    return tuple(mix)
+
+
+def _make_autoscaler(args, argv, fcfg, router, bus, spare_procs,
+                     spare_bodies):
+    """The launcher's elastic-warm-spares wiring: spawn_spare starts a
+    REAL worker subprocess (same argv the base workers got, so it
+    starts warm from the shared AOT/arena stores), waits for its
+    readiness probe, and records its warm-start evidence for the stats
+    JSON; stop_spare is the SIGTERM drain. The controller itself is
+    fleet/autoscale.py."""
+    from pertgnn_tpu.fleet.autoscale import AutoscaleController
+    from pertgnn_tpu.fleet.transport import (WorkerTransportError,
+                                             get_probe)
+
+    def spawn_spare(index: int):
+        port = _free_port()
+        wid = f"spare{index}"
+        wargv = _worker_argv(argv, wid, port)
+        cmd = [sys.executable, "-m", "pertgnn_tpu.cli.fleet_main",
+               *wargv]
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL)
+        spare_procs.append(proc)
+        url = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + args.ready_timeout_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"spare {wid} exited rc={proc.returncode} before "
+                    f"becoming ready")
+            try:
+                status, body = get_probe(url, timeout_s=2.0)
+            except WorkerTransportError:
+                time.sleep(0.1)
+                continue
+            if status == 200:
+                spare_bodies[wid] = body
+                return wid, url, proc, body
+            time.sleep(0.1)
+        proc.terminate()
+        raise RuntimeError(f"spare {wid} not ready after "
+                           f"{args.ready_timeout_s:.0f}s")
+
+    def stop_spare(wid: str, proc):
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                print(f"WARNING: spare {wid} ignored SIGTERM; killing",
+                      file=sys.stderr)
+                proc.kill()
+                proc.wait()
+
+    return AutoscaleController(
+        router, spawn_spare=spawn_spare, stop_spare=stop_spare,
+        max_spares=fcfg.autoscale_max_spares,
+        up_ms=fcfg.autoscale_up_ms, down_ms=fcfg.autoscale_down_ms,
+        hold_s=fcfg.autoscale_hold_s,
+        cooldown_s=fcfg.autoscale_cooldown_s, bus=bus).start()
+
+
+def _await_spare_retire(scaler, fcfg, extra_s: float = 30.0) -> None:
+    """Give the cooldown path a chance to retire live spares NATURALLY
+    (traffic ended, the signal is calm) before close() force-retires
+    them — tail_bench asserts a cooldown retire was OBSERVED, not just
+    a teardown. Also waits out a spawn still mid-flight (a spare
+    triggered during the storm may only become ready after it)."""
+    deadline = time.monotonic() + fcfg.autoscale_cooldown_s + extra_s
+    prev = None
+    while time.monotonic() < deadline:
+        st = scaler.stats_dict()
+        key = (tuple(st["spares"]), st["spawning"])
+        if key != prev:
+            # state moved (spare became ready / one retired): re-arm
+            # the window so a spare that readied late still gets its
+            # full cooldown before the forced close
+            prev = key
+            deadline = (time.monotonic() + fcfg.autoscale_cooldown_s
+                        + extra_s)
+        if not st["spares"] and not st["spawning"]:
+            return
+        time.sleep(0.1)
+
+
 def _run_launcher(args, p: argparse.ArgumentParser,
                   argv: list[str]) -> None:
     if not args.checkpoint_dir and not args.fresh_init:
@@ -363,12 +480,35 @@ def _run_launcher(args, p: argparse.ArgumentParser,
             return m.num_nodes, m.num_edges
 
         client_latency = LatencyRecorder()
-        preds = np.full(len(entries), np.nan, np.float32)
-        served = np.zeros(len(entries), np.bool_)
         import collections
         request_errors: collections.Counter = collections.Counter()
         errors_lock = threading.Lock()
         failures: list[tuple[int, BaseException]] = []
+        schedule = None
+        if args.loadgen:
+            # open-loop: the request stream is the POPULATION the
+            # arrival schedule draws from (Zipf popularity, SLO mix),
+            # deterministic per --seed (fleet/loadgen.py)
+            from pertgnn_tpu.fleet import loadgen
+            spec = loadgen.LoadSpec(
+                duration_s=args.loadgen_duration_s,
+                base_rps=args.loadgen_base_rps,
+                burst_factor=args.loadgen_burst_factor,
+                burst_every_s=args.loadgen_burst_every_s,
+                burst_len_s=args.loadgen_burst_len_s,
+                diurnal_amp=args.loadgen_diurnal_amp,
+                diurnal_period_s=args.loadgen_diurnal_period_s,
+                zipf_s=args.loadgen_zipf_s,
+                slo_mix=_parse_slo_mix(args.loadgen_slo_mix),
+                seed=args.seed)
+            schedule = loadgen.generate_schedule(spec, entries, buckets)
+            out_entries = schedule.entry_ids
+            out_buckets = schedule.ts_buckets
+        else:
+            out_entries, out_buckets = entries, buckets
+        preds = np.full(len(out_entries), np.nan, np.float32)
+        served = np.zeros(len(out_entries), np.bool_)
+        out_errors: list = [None] * len(out_entries)
 
         def client(router, indices):
             for i in indices:
@@ -379,6 +519,7 @@ def _run_launcher(args, p: argparse.ArgumentParser,
                 except ServeError as exc:
                     with errors_lock:
                         request_errors[type(exc).__name__] += 1
+                        out_errors[i] = type(exc).__name__
                     continue
                 except BaseException as exc:  # lint: allow-silent-except — surfaced via SystemExit below
                     with errors_lock:
@@ -388,34 +529,82 @@ def _run_launcher(args, p: argparse.ArgumentParser,
                 served[i] = True
                 client_latency.record_s(time.perf_counter() - t0)
 
+        scaler = None
+        spare_procs: list = []
+        spare_bodies: dict = {}
+        loadgen_stats = None
         t_serve0 = time.perf_counter()
-        with FleetRouter({wid: url for wid, url, _p in workers},
-                         request_size,
-                         (top.max_graphs, top.max_nodes, top.max_edges),
-                         cfg=cfg.fleet, bus=bus) as router:
-            threads = [threading.Thread(
-                target=client,
-                args=(router, range(t, len(entries),
-                                    max(1, args.concurrency))))
-                for t in range(max(1, args.concurrency))]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            router_stats = router.stats_dict()
-        serve_wall_s = time.perf_counter() - t_serve0
+        try:
+            with FleetRouter(
+                    {wid: url for wid, url, _p in workers},
+                    request_size,
+                    (top.max_graphs, top.max_nodes, top.max_edges),
+                    cfg=cfg.fleet, bus=bus) as router:
+                if cfg.fleet.autoscale_max_spares > 0:
+                    scaler = _make_autoscaler(args, argv, cfg.fleet,
+                                              router, bus, spare_procs,
+                                              spare_bodies)
+                try:
+                    if args.loadgen:
+                        from pertgnn_tpu.fleet import loadgen
+                        result = loadgen.replay(router.submit, schedule,
+                                                bus=bus)
+                        preds = result.preds
+                        served = np.isfinite(preds)
+                        out_errors = result.errors
+                        request_errors.update(result.error_counts())
+                        loadgen_stats = {
+                            "offered": result.offered,
+                            "submitted": result.submitted,
+                            "unresolved": result.unresolved,
+                            "lost_futures": result.lost_futures(),
+                            "lag_ms_max": float(result.lag_ms.max())
+                            if len(result.lag_ms) else 0.0,
+                            "latency_by_class":
+                                result.latency_summary_by_class(
+                                    schedule),
+                        }
+                    else:
+                        threads = [threading.Thread(
+                            target=client,
+                            args=(router,
+                                  range(t, len(entries),
+                                        max(1, args.concurrency))))
+                            for t in range(max(1, args.concurrency))]
+                        for t in threads:
+                            t.start()
+                        for t in threads:
+                            t.join()
+                    if scaler is not None:
+                        _await_spare_retire(scaler, cfg.fleet)
+                finally:
+                    if scaler is not None:
+                        scaler.close()
+                router_stats = router.stats_dict()
+                autoscale_stats = (scaler.stats_dict()
+                                   if scaler is not None else None)
+            serve_wall_s = time.perf_counter() - t_serve0
+        finally:
+            for proc in spare_procs:
+                if proc.poll() is None:
+                    proc.terminate()
     finally:
         _stop_workers(workers)
 
     import pandas as pd
 
-    pd.DataFrame({"entry_id": entries, "ts_bucket": buckets,
-                  "y_pred": preds}).to_csv(args.out, index=False)
+    frame = {"entry_id": out_entries, "ts_bucket": out_buckets,
+             "y_pred": preds}
+    if schedule is not None:
+        frame["slo"] = [schedule.slo_name(i)
+                        for i in range(len(schedule))]
+        frame["error"] = out_errors
+    pd.DataFrame(frame).to_csv(args.out, index=False)
     stats = {
         "metric": "fleet_request_latency_ms",
         "unit": "ms",
         "num_workers": args.num_workers,
-        "requests": len(entries),
+        "requests": len(out_entries),
         "served": int(served.sum()),
         "request_errors": dict(request_errors),
         "concurrency": args.concurrency,
@@ -427,8 +616,13 @@ def _run_launcher(args, p: argparse.ArgumentParser,
         "workers_ready": ready,
         "captured_unix_time": time.time(),
     }
+    if loadgen_stats is not None:
+        stats["loadgen"] = loadgen_stats
+    if autoscale_stats is not None:
+        stats["autoscale"] = autoscale_stats
+        stats["autoscale_workers"] = spare_bodies
     bus.flush()
-    print(f"wrote {len(entries)} predictions ({int(served.sum())} "
+    print(f"wrote {len(out_entries)} predictions ({int(served.sum())} "
           f"served by {args.num_workers} worker(s)) to {args.out}",
           file=sys.stderr)
     print(json.dumps(stats), flush=True)
@@ -438,9 +632,16 @@ def _run_launcher(args, p: argparse.ArgumentParser,
             f"{len(failures)} request(s) failed with non-serve errors; "
             f"first: request {i} (entry_id={int(entries[i])}) -> "
             f"{type(exc).__name__}: {exc}")
+    if args.loadgen and loadgen_stats is not None:
+        if loadgen_stats["lost_futures"] or loadgen_stats["unresolved"]:
+            raise SystemExit(
+                f"loadgen: {loadgen_stats['lost_futures']} lost "
+                f"future(s), {loadgen_stats['unresolved']} unresolved "
+                f"at tail-wait timeout — the ALWAYS-resolves contract "
+                f"broke")
     if not served.any():
         raise SystemExit(
-            f"no request was served: all {len(entries)} failed "
+            f"no request was served: all {len(out_entries)} failed "
             f"({dict(request_errors) or 'no typed errors recorded'})")
 
 

@@ -433,6 +433,44 @@ class FleetConfig:
     # bounds the worst case where every dispatch lands on a dying
     # worker; requeue-on-loss is otherwise invisible to the caller.
     max_requeues: int = 3
+    # --- hedged dispatch (fleet/router.py; docs/RELIABILITY.md) ---
+    # Fixed hedge threshold (ms): a dispatched microbatch still running
+    # past it is RE-DISPATCHED to a second worker; first answer wins,
+    # the loser is ignored (predictions are deterministic, so hedging
+    # is bit-safe). > 0 enables hedging with this explicit threshold;
+    # 0 defers to hedge_quantile.
+    hedge_quantile_ms: float = 0.0
+    # Adaptive hedge threshold: the rolling q-quantile of recent batch
+    # round-trip times (policy.hedge_threshold_s; needs a minimum
+    # sample count before it arms). In (0, 1) enables adaptive hedging
+    # when hedge_quantile_ms is 0; both 0 = hedging off.
+    hedge_quantile: float = 0.0
+    # --- SLO brownout (fleet/shield.py) ---
+    # Pending-occupancy ratio (pending / max_pending) at which the
+    # router enters BROWNOUT: best-effort traffic is downgraded to the
+    # workers' cheapest ladder rung before anything is shed. <= 0
+    # disables the mode (class-aware shedding still applies at a full
+    # pending set).
+    brownout_enter_ratio: float = 0.0
+    # Occupancy below which brownout exits (hysteresis); <= 0 = half
+    # the enter ratio.
+    brownout_exit_ratio: float = 0.0
+    # --- elastic warm spares (fleet/autoscale.py) ---
+    # Max spare workers the autoscale controller may spawn (warm from
+    # the shared AOT/arena stores) on top of num_workers; 0 = off.
+    autoscale_max_spares: int = 0
+    # router.queue_wait (ms) above which a spare is spawned once the
+    # signal has held for autoscale_hold_s.
+    autoscale_up_ms: float = 50.0
+    # router.queue_wait (ms) below which the newest spare retires after
+    # autoscale_cooldown_s of sustained calm.
+    autoscale_down_ms: float = 10.0
+    # Seconds the up-signal must hold before spawning (no scale-up off
+    # one noisy batch).
+    autoscale_hold_s: float = 0.5
+    # Seconds the down-signal must hold before a spare retires (spares
+    # are cheap to keep and expensive to thrash).
+    autoscale_cooldown_s: float = 10.0
 
 
 @dataclasses.dataclass(frozen=True)

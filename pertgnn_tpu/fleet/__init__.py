@@ -14,8 +14,17 @@ scales that process out without weakening any of it:
   server wrapping a full PR-4-hardened engine+queue stack;
 - ``router``    — the front door: owns the client-facing request
   queue, coalesces microbatches, dispatches to the
-  predicted-earliest-completion worker, requeues a lost worker's
-  custody to the survivors, and drives membership from /healthz.
+  predicted-earliest-completion worker (excluding workers a retry
+  already failed on), HEDGES stragglers to a second worker
+  (first answer wins — bit-safe), requeues a lost worker's custody to
+  the survivors, and drives membership from /healthz — growable live
+  via add_worker/remove_worker;
+- ``shield``    — SLO classes, lowest-class-first shedding, and the
+  brownout hysteresis, as pure decision functions;
+- ``loadgen``   — open-loop trace-replay load generation: burst and
+  diurnal envelopes, Zipf popularity, SLO mix, deterministic per seed;
+- ``autoscale`` — elastic warm spares off the router's queue-wait
+  signal (spawn from the shared AOT/arena stores, retire on cooldown).
 
 ``cli/fleet_main.py`` is the launcher (spawns N workers warm from the
 shared --compile_cache_dir/--arena_cache_dir, then routes a request
@@ -23,16 +32,26 @@ stream); ``benchmarks/fleet_bench.py`` exit-code-asserts scaling,
 warm start, and the SIGKILL-a-worker chaos invariants.
 """
 
-from pertgnn_tpu.fleet.policy import (WorkerView, choose_worker,
-                                      deadline_infeasible, merge_requeue,
+from pertgnn_tpu.fleet.autoscale import AutoscaleController
+from pertgnn_tpu.fleet.policy import (WorkerView, choose_hedge_worker,
+                                      choose_worker,
+                                      deadline_infeasible,
+                                      hedge_threshold_s, merge_requeue,
                                       predicted_completion_s,
                                       probe_transition)
 from pertgnn_tpu.fleet.router import FleetRouter
+from pertgnn_tpu.fleet.shield import (DEFAULT_CLASS, SLO_CLASSES,
+                                      brownout_transition,
+                                      class_priority, shed_victim_index)
 from pertgnn_tpu.fleet.transport import (WorkerServer,
                                          WorkerTransportError, get_probe,
                                          post_predict)
 
 __all__ = ["FleetRouter", "WorkerServer", "WorkerTransportError",
-           "WorkerView", "choose_worker", "deadline_infeasible",
-           "merge_requeue", "predicted_completion_s", "probe_transition",
-           "get_probe", "post_predict"]
+           "WorkerView", "AutoscaleController", "choose_worker",
+           "choose_hedge_worker", "deadline_infeasible",
+           "hedge_threshold_s", "merge_requeue",
+           "predicted_completion_s", "probe_transition", "get_probe",
+           "post_predict", "SLO_CLASSES", "DEFAULT_CLASS",
+           "class_priority", "shed_victim_index",
+           "brownout_transition"]

@@ -1,0 +1,260 @@
+"""Elastic warm spares: scale the fleet on the queue-wait signal.
+
+The fleet's capacity story before this module was static: N workers at
+launch, minus whatever dies. But the load path this PR builds
+(fleet/loadgen.py) is bursty by construction — diurnal envelopes and
+open-loop bursts that a fixed fleet either overprovisions for or
+collapses under. This controller closes the loop the warm stores make
+cheap: because a worker spawns from the shared AOT + arena stores
+(zero compiles, zero ingest — PR 3/5, the same machinery PR 11's
+rollout restarts ride), a SPARE is seconds away, so capacity can
+follow load instead of provisioning for its peak.
+
+Control law (deliberately boring — hysteresis, not a model):
+
+- **signal** — ``router.queue_wait_signal_ms()``: the rolling max of
+  the ``router.queue_wait`` gauge (admission→dispatch wait of each
+  dispatched batch's oldest request). Queue wait is THE saturation
+  signature for an open-loop arrival process: offered load above
+  capacity shows up here first, before latency percentiles move.
+- **scale up** — signal above ``autoscale_up_ms`` sustained for
+  ``autoscale_hold_s`` (no spawning off one noisy batch), spares below
+  ``autoscale_max_spares``: spawn one spare via the injected
+  ``spawn_spare``, await its readiness probe, `router.add_worker` it.
+  One at a time — each spawn changes the signal, so the loop
+  re-observes before the next.
+- **scale down** — signal below ``autoscale_down_ms`` sustained for
+  ``autoscale_cooldown_s``: retire the NEWEST spare (LIFO keeps the
+  membership churn at the margin) via ``router.remove_worker`` (its
+  queued custody requeues, in-flight work settles) then
+  ``stop_spare`` — the worker's SIGTERM drain. Base workers are never
+  retired; the controller only ever shrinks what it grew.
+
+The controller is process-agnostic the way fleet/rollout.py is: the
+caller injects ``spawn_spare() -> (worker_id, url, handle, probe_body)``
+and ``stop_spare(worker_id, handle)`` (subprocess spawn/SIGTERM in
+cli/fleet_main.py; plain fakes in tests), and the clock is injectable,
+so the hysteresis sequencing is unit-tested with no processes and no
+sleeps (tests/test_shield.py).
+
+Telemetry (docs/OBSERVABILITY.md): counters ``autoscale.spawned`` /
+``autoscale.retired`` / ``autoscale.spawn_failed``, gauges
+``autoscale.spares`` / ``autoscale.signal_ms``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable
+
+from pertgnn_tpu import telemetry
+
+log = logging.getLogger(__name__)
+
+
+class AutoscaleController:
+    """Hysteresis autoscaler over a FleetRouter's queue-wait signal.
+
+    ``spawn_spare(index) -> (worker_id, url, handle, probe_body)`` must
+    return a READY worker (probe answered 200) — the controller adds it
+    to the router only after a successful spawn, so a cold or dead
+    spare never enters dispatch. A spawn that raises is counted
+    (``autoscale.spawn_failed``) and retried on the next up-decision.
+    ``stop_spare(worker_id, handle)`` stops a retired spare (SIGTERM
+    drain; it has already left the router's membership when called)."""
+
+    def __init__(self, router, *,
+                 spawn_spare: Callable[[int], tuple[str, str, Any, dict]],
+                 stop_spare: Callable[[str, Any], None],
+                 max_spares: int,
+                 up_ms: float, down_ms: float,
+                 hold_s: float = 0.5, cooldown_s: float = 10.0,
+                 poll_interval_s: float = 0.1,
+                 signal_window_s: float = 2.0,
+                 bus=None, clock=time.perf_counter):
+        self._router = router
+        self._spawn = spawn_spare
+        self._stop_spare = stop_spare
+        self._max_spares = int(max_spares)
+        self._up_ms = up_ms
+        self._down_ms = down_ms
+        self._hold_s = hold_s
+        self._cooldown_s = cooldown_s
+        self._poll_interval_s = poll_interval_s
+        self._signal_window_s = signal_window_s
+        self._injected_bus = bus
+        self._clock = clock
+        # (worker_id, handle) of live spares, spawn order (LIFO retire)
+        self._spares: list[tuple[str, Any]] = []
+        self._spawned_total = 0
+        self._retired_total = 0
+        self._spawn_failed = 0
+        # True while a spawn is mid-flight (spawn_spare blocks until
+        # the spare answers its readiness probe) — how a launcher's
+        # retire-wait knows a spare is still COMING vs never triggered
+        self._spawning = False
+        # hysteresis state: when the signal first crossed each bound
+        # (None = not currently crossed)
+        self._over_since: float | None = None
+        self._under_since: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    @property
+    def bus(self):
+        return (self._injected_bus if self._injected_bus is not None
+                else telemetry.get_bus())
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "AutoscaleController":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fleet-autoscale")
+        self._thread.start()
+        return self
+
+    def close(self, retire_spares: bool = True) -> None:
+        """Stop the control loop; optionally retire every live spare
+        (the default — a bench must not leak worker processes)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        if retire_spares:
+            while self._retire_one(reason="close"):
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            return {
+                "spares": [wid for wid, _h in self._spares],
+                "spawned": self._spawned_total,
+                "retired": self._retired_total,
+                "spawn_failed": self._spawn_failed,
+                "spawning": self._spawning,
+                "max_spares": self._max_spares,
+            }
+
+    # -- the control loop ------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_interval_s):
+            try:
+                self.step(self._clock())
+            except Exception:  # lint: allow-silent-except — logged; one bad tick must not kill the loop
+                log.exception("autoscale: control step failed")
+
+    def step(self, now: float) -> str | None:
+        """One control decision off the current signal. Public so tests
+        drive the hysteresis with an injected clock and zero sleeps.
+        Returns "up" | "down" | None (what it did)."""
+        signal_ms = self._router.queue_wait_signal_ms(
+            self._signal_window_s)
+        self.bus.gauge("autoscale.signal_ms", round(signal_ms, 3),
+                       spares=len(self._spares))
+        # hysteresis bookkeeping: how long has the signal been over the
+        # up bound / under the down bound, continuously
+        self._over_since = (None if signal_ms < self._up_ms
+                            else self._over_since
+                            if self._over_since is not None else now)
+        self._under_since = (None if signal_ms >= self._down_ms
+                             else self._under_since
+                             if self._under_since is not None else now)
+        if (self._over_since is not None
+                and now - self._over_since >= self._hold_s
+                and len(self._spares) < self._max_spares):
+            self._over_since = None  # one spawn per sustained crossing
+            if self._spawn_one(signal_ms):
+                return "up"
+            return None
+        if (self._under_since is not None
+                and now - self._under_since >= self._cooldown_s
+                and self._spares):
+            self._under_since = None  # one retire per sustained calm
+            if self._retire_one(reason="cooldown", signal_ms=signal_ms):
+                return "down"
+        return None
+
+    def _spawn_one(self, signal_ms: float) -> bool:
+        index = self._spawned_total
+        with self._lock:
+            self._spawning = True
+        try:
+            worker_id, url, handle, body = self._spawn(index)
+        except Exception as exc:
+            with self._lock:
+                self._spawn_failed += 1
+                self._spawning = False
+            log.error("autoscale: spare spawn #%d failed: %s: %s",
+                      index, type(exc).__name__, exc)
+            self.bus.counter("autoscale.spawn_failed",
+                            error=type(exc).__name__)
+            return False
+        try:
+            self._router.add_worker(worker_id, url)
+        except Exception as exc:
+            # router closed (or membership collision) while the spare
+            # was warming: the spare must not leak as an orphan process
+            with self._lock:
+                self._spawn_failed += 1
+                self._spawning = False
+            log.error("autoscale: could not add ready spare %s to the "
+                      "router (%s: %s); stopping it", worker_id,
+                      type(exc).__name__, exc)
+            self.bus.counter("autoscale.spawn_failed",
+                             error=type(exc).__name__)
+            try:
+                self._stop_spare(worker_id, handle)
+            except Exception:  # lint: allow-silent-except — best-effort teardown of a spare that never joined
+                pass
+            return False
+        with self._lock:
+            self._spares.append((worker_id, handle))
+            self._spawned_total += 1
+            self._spawning = False
+            n = len(self._spares)
+        log.warning("autoscale: spawned warm spare %s (queue wait "
+                    "%.1fms > %.1fms; %d spare(s) live; compiles=%s)",
+                    worker_id, signal_ms, self._up_ms, n,
+                    body.get("compiles"))
+        self.bus.counter("autoscale.spawned", worker=worker_id,
+                         compiles=body.get("compiles"),
+                         arena_warm=body.get("arena_warm"))
+        self.bus.gauge("autoscale.spares", n)
+        return True
+
+    def _retire_one(self, reason: str,
+                    signal_ms: float | None = None) -> bool:
+        with self._lock:
+            if not self._spares:
+                return False
+            worker_id, handle = self._spares.pop()  # LIFO: newest first
+            self._retired_total += 1
+            n = len(self._spares)
+        # membership first (the router requeues its queued custody and
+        # stops assigning), THEN the process drain
+        self._router.remove_worker(worker_id)
+        try:
+            self._stop_spare(worker_id, handle)
+        except Exception as exc:
+            log.error("autoscale: stopping retired spare %s raised "
+                      "%s: %s (membership already removed)", worker_id,
+                      type(exc).__name__, exc)
+        log.warning("autoscale: retired spare %s (%s%s; %d spare(s) "
+                    "remain)", worker_id, reason,
+                    "" if signal_ms is None else
+                    f", queue wait {signal_ms:.1f}ms < "
+                    f"{self._down_ms:.1f}ms", n)
+        self.bus.counter("autoscale.retired", worker=worker_id,
+                         reason=reason)
+        self.bus.gauge("autoscale.spares", n)
+        return True

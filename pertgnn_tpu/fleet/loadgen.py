@@ -1,0 +1,309 @@
+"""Open-loop trace-replay load generation: bursts, diurnals, Zipf.
+
+Everything that exercised the fleet before this module was CLOSED-LOOP:
+fleet_bench's client threads submit, WAIT for the answer, submit again
+— so the offered rate self-throttles to whatever the fleet can serve,
+and queueing collapse is structurally invisible (the canonical
+coordinated-omission trap). Real microservice front doors (the paper's
+own Alibaba-trace domain — PAPER.md) are open-loop: arrivals come when
+they come, and a fleet slower than its arrival process grows a queue.
+This module replays that arrival dynamic:
+
+- **schedule generation** (`generate_schedule`) is a PURE function of
+  (spec, request population, seed) — deterministic, so a chaos run is
+  reproducible arrival-for-arrival and the bench's reference
+  predictions line up index-for-index. The arrival process is a
+  non-homogeneous Poisson: per-millisecond-bin counts drawn at rate
+  ``base_rps x diurnal(t) x burst(t)``, where ``diurnal`` is a raised
+  sinusoid (amplitude ``diurnal_amp``, period ``diurnal_period_s`` —
+  the day compressed to bench scale) and ``burst`` multiplies the rate
+  by ``burst_factor`` during ``burst_len_s`` windows every
+  ``burst_every_s`` seconds (the flash-crowd mode the autoscaler and
+  the shed policy exist for).
+- **skewed popularity**: each arrival draws its (entry, ts_bucket)
+  request from a Zipf(``zipf_s``) law over a seeded permutation of the
+  real corpus — a few hot entries dominate, the tail stays warm, which
+  is exactly the regime that makes per-rung executable caches and
+  hedging interesting.
+- **SLO mix**: arrivals draw a class from ``slo_mix``
+  (fleet/shield.py vocabulary), so admission's
+  lowest-class-first shedding faces realistic mixed traffic.
+
+**Replay** (`replay`) submits each arrival at its scheduled time and
+does NOT wait — futures resolve through done-callbacks into
+preallocated result slots, so a drowning fleet shows up as queue growth
+and sheds, not as a politely slowed generator. The only throttle is
+physics: if the submitting thread falls behind the schedule the lag is
+measured and reported (``loadgen.lag_ms``), never silently absorbed.
+
+Telemetry (docs/OBSERVABILITY.md): gauges ``loadgen.offered_rps`` (per
+elapsed second: what was OFFERED, which under collapse exceeds what
+was served — the open-loop signature) and ``loadgen.lag_ms``; counters
+``loadgen.submitted`` / ``loadgen.shed``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import threading
+import time
+
+import numpy as np
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.fleet import shield
+from pertgnn_tpu.serve.errors import ServeError
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    """One open-loop load scenario. All times in seconds; the whole
+    schedule is deterministic given (spec, population, seed)."""
+
+    duration_s: float = 10.0
+    # Baseline offered rate (arrivals per second) before envelopes.
+    base_rps: float = 50.0
+    # Burst envelope: multiply the rate by `burst_factor` during
+    # windows of `burst_len_s` starting every `burst_every_s`.
+    # burst_every_s <= 0 or burst_factor <= 1 = no bursts.
+    burst_factor: float = 1.0
+    burst_every_s: float = 0.0
+    burst_len_s: float = 1.0
+    # Diurnal envelope: rate x (1 + amp * sin(2*pi*t/period)) — the
+    # day's load curve compressed to bench scale. amp in [0, 1).
+    diurnal_amp: float = 0.0
+    diurnal_period_s: float = 10.0
+    # Zipf popularity exponent over the request population (> 0; ~1.1
+    # matches web-trace skew). 0 = uniform.
+    zipf_s: float = 1.1
+    # (class name, weight) mix arrivals draw their SLO class from.
+    slo_mix: tuple = ((shield.SLO_CLASSES[0], 0.1),
+                      (shield.DEFAULT_CLASS, 0.3),
+                      (shield.BEST_EFFORT, 0.6))
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class Schedule:
+    """The materialized arrival schedule: parallel arrays, one row per
+    arrival, times as offsets from replay start."""
+
+    t: np.ndarray           # float64 seconds, non-decreasing
+    entry_ids: np.ndarray   # int64
+    ts_buckets: np.ndarray  # int64
+    slo: np.ndarray         # int8 index into shield.SLO_CLASSES
+
+    def __len__(self) -> int:
+        return len(self.t)
+
+    def slo_name(self, i: int) -> str:
+        return shield.SLO_CLASSES[int(self.slo[i])]
+
+
+def rate_at(spec: LoadSpec, t: float) -> float:
+    """Offered rate (rps) at offset `t` — base x diurnal x burst."""
+    rate = spec.base_rps
+    if spec.diurnal_amp > 0:
+        rate *= 1.0 + spec.diurnal_amp * math.sin(
+            2.0 * math.pi * t / max(spec.diurnal_period_s, 1e-9))
+    if spec.burst_every_s > 0 and spec.burst_factor > 1.0:
+        if (t % spec.burst_every_s) < spec.burst_len_s:
+            rate *= spec.burst_factor
+    return max(rate, 0.0)
+
+
+def _zipf_probs(n: int, s: float) -> np.ndarray:
+    if n <= 0:
+        raise ValueError("empty request population")
+    if s <= 0:
+        return np.full(n, 1.0 / n)
+    p = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** s
+    return p / p.sum()
+
+
+def generate_schedule(spec: LoadSpec, entries, ts_buckets) -> Schedule:
+    """The deterministic arrival schedule for one replay.
+
+    ``entries`` / ``ts_buckets`` are the request POPULATION (the real
+    corpus — e.g. every (entry, ts_bucket) pair of a split); arrivals
+    draw rows from it under the Zipf law over a seeded rank
+    permutation, so 'hot entry' is a property of the seed, not of
+    corpus order. Same (spec, population) -> bit-identical schedule
+    (pinned in tests/test_shield.py)."""
+    entries = np.asarray(entries, np.int64)
+    ts_buckets = np.asarray(ts_buckets, np.int64)
+    if len(entries) != len(ts_buckets):
+        raise ValueError("entries / ts_buckets length mismatch")
+    rng = np.random.default_rng(spec.seed)
+    # arrivals: per-1ms-bin Poisson counts at the envelope rate,
+    # uniform placement within each bin (thinning-free and exact
+    # enough at bench scale)
+    bin_s = 1e-3
+    n_bins = max(int(round(spec.duration_s / bin_s)), 1)
+    t_bins = np.arange(n_bins) * bin_s
+    rates = np.asarray([rate_at(spec, t) for t in t_bins])
+    counts = rng.poisson(rates * bin_s)
+    n = int(counts.sum())
+    t = np.repeat(t_bins, counts) + rng.random(n) * bin_s
+    t.sort(kind="stable")
+    # popularity: Zipf over a seeded rank permutation of the population
+    rank_of = rng.permutation(len(entries))
+    probs = _zipf_probs(len(entries), spec.zipf_s)
+    pop_idx = rank_of[rng.choice(len(entries), size=n, p=probs)]
+    # SLO mix
+    names = [c for c, _w in spec.slo_mix]
+    for c in names:
+        shield.class_priority(c)  # typo'd class fails at build time
+    weights = np.asarray([w for _c, w in spec.slo_mix], np.float64)
+    if weights.sum() <= 0:
+        raise ValueError("slo_mix weights must sum > 0")
+    slo_of_mix = rng.choice(len(names), size=n, p=weights / weights.sum())
+    slo = np.asarray([shield.class_priority(names[i])
+                      for i in slo_of_mix], np.int8)
+    return Schedule(t=t, entry_ids=entries[pop_idx],
+                    ts_buckets=ts_buckets[pop_idx], slo=slo)
+
+
+@dataclasses.dataclass
+class ReplayResult:
+    """Per-arrival outcomes of one open-loop replay — index-aligned
+    with the schedule, so the bench's reference predictions compare
+    row-for-row. Every scheduled arrival lands in exactly one bucket:
+    a prediction (``preds[i]`` finite), or a typed error name
+    (``errors[i]``) — a row with neither is a LOST FUTURE, the thing
+    benchmarks/tail_bench.py exit-code-asserts never happens."""
+
+    preds: np.ndarray            # float32, NaN where no prediction
+    errors: list                 # per-row typed error name or None
+    latency_ms: np.ndarray       # submit -> resolution, NaN where shed
+    lag_ms: np.ndarray           # actual submit - scheduled time
+    offered: int = 0
+    submitted: int = 0
+    unresolved: int = 0          # futures still pending at wait timeout
+
+    def lost_futures(self) -> int:
+        """Rows with neither a prediction nor a typed error — must be
+        zero (the ALWAYS-resolves contract, measured end to end)."""
+        return int(sum(1 for p, e in zip(self.preds, self.errors)
+                       if not np.isfinite(p) and e is None))
+
+    def error_counts(self) -> dict:
+        out: dict[str, int] = {}
+        for e in self.errors:
+            if e is not None:
+                out[e] = out.get(e, 0) + 1
+        return out
+
+    def latency_summary_by_class(self, schedule: Schedule) -> dict:
+        """Served-latency percentiles per SLO class (the bench's
+        bounded-p99-for-the-top-class gate reads this)."""
+        out: dict[str, dict] = {}
+        for ci, cname in enumerate(shield.SLO_CLASSES):
+            mask = (schedule.slo == ci) & np.isfinite(self.latency_ms)
+            lat = np.sort(self.latency_ms[mask])
+            if len(lat) == 0:
+                out[cname] = {"count": 0}
+                continue
+            out[cname] = {
+                "count": int(len(lat)),
+                "p50_ms": float(np.percentile(lat, 50)),
+                "p99_ms": float(np.percentile(lat, 99)),
+                "p99_9_ms": float(np.percentile(lat, 99.9)),
+                "max_ms": float(lat[-1]),
+            }
+        return out
+
+
+def replay(submit, schedule: Schedule, *, bus=None,
+           wait_timeout_s: float = 300.0) -> ReplayResult:
+    """Drive one open-loop replay against a router-shaped front door.
+
+    ``submit(entry_id, ts_bucket, slo=<class name>) -> Future`` is the
+    FleetRouter/MicrobatchQueue contract: it may raise a typed
+    ServeError at admission (recorded as that arrival's outcome) and
+    its Future always resolves. The caller's thread is the injector:
+    it sleeps to each arrival's scheduled time, submits, attaches a
+    done-callback, and moves on — it NEVER waits on a result
+    mid-schedule (open loop). After the last arrival it waits out the
+    in-flight tail (bounded by `wait_timeout_s`; stragglers are
+    counted `unresolved`, and an unresolved future is a finding)."""
+    bus = bus if bus is not None else telemetry.get_bus()
+    n = len(schedule)
+    preds = np.full(n, np.nan, np.float32)
+    errors: list = [None] * n
+    latency_ms = np.full(n, np.nan, np.float64)
+    lag_ms = np.zeros(n, np.float64)
+    outstanding = [0]
+    count_lock = threading.Lock()
+    submitted = 0
+
+    def on_done(i: int, t_submit: float, fut) -> None:
+        t_now = time.perf_counter()
+        exc = fut.exception()
+        if exc is None:
+            preds[i] = fut.result()
+            latency_ms[i] = (t_now - t_submit) * 1e3
+        else:
+            errors[i] = type(exc).__name__
+        with count_lock:
+            outstanding[0] -= 1
+
+    t0 = time.perf_counter()
+    next_second = 1.0
+    offered_in_second = 0
+    for i in range(n):
+        t_sched = float(schedule.t[i])
+        delay = t_sched - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        now_rel = time.perf_counter() - t0
+        lag_ms[i] = max(now_rel - t_sched, 0.0) * 1e3
+        offered_in_second += 1
+        if now_rel >= next_second:
+            bus.gauge("loadgen.offered_rps", offered_in_second,
+                      second=int(next_second))
+            next_second += 1.0
+            offered_in_second = 0
+        t_submit = time.perf_counter()
+        try:
+            fut = submit(int(schedule.entry_ids[i]),
+                         int(schedule.ts_buckets[i]),
+                         slo=schedule.slo_name(i))
+        except ServeError as exc:
+            # an admission reject IS this arrival's outcome (shed at
+            # the door — open loop means we record it and keep going)
+            errors[i] = type(exc).__name__
+            bus.counter("loadgen.shed", level=2,
+                        error=type(exc).__name__)
+            continue
+        submitted += 1
+        with count_lock:
+            outstanding[0] += 1
+        fut.add_done_callback(
+            lambda f, i=i, ts=t_submit: on_done(i, ts, f))
+    bus.counter("loadgen.submitted", submitted)
+    # wait out the in-flight tail (bounded): poll the outstanding
+    # count — callbacks resolve on other threads
+    deadline = time.monotonic() + wait_timeout_s
+    while time.monotonic() < deadline:
+        with count_lock:
+            left = outstanding[0]
+        if left == 0:
+            break
+        time.sleep(0.02)
+    with count_lock:
+        unresolved = outstanding[0]
+    if unresolved:
+        log.error("loadgen: %d future(s) unresolved after %.0fs tail "
+                  "wait — a lost-future finding", unresolved,
+                  wait_timeout_s)
+    bus.gauge("loadgen.lag_ms", float(lag_ms.max()) if n else 0.0,
+              mean=float(lag_ms.mean()) if n else 0.0)
+    return ReplayResult(preds=preds, errors=errors,
+                        latency_ms=latency_ms, lag_ms=lag_ms,
+                        offered=n, submitted=submitted,
+                        unresolved=unresolved)

@@ -36,6 +36,7 @@ invariant tests/test_fleet.py pins across multi-loss interleavings.
 from __future__ import annotations
 
 import dataclasses
+import math
 
 # Conservative prior for a worker that has never reported a batch
 # latency (fresh member): pessimistic enough that the first few
@@ -68,19 +69,74 @@ def predicted_completion_s(w: WorkerView) -> float:
     return (w.inflight_batches + 1) * max(w.ewma_batch_s, 1e-6)
 
 
-def choose_worker(workers) -> WorkerView | None:
+def choose_worker(workers, exclude=frozenset()) -> WorkerView | None:
     """The healthy, non-saturated worker with the earliest predicted
     completion; ties break on fewer in-flight requests then worker_id
     (total order — dispatch is deterministic given the same views).
     None when every healthy worker is at its slot capacity (the router
     waits for a completion) or no worker is healthy (the router waits
-    for membership to recover, or drains on close)."""
+    for membership to recover, or drains on close).
+
+    ``exclude`` is the retry-exclusion set (the rollout's excluded-slot
+    pattern applied to dispatch): a batch recovered from a lost worker
+    carries that worker's id, so a FLAPPING worker — lost on transport,
+    re-admitted by the next probe — cannot eat the same request twice.
+    The caller falls back to an exclusion-free choice when exclusion
+    leaves nobody (one surviving-but-flapping worker still beats
+    failing the request outright)."""
     eligible = [w for w in workers
-                if w.healthy and w.inflight_batches < w.slots]
+                if w.healthy and w.inflight_batches < w.slots
+                and w.worker_id not in exclude]
     if not eligible:
         return None
     return min(eligible, key=lambda w: (predicted_completion_s(w),
                                         w.inflight_requests, w.worker_id))
+
+
+def choose_hedge_worker(workers, exclude=frozenset()) -> WorkerView | None:
+    """The second-opinion worker for a hedged re-dispatch: healthy, not
+    the primary (``exclude``), earliest predicted completion. A hedge
+    may use ONE slot past the worker's cap (`slots + 1`): hedges exist
+    to cut tail latency, and refusing every hedge whenever the fleet is
+    busy — exactly when stragglers appear — would disable the mechanism
+    at the moment it pays; the +1 bound still prevents hedge pile-up."""
+    eligible = [w for w in workers
+                if w.healthy and w.worker_id not in exclude
+                and w.inflight_batches < w.slots + 1]
+    if not eligible:
+        return None
+    return min(eligible, key=lambda w: (predicted_completion_s(w),
+                                        w.inflight_requests, w.worker_id))
+
+
+# Adaptive hedging needs a latency distribution before it can pick a
+# quantile; below this many completed batches the threshold is +inf
+# (hedge nothing) rather than a guess off two samples.
+HEDGE_MIN_SAMPLES = 20
+
+
+def hedge_threshold_s(fixed_ms: float, quantile: float,
+                      recent_batch_s) -> float:
+    """Seconds a dispatched batch may run before the router hedges it.
+
+    ``fixed_ms`` > 0 wins (an explicit --hedge_quantile_ms operator
+    override); else ``quantile`` in (0, 1) adapts the threshold to the
+    observed per-batch round-trip distribution (``recent_batch_s``, a
+    recency window of completed-batch wall times): hedge whatever runs
+    past the rolling q-quantile. Returns +inf (never hedge) when
+    neither is configured or the sample set is still too small —
+    hedging must not fire off noise."""
+    if fixed_ms > 0:
+        return fixed_ms / 1e3
+    if not 0.0 < quantile < 1.0:
+        return math.inf
+    samples = sorted(recent_batch_s)
+    if len(samples) < HEDGE_MIN_SAMPLES:
+        return math.inf
+    pos = quantile * (len(samples) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(samples) - 1)
+    return samples[lo] + (samples[hi] - samples[lo]) * (pos - lo)
 
 
 def deadline_infeasible(workers, now: float, deadline_abs: float) -> bool:

@@ -15,9 +15,13 @@ Threads (all daemon, all owned by the router):
 - **dispatcher** — coalesces pending requests under the router flush
   deadline into microbatches (submission-order prefix, same capacity
   discipline as the single-process queue), picks a worker via
-  ``policy.choose_worker``, and hands the batch to that worker's
-  sender. Blocks — never drops — when every healthy worker is at its
-  slot capacity.
+  ``policy.choose_worker`` (excluding workers a retried batch already
+  failed on — the rollout's excluded-slot pattern, so a FLAPPING worker
+  cannot eat the same request twice), and hands the batch to that
+  worker's sender. Blocks — never drops — when every healthy worker is
+  at its slot capacity. Also drives the BROWNOUT state machine
+  (fleet/shield.py): past the pending-occupancy threshold, best-effort
+  requests are marked for rung DOWNGRADE before anyone is shed.
 - **one sender per worker** — performs the blocking HTTP dispatch and
   settles futures. A transport-level failure is the lost-worker
   signature: the batch (plus anything still queued for that worker)
@@ -28,6 +32,22 @@ Threads (all daemon, all owned by the router):
   probe failures exclude, the first success re-admits. Recovery is
   symmetric with loss — a re-admitted worker starts taking traffic on
   the next dispatch decision.
+- **hedger** (when hedging is configured) — scans in-flight batches;
+  one still running past the hedge threshold (``hedge_quantile_ms``
+  fixed, or the rolling ``hedge_quantile`` of recent batch round
+  trips) is RE-DISPATCHED to a second worker. First answer wins and
+  settles the futures; the loser is ignored (predictions are
+  deterministic, so hedging is bit-safe — benchmarks/tail_bench.py
+  exit-code-asserts hedge winners stay bit-identical to the
+  reference). Counters ``router.hedge_fired`` / ``router.hedge_won``;
+  the transport trace spans tag ``hedged`` / ``hedge_won`` /
+  ``outcome="hedge_lost"`` so graftscope shows what hedging bought.
+
+SLO classes (fleet/shield.py) ride each request: at a full pending set
+admission sheds LOWEST-CLASS-FIRST — a higher-class arrival evicts the
+newest queued request of the lowest class present (its Future resolves
+with the typed ``Shed``; never a lost Future), otherwise the arrival
+itself is shed. Counter ``router.shed_by_class`` (tags slo, mode).
 
 Deadline awareness happens at three points: AT THE DOOR (a request no
 worker's predicted completion could meet is shed immediately with
@@ -43,10 +63,18 @@ a requeued request's prediction is bit-identical wherever it lands —
 benchmarks/fleet_bench.py exit-code-asserts exactly that under a
 mid-traffic SIGKILL.
 
+Elastic membership: ``add_worker`` / ``remove_worker`` grow and shrink
+the fleet live (counters ``router.worker_added`` /
+``router.worker_removed``) — what the autoscale controller
+(fleet/autoscale.py) drives off ``queue_wait_signal_ms()``, the rolling
+window over the ``router.queue_wait`` gauge.
+
 Telemetry (docs/OBSERVABILITY.md): counters ``router.dispatch`` /
 ``router.requeue`` / ``router.worker_lost`` / ``router.worker_recovered``
-/ ``router.shed`` / ``router.shed_infeasible`` /
-``router.deadline_exceeded``, gauges ``router.members`` /
+/ ``router.worker_added`` / ``router.worker_removed`` / ``router.shed``
+/ ``router.shed_by_class`` / ``router.shed_infeasible`` /
+``router.deadline_exceeded`` / ``router.hedge_fired`` /
+``router.hedge_won`` / ``router.brownout``, gauges ``router.members`` /
 ``router.queue_wait`` (admission->dispatch wait — the autoscale
 signal), histograms ``router.batch_ms`` / ``router.request_total_ms``.
 
@@ -62,6 +90,7 @@ tracing").
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import math
@@ -72,20 +101,22 @@ from concurrent.futures import Future
 
 from pertgnn_tpu import telemetry
 from pertgnn_tpu.config import FleetConfig
-from pertgnn_tpu.fleet import policy
+from pertgnn_tpu.fleet import policy, shield
 from pertgnn_tpu.telemetry.tracing import new_span_id
 from pertgnn_tpu.fleet.transport import (WorkerTransportError,
                                          error_from_row, get_probe,
                                          post_predict)
 from pertgnn_tpu.serve.errors import (DeadlineExceeded, QueueClosed,
-                                      QueueFull)
+                                      Shed)
 
 log = logging.getLogger(__name__)
 
 # Worker-reported per-request failures the router retries ELSEWHERE
-# instead of propagating: all three mean "this worker cannot take it
-# right now", none of them is a verdict about the request itself.
-RETRYABLE_ROWS = ("QueueClosed", "QueueFull", "EngineUnhealthy")
+# instead of propagating: all of them mean "this worker cannot take it
+# right now", none of them is a verdict about the request itself (Shed
+# is a worker-local admission verdict — another worker's queue may
+# have room).
+RETRYABLE_ROWS = ("QueueClosed", "QueueFull", "Shed", "EngineUnhealthy")
 
 
 @dataclasses.dataclass
@@ -98,7 +129,15 @@ class _Request:
     arrival: float
     deadline_abs: float
     future: Future
+    slo: str = shield.DEFAULT_CLASS
+    # brownout verdict, stamped at dispatch: the worker serves this
+    # request through its cheapest ladder rung (fleet/shield.py)
+    downgrade: bool = False
     requeues: int = 0
+    # workers this request already FAILED on (transport loss): the
+    # retry excludes them so a flapping worker cannot eat the same
+    # request twice (the rollout's excluded-slot pattern)
+    excluded: tuple = ()
     # distributed tracing (telemetry/tracing.py): the head-sampled
     # TraceContext (None = untraced) and the submit stamp on the
     # CLOCK_MONOTONIC clock graftscope aligns across processes
@@ -108,6 +147,28 @@ class _Request:
     # requeue resets it) — each dispatch attempt gets its own
     # trace.router_queue span instead of overlapping the first
     tm_queue_start: float = 0.0
+
+
+class _Flight:
+    """One dispatched microbatch's shared custody between its primary
+    sender and (at most one) hedge sender. All fields are guarded by
+    the router lock; ``settled`` is the first-answer-wins latch —
+    whichever leg flips it owns the batch's futures, the other leg's
+    answer (or failure) is ignored. ``legs`` counts in-flight legs so
+    loss handling knows when NOBODY owns the batch anymore (only then
+    does it requeue)."""
+
+    __slots__ = ("batch", "primary_id", "hedge_id", "t_dispatch",
+                 "settled", "legs")
+
+    def __init__(self, batch: list[_Request], primary_id: str,
+                 t_dispatch: float):
+        self.batch = batch
+        self.primary_id = primary_id
+        self.hedge_id: str | None = None
+        self.t_dispatch = t_dispatch
+        self.settled = False
+        self.legs = 1
 
 
 class _Worker:
@@ -127,7 +188,7 @@ class _Worker:
         self.probe_failures = 0
         self.dispatches = 0
         self.lost_count = 0
-        # assigned-but-not-yet-sent batches; the sender thread blocks
+        # assigned-but-not-yet-sent flights; the sender thread blocks
         # on this queue (None = shut down)
         self.sender_q: stdlib_queue.SimpleQueue = stdlib_queue.SimpleQueue()
 
@@ -147,13 +208,18 @@ class FleetRouter:
     the dataset's mixture sizes — the same capacity accounting the
     single-process queue uses); ``capacity`` is the per-microbatch
     (max_graphs, max_nodes, max_edges) ceiling, normally the workers'
-    top ladder rung."""
+    top ladder rung. ``transport_post`` / ``transport_probe`` are the
+    wire functions, injectable so the hedging race and the retry
+    exclusion are unit-testable with no sockets (tests/test_shield.py)."""
 
     def __init__(self, workers: dict[str, str], request_size,
                  capacity: tuple[int, int, int],
-                 cfg: FleetConfig | None = None, bus=None):
+                 cfg: FleetConfig | None = None, bus=None,
+                 transport_post=post_predict, transport_probe=get_probe):
         self._cfg = cfg = cfg or FleetConfig()
         self._injected_bus = bus
+        self._post = transport_post
+        self._probe = transport_probe
         self._request_size = request_size
         self._max_graphs, self._max_nodes, self._max_edges = capacity
         self._flush_s = cfg.router_flush_deadline_ms / 1e3
@@ -170,17 +236,35 @@ class FleetRouter:
         self._seq = 0
         self._closed = False
         self._stop_probe = threading.Event()
+        # in-flight microbatches (hedging scans these); legs accounting
+        # is the close-drain condition, robust to removed workers
+        self._flights: set[_Flight] = set()
+        self._inflight_legs = 0
+        # recent completed-batch round trips (adaptive hedge threshold)
+        self._batch_s_recent: collections.deque = collections.deque(
+            maxlen=256)
+        # recent (t, queue_wait_ms) — the autoscale signal window
+        self._qwait_recent: collections.deque = collections.deque(
+            maxlen=512)
+        # brownout state (fleet/shield.py)
+        self._brownout = False
+        self._brownout_since = 0.0
         # counters mirrored to the bus (router.* names)
         self.dispatched_batches = 0
         self.dispatched_requests = 0
         self.requeues = 0
         self.worker_lost = 0
         self.worker_recovered = 0
+        self.worker_added = 0
+        self.worker_removed = 0
         self.shed = 0
         self.shed_infeasible = 0
         self.deadline_exceeded = 0
+        self.hedge_fired = 0
+        self.hedge_won = 0
         self.served = 0
         self.failed = 0
+        self.shed_by_class: collections.Counter = collections.Counter()
         self._senders = [
             threading.Thread(target=self._sender_loop, args=(w,),
                              daemon=True, name=f"router-send-{wid}")
@@ -194,6 +278,12 @@ class FleetRouter:
         self._prober = threading.Thread(target=self._probe_loop,
                                         daemon=True, name="router-probe")
         self._prober.start()
+        self._hedger = None
+        if cfg.hedge_quantile_ms > 0 or 0.0 < cfg.hedge_quantile < 1.0:
+            self._hedger = threading.Thread(target=self._hedge_loop,
+                                            daemon=True,
+                                            name="router-hedge")
+            self._hedger.start()
         self.bus.gauge("router.members", len(self._workers),
                        total=len(self._workers))
 
@@ -205,11 +295,16 @@ class FleetRouter:
             return self._injected_bus
         return telemetry.get_bus()
 
-    def submit(self, entry_id: int, ts_bucket: int) -> Future:
+    def submit(self, entry_id: int, ts_bucket: int,
+               slo: str | None = None) -> Future:
         """Enqueue one request; the Future resolves to its prediction
-        or a typed serve error. Raises QueueClosed / QueueFull /
-        DeadlineExceeded (door shed) at admission."""
+        or a typed serve error. Raises QueueClosed / Shed /
+        DeadlineExceeded (door shed) at admission. ``slo`` is the
+        request's SLO class (fleet/shield.py; default "standard") — at
+        a full pending set admission sheds lowest-class-first."""
         eid = int(entry_id)
+        slo_cls = shield.DEFAULT_CLASS if slo is None else slo
+        shield.class_priority(slo_cls)  # unknown class fails the caller
         # size it NOW so an unknown entry fails the caller, not the
         # dispatcher (same placement as the single-process queue)
         self._request_size(eid)
@@ -220,15 +315,41 @@ class FleetRouter:
         ctx = self.bus.start_trace()
         tm_submit = time.monotonic() if ctx is not None else 0.0
         counter = reject = None
+        lowest_queued = slo_cls
+        evicted: _Request | None = None
         with self._wake:
             if self._closed:
                 reject = QueueClosed("FleetRouter is closed")
             elif len(self._pending) >= self._cfg.max_pending:
-                self.shed += 1
-                counter = "router.shed"
-                reject = QueueFull(
-                    f"router pending set is at "
-                    f"max_pending={self._cfg.max_pending}; request shed")
+                pending_classes = [r.slo for r in self._pending]
+                victim_i = shield.shed_victim_index(pending_classes,
+                                                    slo_cls)
+                if victim_i is None:
+                    self.shed += 1
+                    self.shed_by_class[slo_cls] += 1
+                    counter = "router.shed"
+                    # the lowest-priority class occupying the queue at
+                    # the moment of rejection: the end-to-end evidence
+                    # that lowest-class-first held (a critical reject
+                    # is legitimate ONLY when the queue held nothing
+                    # lower — tail_bench gates on this tag)
+                    lowest_queued = max(
+                        pending_classes, key=shield.class_priority,
+                        default=slo_cls)
+                    reject = Shed(
+                        f"router pending set is at "
+                        f"max_pending={self._cfg.max_pending}; "
+                        f"{slo_cls} request shed", slo=slo_cls)
+                else:
+                    # lowest-class-first: evict the newest queued
+                    # request of the lowest class present to admit
+                    # this higher-class arrival (resolved below,
+                    # OUTSIDE the lock)
+                    evicted = self._pending.pop(victim_i)
+                    self.shed += 1
+                    self.shed_by_class[evicted.slo] += 1
+                    self._admit_locked(eid, ts_bucket, fut, ctx,
+                                       tm_submit, slo_cls)
             else:
                 now = time.perf_counter()
                 deadline = (now + self._deadline_s
@@ -243,26 +364,65 @@ class FleetRouter:
                         f"completion meets the "
                         f"{self._cfg.request_deadline_ms:g}ms deadline")
                 else:
-                    self._pending.append(_Request(
-                        seq=self._seq, entry_id=eid,
-                        ts_bucket=int(ts_bucket), arrival=now,
-                        deadline_abs=deadline, future=fut,
-                        trace=ctx, tm_submit=tm_submit,
-                        tm_queue_start=tm_submit))
-                    self._seq += 1
-                    self._wake.notify_all()
+                    self._admit_locked(eid, ts_bucket, fut, ctx,
+                                       tm_submit, slo_cls,
+                                       deadline=deadline, now=now)
+        if evicted is not None:
+            self.bus.counter("router.shed", entry_id=evicted.entry_id)
+            self.bus.counter("router.shed_by_class", slo=evicted.slo,
+                             mode="evict", entry_id=evicted.entry_id)
+            self._resolve_error(evicted, Shed(
+                f"evicted at admission: a {slo_cls} arrival outranked "
+                f"this queued {evicted.slo} request at "
+                f"max_pending={self._cfg.max_pending}",
+                slo=evicted.slo))
         if reject is not None:
             # bus emission outside the lock — the shed fast path fires
             # exactly when everything contends for this lock
             if counter is not None:
                 self.bus.counter(counter, entry_id=eid)
+            if isinstance(reject, Shed):
+                self.bus.counter("router.shed_by_class", slo=slo_cls,
+                                 mode="reject", entry_id=eid,
+                                 lowest_queued=lowest_queued)
             raise reject
         return fut
 
+    def _admit_locked(self, eid: int, ts_bucket: int, fut: Future, ctx,
+                      tm_submit: float, slo_cls: str,
+                      deadline: float | None = None,
+                      now: float | None = None) -> None:
+        if now is None:
+            now = time.perf_counter()
+        if deadline is None:
+            deadline = (now + self._deadline_s
+                        if self._deadline_s > 0 else math.inf)
+        self._pending.append(_Request(
+            seq=self._seq, entry_id=eid, ts_bucket=int(ts_bucket),
+            arrival=now, deadline_abs=deadline, future=fut, slo=slo_cls,
+            trace=ctx, tm_submit=tm_submit, tm_queue_start=tm_submit))
+        self._seq += 1
+        self._wake.notify_all()
+
     def predict(self, entry_id: int, ts_bucket: int,
-                timeout: float | None = None) -> float:
+                timeout: float | None = None,
+                slo: str | None = None) -> float:
         """Blocking convenience (same shape as MicrobatchQueue.predict)."""
-        return float(self.submit(entry_id, ts_bucket).result(timeout))
+        return float(self.submit(entry_id, ts_bucket,
+                                 slo=slo).result(timeout))
+
+    def queue_wait_signal_ms(self, window_s: float = 2.0) -> float:
+        """Max ``router.queue_wait`` over the last `window_s` seconds —
+        THE autoscale signal (fleet/autoscale.py): how long the oldest
+        request of recent batches sat between admission and dispatch.
+        0.0 when nothing dispatched recently (an idle fleet is a calm
+        fleet)."""
+        cutoff = time.perf_counter() - window_s
+        with self._lock:
+            while self._qwait_recent and self._qwait_recent[0][0] < cutoff:
+                self._qwait_recent.popleft()
+            return max((ms for _t, ms in self._qwait_recent),
+                       default=0.0)
 
     def stats_dict(self) -> dict:
         with self._lock:
@@ -282,18 +442,94 @@ class FleetRouter:
                 "requeues": self.requeues,
                 "worker_lost": self.worker_lost,
                 "worker_recovered": self.worker_recovered,
+                "worker_added": self.worker_added,
+                "worker_removed": self.worker_removed,
                 "shed": self.shed,
+                "shed_by_class": dict(self.shed_by_class),
                 "shed_infeasible": self.shed_infeasible,
                 "deadline_exceeded": self.deadline_exceeded,
+                "hedge_fired": self.hedge_fired,
+                "hedge_won": self.hedge_won,
+                "brownout_active": self._brownout,
                 "served": self.served,
                 "failed": self.failed,
                 "pending": len(self._pending),
             }
 
+    # -- elastic membership (fleet/autoscale.py drives these) ------------
+
+    def add_worker(self, worker_id: str, base_url: str) -> None:
+        """Grow the fleet live: the new member takes traffic on the
+        next dispatch decision. The caller is responsible for the
+        worker being READY (probe 200) — the autoscale controller
+        verifies readiness before adding, so a cold spare never eats
+        traffic it cannot serve."""
+        with self._wake:
+            if self._closed:
+                raise QueueClosed("FleetRouter is closed")
+            if worker_id in self._workers:
+                raise ValueError(f"worker {worker_id!r} already a member")
+            w = _Worker(worker_id, base_url, self._cfg.worker_slots)
+            self._workers[worker_id] = w
+            t = threading.Thread(target=self._sender_loop, args=(w,),
+                                 daemon=True,
+                                 name=f"router-send-{worker_id}")
+            self._senders.append(t)
+            self.worker_added += 1
+            members = sum(x.healthy for x in self._workers.values())
+            self._wake.notify_all()
+        t.start()
+        log.info("router: worker %s added (%d members)", worker_id,
+                 members)
+        self.bus.counter("router.worker_added", worker=worker_id)
+        self.bus.gauge("router.members", members,
+                       total=len(self._workers))
+
+    def remove_worker(self, worker_id: str) -> None:
+        """Shrink the fleet live (the autoscale retire path): the
+        member stops receiving new batches NOW, its queued-but-unsent
+        custody moves back to the pending queue (no requeue-budget
+        charge — retirement is not the request's fault), in-flight
+        legs settle normally through their sender, and the sender
+        thread exits. Idempotent for unknown ids."""
+        recovered: list[_Request] = []
+        with self._wake:
+            w = self._workers.pop(worker_id, None)
+            if w is None:
+                return
+            self.worker_removed += 1
+            while True:
+                try:
+                    queued = w.sender_q.get_nowait()
+                except stdlib_queue.Empty:
+                    break
+                if queued is None:
+                    continue  # close() raced; sentinel re-sent below
+                self._release_leg_locked(w, queued)
+                if queued.settled:
+                    continue
+                if queued.legs == 0:
+                    queued.settled = True
+                    recovered.extend(queued.batch)
+            w.sender_q.put(None)
+            if recovered:
+                self._pending[:] = policy.merge_requeue(self._pending,
+                                                        recovered)
+            members = sum(x.healthy for x in self._workers.values())
+            self._wake.notify_all()
+        log.info("router: worker %s removed (%d members, %d request(s) "
+                 "moved back)", worker_id, members, len(recovered))
+        self.bus.counter("router.worker_removed", worker=worker_id)
+        if recovered:
+            self.bus.counter("router.requeue", len(recovered),
+                             worker=worker_id, reason="worker_retired")
+        self.bus.gauge("router.members", members,
+                       total=len(self._workers))
+
     def close(self) -> None:
         """Stop admissions, dispatch everything already admitted (the
         dispatcher exits only once the pending set AND every in-flight
-        batch have settled), then stop the threads. Any future the
+        leg have settled), then stop the threads. Any future the
         drain could not place (e.g. the whole fleet died) resolves
         with QueueClosed — never a hang. Idempotent."""
         with self._wake:
@@ -308,6 +544,8 @@ class FleetRouter:
         for t in self._senders:
             t.join(timeout=self._timeout_s + 10.0)
         self._prober.join(timeout=5.0)
+        if self._hedger is not None:
+            self._hedger.join(timeout=5.0)
         # backstop for the ALWAYS-resolves invariant: nothing should be
         # left, but a future must never outlive the router unresolved
         with self._lock:
@@ -328,7 +566,7 @@ class FleetRouter:
     # -- dispatcher ------------------------------------------------------
 
     def _total_inflight_locked(self) -> int:
-        return sum(w.inflight_batches for w in self._workers.values())
+        return self._inflight_legs
 
     def _full_locked(self) -> bool:
         g = n = e = 0
@@ -379,10 +617,29 @@ class FleetRouter:
                 f"{self._cfg.request_deadline_ms:g}ms deadline without "
                 f"being dispatched"))
 
+    def _brownout_tick_locked(self, now: float) -> str | None:
+        """One brownout state-machine step off the current pending
+        occupancy (fleet/shield.py). Returns the transition event for
+        the caller to emit OUTSIDE the lock."""
+        cfg = self._cfg
+        if cfg.brownout_enter_ratio <= 0:
+            return None
+        occupancy = len(self._pending) / max(cfg.max_pending, 1)
+        active, event = shield.brownout_transition(
+            self._brownout, occupancy, now, self._brownout_since,
+            enter_ratio=cfg.brownout_enter_ratio,
+            exit_ratio=shield.resolve_exit_ratio(
+                cfg.brownout_enter_ratio, cfg.brownout_exit_ratio))
+        if event is not None:
+            self._brownout = active
+            self._brownout_since = now
+        return event
+
     def _dispatch_loop(self) -> None:
         while True:
             expired: list[_Request] = []
             batch: list[_Request] = []
+            brownout_event = None
             with self._wake:
                 while not self._pending and not (
                         self._closed
@@ -410,10 +667,30 @@ class FleetRouter:
                     self._wake.wait(timeout=max(t_wake - now, 0.0))
                 now = time.perf_counter()
                 expired += self._pop_expired_locked(now)
+                brownout_event = self._brownout_tick_locked(now)
                 if self._pending and (
                         self._closed or self._full_locked()
                         or now >= self._pending[0].arrival + self._flush_s):
                     batch = self._take_batch_locked()
+                    # the brownout verdict, stamped UNCONDITIONALLY at
+                    # dispatch (freshest pressure picture): best-effort
+                    # requests ride the wire with dg=True under
+                    # brownout — and a requeued request stamped during
+                    # a PAST brownout is un-stamped here once the mode
+                    # exits, so a stale verdict never outlives the
+                    # pressure that justified it
+                    for r in batch:
+                        r.downgrade = (self._brownout
+                                       and r.slo == shield.BEST_EFFORT)
+            if brownout_event is not None:
+                log.warning("router: brownout %s (pending occupancy "
+                            "crossed the configured threshold — "
+                            "best-effort traffic %s rung-downgraded)",
+                            brownout_event,
+                            "now" if brownout_event == "enter"
+                            else "no longer")
+                self.bus.counter("router.brownout",
+                                 event=brownout_event)
             self._fail_expired(expired)
             if batch:
                 self._assign(batch)
@@ -423,8 +700,13 @@ class FleetRouter:
         """Place one microbatch on the least-loaded worker; blocks while
         every healthy worker is slot-saturated (senders notify on
         completion). Requests can still expire while waiting — a
-        deadline is a dispatch deadline."""
+        deadline is a dispatch deadline. Workers a retried request
+        already failed on are EXCLUDED from the choice (falling back to
+        ignoring exclusions only when they leave nobody — one
+        surviving-but-flapping worker still beats failing the
+        request)."""
         target: _Worker | None = None
+        flight: _Flight | None = None
         while True:
             expired: list[_Request] = []
             fleet_dead = False
@@ -434,15 +716,24 @@ class FleetRouter:
                     expired = [r for r in batch if r.deadline_abs <= now]
                     batch = [r for r in batch if r.deadline_abs > now]
                 if batch:
-                    view = policy.choose_worker(
-                        [w.view() for w in self._workers.values()])
+                    views = [w.view() for w in self._workers.values()]
+                    exclude = frozenset().union(
+                        *[frozenset(r.excluded) for r in batch])
+                    view = policy.choose_worker(views, exclude)
+                    if view is None and exclude:
+                        view = policy.choose_worker(views)
                     if view is not None:
                         target = self._workers[view.worker_id]
+                        flight = _Flight(batch, target.worker_id, now)
+                        self._flights.add(flight)
+                        self._inflight_legs += 1
                         target.inflight_batches += 1
                         target.inflight_requests += len(batch)
                         target.dispatches += 1
                         self.dispatched_batches += 1
                         self.dispatched_requests += len(batch)
+                        self._qwait_recent.append(
+                            (now, (now - batch[0].arrival) * 1e3))
                     elif (self._closed and not any(
                             w.healthy for w in self._workers.values())):
                         # close-drain with a fully dead fleet: there is
@@ -483,17 +774,115 @@ class FleetRouter:
                             r.tm_queue_start, tm_now,
                             worker=target.worker_id,
                             attempt=r.requeues)
-                target.sender_q.put(batch)
-                return
+                with self._wake:
+                    # the handoff must be atomic with membership:
+                    # remove_worker drains the sender queue and sends
+                    # the exit sentinel under this lock — a flight put
+                    # AFTER the sentinel would never be consumed (its
+                    # futures never resolve, close() hangs on the leg
+                    # count). If the worker retired in the gap, undo
+                    # the leg accounting and re-choose.
+                    if self._workers.get(target.worker_id) is target:
+                        target.sender_q.put(flight)
+                        return
+                    self._release_leg_locked(target, flight)
+                    target.dispatches -= 1
+                    self.dispatched_batches -= 1
+                    self.dispatched_requests -= len(batch)
+                target = flight = None
+
+    # -- hedging ---------------------------------------------------------
+
+    def _hedge_loop(self) -> None:
+        """Scan in-flight batches; re-dispatch stragglers past the
+        hedge threshold to a second worker. First answer wins (the
+        ``_Flight.settled`` latch); the loser is ignored."""
+        cfg = self._cfg
+        while not self._stop_probe.wait(0.02):
+            fired: list[tuple[_Worker, _Flight, float]] = []
+            with self._wake:
+                thr = policy.hedge_threshold_s(cfg.hedge_quantile_ms,
+                                               cfg.hedge_quantile,
+                                               self._batch_s_recent)
+                if thr == math.inf:
+                    continue
+                now = time.perf_counter()
+                views = [w.view() for w in self._workers.values()]
+                for flight in list(self._flights):
+                    if flight.settled or flight.hedge_id is not None:
+                        continue
+                    age = now - flight.t_dispatch
+                    if age < thr:
+                        continue
+                    # exclude the primary AND every worker this batch
+                    # already failed on — hedging to a re-admitted
+                    # flapping worker would re-open exactly the hole
+                    # the retry exclusion closes (and a flight is
+                    # never hedged twice, so a dead hedge leg leaves
+                    # the straggler unprotected)
+                    view = policy.choose_hedge_worker(
+                        views, exclude={flight.primary_id}.union(
+                            *[frozenset(r.excluded)
+                              for r in flight.batch]))
+                    if view is None:
+                        continue
+                    hw = self._workers[view.worker_id]
+                    flight.hedge_id = hw.worker_id
+                    flight.legs += 1
+                    self._inflight_legs += 1
+                    hw.inflight_batches += 1
+                    hw.inflight_requests += len(flight.batch)
+                    hw.dispatches += 1
+                    self.hedge_fired += 1
+                    hw.sender_q.put(flight)
+                    fired.append((hw, flight, age))
+                    # the accounting above staled the snapshot —
+                    # re-take it so a second straggler this tick sees
+                    # the hedge load it just added (never over-hedge
+                    # one worker off a stale picture)
+                    views = [w.view() for w in self._workers.values()]
+            for hw, flight, age in fired:
+                log.warning("router: hedged a %d-request batch to %s "
+                            "after %.1fms (primary %s straggling past "
+                            "the %.1fms threshold)", len(flight.batch),
+                            hw.worker_id, age * 1e3, flight.primary_id,
+                            thr * 1e3)
+                self.bus.counter("router.hedge_fired",
+                                 worker=hw.worker_id,
+                                 primary=flight.primary_id,
+                                 graphs=len(flight.batch),
+                                 threshold_ms=round(thr * 1e3, 3))
 
     # -- senders ---------------------------------------------------------
 
+    def _release_leg_locked(self, w: _Worker, flight: _Flight) -> None:
+        """Account one leg of `flight` leaving worker `w`'s custody
+        (answered, failed, drained, or skipped). Caller holds the
+        lock."""
+        w.inflight_batches -= 1
+        w.inflight_requests -= len(flight.batch)
+        flight.legs -= 1
+        self._inflight_legs -= 1
+        if flight.legs == 0:
+            self._flights.discard(flight)
+
     def _sender_loop(self, w: _Worker) -> None:
         while True:
-            item = w.sender_q.get()
-            if item is None:
+            flight = w.sender_q.get()
+            if flight is None:
                 return
-            batch: list[_Request] = item
+            role = ("hedge" if flight.primary_id != w.worker_id
+                    else "primary")
+            with self._wake:
+                skip = flight.settled
+                if skip:
+                    # the other leg already won while this hedge sat in
+                    # the sender queue: nothing to send, nothing to tag
+                    self._release_leg_locked(w, flight)
+                    self._wake.notify_all()
+            if skip:
+                continue
+            batch = flight.batch
             # transport span ids are pre-allocated so the worker can
             # parent its stage spans under them (the propagation);
             # the span itself is emitted after the round trip settles
@@ -503,38 +892,67 @@ class FleetRouter:
                 {"tid": r.trace.trace_id, "psid": sid}
                 if r.trace is not None and r.trace.sampled else None
                 for r, sid in zip(batch, sids)]
+            slo_meta = [r.slo if r.slo != shield.DEFAULT_CLASS else None
+                        for r in batch]
+            dg_meta = [r.downgrade for r in batch]
             t0 = time.perf_counter()
             tm0 = time.monotonic()
             try:
-                rows = post_predict(
+                rows = self._post(
                     w.base_url, [r.entry_id for r in batch],
                     [r.ts_bucket for r in batch], self._timeout_s,
-                    trace=trace_meta)
+                    trace=trace_meta, slo=slo_meta, dg=dg_meta)
             except WorkerTransportError as exc:
-                tm1 = time.monotonic()
-                for r, sid in zip(batch, sids):
-                    if r.trace is not None:
-                        self.bus.trace_span(
-                            "trace.transport", r.trace, tm0, tm1,
-                            span_id=sid, worker=w.worker_id,
-                            outcome="lost")
-                self._on_worker_lost(w, batch, exc)
+                self._on_leg_failed(w, flight, role, exc, tm0, sids)
                 continue
-            self._on_batch_done(w, batch, rows,
-                                time.perf_counter() - t0,
-                                tm0, time.monotonic(), sids)
+            self._on_leg_done(w, flight, role, rows,
+                              time.perf_counter() - t0, tm0,
+                              time.monotonic(), sids)
 
-    def _on_batch_done(self, w: _Worker, batch: list[_Request],
-                       rows: list[dict], dt: float, tm0: float,
-                       tm1: float, sids: list) -> None:
+    def _on_leg_done(self, w: _Worker, flight: _Flight, role: str,
+                     rows: list[dict], dt: float, tm0: float,
+                     tm1: float, sids: list) -> None:
+        """One leg answered. The first answer WINS the flight and
+        settles the batch's futures; a loser only updates the worker's
+        latency estimate and tags its trace spans ``hedge_lost``."""
+        batch = flight.batch
         alpha = self._cfg.latency_ewma_alpha
+        with self._wake:
+            won = not flight.settled
+            if won:
+                flight.settled = True
+                if role == "hedge":
+                    self.hedge_won += 1
+            self._release_leg_locked(w, flight)
+            w.ewma_batch_s = (dt if not w.ewma_seen else
+                              alpha * dt + (1 - alpha) * w.ewma_batch_s)
+            w.ewma_seen = True
+            self._batch_s_recent.append(dt)
+            self._wake.notify_all()
+        self.bus.histogram("router.batch_ms", dt * 1e3, level=2,
+                           worker=w.worker_id, graphs=len(batch))
+        hedged = flight.hedge_id is not None
+        if not won:
+            # the losing leg of a hedge race: futures are already
+            # resolved (bit-identical predictions make the race safe);
+            # tag the spans so graftscope shows what hedging bought
+            for r, sid in zip(batch, sids):
+                if r.trace is not None:
+                    self.bus.trace_span("trace.transport", r.trace,
+                                        tm0, tm1, span_id=sid,
+                                        worker=w.worker_id,
+                                        outcome="hedge_lost", role=role)
+            return
+        if won and role == "hedge":
+            self.bus.counter("router.hedge_won", worker=w.worker_id,
+                             primary=flight.primary_id,
+                             graphs=len(batch))
         retry: list[_Request] = []
         give_up: list[tuple[_Request, Exception]] = []
         tm_requeue = time.monotonic()
-        # retry triage BEFORE the lock: requeues/tm_queue_start are
-        # sender-custody state (the dispatcher only reads them after
-        # merge_requeue republishes the request, which happens-before
-        # via the lock below)
+        # retry triage BEFORE the republish: requeues/tm_queue_start
+        # are winner-custody state (the settled latch above makes this
+        # leg the batch's sole owner; the loser never touches requests)
         for r, row in zip(batch, rows):
             if row.get("error") in RETRYABLE_ROWS:
                 r.requeues += 1
@@ -555,23 +973,18 @@ class FleetRouter:
                 continue
             outcome = ("retry" if id(r) in retry_set
                        else "ok" if "pred" in row else "error")
+            tags = {"worker": w.worker_id, "outcome": outcome}
+            if hedged:
+                tags["hedged"] = True
+                tags["hedge_won"] = role == "hedge"
             self.bus.trace_span("trace.transport", r.trace, tm0, tm1,
-                                span_id=sid, worker=w.worker_id,
-                                outcome=outcome)
-        with self._wake:
-            w.inflight_batches -= 1
-            w.inflight_requests -= len(batch)
-            w.ewma_batch_s = (dt if not w.ewma_seen else
-                              alpha * dt + (1 - alpha) * w.ewma_batch_s)
-            w.ewma_seen = True
-            if retry:
+                                span_id=sid, **tags)
+        if retry:
+            with self._wake:
                 self.requeues += len(retry)
                 self._pending[:] = policy.merge_requeue(self._pending,
                                                         retry)
-            self._wake.notify_all()
-        self.bus.histogram("router.batch_ms", dt * 1e3, level=2,
-                           worker=w.worker_id, graphs=len(batch))
-        if retry:
+                self._wake.notify_all()
             self.bus.counter("router.requeue", len(retry),
                              worker=w.worker_id, reason="worker_busy")
         t_done = time.perf_counter()
@@ -591,7 +1004,10 @@ class FleetRouter:
                     self.bus.finish_trace("trace.request", r.trace,
                                           r.tm_submit, tm_settle,
                                           outcome="ok",
-                                          entry_id=r.entry_id)
+                                          entry_id=r.entry_id,
+                                          **({"hedge_won":
+                                              role == "hedge"}
+                                             if hedged else {}))
             else:
                 self._resolve_error(r, error_from_row(row))
         if n_served:
@@ -600,22 +1016,35 @@ class FleetRouter:
         for r, exc in give_up:
             self._resolve_error(r, exc)
 
-    def _on_worker_lost(self, w: _Worker, batch: list[_Request],
-                        exc: WorkerTransportError) -> None:
-        """Transport-level failure: exclude the worker NOW and move its
-        entire custody — the failed batch plus anything still queued
-        for it — back into the pending queue in submission order.
+    def _on_leg_failed(self, w: _Worker, flight: _Flight, role: str,
+                       exc: WorkerTransportError, tm0: float,
+                       sids: list) -> None:
+        """Transport-level failure of one leg: exclude the worker NOW
+        and move its entire unsettled custody — this flight (only if no
+        other leg still owns it) plus anything still queued for the
+        worker — back into the pending queue in submission order, each
+        request remembering the failed worker so the retry EXCLUDES it.
         Requests over their requeue budget fail with the transport
         error instead of looping forever."""
-        recovered: list[_Request] = [*batch]
+        tm1 = time.monotonic()
+        for r, sid in zip(flight.batch, sids):
+            if r.trace is not None:
+                self.bus.trace_span("trace.transport", r.trace, tm0,
+                                    tm1, span_id=sid,
+                                    worker=w.worker_id, outcome="lost",
+                                    role=role)
+        recovered: list[_Request] = []
         give_up: list[_Request] = []
         with self._wake:
             was_healthy = w.healthy
             w.healthy = False
             w.probe_failures = 0
             w.lost_count += 1
-            w.inflight_batches -= 1
-            w.inflight_requests -= len(batch)
+            self._release_leg_locked(w, flight)
+            if not flight.settled and flight.legs == 0:
+                # nobody else owns this batch anymore — requeue it
+                flight.settled = True
+                recovered.extend(flight.batch)
             while True:
                 try:
                     queued = w.sender_q.get_nowait()
@@ -626,13 +1055,19 @@ class FleetRouter:
                     # this sender still terminates
                     w.sender_q.put(None)
                     break
-                w.inflight_batches -= 1
-                w.inflight_requests -= len(queued)
-                recovered.extend(queued)
+                self._release_leg_locked(w, queued)
+                if not queued.settled and queued.legs == 0:
+                    queued.settled = True
+                    recovered.extend(queued.batch)
             keep: list[_Request] = []
             tm_requeue = time.monotonic()
             for r in recovered:
                 r.requeues += 1
+                # remember the failure so the retry excludes this
+                # worker even if a probe re-admits it first (the
+                # flapping-worker hole this satellite closes)
+                if w.worker_id not in r.excluded:
+                    r.excluded = (*r.excluded, w.worker_id)
                 if r.requeues > self._max_requeues:
                     give_up.append(r)
                 else:
@@ -669,7 +1104,7 @@ class FleetRouter:
         while not self._stop_probe.wait(interval):
             for w in list(self._workers.values()):
                 try:
-                    status, _body = get_probe(w.base_url, timeout)
+                    status, _body = self._probe(w.base_url, timeout)
                     ok = status == 200
                 except WorkerTransportError:
                     ok = False
@@ -677,6 +1112,8 @@ class FleetRouter:
 
     def _apply_probe(self, w: _Worker, ok: bool) -> None:
         with self._wake:
+            if w.worker_id not in self._workers:
+                return  # removed while this poll was in flight
             healthy, fails, event = policy.probe_transition(
                 w.healthy, w.probe_failures, ok,
                 self._cfg.probe_lost_after)

@@ -1,0 +1,117 @@
+"""SLO-class admission, lowest-class-first shedding, and brownout.
+
+The fleet's overload story before this module was class-blind: at
+``max_pending`` the NEWEST arrival was shed, whoever it was — so a
+burst of best-effort batch traffic could starve the interactive
+requests the SLO actually protects. This module makes priority a
+first-class admission input, as three PURE decision functions (the
+fleet/policy.py discipline — unit-testable with no queues, threads, or
+clocks); `serve/queue.py` and `fleet/router.py` own the mutable state
+and call these at their admission and dispatch points.
+
+**SLO classes.** Three classes, priority by position — `critical` (the
+p99.9-gated interactive tier), `standard` (the default; pre-SLO callers
+land here), `best_effort` (batch/backfill traffic, first to brown out
+and first to shed). The class rides each request: `submit(..., slo=)`
+at both front doors, and per-request over the fleet transport body.
+
+**Lowest-class-first shedding** (`shed_victim_index`). At a full
+pending set the arrival and the queue compete BY CLASS: if some queued
+request has strictly lower priority than the arrival, the NEWEST such
+lowest-class request is evicted (its Future resolves with a typed
+``Shed`` — never a lost Future) and the arrival is admitted; otherwise
+the arrival itself is shed. Newest-victim-first preserves the oldest
+work (it has waited longest and is closest to dispatch); the invariant
+benchmarks/tail_bench.py exit-code-asserts is that no `critical`
+request is ever shed while `best_effort` traffic was being admitted.
+
+**Brownout** (`brownout_transition`). Between "healthy" and "shedding"
+there is a cheaper lever: degrade best-effort service quality before
+refusing anyone. Under brownout the router marks best-effort requests
+for DOWNGRADE and the worker serves them through the CHEAPEST ladder
+rung (serve/engine.py `max_rung` — small-shape executables, a fraction
+of the top rung's padded compute) instead of coalescing them into
+full-size batches. The mode is a hysteresis state machine over pending
+occupancy: enter at `enter_ratio`, exit below `exit_ratio` after
+`min_dwell_s` (no flapping on a noisy boundary).
+"""
+
+from __future__ import annotations
+
+# Priority by position: index 0 is the highest class, shed last.
+SLO_CLASSES = ("critical", "standard", "best_effort")
+
+# What pre-SLO callers get: the middle of the ladder, so a class-aware
+# deployment can both protect traffic above it and sacrifice traffic
+# below it without touching legacy callers.
+DEFAULT_CLASS = "standard"
+
+BEST_EFFORT = "best_effort"
+
+
+def class_priority(slo: str) -> int:
+    """Priority rank of one class (0 = highest). Raises on unknown
+    names — a typo'd class must fail the caller at submit, not silently
+    ride at some default priority."""
+    try:
+        return SLO_CLASSES.index(slo)
+    except ValueError:
+        raise ValueError(f"unknown SLO class {slo!r} "
+                         f"(choose from {SLO_CLASSES})") from None
+
+
+def shed_victim_index(pending_classes, incoming: str) -> int | None:
+    """Which queued request to evict so `incoming` can be admitted to a
+    FULL pending set — or None when the incoming request itself is the
+    one to shed.
+
+    ``pending_classes`` is the queued requests' class names in
+    submission order. The victim is the NEWEST (last-submitted) request
+    of the lowest-priority class present, and only when that class is
+    STRICTLY lower-priority than the incoming one: equal classes never
+    evict each other (FIFO within a class — an arrival cannot bump its
+    own peers), and a lower-class arrival never evicts anyone."""
+    inc = class_priority(incoming)
+    victim_i = None
+    victim_pri = inc
+    for i, cls in enumerate(pending_classes):
+        pri = class_priority(cls)
+        if pri > victim_pri or (victim_i is not None and pri == victim_pri):
+            # strictly lower class than anything seen (or another, NEWER
+            # member of the current victim class): the newest of the
+            # lowest class wins the eviction
+            victim_i, victim_pri = i, pri
+    return victim_i
+
+
+def brownout_transition(active: bool, occupancy: float, now: float,
+                        last_change: float, *, enter_ratio: float,
+                        exit_ratio: float, min_dwell_s: float = 0.5
+                        ) -> tuple[bool, str | None]:
+    """Hysteresis state machine for the brownout mode, as a pure
+    function of one pressure observation: (active', event) where event
+    is "enter" | "exit" | None.
+
+    ``occupancy`` is pending/max_pending at the front door (the same
+    pressure signal admission sheds on — brownout is the rung BELOW
+    shedding, so it keys on the same scale). ``enter_ratio`` <= 0
+    disables the mode entirely. Exit requires occupancy below
+    ``exit_ratio`` AND ``min_dwell_s`` since the last transition, so a
+    queue oscillating on the boundary cannot flap the downgrade."""
+    if enter_ratio <= 0:
+        return False, ("exit" if active else None)
+    if not active:
+        if occupancy >= enter_ratio:
+            return True, "enter"
+        return False, None
+    if occupancy < exit_ratio and now - last_change >= min_dwell_s:
+        return False, "exit"
+    return True, None
+
+
+def resolve_exit_ratio(enter_ratio: float, exit_ratio: float) -> float:
+    """The effective brownout exit threshold: an explicit
+    ``exit_ratio`` > 0 wins; otherwise half the enter ratio (a
+    hysteresis gap wide enough that entering never implies
+    immediately exiting)."""
+    return exit_ratio if exit_ratio > 0 else enter_ratio / 2.0

@@ -96,7 +96,9 @@ class WorkerServer:
                     req = json.loads(self.rfile.read(length))
                     results = outer._predict(req["entries"],
                                              req["ts_buckets"],
-                                             req.get("trace"))
+                                             req.get("trace"),
+                                             req.get("slo"),
+                                             req.get("dg"))
                 except faults.InjectedFault as exc:
                     # the armed chaos plan asked for a transport-level
                     # failure: the router must see this worker as lost
@@ -134,8 +136,9 @@ class WorkerServer:
     def port(self) -> int:
         return self._server.server_address[1]
 
-    def _predict(self, entries, ts_buckets,
-                 trace: list | None = None) -> list[dict]:
+    def _predict(self, entries, ts_buckets, trace: list | None = None,
+                 slo: list | None = None,
+                 dg: list | None = None) -> list[dict]:
         """Submit one router microbatch to the local queue and wait —
         per-request rows in request order, every row present (a
         submitted Future ALWAYS resolves; a rejected submit IS the
@@ -143,7 +146,9 @@ class WorkerServer:
         propagation: None, or one ``{"tid", "psid"}``/null per request
         — the worker's stage spans parent under the router's transport
         span (``psid``), so graftscope can join the two processes'
-        JSONL files into one request tree."""
+        JSONL files into one request tree. ``slo``/``dg`` are the
+        per-request SLO class names and brownout-downgrade flags
+        (fleet/shield.py) — omitted entirely for all-default traffic."""
         plan = faults.active()
         if plan is not None:
             verdict = plan.fire("fleet.worker", entry_ids=entries)
@@ -154,13 +159,18 @@ class WorkerServer:
                 os._exit(137)
         if trace is None or len(trace) != len(entries):
             trace = [None] * len(entries)
+        if slo is None or len(slo) != len(entries):
+            slo = [None] * len(entries)
+        if dg is None or len(dg) != len(entries):
+            dg = [False] * len(entries)
         futures = []
-        for eid, tsb, t in zip(entries, ts_buckets, trace):
+        for eid, tsb, t, s, d in zip(entries, ts_buckets, trace, slo, dg):
             ctx = (self._engine.bus.adopt_trace(t["tid"], t["psid"])
                    if isinstance(t, dict) else None)
             try:
                 futures.append(self._queue.submit(int(eid), int(tsb),
-                                                  trace=ctx))
+                                                  trace=ctx, slo=s,
+                                                  downgrade=bool(d)))
             except serve_errors.ServeError as exc:
                 futures.append(exc)  # admission outcome, row below
         rows: list[dict] = []
@@ -184,17 +194,24 @@ class WorkerServer:
 # -- router-side client ---------------------------------------------------
 
 def post_predict(base_url: str, entries, ts_buckets,
-                 timeout_s: float, trace: list | None = None) -> list[dict]:
+                 timeout_s: float, trace: list | None = None,
+                 slo: list | None = None,
+                 dg: list | None = None) -> list[dict]:
     """One microbatch dispatch; returns per-request rows. Raises
     WorkerTransportError on ANY transport-level failure (the lost-worker
     signature). ``trace`` propagates per-request trace contexts (one
     ``{"tid", "psid"}`` or None per request); omitted entirely when no
     request in the batch is head-sampled, so untraced traffic pays zero
-    wire bytes."""
+    wire bytes. ``slo`` (per-request class names) and ``dg`` (brownout
+    downgrade flags) follow the same omit-when-default rule."""
     payload = {"entries": [int(e) for e in entries],
                "ts_buckets": [int(t) for t in ts_buckets]}
     if trace is not None and any(t is not None for t in trace):
         payload["trace"] = trace
+    if slo is not None and any(s is not None for s in slo):
+        payload["slo"] = slo
+    if dg is not None and any(dg):
+        payload["dg"] = [bool(d) for d in dg]
     body = json.dumps(payload).encode()
     req = urllib.request.Request(
         f"{base_url}/predict", data=body, method="POST",

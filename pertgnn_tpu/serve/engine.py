@@ -404,11 +404,20 @@ class InferenceEngine:
         m = self._mixtures[int(entry_id)]
         return m.num_nodes, m.num_edges
 
-    def pack_microbatch(self, entry_ids, ts_buckets) -> PackedMicrobatch:
+    def pack_microbatch(self, entry_ids, ts_buckets,
+                        max_rung: int | None = None) -> PackedMicrobatch:
         """Host half of a dispatch: bucket selection + ``pack_single``
         into the smallest fitting rung. Pure host work over read-only
         state — the overlapped queue runs this on its worker thread
         while the device computes the previous batch.
+
+        ``max_rung`` caps the ladder search (the brownout DOWNGRADE:
+        best-effort traffic served through rung `max_rung` and below —
+        normally 0, the cheapest shape; fleet/shield.py). The cap is
+        SOFT: a microbatch that fits no capped rung falls back to the
+        full ladder (a downgrade degrades cost, never correctness), and
+        every rung executable already exists from warmup so a downgrade
+        can never trigger a compile.
 
         Raises RequestTooLarge if the microbatch exceeds the top rung —
         callers that cannot pre-size (predict_many, the queue) split
@@ -417,7 +426,14 @@ class InferenceEngine:
         g = len(entry_ids)
         n = sum(self._mixtures[int(e)].num_nodes for e in entry_ids)
         e_tot = sum(self._mixtures[int(e)].num_edges for e in entry_ids)
-        idx = select_bucket(self.ladder, g, n, e_tot)
+        idx = None
+        if max_rung is not None:
+            idx = select_bucket(self.ladder[:max_rung + 1], g, n, e_tot)
+            if idx is None:
+                self._bus.counter("serve.downgrade_overflow", graphs=g,
+                                  max_rung=max_rung)
+        if idx is None:
+            idx = select_bucket(self.ladder, g, n, e_tot)
         if idx is None:
             raise RequestTooLarge(
                 f"microbatch of {g} graphs ({n} nodes, {e_tot} edges) "
@@ -535,14 +551,16 @@ class InferenceEngine:
                       bucket=idx, level=2)
         return pred
 
-    def predict_microbatch(self, entry_ids, ts_buckets) -> np.ndarray:
+    def predict_microbatch(self, entry_ids, ts_buckets,
+                           max_rung: int | None = None) -> np.ndarray:
         """One bucket-shaped dispatch for a coalesced microbatch —
         pack → dispatch → complete, synchronously. The overlapped queue
         calls the three phases itself so the pack of batch k+1 runs
-        while the device computes batch k."""
+        while the device computes batch k. ``max_rung`` is the brownout
+        rung cap (see pack_microbatch)."""
         return self.complete_microbatch(
-            self.dispatch_packed(self.pack_microbatch(entry_ids,
-                                                      ts_buckets)))
+            self.dispatch_packed(self.pack_microbatch(
+                entry_ids, ts_buckets, max_rung=max_rung)))
 
     def predict_many(self, entry_ids, ts_buckets) -> np.ndarray:
         """Predictions for an arbitrary request list, split greedily into

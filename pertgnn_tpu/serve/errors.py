@@ -22,6 +22,22 @@ class QueueFull(ServeError):
     caller times out. Counter: ``serve.shed``."""
 
 
+class Shed(QueueFull):
+    """Class-aware admission shed (pertgnn_tpu/fleet/shield.py): the
+    pending set is full and this request lost the priority comparison —
+    either the submitted request itself (its SLO class is not strictly
+    higher than everything already queued) or a lower-class victim
+    EVICTED to admit a higher-class arrival (its Future resolves with
+    this; never a lost Future). ``slo`` names the shed request's class.
+    Subclasses QueueFull so pre-SLO callers matching on QueueFull keep
+    working. Counters: ``serve.shed_by_class`` /
+    ``router.shed_by_class`` (tags ``slo``, ``mode``: reject/evict)."""
+
+    def __init__(self, message: str, *, slo: str = ""):
+        super().__init__(message)
+        self.slo = slo
+
+
 class QueueClosed(ServeError):
     """Submit after close() or during a graceful drain. The message
     contains "closed" for callers matching on it."""

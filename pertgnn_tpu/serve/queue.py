@@ -36,8 +36,19 @@ Failure semantics (docs/RELIABILITY.md) — a submitted Future ALWAYS
 resolves, to a prediction or to a typed serve error (serve/errors.py):
 
 - **admission control**: submit past `max_pending` queued requests
-  fast-fails with QueueFull (counter ``serve.shed``) — under overload
-  the queue sheds instead of growing without bound;
+  sheds LOWEST-SLO-CLASS-FIRST (fleet/shield.py): a higher-class
+  arrival evicts the newest queued request of the lowest class present
+  (its Future resolves with the typed ``Shed`` — never lost), otherwise
+  the arrival fast-fails with ``Shed`` (a QueueFull subclass; counters
+  ``serve.shed`` / ``serve.shed_by_class``) — under overload the queue
+  sheds instead of growing without bound, and sheds the traffic whose
+  SLO tolerates it;
+- **brownout downgrade**: requests flagged ``downgrade`` (the router's
+  brownout verdict on best-effort traffic) batch separately and
+  dispatch through the engine's CHEAPEST ladder rung
+  (``pack_microbatch(max_rung=0)``, counter
+  ``serve.brownout_downgrade``) — service degrades before anyone is
+  shed;
 - **per-request deadlines**: a request not dispatched within
   `request_deadline_ms` resolves with DeadlineExceeded (counter
   ``serve.deadline_exceeded``);
@@ -71,16 +82,21 @@ import threading
 import time
 from concurrent.futures import Future
 
+from pertgnn_tpu.fleet import shield
 from pertgnn_tpu.serve.engine import InferenceEngine
 from pertgnn_tpu.serve.errors import (DeadlineExceeded, DispatchTimeout,
                                       EngineUnhealthy, QueueClosed,
-                                      QueueFull, RequestQuarantined)
+                                      RequestQuarantined, Shed)
 
 log = logging.getLogger(__name__)
 
 # pending-entry tuple layout (submission order is load-bearing):
-# (entry_id, ts_bucket, arrival_time, deadline_abs, future, trace)
-# trace is None (untraced) or a _ReqTrace
+# (entry_id, ts_bucket, arrival_time, deadline_abs, future, trace,
+#  slo, downgrade)
+# trace is None (untraced) or a _ReqTrace; slo is the request's SLO
+# class name (fleet/shield.py — admission sheds lowest-class-first);
+# downgrade marks brownout'd best-effort traffic the engine serves
+# through the cheapest ladder rung (batches never mix downgrade states)
 
 
 class _ReqTrace:
@@ -207,6 +223,13 @@ class MicrobatchQueue:
         self._max_graphs = min(max_graphs or top.max_graphs, top.max_graphs)
         self._max_nodes = top.max_nodes
         self._max_edges = top.max_edges
+        # brownout'd (downgraded) batches are capped at the CHEAPEST
+        # rung's capacity so they dispatch through its small executable
+        # (fleet/shield.py; engine.pack_microbatch max_rung=0)
+        rung0 = engine.ladder[0]
+        self._dg_graphs = min(self._max_graphs, rung0.max_graphs)
+        self._dg_nodes = rung0.max_nodes
+        self._dg_edges = rung0.max_edges
         self._max_pending = (cfg.max_pending if max_pending is None
                              else max_pending)
         self._req_deadline_s = (cfg.request_deadline_ms
@@ -267,16 +290,26 @@ class MicrobatchQueue:
 
     # -- client side -----------------------------------------------------
 
-    def submit(self, entry_id: int, ts_bucket: int,
-               trace=None) -> Future:
+    def submit(self, entry_id: int, ts_bucket: int, trace=None,
+               slo: str | None = None, downgrade: bool = False) -> Future:
         """Enqueue one request; the Future resolves to its predicted
         latency (label units) once its microbatch is served, or to a
-        typed serve error. Raises QueueClosed / QueueFull /
+        typed serve error. Raises QueueClosed / Shed (a QueueFull) /
         RequestQuarantined at admission (fast-fail: a rejected request
         never occupies a pending slot). ``trace`` is an adopted
         TraceContext propagated over the fleet transport; None lets the
-        queue head-sample its own root (standalone serving)."""
+        queue head-sample its own root (standalone serving).
+
+        ``slo`` is the request's SLO class (fleet/shield.py; default
+        "standard"): at a full pending set admission sheds LOWEST-
+        CLASS-FIRST — a higher-class arrival evicts the newest queued
+        request of the lowest class present (its Future resolves with
+        Shed — never lost), otherwise the arrival itself is shed.
+        ``downgrade`` marks brownout'd best-effort traffic the engine
+        serves through the cheapest ladder rung."""
         eid = int(entry_id)
+        slo_cls = shield.DEFAULT_CLASS if slo is None else slo
+        shield.class_priority(slo_cls)  # unknown class fails the caller
         # size it NOW so an entry the engine has never seen fails the
         # caller, not the shared worker
         self._engine.request_size(eid)
@@ -293,6 +326,8 @@ class MicrobatchQueue:
         else:
             tr = None
         reject = counter = None
+        lowest_queued = slo_cls
+        evicted = None
         with self._wake:
             if self._closed or self._draining:
                 reject = QueueClosed(
@@ -305,20 +340,49 @@ class MicrobatchQueue:
                     f"entry {eid} is quarantined (poisoned "
                     f"{self._offenders.get(eid, 0)} microbatches)")
             elif len(self._pending) >= self._max_pending:
-                self.shed += 1
-                counter = "serve.shed"
-                reject = QueueFull(
-                    f"pending set is at max_pending={self._max_pending}; "
-                    f"request shed")
+                pending_classes = [p[6] for p in self._pending]
+                victim_i = shield.shed_victim_index(pending_classes,
+                                                    slo_cls)
+                if victim_i is None:
+                    self.shed += 1
+                    counter = "serve.shed"
+                    # evidence tag: the lowest class queued at the
+                    # moment of rejection (see fleet/router.py submit)
+                    lowest_queued = max(
+                        pending_classes, key=shield.class_priority,
+                        default=slo_cls)
+                    reject = Shed(
+                        f"pending set is at "
+                        f"max_pending={self._max_pending}; {slo_cls} "
+                        f"request shed", slo=slo_cls)
+                else:
+                    # lowest-class-first: evict the newest queued
+                    # request of the lowest class to admit this one —
+                    # its future resolves OUTSIDE the lock below
+                    evicted = self._pending.pop(victim_i)
+                    self.shed += 1
+                    self.error_counts["Shed"] += 1
+                    self._admit_locked(eid, ts_bucket, fut, tr, slo_cls,
+                                       downgrade)
             else:
-                deadline = (time.perf_counter() + self._req_deadline_s
-                            if self._req_deadline_s > 0 else math.inf)
-                self._pending.append((eid, int(ts_bucket),
-                                      time.perf_counter(), deadline, fut,
-                                      tr))
-                self._wake.notify()
+                self._admit_locked(eid, ts_bucket, fut, tr, slo_cls,
+                                   downgrade)
             if reject is not None:
                 self.error_counts[type(reject).__name__] += 1
+        if evicted is not None:
+            bus = self._engine.bus
+            bus.counter("serve.shed", entry_id=evicted[0])
+            bus.counter("serve.shed_by_class", slo=evicted[6],
+                        mode="evict", entry_id=evicted[0])
+            evicted[4].set_exception(Shed(
+                f"evicted at admission: a {slo_cls} arrival outranked "
+                f"this queued {evicted[6]} request at "
+                f"max_pending={self._max_pending}", slo=evicted[6]))
+            etr = evicted[5]
+            if etr is not None and etr.owns_root:
+                bus.finish_trace("trace.request", etr.ctx, etr.tm_submit,
+                                 time.monotonic(), outcome="error",
+                                 error="Shed", entry_id=evicted[0])
         if reject is not None:
             # counter emission OUTSIDE the lock: a telemetry disk write
             # must not serialize the admission path — under overload the
@@ -326,8 +390,22 @@ class MicrobatchQueue:
             # worker and other clients are contending for this lock
             if counter is not None:
                 self._engine.bus.counter(counter, entry_id=eid)
+            if isinstance(reject, Shed):
+                self._engine.bus.counter("serve.shed_by_class",
+                                         slo=slo_cls, mode="reject",
+                                         entry_id=eid,
+                                         lowest_queued=lowest_queued)
             raise reject
         return fut
+
+    def _admit_locked(self, eid: int, ts_bucket: int, fut: Future,
+                      tr, slo_cls: str, downgrade: bool) -> None:
+        deadline = (time.perf_counter() + self._req_deadline_s
+                    if self._req_deadline_s > 0 else math.inf)
+        self._pending.append((eid, int(ts_bucket), time.perf_counter(),
+                              deadline, fut, tr, slo_cls,
+                              bool(downgrade)))
+        self._wake.notify()
 
     def predict(self, entry_id: int, ts_bucket: int,
                 timeout: float | None = None) -> float:
@@ -378,7 +456,7 @@ class MicrobatchQueue:
         with self._wake:
             taken = self._pending[:]
             self._pending.clear()
-        return [(eid, ts, fut) for eid, ts, _t, _dl, fut, _tr in taken]
+        return [(item[0], item[1], item[4]) for item in taken]
 
     def probe_dict(self) -> dict:
         """The queue half of the health-probe body (serve/health.py):
@@ -439,14 +517,22 @@ class MicrobatchQueue:
 
     def _take_batch_locked(self) -> list[tuple]:
         """Pop the maximal capacity-respecting prefix of the pending list
-        (submission order — alignment depends on it)."""
+        (submission order — alignment depends on it). Batches never mix
+        DOWNGRADE states: a brownout'd best-effort batch is capped at
+        the cheapest rung's capacity (so it actually fits rung 0) and a
+        normal batch stops before absorbing a downgraded request —
+        submission order within each batch is preserved either way."""
+        dg = bool(self._pending[0][7]) if self._pending else False
+        max_g, max_n, max_e = ((self._dg_graphs, self._dg_nodes,
+                                self._dg_edges) if dg else
+                               (self._max_graphs, self._max_nodes,
+                                self._max_edges))
         g = n = e = 0
         take = 0
-        for entry_id, _ts, _t, _dl, _f, _tr in self._pending:
-            dn, de = self._engine.request_size(entry_id)
-            if take and (g + 1 > self._max_graphs
-                         or n + dn > self._max_nodes
-                         or e + de > self._max_edges):
+        for item in self._pending:
+            dn, de = self._engine.request_size(item[0])
+            if take and (bool(item[7]) != dg or g + 1 > max_g
+                         or n + dn > max_n or e + de > max_e):
                 break
             g, n, e = g + 1, n + dn, e + de
             take += 1
@@ -465,11 +551,14 @@ class MicrobatchQueue:
 
     def _full_locked(self) -> bool:
         """Would waiting longer be pointless? True once the pending
-        prefix already saturates a top-bucket batch."""
+        prefix already saturates a top-bucket batch (or crosses a
+        downgrade boundary — the next take flushes up to it anyway)."""
         g = n = e = 0
-        for entry_id, _ts, _t, _dl, _f, _tr in self._pending:
-            dn, de = self._engine.request_size(entry_id)
-            if (g + 1 > self._max_graphs or n + dn > self._max_nodes
+        dg = bool(self._pending[0][7]) if self._pending else False
+        for item in self._pending:
+            dn, de = self._engine.request_size(item[0])
+            if (bool(item[7]) != dg or g + 1 > self._max_graphs
+                    or n + dn > self._max_nodes
                     or e + de > self._max_edges):
                 return True
             g, n, e = g + 1, n + dn, e + de
@@ -566,19 +655,24 @@ class MicrobatchQueue:
             # thread resolves the future, and _dec_inflight retakes the
             # lock — every taken future resolves exactly once (the
             # queue's core invariant), so the count cannot drift
-            for _e, _ts, _t, _dl, fut, _tr in batch:
-                fut.add_done_callback(self._dec_inflight)
+            for item in batch:
+                item[4].add_done_callback(self._dec_inflight)
+            # the downgrade evidence, once per TAKEN batch (retries
+            # and bisect halves of the same batch must not re-count)
+            if batch[0][7]:
+                self._engine.bus.counter("serve.brownout_downgrade",
+                                         graphs=len(batch))
             # queue-wait stage of the request lifecycle: submit -> the
             # moment its microbatch leaves the queue for the engine
             t_now = time.perf_counter()
             tm_now = time.monotonic()
-            for _e, _ts, t_arrival, _dl, _f, tr in batch:
-                self._engine.record_queue_wait(t_now - t_arrival,
+            for item in batch:
+                self._engine.record_queue_wait(t_now - item[2],
                                                coalesced=len(batch))
-                if tr is not None:
+                if item[5] is not None:
                     self._engine.bus.trace_span(
-                        "trace.worker_queue", tr.ctx, tr.tm_submit,
-                        tm_now, coalesced=len(batch))
+                        "trace.worker_queue", item[5].ctx,
+                        item[5].tm_submit, tm_now, coalesced=len(batch))
             try:
                 if self._overlap:
                     self._pump_overlap(batch)
@@ -596,7 +690,8 @@ class MicrobatchQueue:
     def _fail(self, batch, exc: BaseException) -> None:
         failed = 0
         tm_now = time.monotonic()
-        for _e, _ts, _t, _dl, fut, tr in batch:
+        for item in batch:
+            fut, tr = item[4], item[5]
             if not fut.done():
                 fut.set_exception(exc)
                 failed += 1
@@ -634,7 +729,8 @@ class MicrobatchQueue:
         entries = [b[0] for b in batch]
         ts_buckets = [b[1] for b in batch]
         try:
-            preds = self._dispatch(entries, ts_buckets)
+            preds = self._dispatch(entries, ts_buckets,
+                                   max_rung=self._batch_max_rung(batch))
         except DispatchTimeout as exc:
             self._recover_or_fail(batch, exc, retried=retried)
             return
@@ -670,7 +766,8 @@ class MicrobatchQueue:
             # state): safe while the single engine device thread still
             # owns the in-flight batch — THE overlap this path exists for
             packed = self._engine.pack_microbatch(
-                [b[0] for b in batch], [b[1] for b in batch])
+                [b[0] for b in batch], [b[1] for b in batch],
+                max_rung=self._batch_max_rung(batch))
         except Exception as exc:  # lint: allow-silent-except — handed to _fail_or_bisect below
             pack_exc = exc
         self._finish_inflight()
@@ -731,9 +828,10 @@ class MicrobatchQueue:
         dp = stage_tm.get("dispatch")
         cp = stage_tm.get("compute")
         tm_done = time.monotonic()
-        for _e, _ts, t_arrival, _dl, _f, tr in batch:
+        for item in batch:
+            tr = item[5]
             bus.histogram("serve.request_total_ms",
-                          (t_done - t_arrival) * 1e3, level=2)
+                          (t_done - item[2]) * 1e3, level=2)
             if tr is not None:
                 if pk:
                     bus.trace_span("trace.pack", tr.ctx, pk[0], pk[1])
@@ -743,11 +841,12 @@ class MicrobatchQueue:
                 if cp:
                     bus.trace_span("trace.compute", tr.ctx, cp[0],
                                    cp[1])
-        for (_e, _ts, _t, _dl, fut, tr), p in zip(batch, preds):
+        for item, p in zip(batch, preds):
+            fut, tr = item[4], item[5]
             fut.set_result(float(p))
             if tr is not None and tr.owns_root:
                 bus.finish_trace("trace.request", tr.ctx, tr.tm_submit,
-                                 tm_done, outcome="ok", entry_id=_e)
+                                 tm_done, outcome="ok", entry_id=item[0])
 
     def _fail_or_bisect(self, batch, exc: Exception,
                         retried: bool) -> None:
@@ -797,9 +896,19 @@ class MicrobatchQueue:
             self._dispatcher = _Dispatcher(self._engine)
         return self._dispatcher.call(fn, self._dispatch_timeout_s, what)
 
-    def _dispatch(self, entries, ts_buckets):
+    def _batch_max_rung(self, batch) -> int | None:
+        """The brownout rung cap for one (downgrade-homogeneous) batch:
+        0 for downgraded best-effort traffic, None otherwise. PURE —
+        the serve.brownout_downgrade counter is emitted once per TAKEN
+        batch in the worker loop, not here: this helper also runs on
+        watchdog retries and bisect halves, which would multi-count
+        one admitted batch."""
+        return 0 if (batch and batch[0][7]) else None
+
+    def _dispatch(self, entries, ts_buckets, max_rung=None):
         return self._engine_call(
-            lambda: self._engine.predict_microbatch(entries, ts_buckets),
+            lambda: self._engine.predict_microbatch(entries, ts_buckets,
+                                                    max_rung=max_rung),
             what=f"engine dispatch of {len(entries)} request(s)")
 
     def _trip_watchdog(self, exc: DispatchTimeout) -> None:

@@ -10,7 +10,9 @@ site                 where it fires
 ===================  =====================================================
 ``serve.dispatch``   InferenceEngine.predict_microbatch, before the
                      executable runs (``error`` raises, ``wedge`` stalls
-                     the dispatch, ``nan`` corrupts the batch output)
+                     the dispatch, ``nan`` corrupts the batch output,
+                     ``delay`` stalls it ``delay_s`` and then SUCCEEDS —
+                     the straggler mode hedged dispatch defends against)
 ``serve.compile``    InferenceEngine._compile (``error`` fails the rung)
 ``checkpoint.save``  CheckpointManager.save (``corrupt`` garbles the
                      just-committed step on disk)
@@ -59,7 +61,7 @@ log = logging.getLogger(__name__)
 
 ENV_VAR = "PERTGNN_FAULT_PLAN"
 
-KINDS = ("error", "wedge", "nan", "corrupt", "kill")
+KINDS = ("error", "wedge", "nan", "corrupt", "kill", "delay")
 
 
 class InjectedFault(RuntimeError):
@@ -80,6 +82,11 @@ class FaultSpec:
     entry_id: int | None = None
     # Stall duration for kind="wedge" (simulated device-transport hang).
     wedge_s: float = 0.0
+    # Straggler duration for kind="delay": the call SLOWS by this much
+    # but still succeeds — the slow-without-failing mode hedged dispatch
+    # defends against (a wedge is meant to TRIP the watchdog; a delay
+    # must stay below it and return a correct answer late).
+    delay_s: float = 0.0
     # Fire probability per matching occurrence, drawn from the plan's
     # seeded RNG. 1.0 = always.
     p: float = 1.0
@@ -139,6 +146,11 @@ class FaultPlan:
                 spec.message or f"injected {site} error (occurrence {n})")
         if spec.kind == "wedge":
             sleep(spec.wedge_s)
+        elif spec.kind == "delay":
+            # straggler: stall here (mid-call, same place a wedge
+            # stalls) but let the call proceed to a CORRECT answer —
+            # the site needs no special handling, late == injected
+            sleep(spec.delay_s)
         return spec.kind
 
     def _match_locked(self, site, n, entry_ids) -> FaultSpec | None:

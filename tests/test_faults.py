@@ -129,6 +129,55 @@ class TestFaultPlan:
             FaultSpec(site="s", kind="explode")
 
 
+class TestDelayFault:
+    """The straggler mode (ISSUE-13 satellite): ``delay`` stalls a
+    dispatch and then SUCCEEDS — slow-without-failing, which is what
+    hedged dispatch (fleet/router.py) defends against. PR 4 shipped
+    error/wedge/nan; a wedge is meant to TRIP the watchdog, a delay
+    must stay below it and return correct bits late."""
+
+    def test_delay_sleeps_then_returns_kind(self):
+        slept = []
+        plan = FaultPlan([FaultSpec(site="serve.dispatch", kind="delay",
+                                    delay_s=0.4, nth=(2,))])
+        assert plan.fire("serve.dispatch", sleep=slept.append) is None
+        assert plan.fire("serve.dispatch",
+                         sleep=slept.append) == "delay"
+        assert slept == [0.4]
+        assert plan.fired == [("serve.dispatch", 2, "delay")]
+
+    def test_delay_round_trips_and_fires_deterministically(self):
+        plan = FaultPlan([FaultSpec(site="serve.dispatch", kind="delay",
+                                    delay_s=0.25, p=0.5)], seed=11)
+        clone = FaultPlan.from_json(plan.to_json())
+        assert clone.specs == plan.specs
+        logs = []
+        for pl in (plan, clone):
+            for _ in range(20):
+                pl.fire("serve.dispatch", sleep=lambda _s: None)
+            logs.append(list(pl.fired))
+        assert logs[0] == logs[1] and len(logs[0]) > 0
+        assert all(kind == "delay" for _s, _n, kind in logs[0])
+
+    def test_delayed_dispatch_succeeds_bit_identical(self, served):
+        ds, _cfg, _state, engine = served
+        s = ds.splits["test"]
+        ref = _solo_preds(ds, engine, [0])
+        faults.install(FaultPlan([FaultSpec(
+            site="serve.dispatch", kind="delay", delay_s=0.3,
+            nth=(1,))]))
+        t0 = time.perf_counter()
+        pred = engine.predict_microbatch(s.entry_ids[:1],
+                                         s.ts_buckets[:1])
+        dt = time.perf_counter() - t0
+        faults.install(None)
+        # the dispatch STRAGGLED (no error, no watchdog)...
+        assert dt >= 0.3
+        # ...and still returned exactly the fault-free bits
+        np.testing.assert_array_equal(pred, ref)
+        assert engine.healthy
+
+
 class TestQuarantineBisect:
     def test_innocents_survive_a_poisoned_batch_bit_identical(self, served):
         """One persistently-poisoned entry fails every batch containing

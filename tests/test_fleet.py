@@ -32,7 +32,7 @@ from pertgnn_tpu.config import (Config, DataConfig, FleetConfig,
 from pertgnn_tpu.fleet import policy
 from pertgnn_tpu.fleet.policy import WorkerView
 from pertgnn_tpu.serve.errors import (DeadlineExceeded, QueueClosed,
-                                      QueueFull)
+                                      QueueFull, Shed)
 from pertgnn_tpu.serve.queue import MicrobatchQueue
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -238,7 +238,9 @@ def test_probe_dict_counts_errors_and_depth(served):
         assert probe["depth"] == 1 and probe["inflight"] == 0
         with pytest.raises(QueueFull):
             q.submit(eid, tsb)
-        assert q.probe_dict()["errors"].get("QueueFull") == 1
+        # the shed is a Shed (QueueFull subclass) since SLO-class
+        # admission — the raise contract above is unchanged
+        assert q.probe_dict()["errors"].get("Shed") == 1
         handed = q.requeue()
         handed[0][2].set_exception(QueueClosed("test cleanup"))
         assert fut.done()
@@ -273,6 +275,73 @@ def test_queue_stats_include_error_classes(served):
     stats = q.stats_dict()
     assert stats["errors"].get("DeadlineExceeded", 0) >= 1
     assert stats["inflight"] == 0
+
+
+def test_queue_sheds_lowest_class_first(served):
+    """ISSUE-13: at a full pending set the queue evicts the NEWEST
+    lowest-class request for a higher-class arrival (its future
+    resolves with Shed — never lost), and rejects arrivals that
+    outrank nothing (fleet/shield.py drives both front doors)."""
+    ds, _cfg, _state, engine = served
+    s = ds.splits["test"]
+    eid, tsb = int(s.entry_ids[0]), int(s.ts_buckets[0])
+    with MicrobatchQueue(engine, flush_deadline_ms=60_000,
+                         max_pending=2) as q:
+        f_std = q.submit(eid, tsb)
+        f_be = q.submit(eid, tsb, slo="best_effort")
+        f_crit = q.submit(eid, tsb, slo="critical")
+        exc = f_be.exception(timeout=5)
+        assert isinstance(exc, Shed) and exc.slo == "best_effort"
+        with pytest.raises(Shed) as shed:
+            q.submit(eid, tsb, slo="best_effort")
+        assert shed.value.slo == "best_effort"
+        assert q.stats_dict()["pending"] == 2
+        assert q.stats_dict()["shed"] == 2
+        with pytest.raises(ValueError, match="unknown SLO class"):
+            q.submit(eid, tsb, slo="platinum")
+        handed = q.requeue()
+        for _e, _t, fut in handed:
+            fut.set_exception(QueueClosed("test cleanup"))
+    assert f_std.done() and f_crit.done()
+
+
+def test_queue_downgrade_rides_the_cheapest_rung(served):
+    """ISSUE-13 brownout: a downgraded request packs through ladder
+    rung 0 (engine.pack_microbatch max_rung) with BIT-IDENTICAL
+    predictions (padding invariance), and batches never mix downgrade
+    states — a dg pair and a normal request drain as separate engine
+    batches."""
+    ds, _cfg, _state, engine = served
+    s = ds.splits["test"]
+    rung0 = engine.ladder[0]
+    # pick an entry that fits the cheapest rung solo
+    pick = None
+    for i in range(len(s.entry_ids)):
+        dn, de = engine.request_size(int(s.entry_ids[i]))
+        if dn <= rung0.max_nodes and de <= rung0.max_edges:
+            pick = i
+            break
+    assert pick is not None, "no mixture fits the smallest rung"
+    eid, tsb = int(s.entry_ids[pick]), int(s.ts_buckets[pick])
+    ref = float(engine.predict_microbatch([eid], [tsb])[0])
+    # engine level: the cap selects rung 0, same bits
+    packed = engine.pack_microbatch([eid], [tsb], max_rung=0)
+    assert packed.idx == 0
+    assert float(engine.predict_microbatch([eid], [tsb],
+                                           max_rung=0)[0]) == ref
+    # queue level: dg-homogeneous batching (downgraded pair + one
+    # normal request = two engine batches, never one mixed)
+    dn, de = engine.request_size(eid)
+    pair_fits = 2 * dn <= rung0.max_nodes and 2 * de <= rung0.max_edges
+    b0 = engine.batches
+    with MicrobatchQueue(engine, flush_deadline_ms=60_000) as q:
+        futs = [q.submit(eid, tsb, slo="best_effort", downgrade=True),
+                q.submit(eid, tsb, slo="best_effort", downgrade=True),
+                q.submit(eid, tsb)]
+        # close() drains them
+    for f in futs:
+        assert float(f.result(timeout=60)) == ref
+    assert engine.batches - b0 == (2 if pair_fits else 3)
 
 
 # -- 3. one in-process fleet (real router, real HTTP transport) ----------

@@ -1,0 +1,603 @@
+"""graftshield (ISSUE 13): load generation, SLO shedding, brownout,
+hedged dispatch, and elastic warm spares.
+
+Four layers, cheapest first (the fleet-testing discipline of
+tests/test_fleet.py):
+
+1. the PURE decision functions — SLO class priority, lowest-class-first
+   victim choice, brownout hysteresis, hedge threshold/worker choice,
+   exclusion-aware dispatch — no queues, no threads, no clocks;
+2. the open-loop LOAD GENERATOR — schedule determinism per seed, burst
+   and diurnal envelopes, Zipf skew, SLO mix, and a replay against an
+   injected front door (no fleet);
+3. the ROUTER over INJECTED transports — the hedge race driven
+   deterministically in BOTH orders (bit-safety: the future resolves
+   exactly once to identical bits regardless of which leg lands
+   first), retry exclusion of an observed-failing worker, class-aware
+   eviction, brownout downgrade on the wire, and live add/remove
+   membership — no sockets, no engines;
+4. the AUTOSCALE controller over a fake router and injected clock —
+   hysteresis hold/cooldown sequencing with zero sleeps.
+
+Engine-dependent coverage (queue-level eviction, rung downgrade)
+rides tests/test_fleet.py, which already owns the warm engine fixture;
+the full chaos-storm integration is benchmarks/tail_bench.py.
+"""
+
+import math
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from pertgnn_tpu.config import FleetConfig
+from pertgnn_tpu.fleet import loadgen, policy, shield
+from pertgnn_tpu.fleet.autoscale import AutoscaleController
+from pertgnn_tpu.fleet.policy import WorkerView
+from pertgnn_tpu.fleet.router import FleetRouter
+from pertgnn_tpu.fleet.transport import WorkerTransportError
+from pertgnn_tpu.serve.errors import QueueFull, Shed
+from pertgnn_tpu.telemetry.bus import NoopBus
+
+
+# -- 1. pure decision functions ------------------------------------------
+
+class TestSloClasses:
+    def test_priority_order(self):
+        assert shield.class_priority("critical") == 0
+        assert shield.class_priority(shield.DEFAULT_CLASS) == 1
+        assert shield.class_priority(shield.BEST_EFFORT) == 2
+
+    def test_unknown_class_raises(self):
+        with pytest.raises(ValueError, match="unknown SLO class"):
+            shield.class_priority("platinum")
+
+    def test_shed_is_a_queue_full(self):
+        # pre-SLO callers match on QueueFull; Shed must stay catchable
+        exc = Shed("full", slo="best_effort")
+        assert isinstance(exc, QueueFull)
+        assert exc.slo == "best_effort"
+
+
+class TestShedVictim:
+    def test_evicts_newest_of_lowest_class(self):
+        pending = ["standard", "best_effort", "critical", "best_effort"]
+        assert shield.shed_victim_index(pending, "critical") == 3
+
+    def test_equal_class_never_evicts_peers(self):
+        assert shield.shed_victim_index(["standard", "standard"],
+                                        "standard") is None
+        assert shield.shed_victim_index(["critical"], "critical") is None
+
+    def test_lower_class_arrival_never_evicts(self):
+        assert shield.shed_victim_index(["critical", "standard"],
+                                        "best_effort") is None
+        assert shield.shed_victim_index(["critical"],
+                                        "standard") is None
+
+    def test_standard_arrival_evicts_best_effort(self):
+        assert shield.shed_victim_index(
+            ["best_effort", "standard", "best_effort"], "standard") == 2
+
+    def test_empty_pending(self):
+        assert shield.shed_victim_index([], "critical") is None
+
+
+class TestBrownout:
+    def test_disabled_when_enter_ratio_zero(self):
+        active, ev = shield.brownout_transition(
+            False, 1.0, 10.0, 0.0, enter_ratio=0.0, exit_ratio=0.0)
+        assert not active and ev is None
+
+    def test_enter_exit_hysteresis(self):
+        a, ev = shield.brownout_transition(
+            False, 0.6, 0.0, 0.0, enter_ratio=0.5, exit_ratio=0.25)
+        assert a and ev == "enter"
+        # between exit and enter: stays active
+        a, ev = shield.brownout_transition(
+            True, 0.4, 1.0, 0.0, enter_ratio=0.5, exit_ratio=0.25)
+        assert a and ev is None
+        # below exit + past dwell: exits
+        a, ev = shield.brownout_transition(
+            True, 0.1, 2.0, 0.0, enter_ratio=0.5, exit_ratio=0.25)
+        assert not a and ev == "exit"
+
+    def test_min_dwell_blocks_flapping(self):
+        a, ev = shield.brownout_transition(
+            True, 0.0, 0.1, 0.0, enter_ratio=0.5, exit_ratio=0.25,
+            min_dwell_s=0.5)
+        assert a and ev is None  # too soon to exit
+
+    def test_resolve_exit_ratio(self):
+        assert shield.resolve_exit_ratio(0.5, 0.3) == 0.3
+        assert shield.resolve_exit_ratio(0.5, 0.0) == 0.25
+
+
+class TestHedgePolicy:
+    def test_fixed_threshold_wins(self):
+        assert policy.hedge_threshold_s(120.0, 0.9, []) == 0.12
+
+    def test_adaptive_needs_samples(self):
+        assert policy.hedge_threshold_s(0.0, 0.9, [0.01] * 5) == math.inf
+
+    def test_adaptive_quantile(self):
+        samples = [i / 100.0 for i in range(100)]  # 0..0.99
+        thr = policy.hedge_threshold_s(0.0, 0.95, samples)
+        assert 0.90 <= thr <= 0.97
+
+    def test_off_when_unconfigured(self):
+        assert policy.hedge_threshold_s(0.0, 0.0, [0.01] * 100) == \
+            math.inf
+
+    def test_choose_hedge_worker_excludes_primary(self):
+        ws = [WorkerView("a", inflight_batches=0),
+              WorkerView("b", inflight_batches=3, slots=2)]
+        # a is the primary -> excluded; b is OVER its slot cap even
+        # with the +1 hedge allowance -> nobody
+        assert policy.choose_hedge_worker(ws, exclude={"a"}) is None
+        ws[1] = WorkerView("b", inflight_batches=2, slots=2)
+        # slots + 1 allowance admits b for a hedge
+        assert policy.choose_hedge_worker(
+            ws, exclude={"a"}).worker_id == "b"
+
+
+class TestChooseWorkerExclusion:
+    def test_exclusion_beats_earlier_completion(self):
+        ws = [WorkerView("fast", ewma_batch_s=0.001),
+              WorkerView("slow", ewma_batch_s=1.0)]
+        assert policy.choose_worker(ws).worker_id == "fast"
+        assert policy.choose_worker(
+            ws, exclude={"fast"}).worker_id == "slow"
+
+    def test_all_excluded_is_none(self):
+        ws = [WorkerView("a"), WorkerView("b")]
+        assert policy.choose_worker(ws, exclude={"a", "b"}) is None
+
+
+# -- 2. the open-loop load generator -------------------------------------
+
+POP_E = np.arange(50, dtype=np.int64)
+POP_T = np.arange(50, dtype=np.int64) * 30_000
+
+
+class TestSchedule:
+    def test_deterministic_per_seed(self):
+        spec = loadgen.LoadSpec(duration_s=3.0, base_rps=200, seed=3)
+        s1 = loadgen.generate_schedule(spec, POP_E, POP_T)
+        s2 = loadgen.generate_schedule(spec, POP_E, POP_T)
+        for a, b in ((s1.t, s2.t), (s1.entry_ids, s2.entry_ids),
+                     (s1.ts_buckets, s2.ts_buckets), (s1.slo, s2.slo)):
+            np.testing.assert_array_equal(a, b)
+        s3 = loadgen.generate_schedule(
+            loadgen.LoadSpec(duration_s=3.0, base_rps=200, seed=4),
+            POP_E, POP_T)
+        assert len(s3) != len(s1) or not np.array_equal(s1.t, s3.t)
+
+    def test_burst_windows_are_denser(self):
+        spec = loadgen.LoadSpec(duration_s=4.0, base_rps=100,
+                                burst_factor=8.0, burst_every_s=2.0,
+                                burst_len_s=0.5, seed=0)
+        s = loadgen.generate_schedule(spec, POP_E, POP_T)
+        in_burst = ((s.t % 2.0) < 0.5).sum()
+        out_burst = len(s) - in_burst
+        # burst windows are 1/4 of the time at 8x the rate: they must
+        # carry well over half the arrivals
+        assert in_burst > out_burst
+
+    def test_diurnal_envelope(self):
+        spec = loadgen.LoadSpec(base_rps=100, diurnal_amp=0.5,
+                                diurnal_period_s=10.0)
+        # peak at t = period/4, trough at 3*period/4
+        assert loadgen.rate_at(spec, 2.5) == pytest.approx(150.0)
+        assert loadgen.rate_at(spec, 7.5) == pytest.approx(50.0)
+
+    def test_zipf_skew(self):
+        spec = loadgen.LoadSpec(duration_s=5.0, base_rps=400,
+                                zipf_s=1.2, seed=1)
+        s = loadgen.generate_schedule(spec, POP_E, POP_T)
+        counts = np.bincount(s.entry_ids, minlength=len(POP_E))
+        top = counts.max() / len(s)
+        # rank-1 under Zipf(1.2) over 50 entries holds >> uniform share
+        assert top > 3.0 / len(POP_E)
+
+    def test_slo_mix_and_validation(self):
+        spec = loadgen.LoadSpec(duration_s=2.0, base_rps=300, seed=0)
+        s = loadgen.generate_schedule(spec, POP_E, POP_T)
+        present = {s.slo_name(i) for i in range(len(s))}
+        assert present == set(shield.SLO_CLASSES)
+        bad = loadgen.LoadSpec(slo_mix=(("platinum", 1.0),))
+        with pytest.raises(ValueError, match="unknown SLO class"):
+            loadgen.generate_schedule(bad, POP_E, POP_T)
+
+
+class TestReplay:
+    def test_outcomes_recorded_and_open_loop(self):
+        spec = loadgen.LoadSpec(duration_s=0.3, base_rps=300, seed=2)
+        sched = loadgen.generate_schedule(spec, POP_E, POP_T)
+        assert len(sched) > 10
+        n_shed = 0
+
+        def submit(eid, tsb, slo=None):
+            nonlocal n_shed
+            if eid % 7 == 0:  # a deterministic admission reject slice
+                n_shed += 1
+                raise Shed("full", slo=slo)
+            fut: Future = Future()
+            fut.set_result(float(eid) * 2.0)
+            return fut
+
+        res = loadgen.replay(submit, sched, bus=NoopBus(),
+                             wait_timeout_s=10.0)
+        assert res.offered == len(sched)
+        assert res.submitted == len(sched) - n_shed
+        assert res.unresolved == 0
+        assert res.lost_futures() == 0
+        assert res.error_counts().get("Shed", 0) == n_shed
+        ok = np.isfinite(res.preds)
+        np.testing.assert_array_equal(
+            res.preds[ok], sched.entry_ids[ok].astype(np.float32) * 2)
+        by_class = res.latency_summary_by_class(sched)
+        assert sum(v["count"] for v in by_class.values()) == int(ok.sum())
+
+    def test_late_resolution_counts_unresolved(self):
+        sched = loadgen.generate_schedule(
+            loadgen.LoadSpec(duration_s=0.05, base_rps=100, seed=5),
+            POP_E, POP_T)
+        holds = []
+
+        def submit(eid, tsb, slo=None):
+            fut: Future = Future()
+            holds.append(fut)
+            return fut
+
+        res = loadgen.replay(submit, sched, bus=NoopBus(),
+                             wait_timeout_s=0.2)
+        assert res.unresolved == len(holds) > 0
+        for f in holds:  # resolve so no thread leaks a pending future
+            f.set_result(0.0)
+
+
+# -- 3. the router over injected transports ------------------------------
+
+def _probe_200(base_url, timeout_s):
+    return 200, {}
+
+
+def _mk_router(urls, post, cfg, probe=_probe_200):
+    return FleetRouter(urls, lambda eid: (10, 10), (8, 10_000, 10_000),
+                       cfg=cfg, transport_post=post,
+                       transport_probe=probe)
+
+
+def _rows(entries, value=2.0):
+    return [{"pred": float(e) * value} for e in entries]
+
+
+class TestHedgeRace:
+    """The bit-safety property (ISSUE-13 satellite): duplicate
+    dispatches of the same request return bit-identical predictions
+    and the Future resolves EXACTLY once, raced deterministically in
+    both orders with injected transports."""
+
+    CFG = FleetConfig(hedge_quantile_ms=30.0,
+                      router_flush_deadline_ms=0.0,
+                      health_poll_interval_s=60.0,
+                      dispatch_timeout_s=10.0)
+
+    def _race(self, hedge_wins: bool):
+        release_primary = threading.Event()
+        hedge_returned = threading.Event()
+        calls: list[str] = []
+        calls_lock = threading.Lock()
+
+        def post(base_url, entries, ts, timeout_s, trace=None,
+                 slo=None, dg=None):
+            with calls_lock:
+                calls.append(base_url)
+                nth = len(calls)
+            if nth == 1 and hedge_wins:
+                # primary leg: stall until the hedge has answered,
+                # then return the SAME bits late
+                assert release_primary.wait(10.0)
+            elif nth == 1:
+                # primary leg: straggle past the hedge threshold but
+                # answer FIRST
+                time.sleep(0.06)
+            elif nth == 2 and not hedge_wins:
+                # hedge leg: only answers after the primary settled
+                assert hedge_returned.wait(10.0)
+            return _rows(entries)
+
+        with _mk_router({"wa": "http://a", "wb": "http://b"}, post,
+                        self.CFG) as router:
+            fut = router.submit(5, 0)
+            if hedge_wins:
+                assert fut.result(timeout=10.0) == 10.0
+                release_primary.set()
+            else:
+                assert fut.result(timeout=10.0) == 10.0
+                hedge_returned.set()
+            # let the losing leg land before reading stats
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if len(calls) >= 2 and router.stats_dict()[
+                        "dispatched_batches"] >= 1:
+                    with router._lock:
+                        legs = router._inflight_legs
+                    if legs == 0:
+                        break
+                time.sleep(0.01)
+            stats = router.stats_dict()
+        assert len(calls) == 2, "the hedge leg never dispatched"
+        assert stats["hedge_fired"] == 1
+        assert stats["hedge_won"] == (1 if hedge_wins else 0)
+        assert stats["served"] == 1 and stats["failed"] == 0
+        assert fut.result() == 10.0  # still exactly the same bits
+
+    def test_hedge_leg_wins(self):
+        self._race(hedge_wins=True)
+
+    def test_primary_wins_late_hedge_ignored(self):
+        self._race(hedge_wins=False)
+
+
+class TestRetryExclusion:
+    """A flapping worker (transport fails, probe immediately
+    re-admits) must not eat the same request twice: the retry excludes
+    the observed-failing worker (ISSUE-13 satellite)."""
+
+    def test_retry_never_returns_to_the_failing_worker(self):
+        cfg = FleetConfig(router_flush_deadline_ms=0.0,
+                          health_poll_interval_s=0.02,
+                          probe_lost_after=1,
+                          dispatch_timeout_s=5.0, max_requeues=3)
+        w1_calls = []
+
+        def post(base_url, entries, ts, timeout_s, trace=None,
+                 slo=None, dg=None):
+            if base_url == "http://w1":
+                w1_calls.append(list(entries))
+                raise WorkerTransportError("w1 flaps on dispatch")
+            return _rows(entries)
+
+        n = 5
+        with _mk_router({"w1": "http://w1", "w2": "http://w2"}, post,
+                        cfg) as router:
+            for i in range(n):
+                # between requests the probe re-admits w1 (it answers
+                # 200) — without exclusion the retry could land on w1
+                # again and burn requeue budget nondeterministically
+                fut = router.submit(i + 1, 0)
+                assert fut.result(timeout=10.0) == (i + 1) * 2.0
+                time.sleep(0.06)  # let the prober re-admit w1
+            stats = router.stats_dict()
+        # every request failed on w1 exactly once and was served by w2
+        # on its FIRST retry — one requeue per request, never two
+        assert stats["served"] == n and stats["failed"] == 0
+        assert stats["requeues"] == len(w1_calls)
+        assert all(len(c) >= 1 for c in w1_calls)
+
+
+class TestRouterSloAdmission:
+    def test_evicts_lowest_class_and_rejects_with_shed(self):
+        cfg = FleetConfig(max_pending=2,
+                          router_flush_deadline_ms=60_000.0,
+                          health_poll_interval_s=60.0,
+                          dispatch_timeout_s=10.0)
+
+        def post(base_url, entries, ts, timeout_s, trace=None,
+                 slo=None, dg=None):
+            return _rows(entries)
+
+        with _mk_router({"w": "http://w"}, post, cfg) as router:
+            f_std = router.submit(1, 0)
+            f_be = router.submit(2, 0, slo="best_effort")
+            # a critical arrival at a full pending set evicts the
+            # NEWEST lowest-class request — f_be — never itself
+            f_crit = router.submit(3, 0, slo="critical")
+            assert isinstance(f_be.exception(timeout=5.0), Shed)
+            assert f_be.exception().slo == "best_effort"
+            with pytest.raises(Shed) as exc:
+                # a best_effort arrival outranks nothing queued
+                # ([standard, critical]) — it is the one shed
+                router.submit(4, 0, slo="best_effort")
+            assert exc.value.slo == "best_effort"
+            # a second critical evicts the standard request (strictly
+            # lower class) — lowest-class-first all the way up
+            f_crit2 = router.submit(5, 0, slo="critical")
+            assert isinstance(f_std.exception(timeout=5.0), Shed)
+            with pytest.raises(Shed):
+                # an all-critical pending set: peers never evict peers
+                router.submit(6, 0, slo="critical")
+            stats = router.stats_dict()
+            assert stats["shed_by_class"]["best_effort"] == 2
+            assert stats["shed_by_class"]["standard"] == 1
+            assert stats["shed_by_class"]["critical"] == 1
+            assert stats["pending"] == 2
+        # close() drains the admitted requests to real predictions
+        assert f_crit.result(timeout=5.0) == 6.0
+        assert f_crit2.result(timeout=5.0) == 10.0
+
+
+class TestRouterBrownout:
+    def test_best_effort_downgraded_on_the_wire(self):
+        cfg = FleetConfig(max_pending=4, brownout_enter_ratio=0.5,
+                          router_flush_deadline_ms=60_000.0,
+                          health_poll_interval_s=60.0,
+                          dispatch_timeout_s=10.0)
+        seen: list[tuple] = []
+
+        def post(base_url, entries, ts, timeout_s, trace=None,
+                 slo=None, dg=None):
+            seen.append((list(entries), slo, dg))
+            return _rows(entries)
+
+        with _mk_router({"w": "http://w"}, post, cfg) as router:
+            futs = [router.submit(1, 0, slo="best_effort"),
+                    router.submit(2, 0),
+                    router.submit(3, 0, slo="best_effort")]
+            # occupancy 3/4 >= 0.5: the dispatch tick (the close drain
+            # below) enters brownout and stamps downgrade verdicts
+        for f in futs:
+            assert np.isfinite(f.result(timeout=10.0))
+        stats = router.stats_dict()
+        assert stats["brownout_active"] is True
+        entries_, slo_, dg_ = seen[0]
+        assert dg_ == [True, False, True]  # best_effort only
+        assert slo_ == ["best_effort", None, "best_effort"]
+
+
+class TestElasticMembership:
+    def test_add_and_remove_worker_live(self):
+        cfg = FleetConfig(router_flush_deadline_ms=0.0,
+                          health_poll_interval_s=60.0,
+                          dispatch_timeout_s=10.0)
+
+        def post(base_url, entries, ts, timeout_s, trace=None,
+                 slo=None, dg=None):
+            return _rows(entries)
+
+        with _mk_router({"w1": "http://w1"}, post, cfg) as router:
+            router.add_worker("spare0", "http://s0")
+            assert "spare0" in router.stats_dict()["workers"]
+            with pytest.raises(ValueError):
+                router.add_worker("spare0", "http://dup")
+            assert router.predict(7, 0, timeout=10.0) == 14.0
+            router.remove_worker("spare0")
+            router.remove_worker("spare0")  # idempotent
+            stats = router.stats_dict()
+            assert "spare0" not in stats["workers"]
+            assert stats["worker_added"] == 1
+            assert stats["worker_removed"] == 1
+            # the shrunk fleet still serves
+            assert router.predict(8, 0, timeout=10.0) == 16.0
+
+
+def test_lock_discipline_scope_covers_the_new_fleet_modules():
+    """The satellite pin: graftlint's lock-discipline pass must scan
+    the new THREADED fleet modules (loadgen's replay callbacks, the
+    autoscale controller, the hedger) — they all live under
+    pertgnn_tpu/fleet/, so the prefix must stay in SCOPE, and the
+    AutoscaleController allowlist entries must stay live (dead
+    exemptions are a data race with a permission slip)."""
+    import os
+
+    from tools.graftlint.passes import lock_discipline
+
+    assert "pertgnn_tpu/fleet/" in lock_discipline.SCOPE
+    fleet_dir = os.path.dirname(loadgen.__file__)
+    for mod in ("loadgen.py", "autoscale.py", "shield.py", "router.py"):
+        assert os.path.exists(os.path.join(fleet_dir, mod))
+    assert any(cls == "AutoscaleController"
+               for cls, _attr in lock_discipline.ALLOWLIST)
+
+
+# -- 4. the autoscale controller (fake router, injected clock) -----------
+
+class _FakeRouter:
+    def __init__(self):
+        self.signal = 0.0
+        self.added: list = []
+        self.removed: list = []
+
+    def queue_wait_signal_ms(self, window_s=2.0):
+        return self.signal
+
+    def add_worker(self, wid, url):
+        self.added.append(wid)
+
+    def remove_worker(self, wid):
+        self.removed.append(wid)
+
+
+def _mk_controller(router, max_spares=2, **kw):
+    spawned = []
+
+    def spawn(i):
+        spawned.append(i)
+        return f"spare{i}", f"http://spare{i}", object(), \
+            {"compiles": 0, "arena_warm": True}
+
+    stopped = []
+
+    def stop(wid, handle):
+        stopped.append(wid)
+
+    ctrl = AutoscaleController(
+        router, spawn_spare=spawn, stop_spare=stop,
+        max_spares=max_spares, up_ms=50.0, down_ms=10.0, hold_s=1.0,
+        cooldown_s=5.0, bus=NoopBus(), **kw)
+    return ctrl, spawned, stopped
+
+
+class TestAutoscale:
+    def test_hold_then_spawn_then_cooldown_retire(self):
+        router = _FakeRouter()
+        ctrl, spawned, stopped = _mk_controller(router)
+        router.signal = 100.0
+        assert ctrl.step(0.0) is None     # over, hold starts
+        assert ctrl.step(0.5) is None     # still holding
+        assert ctrl.step(1.0) == "up"     # hold_s reached
+        assert router.added == ["spare0"]
+        assert ctrl.step(1.1) is None     # hold re-arms per spawn
+        assert ctrl.step(2.2) == "up"     # second sustained crossing
+        assert ctrl.step(3.5) is None     # at max_spares
+        router.signal = 0.0
+        assert ctrl.step(4.0) is None     # under, cooldown starts
+        assert ctrl.step(8.9) is None
+        assert ctrl.step(9.0) == "down"   # cooldown_s reached
+        assert router.removed == ["spare1"]  # LIFO: newest first
+        assert ctrl.step(9.1) is None     # cooldown re-arms
+        assert ctrl.step(14.2) == "down"
+        assert router.removed == ["spare1", "spare0"]
+        assert stopped == ["spare1", "spare0"]
+        st = ctrl.stats_dict()
+        assert st["spawned"] == 2 and st["retired"] == 2
+        assert st["spares"] == [] and not st["spawning"]
+
+    def test_signal_dip_resets_the_hold(self):
+        router = _FakeRouter()
+        ctrl, spawned, _ = _mk_controller(router)
+        router.signal = 100.0
+        ctrl.step(0.0)
+        router.signal = 0.0
+        ctrl.step(0.5)                    # dip clears over_since
+        router.signal = 100.0
+        assert ctrl.step(0.9) is None     # hold restarts here
+        assert ctrl.step(1.8) is None
+        assert ctrl.step(1.95) == "up"
+        assert spawned == [0]
+
+    def test_spawn_failure_counted_and_retried(self):
+        router = _FakeRouter()
+        boom = [True]
+
+        def spawn(i):
+            if boom[0]:
+                boom[0] = False
+                raise RuntimeError("port bind race")
+            return f"spare{i}", "http://s", object(), {"compiles": 0}
+
+        ctrl = AutoscaleController(
+            router, spawn_spare=spawn, stop_spare=lambda w, h: None,
+            max_spares=1, up_ms=50.0, down_ms=10.0, hold_s=0.1,
+            cooldown_s=5.0, bus=NoopBus())
+        router.signal = 100.0
+        ctrl.step(0.0)
+        assert ctrl.step(0.2) is None     # spawn raised
+        assert ctrl.stats_dict()["spawn_failed"] == 1
+        ctrl.step(0.3)
+        assert ctrl.step(0.5) == "up"     # retried on the next hold
+        assert router.added == ["spare0"]
+
+    def test_close_force_retires(self):
+        router = _FakeRouter()
+        ctrl, _, stopped = _mk_controller(router, max_spares=1)
+        router.signal = 100.0
+        ctrl.step(0.0)
+        assert ctrl.step(1.0) == "up"
+        ctrl.close()
+        assert router.removed == ["spare0"]
+        assert stopped == ["spare0"]

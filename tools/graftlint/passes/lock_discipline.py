@@ -78,6 +78,20 @@ ALLOWLIST: dict[tuple[str, str], str] = {
         "drain-marker latch; worker-only, except close() which reads "
         "AND writes it only after joining the worker (single-threaded "
         "by then)",
+    # fleet/autoscale.py AutoscaleController — control-thread-only
+    # state: step() runs exclusively on the control thread (or a
+    # test's driver thread, never both — start() is how the thread
+    # comes to exist); the lock guards only the spares list /
+    # totals that stats_dict() snapshots cross-thread.
+    ("AutoscaleController", "_thread"):
+        "written once in start() BEFORE the control thread exists; "
+        "read only by close() after _stop is set",
+    ("AutoscaleController", "_over_since"):
+        "hysteresis bookkeeping; step() is control-thread-only by "
+        "design (documented on the attribute)",
+    ("AutoscaleController", "_under_since"):
+        "hysteresis bookkeeping; step() is control-thread-only by "
+        "design",
 }
 # (serve/queue.py's _Dispatcher owns a Thread but synchronizes via a
 # Semaphore, not a lock, so the lock-owning-class criterion skips it —
