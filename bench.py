@@ -883,32 +883,49 @@ def gate_check(result: dict, history: list[dict]) -> tuple[bool, dict]:
     return ok, detail
 
 
-def _graftlint_refusal() -> list[str]:
-    """New graftlint violations in this working tree, as strings —
-    nonempty means --gate must refuse the capture: a tree that fails
-    static analysis is not a valid perf witness, the same loud-refusal
-    contract as the kernel-fallback check (a capture from a known-buggy
-    tree would launder its numbers into the history).
-    BENCH_GATE_SKIP_LINT=1 is the explicit, greppable escape hatch."""
+def _analyzer_refusal(label: str, skip_env: str) -> list[str]:
+    """New violations from one in-process stdlib analyzer (graftlint /
+    graftsync), as strings — nonempty means --gate must refuse the
+    capture: a tree that fails static analysis is not a valid perf
+    witness, the same loud-refusal contract as the kernel-fallback
+    check (a capture from a known-buggy tree would launder its numbers
+    into the history). `skip_env`=1 is the explicit, greppable escape
+    hatch, and a broken analyzer fails the gate LOUDLY, never passes
+    it. The module is resolved via its `tools.<label>` package
+    attribute at call time so tests can monkeypatch `run_repo`."""
+    import importlib
     import sys
 
-    if os.environ.get("BENCH_GATE_SKIP_LINT", "") not in ("", "0"):
-        print("WARNING: BENCH_GATE_SKIP_LINT set — gating WITHOUT the "
-              "graftlint check", file=sys.stderr)
+    if os.environ.get(skip_env, "") not in ("", "0"):
+        print(f"WARNING: {skip_env} set — gating WITHOUT the "
+              f"{label} check", file=sys.stderr)
         return []
     repo = os.path.dirname(os.path.abspath(__file__))
     if repo not in sys.path:
         sys.path.insert(0, repo)
     try:
-        from tools.graftlint import run_repo
-        result = run_repo(repo)
+        mod = importlib.import_module(f"tools.{label}")
+        result = mod.run_repo(repo)
     except Exception as e:
-        # a broken lint harness must fail the gate LOUDLY, not pass it
-        print(f"WARNING: graftlint could not run "
+        print(f"WARNING: {label} could not run "
               f"({type(e).__name__}: {e}); refusing the gate",
               file=sys.stderr)
-        return [f"graftlint could not run: {type(e).__name__}: {e}"]
+        return [f"{label} could not run: {type(e).__name__}: {e}"]
     return [str(v) for v in result.new]
+
+
+def _graftlint_refusal() -> list[str]:
+    """Source-level lint refusal (docs/LINTS.md)."""
+    return _analyzer_refusal("graftlint", "BENCH_GATE_SKIP_LINT")
+
+
+def _graftsync_refusal() -> list[str]:
+    """Thread-protocol refusal: numbers captured from a tree whose
+    lock order / Future custody / CV protocol / wait bounds fail
+    verification are not a valid perf witness — tail latency measured
+    over a racy dispatch path measures the race (docs/LINTS.md
+    "graftsync")."""
+    return _analyzer_refusal("graftsync", "BENCH_GATE_SKIP_SYNC")
 
 
 def _graftaudit_refusal() -> list[str]:
@@ -981,12 +998,14 @@ def _graftaudit_refusal() -> list[str]:
 def gate_main(argv: list[str]) -> int:
     """`bench.py --gate [result.json]`: exit 1 when a finished run's
     headline throughput fell beyond the history's recorded window
-    spread — or when the working tree fails `python -m tools.graftlint`
-    or `python -m tools.graftaudit` (a capture from a tree that fails
-    static analysis — source-level lint or traced-program audit — is
+    spread — or when the working tree fails `python -m tools.graftlint`,
+    `python -m tools.graftsync`, or `python -m tools.graftaudit` (a
+    capture from a tree that fails static analysis — source-level
+    lint, thread-protocol verification, or traced-program audit — is
     refused outright, same pattern as the kernel-fallback refusal;
-    BENCH_GATE_SKIP_LINT=1 / BENCH_GATE_SKIP_AUDIT=1 are the explicit
-    hatches). The result record comes
+    BENCH_GATE_SKIP_LINT=1 / BENCH_GATE_SKIP_SYNC=1 /
+    BENCH_GATE_SKIP_AUDIT=1 are the explicit hatches). The result
+    record comes
     from the given path (a saved bench stdout line, or a BENCH_r-style
     wrapper whose `parsed` field holds it) or from stdin when piped."""
     import sys
@@ -1023,6 +1042,18 @@ def gate_main(argv: list[str]) -> int:
                         f"a valid perf witness (fix or baseline them: "
                         f"python -m tools.graftlint)"),
             "graftlint": lint[:20],
+        }}))
+        return 1
+    sync = _graftsync_refusal()
+    if sync:
+        print(json.dumps({"gate": {
+            "verdict": (f"FAIL: graftsync reports {len(sync)} "
+                        f"violation(s) in this working tree — a "
+                        f"capture from a tree whose thread protocols "
+                        f"fail static verification is not a valid "
+                        f"perf witness (fix or justify them: python "
+                        f"-m tools.graftsync)"),
+            "graftsync": sync[:20],
         }}))
         return 1
     audit = _graftaudit_refusal()

@@ -131,7 +131,8 @@ def drive(queue, entries, ts_buckets, concurrency: int = 8,
                 with lock:
                     errors[i] = type(exc).__name__
     threads = [threading.Thread(
-        target=client, args=(range(t, len(entries), concurrency),))
+        target=client, args=(range(t, len(entries), concurrency),),
+        name=f"chaos-client-{t}")
         for t in range(concurrency)]
     for t in threads:
         t.start()

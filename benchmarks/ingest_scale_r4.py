@@ -100,7 +100,7 @@ def run_cli(data_dir: str, artifact_dir: str,
     # the child exactly at the scale this harness exists to measure.
     chunks: list[str] = []
     drainer = threading.Thread(target=lambda: chunks.append(
-        proc.stdout.read()), daemon=True)
+        proc.stdout.read()), daemon=True, name="ingest-scale-drain")
     drainer.start()
 
     peak_kb = 0

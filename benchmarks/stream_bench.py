@@ -490,7 +490,9 @@ def check_rollout(check: Check, tmp: str, cfg, base_ds, budget,
                      (top.max_graphs, top.max_nodes, top.max_edges),
                      cfg=cfg.fleet) as router:
         threads = [threading.Thread(target=client, args=(router, t),
-                                    daemon=True) for t in range(8)]
+                                    daemon=True,
+                                    name=f"stream-client-{t}")
+                   for t in range(8)]
         for t in threads:
             t.start()
         time.sleep(1.0)  # traffic flowing before the first drain

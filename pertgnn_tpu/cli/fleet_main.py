@@ -569,7 +569,8 @@ def _run_launcher(args, p: argparse.ArgumentParser,
                             target=client,
                             args=(router,
                                   range(t, len(entries),
-                                        max(1, args.concurrency))))
+                                        max(1, args.concurrency))),
+                            name=f"fleet-client-{t}")
                             for t in range(max(1, args.concurrency))]
                         for t in threads:
                             t.start()

@@ -249,7 +249,8 @@ def main(argv=None) -> None:
             # queue's own)
             threads = [threading.Thread(
                 target=client,
-                args=(range(t, len(entries), args.concurrency),))
+                args=(range(t, len(entries), args.concurrency),),
+                name=f"serve-client-{t}")
                 for t in range(max(1, args.concurrency))]
             for t in threads:
                 t.start()

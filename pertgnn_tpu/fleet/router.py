@@ -102,6 +102,7 @@ from concurrent.futures import Future
 from pertgnn_tpu import telemetry
 from pertgnn_tpu.config import FleetConfig
 from pertgnn_tpu.fleet import policy, shield
+from pertgnn_tpu.testing import schedules
 from pertgnn_tpu.telemetry.tracing import new_span_id
 from pertgnn_tpu.fleet.transport import (WorkerTransportError,
                                          error_from_row, get_probe,
@@ -774,6 +775,11 @@ class FleetRouter:
                             r.tm_queue_start, tm_now,
                             worker=target.worker_id,
                             attempt=r.requeues)
+                # interleaving hook (testing/schedules.py): the gap a
+                # concurrent remove_worker can land in — the window
+                # the membership re-check below exists for;
+                # tests/test_schedules.py drives both orders
+                schedules.sync_point("fleet.assign.handoff")
                 with self._wake:
                     # the handoff must be atomic with membership:
                     # remove_worker drains the sender queue and sends
@@ -782,13 +788,17 @@ class FleetRouter:
                     # futures never resolve, close() hangs on the leg
                     # count). If the worker retired in the gap, undo
                     # the leg accounting and re-choose.
-                    if self._workers.get(target.worker_id) is target:
+                    handed = self._workers.get(target.worker_id) is target
+                    if handed:
                         target.sender_q.put(flight)
-                        return
-                    self._release_leg_locked(target, flight)
-                    target.dispatches -= 1
-                    self.dispatched_batches -= 1
-                    self.dispatched_requests -= len(batch)
+                    else:
+                        self._release_leg_locked(target, flight)
+                        target.dispatches -= 1
+                        self.dispatched_batches -= 1
+                        self.dispatched_requests -= len(batch)
+                schedules.sync_point("fleet.assign.handoff_done")
+                if handed:
+                    return
                 target = flight = None
 
     # -- hedging ---------------------------------------------------------

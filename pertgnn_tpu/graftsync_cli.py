@@ -1,0 +1,39 @@
+"""Console-script launcher for graftsync (docs/LINTS.md).
+
+Same pattern as graftlint_cli.py / graftaudit_cli.py: graftsync
+analyzes a SOURCE TREE's thread protocols, so it only makes sense
+where one exists — an editable (in-repo) install, where this package
+sits inside the repo checkout and `tools/graftsync/` is its sibling.
+The launcher lives inside `pertgnn_tpu` so the wheel never ships a
+generic top-level `tools` package (namespace squatting), while the
+`graftsync` entry point still works in the install mode where the
+tool is usable — and fails with a clear message, not a
+ModuleNotFoundError, everywhere else.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(repo, "tools", "graftsync")):
+        print(
+            "graftsync: no tools/graftsync next to this package — the "
+            "analyzer reads a repo working tree's thread protocols, "
+            "which only an editable (in-repo) install has. From a "
+            "checkout, run `python -m tools.graftsync` "
+            "(docs/LINTS.md).",
+            file=sys.stderr)
+        return 2
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.graftsync.cli import main as graftsync_main
+
+    return graftsync_main(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,13 +1,16 @@
-"""Deterministic fault injection for robustness validation.
+"""Deterministic fault injection and interleaving control.
 
-Production code consults :mod:`pertgnn_tpu.testing.faults` at a handful
-of named hook sites (the serve dispatch, rung compiles, checkpoint
-saves). With no plan installed every hook is one module-global read —
-the subsystem costs nothing unless a test or benchmarks/chaos_bench.py
-arms it.
+Production code consults :mod:`pertgnn_tpu.testing.faults` (what
+happens) and :mod:`pertgnn_tpu.testing.schedules` (in which order) at a
+handful of named hook sites — the serve dispatch, rung compiles,
+checkpoint saves, the router's sender handoff. With no plan/scheduler
+installed every hook is one module-global read — the subsystem costs
+nothing unless a test or a chaos bench arms it.
 """
 
+from pertgnn_tpu.testing import schedules
 from pertgnn_tpu.testing.faults import (FaultPlan, FaultSpec, InjectedFault,
                                         active, install)
 
-__all__ = ["FaultPlan", "FaultSpec", "InjectedFault", "active", "install"]
+__all__ = ["FaultPlan", "FaultSpec", "InjectedFault", "active", "install",
+           "schedules"]

@@ -1,0 +1,166 @@
+"""Deterministic interleaving harness — the dynamic twin of graftsync.
+
+graftsync (tools/graftsync) proves thread-protocol properties
+statically; this module drives the interleavings the proofs cannot
+reach: a :class:`ScriptedScheduler` turns named SYNC POINTS into a
+totally ordered script, so a race is explored in BOTH orders on
+purpose instead of once per lucky chaos draw (the generalization of
+the PR-13 hedge race test, which hand-built the same idea from two
+Events).
+
+Production code exposes a sync point the same way it exposes a fault
+hook (pertgnn_tpu/testing/faults.py): one module-global read —
+
+    from pertgnn_tpu.testing import schedules
+    ...
+    schedules.sync_point("fleet.assign.handoff")
+
+With no scheduler installed the call is a None check and costs
+nothing. Under a test, ``install(ScriptedScheduler([...]))`` makes
+every listed point BLOCK until it is the next unconsumed entry of the
+script; points not (or no longer) in the script pass through freely,
+so the same instrumented code runs under any script — including the
+empty one.
+
+Deadlock safety: a point that cannot become the head within
+``timeout_s`` marks the scheduler BROKEN and raises
+:class:`ScheduleTimeout` in every blocked thread — a test failure,
+never a hung suite (the tier-1 watchdog in tests/conftest.py is the
+backstop of last resort).
+
+Current production sync points:
+
+- ``fleet.assign.handoff`` — fleet/router.py ``_assign``, after the
+  worker is chosen and the flight accounted, before the
+  membership-atomic sender handoff (the ``remove_worker`` race
+  window);
+- ``fleet.assign.handoff_done`` — same site, after the flight was
+  handed to (or released from) the chosen sender.
+
+tests/test_schedules.py drives the three nastiest races in both
+orders through this harness (hedge-settle vs. primary-answer,
+autoscale ``remove_worker`` vs. in-flight dispatch, drain vs. queue
+close) and pins bit-identical, exactly-once resolution under every
+explored order.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["ScheduleTimeout", "ScriptedScheduler", "sync_point",
+           "install", "uninstall", "active"]
+
+
+class ScheduleTimeout(RuntimeError):
+    """A scripted point could not be reached/consumed in time — the
+    schedule deadlocked (or the script names a point the code never
+    hits). Every thread blocked on the scheduler gets this."""
+
+
+class ScriptedScheduler:
+    """A totally ordered script over named sync points.
+
+    ``script`` is the exact order in which the listed points may
+    proceed; each entry is consumed once. ``point(name)``:
+
+    - name not in the remaining script → passes through immediately
+      (recorded in :attr:`passed` for debugging, not in
+      :attr:`trace`);
+    - name is the head → consumes it, notifies everyone, proceeds;
+    - name appears later → blocks until everything before it has been
+      consumed (or ``timeout_s`` passes → broken + ScheduleTimeout
+      everywhere).
+
+    Use as a context manager to install/uninstall around a test;
+    :meth:`finished` tells whether the whole script was consumed.
+    """
+
+    def __init__(self, script: list[str], timeout_s: float = 10.0):
+        self.script = list(script)
+        self.timeout_s = float(timeout_s)
+        self.trace: list[str] = []     # consumed points, in order
+        self.passed: list[str] = []    # unscripted pass-throughs
+        self._pos = 0
+        self._cv = threading.Condition()
+        self._broken: str | None = None
+
+    # -- the point --------------------------------------------------------
+
+    def point(self, name: str) -> None:
+        with self._cv:
+            if self._broken is not None:
+                raise ScheduleTimeout(self._broken)
+            if name not in self.script[self._pos:]:
+                self.passed.append(name)
+                return
+            deadline = time.monotonic() + self.timeout_s
+            while (self._broken is None
+                   and (self._pos >= len(self.script)
+                        or self.script[self._pos] != name)):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(
+                        timeout=min(remaining, self.timeout_s)):
+                    if remaining <= 0:
+                        self._broken = (
+                            f"sync point {name!r} waited "
+                            f"{self.timeout_s:g}s for script head "
+                            f"{self.script[self._pos:][:3]!r} — the "
+                            f"schedule deadlocked")
+                        self._cv.notify_all()
+                        raise ScheduleTimeout(self._broken)
+            if self._broken is not None:
+                raise ScheduleTimeout(self._broken)
+            self._pos += 1
+            self.trace.append(name)
+            self._cv.notify_all()
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def finished(self) -> bool:
+        with self._cv:
+            return self._pos >= len(self.script)
+
+    def abort(self, reason: str = "aborted by the test") -> None:
+        """Wake every blocked thread with ScheduleTimeout — cleanup
+        path for a test that already failed for another reason."""
+        with self._cv:
+            self._broken = reason
+            self._cv.notify_all()
+
+    def __enter__(self) -> "ScriptedScheduler":
+        install(self)
+        return self
+
+    def __exit__(self, exc_type, *exc) -> bool:
+        uninstall()
+        if exc_type is not None:
+            self.abort(f"test raised {exc_type.__name__}")
+        return False
+
+
+# -- module-global hook ----------------------------------------------------
+
+_ACTIVE: ScriptedScheduler | None = None
+
+
+def active() -> ScriptedScheduler | None:
+    return _ACTIVE
+
+
+def install(scheduler: ScriptedScheduler) -> None:
+    global _ACTIVE
+    _ACTIVE = scheduler
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def sync_point(name: str) -> None:
+    """The production hook: free when no scheduler is installed."""
+    s = _ACTIVE
+    if s is not None:
+        s.point(name)

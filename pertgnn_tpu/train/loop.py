@@ -291,7 +291,8 @@ def _background(items: Iterator, depth: int = 2) -> Iterator:
         except BaseException as e:  # lint: allow-silent-except — surfaced at the consumer
             q.put(e)
 
-    t = threading.Thread(target=produce, daemon=True)
+    t = threading.Thread(target=produce, daemon=True,
+                         name="train-device-prefetch")
     t.start()
     while True:
         it = q.get()

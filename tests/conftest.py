@@ -5,7 +5,25 @@ Multi-chip sharding logic is tested on a virtual CPU mesh
 the driver's dryrun.
 """
 
+import faulthandler
 import os
+import signal
+
+# Tier-1 deadlock watchdog (ISSUE-14 satellite): the tier-1 command is
+# `timeout -k 10 870 ... pytest ...` — at the budget, `timeout` sends
+# SIGTERM, then SIGKILL 10 s later. Register faulthandler on SIGTERM so
+# that moment dumps EVERY thread's stack to stderr: a lock-order
+# regression (or any wedged thread the graftsync proofs missed)
+# produces a readable report naming the threads (all named since this
+# PR — thread-lifecycle pass) and the frames they are blocked in,
+# instead of an opaque 870 s hard kill. faulthandler's handler is
+# C-level and fires even when every Python thread is deadlocked (a
+# Python signal handler would wait for the main thread's bytecode, i.e.
+# forever). The process no longer dies on SIGTERM itself — `timeout
+# -k`'s SIGKILL (or any supervisor's) remains the terminator, 10 s
+# after the dump.
+if hasattr(signal, "SIGTERM"):
+    faulthandler.register(signal.SIGTERM, all_threads=True, chain=False)
 
 # Override unconditionally: the live session presets JAX_PLATFORMS=axon (the
 # one-chip TPU tunnel) and the axon plugin wins over the env var — the config

@@ -1,0 +1,551 @@
+"""tools/graftsync: static concurrency verification, run over the real
+repo in tier-1 — lock-order cycles, blocking-while-locked, dropped
+Future custody, CV-protocol breaks, unnamed/unjoined threads, and
+unbounded waits must stay mechanically impossible (docs/LINTS.md).
+
+Fixture tests build miniature repos under tmp_path (graftlint's
+Context only needs the path shape); THE gate is
+test_repo_syncs_clean, which runs every pass over the live tree
+inside a wall-clock budget. Per-pass NEGATIVE fixtures pin that each
+pass still detects its planted bug — the repo-wide clean pin cannot
+go vacuous — and the justification tables are liveness-pinned: an
+entry that no longer suppresses a real finding fails here.
+"""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftsync import driver, justify, run_repo  # noqa: E402
+from tools.graftsync.cli import main as cli_main  # noqa: E402
+from tools.graftsync.passes import get_passes  # noqa: E402
+
+BUDGET_S = 60.0  # the ISSUE-14 acceptance bound; measured ~1 s
+
+
+def _mini_repo(tmp_path, files: dict[str, str]) -> str:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _run(tmp_path, files, passes=None):
+    repo = _mini_repo(tmp_path, files)
+    return driver.run_passes(repo, passes, baseline_path="")
+
+
+# --- THE tier-1 gate -----------------------------------------------------
+
+
+def test_repo_syncs_clean():
+    """Every pass, whole repo, zero NEW violations, under the budget."""
+    t0 = time.perf_counter()
+    result = run_repo(REPO)
+    elapsed = time.perf_counter() - t0
+    assert result.new == [], "\n".join(str(v) for v in result.new)
+    assert elapsed < BUDGET_S, (
+        f"graftsync took {elapsed:.1f}s — over the {BUDGET_S:.0f}s "
+        f"budget the ISSUE-14 acceptance pins")
+
+
+def test_all_five_passes_registered():
+    names = [m.RULE for m in get_passes(None)]
+    assert names == ["lock-order", "future-lifecycle", "cv-protocol",
+                     "thread-lifecycle", "timeout-totality"]
+
+
+def test_justification_tables_are_live():
+    """Every (path, key) entry in every graftsync table must still be
+    suppressing a REAL finding on the live tree — a dead exemption is
+    a hole in the proof with a permission slip. (SINGLE_WRITER's
+    liveness is pinned by test_graftlint.py, its consumer.)"""
+    result = run_repo(REPO)
+    hits = result.justification_hits
+    for rule, table in justify.TABLES.items():
+        live = hits.get(rule, set())
+        dead = set(table) - live
+        assert not dead, (
+            f"dead {rule} justification entries (the findings they "
+            f"suppressed no longer exist — delete them): {sorted(dead)}")
+
+
+def test_single_writer_is_the_shared_table():
+    """The fold satellite: graftlint's lock-discipline ALLOWLIST must
+    BE the shared table, not a copy that can drift."""
+    from tools.graftlint.passes import lock_discipline
+
+    assert lock_discipline.ALLOWLIST is justify.SINGLE_WRITER
+
+
+# --- per-pass negative fixtures (the proof cannot go vacuous) -------------
+
+
+_CYCLE = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._la = threading.Lock()
+            self._lb = threading.Lock()
+
+        def ab(self):
+            with self._la:
+                with self._lb:
+                    pass
+
+        def ba(self):
+            with self._lb:
+                with self._la:
+                    pass
+"""
+
+
+def test_lock_order_detects_cycles(tmp_path):
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/c.py": _CYCLE},
+               ["lock-order"])
+    assert any("cycle" in v.message for v in res.new), res.new
+
+
+def test_lock_order_detects_blocking_under_lock(tmp_path):
+    src = """
+        import threading
+        import time
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/serve/b.py": src},
+               ["lock-order"])
+    assert len(res.new) == 1 and "time.sleep" in res.new[0].message
+
+
+def test_lock_order_sees_through_same_file_calls(tmp_path):
+    """The same-file call fixpoint: a helper that blocks, called under
+    a lock, is flagged at the locked call site."""
+    src = """
+        import queue
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def outer(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                self._q.get(timeout=1.0)
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/h.py": src},
+               ["lock-order"])
+    assert any("helper" in v.message for v in res.new), res.new
+
+
+def test_lock_order_condition_wait_on_own_lock_is_fine(tmp_path):
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._wake = threading.Condition(self._lock)
+
+            def ok(self):
+                with self._wake:
+                    while self.pending():
+                        self._wake.wait(timeout=1.0)
+
+            def pending(self):
+                return False
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/serve/w.py": src},
+               ["lock-order"])
+    assert res.new == [], res.new
+
+
+_DROP = """
+    class A:
+        def __init__(self):
+            self._closed = False
+
+        def handoff(self, flight):
+            if self._closed:
+                return
+            self.send(flight)
+
+        def send(self, flight):
+            flight.resolve()
+"""
+
+
+def test_future_lifecycle_detects_dropped_custody(tmp_path):
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/d.py": _DROP},
+               ["future-lifecycle"])
+    assert len(res.new) == 1, res.new
+    v = res.new[0]
+    assert "flight" in v.message and v.key == "A.handoff:flight"
+    # `send` touches flight on its only path — clean
+
+
+def test_future_lifecycle_empty_guard_is_exempt(tmp_path):
+    src = """
+        class A:
+            def fail_expired(self, expired):
+                if not expired:
+                    return
+                for r in expired:
+                    r.future.set_exception(ValueError("x"))
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/serve/g.py": src},
+               ["future-lifecycle"])
+    assert res.new == [], res.new
+
+
+def test_future_lifecycle_detects_dropped_created_future(tmp_path):
+    src = """
+        from concurrent.futures import Future
+
+        class A:
+            def submit(self, closed):
+                fut = Future()
+                if closed:
+                    return None
+                self._pending.append(fut)
+                return fut
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/serve/f.py": src},
+               ["future-lifecycle"])
+    assert len(res.new) == 1 and "escaping" in res.new[0].message
+
+
+def test_cv_protocol_detects_all_three_breaks(tmp_path):
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+
+            def waiter(self):
+                with self._cv:
+                    self._cv.wait(timeout=1.0)
+
+            def nudge(self):
+                self._cv.notify_all()
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/serve/cv.py": src},
+               ["cv-protocol"])
+    msgs = "\n".join(v.message for v in res.new)
+    assert "predicate-rechecking loop" in msgs          # wait not in loop
+    assert "notify_all()` without holding" in msgs      # unlocked notify
+
+
+def test_cv_protocol_detects_never_notified(tmp_path):
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def waiter(self):
+                with self._cv:
+                    while True:
+                        self._cv.wait(timeout=1.0)
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/nn.py": src},
+               ["cv-protocol"])
+    assert any("NEVER notified" in v.message for v in res.new), res.new
+
+
+def test_cv_protocol_clean_protocol_passes(tmp_path):
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._ready = False
+
+            def waiter(self):
+                with self._cv:
+                    while not self._ready:
+                        self._cv.wait(timeout=1.0)
+
+            def producer(self):
+                with self._lock:
+                    self._ready = True
+                    self._cv.notify_all()
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/serve/okcv.py": src},
+               ["cv-protocol"])
+    assert res.new == [], res.new
+
+
+def test_thread_lifecycle_detects_unnamed_and_unjoined(tmp_path):
+    src = """
+        import threading
+
+        def orphan():
+            t = threading.Thread(target=print)
+            t.start()
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/t.py": src},
+               ["thread-lifecycle"])
+    msgs = "\n".join(v.message for v in res.new)
+    assert "without `name=`" in msgs and "no reachable `.join()`" in msgs
+
+
+def test_thread_lifecycle_accepts_named_joined_list(tmp_path):
+    src = """
+        import threading
+
+        def fan_out(n):
+            threads = [threading.Thread(target=print, name=f"w-{i}")
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/serve/tl.py": src},
+               ["thread-lifecycle"])
+    assert res.new == [], res.new
+
+
+def test_timeout_totality_detects_unbounded_waits(tmp_path):
+    src = """
+        import queue
+        import threading
+
+        class A:
+            def __init__(self):
+                self._q = queue.SimpleQueue()
+                self._t = threading.Thread(target=print, name="x",
+                                           daemon=True)
+
+            def drain(self):
+                item = self._q.get()
+                self._t.join()
+                return item
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/to.py": src},
+               ["timeout-totality"])
+    keys = {v.key for v in res.new}
+    assert keys == {"A.drain:get@self._q", "A.drain:join@self._t"}, keys
+
+
+def test_timeout_totality_get_block_positional_is_not_a_timeout(
+        tmp_path):
+    """Queue.get's FIRST positional is `block`, not a timeout:
+    `q.get(True)` is the unbounded wait the pass exists to catch,
+    while `q.get(False)` / `q.get(True, 1.0)` are bounded."""
+    src = """
+        import queue
+
+        class A:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            def bad(self):
+                return self._q.get(True)
+
+            def fine(self):
+                self._q.get(False)
+                return self._q.get(True, 1.0)
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/qb.py": src},
+               ["timeout-totality"])
+    assert {v.key for v in res.new} == {"A.bad:get@self._q"}, res.new
+
+
+def test_timeout_totality_explicit_none_timeout_is_unbounded(
+        tmp_path):
+    """`wait(timeout=None)` / `result(None)` spell out unboundedness —
+    they must not count as a bound (review fix)."""
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def bad(self, fut):
+                with self._cv:
+                    while True:
+                        self._cv.wait(timeout=None)
+                return fut.result(None)
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/serve/tn.py": src},
+               ["timeout-totality"])
+    keys = {v.key for v in res.new}
+    assert keys == {"A.bad:wait@self._cv", "A.bad:result@fut"}, res.new
+
+
+def test_lock_order_nonblocking_queue_ops_under_lock_are_fine(
+        tmp_path):
+    """`get(block=False)` / `get_nowait` never wait — a lock-held
+    drain loop using them must not be flagged (review fix)."""
+    src = """
+        import queue
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def drain(self):
+                with self._lock:
+                    while True:
+                        self._q.get(False)
+                        self._q.get(block=False)
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/nb.py": src},
+               ["lock-order"])
+    assert res.new == [], res.new
+
+
+def test_cv_protocol_justification_table_is_consulted(tmp_path,
+                                                      monkeypatch):
+    """Every pass must honor its justify table — cv-protocol
+    included (review fix: it silently did not)."""
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def waiter(self):
+                with self._cv:
+                    while True:
+                        self._cv.wait(timeout=1.0)
+    """
+    repo = _mini_repo(tmp_path, {"pertgnn_tpu/fleet/nn.py": src})
+    first = driver.run_passes(repo, ["cv-protocol"], baseline_path="")
+    assert len(first.new) == 1
+    monkeypatch.setitem(justify.CV_PROTOCOL,
+                        ("pertgnn_tpu/fleet/nn.py", first.new[0].key),
+                        "test: deliberately timeout-driven")
+    second = driver.run_passes(repo, ["cv-protocol"], baseline_path="")
+    assert second.new == []
+    assert (("pertgnn_tpu/fleet/nn.py", first.new[0].key)
+            in second.justification_hits.get("cv-protocol", set()))
+
+
+def test_timeout_totality_dict_get_is_not_a_queue(tmp_path):
+    src = """
+        class A:
+            def lookup(self, d):
+                return d.get("k")
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/serve/dg.py": src},
+               ["timeout-totality"])
+    assert res.new == [], res.new
+
+
+# --- driver mechanics -----------------------------------------------------
+
+
+def test_pragma_suppresses_on_the_line(tmp_path):
+    fixed = _DROP.replace(
+        "def handoff(self, flight):",
+        "def handoff(self, flight):"
+        "  # graftsync: allow-future-lifecycle")
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/d.py": fixed},
+               ["future-lifecycle"])
+    assert res.new == [], res.new
+
+
+def test_baseline_accepts_known_debt(tmp_path):
+    repo = _mini_repo(tmp_path, {"pertgnn_tpu/fleet/d.py": _DROP})
+    first = driver.run_passes(repo, ["future-lifecycle"],
+                              baseline_path="")
+    assert len(first.new) == 1
+    baseline = tmp_path / "baseline.json"
+    driver.write_baseline(str(baseline), first.new)
+    second = driver.run_passes(repo, ["future-lifecycle"],
+                               baseline_path=str(baseline))
+    assert second.new == [] and len(second.baselined) == 1
+
+
+def test_no_baseline_file_in_tree():
+    """The tree verifies clean with NO baseline file — the baseline is
+    for emergencies, not a parking lot (graftlint's discipline)."""
+    assert not os.path.exists(driver.DEFAULT_BASELINE)
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    repo = _mini_repo(tmp_path, {"pertgnn_tpu/fleet/c.py": _CYCLE})
+    assert cli_main(["lock-order", "--root", repo,
+                     "--no-baseline", "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is False and len(doc["violations"]) >= 1
+    clean = _mini_repo(tmp_path / "clean",
+                       {"pertgnn_tpu/ok.py": "x = 1\n"})
+    assert cli_main(["--root", clean, "--no-baseline"]) == 0
+    assert cli_main(["no-such-pass", "--root", clean]) == 2
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    repo = _mini_repo(tmp_path, {"pertgnn_tpu/fleet/c.py": _CYCLE})
+    baseline = str(tmp_path / "b.json")
+    assert cli_main(["lock-order", "--root", repo,
+                     "--baseline", baseline, "--write-baseline"]) == 0
+    assert cli_main(["lock-order", "--root", repo,
+                     "--baseline", baseline]) == 0
+    capsys.readouterr()
+
+
+# --- bench.py --gate refusal ----------------------------------------------
+
+
+def test_bench_gate_refuses_sync_failing_tree(tmp_path, monkeypatch,
+                                              capsys):
+    import bench
+    import tools.graftsync as gs
+
+    fake = driver.LintResult(
+        new=[driver.Violation(rule="lock-order", path="x.py", line=1,
+                              message="cycle boom")],
+        baselined=[], elapsed_s=0.0, passes=["lock-order"])
+    monkeypatch.setattr(gs, "run_repo", lambda repo: fake)
+    # graftlint must PASS for the gate to reach the graftsync check
+    import tools.graftlint as gl
+    clean = driver.LintResult(new=[], baselined=[], elapsed_s=0.0,
+                              passes=[])
+    monkeypatch.setattr(gl, "run_repo", lambda repo: clean)
+    result = tmp_path / "result.json"
+    result.write_text(json.dumps({"backend": "cpu", "value": 1.0,
+                                  "attention_impl": "segment"}))
+    rc = bench.gate_main([str(result)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "graftsync" in out and "cycle boom" in out
+
+
+def test_bench_gate_skip_sync_env_is_loud(monkeypatch, capsys):
+    import bench
+
+    monkeypatch.setenv("BENCH_GATE_SKIP_SYNC", "1")
+    assert bench._graftsync_refusal() == []
+    err = capsys.readouterr().err
+    assert "BENCH_GATE_SKIP_SYNC" in err

@@ -1,0 +1,400 @@
+"""pertgnn_tpu/testing/schedules.py — the deterministic interleaving
+harness (ISSUE 14), and the three nastiest fleet races driven in BOTH
+orders through it with bit-identical, exactly-once resolution
+asserted:
+
+1. hedge-settle vs. primary-answer (the PR-13 race, now
+   scheduler-driven instead of hand-built from Events);
+2. autoscale ``remove_worker`` vs. an in-flight dispatch (the
+   ``_assign``→sender handoff window the membership re-check closes —
+   driven through the router's ``fleet.assign.handoff`` sync points);
+3. drain vs. queue close on the worker-side MicrobatchQueue.
+
+Plus the harness's own contract: scripts are enforced orders,
+unscripted points pass through, an undeliverable script raises
+ScheduleTimeout instead of hanging, and — the seeded property test —
+a planted LOST-WAKEUP bug in a toy two-thread custody protocol is
+reproduced or avoided deterministically by the scripted order.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from pertgnn_tpu.config import FleetConfig, ServeConfig
+from pertgnn_tpu.fleet.router import FleetRouter
+from pertgnn_tpu.serve.errors import QueueClosed
+from pertgnn_tpu.serve.queue import MicrobatchQueue
+from pertgnn_tpu.testing import schedules
+from pertgnn_tpu.testing.schedules import (ScheduleTimeout,
+                                           ScriptedScheduler)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_scheduler():
+    yield
+    schedules.uninstall()
+
+
+# -- 1. the harness itself -------------------------------------------------
+
+
+class TestScriptedScheduler:
+    def test_enforces_the_scripted_order_across_threads(self):
+        for script in (["a", "b"], ["b", "a"]):
+            order: list[str] = []
+            sched = ScriptedScheduler(script, timeout_s=5.0)
+
+            def hit(name):
+                sched.point(name)
+                order.append(name)
+
+            with sched:
+                ts = [threading.Thread(target=hit, args=(n,),
+                                       name=f"sched-{n}")
+                      for n in ("a", "b")]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=5.0)
+            assert order == script
+            assert sched.trace == script and sched.finished()
+
+    def test_unscripted_points_pass_through(self):
+        sched = ScriptedScheduler(["x"], timeout_s=5.0)
+        with sched:
+            t0 = time.perf_counter()
+            sched.point("free")          # not in the script: no block
+            assert time.perf_counter() - t0 < 1.0
+            sched.point("x")
+        assert sched.passed == ["free"] and sched.trace == ["x"]
+
+    def test_consumed_entries_free_later_occurrences(self):
+        sched = ScriptedScheduler(["p"], timeout_s=5.0)
+        with sched:
+            sched.point("p")     # consumed
+            sched.point("p")     # second occurrence: pass-through
+        assert sched.trace == ["p"] and sched.passed == ["p"]
+
+    def test_undeliverable_script_raises_instead_of_hanging(self):
+        sched = ScriptedScheduler(["never", "late"], timeout_s=0.2)
+        with pytest.raises(ScheduleTimeout):
+            sched.point("late")  # "never" is never delivered
+        # the broken flag propagates: every later point fails fast
+        with pytest.raises(ScheduleTimeout):
+            sched.point("never")
+
+    def test_sync_point_is_free_without_a_scheduler(self):
+        assert schedules.active() is None
+        schedules.sync_point("anything")  # must not raise or block
+
+
+# -- 2. the seeded lost-wakeup property (hypothesis satellite) -------------
+
+
+def _lost_wakeup_trial(producer_first: bool) -> tuple[bool, list[str]]:
+    """A toy two-thread custody protocol with a PLANTED bug: the
+    consumer waits UNCONDITIONALLY (no predicate loop — exactly what
+    graftsync's cv-protocol pass flags), so a notify that fires before
+    the consumer reaches wait() is lost and the wait times out. The
+    scripted order decides the outcome deterministically."""
+    cv = threading.Condition()
+    woken: dict = {}
+
+    def consumer():
+        schedules.sync_point("consume.start")
+        with cv:
+            schedules.sync_point("consume.locked")
+            woken["v"] = cv.wait(timeout=0.4)   # the planted bug
+
+    def producer():
+        schedules.sync_point("produce.go")
+        with cv:
+            cv.notify_all()
+        schedules.sync_point("produce.done")
+
+    script = (["produce.go", "produce.done", "consume.start"]
+              if producer_first else
+              ["consume.locked", "produce.go", "produce.done"])
+    sched = ScriptedScheduler(script, timeout_s=10.0)
+    with sched:
+        ts = [threading.Thread(target=consumer, name="toy-consumer"),
+              threading.Thread(target=producer, name="toy-producer")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10.0)
+    assert sched.finished(), sched.trace
+    return woken["v"], sched.trace
+
+
+def test_lost_wakeup_reproduced_by_order():
+    woken, _trace = _lost_wakeup_trial(producer_first=True)
+    assert woken is False      # the notify fired first: wakeup LOST
+    woken, _trace = _lost_wakeup_trial(producer_first=False)
+    assert woken is True       # waiter first: wakeup delivered
+
+
+def test_schedule_permutation_property():
+    """Seeded permutations: the scheduler explores DISTINCT orders
+    (the consumed trace equals the script) and the planted bug's
+    reproduction is a pure function of the order."""
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=12, deadline=None)
+    @given(st.booleans())
+    def prop(producer_first):
+        woken, trace = _lost_wakeup_trial(producer_first)
+        expected = (["produce.go", "produce.done", "consume.start"]
+                    if producer_first else
+                    ["consume.locked", "produce.go", "produce.done"])
+        assert trace == expected
+        assert woken is (not producer_first)
+
+    prop()
+
+
+# -- 3. race 1: hedge-settle vs. primary-answer ----------------------------
+
+
+def _probe_200(base_url, timeout_s):
+    return 200, {}
+
+
+def _mk_router(urls, post, cfg):
+    return FleetRouter(urls, lambda eid: (10, 10), (8, 10_000, 10_000),
+                       cfg=cfg, transport_post=post,
+                       transport_probe=_probe_200)
+
+
+HEDGE_CFG = FleetConfig(hedge_quantile_ms=30.0,
+                        router_flush_deadline_ms=0.0,
+                        health_poll_interval_s=60.0,
+                        dispatch_timeout_s=10.0)
+
+
+def _race_hedge(hedge_wins: bool) -> float:
+    calls: list[str] = []
+    calls_lock = threading.Lock()
+
+    def post(base_url, entries, ts, timeout_s, trace=None, slo=None,
+             dg=None):
+        with calls_lock:
+            calls.append(base_url)
+            nth = len(calls)
+        # leg identity by dispatch order: the first post is always the
+        # primary (the hedger only fires 30ms later). The primary is
+        # parked at its reply point in BOTH scripts until the hedge
+        # leg has arrived — that is what MAKES it a straggler — and
+        # "settled" (the winner's done-callback) strictly orders the
+        # loser's answer after exactly-once resolution.
+        if nth == 1:
+            schedules.sync_point("primary.reply")
+        else:
+            schedules.sync_point("hedge.arrived")
+            schedules.sync_point("hedge.reply")
+        return [{"pred": float(e) * 2.0} for e in entries]
+
+    script = (["hedge.arrived", "hedge.reply", "settled",
+               "primary.reply"] if hedge_wins
+              else ["hedge.arrived", "primary.reply", "settled",
+                    "hedge.reply"])
+    sched = ScriptedScheduler(script, timeout_s=15.0)
+    with sched, _mk_router({"wa": "http://a", "wb": "http://b"}, post,
+                           HEDGE_CFG) as router:
+        fut = router.submit(5, 0)
+        # the settle point fires on the WINNING sender thread, inline
+        # in the done-callback — strictly after exactly-once resolution
+        fut.add_done_callback(
+            lambda f: schedules.sync_point("settled"))
+        assert fut.result(timeout=15.0) == 10.0
+        # let the losing leg land before reading stats
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with router._lock:
+                legs = router._inflight_legs
+            if len(calls) >= 2 and legs == 0:
+                break
+            time.sleep(0.01)
+        stats = router.stats_dict()
+    assert sched.finished(), (sched.trace, sched.passed)
+    assert len(calls) == 2, "the hedge leg never dispatched"
+    assert stats["hedge_fired"] == 1
+    assert stats["hedge_won"] == (1 if hedge_wins else 0)
+    assert stats["served"] == 1 and stats["failed"] == 0
+    return fut.result()
+
+
+def test_race_hedge_both_orders_bit_identical_exactly_once():
+    assert _race_hedge(hedge_wins=True) == _race_hedge(hedge_wins=False)
+
+
+# -- 4. race 2: remove_worker vs. in-flight dispatch -----------------------
+
+
+REMOVE_CFG = FleetConfig(router_flush_deadline_ms=0.0,
+                         health_poll_interval_s=60.0,
+                         dispatch_timeout_s=10.0)
+
+
+def _race_remove(remove_first: bool) -> float:
+    def post(base_url, entries, ts, timeout_s, trace=None, slo=None,
+             dg=None):
+        return [{"pred": float(e) * 2.0} for e in entries]
+
+    script = (["remove.done", "fleet.assign.handoff",
+               "fleet.assign.handoff_done"]
+              if remove_first else
+              ["fleet.assign.handoff", "fleet.assign.handoff_done",
+               "remove.done"])
+    sched = ScriptedScheduler(script, timeout_s=15.0)
+    with sched, _mk_router({"w1": "http://w1", "w2": "http://w2"},
+                           post, REMOVE_CFG) as router:
+        fut = router.submit(5, 0)
+        if remove_first:
+            # wait until the dispatcher has CHOSEN w1 (deterministic:
+            # both idle, ties break on worker_id) and is parked at the
+            # handoff sync point — the exact window the membership
+            # re-check in _assign exists for — then retire w1
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if router.stats_dict()["dispatched_batches"] >= 1:
+                    break
+                time.sleep(0.005)
+            router.remove_worker("w1")
+        else:
+            # block until the handoff to w1 completed, THEN retire it:
+            # the flight is already in (or past) w1's sender queue
+            schedules.sync_point("remove.done")
+            router.remove_worker("w1")
+        if remove_first:
+            schedules.sync_point("remove.done")
+        assert fut.result(timeout=15.0) == 10.0
+        stats = router.stats_dict()
+    assert sched.finished(), (sched.trace, sched.passed)
+    assert stats["served"] == 1 and stats["failed"] == 0
+    assert stats["worker_removed"] == 1
+    if remove_first:
+        # the re-check caught the retirement: the flight was re-chosen
+        # onto w2, never swallowed by w1's exiting sender
+        assert "w1" not in stats["workers"]
+        assert stats["workers"]["w2"]["dispatches"] >= 1
+    return fut.result()
+
+
+def test_race_remove_worker_both_orders_bit_identical():
+    assert (_race_remove(remove_first=True)
+            == _race_remove(remove_first=False))
+
+
+# -- 5. race 3: drain vs. queue close --------------------------------------
+
+
+class _RecorderBus:
+    """Just enough bus for MicrobatchQueue, with counter capture."""
+
+    enabled = True
+
+    def __init__(self):
+        self.counters: list[tuple] = []
+        self._lock = threading.Lock()
+
+    def counter(self, name, value=1, **tags):
+        with self._lock:
+            self.counters.append((name, value))
+
+    def count(self, name) -> int:
+        with self._lock:
+            return sum(v for n, v in self.counters if n == name)
+
+    def gauge(self, *a, **k):
+        pass
+
+    def histogram(self, *a, **k):
+        pass
+
+    def trace_span(self, *a, **k):
+        pass
+
+    def finish_trace(self, *a, **k):
+        pass
+
+    def start_trace(self, *a, **k):
+        return None
+
+
+class _FakeEngine:
+    """Engine-shaped stub: deterministic predictions, no jax — the
+    queue's protocol is the subject, not the model."""
+
+    def __init__(self):
+        self._cfg = SimpleNamespace(serve=ServeConfig())
+        self.bus = _RecorderBus()
+        self.healthy = True
+        self.unhealthy_reason = ""
+        rung = SimpleNamespace(max_graphs=8, max_nodes=512,
+                               max_edges=512)
+        self.ladder = [rung]
+        self.last_stage_tm: dict = {}
+
+    def request_size(self, eid):
+        return (4, 4)
+
+    def predict_microbatch(self, entries, ts_buckets, max_rung=None):
+        return [float(e) * 2.0 for e in entries]
+
+    def record_queue_wait(self, dt, coalesced=0):
+        pass
+
+
+def _race_drain_close(drain_first: bool):
+    eng = _FakeEngine()
+    q = MicrobatchQueue(eng, flush_deadline_ms=10_000.0,
+                        max_pending=64, request_deadline_ms=0.0,
+                        dispatch_timeout_s=0.0, overlap_dispatch=False,
+                        trace_roots=False)
+    futs = [q.submit(i + 1, 0) for i in range(4)]
+    script = (["go.drain", "drain.done", "go.close", "close.done"]
+              if drain_first else
+              ["go.close", "close.done", "go.drain", "drain.done"])
+    sched = ScriptedScheduler(script, timeout_s=15.0)
+    with sched:
+        def do_drain():
+            schedules.sync_point("go.drain")
+            q.begin_drain()
+            schedules.sync_point("drain.done")
+
+        def do_close():
+            schedules.sync_point("go.close")
+            q.close()
+            schedules.sync_point("close.done")
+
+        ts = [threading.Thread(target=do_drain, name="race-drain"),
+              threading.Thread(target=do_close, name="race-close")]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15.0)
+        assert not any(t.is_alive() for t in ts), "drain/close wedged"
+    assert sched.finished(), (sched.trace, sched.passed)
+    # exactly-once, bit-identical: every admitted future resolves to
+    # its own prediction regardless of the order the race ran in
+    preds = [f.result(timeout=10.0) for f in futs]
+    assert preds == [2.0, 4.0, 6.0, 8.0]
+    # post-close admission is a typed refusal, never a lost future
+    with pytest.raises(QueueClosed):
+        q.submit(9, 0)
+    return preds, eng.bus.count("serve.drain_begin")
+
+
+def test_race_drain_close_both_orders_bit_identical():
+    preds_a, drains_a = _race_drain_close(drain_first=True)
+    preds_b, drains_b = _race_drain_close(drain_first=False)
+    assert preds_a == preds_b
+    # the drain marker fires exactly once when a drain was requested
+    # before close finished the lifecycle; a post-close begin_drain is
+    # a no-op flag write (nothing left to announce it)
+    assert drains_a == 1 and drains_b == 0

@@ -165,14 +165,43 @@ def _compile_glob(pat: str):
     return re.compile("".join(out) + r"\Z")
 
 
-def _suppressed(ctx: Context, v: Violation) -> bool:
+def _suppressed(ctx: Context, v: Violation,
+                pragma_prefix: str = PRAGMA_PREFIX) -> bool:
     if not v.line:
         return False
     try:
         line = ctx.lines(v.path)[v.line - 1]
     except (OSError, IndexError):
         return False
-    return f"{PRAGMA_PREFIX}{v.rule}" in line
+    return f"{pragma_prefix}{v.rule}" in line
+
+
+def split_findings(ctx: Context, modules: list, baseline: set,
+                   pragma_prefix: str = PRAGMA_PREFIX
+                   ) -> tuple[list[Violation], list[Violation]]:
+    """The driver core shared with graftsync (single source of truth):
+    run the pass modules over `ctx`, drop pragma-suppressed findings,
+    split the rest (parse errors included — --write-baseline must
+    leave a tree that lints clean) against the baseline, and sort
+    both sides deterministically."""
+    new: list[Violation] = []
+    baselined: list[Violation] = []
+    for mod in modules:
+        for v in mod.run(ctx):
+            if _suppressed(ctx, v, pragma_prefix):
+                continue
+            if (v.rule, v.path, v.key) in baseline:
+                baselined.append(v)
+            else:
+                new.append(v)
+    for v in ctx.parse_errors:
+        if (v.rule, v.path, v.key) in baseline:
+            baselined.append(v)
+        else:
+            new.append(v)
+    new.sort(key=lambda v: (v.path, v.line, v.rule))
+    baselined.sort(key=lambda v: (v.path, v.line, v.rule))
+    return new, baselined
 
 
 def load_baseline(path: str) -> set[tuple[str, str, str]]:
@@ -231,27 +260,8 @@ def run_passes(repo: str, pass_names: list[str] | None = None,
     ctx = Context(repo, only=only_files)
     baseline = load_baseline(
         DEFAULT_BASELINE if baseline_path is None else baseline_path)
-    new: list[Violation] = []
-    baselined: list[Violation] = []
     modules = get_passes(pass_names)
-    for mod in modules:
-        for v in mod.run(ctx):
-            if _suppressed(ctx, v):
-                continue
-            if (v.rule, v.path, v.key) in baseline:
-                baselined.append(v)
-            else:
-                new.append(v)
-    # parse errors (rule "driver", reported once per unparseable file)
-    # go through the same baseline split — --write-baseline must leave
-    # a tree that lints clean, parse errors included
-    for v in ctx.parse_errors:
-        if (v.rule, v.path, v.key) in baseline:
-            baselined.append(v)
-        else:
-            new.append(v)
-    new.sort(key=lambda v: (v.path, v.line, v.rule))
-    baselined.sort(key=lambda v: (v.path, v.line, v.rule))
+    new, baselined = split_findings(ctx, modules, baseline)
     return LintResult(new=new, baselined=baselined,
                       elapsed_s=time.perf_counter() - t0,
                       passes=[m.RULE for m in modules])

@@ -60,39 +60,15 @@ _MUTATORS = {"append", "extend", "insert", "pop", "remove", "clear",
 
 # (class name, attribute) pairs exempt because exactly ONE thread ever
 # writes them — the explicit shared-state allowlist the pass contract
-# requires (docs/LINTS.md). Keep the reasons current: an entry whose
-# reason stops being true is a data race with a permission slip.
-ALLOWLIST: dict[tuple[str, str], str] = {
-    # serve/queue.py MicrobatchQueue — worker-thread-only pipeline
-    # state: written exclusively by the single `_run` worker (and by
-    # close() only AFTER joining it); never read by another thread.
-    ("MicrobatchQueue", "_inflight"):
-        "overlapped-dispatch slot; worker-thread-only by design "
-        "(documented on the attribute)",
-    ("MicrobatchQueue", "_dispatcher"):
-        "abandonable dispatcher handle; worker-thread-only, rebuilt "
-        "by the worker after a watchdog trip",
-    ("MicrobatchQueue", "_cooldown_until"):
-        "fail-fast window bound; read and written by the worker only",
-    ("MicrobatchQueue", "_drain_announced"):
-        "drain-marker latch; worker-only, except close() which reads "
-        "AND writes it only after joining the worker (single-threaded "
-        "by then)",
-    # fleet/autoscale.py AutoscaleController — control-thread-only
-    # state: step() runs exclusively on the control thread (or a
-    # test's driver thread, never both — start() is how the thread
-    # comes to exist); the lock guards only the spares list /
-    # totals that stats_dict() snapshots cross-thread.
-    ("AutoscaleController", "_thread"):
-        "written once in start() BEFORE the control thread exists; "
-        "read only by close() after _stop is set",
-    ("AutoscaleController", "_over_since"):
-        "hysteresis bookkeeping; step() is control-thread-only by "
-        "design (documented on the attribute)",
-    ("AutoscaleController", "_under_since"):
-        "hysteresis bookkeeping; step() is control-thread-only by "
-        "design",
-}
+# requires (docs/LINTS.md). Since ISSUE 14 the table LIVES in
+# tools/graftsync/justify.py (SINGLE_WRITER): one justification file
+# for both concurrency analyzers, so the single-writer reasoning is
+# never duplicated or half-updated. Keep the reasons there current: an
+# entry whose reason stops being true is a data race with a
+# permission slip. (Re-exported under the historical name — the
+# liveness pins in tests/test_graftlint.py and tests/test_shield.py
+# read `lock_discipline.ALLOWLIST`.)
+from tools.graftsync.justify import SINGLE_WRITER as ALLOWLIST
 # (serve/queue.py's _Dispatcher owns a Thread but synchronizes via a
 # Semaphore, not a lock, so the lock-owning-class criterion skips it —
 # its handoff ordering is documented on the class.)

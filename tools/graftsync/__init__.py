@@ -1,0 +1,10 @@
+"""graftsync — static concurrency verification for the threaded fleet
+(docs/LINTS.md): lock-order cycles and blocking-while-locked, custody
+(future-lifecycle) drops, condition-variable protocol, thread
+lifecycle, and timeout totality, on the graftlint driver conventions.
+The dynamic twin is pertgnn_tpu/testing/schedules.py (the
+deterministic interleaving harness)."""
+
+from tools.graftsync.driver import run_passes, run_repo
+
+__all__ = ["run_passes", "run_repo"]

@@ -1,0 +1,3 @@
+from tools.graftsync.cli import main
+
+raise SystemExit(main())

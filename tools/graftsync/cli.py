@@ -1,0 +1,133 @@
+"""graftsync CLI.
+
+    python -m tools.graftsync [PASS ...] [options]
+
+Options:
+    --json             machine-readable result (one JSON object)
+    --baseline PATH    baseline file (default tools/graftsync/
+                       baseline.json when it exists)
+    --no-baseline      ignore any baseline
+    --write-baseline   accept today's findings into the baseline file
+                       and exit 0 (reviewable: the file is in-tree)
+    --root DIR         repo root (default: this file's repo)
+    --list             list passes and exit
+
+No --changed-only: the acquisition graph and the custody analysis are
+whole-repo properties and the full run is ~1 s (docs/LINTS.md).
+
+Exit codes: 0 clean (or all findings baselined), 1 new violations,
+2 usage / internal error — the contract tests/test_graftsync.py
+enforces in tier-1 and bench.py --gate piggybacks on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _repo_default() -> str:
+    # tools/graftsync/cli.py -> repo root
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    from tools.graftsync import driver
+    from tools.graftsync.passes import get_passes, registry
+
+    p = argparse.ArgumentParser(
+        prog="graftsync",
+        description="static concurrency verification for the threaded "
+                    "fleet (docs/LINTS.md)")
+    p.add_argument("passes", nargs="*",
+                   help="pass names to run (default: all); "
+                        f"canonical: {', '.join(registry())}")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--root", default=None)
+    p.add_argument("--list", action="store_true")
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    if args.list:
+        for name, mod in registry().items():
+            doc = next(iter((mod.__doc__ or "").strip().splitlines()),
+                       "")
+            print(f"{name:20s} {doc}")
+        return 0
+
+    repo = os.path.abspath(args.root or _repo_default())
+    if not os.path.isdir(repo):
+        # a typo'd --root would otherwise discover zero files and
+        # "pass" vacuously
+        print(f"graftsync: root is not a directory: {repo}",
+              file=sys.stderr)
+        return 2
+    try:
+        get_passes(args.passes or None)
+    except KeyError as e:
+        print(f"graftsync: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    baseline = ("" if args.no_baseline else args.baseline)
+    if (baseline and not args.write_baseline
+            and not os.path.exists(baseline)):
+        # an EXPLICIT baseline path that does not exist is a usage
+        # error, not an empty baseline (graftlint's CLI rationale)
+        print(f"graftsync: baseline file not found: {baseline} "
+              f"(--write-baseline creates one; --no-baseline ignores "
+              f"baselines)", file=sys.stderr)
+        return 2
+    try:
+        result = driver.run_passes(repo, args.passes or None,
+                                   baseline_path=baseline)
+    except FileNotFoundError as e:
+        print(f"graftsync: {e}", file=sys.stderr)
+        return 2
+    except (json.JSONDecodeError, KeyError, TypeError) as e:
+        # a corrupt baseline is a USAGE error (exit 2), not "new
+        # violations" (exit 1) — CI reads the exit-code contract
+        print(f"graftsync: unreadable baseline file "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = args.baseline or driver.DEFAULT_BASELINE
+        fresh = result.new + result.baselined
+        ran = set(result.passes)
+        if not args.passes:
+            ran |= {"driver"}
+        keep = [driver.Violation(rule=r, path=pth, line=0, message=k,
+                                 key=k)
+                for (r, pth, k) in driver.load_baseline(path)
+                if r not in ran]
+        driver.write_baseline(path, fresh + keep)
+        print(f"graftsync: wrote {len(fresh) + len(keep)} baseline "
+              f"entr(ies) to {path}"
+              + (f" ({len(keep)} carried over from passes that did "
+                 f"not run)" if keep else ""))
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.as_dict()))
+    else:
+        for v in result.new:
+            print(v)
+        tail = (f"{len(result.new)} violation(s)"
+                + (f", {len(result.baselined)} baselined"
+                   if result.baselined else "")
+                + f" [{', '.join(result.passes)};"
+                  f" {result.elapsed_s:.2f}s]")
+        print(tail, file=sys.stderr)
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

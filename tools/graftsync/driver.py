@@ -1,0 +1,57 @@
+"""graftsync driver — the thread-protocol analyzer on graftlint's
+conventions (docs/LINTS.md): same Context/Violation/baseline
+machinery, its own pragma prefix (``# graftsync: allow-<pass>``), its
+own baseline file, and the shared justification tables
+(tools/graftsync/justify.py) whose liveness tier-1 pins.
+
+Exit contract, identical to the siblings: 0 clean (or everything
+baselined), 1 new violations, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from tools.graftlint.driver import (Context, LintResult, Violation,
+                                    load_baseline, split_findings,
+                                    write_baseline)
+
+__all__ = ["Context", "LintResult", "Violation", "load_baseline",
+           "write_baseline", "run_passes", "run_repo",
+           "DEFAULT_BASELINE", "PRAGMA_PREFIX"]
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+PRAGMA_PREFIX = "graftsync: allow-"
+
+
+def run_passes(repo: str, pass_names: list[str] | None = None,
+               baseline_path: str | None = None) -> LintResult:
+    """Run the named passes (default: all, registry order) over the
+    repo, through graftlint's shared driver core (split_findings) with
+    graftsync's pragma prefix and baseline. No --changed-only variant:
+    the lock-acquisition graph and the custody analysis are whole-repo
+    properties, and the full run is ~1 s."""
+    from tools.graftsync.passes import get_passes
+
+    t0 = time.perf_counter()
+    ctx = Context(repo)
+    ctx.graftsync_hits = {}  # rule -> {(path, key)} justification hits
+    baseline = load_baseline(
+        DEFAULT_BASELINE if baseline_path is None else baseline_path)
+    modules = get_passes(pass_names)
+    new, baselined = split_findings(ctx, modules, baseline,
+                                    pragma_prefix=PRAGMA_PREFIX)
+    result = LintResult(new=new, baselined=baselined,
+                        elapsed_s=time.perf_counter() - t0,
+                        passes=[m.RULE for m in modules])
+    # stashed for the allowlist-liveness pin (tests/test_graftsync.py)
+    result.justification_hits = ctx.graftsync_hits
+    return result
+
+
+def run_repo(repo: str) -> LintResult:
+    """The full suite with the default baseline — what
+    tests/test_graftsync.py and bench.py --gate call."""
+    return run_passes(repo)

@@ -1,0 +1,136 @@
+"""The shared concurrency-justification tables — ONE file where every
+deliberate exemption from the thread-protocol analyzers lives with its
+reason stated (ISSUE-14 satellite: single source of truth).
+
+Two consumers:
+
+- **graftsync passes** consult their table via :func:`lookup`, which
+  also records the hit on the run's Context so tier-1 can pin LIVENESS:
+  an entry that no longer suppresses a real finding fails the suite
+  (tests/test_graftsync.py) — a dead exemption is a hole in the proof
+  with a permission slip.
+- **graftlint's lock-discipline pass** imports :data:`SINGLE_WRITER`
+  (its historical ``ALLOWLIST`` — the name is re-exported there for
+  back-compat), so the single-writer reasoning is not duplicated
+  between the source-level and protocol-level analyzers.
+
+Keys are stable identities (class.attr, ``qualname:what``), never line
+numbers. Keep every reason CURRENT: an entry whose reason stops being
+true is a data race / deadlock / lost future with a permission slip.
+"""
+
+from __future__ import annotations
+
+# -- single-writer instance attributes (graftlint lock-discipline) --------
+# (class name, attribute) -> why exactly ONE thread ever writes it.
+SINGLE_WRITER: dict[tuple[str, str], str] = {
+    # serve/queue.py MicrobatchQueue — worker-thread-only pipeline
+    # state: written exclusively by the single `_run` worker (and by
+    # close() only AFTER joining it); never read by another thread.
+    ("MicrobatchQueue", "_inflight"):
+        "overlapped-dispatch slot; worker-thread-only by design "
+        "(documented on the attribute)",
+    ("MicrobatchQueue", "_dispatcher"):
+        "abandonable dispatcher handle; worker-thread-only, rebuilt "
+        "by the worker after a watchdog trip",
+    ("MicrobatchQueue", "_cooldown_until"):
+        "fail-fast window bound; read and written by the worker only",
+    ("MicrobatchQueue", "_drain_announced"):
+        "drain-marker latch; worker-only, except close() which reads "
+        "AND writes it only after joining the worker (single-threaded "
+        "by then)",
+    # fleet/autoscale.py AutoscaleController — control-thread-only
+    # state: step() runs exclusively on the control thread (or a
+    # test's driver thread, never both — start() is how the thread
+    # comes to exist); the lock guards only the spares list /
+    # totals that stats_dict() snapshots cross-thread.
+    ("AutoscaleController", "_thread"):
+        "written once in start() BEFORE the control thread exists; "
+        "read only by close() after _stop is set",
+    ("AutoscaleController", "_over_since"):
+        "hysteresis bookkeeping; step() is control-thread-only by "
+        "design (documented on the attribute)",
+    ("AutoscaleController", "_under_since"):
+        "hysteresis bookkeeping; step() is control-thread-only by "
+        "design",
+}
+
+# -- timeout-totality (graftsync) -----------------------------------------
+# (path, key) -> why this blocking call may wait without a timeout.
+# key = "<qualname>:<verb>@<receiver>" — see passes/timeout_totality.py.
+TIMEOUT_TOTALITY: dict[tuple[str, str], str] = {
+    ("pertgnn_tpu/serve/queue.py",
+     "MicrobatchQueue._run:wait@self._wake"):
+        "idle worker awaiting work; close() sets _closed and notifies "
+        "under the same lock, so the wakeup that ends the wait is "
+        "guaranteed (liveness pinned by every close-path serve test)",
+    ("pertgnn_tpu/serve/queue.py",
+     "MicrobatchQueue.close:join@self._worker"):
+        "close-drain completeness: the worker exits once the pending "
+        "set is flushed; bounding this join would abandon admitted "
+        "futures mid-drain — the ALWAYS-resolves contract outranks a "
+        "bounded close",
+    ("pertgnn_tpu/fleet/router.py",
+     "FleetRouter._sender_loop:get@w.sender_q"):
+        "sender awaiting work; close()/remove_worker() put the exit "
+        "sentinel under the membership lock, so the queue always "
+        "terminates the wait",
+    ("pertgnn_tpu/fleet/router.py",
+     "FleetRouter.close:join@self._dispatcher"):
+        "close-drain completeness: the dispatcher exits once the "
+        "pending set AND every in-flight leg settled; bounding it "
+        "would abandon futures (request deadlines bound the drain "
+        "in practice)",
+    ("pertgnn_tpu/fleet/transport.py",
+     "WorkerServer._predict:result@fut"):
+        "a submitted Future ALWAYS resolves (serve/errors.py "
+        "contract); the ROUTER bounds the round trip with its "
+        "transport timeout, so a wedged worker is abandoned "
+        "client-side, not waited on here",
+    ("pertgnn_tpu/fleet/loadgen.py",
+     "replay:result@fut"):
+        "done-callback context: the future is already resolved when "
+        "the callback runs (exception() was checked first) — "
+        "result() cannot block",
+}
+
+# -- future-lifecycle (graftsync) -----------------------------------------
+# (path, key) -> why an exit path without a custody action is safe.
+# key = "<qualname>:<param>" — see passes/future_lifecycle.py.
+FUTURE_LIFECYCLE: dict[tuple[str, str], str] = {
+    ("pertgnn_tpu/serve/queue.py",
+     "MicrobatchQueue._health_gate:batch"):
+        "gate helper: on the True path the CALLER retains custody and "
+        "dispatches; the False path fails the batch via _failfast "
+        "before returning",
+}
+
+# -- lock-order (graftsync) -----------------------------------------------
+# (path, key) -> why this blocking-while-locked site is deliberate.
+LOCK_ORDER: dict[tuple[str, str], str] = {}
+
+# -- cv-protocol (graftsync) ----------------------------------------------
+CV_PROTOCOL: dict[tuple[str, str], str] = {}
+
+# -- thread-lifecycle (graftsync) -----------------------------------------
+THREAD_LIFECYCLE: dict[tuple[str, str], str] = {}
+
+TABLES: dict[str, dict[tuple[str, str], str]] = {
+    "timeout-totality": TIMEOUT_TOTALITY,
+    "future-lifecycle": FUTURE_LIFECYCLE,
+    "lock-order": LOCK_ORDER,
+    "cv-protocol": CV_PROTOCOL,
+    "thread-lifecycle": THREAD_LIFECYCLE,
+}
+
+
+def lookup(ctx, rule: str, path: str, key: str) -> str | None:
+    """The justification for (rule, path, key), or None. A hit is
+    recorded on the Context so the liveness test can require every
+    entry to still be suppressing a real finding."""
+    reason = TABLES.get(rule, {}).get((path, key))
+    if reason is not None:
+        hits = getattr(ctx, "graftsync_hits", None)
+        if hits is not None:
+            hits.setdefault(rule, set()).add((path, key))
+    return reason

@@ -1,0 +1,41 @@
+"""Pass registry, graftlint's shape: order is output stability only —
+the acquisition graph first (the deadlock proof), then custody, then
+the protocol/lifecycle/timeout hygiene passes."""
+
+from __future__ import annotations
+
+from tools.graftsync.passes import (cv_protocol, future_lifecycle,
+                                    lock_order, thread_lifecycle,
+                                    timeout_totality)
+
+_ORDER = (lock_order, future_lifecycle, cv_protocol, thread_lifecycle,
+          timeout_totality)
+
+# short aliases accepted on the CLI next to the canonical RULE names
+ALIASES = {
+    "locks": lock_order, "order": lock_order,
+    "futures": future_lifecycle, "custody": future_lifecycle,
+    "cv": cv_protocol,
+    "threads": thread_lifecycle,
+    "timeouts": timeout_totality, "timeout": timeout_totality,
+}
+
+
+def registry() -> dict[str, object]:
+    return {m.RULE: m for m in _ORDER}
+
+
+def get_passes(names: list[str] | None = None) -> list:
+    if not names:
+        return list(_ORDER)
+    reg = registry()
+    out = []
+    for n in names:
+        mod = reg.get(n) or ALIASES.get(n)
+        if mod is None:
+            raise KeyError(
+                f"unknown pass {n!r} (choose from {sorted(reg)} "
+                f"or aliases {sorted(ALIASES)})")
+        if mod not in out:
+            out.append(mod)
+    return out

@@ -1,0 +1,389 @@
+"""Shared concurrency model for the graftsync passes.
+
+One parse of each in-scope file (graftlint's Context cache) is lifted
+into a :class:`ModuleModel`: which class attributes and local names
+hold locks / conditions / queues / events / threads, resolved lexically
+the way graftlint's lock-discipline pass resolves its lock attributes.
+Everything is STATIC and same-file — cross-module aliasing is a
+declared limit (docs/LINTS.md), covered by the dynamic interleaving
+harness (pertgnn_tpu/testing/schedules.py).
+
+Identity conventions:
+
+- a **lock id** is ``"<rel>::<Owner>.<attr>"`` (owner = class name, or
+  ``<module>`` for module-level and function-local locks). A
+  ``Condition(self._lock)`` aliases to the WRAPPED lock's id — waiting
+  on the condition and holding the lock are the same mutex.
+- a **unit** is one analysis scope: a top-level function or a method.
+  Nested defs/lambdas are visited inside their unit with the held-lock
+  state RESET (a closure executes later, on whatever thread calls it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.graftlint.passes._ast_util import attr_chain
+
+_LOCK_TAILS = ("Lock", "RLock")
+_QUEUE_TAILS = {"Queue": "queue", "LifoQueue": "queue",
+                "PriorityQueue": "queue", "SimpleQueue": "simple"}
+
+
+def _ctor_tail(value: ast.AST) -> str | None:
+    """The constructor name of ``x = <mod>.<Ctor>(...)``, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    ch = attr_chain(value.func)
+    return ch[-1] if ch else None
+
+
+@dataclasses.dataclass
+class ClassModel:
+    name: str
+    node: ast.ClassDef
+    lock_attrs: set = dataclasses.field(default_factory=set)
+    cond_attrs: set = dataclasses.field(default_factory=set)
+    event_attrs: set = dataclasses.field(default_factory=set)
+    queue_attrs: dict = dataclasses.field(default_factory=dict)
+    thread_attrs: set = dataclasses.field(default_factory=set)
+    # list-of-threads attrs (self._senders = [Thread(...) ...])
+    thread_list_attrs: set = dataclasses.field(default_factory=set)
+    # attr -> canonical lock attr (Condition(self._lock) -> "_lock")
+    canon: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Unit:
+    """One analysis scope: a module function or a method."""
+
+    qual: str                      # "Class.method" or "func"
+    node: ast.AST
+    cls: ClassModel | None
+    local_locks: set = dataclasses.field(default_factory=set)
+    local_conds: set = dataclasses.field(default_factory=set)
+    local_events: set = dataclasses.field(default_factory=set)
+    local_queues: dict = dataclasses.field(default_factory=dict)
+    local_threads: set = dataclasses.field(default_factory=set)
+    local_thread_lists: set = dataclasses.field(default_factory=set)
+    local_canon: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleModel:
+    rel: str
+    classes: dict = dataclasses.field(default_factory=dict)
+    module_locks: set = dataclasses.field(default_factory=set)
+    module_conds: set = dataclasses.field(default_factory=set)
+    module_queues: dict = dataclasses.field(default_factory=dict)
+    units: list = dataclasses.field(default_factory=list)
+    # unions across classes, for cross-object attribute calls
+    # (``w.sender_q.put`` resolves by attribute NAME, same file)
+    attr_queues: dict = dataclasses.field(default_factory=dict)
+    attr_threads: set = dataclasses.field(default_factory=set)
+    attr_events: set = dataclasses.field(default_factory=set)
+
+    def lock_id(self, owner: str, attr: str) -> str:
+        return f"{self.rel}::{owner}.{attr}"
+
+
+def _classify_assign(node, add):
+    """Dispatch one Assign/AnnAssign on its constructor tail via
+    ``add(category, targets, value)``."""
+    if isinstance(node, ast.Assign):
+        targets, value = node.targets, node.value
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        targets, value = [node.target], node.value
+    else:
+        return
+    tail = _ctor_tail(value)
+    if tail is None:
+        # list-of-threads: [Thread(...) for ...] or [Thread(...), ...]
+        if isinstance(value, (ast.ListComp, ast.List)):
+            elts = ([value.elt] if isinstance(value, ast.ListComp)
+                    else value.elts)
+            if any(isinstance(e, ast.Call)
+                   and (attr_chain(e.func) or [""])[-1] == "Thread"
+                   for e in elts):
+                add("thread_list", targets, value)
+        return
+    if tail in _LOCK_TAILS:
+        add("lock", targets, value)
+    elif tail == "Condition":
+        add("cond", targets, value)
+    elif tail == "Event":
+        add("event", targets, value)
+    elif tail == "Thread":
+        add("thread", targets, value)
+    elif tail in _QUEUE_TAILS:
+        add("queue:" + _QUEUE_TAILS[tail], targets, value)
+
+
+def _build_class(node: ast.ClassDef) -> ClassModel:
+    cm = ClassModel(name=node.name, node=node)
+
+    def add(cat, targets, value):
+        for t in targets:
+            ch = attr_chain(t)
+            if not (ch and len(ch) == 2 and ch[0] == "self"):
+                continue
+            attr = ch[1]
+            if cat == "lock":
+                cm.lock_attrs.add(attr)
+                cm.canon.setdefault(attr, attr)
+            elif cat == "cond":
+                cm.lock_attrs.add(attr)
+                cm.cond_attrs.add(attr)
+                wrapped = None
+                for arg in value.args:
+                    ach = attr_chain(arg)
+                    if ach and len(ach) == 2 and ach[0] == "self":
+                        wrapped = ach[1]
+                if wrapped is not None:
+                    cm.lock_attrs.add(wrapped)
+                    cm.canon.setdefault(wrapped, wrapped)
+                    cm.canon[attr] = wrapped
+                else:
+                    cm.canon.setdefault(attr, attr)
+            elif cat == "event":
+                cm.event_attrs.add(attr)
+            elif cat == "thread":
+                cm.thread_attrs.add(attr)
+            elif cat == "thread_list":
+                cm.thread_list_attrs.add(attr)
+            elif cat.startswith("queue:"):
+                cm.queue_attrs[attr] = cat.split(":", 1)[1]
+
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Assign, ast.AnnAssign)):
+            _classify_assign(n, add)
+    return cm
+
+
+def _build_unit(qual: str, fn: ast.AST, cls: ClassModel | None) -> Unit:
+    u = Unit(qual=qual, node=fn, cls=cls)
+
+    def add(cat, targets, value):
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            name = t.id
+            if cat == "lock":
+                u.local_locks.add(name)
+                u.local_canon.setdefault(name, name)
+            elif cat == "cond":
+                u.local_locks.add(name)
+                u.local_conds.add(name)
+                wrapped = None
+                for arg in value.args:
+                    if isinstance(arg, ast.Name):
+                        wrapped = arg.id
+                if wrapped is not None:
+                    u.local_locks.add(wrapped)
+                    u.local_canon.setdefault(wrapped, wrapped)
+                    u.local_canon[name] = wrapped
+                else:
+                    u.local_canon.setdefault(name, name)
+            elif cat == "event":
+                u.local_events.add(name)
+            elif cat == "thread":
+                u.local_threads.add(name)
+            elif cat == "thread_list":
+                u.local_thread_lists.add(name)
+            elif cat.startswith("queue:"):
+                u.local_queues[name] = cat.split(":", 1)[1]
+
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.Assign, ast.AnnAssign)):
+            _classify_assign(n, add)
+    return u
+
+
+def model_for(ctx, rel: str) -> ModuleModel | None:
+    """The (cached) ModuleModel for one in-scope file; None when the
+    file does not parse (the driver reports that once)."""
+    cache = getattr(ctx, "_graftsync_models", None)
+    if cache is None:
+        cache = {}
+        ctx._graftsync_models = cache
+    if rel in cache:
+        return cache[rel]
+    tree = ctx.tree(rel)
+    if tree is None:
+        cache[rel] = None
+        return None
+    m = ModuleModel(rel=rel)
+
+    def add_mod(cat, targets, value):
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if cat == "lock":
+                m.module_locks.add(t.id)
+            elif cat == "cond":
+                m.module_locks.add(t.id)
+                m.module_conds.add(t.id)
+            elif cat.startswith("queue:"):
+                m.module_queues[t.id] = cat.split(":", 1)[1]
+
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            _classify_assign(stmt, add_mod)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            m.units.append(_build_unit(stmt.name, stmt, None))
+        elif isinstance(stmt, ast.ClassDef):
+            cm = _build_class(stmt)
+            m.classes[stmt.name] = cm
+            for item in stmt.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    m.units.append(_build_unit(
+                        f"{stmt.name}.{item.name}", item, cm))
+    for cm in m.classes.values():
+        m.attr_queues.update(cm.queue_attrs)
+        m.attr_threads |= cm.thread_attrs | cm.thread_list_attrs
+        m.attr_events |= cm.event_attrs
+    cache[rel] = m
+    return m
+
+
+# -- receiver / lock resolution -------------------------------------------
+
+
+def held_lock_id(m: ModuleModel, u: Unit, expr: ast.AST) -> str | None:
+    """The canonical lock id a ``with <expr>`` acquires, else None."""
+    ch = attr_chain(expr)
+    if not ch:
+        return None
+    if len(ch) == 2 and ch[0] == "self" and u.cls is not None:
+        if ch[1] in u.cls.lock_attrs:
+            return m.lock_id(u.cls.name, u.cls.canon.get(ch[1], ch[1]))
+    if len(ch) == 1:
+        name = ch[0]
+        if name in u.local_locks:
+            return m.lock_id("<module>", u.local_canon.get(name, name))
+        if name in m.module_locks:
+            return m.lock_id("<module>", name)
+    return None
+
+
+def receiver_kind(m: ModuleModel, u: Unit,
+                  recv: list[str]) -> tuple[str, str | None] | None:
+    """Classify the receiver chain of an attribute call: returns
+    (kind, detail) with kind in {"lock", "cond", "event", "queue",
+    "thread"}; for "cond"/"lock" detail is the canonical lock id, for
+    "queue" the queue kind ("queue" blocking put / "simple"). None =
+    unresolvable (unknown object)."""
+    if not recv:
+        return None
+    if len(recv) == 2 and recv[0] == "self" and u.cls is not None:
+        attr = recv[1]
+        if attr in u.cls.cond_attrs:
+            return ("cond", m.lock_id(u.cls.name,
+                                      u.cls.canon.get(attr, attr)))
+        if attr in u.cls.lock_attrs:
+            return ("lock", m.lock_id(u.cls.name,
+                                      u.cls.canon.get(attr, attr)))
+        if attr in u.cls.event_attrs:
+            return ("event", None)
+        if attr in u.cls.queue_attrs:
+            return ("queue", u.cls.queue_attrs[attr])
+        if attr in (u.cls.thread_attrs | u.cls.thread_list_attrs):
+            return ("thread", None)
+    if len(recv) == 1:
+        name = recv[0]
+        if name in u.local_conds:
+            return ("cond", m.lock_id("<module>",
+                                      u.local_canon.get(name, name)))
+        if name in u.local_locks:
+            return ("lock", m.lock_id("<module>",
+                                      u.local_canon.get(name, name)))
+        if name in m.module_conds:
+            return ("cond", m.lock_id("<module>", name))
+        if name in m.module_locks:
+            return ("lock", m.lock_id("<module>", name))
+        if name in u.local_events:
+            return ("event", None)
+        if name in u.local_queues:
+            return ("queue", u.local_queues[name])
+        if name in m.module_queues:
+            return ("queue", m.module_queues[name])
+        if name in u.local_threads:
+            return ("thread", None)
+    # cross-object, same-file: resolve by ATTRIBUTE name (w.sender_q)
+    tail = recv[-1]
+    if len(recv) >= 2:
+        if tail in m.attr_queues:
+            return ("queue", m.attr_queues[tail])
+        if tail in m.attr_events:
+            return ("event", None)
+        if tail in m.attr_threads:
+            return ("thread", None)
+    return None
+
+
+def callee_units(m: ModuleModel, u: Unit,
+                 call: ast.Call) -> list[Unit]:
+    """Same-file callees of one call: a bare Name resolves to module
+    functions of that name; ``self.X(...)`` to method X of the unit's
+    own class."""
+    out = []
+    if isinstance(call.func, ast.Name):
+        out = [x for x in m.units
+               if x.cls is None and x.qual == call.func.id]
+    else:
+        ch = attr_chain(call.func)
+        if (ch and len(ch) == 2 and ch[0] == "self"
+                and u.cls is not None):
+            out = [x for x in m.units
+                   if x.cls is u.cls
+                   and x.qual == f"{u.cls.name}.{ch[1]}"]
+    return out
+
+
+def is_none_const(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def has_timeout_arg(call: ast.Call,
+                    first_arg_is_timeout: bool = True) -> bool:
+    """Whether a blocking call is bounded. ``wait``/``join``/``result``
+    take the timeout as their FIRST positional; ``Queue.get``/``put``
+    take ``block`` first and the timeout SECOND (``q.get(True)`` is an
+    unbounded blocking wait — pass ``first_arg_is_timeout=False`` so
+    it is not mistaken for a bounded one; ``q.get(False)`` is
+    non-blocking and counts as bounded). Keywords: ``timeout=`` or
+    ``block=False``. An EXPLICIT literal ``None`` timeout — positional
+    or keyword — is spelled-out unboundedness, not a bound."""
+    if first_arg_is_timeout:
+        if call.args and not is_none_const(call.args[0]):
+            return True
+    else:
+        if len(call.args) >= 2 and not is_none_const(call.args[1]):
+            return True      # (block, timeout)
+        if (len(call.args) == 1
+                and isinstance(call.args[0], ast.Constant)
+                and call.args[0].value is False):
+            return True      # block=False positionally: non-blocking
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not is_none_const(kw.value):
+            return True
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def queue_call_nonblocking(call: ast.Call, attr: str) -> bool:
+    """True for the non-blocking spellings of ``Queue.get``/``put``:
+    a literal ``False`` in the ``block`` position (first for get,
+    second for put) or ``block=False`` — those never wait at all, so
+    even the under-a-lock check must not flag them."""
+    pos = 0 if attr == "get" else 1
+    if (len(call.args) > pos
+            and isinstance(call.args[pos], ast.Constant)
+            and call.args[pos].value is False):
+        return True
+    return any(kw.arg == "block" and isinstance(kw.value, ast.Constant)
+               and kw.value.value is False for kw in call.keywords)

@@ -1,0 +1,178 @@
+"""graftsync pass — cv-protocol: every ``Condition.wait`` must follow
+the condition-variable protocol. Bug-class provenance: the lost-wakeup
+class — a ``notify`` that fires before the waiter reaches ``wait`` is
+silently dropped, so a wait not guarded by a predicate-rechecking loop
+hangs forever on exactly the interleaving the chaos benches rarely
+draw (the planted-bug fixture in tests/test_schedules.py demonstrates
+it deterministically).
+
+Checks, per condition attribute (``self.X = threading.Condition(...)``)
+or module/function-local condition:
+
+- **wait-in-loop** — every ``<cond>.wait(...)`` call must be lexically
+  inside a ``while``/``for`` loop of its function: wakeups are hints,
+  not messages; the predicate must be re-checked (PEP-style
+  ``while not pred: cv.wait()``).
+- **wait-under-lock** — the wait must be lexically inside a ``with``
+  of the condition's (aliased) lock; an unlocked wait raises
+  RuntimeError at runtime, but only on the paths a test happens to
+  drive.
+- **reachable notify** — a condition somebody waits on must have at
+  least one ``notify``/``notify_all`` in the same class (or module),
+  itself under the condition's lock (a ``with``, or the manual
+  ``if <lock>.acquire(blocking=False):`` idiom
+  serve/queue.py ``begin_drain`` uses from its signal-handler
+  context). A waited-on condition nobody notifies is a deadlock
+  scheduled for later.
+
+Exemptions: ``# graftsync: allow-cv-protocol`` on the line, or a
+justified entry in tools/graftsync/justify.py CV_PROTOCOL.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import attr_chain
+from tools.graftsync import justify
+from tools.graftsync.passes import _sync_util as su
+
+RULE = "cv-protocol"
+
+
+def _cond_of_call(m, u, call: ast.Call) -> tuple[str, str] | None:
+    """(display name, canonical lock id) when `call` is a method call
+    on a known condition object."""
+    ch = attr_chain(call.func)
+    if not ch or len(ch) < 2:
+        return None
+    recv = ch[:-1]
+    kind = su.receiver_kind(m, u, recv)
+    if kind is not None and kind[0] == "cond":
+        return (".".join(recv), kind[1])
+    return None
+
+
+def _walk_with_context(u, m):
+    """Yield (node, held lock ids, loop_depth) over the unit, with
+    held/loop state reset inside nested defs (closures run later, on
+    another thread, outside any loop of ours)."""
+
+    def visit(node, held: tuple, loops: int):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not u.node:
+            held, loops = (), 0
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lid = su.held_lock_id(m, u, item.context_expr)
+                if lid is not None and lid not in held:
+                    held = held + (lid,)
+        if isinstance(node, (ast.While, ast.For)):
+            loops += 1
+        # the manual-acquire idiom: `if <lock>.acquire(...):` makes the
+        # IF BODY a held region (begin_drain's signal-handler pattern)
+        if isinstance(node, ast.If):
+            for n in ast.walk(node.test):
+                if isinstance(n, ast.Call):
+                    fch = attr_chain(n.func) or []
+                    if fch and fch[-1] == "acquire":
+                        lid = None
+                        if len(fch) >= 2:
+                            kind = su.receiver_kind(m, u, fch[:-1])
+                            if kind is not None and kind[0] in ("lock",
+                                                                "cond"):
+                                lid = kind[1]
+                        if lid is not None:
+                            # recurse the body with the lock held
+                            for child in node.body:
+                                yield from visit(child,
+                                                 held + (lid,), loops)
+                            for child in node.orelse:
+                                yield from visit(child, held, loops)
+                            return
+        yield (node, held, loops)
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, held, loops)
+
+    # the `*_locked` naming convention (graftlint lock-discipline
+    # enforces the caller side): the suffix asserts every caller
+    # already holds the class lock, so the method body runs locked
+    held0: tuple = ()
+    if getattr(u.node, "name", "").endswith("_locked") \
+            and u.cls is not None:
+        held0 = tuple(sorted({m.lock_id(u.cls.name, c)
+                              for c in u.cls.canon.values()}))
+    yield from visit(u.node, held0, 0)
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+
+    def emit(path: str, line: int, message: str, key: str) -> None:
+        if justify.lookup(ctx, RULE, path, key) is None:
+            out.append(Violation(rule=RULE, path=path, line=line,
+                                 message=message, key=key))
+
+    for rel in ctx.files:
+        m = su.model_for(ctx, rel)
+        if m is None:
+            continue
+        # collect waits and notifies with context. The "reachable
+        # notify under the lock" promise is the CONJUNCTION of two
+        # checks: existence (notified_anywhere, below) and the
+        # per-site notify-no-lock violation — an unlocked-only notify
+        # satisfies existence but is flagged at its own site.
+        waited: dict[tuple, tuple] = {}   # cond key -> (display, line)
+        notified_anywhere: set[tuple] = set()
+        for u in m.units:
+            owner = u.cls.name if u.cls is not None else "<module>"
+            for node, held, loops in _walk_with_context(u, m):
+                if not isinstance(node, ast.Call):
+                    continue
+                attr = (node.func.attr
+                        if isinstance(node.func, ast.Attribute) else "")
+                if attr not in ("wait", "notify", "notify_all"):
+                    continue
+                cond = _cond_of_call(m, u, node)
+                if cond is None:
+                    continue
+                display, lock_id = cond
+                ckey = (owner if display.startswith("self.")
+                        else "<module>", display.split(".")[-1])
+                if attr == "wait":
+                    waited.setdefault(ckey, (display, node.lineno, rel))
+                    if loops == 0:
+                        emit(rel, node.lineno,
+                             (f"{u.qual}: `{display}.wait()` outside "
+                              f"a predicate-rechecking loop — "
+                              f"wakeups are hints; wrap it in "
+                              f"`while not <predicate>:` (lost-"
+                              f"wakeup/spurious-wakeup hazard)"),
+                             f"wait-no-loop@{u.qual}")
+                    if lock_id not in held:
+                        emit(rel, node.lineno,
+                             (f"{u.qual}: `{display}.wait()` without "
+                              f"holding the condition's lock (`with "
+                              f"{display}:` or its aliased lock) — "
+                              f"RuntimeError at runtime"),
+                             f"wait-no-lock@{u.qual}")
+                else:
+                    notified_anywhere.add(ckey)
+                    if lock_id not in held:
+                        emit(rel, node.lineno,
+                             (f"{u.qual}: `{display}.{attr}()` "
+                              f"without holding the condition's lock "
+                              f"— RuntimeError at runtime (take "
+                              f"`with {display}:` around the state "
+                              f"change AND the notify)"),
+                             f"notify-no-lock@{u.qual}")
+        for ckey, (display, line, vrel) in waited.items():
+            if ckey not in notified_anywhere:
+                emit(vrel, line,
+                     (f"`{display}` is waited on but NEVER notified "
+                      f"in {ckey[0]} — every waiter relies on its "
+                      f"timeout (or hangs); add the notify on the "
+                      f"state change, or justify"),
+                     f"no-notify@{ckey[0]}.{ckey[1]}")
+    return out

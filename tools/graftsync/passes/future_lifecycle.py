@@ -1,0 +1,330 @@
+"""graftsync pass — future-lifecycle: the static half of the
+zero-lost-Futures invariant (docs/RELIABILITY.md: "a submitted Future
+ALWAYS resolves"). Bug-class provenance: PR 13's ``_assign``→sender
+handoff race — a path existed on which a dispatched flight was neither
+handed to a sender nor released, so its futures never resolved and
+``close()`` hung on the leg count. The benches assert zero lost
+futures PER SCHEDULE; this pass checks every schedule at once, at the
+price of a coarser property.
+
+What it proves (and what it does not — docs/LINTS.md "Limits"):
+
+- a **custody function** is one in serve/ or fleet/ whose parameter is
+  a request-custody object — annotated ``_Request``/``_Flight``/
+  ``Future`` (or a list of them), or named ``batch`` / ``flight`` /
+  ``expired`` / ``recovered`` (underscore-prefixed params are
+  deliberately-unused and exempt). On EVERY exit path (each ``return``
+  and the fall-through), the function must have performed at least one
+  **custody action**: resolving (``set_result``/``set_exception``),
+  any call taking the object (or an element derived from iterating
+  it) as an argument or receiver — the handoff —, mutating its
+  attributes/subscripts, iterating it, or returning/referencing it in
+  the return expression. An exit path on which the custody object is
+  NEVER TOUCHED is a dropped-custody path: the futures inside it can
+  no longer resolve. ``raise`` exits are exempt (the worker loops
+  catch and fail the batch — the catch-all backstop), as is an early
+  return directly guarded by emptiness (``if not batch: return``).
+- a **locally created Future** (``fut = Future()``) must escape —
+  be passed to a call, stored into shared state, or returned — on
+  every non-``raise`` exit path. A raise before the future escaped is
+  fine: no caller ever saw it.
+
+This is intraprocedural and exactly-once is NOT proven (a path that
+touches custody twice passes); the deterministic interleaving harness
+(pertgnn_tpu/testing/schedules.py) is the dynamic twin that pins
+exactly-once for the nastiest windows. Exemptions:
+``# graftsync: allow-future-lifecycle`` on the ``def`` line, or a
+justified entry in tools/graftsync/justify.py FUTURE_LIFECYCLE
+(key ``<qualname>:<param>``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import attr_chain
+from tools.graftsync import justify
+from tools.graftsync.passes import _sync_util as su
+
+RULE = "future-lifecycle"
+
+SCOPE = ("pertgnn_tpu/serve/", "pertgnn_tpu/fleet/")
+
+_CUSTODY_NAMES = {"batch", "flight", "expired", "recovered"}
+_CUSTODY_TYPES = ("_Request", "_Flight", "Future")
+_NON_ACTIONS = {"len", "isinstance", "bool", "id", "type", "repr",
+                "str", "print"}
+
+
+def _annotation_is_custody(ann: ast.AST | None) -> bool:
+    if ann is None:
+        return False
+    for n in ast.walk(ann):
+        if isinstance(n, ast.Name) and n.id in _CUSTODY_TYPES:
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and any(t in n.value for t in _CUSTODY_TYPES):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _CUSTODY_TYPES:
+            return True
+    return False
+
+
+def _custody_params(fn: ast.AST) -> list[str]:
+    out = []
+    args = fn.args
+    for a in args.posonlyargs + args.args + args.kwonlyargs:
+        if a.arg in ("self", "cls") or a.arg.startswith("_"):
+            continue
+        if a.arg in _CUSTODY_NAMES or _annotation_is_custody(
+                a.annotation):
+            out.append(a.arg)
+    return out
+
+
+class _Analysis:
+    """Path-insensitive-per-branch custody walk: statements are
+    interpreted over a SET of boolean "acted" states (one per
+    still-live path); branches union, action is monotone."""
+
+    def __init__(self, names: set[str]):
+        self.tracked = set(names)   # custody name + derived elements
+        self.drops: list[tuple[int, str]] = []  # (line, kind)
+
+    # -- action detection -------------------------------------------------
+
+    def _mentions(self, node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in self.tracked:
+                return True
+        return False
+
+    def _is_action(self, node: ast.AST) -> bool:
+        """Does this statement/expression touch the custody object in
+        a consuming way?"""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                fch = attr_chain(n.func) or []
+                if fch and fch[0] in self.tracked:
+                    return True  # custody.x.y(...) — receiver root
+                if fch and fch[-1] in _NON_ACTIONS and len(fch) == 1:
+                    continue
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    if self._mentions(a):
+                        return True
+            elif isinstance(n, (ast.Assign, ast.AugAssign,
+                                ast.AnnAssign)):
+                targets = (n.targets if isinstance(n, ast.Assign)
+                           else [n.target])
+                for t in targets:
+                    base = t
+                    while isinstance(base, (ast.Attribute,
+                                            ast.Subscript)):
+                        base = base.value
+                    if isinstance(base, ast.Name) \
+                            and base.id in self.tracked \
+                            and base is not t:
+                        return True  # custody.attr = / custody[i] =
+                # custody.attr read into a name DERIVES the name
+                if isinstance(n, ast.Assign) and n.value is not None \
+                        and self._mentions(n.value):
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self.tracked.add(t.id)
+                    return True
+            elif isinstance(n, (ast.For, ast.comprehension)):
+                it = n.iter
+                if self._mentions(it):
+                    for sub in ast.walk(n.target):
+                        if isinstance(sub, ast.Name):
+                            self.tracked.add(sub.id)
+                    return True
+        return False
+
+    # -- the walk ---------------------------------------------------------
+
+    def _guarded_empty_return(self, stmt: ast.If) -> bool:
+        """``if not custody: return`` / ``if custody is None: return``
+        — an exit with provably-empty custody."""
+        test = stmt.test
+        names_in_test = self._mentions(test)
+        if not names_in_test:
+            return False
+        ok_shape = False
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op,
+                                                        ast.Not):
+            ok_shape = True
+        if isinstance(test, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.Eq))
+                for op in test.ops):
+            ok_shape = True
+        if not ok_shape:
+            return False
+        return all(isinstance(s, (ast.Return, ast.Pass, ast.Continue))
+                   or (isinstance(s, ast.Expr)
+                       and isinstance(s.value, ast.Constant))
+                   for s in stmt.body)
+
+    def block(self, stmts: list, states: set[bool],
+              raises_exempt: bool) -> set[bool]:
+        """Interpret a statement list; returns fall-through states
+        (empty set = no fall-through). Exits are checked inline."""
+        for stmt in stmts:
+            if not states:
+                return states
+            if isinstance(stmt, ast.Return):
+                acted_now = states
+                if stmt.value is not None and self._mentions(stmt.value):
+                    acted_now = {True}
+                elif stmt.value is not None and self._is_action(
+                        stmt.value):
+                    acted_now = {True}
+                if False in acted_now:
+                    self.drops.append((stmt.lineno, "return"))
+                return set()
+            if isinstance(stmt, ast.Raise):
+                if not raises_exempt and False in states:
+                    self.drops.append((stmt.lineno, "raise"))
+                return set()
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                return set()
+            if isinstance(stmt, ast.If):
+                if self._guarded_empty_return(stmt):
+                    states = self.block(stmt.orelse, set(states),
+                                        raises_exempt)
+                    continue
+                test_acts = self._is_action(stmt.test)
+                entry = {True} if test_acts else set(states)
+                a = self.block(stmt.body, set(entry), raises_exempt)
+                b = self.block(stmt.orelse, set(entry), raises_exempt)
+                states = a | b
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                acts = self._is_action(stmt)
+                entry = {True} if acts else set(states)
+                body = self.block(stmt.body, set(entry), raises_exempt)
+                # zero-trip path keeps the entry states
+                states = entry | body
+                states |= self.block(stmt.orelse, set(states),
+                                     raises_exempt)
+                continue
+            if isinstance(stmt, ast.With):
+                acts = any(self._is_action(i.context_expr)
+                           for i in stmt.items)
+                entry = {True} if acts else set(states)
+                states = self.block(stmt.body, set(entry),
+                                    raises_exempt)
+                continue
+            if isinstance(stmt, ast.Try):
+                t = self.block(stmt.body, set(states), raises_exempt)
+                h = set()
+                for handler in stmt.handlers:
+                    h |= self.block(handler.body, set(states),
+                                    raises_exempt)
+                merged = t | h
+                merged |= self.block(stmt.orelse, set(t or states),
+                                     raises_exempt)
+                if stmt.finalbody:
+                    merged = self.block(stmt.finalbody,
+                                        set(merged or states),
+                                        raises_exempt)
+                states = merged or states
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scope: analyzed separately if at all
+            # plain statement: does it act?
+            if self._is_action(stmt):
+                states = {True}
+        return states
+
+
+def _check_custody(fn, param: str) -> list[tuple[int, str]]:
+    a = _Analysis({param})
+    final = a.block(fn.body, {False}, raises_exempt=True)
+    if False in final:
+        a.drops.append((getattr(fn, "lineno", 0), "fall-through"))
+    return a.drops
+
+
+def _check_created_future(fn, name: str,
+                          create_line: int) -> list[tuple[int, str]]:
+    """A ``name = Future()`` local must escape on every non-raise exit
+    path REACHED AFTER the creation. Approximation: analyze the whole
+    body with the future tracked; creation itself is not an action."""
+    a = _Analysis({name})
+    final = a.block(fn.body, {False}, raises_exempt=True)
+    drops = [(ln, kind) for ln, kind in a.drops if ln > create_line]
+    if False in final:
+        drops.append((create_line, "fall-through"))
+    return drops
+
+
+def _pragma_on_def(ctx, rel: str, fn) -> bool:
+    try:
+        line = ctx.lines(rel)[fn.lineno - 1]
+    except (OSError, IndexError):
+        return False
+    return "graftsync: allow-future-lifecycle" in line
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+    for rel in ctx.files_under(*SCOPE):
+        m = su.model_for(ctx, rel)
+        if m is None:
+            continue
+        for u in m.units:
+            fn = u.node
+            if fn.name == "__init__":
+                continue
+            if _pragma_on_def(ctx, rel, fn):
+                continue
+            for param in _custody_params(fn):
+                key = f"{u.qual}:{param}"
+                if justify.lookup(ctx, RULE, rel, key) is not None:
+                    continue
+                for line, kind in _check_custody(fn, param):
+                    if kind == "raise":
+                        continue
+                    out.append(Violation(
+                        rule=RULE, path=rel, line=line,
+                        message=(f"{u.qual}: exit path ({kind}) on "
+                                 f"which custody parameter "
+                                 f"`{param}` is never touched — its "
+                                 f"futures can no longer resolve "
+                                 f"(dropped custody); resolve, hand "
+                                 f"off, or justify in "
+                                 f"tools/graftsync/justify.py"),
+                        key=key))
+                    break  # one finding per (function, param)
+            # locally created futures must escape
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and (attr_chain(node.value.func) or [""])[-1]
+                        == "Future"):
+                    for t in node.targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        key = f"{u.qual}:{t.id}"
+                        if justify.lookup(ctx, RULE, rel,
+                                          key) is not None:
+                            continue
+                        drops = _check_created_future(fn, t.id,
+                                                      node.lineno)
+                        drops = [d for d in drops if d[1] != "raise"]
+                        if drops:
+                            line, kind = drops[0]
+                            out.append(Violation(
+                                rule=RULE, path=rel, line=line,
+                                message=(
+                                    f"{u.qual}: Future created at "
+                                    f"line {node.lineno} "
+                                    f"(`{t.id}`) can reach an exit "
+                                    f"({kind}) without escaping — "
+                                    f"a dropped future never "
+                                    f"resolves"),
+                                key=key))
+    return out

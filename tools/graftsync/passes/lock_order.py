@@ -1,0 +1,272 @@
+"""graftsync pass — lock-order: the whole-repo lock-acquisition graph
+must be acyclic, and no blocking operation may run while a lock is
+held. Bug-class provenance: PR 13's review found the router's
+``_assign``→sender handoff could swallow a flight against a concurrent
+``remove_worker`` — exactly the window where "what runs under which
+lock, in what order" stopped being checkable by eye; every threaded
+module since (autoscaler, hedger, loadgen) adds acquisition contexts.
+
+Static model (same resolution discipline as graftlint's passes —
+lexical, same-file, with the same-file call fixpoint trace-hazard
+pioneered):
+
+- **acquisition graph**: a node per lock identity (class attribute,
+  module global, or function local; ``Condition(self._lock)`` aliases
+  to the wrapped lock). An edge A→B exists when code acquires B while
+  lexically holding A, directly (nested ``with``) or through a
+  same-file callee (fixpoint over the module call graph: bare-name
+  functions and ``self.<method>``). Any cycle is a potential deadlock
+  and a violation naming the cycle.
+- **blocking-while-locked**: inside a held-lock region, these calls
+  are violations — ``time.sleep``; ``<queue>.get`` (both kinds) and
+  ``<Queue>.put`` (bounded queues; ``SimpleQueue.put`` never blocks);
+  ``<thread>.join``; ``<event>.wait``; a ``Condition.wait`` whose lock
+  is NOT the one held (waiting on one mutex while holding another);
+  ``Future.result``; ``Future.set_result`` / ``set_exception``
+  (done-callbacks run inline and may re-enter the very lock held —
+  the deadlock class fleet/router.py documents on ``_resolve_error``);
+  unbounded ``.acquire()``; the HTTP transport
+  (``post_predict`` / ``get_probe`` / ``urlopen`` / ``self._post`` /
+  ``self._probe``); and bus emission (``*.bus.counter/gauge/...`` —
+  the writer takes its own non-reentrant lock and does file I/O, which
+  must never serialize an admission path; pertgnn_tpu/telemetry/'s own
+  internals are exempt, the bus IS telemetry). A same-file callee that
+  performs any of these is flagged at the locked call site.
+
+Deliberate exceptions carry ``# graftsync: allow-lock-order`` on the
+line, or a justified entry in tools/graftsync/justify.py LOCK_ORDER.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import attr_chain
+from tools.graftsync import justify
+from tools.graftsync.passes import _sync_util as su
+
+RULE = "lock-order"
+
+_TRANSPORT_NAMES = {"post_predict", "get_probe", "urlopen"}
+_TRANSPORT_SELF_ATTRS = {"_post", "_probe"}
+_BUS_METHODS = {"counter", "gauge", "histogram", "span", "trace_span",
+                "finish_trace", "start_trace"}
+_RESOLVE_METHODS = {"set_result", "set_exception"}
+
+
+def _blocking_desc(m, u, call: ast.Call, held: set,
+                   in_telemetry: bool) -> str | None:
+    """Why this call blocks (or re-enters), or None. `held` is the set
+    of canonical lock ids lexically held at the call site."""
+    ch = attr_chain(call.func) or []
+    attr = (call.func.attr
+            if isinstance(call.func, ast.Attribute) else "")
+    if ch == ["time", "sleep"]:
+        return "time.sleep"
+    if ch and ch[-1] in _TRANSPORT_NAMES:
+        return f"HTTP transport call `{'.'.join(ch)}`"
+    if (len(ch) == 2 and ch[0] == "self"
+            and ch[1] in _TRANSPORT_SELF_ATTRS):
+        return f"injected transport call `self.{ch[1]}(...)`"
+    if attr in _RESOLVE_METHODS:
+        return (f"Future.{attr} — done-callbacks run inline and may "
+                f"re-enter the lock held here")
+    recv = ch[:-1] if ch else []
+    kind = su.receiver_kind(m, u, recv) if recv else None
+    if attr == "result" and recv:
+        return f"Future.result on `{'.'.join(recv)}`"
+    if attr == "join" and kind is not None and kind[0] == "thread":
+        return f"Thread.join on `{'.'.join(recv)}`"
+    if attr == "wait" and kind is not None:
+        if kind[0] == "event":
+            return f"Event.wait on `{'.'.join(recv)}`"
+        if kind[0] == "cond" and kind[1] not in held:
+            return (f"Condition.wait on `{'.'.join(recv)}` while "
+                    f"holding a DIFFERENT lock (wait only releases "
+                    f"its own)")
+    if attr in ("get", "put") and kind is not None \
+            and kind[0] == "queue" \
+            and not su.queue_call_nonblocking(call, attr):
+        if attr == "get":
+            return f"blocking queue get on `{'.'.join(recv)}`"
+        if kind[1] == "queue":
+            return f"bounded-queue put on `{'.'.join(recv)}`"
+    if attr == "acquire" and kind is not None \
+            and kind[0] in ("lock", "cond"):
+        if not any(kw.arg == "blocking"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is False
+                   for kw in call.keywords):
+            return f"unbounded acquire on `{'.'.join(recv)}`"
+    if (not in_telemetry and attr in _BUS_METHODS
+            and "bus" in recv):
+        return (f"bus emission `{'.'.join(ch)}` — the telemetry "
+                f"writer takes its own lock and does file I/O")
+    return None
+
+
+class _UnitFacts:
+    """Per-unit lexical facts feeding the two fixpoints."""
+
+    __slots__ = ("acquires", "blocking", "calls_under",
+                 "acquired_under", "blocking_sites")
+
+    def __init__(self):
+        self.acquires: set[str] = set()            # lock ids, anywhere
+        self.blocking: list[str] = []              # descs, anywhere
+        # (held lock id, call node, callee-qual list)
+        self.calls_under: list = []
+        # (held lock id, acquired lock id, line)
+        self.acquired_under: list = []
+        # (held lock id, desc, line) — direct blocking under a lock
+        self.blocking_sites: list = []
+
+
+def _unit_facts(m, u, in_telemetry: bool) -> _UnitFacts:
+    f = _UnitFacts()
+
+    def visit(node, held: tuple):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not u.node:
+            held = ()  # a closure body executes later, unlocked
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lid = su.held_lock_id(m, u, item.context_expr)
+                if lid is not None:
+                    f.acquires.add(lid)
+                    for h in held:
+                        if h != lid:
+                            f.acquired_under.append(
+                                (h, lid, node.lineno))
+                    if lid not in held:
+                        held = held + (lid,)
+        if isinstance(node, ast.Call):
+            desc = _blocking_desc(m, u, node, set(held), in_telemetry)
+            if desc is not None:
+                f.blocking.append(desc)
+                if held:
+                    f.blocking_sites.append((held[-1], desc,
+                                             node.lineno))
+            elif held:
+                callees = su.callee_units(m, u, node)
+                if callees:
+                    f.calls_under.append((held[-1], node,
+                                          [c.qual for c in callees]))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    visit(u.node, ())
+    return f
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+    edges: dict[str, set[str]] = {}      # lock id -> acquired-while-held
+    edge_site: dict[tuple[str, str], tuple[str, int]] = {}
+    per_file: list[tuple] = []           # (rel, m, u, facts)
+
+    for rel in ctx.files:
+        m = su.model_for(ctx, rel)
+        if m is None:
+            continue
+        in_telemetry = rel.startswith("pertgnn_tpu/telemetry/")
+        facts = {u.qual: (u, _unit_facts(m, u, in_telemetry))
+                 for u in m.units}
+        # the same-file call graph, computed once per unit
+        call_edges: dict[str, set] = {}
+        for q, (u, f) in facts.items():
+            outs: set[str] = set()
+            for node in ast.walk(u.node):
+                if isinstance(node, ast.Call):
+                    outs.update(c.qual for c in su.callee_units(m, u,
+                                                                node))
+            call_edges[q] = outs & set(facts)
+        # fixpoints: transitive acquisitions and base blocking descs
+        acq: dict[str, set] = {q: set(f.acquires)
+                               for q, (u, f) in facts.items()}
+        blk: dict[str, set] = {q: set(f.blocking)
+                               for q, (u, f) in facts.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q in facts:
+                for cq in call_edges[q]:
+                    if not acq[cq] <= acq[q]:
+                        acq[q] |= acq[cq]
+                        changed = True
+                    if not blk[cq] <= blk[q]:
+                        blk[q] |= blk[cq]
+                        changed = True
+        for q, (u, f) in facts.items():
+            for h, lid, line in f.acquired_under:
+                edges.setdefault(h, set()).add(lid)
+                edge_site.setdefault((h, lid), (rel, line))
+            for h, desc, line in f.blocking_sites:
+                per_file.append((rel, u, h, desc, line))
+            for h, call, callees in f.calls_under:
+                for cq in callees:
+                    cu, cf = facts[cq]
+                    for lid in acq[cq]:
+                        if lid != h:
+                            edges.setdefault(h, set()).add(lid)
+                            edge_site.setdefault((h, lid),
+                                                 (rel, call.lineno))
+                    if blk[cq]:
+                        per_file.append((rel, u, h,
+                                         f"call to {cq}, which "
+                                         f"performs: "
+                                         f"{sorted(blk[cq])[0]}",
+                                         call.lineno))
+
+    # blocking-while-locked violations
+    for rel, u, held, desc, line in per_file:
+        key = f"{u.qual}@{held.split('::')[-1]}"
+        reason = justify.lookup(ctx, RULE, rel, key)
+        if reason is not None:
+            continue
+        out.append(Violation(
+            rule=RULE, path=rel, line=line,
+            message=(f"{u.qual}: {desc} while holding "
+                     f"{held.split('::')[-1]} — a blocking operation "
+                     f"under a lock stalls every thread contending "
+                     f"for it; move it outside the critical section "
+                     f"or justify it (tools/graftsync/justify.py)"),
+            key=key))
+
+    # cycle detection over the acquisition graph
+    seen_cycles: set[frozenset] = set()
+    state: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(nid: str):
+        state[nid] = 1
+        stack.append(nid)
+        for nxt in sorted(edges.get(nid, ())):
+            if state.get(nxt, 0) == 0:
+                dfs(nxt)
+            elif state.get(nxt) == 1:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                fs = frozenset(cycle)
+                if fs not in seen_cycles:
+                    seen_cycles.add(fs)
+                    rel, line = edge_site.get((nid, nxt), ("", 0))
+                    pretty = " -> ".join(c.split("::")[-1]
+                                         for c in cycle)
+                    out.append(Violation(
+                        rule=RULE, path=rel or cycle[0].split("::")[0],
+                        line=line,
+                        message=(f"lock-order cycle (potential "
+                                 f"deadlock): {pretty} — two threads "
+                                 f"taking these locks in opposite "
+                                 f"orders wedge forever; pick ONE "
+                                 f"global order"),
+                        key="cycle:" + "|".join(sorted(fs))))
+        stack.pop()
+        state[nid] = 2
+
+    for nid in sorted(set(edges) | {x for v in edges.values()
+                                    for x in v}):
+        if state.get(nid, 0) == 0:
+            dfs(nid)
+    return out

@@ -1,0 +1,151 @@
+"""graftsync pass — thread-lifecycle: every started Thread is NAMED,
+and every non-daemon thread has a reachable join. Bug-class
+provenance: the tier-1 deadlock watchdog (tests/conftest.py) dumps all
+thread stacks via faulthandler when the suite's ``timeout -k`` budget
+fires — a dump full of ``Thread-7`` frames attributes nothing, and
+graftscope's per-process traces face the same problem. An un-joined
+non-daemon thread is worse: it silently blocks process exit (the
+``_call_abandonable`` docstring documents the ThreadPoolExecutor
+variant of exactly that hang).
+
+Checks, on every ``threading.Thread(...)`` construction in scope:
+
+- **named** — the call must carry ``name=`` (a variable is fine; the
+  point is that SOMEONE chose a name).
+- **daemon-or-joined** — ``daemon=True``, or the constructed thread's
+  binding (a local, a ``self.<attr>``, or the elements of a
+  list/list-comprehension it lands in) is ``.join()``ed somewhere in
+  the same file (for thread LISTS: a ``for X in <list>:`` loop whose
+  variable is joined). A thread that is neither daemonized nor joined
+  outlives its owner invisibly.
+
+Exemptions: ``# graftsync: allow-thread-lifecycle`` on the
+construction line, or tools/graftsync/justify.py THREAD_LIFECYCLE.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import attr_chain
+from tools.graftsync import justify
+from tools.graftsync.passes import _sync_util as su
+
+RULE = "thread-lifecycle"
+
+
+def _thread_calls(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            ch = attr_chain(node.func) or []
+            if ch and ch[-1] == "Thread" and len(ch) <= 2:
+                yield node
+
+
+def _kw(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _joined_names(tree) -> set[str]:
+    """Every dotted name `.join()` is called on in the file."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr == "join":
+            recv = attr_chain(node.func.value)
+            if recv:
+                out.add(".".join(recv))
+    return out
+
+
+def _loop_vars_over(tree, container: str) -> set[str]:
+    """Loop variables of ``for X in <container>:`` in the file."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For):
+            it = attr_chain(node.iter)
+            if it and ".".join(it) == container \
+                    and isinstance(node.target, ast.Name):
+                out.add(node.target.id)
+    return out
+
+
+def _bindings(tree, call: ast.Call) -> list[str]:
+    """Dotted names the constructed Thread may be reachable under:
+    direct assignment targets, or — when the construction sits inside
+    a list / list-comprehension that is itself assigned — the loop
+    variables iterating that list."""
+    out: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        direct = node.value is call
+        via_list = False
+        if isinstance(node.value, ast.ListComp) \
+                and node.value.elt is call:
+            via_list = True
+        if isinstance(node.value, ast.List) \
+                and call in node.value.elts:
+            via_list = True
+        # `self._x.append(Thread(...))`-style incremental list growth
+        # is NOT resolved (declared limit — none in the tree today);
+        # such a site would need `daemon=True` or a line pragma
+        if not (direct or via_list):
+            continue
+        for t in node.targets:
+            ch = attr_chain(t)
+            if not ch:
+                continue
+            name = ".".join(ch)
+            if direct:
+                out.append(name)
+            if via_list:
+                out.extend(sorted(_loop_vars_over(tree, name)))
+                out.append(name)
+    return out
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+    for rel in ctx.files:
+        m = su.model_for(ctx, rel)
+        if m is None:
+            continue
+        tree = ctx.tree(rel)
+        joined = _joined_names(tree)
+        for call in _thread_calls(tree):
+            if _kw(call, "name") is None:
+                key = f"unnamed@{call.lineno}"
+                if justify.lookup(ctx, RULE, rel, key) is None:
+                    out.append(Violation(
+                        rule=RULE, path=rel, line=call.lineno,
+                        message=("Thread constructed without "
+                                 "`name=` — faulthandler dumps and "
+                                 "graftscope attribution need every "
+                                 "thread named (Thread-<n> "
+                                 "attributes nothing)"),
+                        key=key))
+            daemon = _kw(call, "daemon")
+            is_daemon = (isinstance(daemon, ast.Constant)
+                         and daemon.value is True)
+            if is_daemon:
+                continue
+            bindings = _bindings(tree, call)
+            if any(b in joined for b in bindings):
+                continue
+            key = f"unjoined@{call.lineno}"
+            if justify.lookup(ctx, RULE, rel, key) is None:
+                out.append(Violation(
+                    rule=RULE, path=rel, line=call.lineno,
+                    message=("non-daemon Thread with no reachable "
+                             "`.join()` in this file — it outlives "
+                             "its owner and blocks process exit; "
+                             "daemonize it or join it on the "
+                             "close/drain path"),
+                    key=key))
+    return out

@@ -1,0 +1,117 @@
+"""graftsync pass — timeout-totality: every blocking wait on the
+REQUEST PATH (serve/, fleet/) is bounded — a timeout argument — or
+carries a justified allowlist entry explaining which protocol
+guarantees the wakeup. Bug-class provenance: the chaos scenarios' hang
+class. The ALWAYS-resolves contract (docs/RELIABILITY.md) is enforced
+at the Future layer, but a raw ``queue.get()`` / ``Thread.join()`` /
+``Condition.wait()`` below it waits on a PROTOCOL, not a promise — and
+when the protocol's other half dies (wedged device, killed worker),
+an unbounded wait turns a typed failure into an opaque 870 s tier-1
+timeout.
+
+Checked call shapes (receivers resolved same-file via the shared
+model — dict ``.get`` is never confused with a queue's):
+
+- ``<Condition>.wait()`` / ``<Event>.wait()`` with no timeout;
+- ``<Thread>.join()`` with no timeout;
+- ``<queue>.get()`` with no timeout (``get_nowait`` is fine);
+  ``<bounded Queue>.put()`` with no timeout (``SimpleQueue.put``
+  never blocks);
+- ``<anything>.result()`` with NO argument — a Future wait.
+
+An unbounded wait that is CORRECT states its wakeup guarantee in
+tools/graftsync/justify.py TIMEOUT_TOTALITY (liveness-pinned: a dead
+entry fails tier-1), or carries
+``# graftsync: allow-timeout-totality`` on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import attr_chain
+from tools.graftsync import justify
+from tools.graftsync.passes import _sync_util as su
+
+RULE = "timeout-totality"
+
+SCOPE = ("pertgnn_tpu/serve/", "pertgnn_tpu/fleet/")
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+    for rel in ctx.files_under(*SCOPE):
+        m = su.model_for(ctx, rel)
+        if m is None:
+            continue
+        for u in m.units:
+            for node in ast.walk(u.node):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                if attr not in ("wait", "join", "get", "put",
+                                "result"):
+                    continue
+                recv = attr_chain(node.func.value)
+                if recv is None:
+                    continue
+                display = ".".join(recv)
+                kind = su.receiver_kind(m, u, recv)
+                verdict = None
+                if attr == "result":
+                    if not su.has_timeout_arg(node):
+                        verdict = (f"`{display}.result()` without a "
+                                   f"timeout — an unbounded Future "
+                                   f"wait")
+                elif kind is None:
+                    continue
+                elif attr == "wait" and kind[0] in ("cond", "event"):
+                    if not su.has_timeout_arg(node):
+                        verdict = (f"`{display}.wait()` without a "
+                                   f"timeout")
+                elif attr == "join" and kind[0] == "thread":
+                    if not su.has_timeout_arg(node):
+                        verdict = (f"`{display}.join()` without a "
+                                   f"timeout")
+                elif attr == "get" and kind[0] == "queue":
+                    # Queue.get(block, timeout): the FIRST positional
+                    # is `block`, not a timeout — q.get(True) is the
+                    # unbounded wait this pass exists to catch
+                    if not su.has_timeout_arg(
+                            node, first_arg_is_timeout=False):
+                        verdict = (f"`{display}.get()` without a "
+                                   f"timeout")
+                elif attr == "put" and kind[0] == "queue" \
+                        and kind[1] == "queue":
+                    # bounded queues block on put; SimpleQueue never.
+                    # put(item, block, timeout): bounded with a real
+                    # (non-None) third positional / timeout= keyword,
+                    # or the non-blocking block=False spellings
+                    bounded = (su.queue_call_nonblocking(node, "put")
+                               or (len(node.args) >= 3
+                                   and not su.is_none_const(
+                                       node.args[2]))
+                               or any(kw.arg == "timeout"
+                                      and not su.is_none_const(
+                                          kw.value)
+                                      for kw in node.keywords))
+                    if not bounded:
+                        verdict = (f"`{display}.put()` on a bounded "
+                                   f"queue without a timeout")
+                if verdict is None:
+                    continue
+                key = f"{u.qual}:{attr}@{display}"
+                if justify.lookup(ctx, RULE, rel, key) is not None:
+                    continue
+                out.append(Violation(
+                    rule=RULE, path=rel, line=node.lineno,
+                    message=(f"{u.qual}: {verdict} on the request "
+                             f"path — when the other half of this "
+                             f"protocol dies, the wait becomes an "
+                             f"opaque hang; bound it, or state the "
+                             f"wakeup guarantee in "
+                             f"tools/graftsync/justify.py"),
+                    key=key))
+    return out
