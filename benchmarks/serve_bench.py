@@ -161,18 +161,26 @@ def quality_gate(ds, cfg, state, engine):
     OFFLINE forward (same dtype, different dispatch path — comparing the
     engine to itself would make the gate vacuous). Returns the JSON
     fields; raises AssertionError when the relative worsening exceeds the
-    pre-registered QLOSS_DELTA_BUDGET for this dtype."""
+    pre-registered QLOSS_DELTA_BUDGET for this dtype.
+
+    Multi-quantile heads (ModelConfig.quantile_taus, pertgnn_tpu/lens/)
+    are gated PER TAU: each column's pinball loss at its own level vs
+    the reference's same column, EVERY delta inside the budget — a
+    quantization scheme that only degrades the tail columns cannot hide
+    behind a healthy median."""
     import dataclasses
 
     import jax.numpy as jnp
 
+    from pertgnn_tpu.config import resolve_quantile_taus
     from pertgnn_tpu.serve.engine import InferenceEngine
     from pertgnn_tpu.train.metrics import quantile_loss
     from pertgnn_tpu.train.predict import predict_split, predict_split_served
 
     dtype = cfg.serve.serve_dtype
     ys = np.asarray(ds.splits["test"].ys, np.float32)
-    pred_d = predict_split_served(ds, cfg, state, "test", engine=engine)
+    pred_d = np.asarray(predict_split_served(ds, cfg, state, "test",
+                                             engine=engine))
     if dtype == "f32":
         pred_f = predict_split(ds, cfg, state, "test")
     else:
@@ -184,23 +192,41 @@ def quality_gate(ds, cfg, state, engine):
         eng_f = InferenceEngine.from_dataset(ds, cfg_f, state)
         pred_f = predict_split_served(ds, cfg_f, state, "test",
                                       engine=eng_f)
-    tau = cfg.train.tau
-    q_d = float(quantile_loss(jnp.asarray(ys), jnp.asarray(pred_d), tau))
-    q_f = float(quantile_loss(jnp.asarray(ys), jnp.asarray(pred_f), tau))
-    delta = (q_d - q_f) / max(abs(q_f), 1e-12)
+    pred_f = np.asarray(pred_f)
+    taus = resolve_quantile_taus(cfg.model, cfg.train.tau)
+    if pred_d.ndim == 1:
+        pred_d, pred_f = pred_d[:, None], pred_f[:, None]
     budget = QLOSS_DELTA_BUDGET[dtype]
+    per_tau = []
+    for i, tau in enumerate(taus):
+        q_d = float(quantile_loss(jnp.asarray(ys),
+                                  jnp.asarray(pred_d[:, i]), tau))
+        q_f = float(quantile_loss(jnp.asarray(ys),
+                                  jnp.asarray(pred_f[:, i]), tau))
+        per_tau.append({"tau": float(tau), "qloss_f32": q_f,
+                        "qloss_served": q_d,
+                        "delta_rel": (q_d - q_f) / max(abs(q_f), 1e-12)})
+    worst = max(per_tau, key=lambda r: r["delta_rel"])
     fields = {
-        "qloss_f32": q_f,
-        "qloss_served": q_d,
-        "qloss_delta_rel": delta,
+        # the three legacy fields describe ONE measurement: the WORST
+        # column (single-tau mode: the only column) — a consumer
+        # recomputing the delta from the qloss pair must get
+        # qloss_delta_rel back; per-column detail rides qloss_per_tau
+        "qloss_f32": worst["qloss_f32"],
+        "qloss_served": worst["qloss_served"],
+        "qloss_delta_rel": worst["delta_rel"],
         "qloss_delta_budget": budget,
+        "qloss_worst_tau": worst["tau"],
+        "qloss_per_tau": per_tau,
         "qloss_rows": int(len(ys)),
     }
-    if delta > budget:
+    if worst["delta_rel"] > budget:
         raise AssertionError(
-            f"serve_dtype={dtype} quantile-loss delta {delta:.4%} exceeds "
+            f"serve_dtype={dtype} quantile-loss delta "
+            f"{worst['delta_rel']:.4%} at tau={worst['tau']:g} exceeds "
             f"the pre-registered budget {budget:.2%} "
-            f"(f32 {q_f:.6g} -> {dtype} {q_d:.6g})")
+            f"(f32 {worst['qloss_f32']:.6g} -> {dtype} "
+            f"{worst['qloss_served']:.6g})")
     return fields
 
 
@@ -216,7 +242,7 @@ def rung_attribution(engine, stats, throughput_rps):
     hot = max(range(len(engine.ladder)),
               key=lambda i: stats["buckets"][i]["dispatches"])
     f = b = None
-    exe = engine._exe.get(hot)
+    exe = engine._exe.get((hot, False))
     if exe is not None:
         per_dispatch = flops_util.executable_cost(exe)
         g = engine.ladder[hot].max_graphs

@@ -144,6 +144,7 @@ def pack_single(
     lookup: ResourceLookup,
     ys: np.ndarray | None = None,
     node_depth_in_x: bool = False,
+    mixture_of: "list[Mixture] | None" = None,
 ) -> PackedBatch:
     """Pack the given examples into exactly ONE budget-shaped batch.
 
@@ -157,14 +158,27 @@ def pack_single(
 
     `ys` defaults to zeros: a live request has no label; the y slots ride
     along only because the batch layout is shared with training.
+
+    `mixture_of` overrides the mixture packed for each example (aligned
+    with `entry_ids`; the entry_id slot keeps the REAL id for the entry
+    embedding) — the counterfactual serving path (pertgnn_tpu/lens/
+    whatif.py) packs an edited topology under the request's own entry.
     """
     entry_ids = np.asarray(entry_ids)
     if len(entry_ids) == 0:
         raise ValueError("pack_single needs at least one example")
     if ys is None:
         ys = np.zeros(len(entry_ids), dtype=np.float32)
-    n = sum(mixtures[int(e)].num_nodes for e in entry_ids)
-    e_tot = sum(mixtures[int(e)].num_edges for e in entry_ids)
+    if mixture_of is None:
+        mixes = [mixtures[int(e)] for e in entry_ids]
+    else:
+        mixes = list(mixture_of)
+        if len(mixes) != len(entry_ids):
+            raise ValueError(
+                f"mixture_of has {len(mixes)} entries for "
+                f"{len(entry_ids)} examples")
+    n = sum(m.num_nodes for m in mixes)
+    e_tot = sum(m.num_edges for m in mixes)
     if (len(entry_ids) > budget.max_graphs or n > budget.max_nodes
             or e_tot > budget.max_edges):
         raise ValueError(
@@ -174,7 +188,8 @@ def pack_single(
         batches = list(pack_examples(mixtures, entry_ids,
                                      np.asarray(ts_buckets), ys, budget,
                                      lookup,
-                                     node_depth_in_x=node_depth_in_x))
+                                     node_depth_in_x=node_depth_in_x,
+                                     mixture_of=mixes))
         # the fit pre-check above makes a second flush impossible
         (batch,) = batches
         return batch
@@ -188,11 +203,14 @@ def pack_examples(
     budget: BatchBudget,
     lookup: ResourceLookup,
     node_depth_in_x: bool = False,
+    mixture_of: "list[Mixture] | None" = None,
 ) -> Iterator[PackedBatch]:
     """Greedily pack examples (in the given order) into fixed-shape batches.
 
     Every example must fit a budget alone; an example larger than the budget
-    raises (size your budget with `derive_budget`).
+    raises (size your budget with `derive_budget`). `mixture_of` (aligned
+    per example) overrides the mixture looked up by entry id — the
+    counterfactual serving path packs edited topologies through it.
     """
     G = budget.max_graphs + 1  # +1: reserved pad graph slot
     n_feat = lookup.num_features + (1 if node_depth_in_x else 0)
@@ -235,8 +253,9 @@ def pack_examples(
         g = n = e = 0
         return batch
 
-    for entry, bucket, y in zip(entry_ids, ts_buckets, ys):
-        mix = mixtures[int(entry)]
+    for i, (entry, bucket, y) in enumerate(zip(entry_ids, ts_buckets, ys)):
+        mix = (mixture_of[i] if mixture_of is not None
+               else mixtures[int(entry)])
         if mix.num_nodes > budget.max_nodes or mix.num_edges > budget.max_edges:
             raise ValueError(
                 f"entry {entry} mixture ({mix.num_nodes} nodes, "

@@ -13,9 +13,9 @@ import os
 
 from pertgnn_tpu.config import (ATTENTION_IMPLS, SERVE_DTYPES,
                                 CompileCacheConfig, Config, DataConfig,
-                                FleetConfig, IngestConfig, ModelConfig,
-                                ParallelConfig, ServeConfig, StreamConfig,
-                                TelemetryConfig, TrainConfig)
+                                FleetConfig, IngestConfig, LensConfig,
+                                ModelConfig, ParallelConfig, ServeConfig,
+                                StreamConfig, TelemetryConfig, TrainConfig)
 
 
 def apply_platform_env() -> None:
@@ -215,6 +215,14 @@ def add_model_train_flags(p: argparse.ArgumentParser) -> None:
                         "checkpointed embedding and continual training "
                         "warm-restarts; 0 = exact sizing (reference "
                         "parity)")
+    p.add_argument("--quantile_taus", default="0.5",
+                   help="comma-separated quantile levels of the global "
+                        "head (e.g. 0.5,0.95,0.99 = p50/p95/p99 in one "
+                        "forward, non-crossing by construction; "
+                        "pertgnn_tpu/lens/). The default 0.5 is the "
+                        "legacy single-tau mode where --tau is the "
+                        "quantile level, byte-identical to pre-lens "
+                        "behavior")
     p.add_argument("--missing_indicator_is_zero", action="store_true",
                    help="preprocess-time indicator convention (1=present) "
                         "instead of the live get_x convention (1=missing)")
@@ -337,6 +345,47 @@ def add_serve_flags(p: argparse.ArgumentParser) -> None:
                         "int8 weights dequantized in-graph); quality "
                         "exit-code-gated by benchmarks/serve_bench.py "
                         "(docs/GUIDE.md)")
+
+
+def add_lens_flags(p: argparse.ArgumentParser) -> None:
+    """Distributional / what-if serving knobs (LensConfig,
+    pertgnn_tpu/lens/) — the serving CLIs' lens surface (serve_main,
+    fleet_main, predict_main)."""
+    p.add_argument("--lens_local", action="store_true",
+                   default=LensConfig.lens_local,
+                   help="warm + serve the attribution (local-pred-"
+                        "returning) rung programs next to the standard "
+                        "ladder; off = attribution requests are refused "
+                        "at submit (LensDisabled) so nothing compiles "
+                        "on the request path (docs/GUIDE.md §13)")
+    p.add_argument("--lens_top_k", type=int,
+                   default=LensConfig.lens_top_k,
+                   help="cap on per-request top-k attribution rows "
+                        "(larger requests are clamped, never refused)")
+
+
+def lens_config_from_args(args: argparse.Namespace) -> LensConfig:
+    """The ONE flags -> LensConfig mapping (same pattern as
+    telemetry_config_from_args); config_from_args embeds it so the
+    sidecar provenance and the live engine cannot drift."""
+    return LensConfig(
+        lens_local=getattr(args, "lens_local", LensConfig.lens_local),
+        lens_top_k=getattr(args, "lens_top_k", LensConfig.lens_top_k))
+
+
+def parse_quantile_taus(spec: str) -> tuple[float, ...]:
+    """--quantile_taus "0.5,0.95,0.99" -> (0.5, 0.95, 0.99). Validation
+    (ascending, in (0,1)) happens at the single resolution point
+    (config.resolve_quantile_taus), not here — a config file can carry
+    the same tuple without passing through this parser."""
+    try:
+        taus = tuple(float(t) for t in spec.split(",") if t.strip())
+    except ValueError:
+        raise SystemExit(f"--quantile_taus must be comma-separated "
+                         f"floats; got {spec!r}")
+    if not taus:
+        raise SystemExit("--quantile_taus must name at least one level")
+    return taus
 
 
 def add_fleet_flags(p: argparse.ArgumentParser) -> None:
@@ -691,6 +740,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             attention_impl=args.attention_impl,
             vocab_headroom_entries=getattr(args, "vocab_headroom_entries",
                                            0),
+            quantile_taus=parse_quantile_taus(
+                getattr(args, "quantile_taus", "0.5")),
             kernel_block_n=args.kernel_block_n,
             kernel_block_e=args.kernel_block_e,
             blocked_dense_max_cells=args.blocked_dense_max_cells,
@@ -738,6 +789,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                                 ServeConfig.serve_dtype)),
         fleet=fleet_config_from_args(args),
         stream=stream_config_from_args(args),
+        lens=lens_config_from_args(args),
         telemetry=telemetry_config_from_args(args),
         aot=aot_config_from_args(args),
         graph_type=args.graph_type,

@@ -51,7 +51,8 @@ import numpy as np
 
 from pertgnn_tpu.cli.common import (add_aot_flags, add_fleet_flags,
                                     add_ingest_flags,
-                                    add_model_train_flags, add_serve_flags,
+                                    add_lens_flags, add_model_train_flags,
+                                    add_serve_flags,
                                     add_telemetry_flags,
                                     apply_platform_env,
                                     build_dataset_cached, config_from_args,
@@ -97,6 +98,7 @@ def _parser() -> argparse.ArgumentParser:
     add_ingest_flags(p)
     add_model_train_flags(p)
     add_serve_flags(p)
+    add_lens_flags(p)
     add_fleet_flags(p)
     add_telemetry_flags(p)
     add_aot_flags(p)
@@ -486,6 +488,14 @@ def _run_launcher(args, p: argparse.ArgumentParser,
         failures: list[tuple[int, BaseException]] = []
         schedule = None
         if args.loadgen:
+            from pertgnn_tpu.config import resolve_quantile_taus as _rqt
+            if len(_rqt(cfg.model, cfg.train.tau)) > 1:
+                # the replay's per-request result slots are scalar;
+                # refuse loudly rather than truncate quantile vectors
+                raise SystemExit(
+                    "--loadgen does not support a multi-quantile head "
+                    "yet (scalar result slots); drop --quantile_taus "
+                    "or run without --loadgen")
             # open-loop: the request stream is the POPULATION the
             # arrival schedule draws from (Zipf popularity, SLO mix),
             # deterministic per --seed (fleet/loadgen.py)
@@ -506,7 +516,12 @@ def _run_launcher(args, p: argparse.ArgumentParser,
             out_buckets = schedule.ts_buckets
         else:
             out_entries, out_buckets = entries, buckets
-        preds = np.full(len(out_entries), np.nan, np.float32)
+        # multi-quantile heads (ModelConfig.quantile_taus, lens/) serve
+        # one column per level; single-tau stays a flat vector
+        from pertgnn_tpu.config import resolve_quantile_taus
+        taus = resolve_quantile_taus(cfg.model, cfg.train.tau)
+        preds = np.full((len(out_entries), len(taus)) if len(taus) > 1
+                        else len(out_entries), np.nan, np.float32)
         served = np.zeros(len(out_entries), np.bool_)
         out_errors: list = [None] * len(out_entries)
 
@@ -514,8 +529,10 @@ def _run_launcher(args, p: argparse.ArgumentParser,
             for i in indices:
                 t0 = time.perf_counter()
                 try:
-                    preds[i] = router.predict(int(entries[i]),
-                                              int(buckets[i]))
+                    # submit + result (not .predict): a multi-quantile
+                    # future resolves to a (T,) vector float() rejects
+                    preds[i] = router.submit(int(entries[i]),
+                                             int(buckets[i])).result()
                 except ServeError as exc:
                     with errors_lock:
                         request_errors[type(exc).__name__] += 1
@@ -594,8 +611,17 @@ def _run_launcher(args, p: argparse.ArgumentParser,
 
     import pandas as pd
 
-    frame = {"entry_id": out_entries, "ts_bucket": out_buckets,
-             "y_pred": preds}
+    frame = {"entry_id": out_entries, "ts_bucket": out_buckets}
+    if preds.ndim == 2:
+        # one labeled column per quantile level + the primary under the
+        # legacy y_pred name (same convention as serve_main/predict_main)
+        from pertgnn_tpu.config import primary_tau_index
+        for i, t in enumerate(taus):
+            frame[f"y_pred_q{t:g}"] = preds[:, i]
+        frame["y_pred"] = preds[:, primary_tau_index(taus,
+                                                     cfg.train.tau)]
+    else:
+        frame["y_pred"] = preds
     if schedule is not None:
         frame["slo"] = [schedule.slo_name(i)
                         for i in range(len(schedule))]

@@ -24,7 +24,8 @@ import pandas as pd
 
 from pertgnn_tpu.batching.dataset import split_indices
 from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
-                                    add_model_train_flags, add_serve_flags,
+                                    add_lens_flags, add_model_train_flags,
+                                    add_serve_flags,
                                     add_telemetry_flags, apply_platform_env,
                                     build_dataset_cached, config_from_args,
                                     load_or_ingest_artifacts,
@@ -100,6 +101,7 @@ def main(argv=None) -> None:
     add_ingest_flags(p)
     add_model_train_flags(p)
     add_serve_flags(p)
+    add_lens_flags(p)
     add_telemetry_flags(p)
     add_aot_flags(p)
     p.add_argument("--split", default="test",
@@ -174,7 +176,20 @@ def main(argv=None) -> None:
                 "split — build_dataset's meta slicing changed without "
                 "this CLI following")
         rows["split"] = split
-        rows["y_pred"] = np.asarray(pred, np.float32)
+        pred = np.asarray(pred, np.float32)
+        if pred.ndim == 2:
+            # multi-quantile head (ModelConfig.quantile_taus, lens/):
+            # y_pred carries the PRIMARY column, plus one labeled
+            # column per quantile level so the CSV keeps the vector
+            from pertgnn_tpu.config import (primary_tau_index,
+                                            resolve_quantile_taus)
+            taus = resolve_quantile_taus(cfg.model, cfg.train.tau)
+            pi = primary_tau_index(taus, cfg.train.tau)
+            for i, t in enumerate(taus):
+                rows[f"y_pred_q{t:g}"] = pred[:, i]
+            rows["y_pred"] = pred[:, pi]
+        else:
+            rows["y_pred"] = pred
         frames.append(rows.rename(columns={"y": "y_true"}))
     out = pd.concat(frames, ignore_index=True)
     out.to_csv(args.out, index=False)

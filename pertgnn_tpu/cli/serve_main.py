@@ -43,7 +43,8 @@ import time
 import numpy as np
 
 from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
-                                    add_model_train_flags, add_serve_flags,
+                                    add_lens_flags, add_model_train_flags,
+                                    add_serve_flags,
                                     add_telemetry_flags, apply_platform_env,
                                     build_dataset_cached, config_from_args,
                                     setup_compile_cache, setup_telemetry)
@@ -86,6 +87,7 @@ def main(argv=None) -> None:
     add_ingest_flags(p)
     add_model_train_flags(p)
     add_serve_flags(p)
+    add_lens_flags(p)
     add_telemetry_flags(p)
     add_aot_flags(p)
     p.add_argument("--requests", default="",
@@ -185,7 +187,12 @@ def main(argv=None) -> None:
     import threading
 
     client_latency = LatencyRecorder()
-    preds = np.full(len(entries), np.nan, np.float32)
+    # multi-quantile heads (ModelConfig.quantile_taus, lens/) serve one
+    # column per level; single-tau stays a flat vector
+    from pertgnn_tpu.config import resolve_quantile_taus
+    taus = resolve_quantile_taus(cfg.model, cfg.train.tau)
+    preds = np.full((len(entries), len(taus)) if len(taus) > 1
+                    else len(entries), np.nan, np.float32)
     served = np.zeros(len(entries), np.bool_)
     request_errors: collections.Counter = collections.Counter()
     errors_lock = threading.Lock()
@@ -198,7 +205,10 @@ def main(argv=None) -> None:
                 return
             t0 = time.perf_counter()
             try:
-                preds[i] = queue.predict(int(entries[i]), int(buckets[i]))
+                # submit + result (not .predict): a multi-quantile
+                # future resolves to a (T,) vector float() would reject
+                preds[i] = queue.submit(int(entries[i]),
+                                        int(buckets[i])).result()
             except QueueClosed:
                 return  # admission stopped: drain raced this submit
             except ServeError as exc:
@@ -274,8 +284,17 @@ def main(argv=None) -> None:
 
     import pandas as pd
 
-    pd.DataFrame({"entry_id": entries, "ts_bucket": buckets,
-                  "y_pred": preds}).to_csv(args.out, index=False)
+    rows = {"entry_id": entries, "ts_bucket": buckets}
+    if preds.ndim == 2:
+        # one labeled column per quantile level + the primary under the
+        # legacy y_pred name (same convention as predict_main)
+        from pertgnn_tpu.config import primary_tau_index
+        for i, t in enumerate(taus):
+            rows[f"y_pred_q{t:g}"] = preds[:, i]
+        rows["y_pred"] = preds[:, primary_tau_index(taus, cfg.train.tau)]
+    else:
+        rows["y_pred"] = preds
+    pd.DataFrame(rows).to_csv(args.out, index=False)
     stats = {
         "metric": "pert_serve_request_latency_ms",
         "unit": "ms",

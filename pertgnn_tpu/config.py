@@ -205,6 +205,23 @@ class ModelConfig:
     # — the remaining init difference, A/B'd for the span 20-epoch gap
     # (benchmarks/span_gap_r4.py).
     init_scheme: str = "torch"
+    # Multi-quantile global head (pertgnn_tpu/lens/ — distributional
+    # serving): one output column per quantile level, e.g.
+    # (0.5, 0.95, 0.99) predicts p50/p95/p99 latency in ONE forward.
+    # Non-crossing BY CONSTRUCTION: column 0 is the raw head output,
+    # every later column adds a softplus increment (cumulative-softplus
+    # parameterization in models/pert_model.py), so served quantile
+    # vectors are monotone for ANY parameter values — a property test,
+    # not a training outcome. The default (0.5,) is the LEGACY
+    # single-tau mode: the head keeps its exact pre-lens shape (Dense(1)
+    # — checkpoints and compiled programs byte-identical) and the
+    # training quantile stays TrainConfig.tau (the reference's --tau
+    # flag); resolve_quantile_taus is the ONE resolution point. With
+    # >= 2 taus the loss sums one pinball term per (tau, column) and
+    # metrics report the PRIMARY column (tau closest to train.tau).
+    # Changes model shapes, so it rides checkpoints and every AOT key
+    # via cfg.model.
+    quantile_taus: Sequence[float] = (0.5,)
 
 
 ATTENTION_IMPLS = ("segment", "pallas", "pallas_fused", "blocked_dense")
@@ -228,6 +245,44 @@ def resolve_attention_impl(model: "ModelConfig") -> str:
     if model.attention_impl != "segment":
         return model.attention_impl
     return "pallas" if model.use_pallas_attention else "segment"
+
+
+def resolve_quantile_taus(model: "ModelConfig",
+                          train_tau: float) -> tuple[float, ...]:
+    """The effective quantile levels of the global head — the ONE
+    resolution point (models, the train loop, serving, and the lens
+    benches all go through it, so legacy and multi-quantile configs
+    cannot mean different losses in different layers).
+
+    The default ``quantile_taus=(0.5,)`` is the LEGACY single-tau mode:
+    the quantile level is ``TrainConfig.tau`` (the reference's ``--tau``
+    flag), exactly as before the lens subsystem existed — byte-identical
+    programs for every pre-lens config, including non-default ``--tau``.
+    Any OTHER setting wins over train.tau and must be strictly ascending
+    in (0, 1)."""
+    taus = tuple(float(t) for t in model.quantile_taus)
+    if not taus:
+        raise ValueError("quantile_taus must name at least one level")
+    if taus == (0.5,):
+        return (float(train_tau),)
+    for t in taus:
+        if not 0.0 < t < 1.0:
+            raise ValueError(
+                f"quantile_taus entries must lie in (0, 1); got {t}")
+    if any(b <= a for a, b in zip(taus, taus[1:])):
+        raise ValueError(
+            f"quantile_taus must be strictly ascending (the non-crossing "
+            f"head assigns column i the i-th level); got {taus}")
+    return taus
+
+
+def primary_tau_index(taus: Sequence[float], train_tau: float) -> int:
+    """The column whose quantile level is closest to TrainConfig.tau —
+    what single-number metrics (mae/mape/qloss history rows, the serve
+    quality gates) report in multi-quantile mode, and the level the
+    auxiliary local-head loss trains at (attribution ranks the local
+    head, so it should be trained at the quantile callers ask about)."""
+    return min(range(len(taus)), key=lambda i: abs(taus[i] - train_tau))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -501,6 +556,34 @@ class StreamConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class LensConfig:
+    """Distributional / explainable what-if serving knobs
+    (pertgnn_tpu/lens/ — docs/GUIDE.md §13).
+
+    Three request variants ride the EXISTING pack/dispatch/hedge/trace
+    machinery (serve/queue.py ``submit(lens=...)``, fleet/router.py,
+    the transport body — fields omitted when default, like SLO classes):
+    multi-quantile predictions (``ModelConfig.quantile_taus``),
+    root-cause attribution (top-k per-node local predictions mapped
+    back to (ms, interface) calls — lens/attribute.py), and
+    counterfactual topology queries (pure call-graph edits re-packed
+    through the existing bucket ladder — lens/whatif.py, zero fresh
+    compiles by construction since rungs key on shape)."""
+
+    # Warm + serve the local-pred-returning (attribution) rung programs
+    # next to the standard ladder. Off (default) = attribution requests
+    # are refused at submit with the typed LensDisabled — the engine
+    # NEVER compiles a program variant on the request path. The local
+    # variant is a distinct compiled program per rung (pad node rows
+    # masked to -inf in-graph so top-k can never rank them — verified
+    # by graftaudit's padding-taint pass on the traced programs).
+    lens_local: bool = False
+    # Cap on per-request top-k attribution rows (a request asking for
+    # more is clamped, never refused — k is a presentation knob).
+    lens_top_k: int = 8
+
+
+@dataclasses.dataclass(frozen=True)
 class CompileCacheConfig:
     """Cold-start elimination knobs (pertgnn_tpu/aot/).
 
@@ -598,6 +681,7 @@ class Config:
     serve: ServeConfig = ServeConfig()
     fleet: FleetConfig = FleetConfig()
     stream: StreamConfig = StreamConfig()
+    lens: LensConfig = LensConfig()
     telemetry: TelemetryConfig = TelemetryConfig()
     aot: CompileCacheConfig = CompileCacheConfig()
     # span | pert (reference: pert_gnn.py:32).

@@ -243,14 +243,23 @@ def replay(submit, schedule: Schedule, *, bus=None,
 
     def on_done(i: int, t_submit: float, fut) -> None:
         t_now = time.perf_counter()
-        exc = fut.exception()
-        if exc is None:
-            preds[i] = fut.result()
-            latency_ms[i] = (t_now - t_submit) * 1e3
-        else:
-            errors[i] = type(exc).__name__
-        with count_lock:
-            outstanding[0] -= 1
+        try:
+            exc = fut.exception()
+            if exc is None:
+                # scalar slots: loadgen drives PLAIN traffic (no lens
+                # variants; fleet_main refuses --loadgen with a
+                # multi-quantile head rather than truncate vectors)
+                preds[i] = fut.result()
+                latency_ms[i] = (t_now - t_submit) * 1e3
+            else:
+                errors[i] = type(exc).__name__
+        except BaseException:  # lint: allow-silent-except — recorded as an outcome
+            errors[i] = "ResultStorageError"
+        finally:
+            # the drain wait counts on EVERY callback decrementing —
+            # a storage surprise must not hang the replay
+            with count_lock:
+                outstanding[0] -= 1
 
     t0 = time.perf_counter()
     next_second = 1.0

@@ -106,7 +106,7 @@ from pertgnn_tpu.testing import schedules
 from pertgnn_tpu.telemetry.tracing import new_span_id
 from pertgnn_tpu.fleet.transport import (WorkerTransportError,
                                          error_from_row, get_probe,
-                                         post_predict)
+                                         post_predict, result_from_row)
 from pertgnn_tpu.serve.errors import (DeadlineExceeded, QueueClosed,
                                       Shed)
 
@@ -134,6 +134,13 @@ class _Request:
     # brownout verdict, stamped at dispatch: the worker serves this
     # request through its cheapest ladder rung (fleet/shield.py)
     downgrade: bool = False
+    # lens request variants (pertgnn_tpu/lens/): the WIRE form
+    # (LensRequest.to_wire dict, None for a plain request). The router
+    # forwards it opaquely — validation and edit application happen at
+    # the worker's own admission (the router holds no mixtures), so a
+    # refused edit comes back as a typed per-request row, and BOTH legs
+    # of a hedged dispatch carry the identical variant by construction.
+    lens: dict | None = None
     requeues: int = 0
     # workers this request already FAILED on (transport loss): the
     # retry excludes them so a flapping worker cannot eat the same
@@ -297,13 +304,25 @@ class FleetRouter:
         return telemetry.get_bus()
 
     def submit(self, entry_id: int, ts_bucket: int,
-               slo: str | None = None) -> Future:
+               slo: str | None = None, lens=None) -> Future:
         """Enqueue one request; the Future resolves to its prediction
         or a typed serve error. Raises QueueClosed / Shed /
         DeadlineExceeded (door shed) at admission. ``slo`` is the
         request's SLO class (fleet/shield.py; default "standard") — at
-        a full pending set admission sheds lowest-class-first."""
+        a full pending set admission sheds lowest-class-first.
+
+        ``lens`` (a pertgnn_tpu/lens LensRequest, or None) rides the
+        transport body to the worker, whose own admission validates it
+        — a refused what-if edit or a cold attribution ladder comes
+        back as the same typed error a single-process caller would see
+        (WhatIfRefused / LensDisabled, not retryable). Lens futures
+        resolve to a LensResult / (T,)-vector exactly like the queue's
+        (transport.result_from_row)."""
         eid = int(entry_id)
+        lens_wire = None
+        if lens is not None:
+            lens_wire = (lens.to_wire() if hasattr(lens, "to_wire")
+                         else dict(lens))
         slo_cls = shield.DEFAULT_CLASS if slo is None else slo
         shield.class_priority(slo_cls)  # unknown class fails the caller
         # size it NOW so an unknown entry fails the caller, not the
@@ -350,7 +369,8 @@ class FleetRouter:
                     self.shed += 1
                     self.shed_by_class[evicted.slo] += 1
                     self._admit_locked(eid, ts_bucket, fut, ctx,
-                                       tm_submit, slo_cls)
+                                       tm_submit, slo_cls,
+                                       lens=lens_wire)
             else:
                 now = time.perf_counter()
                 deadline = (now + self._deadline_s
@@ -367,7 +387,8 @@ class FleetRouter:
                 else:
                     self._admit_locked(eid, ts_bucket, fut, ctx,
                                        tm_submit, slo_cls,
-                                       deadline=deadline, now=now)
+                                       deadline=deadline, now=now,
+                                       lens=lens_wire)
         if evicted is not None:
             self.bus.counter("router.shed", entry_id=evicted.entry_id)
             self.bus.counter("router.shed_by_class", slo=evicted.slo,
@@ -392,7 +413,8 @@ class FleetRouter:
     def _admit_locked(self, eid: int, ts_bucket: int, fut: Future, ctx,
                       tm_submit: float, slo_cls: str,
                       deadline: float | None = None,
-                      now: float | None = None) -> None:
+                      now: float | None = None,
+                      lens: dict | None = None) -> None:
         if now is None:
             now = time.perf_counter()
         if deadline is None:
@@ -401,7 +423,8 @@ class FleetRouter:
         self._pending.append(_Request(
             seq=self._seq, entry_id=eid, ts_bucket=int(ts_bucket),
             arrival=now, deadline_abs=deadline, future=fut, slo=slo_cls,
-            trace=ctx, tm_submit=tm_submit, tm_queue_start=tm_submit))
+            lens=lens, trace=ctx, tm_submit=tm_submit,
+            tm_queue_start=tm_submit))
         self._seq += 1
         self._wake.notify_all()
 
@@ -905,13 +928,24 @@ class FleetRouter:
             slo_meta = [r.slo if r.slo != shield.DEFAULT_CLASS else None
                         for r in batch]
             dg_meta = [r.downgrade for r in batch]
+            # lens variants ride every leg identically (the hedge leg
+            # rebuilds this list from the same _Request objects), so a
+            # hedged what-if/attribution answer is bit-identical to the
+            # primary's regardless of which leg wins. The kwarg itself
+            # follows the omit-when-default rule one level up too: an
+            # all-plain batch never passes it, so pre-lens injected
+            # transports (tests) keep working unchanged.
+            lens_meta = [r.lens for r in batch]
+            lens_kw = ({"lens": lens_meta}
+                       if any(ln is not None for ln in lens_meta) else {})
             t0 = time.perf_counter()
             tm0 = time.monotonic()
             try:
                 rows = self._post(
                     w.base_url, [r.entry_id for r in batch],
                     [r.ts_bucket for r in batch], self._timeout_s,
-                    trace=trace_meta, slo=slo_meta, dg=dg_meta)
+                    trace=trace_meta, slo=slo_meta, dg=dg_meta,
+                    **lens_kw)
             except WorkerTransportError as exc:
                 self._on_leg_failed(w, flight, role, exc, tm0, sids)
                 continue
@@ -1006,7 +1040,7 @@ class FleetRouter:
                 n_served += 1
                 self.bus.histogram("router.request_total_ms",
                                    (t_done - r.arrival) * 1e3, level=2)
-                r.future.set_result(float(row["pred"]))
+                r.future.set_result(result_from_row(row))
                 if r.trace is not None:
                     tm_settle = time.monotonic()
                     self.bus.trace_span("trace.complete", r.trace, tm1,

@@ -47,11 +47,41 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from pertgnn_tpu.lens.request import LensRequest, LensResult
 from pertgnn_tpu.serve import errors as serve_errors
 from pertgnn_tpu.serve.health import probe_payload
 from pertgnn_tpu.testing import faults
 
 log = logging.getLogger(__name__)
+
+
+def pred_to_wire(pred):
+    """A prediction as it rides a result row: a float (single-tau) or a
+    list of floats (a multi-quantile vector). JSON float round-trips
+    are exact in Python, so the fleet's bit-identity contract survives
+    the wire for vectors exactly as it always has for scalars."""
+    import numpy as np
+
+    if np.ndim(pred) == 0:
+        return float(pred)
+    return [float(x) for x in np.asarray(pred)]
+
+
+def result_from_row(row: dict):
+    """Rehydrate one OK result row into what a single-process caller's
+    Future would have resolved to: a float, a (T,) float32 vector, or a
+    LensResult carrying attribution rows — the fleet front door's
+    contract matches the queue's by construction."""
+    import numpy as np
+
+    pred = row["pred"]
+    val = (np.asarray(pred, np.float32) if isinstance(pred, list)
+           else float(pred))
+    if "attr" in row:
+        return LensResult(pred=val,
+                          attribution=tuple(dict(r)
+                                            for r in row["attr"]))
+    return val
 
 
 class WorkerTransportError(RuntimeError):
@@ -98,7 +128,8 @@ class WorkerServer:
                                              req["ts_buckets"],
                                              req.get("trace"),
                                              req.get("slo"),
-                                             req.get("dg"))
+                                             req.get("dg"),
+                                             req.get("lens"))
                 except faults.InjectedFault as exc:
                     # the armed chaos plan asked for a transport-level
                     # failure: the router must see this worker as lost
@@ -138,7 +169,8 @@ class WorkerServer:
 
     def _predict(self, entries, ts_buckets, trace: list | None = None,
                  slo: list | None = None,
-                 dg: list | None = None) -> list[dict]:
+                 dg: list | None = None,
+                 lens: list | None = None) -> list[dict]:
         """Submit one router microbatch to the local queue and wait —
         per-request rows in request order, every row present (a
         submitted Future ALWAYS resolves; a rejected submit IS the
@@ -148,7 +180,9 @@ class WorkerServer:
         span (``psid``), so graftscope can join the two processes'
         JSONL files into one request tree. ``slo``/``dg`` are the
         per-request SLO class names and brownout-downgrade flags
-        (fleet/shield.py) — omitted entirely for all-default traffic."""
+        (fleet/shield.py), and ``lens`` the per-request lens variant
+        dicts (pertgnn_tpu/lens/: attribution k + what-if edits) —
+        all omitted entirely for all-default traffic."""
         plan = faults.active()
         if plan is not None:
             verdict = plan.fire("fleet.worker", entry_ids=entries)
@@ -163,14 +197,17 @@ class WorkerServer:
             slo = [None] * len(entries)
         if dg is None or len(dg) != len(entries):
             dg = [False] * len(entries)
+        if lens is None or len(lens) != len(entries):
+            lens = [None] * len(entries)
         futures = []
-        for eid, tsb, t, s, d in zip(entries, ts_buckets, trace, slo, dg):
+        for eid, tsb, t, s, d, ln in zip(entries, ts_buckets, trace,
+                                         slo, dg, lens):
             ctx = (self._engine.bus.adopt_trace(t["tid"], t["psid"])
                    if isinstance(t, dict) else None)
             try:
-                futures.append(self._queue.submit(int(eid), int(tsb),
-                                                  trace=ctx, slo=s,
-                                                  downgrade=bool(d)))
+                futures.append(self._queue.submit(
+                    int(eid), int(tsb), trace=ctx, slo=s,
+                    downgrade=bool(d), lens=LensRequest.from_wire(ln)))
             except serve_errors.ServeError as exc:
                 futures.append(exc)  # admission outcome, row below
         rows: list[dict] = []
@@ -180,7 +217,12 @@ class WorkerServer:
                              "message": str(fut)})
                 continue
             try:
-                rows.append({"pred": float(fut.result())})
+                res = fut.result()
+                if isinstance(res, LensResult):
+                    rows.append({"pred": pred_to_wire(res.pred),
+                                 "attr": list(res.attribution)})
+                else:
+                    rows.append({"pred": pred_to_wire(res)})
             except Exception as exc:  # lint: allow-silent-except — the row IS the record; the router rehydrates it
                 rows.append({"error": type(exc).__name__,
                              "message": str(exc)})
@@ -196,14 +238,16 @@ class WorkerServer:
 def post_predict(base_url: str, entries, ts_buckets,
                  timeout_s: float, trace: list | None = None,
                  slo: list | None = None,
-                 dg: list | None = None) -> list[dict]:
+                 dg: list | None = None,
+                 lens: list | None = None) -> list[dict]:
     """One microbatch dispatch; returns per-request rows. Raises
     WorkerTransportError on ANY transport-level failure (the lost-worker
     signature). ``trace`` propagates per-request trace contexts (one
     ``{"tid", "psid"}`` or None per request); omitted entirely when no
     request in the batch is head-sampled, so untraced traffic pays zero
-    wire bytes. ``slo`` (per-request class names) and ``dg`` (brownout
-    downgrade flags) follow the same omit-when-default rule."""
+    wire bytes. ``slo`` (per-request class names), ``dg`` (brownout
+    downgrade flags), and ``lens`` (per-request lens variant dicts —
+    LensRequest.to_wire) follow the same omit-when-default rule."""
     payload = {"entries": [int(e) for e in entries],
                "ts_buckets": [int(t) for t in ts_buckets]}
     if trace is not None and any(t is not None for t in trace):
@@ -212,6 +256,8 @@ def post_predict(base_url: str, entries, ts_buckets,
         payload["slo"] = slo
     if dg is not None and any(dg):
         payload["dg"] = [bool(d) for d in dg]
+    if lens is not None and any(ln is not None for ln in lens):
+        payload["lens"] = lens
     body = json.dumps(payload).encode()
     req = urllib.request.Request(
         f"{base_url}/predict", data=body, method="POST",

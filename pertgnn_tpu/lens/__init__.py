@@ -1,0 +1,39 @@
+"""graftlens: distributional, explainable what-if serving.
+
+The model has always computed more than the single scalar serving
+exposed: it regresses under a pinball loss (a QUANTILE, not a mean) and
+produces a per-node ``local_pred`` next to the trace-level prediction
+(models/pert_model.py — the reference computes it and throws it away,
+pert_gnn.py:245). This package opens those capabilities as three new
+REQUEST VARIANTS through the existing pack/dispatch/hedge/trace
+machinery, so the fault invariants (PR 4), trace chains (PR 12), and
+graftaudit proofs (PR 10) extend to them mechanically:
+
+- **multi-quantile predictions** — ``ModelConfig.quantile_taus``
+  widens the global head to one column per level under a
+  cumulative-softplus NON-CROSSING parameterization (monotone by
+  construction); served vectors are exit-code-gated on empirical
+  calibration (benchmarks/lens_bench.py, lens/calibrate.py);
+- **root-cause attribution** — a request flag (``LensRequest.
+  attribute_k``) routes the already-computed local head out of the
+  step program (pad rows pinned to -inf in-graph so top-k can never
+  rank them — graftaudit's padding-taint verifies the pin) and
+  lens/attribute.py maps the top-k node predictions back through the
+  arena's vocabulary to (ms, interface) calls;
+- **counterfactual topology queries** — ``LensRequest.edits`` applies
+  pure drop/substitute edits over the Mixture arena representation
+  (lens/whatif.py) and re-packs through the existing bucket ladder:
+  zero fresh compiles by construction, since rungs key on shape.
+
+Request fields ride ``MicrobatchQueue.submit(lens=...)``,
+``FleetRouter.submit(lens=...)``, and the fleet transport body (omitted
+when default, like PR 13's SLO classes). docs/GUIDE.md §13 documents
+the request types, the calibration gate, and the counterfactual
+semantics including every refusal case.
+"""
+
+from pertgnn_tpu.lens.attribute import name_rows, top_k_rows  # noqa: F401
+from pertgnn_tpu.lens.calibrate import (coverage_per_tau,  # noqa: F401
+                                        monotone_violations)
+from pertgnn_tpu.lens.request import LensRequest, LensResult  # noqa: F401
+from pertgnn_tpu.lens.whatif import apply_whatif  # noqa: F401

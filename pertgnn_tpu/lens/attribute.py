@@ -1,0 +1,87 @@
+"""Root-cause attribution: per-node local predictions -> named calls.
+
+The model's local head scores every node (service stage) of a request's
+mixture with its own latency prediction — trained against the trace
+label when ``ModelConfig.local_loss_weight`` > 0 (the reference never
+trains it, so attribution from a zero-weight head is noise —
+docs/GUIDE.md §13). Serving routes that vector out of the step program
+with pad rows pinned to -inf IN-GRAPH (serve/engine.py ``step_local``;
+graftaudit's padding-taint pass proves the pin on the traced program),
+so by the time this module ranks nodes a padded row is unrankable by
+construction: every value it sees belongs to a real node.
+
+``top_k_rows`` ranks one request's real-node local predictions and maps
+each winner back through the arena representation to the call it names:
+the node's microservice id and the interface of its INCOMING edge (the
+call that produced this stage; roots have none). ``name_rows``
+translates the ids to strings through the preprocess vocabularies
+(ingest/preprocess.PreprocessResult ``ms_vocab`` /
+``interface_vocab``) when the caller has them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pertgnn_tpu.batching.mixture import Mixture
+
+
+def top_k_rows(local_vals: np.ndarray, mixture: Mixture, k: int,
+               ms_names=None, iface_names=None) -> list[dict]:
+    """Top-k attribution rows for ONE request, descending local-pred
+    order (ties broken by node index for determinism — a hedged
+    re-dispatch must produce the identical row list).
+
+    ``local_vals`` is the request's real-node slice of the engine's
+    local output, aligned with the mixture's node order (pack_single
+    lays a request's nodes out contiguously in mixture order). Rows are
+    JSON-able: node / ms_id / iface (None for a root) / local; id->name
+    translation is ``name_rows``' job (the ONE naming point), applied
+    here when the vocabularies are provided."""
+    local_vals = np.asarray(local_vals, np.float32)
+    if len(local_vals) != mixture.num_nodes:
+        raise ValueError(
+            f"attribution got {len(local_vals)} local values for a "
+            f"{mixture.num_nodes}-node mixture — the pad mask leaked")
+    if not np.isfinite(local_vals).all():
+        # -inf is the PAD pin; a real node carrying it means the mask
+        # slipped — refuse rather than silently rank garbage
+        raise ValueError(
+            "attribution saw non-finite local predictions on real "
+            "nodes — the pad pin leaked into real lanes")
+    k = max(0, min(int(k), mixture.num_nodes))
+    # stable argsort on (-value, index): deterministic under ties
+    order = np.lexsort((np.arange(len(local_vals)), -local_vals))[:k]
+    rows: list[dict] = []
+    recv = mixture.receivers
+    for node in order.tolist():
+        incoming = np.nonzero(recv == node)[0]
+        iface = (int(mixture.edge_iface[incoming[0]])
+                 if len(incoming) else None)
+        rows.append({"node": int(node),
+                     "ms_id": int(mixture.ms_id[node]), "iface": iface,
+                     "local": float(local_vals[node])})
+    return name_rows(rows, ms_names, iface_names)
+
+
+def name_rows(rows: list[dict], ms_vocab=None,
+              iface_vocab=None) -> list[dict]:
+    """Translate id-based attribution rows to named calls through the
+    preprocess vocabularies (code -> original string) — THE one naming
+    point: ``top_k_rows`` routes through it, and callers holding a
+    PreprocessResult can apply it to rows that crossed the fleet wire
+    id-only. None vocabularies pass rows through unchanged."""
+    if ms_vocab is None and iface_vocab is None:
+        return [dict(r) for r in rows]
+    out = []
+    for r in rows:
+        r = dict(r)
+        if (ms_vocab is not None
+                and 0 <= r.get("ms_id", -1) < len(ms_vocab)):
+            r["ms"] = str(ms_vocab[r["ms_id"]])
+        iface = r.get("iface")
+        if (iface_vocab is not None and iface is not None
+                and 0 <= iface < len(iface_vocab)):
+            r["interface"] = str(iface_vocab[iface])
+        out.append(r)
+    return out

@@ -1,0 +1,56 @@
+"""Calibration math for multi-quantile serving — tiny and testable.
+
+A quantile head is only worth serving if its columns MEAN what their
+levels claim: the tau-column's prediction should exceed the true label
+about tau of the time. ``coverage_per_tau`` measures exactly that
+(empirical coverage over a labeled split), and
+``benchmarks/lens_bench.py`` exit-code-gates it against a
+pre-registered budget so a head whose calibration drifts turns the
+bench red instead of shipping quietly. ``monotone_violations`` is the
+serving-side check of the non-crossing guarantee (which holds by
+construction — models/pert_model.py cumulative-softplus head — but the
+bench asserts it on every SERVED vector, proving the property survived
+packing, quantization tiers, and the transport round-trip).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def coverage_per_tau(y_true: np.ndarray,
+                     preds: np.ndarray) -> np.ndarray:
+    """Empirical coverage of each quantile column: fraction of rows
+    whose predicted quantile is >= the true label. A calibrated
+    tau-column covers ~tau. ``preds`` is (rows, T); returns (T,)."""
+    y = np.asarray(y_true, np.float64)
+    p = np.asarray(preds, np.float64)
+    if p.ndim == 1:
+        p = p[:, None]
+    if len(y) != len(p):
+        raise ValueError(f"{len(y)} labels vs {len(p)} prediction rows")
+    if len(y) == 0:
+        raise ValueError("coverage needs at least one labeled row")
+    return (p >= y[:, None]).mean(axis=0)
+
+
+def calibration_errors(y_true: np.ndarray, preds: np.ndarray,
+                       taus) -> np.ndarray:
+    """|coverage - tau| per column — what the lens_bench gate compares
+    against its pre-registered budget."""
+    taus = np.asarray(list(taus), np.float64)
+    cov = coverage_per_tau(y_true, preds)
+    if len(cov) != len(taus):
+        raise ValueError(f"{len(cov)} prediction columns vs "
+                         f"{len(taus)} taus")
+    return np.abs(cov - taus)
+
+
+def monotone_violations(preds: np.ndarray, atol: float = 0.0) -> int:
+    """Rows whose quantile vector DECREASES anywhere along the tau axis
+    (beyond ``atol``). 0 for every vector the non-crossing head can
+    produce; the bench asserts 0 on every served prediction."""
+    p = np.asarray(preds, np.float64)
+    if p.ndim == 1 or p.shape[1] < 2:
+        return 0
+    return int((np.diff(p, axis=1) < -atol).any(axis=1).sum())

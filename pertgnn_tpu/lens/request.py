@@ -1,0 +1,75 @@
+"""The lens request/result vocabulary riding the serving request path.
+
+``LensRequest`` is the per-request variant spec a caller attaches to
+``submit(lens=...)`` at either front door (serve/queue.MicrobatchQueue,
+fleet/router.FleetRouter); ``LensResult`` is what the Future resolves to
+when the request asked for more than a scalar. Both have wire codecs
+because the fleet transport carries them as JSON next to the SLO/trace
+fields (fleet/transport.py) — ``to_wire`` returns None for an
+all-default request so plain traffic pays zero extra wire bytes, the
+same omit-when-default rule the slo/dg/trace fields follow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class LensRequest:
+    """One request's lens variant flags (all-default = a plain request).
+
+    ``attribute_k`` > 0 asks for root-cause attribution: the top-k
+    per-node local predictions of this request's mixture, mapped to
+    (ms, interface) calls (lens/attribute.py); requires the engine's
+    local-pred rung programs (``LensConfig.lens_local``), else the
+    submit is refused with the typed LensDisabled. ``edits`` is a tuple
+    of counterfactual edit ops applied to the request's call graph
+    before packing (lens/whatif.py documents the op vocabulary and the
+    refusal cases)."""
+
+    attribute_k: int = 0
+    edits: tuple = ()
+
+    @property
+    def wants_local(self) -> bool:
+        return self.attribute_k > 0
+
+    @property
+    def is_default(self) -> bool:
+        return self.attribute_k <= 0 and not self.edits
+
+    def to_wire(self) -> dict | None:
+        """JSON-able transport form; None when default (omitted from the
+        POST body entirely)."""
+        if self.is_default:
+            return None
+        out: dict = {}
+        if self.attribute_k > 0:
+            out["k"] = int(self.attribute_k)
+        if self.edits:
+            out["edits"] = [dict(e) for e in self.edits]
+        return out
+
+    @classmethod
+    def from_wire(cls, d: dict | None) -> "LensRequest | None":
+        if not isinstance(d, dict):
+            return None
+        return cls(attribute_k=int(d.get("k", 0)),
+                   edits=tuple(dict(e) for e in d.get("edits", ())))
+
+
+@dataclasses.dataclass(frozen=True)
+class LensResult:
+    """What a lens request's Future resolves to.
+
+    ``pred`` keeps the plain-request contract (a float in single-tau
+    mode, a (T,)-float32 vector under a multi-quantile head — monotone
+    by construction). ``attribution`` is a tuple of JSON-able row dicts
+    in descending local-pred order (lens/attribute.py: node / ms_id /
+    iface / local, plus ms / interface names when the engine was built
+    with the arena vocabularies); empty when the request did not ask
+    for attribution."""
+
+    pred: object
+    attribution: tuple = ()

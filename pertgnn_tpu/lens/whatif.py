@@ -1,0 +1,229 @@
+"""Counterfactual topology edits: pure functions over the Mixture arena.
+
+The serving engine answers "what latency does the model predict for
+THIS entry" — a what-if query asks "...and what if this call were not
+there / went through a different service?" Because a Mixture is just
+flat numpy arrays (batching/mixture.py), a counterfactual is a PURE
+edit of those arrays: no ingest, no graph reconstruction, no state.
+The edited mixture re-packs through the existing bucket ladder
+(serve/engine.pack_microbatch with a per-request mixture override), and
+since ladder rungs key on SHAPE — and edits never grow the graph — a
+counterfactual dispatch can never trigger a compile: zero fresh
+compiles by construction (benchmarks/lens_bench.py exit-code-asserts
+exactly that).
+
+Edit-op vocabulary (JSON-able dicts, applied in order):
+
+- ``{"op": "drop_edge", "edge": i}``     — remove edge i (a call);
+- ``{"op": "drop_node", "node": i}``     — remove node i (a service
+  stage) and every incident edge; the node's pattern shrinks by one;
+- ``{"op": "sub_node", "node": i, "ms_id": m}`` — the node's stage runs
+  on microservice ``m`` instead (same topology);
+- ``{"op": "sub_edge", "edge": i, "iface": f[, "rpctype": r]}`` — the
+  call goes through interface ``f`` (and optionally rpctype ``r``).
+
+Semantics (the parts a pure edit must PIN, and the from-scratch oracle
+in tests/test_lens.py verifies): node/edge index spaces are the
+mixture's own (block-diagonal over its runtime patterns, recoverable
+from ``pattern_size`` — each block's length IS its nodes' size value);
+``pattern_prob`` is untouched (the mixture weighting is observed
+traffic, not topology); ``pattern_size`` follows the edited node count
+so pooling weights match a from-scratch build of the edited graph;
+``feature_mask`` is recomputed per pattern block with the reference's
+last-stage-copy rule (build_mixtures._last_occurrence_mask — a
+substitution can move which copy is "last"); ``node_depth`` keeps the
+OBSERVED values (depth is a feature of the measured topology; the
+counterfactual does not re-derive features the real system never
+emitted for it).
+
+Everything the algebra cannot honor is REFUSED with the typed
+``WhatIfRefused`` (serve/errors.py) at submit — out-of-range indices,
+substitute ids outside the embedding vocabularies, dropping a
+pattern's last node (its pooling weight would divide by zero), or an
+oversized edit list. Never an approximate edit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from pertgnn_tpu.batching.mixture import Mixture, _last_occurrence_mask
+from pertgnn_tpu.serve.errors import WhatIfRefused
+
+# Backstop against degenerate requests hauling unbounded edit scripts
+# through the admission path; real what-if queries edit a handful of
+# calls.
+MAX_EDITS = 64
+
+EDIT_OPS = ("drop_edge", "drop_node", "sub_node", "sub_edge")
+
+
+def pattern_blocks(mixture: Mixture) -> list[tuple[int, int]]:
+    """[start, end) node ranges of the mixture's runtime-pattern blocks,
+    recovered from ``pattern_size`` — build_mixtures lays patterns out
+    contiguously and stamps each node with its pattern's node count, so
+    the size at a block's first node IS the block length."""
+    blocks: list[tuple[int, int]] = []
+    i, n = 0, mixture.num_nodes
+    while i < n:
+        size = int(mixture.pattern_size[i])
+        if size <= 0 or i + size > n:
+            raise WhatIfRefused(
+                f"mixture pattern layout is inconsistent at node {i} "
+                f"(size {size} of {n}) — cannot edit it safely")
+        blocks.append((i, i + size))
+        i += size
+    return blocks
+
+
+def _check_index(kind: str, idx, limit: int) -> int:
+    try:
+        i = int(idx)
+    except (TypeError, ValueError):
+        raise WhatIfRefused(f"{kind} index must be an int, got {idx!r}")
+    if not 0 <= i < limit:
+        raise WhatIfRefused(
+            f"{kind} index {i} out of range [0, {limit})")
+    return i
+
+
+def _check_vocab(kind: str, value, limit: int | None) -> int:
+    try:
+        v = int(value)
+    except (TypeError, ValueError):
+        raise WhatIfRefused(f"{kind} must be an int, got {value!r}")
+    if v < 0 or (limit is not None and v >= limit):
+        raise WhatIfRefused(
+            f"{kind} {v} outside the embedding vocabulary "
+            f"[0, {limit if limit is not None else 'unknown'}) — a "
+            f"counterfactual cannot invent services the model never "
+            f"embedded")
+    return v
+
+
+def _recompute_feature_mask(mix: dict, blocks: list[tuple[int, int]],
+                            feature_all_stage_copies: bool) -> np.ndarray:
+    if feature_all_stage_copies:
+        return np.ones(len(mix["ms_id"]), dtype=bool)
+    parts = [_last_occurrence_mask(mix["ms_id"][a:b]) for a, b in blocks]
+    return (np.concatenate(parts) if parts
+            else np.zeros(0, dtype=bool))
+
+
+def apply_whatif(mixture: Mixture, edits, *,
+                 num_ms: int | None = None,
+                 num_interfaces: int | None = None,
+                 num_rpctypes: int | None = None,
+                 feature_all_stage_copies: bool = False) -> Mixture:
+    """The edited Mixture — a pure function of (mixture, edits); the
+    input is never mutated. Raises ``WhatIfRefused`` for anything the
+    edit algebra cannot honor (module docstring lists the cases). The
+    vocabulary bounds are optional (None skips that check) so the
+    function stays usable on bare mixtures in tests; the serving path
+    always passes the dataset's sizes."""
+    edits = list(edits)
+    if len(edits) > MAX_EDITS:
+        raise WhatIfRefused(
+            f"{len(edits)} edits exceed the {MAX_EDITS}-op cap")
+    arr = {
+        "senders": mixture.senders.copy(),
+        "receivers": mixture.receivers.copy(),
+        "edge_iface": mixture.edge_iface.copy(),
+        "edge_rpctype": mixture.edge_rpctype.copy(),
+        "edge_duration": mixture.edge_duration.copy(),
+        "ms_id": mixture.ms_id.copy(),
+        "node_depth": mixture.node_depth.copy(),
+        "pattern_prob": mixture.pattern_prob.copy(),
+        "pattern_size": mixture.pattern_size.copy(),
+    }
+    for e in edits:
+        if not isinstance(e, dict):
+            raise WhatIfRefused(f"edit must be a dict, got {type(e)}")
+        op = e.get("op")
+        if op == "drop_edge":
+            i = _check_index("edge", e.get("edge"), len(arr["senders"]))
+            for f in ("senders", "receivers", "edge_iface",
+                      "edge_rpctype", "edge_duration"):
+                arr[f] = np.delete(arr[f], i)
+        elif op == "sub_edge":
+            i = _check_index("edge", e.get("edge"), len(arr["senders"]))
+            if "iface" in e:
+                arr["edge_iface"][i] = _check_vocab(
+                    "iface", e["iface"], num_interfaces)
+            if "rpctype" in e:
+                arr["edge_rpctype"][i] = _check_vocab(
+                    "rpctype", e["rpctype"], num_rpctypes)
+            if "iface" not in e and "rpctype" not in e:
+                raise WhatIfRefused(
+                    "sub_edge needs an 'iface' and/or 'rpctype'")
+        elif op == "sub_node":
+            i = _check_index("node", e.get("node"), len(arr["ms_id"]))
+            arr["ms_id"][i] = _check_vocab("ms_id", e.get("ms_id"),
+                                           num_ms)
+        elif op == "drop_node":
+            i = _check_index("node", e.get("node"), len(arr["ms_id"]))
+            if int(arr["pattern_size"][i]) <= 1:
+                raise WhatIfRefused(
+                    f"node {i} is its pattern's last node — dropping it "
+                    f"would leave an empty pattern (pooling weight "
+                    f"divides by pattern_size)")
+            # the node's contiguous pattern block shrinks by one, so
+            # remaining members' pattern_size matches a from-scratch
+            # build of the edited graph; recover the block via the
+            # layout walk (sizes change as edits apply)
+            size = arr["pattern_size"][i]
+            start = 0
+            n = len(arr["ms_id"])
+            while start < n:
+                b = int(arr["pattern_size"][start])
+                if start <= i < start + b:
+                    break
+                start += b
+            else:  # pragma: no cover — _check_index bounds i
+                raise WhatIfRefused(f"node {i} not inside any pattern")
+            sel = slice(start, start + int(size))
+            arr["pattern_size"][sel] = size - 1
+            keep_e = (arr["senders"] != i) & (arr["receivers"] != i)
+            for f in ("senders", "receivers", "edge_iface",
+                      "edge_rpctype", "edge_duration"):
+                arr[f] = arr[f][keep_e]
+            arr["senders"] = np.where(arr["senders"] > i,
+                                      arr["senders"] - 1, arr["senders"])
+            arr["receivers"] = np.where(arr["receivers"] > i,
+                                        arr["receivers"] - 1,
+                                        arr["receivers"])
+            for f in ("ms_id", "node_depth", "pattern_prob",
+                      "pattern_size"):
+                arr[f] = np.delete(arr[f], i)
+        else:
+            raise WhatIfRefused(
+                f"unknown edit op {op!r} (choose from {EDIT_OPS})")
+    if len(arr["ms_id"]) == 0:
+        raise WhatIfRefused("edits removed every node of the mixture")
+    out = dataclasses.replace(
+        mixture,
+        senders=arr["senders"].astype(np.int32),
+        receivers=arr["receivers"].astype(np.int32),
+        edge_iface=arr["edge_iface"].astype(np.int32),
+        edge_rpctype=arr["edge_rpctype"].astype(np.int32),
+        edge_duration=arr["edge_duration"].astype(np.float32),
+        ms_id=arr["ms_id"].astype(np.int32),
+        node_depth=arr["node_depth"].astype(np.float32),
+        pattern_prob=arr["pattern_prob"].astype(np.float32),
+        pattern_size=arr["pattern_size"].astype(np.float32),
+        feature_mask=np.zeros(0, dtype=bool),  # recomputed below
+        num_nodes=int(len(arr["ms_id"])),
+        num_edges=int(len(arr["senders"])),
+    )
+    blocks = pattern_blocks(out)
+    out = dataclasses.replace(
+        out, feature_mask=_recompute_feature_mask(
+            arr, blocks, feature_all_stage_copies))
+    # edits only drop or substitute: the capacity accounting at the
+    # front doors keeps using the BASE mixture's sizes as a safe upper
+    # bound, which this invariant is load-bearing for
+    assert out.num_nodes <= mixture.num_nodes
+    assert out.num_edges <= mixture.num_edges
+    return out

@@ -16,7 +16,10 @@ transformer, SURVEY.md §2.3):
   pert_gnn.py:245);
 - global head: prob-weighted mixture pooling, concat entry embedding,
   2-layer MLP → scalar (model.py:106-112); optional non-negativity clamp
-  (the unimplemented comment at model.py:113).
+  (the unimplemented comment at model.py:113). With
+  `ModelConfig.quantile_taus` >= 2 levels the head widens to one column
+  per tau under a cumulative-softplus non-crossing parameterization
+  (distributional serving, pertgnn_tpu/lens/).
 
 TPU-first details: all GEMMs via flax Dense on the MXU (optionally bf16
 activations), attention via masked segment ops, BatchNorm masked for
@@ -126,12 +129,32 @@ class PertGNN(nn.Module):
             hidden, name="global_head1", dtype=dtype,
             kernel_init=head_init,
             bias_init=bias_initializer(cfg.init_scheme, g.shape[-1]))(g))
-        global_pred = nn.Dense(
-            1, name="global_head2", dtype=dtype, kernel_init=head_init,
-            bias_init=bias_initializer(cfg.init_scheme, hidden))(g)[:, 0]
+        # Multi-quantile head (ModelConfig.quantile_taus, lens/): one
+        # column per quantile level. Single-tau keeps the exact legacy
+        # Dense(1)[:, 0] graph (checkpoints + compiled programs
+        # byte-identical); >= 2 taus use the CUMULATIVE-SOFTPLUS
+        # parameterization — column 0 is raw, column i adds
+        # softplus(raw_i) — so quantile vectors are monotone for ANY
+        # parameter values, a structural guarantee rather than a
+        # training outcome (non-crossing property, tests/test_lens.py).
+        num_taus = len(cfg.quantile_taus)
+        raw = nn.Dense(
+            num_taus, name="global_head2", dtype=dtype,
+            kernel_init=head_init,
+            bias_init=bias_initializer(cfg.init_scheme, hidden))(g)
+        if num_taus == 1:
+            global_pred = raw[:, 0]
+        else:
+            # explicit accumulation (not jnp.cumsum) so the traced
+            # program stays inside graftaudit's modeled primitive set
+            cols = [raw[:, 0]]
+            for i in range(1, num_taus):
+                cols.append(cols[-1] + nn.softplus(raw[:, i]))
+            global_pred = jnp.stack(cols, axis=1)
         if cfg.nonnegative_pred:
             # softplus, not relu: a relu clamp kills the gradient whenever
-            # the raw prediction is negative (dead at init)
+            # the raw prediction is negative (dead at init). Elementwise
+            # monotone, so the non-crossing ordering survives the clamp.
             global_pred = nn.softplus(global_pred)
         return global_pred.astype(jnp.float32), local_pred.astype(jnp.float32)
 

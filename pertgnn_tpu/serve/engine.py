@@ -121,6 +121,12 @@ class PackedMicrobatch:
     # not perf_counter, because the graftscope collector aligns these
     # stamps across processes
     stage_tm: dict = dataclasses.field(default_factory=dict)
+    # lens (pertgnn_tpu/lens/): True = dispatch through the rung's
+    # LOCAL-pred-returning program variant (attribution requests);
+    # ``local`` is filled by complete_microbatch with the (N,)-shaped
+    # local head output, pad rows pinned to -inf in-graph
+    want_local: bool = False
+    local: np.ndarray | None = None
 
 
 @dataclasses.dataclass
@@ -148,8 +154,17 @@ class InferenceEngine:
 
     def __init__(self, model, state, cfg: Config,
                  mixtures: dict[int, Mixture], lookup: ResourceLookup,
-                 budget: BatchBudget, bus=None, store=None):
+                 budget: BatchBudget, bus=None, store=None,
+                 lens_names=None, lens_bounds=None):
         self._cfg = cfg
+        # lens (pertgnn_tpu/lens/): optional (ms_vocab, interface_vocab)
+        # string arrays so attribution rows carry NAMED calls, and the
+        # (num_ms, num_interfaces, num_rpctypes) vocabulary bounds the
+        # what-if validator refuses out-of-embedding substitutions
+        # against (from_dataset wires the bounds; names need a
+        # PreprocessResult, which not every construction path holds)
+        self._lens_names = lens_names
+        self._lens_bounds = lens_bounds
         # serialized-executable store (pertgnn_tpu/aot/); None = every
         # process compiles its own ladder
         self._store = store
@@ -187,19 +202,38 @@ class InferenceEngine:
         if self.serve_dtype == "int8":
             from pertgnn_tpu.ops.quantize import dequantize_tree
 
-            def step(variables, batch):
+            def _apply(variables, batch):
                 deq = {"params": dequantize_tree(variables["params"]),
                        "batch_stats": variables["batch_stats"]}
-                global_pred, _ = model.apply(deq, batch, training=False)
-                return global_pred * label_scale
+                return model.apply(deq, batch, training=False)
         else:
-            def step(variables, batch):
-                global_pred, _ = model.apply(variables, batch,
-                                             training=False)
-                return global_pred * label_scale
+            def _apply(variables, batch):
+                return model.apply(variables, batch, training=False)
+
+        def step(variables, batch):
+            global_pred, _ = _apply(variables, batch)
+            return global_pred * label_scale
+
+        def step_local(variables, batch):
+            # the attribution variant (pertgnn_tpu/lens/): route the
+            # already-computed per-node local head out of the program,
+            # pad node rows pinned to -inf IN-GRAPH so downstream top-k
+            # can never rank a padded node — graftaudit's padding-taint
+            # pass verifies the pin on the traced program
+            global_pred, local_pred = _apply(variables, batch)
+            local = jnp.where(batch.node_mask,
+                              local_pred * label_scale, -jnp.inf)
+            return global_pred * label_scale, local
 
         self._step = step
-        self._exe: dict[int, object] = {}
+        self._step_local = step_local
+        # lens serving (LensConfig): whether warmup also builds the
+        # local-pred program per rung; attribution requests against a
+        # cold local ladder are refused at submit (LensDisabled) so
+        # nothing ever compiles on the request path
+        self.lens_local = cfg.lens.lens_local
+        # (rung index, local variant) -> compiled executable
+        self._exe: dict[tuple[int, bool], object] = {}
         self._warmed = False
         self.warmup_s: float | None = None
         self.latency = LatencyRecorder()
@@ -228,7 +262,7 @@ class InferenceEngine:
 
     @classmethod
     def from_dataset(cls, dataset, cfg: Config, state, bus=None,
-                     store=None) -> "InferenceEngine":
+                     store=None, lens_names=None) -> "InferenceEngine":
         model_cfg = cfg.model
         if cfg.serve.serve_dtype in ("bf16", "int8"):
             # the quantized tiers run bf16 activations through the MXU;
@@ -242,17 +276,22 @@ class InferenceEngine:
             from pertgnn_tpu import aot
             store = aot.store_from_config(cfg, bus=bus)
         return cls(model, state, cfg, dataset.mixtures, dataset.lookup,
-                   dataset.budget, bus=bus, store=store)
+                   dataset.budget, bus=bus, store=store,
+                   lens_names=lens_names,
+                   lens_bounds=(dataset.num_ms, dataset.num_interfaces,
+                                dataset.num_rpctypes))
 
     # -- executable cache ------------------------------------------------
 
-    def _rung_entry(self, idx: int):
+    def _rung_entry(self, idx: int, local: bool = False):
         """(name, key, components, abstract_args) addressing rung `idx`
         in the AOT store. The name is the rung's shape (the logical
         slot); the key hashes everything the compiled program is welded
         to — so e.g. a hidden_channels or jax upgrade lands in the SAME
         slot with a DIFFERENT key, which is exactly the shape of miss
-        the store diagnoses loudly (aot/store.py)."""
+        the store diagnoses loudly (aot/store.py). ``local`` addresses
+        the rung's attribution variant — a distinct slot AND key
+        component, so the two program flavors coexist in the store."""
         from pertgnn_tpu import aot
 
         b = self.ladder[idx]
@@ -274,28 +313,35 @@ class InferenceEngine:
         # signature (int8 param leaves), but bf16 does not — hence the
         # explicit key component. cfg.model rides whole, which covers
         # attention_impl / use_pallas_attention / kernel block sizes /
-        # blocked_dense_max_cells by construction (dataclass fields).
+        # blocked_dense_max_cells — and the lens quantile-head width
+        # (quantile_taus) — by construction (dataclass fields).
+        # lens_local distinguishes the attribution program (it returns
+        # the extra local output and bakes in the pad pin).
         key, components = aot.cache_key(
             fn_id="serve.engine.step.v1",
             config={"model": cfg.model,
                     "serve_dtype": cfg.serve.serve_dtype,
                     "label_scale": cfg.train.label_scale,
+                    "lens_local": bool(local),
                     "graph_type": cfg.graph_type},
             args_sig=aot.abstract_signature(abstract_args))
-        name = f"serve_rung_g{b.max_graphs}_n{b.max_nodes}_e{b.max_edges}"
+        name = (f"serve_rung_g{b.max_graphs}_n{b.max_nodes}"
+                f"_e{b.max_edges}{'_local' if local else ''}")
         return name, key, components, abstract_args
 
-    def _compile(self, idx: int) -> object:
+    def _compile(self, idx: int, local: bool = False) -> object:
         plan = faults.active()
         if plan is not None:
             plan.fire("serve.compile", entry_ids=None)
+        step_fn = self._step_local if local else self._step
         if self._store is not None:
-            name, key, components, abstract_args = self._rung_entry(idx)
+            name, key, components, abstract_args = self._rung_entry(
+                idx, local)
             with self._bus.span("serve.compile", bucket=idx):
                 exe, outcome = self._store.load_or_build(
-                    name, key, components, jax.jit(self._step),
+                    name, key, components, jax.jit(step_fn),
                     abstract_args)
-            self._exe[idx] = exe
+            self._exe[(idx, local)] = exe
             if outcome == "deserialized":
                 self.deserialized += 1
                 self._bus.counter("serve.deserialized", bucket=idx)
@@ -304,32 +350,36 @@ class InferenceEngine:
                 self._bus.counter("serve.compiles", bucket=idx)
             return exe
         with self._bus.span("serve.compile", bucket=idx):
-            exe = jax.jit(self._step).lower(
+            exe = jax.jit(step_fn).lower(
                 self._variables,
                 abstract_batch(self.ladder[idx], self._n_feat)).compile()
-        self._exe[idx] = exe
+        self._exe[(idx, local)] = exe
         self.compiles += 1
         self._bus.counter("serve.compiles", bucket=idx)
         return exe
 
     def warmup(self) -> "InferenceEngine":
-        """AOT-compile every ladder rung so steady-state serving never
-        compiles. Idempotent; returns self for chaining."""
+        """AOT-compile every ladder rung — plus, with LensConfig.
+        lens_local, every rung's attribution variant — so steady-state
+        serving never compiles. Idempotent; returns self for chaining."""
         t0 = time.perf_counter()
         # attribution: which quantized tier + kernel variant the rung
         # executables bake in (docs/OBSERVABILITY.md)
         self._bus.counter("serve.dtype", dtype=self.serve_dtype,
                           impl=resolve_attention_impl(self._cfg.model))
+        variants = [False] + ([True] if self.lens_local else [])
         with self._bus.span("serve.warmup", buckets=len(self.ladder)):
             for i in range(len(self.ladder)):
-                if i not in self._exe:
-                    self._compile(i)
+                for local in variants:
+                    if (i, local) not in self._exe:
+                        self._compile(i, local)
         self.warmup_s = time.perf_counter() - t0
         self._warmed = True
         log.info("serve warmup: %d bucket executables in %.2fs "
-                 "(%d compiled, %d deserialized; ladder %s)",
-                 len(self.ladder), self.warmup_s, self.compiles,
+                 "(%d compiled, %d deserialized%s; ladder %s)",
+                 len(self._exe), self.warmup_s, self.compiles,
                  self.deserialized,
+                 "; incl. lens-local variants" if self.lens_local else "",
                  [(b.max_nodes, b.max_edges) for b in self.ladder])
         return self
 
@@ -400,12 +450,57 @@ class InferenceEngine:
 
     def request_size(self, entry_id: int) -> tuple[int, int]:
         """(nodes, edges) one request for this entry costs — the queue's
-        capacity accounting."""
+        capacity accounting. Counterfactual (edited) requests keep
+        using the BASE mixture's sizes as a safe upper bound: edits
+        only drop or substitute (lens/whatif.py asserts it), so an
+        edited batch is under-filled at worst, never over-packed."""
         m = self._mixtures[int(entry_id)]
         return m.num_nodes, m.num_edges
 
+    def base_mixture(self, entry_id: int) -> Mixture:
+        """The entry's unedited mixture — what lens/whatif.py edits and
+        lens/attribute.py maps attribution rows against."""
+        return self._mixtures[int(entry_id)]
+
+    def apply_whatif(self, entry_id: int, edits):
+        """The entry's mixture under the request's counterfactual edits
+        (pure; raises the typed WhatIfRefused) — validated with THIS
+        dataset's vocabulary bounds so a substitution outside the
+        embedding tables is refused at submit, not discovered as a
+        clamped gather at dispatch."""
+        from pertgnn_tpu.lens.whatif import apply_whatif
+
+        bounds = self._lens_bounds or (None, None, None)
+        return apply_whatif(
+            self.base_mixture(entry_id), edits,
+            num_ms=bounds[0], num_interfaces=bounds[1],
+            num_rpctypes=bounds[2],
+            feature_all_stage_copies=(
+                self._cfg.model.feature_all_stage_copies))
+
+    def attribution_rows(self, packed: PackedMicrobatch, slot: int,
+                         k: int, mixture: Mixture) -> list[dict]:
+        """Top-k attribution rows for graph ``slot`` of a completed
+        lens microbatch (lens/attribute.py): the slot's real-node slice
+        of the local output, ranked and mapped back through the arena
+        vocabulary. Pad rows cannot appear — they were pinned to -inf
+        in-graph and the slice below selects real lanes only."""
+        from pertgnn_tpu.lens.attribute import top_k_rows
+
+        if packed.local is None:
+            raise ValueError("attribution requested from a microbatch "
+                             "dispatched without the local variant")
+        sel = ((np.asarray(packed.batch.node_graph) == slot)
+               & np.asarray(packed.batch.node_mask))
+        names = self._lens_names or (None, None)
+        return top_k_rows(packed.local[sel], mixture,
+                          min(int(k), self._cfg.lens.lens_top_k),
+                          ms_names=names[0], iface_names=names[1])
+
     def pack_microbatch(self, entry_ids, ts_buckets,
-                        max_rung: int | None = None) -> PackedMicrobatch:
+                        max_rung: int | None = None,
+                        mixtures: list | None = None,
+                        want_local: bool = False) -> PackedMicrobatch:
         """Host half of a dispatch: bucket selection + ``pack_single``
         into the smallest fitting rung. Pure host work over read-only
         state — the overlapped queue runs this on its worker thread
@@ -419,13 +514,26 @@ class InferenceEngine:
         every rung executable already exists from warmup so a downgrade
         can never trigger a compile.
 
+        ``mixtures`` (aligned per request; None entries = base) carries
+        counterfactually edited mixtures (lens/whatif.py) — packed
+        under the request's REAL entry id, sized by the ACTUAL (edited)
+        arrays, selected into the existing ladder: since edits never
+        grow the graph and every rung executable exists from warmup, a
+        what-if dispatch can never compile. ``want_local`` dispatches
+        through the rung's attribution (local-returning) program.
+
         Raises RequestTooLarge if the microbatch exceeds the top rung —
         callers that cannot pre-size (predict_many, the queue) split
         instead."""
         entry_ids = np.asarray(entry_ids)
         g = len(entry_ids)
-        n = sum(self._mixtures[int(e)].num_nodes for e in entry_ids)
-        e_tot = sum(self._mixtures[int(e)].num_edges for e in entry_ids)
+        mixes = [self._mixtures[int(e)]
+                 if (mixtures is None or mixtures[i] is None)
+                 else mixtures[i] for i, e in enumerate(entry_ids)]
+        any_override = mixtures is not None and any(
+            m is not None for m in mixtures)
+        n = sum(m.num_nodes for m in mixes)
+        e_tot = sum(m.num_edges for m in mixes)
         idx = None
         if max_rung is not None:
             idx = select_bucket(self.ladder[:max_rung + 1], g, n, e_tot)
@@ -446,11 +554,13 @@ class InferenceEngine:
             batch = pack_single(self._mixtures, entry_ids,
                                 np.asarray(ts_buckets), self.ladder[idx],
                                 self._lookup,
-                                node_depth_in_x=self._node_depth_in_x)
+                                node_depth_in_x=self._node_depth_in_x,
+                                mixture_of=mixes if any_override else None)
         return PackedMicrobatch(entry_ids=entry_ids, idx=idx, batch=batch,
                                 n=n, e_tot=e_tot,
                                 engine_s=time.perf_counter() - t0,
-                                stage_tm={"pack": (tm0, time.monotonic())})
+                                stage_tm={"pack": (tm0, time.monotonic())},
+                                want_local=bool(want_local))
 
     def dispatch_packed(self, packed: PackedMicrobatch) -> InFlightBatch:
         """Device half, part 1: resolve the rung executable and launch
@@ -474,10 +584,11 @@ class InferenceEngine:
         # multi-second stall must show up in the engine latency
         # percentiles (as it did when predict_microbatch was one piece)
         t0 = time.perf_counter()
-        if idx in self._exe:
+        exe_key = (idx, packed.want_local)
+        if exe_key in self._exe:
             self.cache_hits += 1
             bus.counter("serve.cache_hit", bucket=idx, level=2)
-            exe = self._exe[idx]
+            exe = self._exe[exe_key]
         else:
             self.cache_misses += 1
             bus.counter("serve.cache_miss", bucket=idx,
@@ -487,7 +598,7 @@ class InferenceEngine:
                     "executable cache miss AFTER warmup for bucket %s "
                     "— the ladder no longer covers the request range",
                     self.ladder[idx])
-            exe = self._compile(idx)
+            exe = self._compile(idx, packed.want_local)
         tm0 = time.monotonic()
         with self.stage_latency["dispatch"].time(), \
                 bus.span("serve.dispatch", level=2, bucket=idx):
@@ -508,7 +619,12 @@ class InferenceEngine:
         tm0 = time.monotonic()
         with self.stage_latency["compute"].time(), \
                 bus.span("serve.compute", level=2, bucket=idx):
-            pred = np.asarray(inflight.out)[:g]
+            if packed.want_local:
+                pred_dev, local_dev = inflight.out
+                pred = np.asarray(pred_dev)[:g]
+                packed.local = np.asarray(local_dev)
+            else:
+                pred = np.asarray(inflight.out)[:g]
         packed.stage_tm["compute"] = (tm0, time.monotonic())
         packed.engine_s += time.perf_counter() - t0
         if inflight.injected == "nan":
@@ -516,22 +632,40 @@ class InferenceEngine:
         # output guard: NEVER hand garbage to a caller. A non-finite
         # prediction fails the batch (the queue's bisect then isolates
         # the offending request; direct callers see the typed error
-        # instead of silently propagating NaN).
-        if not np.isfinite(pred).all():
-            bad = entry_ids[~np.isfinite(pred)]
+        # instead of silently propagating NaN). Multi-quantile
+        # predictions are (G, T): a request fails if ANY column is bad.
+        finite_rows = (np.isfinite(pred) if pred.ndim == 1
+                       else np.isfinite(pred).all(axis=-1))
+        if not finite_rows.all():
+            bad = entry_ids[~finite_rows]
             self.nan_outputs += 1
             bus.counter("serve.nan_outputs", bucket=idx, graphs=int(g))
             log.error("non-finite model output for %d/%d requests "
                       "(entries %s) — quarantining the batch",
-                      int((~np.isfinite(pred)).sum()), g,
+                      int((~finite_rows).sum()), g,
                       bad[:8].tolist())
             raise NonFiniteOutput(
                 f"model returned non-finite predictions for entries "
                 f"{bad[:8].tolist()}")
+        if packed.local is not None:
+            # the local vector's REAL lanes get the same guard (-inf on
+            # pad lanes is the pin, by design — not an error)
+            nm = np.asarray(packed.batch.node_mask)
+            if not np.isfinite(packed.local[nm]).all():
+                self.nan_outputs += 1
+                bus.counter("serve.nan_outputs", bucket=idx,
+                            graphs=int(g))
+                raise NonFiniteOutput(
+                    "model returned non-finite LOCAL predictions for "
+                    "real nodes — quarantining the batch")
         # stage stamps of the batch that JUST completed, for the queue's
         # per-request trace spans: engine device calls are strictly
         # serialized (one worker/dispatcher thread), so "last completed"
-        # is unambiguous when the queue reads it in its settle step
+        # is unambiguous when the queue reads it in its settle step.
+        # (Lens attribution deliberately does NOT ride engine state
+        # like this: a watchdog-abandoned zombie thread could clobber
+        # it between completion and settle, so the queue threads the
+        # PackedMicrobatch object through its own call chain instead.)
         self.last_stage_tm = packed.stage_tm
         # pack + dispatch + compute phase durations, NOT wall since pack
         # start: an overlapped completion is deferred past the next
@@ -552,15 +686,19 @@ class InferenceEngine:
         return pred
 
     def predict_microbatch(self, entry_ids, ts_buckets,
-                           max_rung: int | None = None) -> np.ndarray:
+                           max_rung: int | None = None,
+                           mixtures: list | None = None,
+                           want_local: bool = False) -> np.ndarray:
         """One bucket-shaped dispatch for a coalesced microbatch —
         pack → dispatch → complete, synchronously. The overlapped queue
         calls the three phases itself so the pack of batch k+1 runs
         while the device computes batch k. ``max_rung`` is the brownout
-        rung cap (see pack_microbatch)."""
+        rung cap; ``mixtures``/``want_local`` are the lens request
+        variants (see pack_microbatch)."""
         return self.complete_microbatch(
             self.dispatch_packed(self.pack_microbatch(
-                entry_ids, ts_buckets, max_rung=max_rung)))
+                entry_ids, ts_buckets, max_rung=max_rung,
+                mixtures=mixtures, want_local=want_local)))
 
     def predict_many(self, entry_ids, ts_buckets) -> np.ndarray:
         """Predictions for an arbitrary request list, split greedily into
@@ -621,6 +759,7 @@ class InferenceEngine:
             "healthy": self.healthy,
             "rebuilds": self.rebuilds,
             "nan_outputs": self.nan_outputs,
+            "lens_local": self.lens_local,
             "warmup_s": self.warmup_s,
             "pad_waste_ratio": self.pad_waste_ratio(),
             "latency": self.latency.summary_dict(),

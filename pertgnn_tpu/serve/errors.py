@@ -76,3 +76,21 @@ class NonFiniteOutput(ServeError):
     """The model returned NaN/inf for this request. The output guard
     quarantines the batch rather than returning garbage to a caller.
     Counter: ``serve.nan_outputs``."""
+
+
+class WhatIfRefused(ServeError):
+    """A counterfactual topology edit (pertgnn_tpu/lens/whatif.py) names
+    something the pure edit algebra cannot honor — an out-of-range
+    node/edge index, a substitute id outside the embedding vocabulary,
+    dropping a pattern's last node, an edit that would GROW the graph.
+    Refused loudly at submit (the request never occupies a pending
+    slot); never an approximate edit. Counter: ``lens.whatif_refused``.
+    Semantics + the full refusal list: docs/GUIDE.md §13."""
+
+
+class LensDisabled(ServeError):
+    """An attribution request (lens.attribute_k > 0) reached an engine
+    whose local-pred rung programs were not warmed
+    (``LensConfig.lens_local`` off). Refused at submit: the engine NEVER
+    compiles a program variant on the request path — enable
+    ``--lens_local`` so warmup builds the attribution ladder."""

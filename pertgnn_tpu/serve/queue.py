@@ -32,6 +32,16 @@ Semantics:
   unchanged under overlap (benchmarks/pipeline_bench.py re-asserts the
   chaos scenarios on the overlapped path).
 
+Lens request variants (pertgnn_tpu/lens/, docs/GUIDE.md §13) ride
+``submit(lens=...)`` through the SAME machinery: multi-quantile heads
+resolve futures to (T,)-vectors instead of scalars, attribution
+requests batch separately (they dispatch the rung's local-pred program
+variant) and resolve to a LensResult, and counterfactual edits are
+applied + validated AT SUBMIT (a refused edit fast-fails with the typed
+WhatIfRefused) so the worker packs pre-validated arrays — every fault
+path below (bisect, watchdog, shed, deadline) applies to them
+unchanged.
+
 Failure semantics (docs/RELIABILITY.md) — a submitted Future ALWAYS
 resolves, to a prediction or to a typed serve error (serve/errors.py):
 
@@ -82,21 +92,48 @@ import threading
 import time
 from concurrent.futures import Future
 
+import numpy as np
+
 from pertgnn_tpu.fleet import shield
+from pertgnn_tpu.lens.request import LensResult
 from pertgnn_tpu.serve.engine import InferenceEngine
 from pertgnn_tpu.serve.errors import (DeadlineExceeded, DispatchTimeout,
-                                      EngineUnhealthy, QueueClosed,
-                                      RequestQuarantined, Shed)
+                                      EngineUnhealthy, LensDisabled,
+                                      QueueClosed, RequestQuarantined,
+                                      Shed, WhatIfRefused)
 
 log = logging.getLogger(__name__)
 
 # pending-entry tuple layout (submission order is load-bearing):
 # (entry_id, ts_bucket, arrival_time, deadline_abs, future, trace,
-#  slo, downgrade)
+#  slo, downgrade, lens)
 # trace is None (untraced) or a _ReqTrace; slo is the request's SLO
 # class name (fleet/shield.py — admission sheds lowest-class-first);
 # downgrade marks brownout'd best-effort traffic the engine serves
-# through the cheapest ladder rung (batches never mix downgrade states)
+# through the cheapest ladder rung; lens is None (a plain request) or
+# a _LensReq (pertgnn_tpu/lens/): attribution k + the counterfactually
+# edited mixture, resolved AT SUBMIT so a refused edit fast-fails the
+# caller and the worker packs pre-validated arrays. Batches never mix
+# downgrade states, and never mix attribution (local-program) requests
+# with plain ones — the two dispatch through different rung programs.
+
+
+class _LensReq:
+    """One admitted lens request's resolved variant state riding its
+    pending tuple: ``k`` (top-k attribution rows; 0 = none) and
+    ``mixture`` (the what-if-edited Mixture, None = the base).
+    ``num_edits`` feeds the post-admission lens.whatif counter."""
+
+    __slots__ = ("k", "mixture", "num_edits")
+
+    def __init__(self, k: int, mixture, num_edits: int = 0):
+        self.k = k
+        self.mixture = mixture
+        self.num_edits = num_edits
+
+    @property
+    def wants_local(self) -> bool:
+        return self.k > 0
 
 
 class _ReqTrace:
@@ -291,7 +328,8 @@ class MicrobatchQueue:
     # -- client side -----------------------------------------------------
 
     def submit(self, entry_id: int, ts_bucket: int, trace=None,
-               slo: str | None = None, downgrade: bool = False) -> Future:
+               slo: str | None = None, downgrade: bool = False,
+               lens=None) -> Future:
         """Enqueue one request; the Future resolves to its predicted
         latency (label units) once its microbatch is served, or to a
         typed serve error. Raises QueueClosed / Shed (a QueueFull) /
@@ -306,13 +344,25 @@ class MicrobatchQueue:
         request of the lowest class present (its Future resolves with
         Shed — never lost), otherwise the arrival itself is shed.
         ``downgrade`` marks brownout'd best-effort traffic the engine
-        serves through the cheapest ladder rung."""
+        serves through the cheapest ladder rung.
+
+        ``lens`` (a pertgnn_tpu/lens LensRequest, or None) attaches the
+        distributional/what-if request variants: ``attribute_k`` > 0
+        resolves the Future to a LensResult carrying top-k root-cause
+        attribution (requires LensConfig.lens_local — else the typed
+        LensDisabled at admission), ``edits`` serves the prediction of
+        a counterfactually edited call graph (applied + validated HERE,
+        so a refused edit fast-fails the caller with WhatIfRefused and
+        never occupies a pending slot)."""
         eid = int(entry_id)
         slo_cls = shield.DEFAULT_CLASS if slo is None else slo
         shield.class_priority(slo_cls)  # unknown class fails the caller
         # size it NOW so an entry the engine has never seen fails the
         # caller, not the shared worker
         self._engine.request_size(eid)
+        lens_req = None
+        if lens is not None and not getattr(lens, "is_default", False):
+            lens_req = self._resolve_lens(eid, lens)
         fut: Future = Future()
         # trace identity BEFORE the lock (a dice roll + urandom must not
         # serialize the admission path); a rejected submit just discards
@@ -363,10 +413,10 @@ class MicrobatchQueue:
                     self.shed += 1
                     self.error_counts["Shed"] += 1
                     self._admit_locked(eid, ts_bucket, fut, tr, slo_cls,
-                                       downgrade)
+                                       downgrade, lens_req)
             else:
                 self._admit_locked(eid, ts_bucket, fut, tr, slo_cls,
-                                   downgrade)
+                                   downgrade, lens_req)
             if reject is not None:
                 self.error_counts[type(reject).__name__] += 1
         if evicted is not None:
@@ -396,15 +446,51 @@ class MicrobatchQueue:
                                          entry_id=eid,
                                          lowest_queued=lowest_queued)
             raise reject
+        if lens_req is not None and lens_req.mixture is not None:
+            # ADMITTED counterfactual traffic only (the documented
+            # semantics): edits validated AND a pending slot taken
+            self._engine.bus.counter("lens.whatif", entry_id=eid,
+                                     edits=lens_req.num_edits)
         return fut
 
+    def _resolve_lens(self, eid: int, lens) -> _LensReq:
+        """Validate + resolve one request's lens variants at admission
+        (fast-fail, outside the queue lock — whatif application is pure
+        numpy over read-only arenas). Raises the typed LensDisabled /
+        WhatIfRefused; the rejected request never occupies a slot."""
+        k = int(getattr(lens, "attribute_k", 0))
+        edits = tuple(getattr(lens, "edits", ()))
+        if k > 0 and not self._engine.lens_local:
+            with self._lock:
+                self.error_counts["LensDisabled"] += 1
+            raise LensDisabled(
+                "attribution requested but the engine's local-pred rung "
+                "programs are not warmed (LensConfig.lens_local off) — "
+                "nothing compiles on the request path, so the request "
+                "is refused instead")
+        mixture = None
+        if edits:
+            try:
+                mixture = self._engine.apply_whatif(eid, edits)
+            except WhatIfRefused:
+                with self._lock:
+                    self.error_counts["WhatIfRefused"] += 1
+                self._engine.bus.counter("lens.whatif_refused",
+                                         entry_id=eid)
+                raise
+            # the lens.whatif counter is emitted by submit() only once
+            # the request is ACTUALLY admitted — a shed/closed reject
+            # after a clean edit must not count as admitted traffic
+        return _LensReq(k, mixture, len(edits))
+
     def _admit_locked(self, eid: int, ts_bucket: int, fut: Future,
-                      tr, slo_cls: str, downgrade: bool) -> None:
+                      tr, slo_cls: str, downgrade: bool,
+                      lens_req: _LensReq | None = None) -> None:
         deadline = (time.perf_counter() + self._req_deadline_s
                     if self._req_deadline_s > 0 else math.inf)
         self._pending.append((eid, int(ts_bucket), time.perf_counter(),
                               deadline, fut, tr, slo_cls,
-                              bool(downgrade)))
+                              bool(downgrade), lens_req))
         self._wake.notify()
 
     def predict(self, entry_id: int, ts_bucket: int,
@@ -515,14 +601,22 @@ class MicrobatchQueue:
 
     # -- worker side -----------------------------------------------------
 
+    @staticmethod
+    def _wants_local(item) -> bool:
+        return item[8] is not None and item[8].wants_local
+
     def _take_batch_locked(self) -> list[tuple]:
         """Pop the maximal capacity-respecting prefix of the pending list
         (submission order — alignment depends on it). Batches never mix
-        DOWNGRADE states: a brownout'd best-effort batch is capped at
-        the cheapest rung's capacity (so it actually fits rung 0) and a
-        normal batch stops before absorbing a downgraded request —
-        submission order within each batch is preserved either way."""
+        DOWNGRADE states (a brownout'd best-effort batch is capped at
+        the cheapest rung's capacity so it actually fits rung 0), and
+        never mix ATTRIBUTION requests with plain ones — the two
+        dispatch through different rung programs (the lens local
+        variant) and a batch has exactly one. Submission order within
+        each batch is preserved either way. What-if-only lens requests
+        mix freely: they differ only in the packed arrays."""
         dg = bool(self._pending[0][7]) if self._pending else False
+        loc = self._wants_local(self._pending[0]) if self._pending else False
         max_g, max_n, max_e = ((self._dg_graphs, self._dg_nodes,
                                 self._dg_edges) if dg else
                                (self._max_graphs, self._max_nodes,
@@ -531,7 +625,9 @@ class MicrobatchQueue:
         take = 0
         for item in self._pending:
             dn, de = self._engine.request_size(item[0])
-            if take and (bool(item[7]) != dg or g + 1 > max_g
+            if take and (bool(item[7]) != dg
+                         or self._wants_local(item) != loc
+                         or g + 1 > max_g
                          or n + dn > max_n or e + de > max_e):
                 break
             g, n, e = g + 1, n + dn, e + de
@@ -555,9 +651,11 @@ class MicrobatchQueue:
         downgrade boundary — the next take flushes up to it anyway)."""
         g = n = e = 0
         dg = bool(self._pending[0][7]) if self._pending else False
+        loc = self._wants_local(self._pending[0]) if self._pending else False
         for item in self._pending:
             dn, de = self._engine.request_size(item[0])
-            if (bool(item[7]) != dg or g + 1 > self._max_graphs
+            if (bool(item[7]) != dg or self._wants_local(item) != loc
+                    or g + 1 > self._max_graphs
                     or n + dn > self._max_nodes
                     or e + de > self._max_edges):
                 return True
@@ -728,16 +826,19 @@ class MicrobatchQueue:
             return
         entries = [b[0] for b in batch]
         ts_buckets = [b[1] for b in batch]
+        mixtures, want_local = self._batch_lens_args(batch)
         try:
-            preds = self._dispatch(entries, ts_buckets,
-                                   max_rung=self._batch_max_rung(batch))
+            preds, packed = self._dispatch(
+                entries, ts_buckets,
+                max_rung=self._batch_max_rung(batch),
+                mixtures=mixtures, want_local=want_local)
         except DispatchTimeout as exc:
             self._recover_or_fail(batch, exc, retried=retried)
             return
         except Exception as exc:  # lint: allow-silent-except — _fail_or_bisect logs/counts per sub-batch
             self._fail_or_bisect(batch, exc, retried=retried)
             return
-        self._settle(batch, preds)
+        self._settle(batch, preds, packed)
 
     def _recover_or_fail(self, batch, exc: DispatchTimeout,
                          retried: bool = False) -> None:
@@ -765,9 +866,11 @@ class MicrobatchQueue:
             # host-only work (bucket select + pack_single over read-only
             # state): safe while the single engine device thread still
             # owns the in-flight batch — THE overlap this path exists for
+            mixtures, want_local = self._batch_lens_args(batch)
             packed = self._engine.pack_microbatch(
                 [b[0] for b in batch], [b[1] for b in batch],
-                max_rung=self._batch_max_rung(batch))
+                max_rung=self._batch_max_rung(batch),
+                mixtures=mixtures, want_local=want_local)
         except Exception as exc:  # lint: allow-silent-except — handed to _fail_or_bisect below
             pack_exc = exc
         self._finish_inflight()
@@ -813,14 +916,17 @@ class MicrobatchQueue:
         except Exception as exc:  # lint: allow-silent-except — _fail_or_bisect logs/counts per sub-batch
             self._fail_or_bisect(batch, exc, retried=False)
             return
-        self._settle(batch, preds)
+        self._settle(batch, preds, handle.packed)
 
-    def _settle(self, batch, preds) -> None:
+    def _settle(self, batch, preds, packed=None) -> None:
         """Resolve a served batch's futures to their own predictions
         (submission-order alignment) + per-request total latency, and —
         for traced requests — the engine-stage trace spans (the batch's
         pack/dispatch/compute stamps, one span set per traced request:
-        trees are per REQUEST even though the work was per batch)."""
+        trees are per REQUEST even though the work was per batch).
+        ``packed`` is THIS batch's completed PackedMicrobatch, threaded
+        through the dispatch chain (never read off engine state — see
+        _dispatch); lens attribution requires it."""
         bus = self._engine.bus
         t_done = time.perf_counter()
         stage_tm = self._engine.last_stage_tm
@@ -841,9 +947,35 @@ class MicrobatchQueue:
                 if cp:
                     bus.trace_span("trace.compute", tr.ctx, cp[0],
                                    cp[1])
-        for item, p in zip(batch, preds):
-            fut, tr = item[4], item[5]
-            fut.set_result(float(p))
+        # lens attribution rides THIS batch's completed microbatch
+        # (threaded through the call chain); graph slot i is batch
+        # position i by pack order. One counter per attributed batch.
+        lens_packed = None
+        if batch and self._wants_local(batch[0]):
+            if packed is None or packed.local is None:
+                # structurally impossible (every local-batch path
+                # threads its packed through) — fail typed, not silent
+                self._fail(batch, RuntimeError(
+                    "lens batch settled without its packed microbatch"))
+                return
+            lens_packed = packed
+            bus.counter("lens.attribution", graphs=len(batch))
+        for slot, (item, p) in enumerate(zip(batch, preds)):
+            fut, tr, lens_req = item[4], item[5], item[8]
+            # multi-quantile heads resolve to the (T,) vector; the
+            # legacy scalar contract is untouched in single-tau mode
+            val = (float(p) if np.ndim(p) == 0
+                   else np.asarray(p, np.float32))
+            if lens_req is not None and lens_req.wants_local:
+                mixture = (lens_req.mixture
+                           if lens_req.mixture is not None
+                           else self._engine.base_mixture(item[0]))
+                rows = self._engine.attribution_rows(
+                    lens_packed, slot, lens_req.k, mixture)
+                fut.set_result(LensResult(pred=val,
+                                          attribution=tuple(rows)))
+            else:
+                fut.set_result(val)
             if tr is not None and tr.owns_root:
                 bus.finish_trace("trace.request", tr.ctx, tr.tm_submit,
                                  tm_done, outcome="ok", entry_id=item[0])
@@ -905,11 +1037,42 @@ class MicrobatchQueue:
         one admitted batch."""
         return 0 if (batch and batch[0][7]) else None
 
-    def _dispatch(self, entries, ts_buckets, max_rung=None):
-        return self._engine_call(
-            lambda: self._engine.predict_microbatch(entries, ts_buckets,
-                                                    max_rung=max_rung),
-            what=f"engine dispatch of {len(entries)} request(s)")
+    def _batch_lens_args(self, batch) -> tuple[list | None, bool]:
+        """(per-request mixture overrides, want_local) for one
+        (local-homogeneous) batch — PURE, same retry/bisect argument
+        as _batch_max_rung. Mixture overrides ride per item, so bisect
+        halves keep exactly their own counterfactual edits."""
+        mixtures = None
+        if any(item[8] is not None and item[8].mixture is not None
+               for item in batch):
+            mixtures = [item[8].mixture if item[8] is not None else None
+                        for item in batch]
+        return mixtures, bool(batch and self._wants_local(batch[0]))
+
+    def _dispatch(self, entries, ts_buckets, max_rung=None,
+                  mixtures=None, want_local=False):
+        """(predictions, packed-or-None). Lens (local) batches run the
+        engine's three phases explicitly and RETURN the packed
+        microbatch through this call chain — attribution must read the
+        local vector of exactly this batch, and engine-level
+        "last completed" state could be clobbered by a
+        watchdog-abandoned zombie thread finishing late."""
+        what = f"engine dispatch of {len(entries)} request(s)"
+        if not want_local:
+            return self._engine_call(
+                lambda: self._engine.predict_microbatch(
+                    entries, ts_buckets, max_rung=max_rung,
+                    mixtures=mixtures),
+                what=what), None
+
+        def run():
+            packed = self._engine.pack_microbatch(
+                entries, ts_buckets, max_rung=max_rung,
+                mixtures=mixtures, want_local=True)
+            return self._engine.complete_microbatch(
+                self._engine.dispatch_packed(packed)), packed
+
+        return self._engine_call(run, what=what)
 
     def _trip_watchdog(self, exc: DispatchTimeout) -> None:
         with self._lock:  # stats_dict snapshots this counter

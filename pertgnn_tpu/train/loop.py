@@ -33,7 +33,9 @@ from pertgnn_tpu.batching.materialize import (
     DeviceArenas, arena_nbytes, build_device_arenas, materialize_compact,
     zero_masked_idx)
 from pertgnn_tpu.batching.pack import PackedBatch, zero_masked
-from pertgnn_tpu.config import Config, resolve_attention_impl
+from pertgnn_tpu.config import (Config, primary_tau_index,
+                                resolve_attention_impl,
+                                resolve_quantile_taus)
 from pertgnn_tpu.models.pert_model import PertGNN, make_model
 from pertgnn_tpu.train.metrics import masked_metric_sums, quantile_loss
 
@@ -82,6 +84,14 @@ def create_train_state(model: PertGNN, tx: optax.GradientTransformation,
                       step=jnp.zeros((), jnp.int32))
 
 
+def _resolved_taus(cfg: Config) -> tuple[tuple[float, ...], int]:
+    """(quantile levels, primary column index) — the per-config loss
+    layout, resolved once through the single resolution point
+    (config.resolve_quantile_taus)."""
+    taus = resolve_quantile_taus(cfg.model, cfg.train.tau)
+    return taus, primary_tau_index(taus, cfg.train.tau)
+
+
 def _loss_fn(model: PertGNN, cfg: Config, params, batch_stats, batch,
              dropout_rng):
     variables = {"params": params, "batch_stats": batch_stats}
@@ -90,13 +100,29 @@ def _loss_fn(model: PertGNN, cfg: Config, params, batch_stats, batch,
         variables, batch, training=True, mutable=["batch_stats"], rngs=rngs)
     scale = cfg.train.label_scale
     y_scaled = batch.y / scale
-    loss = quantile_loss(y_scaled, global_pred, cfg.train.tau,
-                         mask=batch.graph_mask)
+    taus, pi = _resolved_taus(cfg)
+    if len(taus) == 1:
+        loss = quantile_loss(y_scaled, global_pred, taus[0],
+                             mask=batch.graph_mask)
+        primary = global_pred
+    else:
+        # one pinball term per (tau, column): the summed objective is
+        # what makes every column a calibrated quantile regressor
+        # (lens_bench exit-gates the empirical coverage)
+        loss = sum(quantile_loss(y_scaled, global_pred[:, i], t,
+                                 mask=batch.graph_mask)
+                   for i, t in enumerate(taus))
+        primary = global_pred[:, pi]
     if cfg.model.local_loss_weight > 0:
+        # auxiliary per-node head, trained at the PRIMARY tau: the
+        # reference computes local_pred but never trains on it
+        # (pert_gnn.py:245) — attribution from an untrained head is
+        # noise (docs/GUIDE.md §13), so attribution serving should set
+        # this weight > 0. Rides every AOT train key via cfg.model.
         y_per_node = y_scaled[batch.node_graph]
         loss = loss + cfg.model.local_loss_weight * quantile_loss(
-            y_per_node, local_pred, cfg.train.tau, mask=batch.node_mask)
-    metrics = masked_metric_sums(batch.y, global_pred * scale, cfg.train.tau,
+            y_per_node, local_pred, taus[pi], mask=batch.node_mask)
+    metrics = masked_metric_sums(batch.y, primary * scale, taus[pi],
                                  batch.graph_mask)
     return loss, (updates["batch_stats"], metrics)
 
@@ -123,13 +149,16 @@ def train_step_fn(model: PertGNN, cfg: Config,
 
 
 def eval_step_fn(model: PertGNN, cfg: Config) -> Callable:
+    taus, pi = _resolved_taus(cfg)
+
     def step(state: TrainState, batch: PackedBatch):
         (global_pred, _) = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
             batch, training=False)
+        pred = global_pred if global_pred.ndim == 1 else global_pred[:, pi]
         return masked_metric_sums(batch.y,
-                                  global_pred * cfg.train.label_scale,
-                                  cfg.train.tau, batch.graph_mask)
+                                  pred * cfg.train.label_scale,
+                                  taus[pi], batch.graph_mask)
 
     return step
 

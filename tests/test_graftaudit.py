@@ -98,6 +98,13 @@ def test_repo_audits_clean_within_budget():
     # first-class audit subject — donation/dtype-flow/host-interop
     # coverage extends to continual training mechanically
     assert any(n.startswith("continual/finetune_") for n in names), names
+    # the ISSUE-15 satellite: the lens serving programs are audited —
+    # the multi-quantile (non-crossing head, (G, T) output) and the
+    # local-pred-returning (attribution) variants; the latter KEEPS
+    # node lanes, so a clean audit here IS the static proof that pad
+    # rows are pinned to -inf before any top-k can see them
+    assert any(n.startswith("lens/quantile/") for n in names), names
+    assert any(n.startswith("lens/local/") for n in names), names
 
 
 def test_no_baseline_file():
@@ -142,6 +149,25 @@ def test_audit_emits_telemetry():
     assert cap.gauges["audit.programs"] == len(result.programs)
     assert cap.gauges["audit.violations"] == 0
     assert cap.gauges["audit.seconds"] > 0
+
+
+def test_lens_local_unpinned_output_flagged():
+    """The negative pin behind the lens/local coverage above: a program
+    that returns per-node data WITHOUT the -inf pad pin is flagged
+    (node-pad lanes reach an output the caller keeps), while the
+    engine's actual shape — where(node_mask, local, -inf) — is clean.
+    Keeps the 'padded rows provably unrankable' proof non-vacuous."""
+    def unpinned(w, x, mask, idx):
+        return (x * w).sum(-1)  # node-pad lanes carried out verbatim
+
+    def pinned(w, x, mask, idx):
+        return jnp.where(mask, (x * w).sum(-1), -jnp.inf)
+
+    res = _audit([_serve_spec(unpinned, name="lens/local/unpinned")],
+                 passes=["padding-taint"])
+    assert not res.ok and "node" in res.new[0].message
+    assert _audit([_serve_spec(pinned, name="lens/local/pinned")],
+                  passes=["padding-taint"]).ok
 
 
 # --- padding-taint -------------------------------------------------------

@@ -1,0 +1,593 @@
+"""The lens subsystem (pertgnn_tpu/lens/ — ISSUE 15).
+
+Layered cheapest-first, like the sibling suites:
+
+1. pure math — quantile-tau resolution (legacy byte-compat rules),
+   calibration coverage/monotonicity, the LensRequest wire codec;
+2. the NON-CROSSING property under hypothesis: quantile vectors are
+   monotone for RANDOM params and inputs (a structural guarantee of the
+   cumulative-softplus head, not a training outcome);
+3. the counterfactual edit ORACLE: apply_whatif on a built mixture is
+   array-identical to build_mixtures over the hand-edited GraphSpec,
+   and the edited mixture PACKS bit-identically to packing the edited
+   graph from scratch;
+4. engine-level attribution: pad rows of the local output are -inf and
+   can never be named by top-k;
+5. fleet round-trip of the new request-variant fields — including the
+   hedged (both legs carry identical lens meta, exactly-once result)
+   and shed (typed Shed for a lens request, never lost) paths, with
+   injected transports so both race orders are deterministic;
+6. AOT key coverage: quantile_taus and local_loss_weight invalidate
+   the train/serve program keys.
+"""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pertgnn_tpu.batching import build_dataset
+from pertgnn_tpu.batching.mixture import build_mixtures
+from pertgnn_tpu.batching.pack import BatchBudget, pack_single
+from pertgnn_tpu.config import (Config, DataConfig, FleetConfig,
+                                IngestConfig, LensConfig, ModelConfig,
+                                ServeConfig, TrainConfig,
+                                primary_tau_index, resolve_quantile_taus)
+from pertgnn_tpu.graphs.construct import GraphSpec
+from pertgnn_tpu.lens.calibrate import (calibration_errors,
+                                        coverage_per_tau,
+                                        monotone_violations)
+from pertgnn_tpu.lens.request import LensRequest, LensResult
+from pertgnn_tpu.lens.whatif import apply_whatif, pattern_blocks
+from pertgnn_tpu.serve.errors import (LensDisabled, Shed, WhatIfRefused)
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    _HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover — dev extra absent
+    _HAVE_HYPOTHESIS = False
+
+_needs_hypothesis = pytest.mark.skipif(
+    not _HAVE_HYPOTHESIS, reason="property tests need the hypothesis "
+    "dev extra; the deterministic grid twin below always runs")
+
+
+# --- 1. pure math ---------------------------------------------------------
+
+
+def test_resolve_taus_legacy_default_follows_train_tau():
+    # the byte-compat rule: (0.5,) = legacy mode, train.tau wins
+    assert resolve_quantile_taus(ModelConfig(), 0.5) == (0.5,)
+    assert resolve_quantile_taus(ModelConfig(), 0.7) == (0.7,)
+    m = ModelConfig(quantile_taus=(0.5, 0.95, 0.99))
+    assert resolve_quantile_taus(m, 0.7) == (0.5, 0.95, 0.99)
+    # a single NON-default level wins over train.tau too
+    assert resolve_quantile_taus(
+        ModelConfig(quantile_taus=(0.9,)), 0.5) == (0.9,)
+
+
+def test_resolve_taus_validation():
+    with pytest.raises(ValueError):
+        resolve_quantile_taus(ModelConfig(quantile_taus=()), 0.5)
+    with pytest.raises(ValueError):
+        resolve_quantile_taus(
+            ModelConfig(quantile_taus=(0.9, 0.5)), 0.5)  # not ascending
+    with pytest.raises(ValueError):
+        resolve_quantile_taus(
+            ModelConfig(quantile_taus=(0.5, 0.5)), 0.5)  # not strict
+    with pytest.raises(ValueError):
+        resolve_quantile_taus(
+            ModelConfig(quantile_taus=(0.1, 1.5)), 0.5)  # out of (0,1)
+
+
+def test_primary_tau_index():
+    assert primary_tau_index((0.5, 0.95, 0.99), 0.5) == 0
+    assert primary_tau_index((0.1, 0.5, 0.9), 0.5) == 1
+    assert primary_tau_index((0.9, 0.95), 0.5) == 0
+
+
+def test_coverage_and_monotone_math():
+    y = np.array([1.0, 2.0, 3.0, 4.0])
+    preds = np.array([[0.5, 5.0]] * 4)  # q0 under everything, q1 over
+    cov = coverage_per_tau(y, preds)
+    assert cov.tolist() == [0.0, 1.0]
+    errs = calibration_errors(y, preds, (0.5, 0.9))
+    assert errs.tolist() == [0.5, pytest.approx(0.1)]
+    assert monotone_violations(preds) == 0
+    bad = np.array([[1.0, 0.5], [1.0, 2.0]])
+    assert monotone_violations(bad) == 1
+    # scalar predictions: trivially monotone, coverage still defined
+    assert monotone_violations(np.array([1.0, 2.0])) == 0
+    with pytest.raises(ValueError):
+        coverage_per_tau(np.zeros(0), np.zeros((0, 2)))
+    with pytest.raises(ValueError):
+        calibration_errors(y, preds, (0.5,))  # column/tau mismatch
+
+
+def test_lens_request_wire_roundtrip():
+    assert LensRequest().to_wire() is None
+    assert LensRequest.from_wire(None) is None
+    r = LensRequest(attribute_k=3,
+                    edits=({"op": "drop_edge", "edge": 1},))
+    w = r.to_wire()
+    assert w == {"k": 3, "edits": [{"op": "drop_edge", "edge": 1}]}
+    back = LensRequest.from_wire(w)
+    assert back.attribute_k == 3 and back.edits == r.edits
+    # edits-only and k-only both omit the other field
+    assert LensRequest(edits=({"op": "x"},)).to_wire() == {
+        "edits": [{"op": "x"}]}
+    assert LensRequest(attribute_k=2).to_wire() == {"k": 2}
+
+
+# --- 2. the non-crossing property (hypothesis) ----------------------------
+
+
+def _tiny_batch(rng, n_feat=4):
+    from pertgnn_tpu.batching.pack import PackedBatch
+
+    N, E, G = 8, 10, 3
+    return PackedBatch(
+        x=rng.normal(size=(N, n_feat)).astype(np.float32) * 3,
+        ms_id=rng.integers(0, 5, N).astype(np.int32),
+        node_depth=np.zeros(N, np.float32),
+        node_graph=np.array([0, 0, 0, 1, 1, 2, 2, 2], np.int32),
+        node_mask=np.array([1, 1, 1, 1, 1, 1, 0, 0], bool),
+        pattern_prob=np.ones(N, np.float32),
+        pattern_size=np.ones(N, np.float32),
+        senders=rng.integers(0, 6, E).astype(np.int32),
+        receivers=rng.integers(0, 6, E).astype(np.int32),
+        edge_iface=rng.integers(0, 3, E).astype(np.int32),
+        edge_rpctype=rng.integers(0, 2, E).astype(np.int32),
+        edge_duration=np.zeros(E, np.float32),
+        edge_mask=np.ones(E, bool),
+        entry_id=np.array([0, 1, 0], np.int32),
+        y=np.zeros(3, np.float32),
+        graph_mask=np.array([1, 1, 1], bool))
+
+
+def _assert_noncrossing(param_seed: int, data_seed: int) -> None:
+    import jax
+
+    from pertgnn_tpu.models.pert_model import make_model
+
+    cfg = ModelConfig(hidden_channels=8, num_layers=1,
+                      quantile_taus=(0.1, 0.5, 0.9))
+    model = make_model(cfg, 5, 2, 3, 2)
+    batch = _tiny_batch(np.random.default_rng(data_seed))
+    variables = model.init(jax.random.PRNGKey(param_seed), batch,
+                           training=False)
+    pred, _ = model.apply(variables, batch, training=False)
+    assert pred.shape == (3, 3)
+    assert monotone_violations(np.asarray(pred)) == 0
+
+
+if _HAVE_HYPOTHESIS:
+    @_needs_hypothesis
+    @settings(max_examples=10, deadline=None)
+    @given(param_seed=st.integers(0, 2**31 - 1),
+           data_seed=st.integers(0, 2**31 - 1))
+    def test_noncrossing_property_random_params_and_inputs(param_seed,
+                                                           data_seed):
+        """Quantile vectors are monotone for ANY parameters and inputs
+        — the cumulative-softplus head makes crossing impossible by
+        construction, so this needs no training to hold."""
+        _assert_noncrossing(param_seed, data_seed)
+
+
+def test_noncrossing_grid_twin():
+    """Deterministic twin of the hypothesis property (always runs)."""
+    for param_seed, data_seed in ((0, 0), (1, 7), (12345, 999),
+                                  (2**31 - 1, 3)):
+        _assert_noncrossing(param_seed, data_seed)
+
+
+def test_single_tau_head_shape_is_legacy():
+    """quantile_taus=(0.5,) keeps the exact pre-lens head: Dense(1)
+    kernel shape and a (G,)-shaped prediction — checkpoints and
+    compiled programs stay byte-identical."""
+    import jax
+
+    from pertgnn_tpu.models.pert_model import make_model
+
+    model = make_model(ModelConfig(hidden_channels=8, num_layers=1),
+                       5, 2, 3, 2)
+    batch = _tiny_batch(np.random.default_rng(0))
+    v = model.init(jax.random.PRNGKey(0), batch, training=False)
+    assert v["params"]["global_head2"]["kernel"].shape == (8, 1)
+    pred, local = model.apply(v, batch, training=False)
+    assert pred.shape == (3,) and local.shape == (8,)
+
+
+# --- 3. the counterfactual edit oracle ------------------------------------
+
+
+def _spec(nn, edges, ms, depth=None):
+    s = np.array([e[0] for e in edges], np.int32)
+    r = np.array([e[1] for e in edges], np.int32)
+    ea = np.array([[e[2], e[3]] for e in edges],
+                  np.int32).reshape(-1, 2)
+    return GraphSpec(
+        senders=s, receivers=r, edge_attr=ea,
+        ms_id=np.array(ms, np.int32),
+        node_depth=np.asarray(depth if depth is not None
+                              else np.zeros(nn), np.float32),
+        num_nodes=nn, edge_durations=None)
+
+
+@pytest.fixture()
+def mixture_pair():
+    """(full mixture, builder) over two patterns: a 3-node chain and a
+    2-node pair, mixture-weighted 0.7/0.3."""
+    g0 = _spec(3, [(0, 1, 5, 0), (1, 2, 6, 1)], [10, 11, 10],
+               [0, .5, 1])
+    g1 = _spec(2, [(0, 1, 7, 0)], [12, 10], [0, 1])
+    e2r = {0: (np.array([0, 1]), np.array([0.7, 0.3], np.float32))}
+
+    def build(graphs):
+        return build_mixtures(graphs, e2r)[0]
+
+    return build({0: g0, 1: g1}), build, g1
+
+
+def _assert_mixture_equal(a, b, skip=()):
+    for f in dataclasses.fields(a):
+        if f.name in skip:
+            continue
+        assert np.array_equal(getattr(a, f.name), getattr(b, f.name)), \
+            f.name
+
+
+def test_whatif_drop_edge_matches_from_scratch(mixture_pair):
+    full, build, g1 = mixture_pair
+    got = apply_whatif(full, [{"op": "drop_edge", "edge": 1}])
+    oracle = build({0: _spec(3, [(0, 1, 5, 0)], [10, 11, 10],
+                             [0, .5, 1]), 1: g1})
+    _assert_mixture_equal(oracle, got)
+
+
+def test_whatif_drop_node_matches_from_scratch(mixture_pair):
+    full, build, g1 = mixture_pair
+    got = apply_whatif(full, [{"op": "drop_node", "node": 1}])
+    # documented semantics: node_depth keeps the OBSERVED values
+    oracle = build({0: _spec(2, [], [10, 10], [0, 1]), 1: g1})
+    _assert_mixture_equal(oracle, got, skip=("node_depth",))
+    assert np.array_equal(got.node_depth,
+                          np.array([0, 1, 0, 1], np.float32))
+    # the pattern block layout is still recoverable
+    assert pattern_blocks(got) == [(0, 2), (2, 4)]
+
+
+def test_whatif_sub_node_recomputes_feature_mask(mixture_pair):
+    full, build, g1 = mixture_pair
+    got = apply_whatif(full, [{"op": "sub_node", "node": 2,
+                               "ms_id": 11}])
+    oracle = build({0: _spec(3, [(0, 1, 5, 0), (1, 2, 6, 1)],
+                             [10, 11, 11], [0, .5, 1]), 1: g1})
+    _assert_mixture_equal(oracle, got)
+
+
+def test_whatif_sub_edge(mixture_pair):
+    full, build, g1 = mixture_pair
+    got = apply_whatif(full, [{"op": "sub_edge", "edge": 0, "iface": 9,
+                               "rpctype": 1}])
+    oracle = build({0: _spec(3, [(0, 1, 9, 1), (1, 2, 6, 1)],
+                             [10, 11, 10], [0, .5, 1]), 1: g1})
+    _assert_mixture_equal(oracle, got)
+
+
+def test_whatif_refusals(mixture_pair):
+    full, _build, _g1 = mixture_pair
+    cases = [
+        [{"op": "nope"}],
+        [{"op": "drop_edge", "edge": 99}],
+        [{"op": "drop_edge", "edge": -1}],
+        [{"op": "sub_edge", "edge": 0}],           # no field to set
+        [{"op": "drop_node", "node": 4},           # then last of pattern
+         {"op": "drop_node", "node": 3}],
+        "not-a-dict-list",
+    ]
+    for edits in cases:
+        with pytest.raises((WhatIfRefused, TypeError)):
+            apply_whatif(full, edits
+                         if isinstance(edits, list) else [edits])
+    with pytest.raises(WhatIfRefused):
+        apply_whatif(full, [{"op": "sub_node", "node": 0, "ms_id": 99}],
+                     num_ms=13)
+    with pytest.raises(WhatIfRefused):
+        apply_whatif(full, [{"op": "sub_edge", "edge": 0, "iface": 50}],
+                     num_interfaces=13)
+    # the input is never mutated by a refused (or successful) edit
+    assert full.num_nodes == 5 and full.num_edges == 3
+
+
+def test_whatif_never_grows(mixture_pair):
+    full, _build, _g1 = mixture_pair
+    for edits in ([{"op": "drop_edge", "edge": 0}],
+                  [{"op": "drop_node", "node": 1}],
+                  [{"op": "sub_node", "node": 0, "ms_id": 1}]):
+        out = apply_whatif(full, edits)
+        assert out.num_nodes <= full.num_nodes
+        assert out.num_edges <= full.num_edges
+
+
+# --- engine-level: pack bit-identity + attribution pad exclusion ----------
+
+
+@pytest.fixture(scope="module")
+def lens_served(preprocessed):
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.train.loop import restore_target_state
+
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=10),
+        data=DataConfig(max_traces=200, batch_size=16),
+        model=ModelConfig(hidden_channels=8, num_layers=1,
+                          local_loss_weight=0.1),
+        train=TrainConfig(label_scale=1000.0),
+        serve=ServeConfig(bucket_growth=2.0, min_bucket_nodes=256,
+                          min_bucket_edges=256, max_graphs_per_batch=8),
+        lens=LensConfig(lens_local=True, lens_top_k=4),
+        graph_type="pert",
+    )
+    ds = build_dataset(preprocessed, cfg)
+    _model, state = restore_target_state(ds, cfg)
+    engine = InferenceEngine.from_dataset(ds, cfg, state).warmup()
+    return ds, cfg, state, engine
+
+
+def test_edited_pack_bit_identical_to_from_scratch(lens_served):
+    """The acceptance oracle: packing an edited mixture through
+    mixture_of is bit-identical to packing the same edited mixture
+    registered as the entry's base — the override changes WHICH arrays
+    pack, nothing about HOW."""
+    ds, cfg, _state, _engine = lens_served
+    eid = int(ds.splits["test"].entry_ids[0])
+    tsb = int(ds.splits["test"].ts_buckets[0])
+    mix = ds.mixtures[eid]
+    assert mix.num_edges > 0
+    edited = apply_whatif(mix, [{"op": "drop_edge", "edge": 0}])
+    budget = BatchBudget(max_graphs=4, max_nodes=256, max_edges=256)
+    via_override = pack_single(
+        ds.mixtures, np.array([eid]), np.array([tsb]), budget,
+        ds.lookup, mixture_of=[edited])
+    scratch_mixtures = dict(ds.mixtures)
+    scratch_mixtures[eid] = edited
+    from_scratch = pack_single(
+        scratch_mixtures, np.array([eid]), np.array([tsb]), budget,
+        ds.lookup)
+    for field, a, b in zip(via_override._fields, via_override,
+                           from_scratch):
+        assert np.array_equal(a, b), field
+
+
+def test_attribution_pads_unrankable(lens_served):
+    """Pad rows of the local output are -inf (pinned in-graph) and the
+    attribution rows can only name real nodes; k past the node count
+    truncates."""
+    ds, cfg, _state, engine = lens_served
+    s = ds.splits["test"]
+    eid, tsb = int(s.entry_ids[0]), int(s.ts_buckets[0])
+    mix = ds.mixtures[eid]
+    packed = engine.pack_microbatch([eid], [tsb], want_local=True)
+    preds = engine.complete_microbatch(engine.dispatch_packed(packed))
+    assert len(preds) == 1
+    nm = np.asarray(packed.batch.node_mask)
+    assert np.isneginf(packed.local[~nm]).all()
+    assert np.isfinite(packed.local[nm]).all()
+    rows = engine.attribution_rows(packed, 0, 100, mix)
+    # k clamped by lens_top_k (4) and the mixture's node count
+    assert len(rows) == min(4, mix.num_nodes)
+    for r in rows:
+        assert 0 <= r["node"] < mix.num_nodes
+        assert np.isfinite(r["local"])
+    locals_ = [r["local"] for r in rows]
+    assert locals_ == sorted(locals_, reverse=True)
+
+
+def test_lens_disabled_refused_at_submit(lens_served):
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+    from pertgnn_tpu.train.loop import restore_target_state
+
+    ds, cfg, state, _engine = lens_served
+    cfg_off = dataclasses.replace(cfg, lens=LensConfig(lens_local=False))
+    engine = InferenceEngine.from_dataset(ds, cfg_off, state).warmup()
+    s = ds.splits["test"]
+    with MicrobatchQueue(engine, flush_deadline_ms=0.0) as q:
+        with pytest.raises(LensDisabled):
+            q.submit(int(s.entry_ids[0]), int(s.ts_buckets[0]),
+                     lens=LensRequest(attribute_k=1))
+        # plain traffic unaffected
+        assert isinstance(q.predict(int(s.entry_ids[0]),
+                                    int(s.ts_buckets[0])), float)
+
+
+def test_queue_mixed_lens_traffic_resolves(lens_served):
+    """Attribution, what-if, and plain requests interleaved through one
+    queue: every future resolves to its own variant's result type, and
+    the edited request's prediction rides the same coalescing
+    machinery (local-homogeneous batching)."""
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+    ds, cfg, _state, engine = lens_served
+    s = ds.splits["test"]
+    eid, tsb = int(s.entry_ids[0]), int(s.ts_buckets[0])
+    with MicrobatchQueue(engine, flush_deadline_ms=2.0) as q:
+        futs = [
+            q.submit(eid, tsb),
+            q.submit(eid, tsb, lens=LensRequest(attribute_k=2)),
+            q.submit(eid, tsb, lens=LensRequest(
+                edits=({"op": "drop_edge", "edge": 0},))),
+            q.submit(eid, tsb),
+        ]
+        plain = futs[0].result(60)
+        attr = futs[1].result(60)
+        what = futs[2].result(60)
+        plain2 = futs[3].result(60)
+    assert isinstance(plain, float) and plain == plain2
+    assert isinstance(attr, LensResult)
+    assert float(np.asarray(attr.pred)) == plain  # same topology
+    assert 1 <= len(attr.attribution) <= 2
+    assert isinstance(what, float)
+
+
+# --- 5. fleet round-trip of the lens request fields -----------------------
+
+
+def _probe_200(base_url, timeout_s):
+    return 200, {"ready": True}
+
+
+def _lens_rows(entries, lens=None):
+    rows = []
+    lens = lens or [None] * len(entries)
+    for e, ln in zip(entries, lens):
+        row = {"pred": float(e) * 2.0}
+        if isinstance(ln, dict) and ln.get("k"):
+            row["attr"] = [{"node": 0, "ms_id": 1, "iface": None,
+                            "local": 1.5}]
+        rows.append(row)
+    return rows
+
+
+def test_fleet_lens_fields_ride_the_wire_and_back():
+    """submit(lens=...) serializes to the transport body (omitted for
+    plain traffic) and the worker's attr rows rehydrate to a
+    LensResult."""
+    from pertgnn_tpu.fleet.router import FleetRouter
+
+    seen = []
+
+    def post(base_url, entries, ts, timeout_s, trace=None, slo=None,
+             dg=None, lens=None):
+        seen.append(lens)
+        return _lens_rows(entries, lens)
+
+    cfg = FleetConfig(router_flush_deadline_ms=0.0,
+                      health_poll_interval_s=60.0)
+    with FleetRouter({"w": "http://w"}, lambda e: (2, 1), (8, 512, 512),
+                     cfg=cfg, transport_post=post,
+                     transport_probe=_probe_200) as router:
+        f_lens = router.submit(
+            3, 0, lens=LensRequest(attribute_k=2,
+                                   edits=({"op": "drop_edge",
+                                           "edge": 0},)))
+        res = f_lens.result(10)
+        f_plain = router.submit(4, 0)
+        assert f_plain.result(10) == 8.0
+    assert isinstance(res, LensResult)
+    assert res.pred == 6.0 and res.attribution[0]["ms_id"] == 1
+    # first batch carried the wire dict, the plain one omitted lens
+    # entirely (the kwarg itself is omit-when-default)
+    lens_batches = [x for x in seen if x is not None]
+    assert lens_batches and lens_batches[0][0] == {
+        "k": 2, "edits": [{"op": "drop_edge", "edge": 0}]}
+    assert seen[-1] is None
+
+
+def test_fleet_lens_hedged_both_legs_identical_meta():
+    """A hedged lens dispatch: BOTH legs carry the identical lens wire
+    form, the future resolves exactly once to a LensResult, and the
+    loser's answer is ignored."""
+    from pertgnn_tpu.fleet.router import FleetRouter
+
+    release_primary = threading.Event()
+    calls = []
+    lock = threading.Lock()
+
+    def post(base_url, entries, ts, timeout_s, trace=None, slo=None,
+             dg=None, lens=None):
+        with lock:
+            calls.append((base_url, lens))
+            nth = len(calls)
+        if nth == 1:
+            assert release_primary.wait(10.0)  # hedge wins
+        return _lens_rows(entries, lens)
+
+    cfg = FleetConfig(hedge_quantile_ms=30.0,
+                      router_flush_deadline_ms=0.0,
+                      health_poll_interval_s=60.0,
+                      dispatch_timeout_s=10.0)
+    with FleetRouter({"wa": "http://a", "wb": "http://b"},
+                     lambda e: (2, 1), (8, 512, 512), cfg=cfg,
+                     transport_post=post,
+                     transport_probe=_probe_200) as router:
+        fut = router.submit(5, 0, lens=LensRequest(attribute_k=1))
+        res = fut.result(10.0)
+        release_primary.set()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with router._lock:
+                if len(calls) >= 2 and router._inflight_legs == 0:
+                    break
+            time.sleep(0.01)
+        stats = router.stats_dict()
+    assert isinstance(res, LensResult) and res.pred == 10.0
+    assert stats["hedge_fired"] == 1 and stats["hedge_won"] == 1
+    assert len(calls) == 2
+    # the load-bearing bit: both legs saw the SAME lens meta
+    assert calls[0][1] == calls[1][1] == [{"k": 1}]
+    assert fut.result() is res  # exactly-once
+
+
+def test_fleet_lens_request_shed_is_typed_not_lost():
+    """A lens request shed at a full router pending set resolves with
+    the typed Shed like any other request — the variant fields never
+    cost the ALWAYS-resolves contract."""
+    from pertgnn_tpu.fleet.router import FleetRouter
+
+    hold = threading.Event()
+
+    def post(base_url, entries, ts, timeout_s, trace=None, slo=None,
+             dg=None, lens=None):
+        hold.wait(10.0)
+        return _lens_rows(entries, lens)
+
+    cfg = FleetConfig(max_pending=1, worker_slots=1,
+                      router_flush_deadline_ms=1000.0,
+                      health_poll_interval_s=60.0,
+                      dispatch_timeout_s=10.0)
+    with FleetRouter({"w": "http://w"}, lambda e: (2, 1), (1, 512, 512),
+                     cfg=cfg, transport_post=post,
+                     transport_probe=_probe_200) as router:
+        first = router.submit(1, 0, lens=LensRequest(attribute_k=1))
+        # the pending set (size 1) is now occupied; same-class arrivals
+        # shed with the typed error
+        with pytest.raises(Shed):
+            for _ in range(50):
+                router.submit(2, 0, lens=LensRequest(attribute_k=1))
+                time.sleep(0.01)
+        hold.set()
+        assert isinstance(first.result(10.0), LensResult)
+
+
+# --- 6. AOT key coverage --------------------------------------------------
+
+
+def test_quantile_and_local_weight_ride_the_aot_keys():
+    """ModelConfig.quantile_taus and local_loss_weight invalidate the
+    train/serve program keys (they change the compiled loss/head), via
+    the model subtree riding the key whole."""
+    from pertgnn_tpu import aot
+
+    def key_for(model_cfg):
+        k, _c = aot.cache_key(fn_id="test.lens.v1",
+                              config={"model": model_cfg},
+                              args_sig="sig")
+        return k
+
+    base = key_for(ModelConfig())
+    assert key_for(ModelConfig(quantile_taus=(0.5, 0.9))) != base
+    assert key_for(ModelConfig(local_loss_weight=0.1)) != base
+    assert key_for(ModelConfig()) == base
+
+
+def test_serve_rung_key_distinguishes_local_variant(lens_served):
+    ds, cfg, _state, engine = lens_served
+    name_std, key_std, _c, _a = engine._rung_entry(0, local=False)
+    name_loc, key_loc, _c2, _a2 = engine._rung_entry(0, local=True)
+    assert name_std != name_loc
+    assert key_std != key_loc

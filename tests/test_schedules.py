@@ -335,6 +335,7 @@ class _FakeEngine:
         self.bus = _RecorderBus()
         self.healthy = True
         self.unhealthy_reason = ""
+        self.lens_local = False
         rung = SimpleNamespace(max_graphs=8, max_nodes=512,
                                max_edges=512)
         self.ladder = [rung]
@@ -343,7 +344,8 @@ class _FakeEngine:
     def request_size(self, eid):
         return (4, 4)
 
-    def predict_microbatch(self, entries, ts_buckets, max_rung=None):
+    def predict_microbatch(self, entries, ts_buckets, max_rung=None,
+                           mixtures=None):
         return [float(e) * 2.0 for e in entries]
 
     def record_queue_wait(self, dt, coalesced=0):

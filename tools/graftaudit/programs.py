@@ -425,6 +425,72 @@ def _continual_spec(out: list, errors: list) -> None:
                        f"{type(e).__name__}: {e}"))
 
 
+def _lens_specs(ds, cfg, state, out: list, errors: list) -> None:
+    """The lens serving programs (pertgnn_tpu/lens/, ISSUE 15) as
+    first-class audit subjects: (a) the MULTI-QUANTILE step — the
+    non-crossing head widens the output to (G, T), and graph-pad lanes
+    of every column must stay discarded; (b) the LOCAL-pred-returning
+    (attribution) step — its second output keeps NODE lanes, so the
+    padding-taint pass must prove the in-graph -inf pin on pad rows
+    (the 'padded rows provably unrankable' claim, statically). Both
+    trace through the engine's OWN step construction, exactly like the
+    standard serve matrix."""
+    import jax
+
+    from pertgnn_tpu.batching.pack import BatchBudget
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.serve.engine import InferenceEngine, abstract_batch
+
+    budget = BatchBudget(max_graphs=cfg.serve.max_graphs_per_batch,
+                         max_nodes=max(ds.budget.max_nodes, 256),
+                         max_edges=max(ds.budget.max_edges, 256))
+    variants = (
+        ("lens/quantile", dataclasses.replace(
+            cfg, model=dataclasses.replace(
+                cfg.model, quantile_taus=(0.5, 0.95, 0.99))), False),
+        ("lens/local", dataclasses.replace(
+            cfg, model=dataclasses.replace(
+                cfg.model, local_loss_weight=0.1)), True),
+    )
+    for name_prefix, c, local in variants:
+        try:
+            model = make_model(c.model, ds.num_ms, ds.num_entries,
+                               ds.num_interfaces, ds.num_rpctypes)
+            var_state = state
+            if not local:
+                # the multi-quantile head widens global_head2: the toy
+                # single-tau state's tree no longer fits — init a fresh
+                # one through the restore-target path (cheap at toy
+                # scale; shapes are all the audit consumes)
+                from pertgnn_tpu.train.loop import restore_target_state
+
+                _m, var_state = restore_target_state(ds, c)
+            eng = InferenceEngine(model, var_state, c, ds.mixtures,
+                                  ds.lookup, budget)
+            var_abs = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                eng._variables)
+            roles = _serve_roles(var_abs, eng._n_feat)
+            step = eng._step_local if local else eng._step
+            for i, rung in enumerate(eng.ladder):
+                abs_args = (var_abs, abstract_batch(rung, eng._n_feat))
+                traced = jax.jit(step).trace(*abs_args)
+                out.append(ProgramSpec(
+                    name=(f"{name_prefix}/rung{i}_g{rung.max_graphs}"
+                          f"n{rung.max_nodes}e{rung.max_edges}"),
+                    tags=frozenset({"serve", "lens", "f32", "segment",
+                                    "local" if local else "quantile"}),
+                    jaxpr=traced.jaxpr,
+                    invar_roles=roles,
+                    # the caller discards graph-pad prediction lanes
+                    # ([:g] slice); the local output's NODE lanes are
+                    # KEPT — the -inf pin is what must make them clean
+                    out_discard=frozenset({"graph"})))
+        except Exception as e:  # noqa: BLE001 — see _serve_specs
+            log.exception("graftaudit: building %s failed", name_prefix)
+            errors.append((name_prefix, f"{type(e).__name__}: {e}"))
+
+
 def build_programs() -> tuple[list[ProgramSpec], list[tuple[str, str]]]:
     """(specs, build_errors). Build errors are audit findings (rule
     "driver"), not skips — a program variant that stopped tracing is
@@ -437,6 +503,7 @@ def build_programs() -> tuple[list[ProgramSpec], list[tuple[str, str]]]:
     specs: list[ProgramSpec] = []
     errors: list[tuple[str, str]] = []
     _serve_specs(ds, cfg, state, specs, errors)
+    _lens_specs(ds, cfg, state, specs, errors)
     _train_specs(ds, cfg, model, state, specs, errors)
     _init_spec(ds, cfg, model, state, specs, errors)
     _sharded_specs(ds, cfg, model, state, specs, errors)
