@@ -116,6 +116,9 @@ def run_storm(tmp: str, pop_csv: str, args) -> dict:
     cmd = [sys.executable, "-m", "pertgnn_tpu.cli.fleet_main",
            *common_flags(tmp), "--fresh_init",
            "--num_workers", "2", "--pin_worker_cpus",
+           # the storm rides the shared-memory ring: a SIGKILLed worker
+           # must surface as RingPeerDead -> requeue, not a stall
+           "--transport", "shm",
            "--requests", pop_csv,
            # the storm: open-loop bursts + diurnal + Zipf + SLO mix
            "--loadgen",

@@ -1,0 +1,457 @@
+"""Wire benchmark: the graftwire data-plane A/B, exit-code-asserted.
+
+Three phases, one verdict (the fleet_bench discipline: numbers in the
+JSON, pass/fail in the return code). ISSUE-16 acceptance:
+
+1. **Fleet A/B** — the SAME request stream through three REAL fleets
+   (cli/fleet_main.py subprocesses, N=2, hedging armed against an
+   injected straggler delay), one per ``--transport`` mode. Every run
+   must exit 0, start WARM (``compiles == 0`` per worker — the data
+   plane must not perturb the AOT story), and serve EVERY request
+   BIT-IDENTICAL to the single-engine in-process reference — hedge
+   winners included (``router.hedge_fired >= 1`` is gated so the
+   first-answer-wins path is provably exercised on every wire). The
+   byte accounting (``transport.bytes_out/bytes_in``) must land in the
+   JSONL and every ``trace.transport`` span must be tagged with the
+   wire it actually rode (``wire=json|binary|shm`` — a silent fallback
+   fails the run).
+2. **One worker, three wires** — ONE in-process WorkerServer built
+   with ``transport="shm"`` serves a JSON router, a binary router, and
+   an shm router IN TURN (capability, not configuration — the mixed
+   fleet story), over a lens-enabled multi-quantile engine: quantile
+   VECTORS, attribution rows, and what-if counterfactuals must come
+   back STRUCT-BIT-IDENTICAL across all three wires, with ZERO fresh
+   compiles after the first (burn-in) round — the codec must never
+   perturb shapes.
+3. **Null-worker latency** — the wire cost ISOLATED: a worker whose
+   queue resolves instantly, so ``trace.transport`` span durations
+   measure serialization + transport, not compute. Gates:
+   ``binary p50 < json p50`` (the codec beats json.dumps/loads) and
+   ``shm p99 < binary p99`` (the ring beats TCP where it hurts — the
+   tail).
+
+CPU by default. One JSON line on stdout.
+
+    python benchmarks/wire_bench.py [--dryrun]
+
+``--dryrun`` is the CI wiring: smaller streams, same gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from concurrent.futures import Future
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from benchmarks.fleet_bench import (Check, build_reference,  # noqa: E402
+                                    check_bit_identical, check_warm,
+                                    common_flags, counters_in,
+                                    reference_preds, request_stream,
+                                    run_fleet)
+
+MODES = ("json", "binary", "shm")
+
+
+def straggler_plan() -> str:
+    """A seeded DELAY fault on a fraction of worker dispatches — the
+    hedging target (the tail_bench chaos, derated): with it armed and
+    --hedge_quantile_ms under the delay, every fleet run provably
+    exercises the hedge race ON ITS WIRE, and bit-identity then covers
+    hedge winners too."""
+    from pertgnn_tpu.testing.faults import FaultPlan, FaultSpec
+
+    return FaultPlan([FaultSpec(site="serve.dispatch", kind="delay",
+                                delay_s=0.3, p=0.1)],
+                     seed=99).to_json()
+
+
+def transport_spans(tele_dir: str, per_dispatch: bool = False,
+                    after: float = 0.0) -> dict[str, list[float]]:
+    """wire tag -> [dur_ms] over every ``trace.transport`` span in the
+    run's telemetry dir — the per-hop wire evidence phase 1 audits and
+    phase 3 measures. Every request row in a flight shares the flight's
+    (tm0, dur) stamp, so with ``per_dispatch`` the rows collapse to ONE
+    sample per wire round trip — percentiles then weight each dispatch
+    equally instead of multiplying the worst batch by its row count.
+    ``after`` drops spans whose monotonic tm0 predates it (same-process
+    clock: the caller's warmup cut)."""
+    from pertgnn_tpu.telemetry import load_events
+
+    spans: dict[str, list[float]] = {}
+    seen: set[tuple[str, float, float]] = set()
+    if not os.path.isdir(tele_dir):
+        return spans
+    for fname in os.listdir(tele_dir):
+        if not fname.endswith(".jsonl"):
+            continue
+        for ev in load_events(os.path.join(tele_dir, fname)):
+            if ev["kind"] == "span" and ev["name"] == "trace.transport":
+                wire = (ev.get("tags") or {}).get("wire", "<untagged>")
+                dur = float(ev["dur_ms"])
+                tm0 = float(ev.get("tm0", 0.0))
+                if tm0 < after:
+                    continue
+                if per_dispatch:
+                    key = (wire, tm0, dur)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                spans.setdefault(wire, []).append(dur)
+    return spans
+
+
+def pct(xs: list[float], q: float) -> float:
+    return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
+
+
+# -- phase 1: fleet A/B ----------------------------------------------------
+
+
+def phase_fleet(check: Check, tmp: str, args) -> dict:
+    ds, engine = build_reference(tmp)
+    n = 300 if args.dryrun else 1500
+    req_csv = os.path.join(tmp, "requests.csv")
+    entries, tsb = request_stream(ds, n, req_csv)
+    ref = reference_preds(engine, entries, tsb)
+
+    results: dict = {}
+    os.environ["PERTGNN_FAULT_PLAN"] = straggler_plan()
+    try:
+        for mode in MODES:
+            r = run_fleet(tmp, f"wire_{mode}", 2, req_csv,
+                          telemetry_level="trace",
+                          extra_flags=["--transport", mode,
+                                       "--hedge_quantile_ms", "120",
+                                       "--trace_sample_rate", "1.0"])
+            st = r["stats"]
+            check.expect(r["rc"] == 0,
+                         f"fleet[{mode}]: run exited {r['rc']}")
+            check_warm(check, f"fleet[{mode}]", st)
+            check_bit_identical(check, f"fleet[{mode}]", r["out_csv"],
+                                ref, require_all=True)
+            router = st.get("router", {})
+            check.expect(router.get("hedge_fired", 0) >= 1,
+                         f"fleet[{mode}]: no hedge ever fired — the "
+                         f"stragglers were injected; bit-identity did "
+                         f"not cover hedge winners on this wire")
+            tele = os.path.join(tmp, f"tele_wire_{mode}")
+            names = counters_in(tele)
+            for counter in ("transport.bytes_out", "transport.bytes_in"):
+                check.expect(counter in names,
+                             f"fleet[{mode}]: {counter} missing from "
+                             f"the JSONL — the byte A/B is dark")
+            spans = transport_spans(tele)
+            check.expect(set(spans) == {mode},
+                         f"fleet[{mode}]: trace.transport spans rode "
+                         f"{sorted(spans)} (want exactly ['{mode}'] — "
+                         f"a silent fallback or a missing wire tag)")
+            results[mode] = {
+                "served": st.get("served"),
+                "throughput_rps": st.get("throughput_rps"),
+                "hedge_fired": router.get("hedge_fired"),
+                "hedge_won": router.get("hedge_won"),
+                "transport_spans": sum(len(v) for v in spans.values()),
+            }
+    finally:
+        os.environ.pop("PERTGNN_FAULT_PLAN", None)
+    return results
+
+
+# -- phase 2: one worker, three wires --------------------------------------
+
+
+def build_lens_stack():
+    """A small lens-enabled multi-quantile serving stack — the traffic
+    shapes (vectors, attribution JSON, what-if edits) that stress every
+    section of the codec. Deterministic seeded init (no training): the
+    gate is cross-wire bit-identity, not model quality."""
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                    LensConfig, ModelConfig, ServeConfig)
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.train.loop import restore_target_state
+
+    corpus = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=40, num_entries=8, patterns_per_entry=2,
+        pattern_size_range=(3, 16), traces_per_entry=30, seed=7))
+    cfg = Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(batch_size=32),
+        model=ModelConfig(hidden_channels=24, num_layers=2,
+                          quantile_taus=(0.5, 0.9),
+                          local_loss_weight=0.1),
+        serve=ServeConfig(bucket_growth=2.0, max_graphs_per_batch=8),
+        lens=LensConfig(lens_local=True),
+        graph_type="pert")
+    pre = preprocess(corpus.spans, corpus.resources, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+    _model, state = restore_target_state(ds, cfg)
+    engine = InferenceEngine.from_dataset(
+        ds, cfg, state,
+        lens_names=(pre.ms_vocab, pre.interface_vocab)).warmup()
+    return ds, cfg, engine
+
+
+def serve_round(ds, cfg, server_url: str, capacity, mode: str,
+                rows) -> dict:
+    """One router (transport=mode) against THE shared worker: plain
+    multi-quantile, attribution, and what-if traffic; returns the raw
+    results for cross-wire comparison."""
+    from pertgnn_tpu.config import FleetConfig
+    from pertgnn_tpu.fleet.router import FleetRouter
+    from pertgnn_tpu.lens.request import LensRequest
+
+    def size(eid):
+        m = ds.mixtures[int(eid)]
+        return m.num_nodes, m.num_edges
+
+    out = {"plain": [], "attr": [], "whatif": []}
+    with FleetRouter({"w1": server_url}, size, capacity,
+                     cfg=FleetConfig(transport=mode,
+                                     health_poll_interval_s=0.2)
+                     ) as router:
+        plain = [router.submit(int(e), int(t)) for e, t in rows]
+        lens_f = [router.submit(int(e), int(t),
+                                lens=LensRequest(attribute_k=3))
+                  for e, t in rows]
+        whatif = [router.submit(
+            int(e), int(t),
+            lens=LensRequest(edits=({"op": "drop_edge", "edge": 0},)))
+            for e, t in rows if ds.mixtures[int(e)].num_edges > 0]
+        out["plain"] = [np.asarray(f.result(300)) for f in plain]
+        for f in lens_f:
+            res = f.result(300)
+            out["attr"].append((np.asarray(res.pred),
+                                tuple(res.attribution)))
+        out["whatif"] = [np.asarray(f.result(300)) for f in whatif]
+    return out
+
+
+def phase_inproc(check: Check, args) -> dict:
+    from pertgnn_tpu.fleet.transport import WorkerServer
+    from pertgnn_tpu.serve.buckets import make_bucket_ladder
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+    ds, cfg, engine = build_lens_stack()
+    s = ds.splits["test"]
+    n = min(12 if args.dryrun else 48, len(s.entry_ids))
+    rows = list(zip(s.entry_ids[:n], s.ts_buckets[:n]))
+    top = make_bucket_ladder(ds.budget, cfg.serve)[-1]
+    capacity = (top.max_graphs, top.max_nodes, top.max_edges)
+
+    queue = MicrobatchQueue(engine)
+    # ONE worker, built shm-capable, serving all three wires in turn:
+    # capability, not configuration
+    server = WorkerServer(engine, queue, transport="shm")
+    rounds: dict[str, dict] = {}
+    try:
+        url = f"http://127.0.0.1:{server.port}"
+        rounds["json"] = serve_round(ds, cfg, url, capacity, "json",
+                                     rows)
+        # burn-in complete: the json round paid any lazy lens-variant
+        # compiles; the other wires must add ZERO
+        compiles0 = engine.compiles
+        rounds["binary"] = serve_round(ds, cfg, url, capacity, "binary",
+                                       rows)
+        rounds["shm"] = serve_round(ds, cfg, url, capacity, "shm", rows)
+        check.expect(engine.compiles == compiles0,
+                     f"inproc: serving binary+shm compiled "
+                     f"{engine.compiles - compiles0} fresh rung(s) — "
+                     f"the wire perturbed shapes")
+    finally:
+        queue.close()
+        server.close()
+
+    base = rounds["json"]
+    for mode in ("binary", "shm"):
+        got = rounds[mode]
+        bad = sum(not np.array_equal(a, b)
+                  for a, b in zip(base["plain"], got["plain"]))
+        check.expect(bad == 0,
+                     f"inproc[{mode}]: {bad}/{len(rows)} quantile "
+                     f"vector(s) differ from the json wire")
+        bad = sum(not (np.array_equal(a[0], b[0]) and a[1] == b[1])
+                  for a, b in zip(base["attr"], got["attr"]))
+        check.expect(bad == 0,
+                     f"inproc[{mode}]: {bad}/{len(rows)} attribution "
+                     f"result(s) differ from the json wire")
+        bad = sum(not np.array_equal(a, b)
+                  for a, b in zip(base["whatif"], got["whatif"]))
+        check.expect(bad == 0,
+                     f"inproc[{mode}]: {bad} what-if prediction(s) "
+                     f"differ from the json wire")
+    return {"rows": n, "whatif_rows": len(base["whatif"]),
+            "attr_rows": len(base["attr"])}
+
+
+# -- phase 3: null-worker latency ------------------------------------------
+
+
+class _NullEngine:
+    """The minimum surface WorkerServer + probe_payload need, with
+    instant answers — so trace.transport spans time the WIRE."""
+
+    @property
+    def bus(self):
+        from pertgnn_tpu import telemetry
+        return telemetry.get_bus()
+
+    def health(self) -> dict:
+        return {"healthy": True, "reason": None, "warmed": True,
+                "executables": 0, "buckets": 0, "rebuilds": 0,
+                "nan_outputs": 0}
+
+
+class _NullQueue:
+    """Resolves every submit instantly with a PRECOMPUTED f32-exact
+    quantile vector — the traffic shape the codec was built for (raw
+    IEEE-754 on the binary wire vs 17-significant-digit decimal strings
+    on json), with zero per-call compute polluting the timing."""
+
+    draining = False
+
+    def __init__(self, width: int = 24):
+        self._vecs = [[float(np.float32(0.1 + 0.07 * j + r))
+                       for j in range(width)] for r in range(13)]
+
+    def probe_dict(self) -> dict:
+        return {"depth": 0, "inflight": 0, "errors": {}}
+
+    def submit(self, eid, tsb, trace=None, slo=None, downgrade=False,
+               lens=None) -> Future:
+        fut: Future = Future()
+        fut.set_result(self._vecs[int(eid) % 13])
+        return fut
+
+
+def latency_round(tmp: str, mode: str, rnd: int,
+                  n: int) -> tuple[str, float]:
+    """One mode's null-worker traffic round under a REAL trace-level
+    bus (sample rate 1.0); returns the telemetry dir holding its
+    trace.transport spans and the monotonic stamp measurement began
+    at. One full warmup wave runs BEFORE that stamp (negotiation,
+    ring attach, connection pool fill, first-call code paths), and the
+    cyclic GC is parked for the measured waves — a collection pause
+    with jax loaded is multi-ms and would land on whichever transport
+    happened to be running."""
+    import gc
+
+    from pertgnn_tpu import telemetry
+    from pertgnn_tpu.config import FleetConfig
+    from pertgnn_tpu.fleet.router import FleetRouter
+    from pertgnn_tpu.fleet.transport import WorkerServer
+
+    tele = os.path.join(tmp, f"tele_null_{mode}_r{rnd}")
+    telemetry.configure(tele, level="trace", trace_sample_rate=1.0,
+                        jax_monitoring=False)
+    server = WorkerServer(_NullEngine(), _NullQueue(), transport="shm")
+    wave = 128
+    try:
+        with FleetRouter({"w1": f"http://127.0.0.1:{server.port}"},
+                         lambda eid: (1, 1), (32, 1 << 20, 1 << 20),
+                         cfg=FleetConfig(transport=mode,
+                                         health_poll_interval_s=5.0)
+                         ) as router:
+            for f in [router.submit(i, i % 7) for i in range(wave)]:
+                f.result(60)                  # warmup, excluded below
+            gc.collect()
+            gc.disable()
+            t_meas = time.monotonic()
+            try:
+                for lo in range(0, n, wave):
+                    futs = [router.submit(i, i % 7)
+                            for i in range(lo, min(lo + wave, n))]
+                    for f in futs:
+                        f.result(60)
+            finally:
+                gc.enable()
+    finally:
+        server.close()
+        telemetry.shutdown()
+    return tele, t_meas
+
+
+def phase_latency(check: Check, tmp: str, args) -> dict:
+    n = 1600 if args.dryrun else 3200
+    pooled: dict[str, list[float]] = {m: [] for m in MODES}
+    # alternating rounds: host drift lands on every mode evenly
+    for rnd in range(6):
+        for mode in MODES:
+            tele, t_meas = latency_round(tmp, mode, rnd, n)
+            spans = transport_spans(tele, per_dispatch=True,
+                                    after=t_meas)
+            check.expect(set(spans) == {mode},
+                         f"latency[{mode}] r{rnd}: spans rode "
+                         f"{sorted(spans)} (want exactly ['{mode}'])")
+            pooled[mode].extend(spans.get(mode, []))
+    for mode in MODES:
+        check.expect(len(pooled[mode]) >= n // 32,
+                     f"latency[{mode}]: only {len(pooled[mode])} "
+                     f"transport dispatches collected")
+    stats = {m: {"spans": len(v), "p50_ms": round(pct(v, 50), 4),
+                 "p99_ms": round(pct(v, 99), 4)}
+             for m, v in pooled.items()}
+    check.expect(stats["binary"]["p50_ms"] < stats["json"]["p50_ms"],
+                 f"latency: binary p50 {stats['binary']['p50_ms']}ms "
+                 f"not under json p50 {stats['json']['p50_ms']}ms — "
+                 f"the codec lost to json.dumps/loads")
+    check.expect(stats["shm"]["p99_ms"] < stats["binary"]["p99_ms"],
+                 f"latency: shm p99 {stats['shm']['p99_ms']}ms not "
+                 f"under binary p99 {stats['binary']['p99_ms']}ms — "
+                 f"the ring lost to TCP at the tail")
+    return stats
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dryrun", action="store_true",
+                   help="CI mode: smaller streams, same gates")
+    p.add_argument("--skip_fleet", action="store_true",
+                   help="skip the subprocess fleet A/B phase")
+    p.add_argument("--skip_inproc", action="store_true",
+                   help="skip the one-worker-three-wires phase")
+    p.add_argument("--skip_latency", action="store_true",
+                   help="skip the null-worker latency phase")
+    args = p.parse_args(argv)
+
+    check = Check()
+    t0 = time.perf_counter()
+    tmp = tempfile.mkdtemp(prefix="wire_bench_")
+    results: dict = {"tmp": tmp}
+
+    if not args.skip_fleet:
+        results["fleet"] = phase_fleet(check, tmp, args)
+    if not args.skip_inproc:
+        results["inproc"] = phase_inproc(check, args)
+    if not args.skip_latency:
+        results["latency"] = phase_latency(check, tmp, args)
+
+    print(json.dumps({
+        "metric": "wire_invariants_ok",
+        "value": int(not check.failures),
+        "unit": "bool",
+        "dryrun": args.dryrun,
+        "results": results,
+        "violations": check.failures,
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "captured_unix_time": time.time(),
+    }))
+    return 1 if check.failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

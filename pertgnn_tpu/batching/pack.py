@@ -18,6 +18,7 @@ segment pooling needs no special cases.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Iterator, NamedTuple
 
 import numpy as np
@@ -83,17 +84,137 @@ EDGE_FIELDS = ("senders", "receivers", "edge_iface", "edge_rpctype",
                "edge_duration", "edge_mask")
 
 
-def receiver_sort_edges(arrays: dict, sentinel: int) -> dict:
+def receiver_sort_edges(arrays: dict, sentinel: int,
+                        scratch: dict | None = None) -> dict:
     """Reorder all per-edge arrays by receiver, masked (pad) edges last —
     the PackedBatch edge-order invariant. `sentinel` is the sort key for
     masked edges (any value > the largest real node id). Shared by
     pack_examples.flush and parallel.data_parallel.stack_batches so the
-    edge-field list can't drift between them."""
+    edge-field list can't drift between them.
+
+    `scratch` (arena hot path): a dict of same-shape/dtype per-edge
+    arrays to gather INTO (``np.take(..., out=)``) instead of fancy-
+    index allocating fresh ones; the gathered array and the scratch
+    swap roles in place, so over repeated batches the two buffers
+    ping-pong and the sort allocates nothing."""
     key = np.where(arrays["edge_mask"], arrays["receivers"], sentinel)
     order = np.argsort(key, kind="stable")
     for field in EDGE_FIELDS:
-        arrays[field] = arrays[field][order]
+        if scratch is None:
+            arrays[field] = arrays[field][order]
+        else:
+            np.take(arrays[field], order, out=scratch[field])
+            arrays[field], scratch[field] = scratch[field], arrays[field]
     return arrays
+
+
+def _init_arrays(budget: BatchBudget, n_feat: int) -> dict:
+    """Freshly-initialised packing buffers for one budget shape — the
+    single source of truth for the empty-batch state. pack_examples
+    allocates through it per batch; PackArena allocates through it once
+    and RESETS leases back to exactly this state on reuse."""
+    G = budget.max_graphs + 1  # +1: reserved pad graph slot
+    return dict(
+        x=np.zeros((budget.max_nodes, n_feat), dtype=np.float32),
+        ms_id=np.zeros(budget.max_nodes, dtype=np.int32),
+        node_depth=np.zeros(budget.max_nodes, dtype=np.float32),
+        node_graph=np.full(budget.max_nodes, G - 1, dtype=np.int32),
+        node_mask=np.zeros(budget.max_nodes, dtype=bool),
+        pattern_prob=np.zeros(budget.max_nodes, dtype=np.float32),
+        pattern_size=np.ones(budget.max_nodes, dtype=np.float32),
+        senders=np.zeros(budget.max_edges, dtype=np.int32),
+        receivers=np.zeros(budget.max_edges, dtype=np.int32),
+        edge_iface=np.zeros(budget.max_edges, dtype=np.int32),
+        edge_rpctype=np.zeros(budget.max_edges, dtype=np.int32),
+        edge_duration=np.zeros(budget.max_edges, dtype=np.float32),
+        edge_mask=np.zeros(budget.max_edges, dtype=bool),
+        entry_id=np.zeros(G, dtype=np.int32),
+        y=np.zeros(G, dtype=np.float32),
+        graph_mask=np.zeros(G, dtype=bool),
+    )
+
+
+class ArenaLease:
+    """Custody token for one set of arena buffers. Whoever holds the
+    lease may write `arrays` (and hand them to pack_examples via
+    ``into=``); calling `release()` returns the buffers to the pool for
+    the NEXT microbatch to overwrite — so release only after every
+    consumer of the packed arrays is done with them (the serving engine
+    releases at complete_microbatch, AFTER np.asarray has forced the
+    device computation; lens batches never release because attribution
+    reads the host arrays later)."""
+
+    __slots__ = ("arrays", "scratch", "_arena")
+
+    def __init__(self, arrays: dict, scratch: dict, arena: "PackArena"):
+        self.arrays = arrays
+        self.scratch = scratch
+        self._arena = arena
+
+    def release(self) -> None:
+        self._arena._release(self)
+
+
+class PackArena:
+    """Reusable packing-buffer pool for ONE budget shape.
+
+    The serving hot path packs every microbatch into freshly-allocated
+    numpy arrays (~a few MB per batch at serving budgets) that live just
+    long enough to be device-put — pure allocator churn. The arena keeps
+    a small pool (depth 2 covers pack-on-queue-thread overlapping
+    complete-on-dispatch-thread) of buffer sets and hands them out as
+    leases; `acquire` resets a reused lease to the exact `_init_arrays`
+    state so packed output is bit-identical to the fresh-allocation
+    path.
+
+    Thread-safety: acquire and release happen on DIFFERENT threads (the
+    queue worker packs, the dispatcher completes), hence the lock; it
+    guards only the free-list, never any blocking work."""
+
+    def __init__(self, budget: BatchBudget, n_feat: int, depth: int = 2):
+        self._budget = budget
+        self._n_feat = n_feat
+        self._depth = depth
+        self._lock = threading.Lock()
+        self._free: list[ArenaLease] = []
+
+    def _new_lease(self) -> ArenaLease:
+        arrays = _init_arrays(self._budget, self._n_feat)
+        scratch = {f: np.empty_like(arrays[f]) for f in EDGE_FIELDS}
+        return ArenaLease(arrays, scratch, self)
+
+    def _reset(self, lease: ArenaLease) -> None:
+        a = lease.arrays
+        G = self._budget.max_graphs + 1
+        for field in ("x", "ms_id", "node_depth", "pattern_prob",
+                      "senders", "receivers", "edge_iface",
+                      "edge_rpctype", "edge_duration", "entry_id", "y"):
+            a[field].fill(0)
+        a["node_graph"].fill(G - 1)
+        a["pattern_size"].fill(1.0)
+        for field in ("node_mask", "edge_mask", "graph_mask"):
+            a[field].fill(False)
+
+    def acquire(self) -> ArenaLease:
+        with self._lock:
+            lease = self._free.pop() if self._free else None
+        bus = telemetry.get_bus()
+        if lease is None:
+            lease = self._new_lease()
+            if bus.enabled:
+                bus.counter("pack.arena_alloc", level=2)
+        else:
+            self._reset(lease)
+            if bus.enabled:
+                bus.counter("pack.arena_reuse", level=2)
+        return lease
+
+    def _release(self, lease: ArenaLease) -> None:
+        with self._lock:
+            if len(self._free) < self._depth:
+                self._free.append(lease)
+            # beyond depth the lease is simply dropped (GC'd): a burst
+            # that outran the pool shrinks back to steady state
 
 
 def zero_masked(b: PackedBatch) -> PackedBatch:
@@ -145,6 +266,7 @@ def pack_single(
     ys: np.ndarray | None = None,
     node_depth_in_x: bool = False,
     mixture_of: "list[Mixture] | None" = None,
+    into: ArenaLease | None = None,
 ) -> PackedBatch:
     """Pack the given examples into exactly ONE budget-shaped batch.
 
@@ -163,6 +285,11 @@ def pack_single(
     with `entry_ids`; the entry_id slot keeps the REAL id for the entry
     embedding) — the counterfactual serving path (pertgnn_tpu/lens/
     whatif.py) packs an edited topology under the request's own entry.
+
+    `into` (graftwire hot path): an ArenaLease whose buffers this batch
+    is packed into instead of freshly-allocated arrays — zero-alloc
+    steady state. The returned PackedBatch VIEWS the lease's arrays;
+    custody rules are on ArenaLease.release.
     """
     entry_ids = np.asarray(entry_ids)
     if len(entry_ids) == 0:
@@ -189,7 +316,7 @@ def pack_single(
                                      np.asarray(ts_buckets), ys, budget,
                                      lookup,
                                      node_depth_in_x=node_depth_in_x,
-                                     mixture_of=mixes))
+                                     mixture_of=mixes, into=into))
         # the fit pre-check above makes a second flush impossible
         (batch,) = batches
         return batch
@@ -204,6 +331,7 @@ def pack_examples(
     lookup: ResourceLookup,
     node_depth_in_x: bool = False,
     mixture_of: "list[Mixture] | None" = None,
+    into: ArenaLease | None = None,
 ) -> Iterator[PackedBatch]:
     """Greedily pack examples (in the given order) into fixed-shape batches.
 
@@ -211,32 +339,24 @@ def pack_examples(
     raises (size your budget with `derive_budget`). `mixture_of` (aligned
     per example) overrides the mixture looked up by entry id — the
     counterfactual serving path packs edited topologies through it.
+    `into` packs the FIRST batch into an arena lease's buffers (the
+    serving path always yields exactly one); any later batch falls back
+    to fresh allocation so epoch packing can pass a lease too.
     """
-    G = budget.max_graphs + 1  # +1: reserved pad graph slot
     n_feat = lookup.num_features + (1 if node_depth_in_x else 0)
 
-    def new_batch():
-        return dict(
-            x=np.zeros((budget.max_nodes, n_feat), dtype=np.float32),
-            ms_id=np.zeros(budget.max_nodes, dtype=np.int32),
-            node_depth=np.zeros(budget.max_nodes, dtype=np.float32),
-            node_graph=np.full(budget.max_nodes, G - 1, dtype=np.int32),
-            node_mask=np.zeros(budget.max_nodes, dtype=bool),
-            pattern_prob=np.zeros(budget.max_nodes, dtype=np.float32),
-            pattern_size=np.ones(budget.max_nodes, dtype=np.float32),
-            senders=np.zeros(budget.max_edges, dtype=np.int32),
-            receivers=np.zeros(budget.max_edges, dtype=np.int32),
-            edge_iface=np.zeros(budget.max_edges, dtype=np.int32),
-            edge_rpctype=np.zeros(budget.max_edges, dtype=np.int32),
-            edge_duration=np.zeros(budget.max_edges, dtype=np.float32),
-            edge_mask=np.zeros(budget.max_edges, dtype=bool),
-            entry_id=np.zeros(G, dtype=np.int32),
-            y=np.zeros(G, dtype=np.float32),
-            graph_mask=np.zeros(G, dtype=bool),
-        )
-
-    buf = new_batch()
+    # buffers are allocated lazily at the first example of each batch so
+    # the lease (one buffer set) can be consumed by the first batch only
+    buf: dict | None = None
+    lease_pending = into is not None
     g = n = e = 0
+
+    def next_buf():
+        nonlocal lease_pending
+        if lease_pending:
+            lease_pending = False
+            return into.arrays
+        return _init_arrays(budget, n_feat)
 
     def flush():
         nonlocal buf, g, n, e
@@ -248,8 +368,11 @@ def pack_examples(
         # aggregation is order-free, so this changes nothing for the XLA
         # path, and it lets the fused Pallas kernel skip its in-jit sort
         # (ops/pallas_attention.py assume_sorted).
-        batch = PackedBatch(**receiver_sort_edges(buf, budget.max_nodes))
-        buf = new_batch()
+        scratch = (into.scratch
+                   if into is not None and buf is into.arrays else None)
+        batch = PackedBatch(**receiver_sort_edges(buf, budget.max_nodes,
+                                                  scratch=scratch))
+        buf = None
         g = n = e = 0
         return batch
 
@@ -263,6 +386,8 @@ def pack_examples(
         if (g + 1 > budget.max_graphs or n + mix.num_nodes > budget.max_nodes
                 or e + mix.num_edges > budget.max_edges):
             yield flush()
+        if buf is None:
+            buf = next_buf()
         ns = slice(n, n + mix.num_nodes)
         es = slice(e, e + mix.num_edges)
         feats = lookup(np.full(mix.num_nodes, bucket, dtype=np.int64),

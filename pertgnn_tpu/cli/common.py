@@ -345,6 +345,19 @@ def add_serve_flags(p: argparse.ArgumentParser) -> None:
                         "int8 weights dequantized in-graph); quality "
                         "exit-code-gated by benchmarks/serve_bench.py "
                         "(docs/GUIDE.md)")
+    p.add_argument("--transport", choices=("json", "binary", "shm"),
+                   default=FleetConfig.transport,
+                   help="router<->worker wire (fleet/wire.py, "
+                        "docs/GUIDE.md §14): json = the legacy "
+                        "JSON-over-HTTP wire (default, byte-identical "
+                        "behavior), binary = the versioned graftwire "
+                        "frame codec over pooled HTTP, shm = binary "
+                        "frames over same-host shared-memory rings "
+                        "(negotiated at probe time; skewed/cross-host "
+                        "workers degrade loudly to HTTP — counter "
+                        "transport.fallback); predictions are "
+                        "bit-identical across all three "
+                        "(benchmarks/wire_bench.py exit-asserts it)")
 
 
 def add_lens_flags(p: argparse.ArgumentParser) -> None:
@@ -482,6 +495,15 @@ def add_fleet_flags(p: argparse.ArgumentParser) -> None:
                    default=FleetConfig.autoscale_cooldown_s,
                    help="seconds of calm before the newest spare "
                         "retires")
+    p.add_argument("--shm_ring_slots", type=int,
+                   default=FleetConfig.shm_ring_slots,
+                   help="slots per shared-memory ring direction "
+                        "(--transport shm; fleet/shmring.py)")
+    p.add_argument("--shm_slot_bytes", type=int,
+                   default=FleetConfig.shm_slot_bytes,
+                   help="payload budget per ring slot; an oversize "
+                        "frame falls back to HTTP for that call "
+                        "(counter transport.fallback)")
 
 
 def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
@@ -530,7 +552,12 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         autoscale_hold_s=getattr(args, "autoscale_hold_s",
                                  FleetConfig.autoscale_hold_s),
         autoscale_cooldown_s=getattr(args, "autoscale_cooldown_s",
-                                     FleetConfig.autoscale_cooldown_s))
+                                     FleetConfig.autoscale_cooldown_s),
+        transport=getattr(args, "transport", FleetConfig.transport),
+        shm_ring_slots=getattr(args, "shm_ring_slots",
+                               FleetConfig.shm_ring_slots),
+        shm_slot_bytes=getattr(args, "shm_slot_bytes",
+                               FleetConfig.shm_slot_bytes))
 
 
 def add_aot_flags(p: argparse.ArgumentParser) -> None:

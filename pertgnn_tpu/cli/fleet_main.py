@@ -253,7 +253,10 @@ def _run_worker(args, p: argparse.ArgumentParser) -> None:
                 "checkpoint_epoch": ckpt_epoch}
 
     server = WorkerServer(engine, queue, port=args.worker_port,
-                          extra_fn=extra)
+                          extra_fn=extra,
+                          transport=cfg.fleet.transport,
+                          shm_ring_slots=cfg.fleet.shm_ring_slots,
+                          shm_slot_bytes=cfg.fleet.shm_slot_bytes)
 
     def _on_term(signum, frame):
         stop.set()

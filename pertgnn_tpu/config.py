@@ -526,6 +526,25 @@ class FleetConfig:
     # Seconds the down-signal must hold before a spare retires (spares
     # are cheap to keep and expensive to thrash).
     autoscale_cooldown_s: float = 10.0
+    # --- graftwire data plane (fleet/wire.py, fleet/shmring.py) ---
+    # Router->worker wire: "json" (the legacy JSON-over-HTTP wire,
+    # byte-identical default), "binary" (the versioned graftwire frame
+    # codec over pooled HTTP — bit-identity is structural, raw IEEE-754
+    # on the wire), or "shm" (binary frames over same-host shared-
+    # memory SPSC rings with an eventfd-style doorbell; negotiated at
+    # probe time, degrading LOUDLY to HTTP — counter transport.fallback
+    # — for version-skewed or cross-host workers). docs/GUIDE.md §14.
+    transport: str = "json"
+    # Slots per shm ring direction (per worker). The router's serial
+    # per-sender call protocol needs only a few; extra slots absorb
+    # abandoned-deadline responses without stalling the service thread.
+    shm_ring_slots: int = 8
+    # Slot payload budget (bytes) per ring slot. A frame larger than
+    # one slot falls back to HTTP for that call (transport.fallback
+    # reason=oversize); size it to the largest microbatch frame —
+    # request frames are ~16B/request, response frames ~8B/request
+    # plus lens attribution JSON.
+    shm_slot_bytes: int = 65536
 
 
 @dataclasses.dataclass(frozen=True)

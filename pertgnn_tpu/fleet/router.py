@@ -104,7 +104,8 @@ from pertgnn_tpu.config import FleetConfig
 from pertgnn_tpu.fleet import policy, shield
 from pertgnn_tpu.testing import schedules
 from pertgnn_tpu.telemetry.tracing import new_span_id
-from pertgnn_tpu.fleet.transport import (WorkerTransportError,
+from pertgnn_tpu.fleet.transport import (FleetTransport,
+                                         WorkerTransportError,
                                          error_from_row, get_probe,
                                          post_predict, result_from_row)
 from pertgnn_tpu.serve.errors import (DeadlineExceeded, QueueClosed,
@@ -223,9 +224,20 @@ class FleetRouter:
     def __init__(self, workers: dict[str, str], request_size,
                  capacity: tuple[int, int, int],
                  cfg: FleetConfig | None = None, bus=None,
-                 transport_post=post_predict, transport_probe=get_probe):
+                 transport_post=None, transport_probe=get_probe):
         self._cfg = cfg = cfg or FleetConfig()
         self._injected_bus = bus
+        # the data plane: None (the default) builds the graftwire
+        # FleetTransport for cfg.transport — json mode reproduces the
+        # legacy wire bytes over pooled connections; tests that inject
+        # a transport_post callable (the historical post_predict
+        # signature) bypass it entirely and nothing changes for them
+        self._transport = None
+        if transport_post is None:
+            self._transport = FleetTransport(mode=cfg.transport,
+                                             probe=transport_probe,
+                                             bus=bus)
+            transport_post = self._transport.post
         self._post = transport_post
         self._probe = transport_probe
         self._request_size = request_size
@@ -543,6 +555,8 @@ class FleetRouter:
             self._wake.notify_all()
         log.info("router: worker %s removed (%d members, %d request(s) "
                  "moved back)", worker_id, members, len(recovered))
+        if self._transport is not None:
+            self._transport.forget(w.base_url)
         self.bus.counter("router.worker_removed", worker=worker_id)
         if recovered:
             self.bus.counter("router.requeue", len(recovered),
@@ -579,6 +593,10 @@ class FleetRouter:
             self._resolve_error(r, QueueClosed(
                 "router closed before this request could be dispatched "
                 "(no live worker took it)"))
+        if self._transport is not None:
+            # after the sender joins above: no thread still owns a
+            # pooled connection or an attached ring
+            self._transport.close()
 
     def __enter__(self):
         return self
@@ -976,6 +994,11 @@ class FleetRouter:
         self.bus.histogram("router.batch_ms", dt * 1e3, level=2,
                            worker=w.worker_id, graphs=len(batch))
         hedged = flight.hedge_id is not None
+        # which wire THIS leg actually travelled (json/binary/shm) — the
+        # transport records it per endpoint after every post, so hedge
+        # legs to a differently-negotiated worker tag truthfully
+        wire_used = (self._transport.wire_for(w.base_url)
+                     if self._transport is not None else "json")
         if not won:
             # the losing leg of a hedge race: futures are already
             # resolved (bit-identical predictions make the race safe);
@@ -985,7 +1008,8 @@ class FleetRouter:
                     self.bus.trace_span("trace.transport", r.trace,
                                         tm0, tm1, span_id=sid,
                                         worker=w.worker_id,
-                                        outcome="hedge_lost", role=role)
+                                        outcome="hedge_lost", role=role,
+                                        wire=wire_used)
             return
         if won and role == "hedge":
             self.bus.counter("router.hedge_won", worker=w.worker_id,
@@ -1017,7 +1041,8 @@ class FleetRouter:
                 continue
             outcome = ("retry" if id(r) in retry_set
                        else "ok" if "pred" in row else "error")
-            tags = {"worker": w.worker_id, "outcome": outcome}
+            tags = {"worker": w.worker_id, "outcome": outcome,
+                    "wire": wire_used}
             if hedged:
                 tags["hedged"] = True
                 tags["hedge_won"] = role == "hedge"
@@ -1071,12 +1096,14 @@ class FleetRouter:
         Requests over their requeue budget fail with the transport
         error instead of looping forever."""
         tm1 = time.monotonic()
+        wire_used = (self._transport.wire_for(w.base_url)
+                     if self._transport is not None else "json")
         for r, sid in zip(flight.batch, sids):
             if r.trace is not None:
                 self.bus.trace_span("trace.transport", r.trace, tm0,
                                     tm1, span_id=sid,
                                     worker=w.worker_id, outcome="lost",
-                                    role=role)
+                                    role=role, wire=wire_used)
         recovered: list[_Request] = []
         give_up: list[_Request] = []
         with self._wake:
@@ -1124,6 +1151,8 @@ class FleetRouter:
             self.worker_lost += 1
             members = sum(x.healthy for x in self._workers.values())
             self._wake.notify_all()
+        if self._transport is not None:
+            self._transport.forget(w.base_url)
         log.error("router: worker %s lost (%s); requeued %d request(s), "
                   "%d member(s) remain", w.worker_id, exc, len(keep),
                   members)
@@ -1172,6 +1201,12 @@ class FleetRouter:
                 self._wake.notify_all()
         if event is None:
             return
+        if self._transport is not None:
+            # a lost/recovered transition invalidates the negotiated
+            # wire: the replacement process on the same port may speak
+            # a different protocol (version skew during rolling
+            # restarts), so re-probe before the next post
+            self._transport.forget(w.base_url)
         log.warning("router: worker %s %s via probe (%d/%d members)",
                     w.worker_id, event, members, len(self._workers))
         # literal names, not f"router.worker_{event}": the telemetry

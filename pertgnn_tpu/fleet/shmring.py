@@ -1,0 +1,444 @@
+"""graftwire shared-memory transport — SPSC rings for same-host hops.
+
+The binary codec (fleet/wire.py) removes the serialize cost; this
+module removes the kernel round trips: one ``multiprocessing.shared_
+memory`` ring per direction between the router's per-worker sender
+thread and the worker's ring service thread. Single-producer/
+single-consumer holds BY CONSTRUCTION (the router has exactly one
+sender thread per worker — hedge legs ride the hedge target's own
+sender — and the worker runs exactly one ring service thread), so the
+ring needs no locks, only ordering:
+
+- every slot is ``seq u64 | len u32 | payload``; the producer writes
+  payload then length, and stamps the sequence number LAST — the
+  sequence stamp IS the commit counter, so a crashed producer can
+  never publish a half-written slot;
+- the consumer reads the stamp, copies the payload out, and RE-READS
+  the stamp: a mismatch is a torn write (:class:`RingTornWrite`) and
+  the peer is treated as gone, never trusted;
+- backpressure is structural: the producer may claim slot ``seq`` only
+  while ``seq - consumed <= slots`` (the consumer still owns the
+  oldest slot otherwise), so a dead reader fills the ring and the
+  writer's bounded wait times out instead of overwriting.
+
+Wakeup is an eventfd-style DOORBELL, not a spin: a localhost TCP pair
+(the stdlib's portable socketpair-across-processes) carries one-byte
+tokens after every push, and both sides wait in ``select`` with
+bounded timeouts feeding the router's existing watchdog/hedge
+machinery. The doorbell doubles as the liveness signal — a SIGKILLed
+peer resets it, which surfaces as :class:`RingPeerDead` and maps to
+the transport's lost-worker path (every Future still resolves).
+
+TRUST boundary (docs/GUIDE.md §14): the segments are same-host,
+same-user only — names travel in the worker's probe body, payloads
+are graftwire frames (ints/floats/UTF-8 JSON), and nothing on either
+side ever unpickles a byte of shared memory.
+
+graftsync's ring-protocol pass statically checks the commit-counter
+ordering against the ``_payload_write``/``_seq_write`` /
+``_seq_read``/``_payload_read`` helpers below — keep the names.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import select
+import socket
+import struct
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+_HDR = struct.Struct("<IIII")            # magic, version, slots, slot_bytes
+_MAGIC = 0x47575231                      # "GWR1"
+RING_VERSION = 1
+_CTR = struct.Struct("<Q")               # produced / consumed counters
+_PRODUCED_OFF = _HDR.size                # 16
+_CONSUMED_OFF = _HDR.size + 8            # 24
+_DATA_OFF = _HDR.size + 16               # 32
+_SEQ = struct.Struct("<Q")               # per-slot commit stamp
+_LEN = struct.Struct("<I")
+_SLOT_HDR = _SEQ.size + _LEN.size        # 12
+_CORR = struct.Struct("<Q")              # per-call correlation prefix
+
+
+class RingError(RuntimeError):
+    """Base of every ring failure the transport maps to its
+    lost-worker/fallback machinery."""
+
+
+class RingPeerDead(RingError):
+    """The doorbell reset or closed: the peer process is gone."""
+
+
+class RingTimeout(RingError):
+    """A bounded ring wait expired (full ring with a dead reader, or
+    no response within the dispatch timeout)."""
+
+
+class RingTornWrite(RingError):
+    """A slot's commit stamp changed across the payload copy, or a
+    stamp from the future appeared — the ring's ordering contract is
+    broken and the peer cannot be trusted."""
+
+
+class RingFrameTooLarge(RingError):
+    """The frame exceeds the slot payload capacity; the transport
+    falls back to HTTP for this call (counter transport.fallback)."""
+
+
+def _untrack(name: str) -> None:
+    """Detach-side resource-tracker unregistration: before 3.13 the
+    tracker registers ATTACHED segments too and unlinks them when the
+    attaching process exits — which would tear the worker's live ring
+    down under it. The creator side keeps ownership and unlinks."""
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(f"/{name}", "shared_memory")
+    except Exception:  # lint: allow-silent-except
+        # best-effort: on interpreters that don't track attached
+        # segments there is nothing to unregister, and failing the
+        # ATTACH because a bookkeeping opt-out failed would be absurd
+        pass
+
+
+class ShmRing:
+    """One SPSC ring over a shared-memory segment. The same class
+    serves both roles; which cursor advances is decided by which of
+    ``try_push``/``try_pop`` the owner calls."""
+
+    def __init__(self, shm, slots: int, slot_bytes: int,
+                 owned: bool) -> None:
+        self._shm = shm
+        self._buf = shm.buf
+        self.slots = int(slots)
+        self.slot_bytes = int(slot_bytes)
+        self.payload_max = self.slot_bytes - _SLOT_HDR
+        self._owned = owned
+        self._produced = self._load_ctr(_PRODUCED_OFF)
+        self._consumed = self._load_ctr(_CONSUMED_OFF)
+
+    @classmethod
+    def create(cls, slots: int, slot_bytes: int) -> "ShmRing":
+        from multiprocessing import shared_memory
+
+        if slots < 2 or slot_bytes <= _SLOT_HDR:
+            raise RingError(f"ring needs >= 2 slots and "
+                            f"> {_SLOT_HDR}-byte slots "
+                            f"(got {slots} x {slot_bytes})")
+        size = _DATA_OFF + slots * slot_bytes
+        shm = shared_memory.SharedMemory(create=True, size=size)
+        shm.buf[:_DATA_OFF] = bytes(_DATA_OFF)
+        _HDR.pack_into(shm.buf, 0, _MAGIC, RING_VERSION, slots,
+                       slot_bytes)
+        return cls(shm, slots, slot_bytes, owned=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        from multiprocessing import shared_memory
+
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+        except (OSError, ValueError) as exc:
+            raise RingPeerDead(f"ring segment {name!r} gone: "
+                               f"{exc}") from exc
+        _untrack(shm.name)
+        if len(shm.buf) < _DATA_OFF:
+            shm.close()
+            raise RingError(f"ring segment {name!r} too small")
+        magic, version, slots, slot_bytes = _HDR.unpack_from(shm.buf)
+        if magic != _MAGIC or version != RING_VERSION:
+            shm.close()
+            raise RingError(
+                f"ring segment {name!r} version skew: magic "
+                f"0x{magic:08x} v{version}, this build speaks "
+                f"v{RING_VERSION}")
+        if len(shm.buf) < _DATA_OFF + slots * slot_bytes:
+            shm.close()
+            raise RingError(f"ring segment {name!r} truncated")
+        return cls(shm, slots, slot_bytes, owned=False)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    # -- raw slot access (ring-protocol pass checks the ordering) -----
+
+    def _slot_off(self, seq: int) -> int:
+        return _DATA_OFF + ((seq - 1) % self.slots) * self.slot_bytes
+
+    def _seq_write(self, off: int, seq: int) -> None:
+        _SEQ.pack_into(self._buf, off, seq)
+
+    def _seq_read(self, off: int) -> int:
+        return _SEQ.unpack_from(self._buf, off)[0]
+
+    def _payload_write(self, off: int, payload: bytes) -> None:
+        _LEN.pack_into(self._buf, off + _SEQ.size, len(payload))
+        start = off + _SLOT_HDR
+        self._buf[start:start + len(payload)] = payload
+
+    def _len_read(self, off: int) -> int:
+        return _LEN.unpack_from(self._buf, off + _SEQ.size)[0]
+
+    def _payload_read(self, off: int, n: int) -> bytes:
+        start = off + _SLOT_HDR
+        return bytes(self._buf[start:start + n])
+
+    def _load_ctr(self, ctr_off: int) -> int:
+        return _CTR.unpack_from(self._buf, ctr_off)[0]
+
+    def _store_ctr(self, ctr_off: int, value: int) -> None:
+        _CTR.pack_into(self._buf, ctr_off, value)
+
+    # -- the SPSC protocol --------------------------------------------
+
+    def try_push(self, payload: bytes) -> bool:
+        """Publish one frame, or False when the consumer still owns
+        the oldest slot (full-ring backpressure). Payload first,
+        sequence stamp LAST — the stamp is the commit."""
+        if len(payload) > self.payload_max:
+            raise RingFrameTooLarge(
+                f"{len(payload)}-byte frame > {self.payload_max}-byte "
+                f"slot payload (raise --shm_slot_bytes)")
+        seq = self._produced + 1
+        if seq - self._load_ctr(_CONSUMED_OFF) > self.slots:
+            return False
+        off = self._slot_off(seq)
+        self._payload_write(off, payload)
+        self._seq_write(off, seq)
+        self._produced = seq
+        self._store_ctr(_PRODUCED_OFF, seq)
+        return True
+
+    def try_pop(self) -> bytes | None:
+        """Consume one frame, or None when nothing is published.
+        Stamp, copy, RE-READ the stamp: a moved stamp means the
+        producer overwrote an unconsumed slot (torn write)."""
+        seq = self._consumed + 1
+        off = self._slot_off(seq)
+        got = self._seq_read(off)
+        if got != seq:
+            if got > seq:
+                raise RingTornWrite(
+                    f"slot stamp {got} from the future (expected "
+                    f"{seq}) — the producer overwrote an unconsumed "
+                    f"slot")
+            return None
+        n = self._len_read(off)
+        if n > self.payload_max:
+            raise RingTornWrite(f"slot {seq} declares {n} payload "
+                                f"bytes > {self.payload_max} capacity")
+        payload = self._payload_read(off, n)
+        if self._seq_read(off) != seq:
+            raise RingTornWrite(f"slot {seq} re-stamped mid-copy")
+        self._consumed = seq
+        self._store_ctr(_CONSUMED_OFF, seq)
+        return payload
+
+    def close(self) -> None:
+        self._buf = None
+        try:
+            self._shm.close()
+        except (OSError, BufferError):
+            pass
+        if self._owned:
+            try:
+                # re-register before unlink: when creator and attacher
+                # share a process (tests, benches), _untrack removed
+                # the CREATION registration too, and unlink's own
+                # unregister would spam the tracker with KeyErrors —
+                # registering is a set-add, so this is a no-op when
+                # the registration is still there
+                from multiprocessing import resource_tracker
+                resource_tracker.register(
+                    getattr(self._shm, "_name", f"/{self._shm.name}"),
+                    "shared_memory")
+            except Exception:  # lint: allow-silent-except
+                pass
+            try:
+                self._shm.unlink()
+            except (OSError, FileNotFoundError):
+                pass
+
+
+class RingServer:
+    """The worker side: owns a request ring + a response ring + the
+    doorbell listener, and services frames on one daemon thread (the
+    single consumer/producer). ``handle`` maps a request frame's
+    payload to a response payload; its failures are the CALLER's
+    contract (fleet/transport.py answers refusal frames)."""
+
+    def __init__(self, handle, slots: int, slot_bytes: int) -> None:
+        self._handle = handle
+        self._req = ShmRing.create(slots, slot_bytes)
+        self._rsp = ShmRing.create(slots, slot_bytes)
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(1)
+        self._sock.settimeout(0.25)
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        daemon=True,
+                                        name="graftwire-ring")
+        self._thread.start()
+
+    def advertisement(self) -> dict:
+        """What the probe body carries so the router can attach: the
+        segment names, the doorbell port, and the pid (same-host
+        evidence — the router refuses an advert it cannot attach)."""
+        return {"req": self._req.name, "rsp": self._rsp.name,
+                "bell_port": self._sock.getsockname()[1],
+                "slots": self._req.slots,
+                "slot_bytes": self._req.slot_bytes,
+                "pid": os.getpid()}
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed: shutdown
+            with conn:
+                self._serve_conn(conn)
+
+    def _serve_conn(self, conn) -> None:
+        """One attached router: drain the request ring on every bell
+        token (and on a bounded poll, belt over the bell), until the
+        peer hangs up or close() stops us."""
+        conn.settimeout(0.25)
+        # bell tokens must never sit in Nagle's buffer behind a
+        # delayed ACK — the doorbell IS the latency path
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while not self._stop.is_set():
+            try:
+                token = conn.recv(64)
+            except socket.timeout:
+                token = b"?"  # poll anyway: a token can be coalesced
+            except OSError:
+                return
+            else:
+                if not token:
+                    return  # peer closed: back to accept
+            if not self._drain(conn):
+                return
+
+    def _drain(self, conn) -> bool:
+        while True:
+            try:
+                frame = self._req.try_pop()
+            except RingError as exc:
+                log.error("ring service: request ring broken: %s", exc)
+                return False
+            if frame is None or len(frame) < _CORR.size:
+                return True
+            reply = frame[:_CORR.size] + self._handle(
+                bytes(frame[_CORR.size:]))
+            deadline = time.monotonic() + 5.0
+            while not self._rsp.try_push(reply):
+                # response ring full: the client stopped draining —
+                # bounded wait, then drop the peer (it re-probes)
+                if self._stop.is_set() or time.monotonic() > deadline:
+                    return False
+                time.sleep(0.0005)
+            try:
+                conn.sendall(b"!")
+            except OSError:
+                return False
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+        self._req.close()
+        self._rsp.close()
+
+
+class RingClient:
+    """The router side: attaches one worker's rings and drives the
+    serial call protocol from that worker's OWN sender thread (the
+    single producer/consumer — never share a client across threads)."""
+
+    def __init__(self, advert: dict, connect_timeout_s: float = 2.0):
+        self._req = ShmRing.attach(advert["req"])
+        self._rsp = None
+        self._bell = None
+        try:
+            self._rsp = ShmRing.attach(advert["rsp"])
+            self._bell = socket.create_connection(
+                ("127.0.0.1", int(advert["bell_port"])),
+                timeout=connect_timeout_s)
+            self._bell.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+        except RingError:
+            self.close()
+            raise
+        except OSError as exc:
+            self.close()
+            raise RingPeerDead(f"doorbell connect failed: "
+                               f"{exc}") from exc
+        self._corr = 0
+
+    def call(self, payload: bytes, timeout_s: float) -> bytes:
+        """One bounded round trip. Raises RingTimeout past the
+        deadline, RingPeerDead on a reset doorbell, RingTornWrite on a
+        broken slot — the transport maps all of them to the
+        lost-worker path, so every router Future still resolves."""
+        self._corr += 1
+        corr = _CORR.pack(self._corr)
+        deadline = time.monotonic() + timeout_s
+        while not self._req.try_push(corr + payload):
+            self._await_bell(deadline, "request ring full")
+        self._ring_bell()
+        while True:
+            got = self._rsp.try_pop()
+            if got is None:
+                self._await_bell(deadline, "awaiting the response")
+                continue
+            if got[:_CORR.size] == corr:
+                return bytes(got[_CORR.size:])
+            # a stale response to a call an earlier deadline abandoned
+            log.debug("ring client: dropped stale response")
+
+    def _ring_bell(self) -> None:
+        try:
+            self._bell.sendall(b"!")
+        except OSError as exc:
+            raise RingPeerDead(f"doorbell send failed: {exc}") from exc
+
+    def _await_bell(self, deadline: float, why: str) -> None:
+        """Bounded wait for the peer's token — select, never spin; EOF
+        and reset are the peer-death signal."""
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RingTimeout(f"ring call timed out ({why})")
+        try:
+            ready, _, _ = select.select([self._bell], [], [],
+                                        min(remaining, 0.25))
+        except (OSError, ValueError) as exc:
+            raise RingPeerDead(f"doorbell lost: {exc}") from exc
+        if ready:
+            try:
+                token = self._bell.recv(4096)
+            except OSError as exc:
+                raise RingPeerDead(f"doorbell reset: {exc}") from exc
+            if not token:
+                raise RingPeerDead("ring peer closed the doorbell")
+
+    def close(self) -> None:
+        if self._bell is not None:
+            try:
+                self._bell.close()
+            except OSError:
+                pass
+        self._req.close()
+        if self._rsp is not None:
+            self._rsp.close()

@@ -35,18 +35,30 @@ The ``fleet.worker`` fault-injection site fires per handled microbatch
 level, ``wedge`` stalls it into the router's dispatch timeout, and
 ``kill`` enacts ``os._exit(137)`` — the deterministic worker-death
 drill behind the chaos scenario in benchmarks/fleet_bench.py.
+
+Since ISSUE 16 the boring wire is the DEFAULT, not the ceiling: the
+graftwire data plane (fleet/wire.py + fleet/shmring.py, selected via
+``FleetConfig.transport``) layers a versioned binary frame codec and a
+same-host shared-memory ring transport over the SAME contract —
+:class:`FleetTransport` below negotiates per worker at probe time and
+degrades loudly to this file's JSON wire whenever the capability is
+missing, so every failure map above survives verbatim on every wire.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
 import os
+import socket
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from pertgnn_tpu.fleet import shmring, wire
 from pertgnn_tpu.lens.request import LensRequest, LensResult
 from pertgnn_tpu.serve import errors as serve_errors
 from pertgnn_tpu.serve.health import probe_payload
@@ -105,25 +117,63 @@ def error_from_row(row: dict) -> Exception:
 
 
 class WorkerServer:
-    """One serve worker's wire surface over its engine + queue."""
+    """One serve worker's wire surface over its engine + queue. Speaks
+    BOTH HTTP wires on /predict (JSON and the graftwire binary frame,
+    selected per request by Content-Type — capability, not
+    configuration, so a mixed fleet never hard-fails) and, when
+    constructed with ``transport="shm"``, additionally services a
+    shared-memory ring pair (fleet/shmring.py) advertised in the probe
+    body for the router to attach at negotiation time."""
 
-    def __init__(self, engine, queue, port: int = 0, extra_fn=None):
+    def __init__(self, engine, queue, port: int = 0, extra_fn=None,
+                 transport: str = "json", shm_ring_slots: int = 8,
+                 shm_slot_bytes: int = 65536):
         self._engine = engine
         self._queue = queue
         self._extra_fn = extra_fn
+        self._ring = None
+        if transport == "shm":
+            self._ring = shmring.RingServer(self._handle_frame,
+                                            shm_ring_slots,
+                                            shm_slot_bytes)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: the router's pooled per-worker
+            # connections (FleetTransport) reuse one TCP stream; 1.0
+            # would close after every reply and the A/B against
+            # binary/shm would be measuring TCP handshakes
+            protocol_version = "HTTP/1.1"
+            # Nagle + delayed ACK on a keep-alive stream turns every
+            # reply into a ~40ms stall; replies must leave NOW
+            disable_nagle_algorithm = True
+
             def do_GET(self):
                 ready, body = probe_payload(
                     outer._engine, outer._queue,
                     outer._extra_fn() if outer._extra_fn else None)
+                # transport negotiation rides the existing probe: the
+                # wire version always, the ring advert when one exists
+                body["wire_version"] = wire.WIRE_VERSION
+                if outer._ring is not None:
+                    body["shm"] = outer._ring.advertisement()
                 self._reply(200 if ready else 503, body)
 
             def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+                ctype = self.headers.get("Content-Type", "")
+                binary = ctype.startswith(wire.CONTENT_TYPE)
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(length))
+                    req = (wire.decode_request(raw) if binary
+                           else json.loads(raw))
+                except (wire.WireFormatError, ValueError) as exc:
+                    # typed refusal, never a crash: a skewed/corrupt
+                    # frame answers 400 and the client renegotiates
+                    self._reply(400, {"error": type(exc).__name__,
+                                      "message": str(exc)})
+                    return
+                try:
                     results = outer._predict(req["entries"],
                                              req["ts_buckets"],
                                              req.get("trace"),
@@ -145,12 +195,20 @@ class WorkerServer:
                     self._reply(500, {"error": type(exc).__name__,
                                       "message": str(exc)})
                     return
-                self._reply(200, {"results": results})
+                if binary:
+                    self._reply_raw(200, wire.encode_response(results),
+                                    wire.CONTENT_TYPE)
+                else:
+                    self._reply(200, {"results": results})
 
             def _reply(self, status: int, body: dict):
-                payload = json.dumps(body).encode()
+                self._reply_raw(status, json.dumps(body).encode(),
+                                "application/json")
+
+            def _reply_raw(self, status: int, payload: bytes,
+                           ctype: str):
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
@@ -162,6 +220,30 @@ class WorkerServer:
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True, name="fleet-worker")
         self._thread.start()
+
+    def _handle_frame(self, frame: bytes) -> bytes:
+        """The ring service callback: one request frame in, one
+        response/refusal frame out. Mirrors do_POST's failure map —
+        a decode failure or handler bug becomes a typed refusal frame
+        (the ring's 400/500), which the router-side transport raises
+        as WorkerTransportError; the ``kill`` fault fires inside
+        _predict exactly as it does for HTTP, so the worker-death
+        drill covers this wire too."""
+        try:
+            req = wire.decode_request(frame)
+        except wire.WireFormatError as exc:
+            return wire.encode_refusal(type(exc).__name__, str(exc))
+        try:
+            results = self._predict(req["entries"], req["ts_buckets"],
+                                    req.get("trace"), req.get("slo"),
+                                    req.get("dg"), req.get("lens"))
+        except faults.InjectedFault as exc:
+            log.warning("worker: injected ring failure: %s", exc)
+            return wire.encode_refusal("InjectedFault", str(exc))
+        except Exception as exc:
+            log.exception("worker: ring handler failed")
+            return wire.encode_refusal(type(exc).__name__, str(exc))
+        return wire.encode_response(results)
 
     @property
     def port(self) -> int:
@@ -229,6 +311,8 @@ class WorkerServer:
         return rows
 
     def close(self) -> None:
+        if self._ring is not None:
+            self._ring.close()
         self._server.shutdown()
         self._server.server_close()
 
@@ -299,3 +383,326 @@ def get_probe(base_url: str, timeout_s: float) -> tuple[int, dict]:
         raise WorkerTransportError(
             f"worker {base_url} probe failed: "
             f"{type(exc).__name__}: {exc}") from exc
+
+
+class FleetTransport:
+    """The graftwire dispatch client — one per router, ``post``-
+    signature-compatible with :func:`post_predict` so the router's
+    sender loops and every injected test transport stay untouched.
+
+    Mode selects the PREFERRED wire; what a given worker actually
+    speaks is negotiated once per URL off its probe body and degrades
+    LOUDLY (counter ``transport.fallback``), never silently:
+
+    - ``json`` — the legacy JSON body, now over a pooled persistent
+      HTTP/1.1 connection per (sender thread, worker) with
+      reconnect-on-error (counter ``transport.reconnects``) instead of
+      a fresh TCP handshake per POST;
+    - ``binary`` — graftwire frames (fleet/wire.py) over the same
+      pooled HTTP; a worker that does not advertise ``wire_version``
+      falls back to json;
+    - ``shm`` — frames over the worker's advertised shared-memory
+      rings (fleet/shmring.py); no advert / failed attach / oversize
+      frame falls back to binary HTTP (per-worker sticky or per-call,
+      by cause), and any ring failure mid-flight maps to
+      WorkerTransportError — the existing lost-worker path.
+
+    Thread custody mirrors the ring's SPSC contract: connections and
+    ring clients live in thread-local maps, so each router sender
+    thread owns its transport endpoints exclusively; the shared
+    negotiation cache is the only locked state and the lock never
+    covers a blocking call (graftsync lock-order proves it). Byte
+    accounting (``transport.bytes_out/bytes_in``, tagged
+    ``wire=json|binary|shm``) hangs the A/B evidence on every hop."""
+
+    def __init__(self, mode: str = "json", probe=get_probe, bus=None,
+                 connect_timeout_s: float = 2.0):
+        if mode not in ("json", "binary", "shm"):
+            raise ValueError(f"unknown transport mode {mode!r}")
+        self.mode = mode
+        self._probe = probe
+        self._injected_bus = bus
+        self._connect_timeout_s = connect_timeout_s
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._neg: dict[str, dict] = {}     # url -> negotiated state
+        self._gen: dict[str, int] = {}      # url -> forget() epoch
+        self._last_wire: dict[str, str] = {}
+        self._endpoints: list = []          # every conn/ring, for close
+
+    @property
+    def bus(self):
+        if self._injected_bus is not None:
+            return self._injected_bus
+        from pertgnn_tpu import telemetry
+        return telemetry.get_bus()
+
+    # -- negotiation ---------------------------------------------------
+
+    def _negotiate(self, base_url: str, timeout_s: float) -> dict:
+        """The per-URL wire decision, probed once and cached until
+        forget(). A probe transport failure raises — the caller's
+        lost-worker verdict — and leaves nothing cached."""
+        with self._lock:
+            st = self._neg.get(base_url)
+        if st is not None:
+            return st
+        status, body = self._probe(
+            base_url, max(self._connect_timeout_s, min(timeout_s, 5.0)))
+        st = {"wire": "json", "shm": None}
+        if body.get("wire_version") == wire.WIRE_VERSION:
+            st["wire"] = "binary"
+            if self.mode == "shm":
+                advert = body.get("shm")
+                if isinstance(advert, dict):
+                    st["shm"] = advert
+                else:
+                    self.bus.counter("transport.fallback", level=2,
+                                     wire="shm", reason="no_ring")
+        else:
+            # version skew (or a pre-graftwire worker): binary frames
+            # would be refused — degrade to the wire both sides speak
+            self.bus.counter("transport.fallback", level=2,
+                             wire=self.mode, reason="version")
+        with self._lock:
+            st = self._neg.setdefault(base_url, st)
+        return st
+
+    def wire_for(self, base_url: str) -> str:
+        """The wire the LAST dispatch to this worker actually rode —
+        the router stamps it on its transport spans so graftscope
+        attributes the win (and the fallback)."""
+        return self._last_wire.get(base_url, "json")
+
+    def forget(self, base_url: str) -> None:
+        """Membership hook: drop the URL's negotiated state so the next
+        dispatch renegotiates — a respawned worker advertises fresh
+        ring segment names, and a recovered one may have changed
+        capabilities. The router calls this on probe lost/recovered
+        transitions and on remove_worker."""
+        with self._lock:
+            self._neg.pop(base_url, None)
+            self._gen[base_url] = self._gen.get(base_url, 0) + 1
+
+    # -- per-thread endpoints ------------------------------------------
+
+    def _cache(self, name: str) -> dict:
+        cache = getattr(self._local, name, None)
+        if cache is None:
+            cache = {}
+            setattr(self._local, name, cache)
+        return cache
+
+    def _ring_for(self, base_url: str, st: dict, gen: int):
+        """This thread's ring client for the URL, attaching on first
+        use; None = fall back to HTTP (sticky until forget())."""
+        rings = self._cache("rings")
+        cached = rings.get(base_url)
+        if cached is not None:
+            if cached[0] == gen:
+                return cached[1]
+            cached[1].close()       # a respawn invalidated the attach
+            del rings[base_url]
+        advert = st.get("shm")
+        if advert is None:
+            return None
+        try:
+            client = shmring.RingClient(advert, self._connect_timeout_s)
+        except shmring.RingError as exc:
+            log.warning("transport: ring attach to %s failed (%s); "
+                        "falling back to HTTP", base_url, exc)
+            self.bus.counter("transport.fallback", level=2, wire="shm",
+                             reason="attach")
+            with self._lock:
+                neg = self._neg.get(base_url)
+                if neg is not None:
+                    neg["shm"] = None
+            return None
+        rings[base_url] = (gen, client)
+        with self._lock:
+            self._endpoints.append(client)
+        return client
+
+    def _drop_ring(self, base_url: str) -> None:
+        cached = self._cache("rings").pop(base_url, None)
+        if cached is not None:
+            cached[1].close()
+
+    def _conn_for(self, base_url: str,
+                  timeout_s: float) -> tuple[object, bool]:
+        """(connection, was_fresh) from this thread's pool."""
+        conns = self._cache("conns")
+        conn = conns.get(base_url)
+        fresh = conn is None
+        if fresh:
+            parts = urllib.parse.urlsplit(base_url)
+            conn = http.client.HTTPConnection(parts.hostname,
+                                              parts.port,
+                                              timeout=timeout_s)
+            conns[base_url] = conn
+            with self._lock:
+                self._endpoints.append(conn)
+        conn.timeout = timeout_s
+        if conn.sock is None:
+            try:
+                conn.connect()     # eager, so NODELAY covers call #1
+            except OSError:
+                pass               # conn.request() surfaces it on the
+                                   # handled transport-failure path
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout_s)
+            # headers and body go out in separate sends; without
+            # NODELAY the second send waits out the peer's delayed ACK
+            conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+        return conn, fresh
+
+    def _drop_conn(self, base_url: str) -> None:
+        conn = self._cache("conns").pop(base_url, None)
+        if conn is not None:
+            conn.close()
+
+    # -- dispatch ------------------------------------------------------
+
+    def post(self, base_url: str, entries, ts_buckets,
+             timeout_s: float, trace: list | None = None,
+             slo: list | None = None,
+             dg: list | None = None,
+             lens: list | None = None) -> list[dict]:
+        """One microbatch dispatch over the negotiated wire — the same
+        contract as :func:`post_predict`: per-request rows back, or
+        WorkerTransportError for anything that means the WORKER (not a
+        request) failed."""
+        st = (self._negotiate(base_url, timeout_s)
+              if self.mode != "json" else None)
+        if st is not None and self.mode == "shm":
+            with self._lock:
+                gen = self._gen.get(base_url, 0)
+            ring = self._ring_for(base_url, st, gen)
+            if ring is not None:
+                frame = wire.encode_request(entries, ts_buckets,
+                                            trace=trace, slo=slo,
+                                            dg=dg, lens=lens)
+                bus = self.bus
+                try:
+                    bus.counter("transport.bytes_out", len(frame),
+                                level=2, wire="shm")
+                    raw = ring.call(frame, timeout_s)
+                    bus.counter("transport.bytes_in", len(raw),
+                                level=2, wire="shm")
+                    rows = wire.decode_response(raw)
+                except shmring.RingFrameTooLarge as exc:
+                    # this CALL outgrew the slot; the worker is fine —
+                    # ride HTTP for it and keep the ring
+                    log.warning("transport: %s (worker %s); this call "
+                                "falls back to HTTP", exc, base_url)
+                    bus.counter("transport.fallback", level=2,
+                                wire="shm", reason="oversize")
+                except (shmring.RingError,
+                        wire.WireFormatError) as exc:
+                    # peer dead / timed out / torn slot / refused or
+                    # undecodable frame: the lost-worker verdict — the
+                    # router requeues and every Future still resolves
+                    self._drop_ring(base_url)
+                    self.forget(base_url)
+                    raise WorkerTransportError(
+                        f"worker {base_url} ring dispatch failed: "
+                        f"{type(exc).__name__}: {exc}") from exc
+                else:
+                    self._check_rows(base_url, rows, len(entries))
+                    self._last_wire[base_url] = "shm"
+                    return rows
+        binary = st is not None and st["wire"] == "binary"
+        wire_used = "binary" if binary else "json"
+        if binary:
+            body = wire.encode_request(entries, ts_buckets, trace=trace,
+                                       slo=slo, dg=dg, lens=lens)
+            ctype = wire.CONTENT_TYPE
+        else:
+            payload = {"entries": [int(e) for e in entries],
+                       "ts_buckets": [int(t) for t in ts_buckets]}
+            if trace is not None and any(t is not None for t in trace):
+                payload["trace"] = trace
+            if slo is not None and any(s is not None for s in slo):
+                payload["slo"] = slo
+            if dg is not None and any(dg):
+                payload["dg"] = [bool(d) for d in dg]
+            if lens is not None and any(ln is not None for ln in lens):
+                payload["lens"] = lens
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
+        bus = self.bus
+        bus.counter("transport.bytes_out", len(body), level=2,
+                    wire=wire_used)
+        data = self._http_post(base_url, body, ctype, timeout_s)
+        bus.counter("transport.bytes_in", len(data), level=2,
+                    wire=wire_used)
+        if binary:
+            try:
+                rows = wire.decode_response(data)
+            except wire.WireFormatError as exc:
+                self.forget(base_url)   # renegotiate before retrying
+                raise WorkerTransportError(
+                    f"worker {base_url} answered an undecodable "
+                    f"frame: {exc}") from exc
+        else:
+            try:
+                rows = json.loads(data).get("results")
+            except ValueError as exc:
+                raise WorkerTransportError(
+                    f"worker {base_url} answered unparseable JSON: "
+                    f"{exc}") from exc
+        self._check_rows(base_url, rows, len(entries))
+        self._last_wire[base_url] = wire_used
+        return rows
+
+    def _http_post(self, base_url: str, body: bytes, ctype: str,
+                   timeout_s: float) -> bytes:
+        """One pooled POST with reconnect-on-error: a REUSED keep-alive
+        connection the worker closed between batches retries exactly
+        once on a fresh one (counter ``transport.reconnects``; safe for
+        the same reason requeue-after-loss is — predictions are
+        deterministic); a FRESH connection failing is the lost-worker
+        signature and raises immediately."""
+        for _ in range(2):
+            conn, was_fresh = self._conn_for(base_url, timeout_s)
+            try:
+                conn.request("POST", "/predict", body=body,
+                             headers={"Content-Type": ctype})
+                resp = conn.getresponse()
+                data = resp.read()
+            except Exception as exc:
+                self._drop_conn(base_url)
+                if was_fresh:
+                    raise WorkerTransportError(
+                        f"worker {base_url} dispatch failed: "
+                        f"{type(exc).__name__}: {exc}") from exc
+                self.bus.counter("transport.reconnects", level=2)
+                continue
+            if resp.status != 200:
+                raise WorkerTransportError(
+                    f"worker {base_url} answered {resp.status}: "
+                    f"{data[:200]!r}")
+            return data
+        raise WorkerTransportError(     # pragma: no cover — loop logic
+            f"worker {base_url} dispatch failed after reconnect")
+
+    @staticmethod
+    def _check_rows(base_url: str, rows, n: int) -> None:
+        if not isinstance(rows, list) or len(rows) != n:
+            got = len(rows) if isinstance(rows, list) else "no"
+            raise WorkerTransportError(
+                f"worker {base_url} answered {got} rows for a "
+                f"{n}-request batch")
+
+    def close(self) -> None:
+        """Release every endpoint any thread opened. The router calls
+        this AFTER joining its sender threads, so no thread-local owner
+        is still dispatching."""
+        with self._lock:
+            endpoints, self._endpoints = self._endpoints, []
+        for ep in endpoints:
+            try:
+                ep.close()
+            except Exception:       # lint: allow-silent-except — best-effort teardown of dead sockets/segments
+                pass

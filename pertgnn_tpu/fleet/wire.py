@@ -1,0 +1,358 @@
+"""graftwire codec — the fleet's versioned binary wire format.
+
+The JSON transport (fleet/transport.py) spends the hot path in
+``json.dumps``/``json.loads`` and has to ARGUE float bit-identity
+through decimal round-trip; this codec makes both structural. One
+frame is a fixed little-endian header followed by tagged
+length-prefixed sections (docs/GUIDE.md §14 renders the byte-layout
+table):
+
+    frame   := magic "GW" | version u8 | kind u8 | frame_len u32
+               | section*
+    section := tag u8 | len u32 | payload[len]
+
+Request frames (kind 1) mirror the JSON body's omit-when-default
+contract exactly — entries/ts_buckets as packed i64 arrays, ``dg`` as
+a bitmask, and the rare metadata sections (``trace``/``slo``/``lens``)
+as UTF-8 JSON so their nested dict shapes stay in lockstep with the
+legacy wire. Response frames (kind 2) carry scalar predictions as raw
+IEEE-754 f64 and vector predictions as contiguous raw f32 (or f64 when
+an element would not survive the narrowing) row blocks — bit-identity
+across transports is a property of the LAYOUT, not of a printer.
+Error rows travel as the same ``{"error", "message"}`` pairs
+``error_from_row`` rehydrates, so the typed-outcome contract is
+transport-invariant. A refusal frame (kind 3) is how a worker answers
+a frame it cannot decode: typed, loud, never a crash.
+
+Decoding NEVER throws anything but :class:`WireFormatError` (or its
+:class:`WireRefusal` subclass) at a malformed, truncated, or
+version-skewed frame — the transport maps that to its existing
+lost-worker/fallback machinery. No pickle anywhere: every byte on
+this wire is ints, floats, and UTF-8 JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+WIRE_VERSION = 1
+# the Content-Type that negotiates the binary wire over HTTP
+CONTENT_TYPE = "application/x-pertgnn-wire"
+
+_MAGIC = b"GW"
+_HDR = struct.Struct("<2sBBI")          # magic, version, kind, frame_len
+_SEC = struct.Struct("<BI")             # tag, len
+_U32 = struct.Struct("<I")
+
+KIND_REQUEST = 1
+KIND_RESPONSE = 2
+KIND_REFUSAL = 3
+
+# request sections
+_TAG_ENTRIES = 0x01                      # u32 count + count * i64
+_TAG_TS = 0x02                           # u32 count + count * i64
+_TAG_TRACE = 0x03                        # UTF-8 JSON (list of dict|null)
+_TAG_SLO = 0x04                          # UTF-8 JSON (list of str|null)
+_TAG_DG = 0x05                           # u32 count + LSB-first bitmask
+_TAG_LENS = 0x06                         # UTF-8 JSON (list of dict|null)
+# response sections
+_TAG_ROWKIND = 0x10                      # u32 count + count * u8
+_TAG_SCALARS = 0x11                      # raw f64 per scalar row
+_TAG_VECTORS = 0x12                      # per vector: u8 width, u32 T, raw
+_TAG_ERRORS = 0x13                       # UTF-8 JSON ([{error, message}])
+_TAG_ATTR = 0x14                         # UTF-8 JSON ([[row, rows], ...])
+# refusal section
+_TAG_REFUSAL = 0x20                      # UTF-8 JSON ({error, message})
+
+_ROW_SCALAR = 0
+_ROW_VECTOR = 1
+_ROW_ERROR = 2
+
+
+class WireFormatError(RuntimeError):
+    """The frame cannot be decoded — truncated, corrupt, wrong magic,
+    unknown section, or a version this build does not speak. The
+    transport converts this into its fallback/lost-worker machinery;
+    it must never surface as a crash."""
+
+
+class WireRefusal(WireFormatError):
+    """The PEER decoded our frame and refused it (a kind-3 frame):
+    typically version skew on the worker side. Carries the peer's own
+    error name + message."""
+
+
+def _section(tag: int, payload: bytes) -> bytes:
+    return _SEC.pack(tag, len(payload)) + payload
+
+
+def _frame(kind: int, sections: list[bytes]) -> bytes:
+    body = b"".join(sections)
+    return _HDR.pack(_MAGIC, WIRE_VERSION, kind,
+                     _HDR.size + len(body)) + body
+
+
+def _pack_i64s(values) -> bytes:
+    vals = [int(v) for v in values]
+    return _U32.pack(len(vals)) + struct.pack(f"<{len(vals)}q", *vals)
+
+
+def _unpack_i64s(buf: bytes, what: str) -> list[int]:
+    if len(buf) < 4:
+        raise WireFormatError(f"{what}: truncated count")
+    (n,) = _U32.unpack_from(buf)
+    if len(buf) != 4 + 8 * n:
+        raise WireFormatError(
+            f"{what}: {len(buf) - 4} payload bytes for {n} i64s")
+    return list(struct.unpack_from(f"<{n}q", buf, 4))
+
+
+def _pack_json(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _unpack_json(buf: bytes, what: str):
+    try:
+        return json.loads(buf.decode())
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise WireFormatError(f"{what}: bad JSON section: {exc}") from exc
+
+
+def _split_sections(buf: bytes, expect_kind: int) -> dict[int, bytes]:
+    """Header-validate one frame and return {tag: payload}. The ONLY
+    raise is WireFormatError (WireRefusal for a peer's kind-3)."""
+    if len(buf) < _HDR.size:
+        raise WireFormatError(f"frame truncated at {len(buf)} bytes "
+                              f"(header is {_HDR.size})")
+    magic, version, kind, frame_len = _HDR.unpack_from(buf)
+    if magic != _MAGIC:
+        raise WireFormatError(f"bad magic {magic!r} (not a graftwire "
+                              f"frame)")
+    if version != WIRE_VERSION:
+        raise WireFormatError(f"wire version skew: frame v{version}, "
+                              f"this build speaks v{WIRE_VERSION}")
+    if frame_len != len(buf):
+        raise WireFormatError(f"frame length {frame_len} != "
+                              f"{len(buf)} bytes on the wire "
+                              f"(truncated or concatenated)")
+    sections: dict[int, bytes] = {}
+    off = _HDR.size
+    while off < len(buf):
+        if off + _SEC.size > len(buf):
+            raise WireFormatError("section header truncated")
+        tag, n = _SEC.unpack_from(buf, off)
+        off += _SEC.size
+        if off + n > len(buf):
+            raise WireFormatError(f"section 0x{tag:02x} truncated: "
+                                  f"{n} declared, "
+                                  f"{len(buf) - off} remain")
+        if tag in sections:
+            raise WireFormatError(f"duplicate section 0x{tag:02x}")
+        sections[tag] = bytes(buf[off:off + n])
+        off += n
+    if kind == KIND_REFUSAL and expect_kind != KIND_REFUSAL:
+        info = _unpack_json(sections.get(_TAG_REFUSAL, b"{}"),
+                            "refusal")
+        raise WireRefusal(f"peer refused the frame: "
+                          f"{info.get('error', 'WireFormatError')}: "
+                          f"{info.get('message', '(no message)')}")
+    if kind != expect_kind:
+        raise WireFormatError(f"frame kind {kind}, expected "
+                              f"{expect_kind}")
+    return sections
+
+
+# -- request frames -------------------------------------------------------
+
+def encode_request(entries, ts_buckets, trace: list | None = None,
+                   slo: list | None = None,
+                   dg: list | None = None,
+                   lens: list | None = None) -> bytes:
+    """One microbatch request frame — the same omit-when-default rules
+    as ``post_predict``'s JSON body, so all-plain traffic is two packed
+    int arrays and nothing else."""
+    sections = [_section(_TAG_ENTRIES, _pack_i64s(entries)),
+                _section(_TAG_TS, _pack_i64s(ts_buckets))]
+    if trace is not None and any(t is not None for t in trace):
+        sections.append(_section(_TAG_TRACE, _pack_json(trace)))
+    if slo is not None and any(s is not None for s in slo):
+        sections.append(_section(_TAG_SLO, _pack_json(slo)))
+    if dg is not None and any(dg):
+        bits = bytearray((len(dg) + 7) // 8)
+        for i, d in enumerate(dg):
+            if d:
+                bits[i // 8] |= 1 << (i % 8)
+        sections.append(_section(
+            _TAG_DG, _U32.pack(len(dg)) + bytes(bits)))
+    if lens is not None and any(ln is not None for ln in lens):
+        sections.append(_section(_TAG_LENS, _pack_json(lens)))
+    return _frame(KIND_REQUEST, sections)
+
+
+def decode_request(buf: bytes) -> dict:
+    """A request frame back into the JSON body's dict shape —
+    ``WorkerServer._predict`` consumes either wire without knowing
+    which one carried the batch."""
+    sections = _split_sections(buf, KIND_REQUEST)
+    if _TAG_ENTRIES not in sections or _TAG_TS not in sections:
+        raise WireFormatError("request frame missing entries/ts "
+                              "sections")
+    req = {"entries": _unpack_i64s(sections[_TAG_ENTRIES], "entries"),
+           "ts_buckets": _unpack_i64s(sections[_TAG_TS], "ts_buckets")}
+    if _TAG_TRACE in sections:
+        req["trace"] = _unpack_json(sections[_TAG_TRACE], "trace")
+    if _TAG_SLO in sections:
+        req["slo"] = _unpack_json(sections[_TAG_SLO], "slo")
+    if _TAG_DG in sections:
+        raw = sections[_TAG_DG]
+        if len(raw) < 4:
+            raise WireFormatError("dg: truncated count")
+        (n,) = _U32.unpack_from(raw)
+        bits = raw[4:]
+        if len(bits) != (n + 7) // 8:
+            raise WireFormatError(f"dg: {len(bits)} mask bytes for "
+                                  f"{n} flags")
+        req["dg"] = [bool(bits[i // 8] >> (i % 8) & 1)
+                     for i in range(n)]
+    if _TAG_LENS in sections:
+        req["lens"] = _unpack_json(sections[_TAG_LENS], "lens")
+    return req
+
+
+# -- response frames ------------------------------------------------------
+
+def _f32_exact(arr64: np.ndarray) -> bool:
+    """Whether every element survives f64 -> f32 -> f64 bit-exactly —
+    true for anything that was ever a float32 (pred_to_wire's vectors),
+    in which case the narrow row block loses nothing. One vectorized
+    round trip, not a per-float pack (the response encode hot path);
+    out-of-f32-range values overflow to inf and compare unequal, NaNs
+    compare unequal — both take the wide block."""
+    with np.errstate(over="ignore"):
+        return bool((arr64.astype(np.float32).astype(np.float64)
+                     == arr64).all())
+
+
+def encode_response(rows: list[dict]) -> bytes:
+    """Per-request result rows as one frame: a rowkind byte per row,
+    then the scalar block (raw f64), the vector blocks (raw f32 where
+    exact, f64 otherwise), the error rows, and the lens attribution
+    payloads, each in row order."""
+    kinds = bytearray()
+    scalar_vals: list[float] = []
+    vectors = bytearray()
+    errors: list[dict] = []
+    attr: list[list] = []
+    nvec = 0
+    for i, row in enumerate(rows):
+        if "error" in row:
+            kinds.append(_ROW_ERROR)
+            errors.append({"error": str(row.get("error", "")),
+                           "message": str(row.get("message", ""))})
+            continue
+        pred = row["pred"]
+        if isinstance(pred, list):
+            kinds.append(_ROW_VECTOR)
+            nvec += 1
+            arr = np.asarray(pred, np.float64)
+            width = 4 if _f32_exact(arr) else 8
+            vectors += struct.pack("<BI", width, len(arr))
+            vectors += (arr.astype("<f4") if width == 4
+                        else arr.astype("<f8")).tobytes()
+        else:
+            kinds.append(_ROW_SCALAR)
+            scalar_vals.append(float(pred))
+        if "attr" in row:
+            attr.append([i, list(row["attr"])])
+    sections = [_section(_TAG_ROWKIND,
+                         _U32.pack(len(rows)) + bytes(kinds))]
+    if scalar_vals:
+        sections.append(_section(
+            _TAG_SCALARS,
+            np.asarray(scalar_vals, "<f8").tobytes()))
+    if nvec:
+        sections.append(_section(_TAG_VECTORS,
+                                 _U32.pack(nvec) + bytes(vectors)))
+    if errors:
+        sections.append(_section(_TAG_ERRORS, _pack_json(errors)))
+    if attr:
+        sections.append(_section(_TAG_ATTR, _pack_json(attr)))
+    return _frame(KIND_RESPONSE, sections)
+
+
+def decode_response(buf: bytes) -> list[dict]:
+    """A response frame back into the JSON wire's row dicts —
+    ``result_from_row``/``error_from_row`` rehydrate them identically,
+    and ``decode_response(encode_response(rows)) == rows`` holds with
+    struct-level float equality (tests/test_wire.py pins it)."""
+    sections = _split_sections(buf, KIND_RESPONSE)
+    if _TAG_ROWKIND not in sections:
+        raise WireFormatError("response frame missing rowkind section")
+    raw = sections[_TAG_ROWKIND]
+    if len(raw) < 4:
+        raise WireFormatError("rowkind: truncated count")
+    (n,) = _U32.unpack_from(raw)
+    kinds = raw[4:]
+    if len(kinds) != n:
+        raise WireFormatError(f"rowkind: {len(kinds)} bytes for "
+                              f"{n} rows")
+    scalars_raw = sections.get(_TAG_SCALARS, b"")
+    n_scalar = sum(1 for k in kinds if k == _ROW_SCALAR)
+    if len(scalars_raw) != 8 * n_scalar:
+        raise WireFormatError(f"scalars: {len(scalars_raw)} bytes for "
+                              f"{n_scalar} scalar rows")
+    scalars = np.frombuffer(scalars_raw, "<f8").tolist()
+    errors = (_unpack_json(sections[_TAG_ERRORS], "errors")
+              if _TAG_ERRORS in sections else [])
+    vec_buf = sections.get(_TAG_VECTORS, b"")
+    vec_off = 4 if vec_buf else 0
+    rows: list[dict] = []
+    s_i = e_i = 0
+    for k in kinds:
+        if k == _ROW_SCALAR:
+            rows.append({"pred": scalars[s_i]})
+            s_i += 1
+        elif k == _ROW_VECTOR:
+            if vec_off + 5 > len(vec_buf):
+                raise WireFormatError("vectors: truncated block header")
+            width, t = struct.unpack_from("<BI", vec_buf, vec_off)
+            vec_off += 5
+            if width not in (4, 8) or vec_off + width * t > len(vec_buf):
+                raise WireFormatError(f"vectors: bad block "
+                                      f"(width {width}, T {t})")
+            block = np.frombuffer(vec_buf, "<f4" if width == 4
+                                  else "<f8", count=t, offset=vec_off)
+            rows.append({"pred": block.astype(np.float64).tolist()})
+            vec_off += width * t
+        elif k == _ROW_ERROR:
+            if e_i >= len(errors) or not isinstance(errors[e_i], dict):
+                raise WireFormatError("errors: fewer error payloads "
+                                      "than error rows")
+            rows.append({"error": errors[e_i].get("error", ""),
+                         "message": errors[e_i].get("message", "")})
+            e_i += 1
+        else:
+            raise WireFormatError(f"unknown rowkind {k}")
+    for item in (_unpack_json(sections[_TAG_ATTR], "attr")
+                 if _TAG_ATTR in sections else []):
+        if (not isinstance(item, list) or len(item) != 2
+                or not isinstance(item[0], int)
+                or not 0 <= item[0] < len(rows)
+                or "pred" not in rows[item[0]]):
+            raise WireFormatError("attr: row reference out of range")
+        rows[item[0]]["attr"] = item[1]
+    return rows
+
+
+# -- refusal frames -------------------------------------------------------
+
+def encode_refusal(error: str, message: str) -> bytes:
+    """A typed decode refusal — what a worker answers when it cannot
+    decode a frame (version skew, corruption). The client's decoder
+    raises it as :class:`WireRefusal`, which the transport maps to the
+    lost-worker path, never a crash."""
+    return _frame(KIND_REFUSAL, [_section(
+        _TAG_REFUSAL, _pack_json({"error": error, "message": message}))])

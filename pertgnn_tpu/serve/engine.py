@@ -43,7 +43,8 @@ import numpy as np
 from pertgnn_tpu import telemetry
 from pertgnn_tpu.batching.featurize import ResourceLookup
 from pertgnn_tpu.batching.mixture import Mixture
-from pertgnn_tpu.batching.pack import BatchBudget, PackedBatch, pack_single
+from pertgnn_tpu.batching.pack import (ArenaLease, BatchBudget, PackArena,
+                                       PackedBatch, pack_single)
 from pertgnn_tpu.config import SERVE_DTYPES, Config, resolve_attention_impl
 from pertgnn_tpu.models.pert_model import make_model
 from pertgnn_tpu.serve.buckets import (make_bucket_ladder, pad_waste,
@@ -127,6 +128,13 @@ class PackedMicrobatch:
     # local head output, pad rows pinned to -inf in-graph
     want_local: bool = False
     local: np.ndarray | None = None
+    # graftwire arena custody: the lease whose buffers ``batch`` views,
+    # or None for plain-allocated batches. complete_microbatch releases
+    # it for non-lens batches (the np.asarray there forces the device
+    # computation, so the buffers are reusable); lens batches keep the
+    # lease forever because attribution_rows reads ``batch`` arrays
+    # AFTER completion — a deliberate leak, the pool just refills
+    arena_lease: ArenaLease | None = None
 
 
 @dataclasses.dataclass
@@ -245,6 +253,10 @@ class InferenceEngine:
         self.last_stage_tm: dict[str, tuple[float, float]] = {}
         self._bucket_stats = {i: _BucketStats()
                               for i in range(len(self.ladder))}
+        # graftwire: per-rung packing-buffer pools, built lazily on the
+        # first dispatch through a rung (warmup touches every rung, so
+        # steady-state serving never allocates a pool)
+        self._arenas: dict[int, PackArena] = {}
         self.requests = 0
         self.batches = 0
         self.cache_hits = 0
@@ -548,6 +560,17 @@ class InferenceEngine:
                 f"exceeds the top bucket {self.ladder[-1]}")
         t0 = time.perf_counter()
         tm0 = time.monotonic()
+        # arena lease (graftwire): plain batches pack into pooled
+        # buffers released at complete; lens batches pack fresh — their
+        # arrays outlive completion (attribution_rows reads them), so a
+        # lease would either dangle or leak every time
+        lease = None
+        if not want_local:
+            arena = self._arenas.get(idx)
+            if arena is None:
+                arena = self._arenas[idx] = PackArena(self.ladder[idx],
+                                                      self._n_feat)
+            lease = arena.acquire()
         with self.stage_latency["pack"].time(), \
                 self._bus.span("serve.pack", level=2, bucket=idx,
                                graphs=g):
@@ -555,12 +578,14 @@ class InferenceEngine:
                                 np.asarray(ts_buckets), self.ladder[idx],
                                 self._lookup,
                                 node_depth_in_x=self._node_depth_in_x,
-                                mixture_of=mixes if any_override else None)
+                                mixture_of=mixes if any_override else None,
+                                into=lease)
         return PackedMicrobatch(entry_ids=entry_ids, idx=idx, batch=batch,
                                 n=n, e_tot=e_tot,
                                 engine_s=time.perf_counter() - t0,
                                 stage_tm={"pack": (tm0, time.monotonic())},
-                                want_local=bool(want_local))
+                                want_local=bool(want_local),
+                                arena_lease=lease)
 
     def dispatch_packed(self, packed: PackedMicrobatch) -> InFlightBatch:
         """Device half, part 1: resolve the rung executable and launch
@@ -683,6 +708,14 @@ class InferenceEngine:
         bs.padded_edges += bucket.max_edges
         bus.histogram("serve.pad_waste", pad_waste(bucket, n, e_tot),
                       bucket=idx, level=2)
+        # arena custody ends HERE for plain batches: the np.asarray
+        # above forced the device computation, so nothing reads the
+        # packed host buffers again — return them for the next pack.
+        # (Error paths above deliberately leak: a quarantined batch's
+        # lease is dropped and the pool refills on the next acquire.)
+        if packed.arena_lease is not None:
+            packed.arena_lease.release()
+            packed.arena_lease = None
         return pred
 
     def predict_microbatch(self, entry_ids, ts_buckets,

@@ -56,10 +56,11 @@ def test_repo_syncs_clean():
         f"budget the ISSUE-14 acceptance pins")
 
 
-def test_all_five_passes_registered():
+def test_all_six_passes_registered():
     names = [m.RULE for m in get_passes(None)]
     assert names == ["lock-order", "future-lifecycle", "cv-protocol",
-                     "thread-lifecycle", "timeout-totality"]
+                     "thread-lifecycle", "timeout-totality",
+                     "ring-protocol"]
 
 
 def test_justification_tables_are_live():
@@ -549,3 +550,88 @@ def test_bench_gate_skip_sync_env_is_loud(monkeypatch, capsys):
     assert bench._graftsync_refusal() == []
     err = capsys.readouterr().err
     assert "BENCH_GATE_SKIP_SYNC" in err
+
+
+# --- ring-protocol (graftwire shm ring publication discipline) ------------
+
+
+_RING_OK = """
+    class R:
+        def try_push(self, off, payload, seq):
+            self._payload_write(off, payload)
+            self._seq_write(off, seq)
+
+        def try_pop(self, off, n):
+            seq = self._seq_read(off)
+            payload = self._payload_read(off, n)
+            if self._seq_read(off) != seq:
+                return None
+            return payload
+"""
+
+
+def test_ring_protocol_accepts_the_real_discipline(tmp_path):
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/r.py": _RING_OK},
+               ["ring-protocol"])
+    assert res.new == [], res.new
+
+
+def test_ring_protocol_detects_publication_before_payload(tmp_path):
+    src = """
+        class R:
+            def try_push(self, off, payload, seq):
+                self._seq_write(off, seq)
+                self._payload_write(off, payload)
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/r.py": src},
+               ["ring-protocol"])
+    assert len(res.new) == 1 and "COMMIT" in res.new[0].message
+
+
+def test_ring_protocol_detects_missing_validate(tmp_path):
+    src = """
+        class R:
+            def try_pop(self, off, n):
+                payload = self._payload_read(off, n)
+                self._seq_read(off)
+                return payload
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/r.py": src},
+               ["ring-protocol"])
+    assert any("preceding _seq_read" in v.message for v in res.new), \
+        res.new
+
+
+def test_ring_protocol_detects_missing_revalidate(tmp_path):
+    src = """
+        class R:
+            def try_pop(self, off, n):
+                seq = self._seq_read(off)
+                return self._payload_read(off, n)
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/r.py": src},
+               ["ring-protocol"])
+    assert any("re-read" in v.message for v in res.new), res.new
+
+
+def test_lock_order_flags_ring_call_under_lock(tmp_path):
+    """A blocking ring round trip under a held lock is the same bug as
+    an HTTP post under a lock — every thread contending for the lock
+    stalls for the full transport timeout."""
+    src = """
+        import threading
+
+        from pertgnn_tpu.fleet.shmring import RingClient
+
+        class A:
+            def __init__(self, advert):
+                self._lock = threading.Lock()
+                self._ring = RingClient(advert)
+
+            def bad(self, payload):
+                with self._lock:
+                    return self._ring.call(payload, 1.0)
+    """
+    res = _run(tmp_path, {"pertgnn_tpu/fleet/a.py": src},
+               ["lock-order"])
+    assert any("ring transport" in v.message for v in res.new), res.new

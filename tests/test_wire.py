@@ -1,0 +1,288 @@
+"""graftwire data plane: the binary codec and the shared-memory ring.
+
+Codec tests pin STRUCT-level round-trips (decode(encode(x)) == x with
+float bit-equality) and the refusal contract: a truncated, corrupt, or
+version-skewed frame raises WireFormatError — never anything else, and
+never a crash. Ring tests pin the SPSC protocol: wrap-around,
+full-ring backpressure, torn-write detection, and the doorbell's
+peer-death/timeout surfacing. The hypothesis property is gated the
+repo's usual way (importorskip) so environments without hypothesis
+still run every example-based case.
+"""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from pertgnn_tpu.fleet import shmring, wire
+
+# --- codec: request frames ------------------------------------------------
+
+
+def test_request_roundtrip_minimal():
+    buf = wire.encode_request([1, 2, 3], [10, 20, 30])
+    assert wire.decode_request(buf) == {"entries": [1, 2, 3],
+                                        "ts_buckets": [10, 20, 30]}
+
+
+def test_request_omit_when_default_sections_absent():
+    """All-default metadata must not appear in the decoded body at all
+    (the same omit-when-default contract as the JSON wire)."""
+    buf = wire.encode_request([7], [8], trace=[None], slo=[None],
+                              dg=[False], lens=[None])
+    assert wire.decode_request(buf) == {"entries": [7],
+                                        "ts_buckets": [8]}
+
+
+def test_request_roundtrip_full_metadata():
+    trace = [{"tid": "t1", "psid": "s1"}, None]
+    slo = ["critical", None]
+    dg = [False, True]
+    lens = [None, {"kind": "whatif", "edits": [[1, 2]]}]
+    buf = wire.encode_request([4, 5], [1, 1], trace=trace, slo=slo,
+                              dg=dg, lens=lens)
+    got = wire.decode_request(buf)
+    assert got == {"entries": [4, 5], "ts_buckets": [1, 1],
+                   "trace": trace, "slo": slo, "dg": dg, "lens": lens}
+
+
+def test_request_dg_bitmask_is_compact():
+    """9 flags fit 2 mask bytes (count u32 + LSB-first bits)."""
+    dg = [True] + [False] * 7 + [True]
+    buf = wire.encode_request(list(range(9)), [0] * 9, dg=dg)
+    assert wire.decode_request(buf)["dg"] == dg
+
+
+# --- codec: response frames -----------------------------------------------
+
+
+def test_response_roundtrip_scalar_vector_error_attr():
+    rows = [
+        {"pred": 1.5},
+        {"pred": [0.25, 0.5, 0.75]},                     # f32-exact
+        {"error": "Shed", "message": "class best_effort shed"},
+        {"pred": [0.1, 0.2], "attr": [{"rank": 1, "score": 0.5}]},
+    ]
+    got = wire.decode_response(wire.encode_response(rows))
+    assert got == rows
+    # float equality above is STRUCT-level: 0.1 does not survive f32,
+    # so the codec must have chosen the f64 block for that row
+    assert got[3]["pred"][0] == 0.1
+
+
+def test_response_vector_width_narrows_only_when_exact():
+    exact = [float(struct.unpack("<f", struct.pack("<f", v))[0])
+             for v in (1.1, 2.2, 3.3)]
+    buf_exact = wire.encode_response([{"pred": exact}])
+    buf_wide = wire.encode_response([{"pred": [1.1, 2.2, 3.3]}])
+    assert len(buf_exact) < len(buf_wide)
+    assert wire.decode_response(buf_exact) == [{"pred": exact}]
+    assert wire.decode_response(buf_wide) == [{"pred": [1.1, 2.2, 3.3]}]
+
+
+def test_response_roundtrip_property():
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    finite = st.floats(allow_nan=False, allow_infinity=False)
+    row = st.one_of(
+        st.fixed_dictionaries({"pred": finite}),
+        st.fixed_dictionaries({"pred": st.lists(finite, max_size=8)}),
+        st.fixed_dictionaries({"error": st.text(max_size=20),
+                               "message": st.text(max_size=40)}))
+
+    @hyp.given(st.lists(row, max_size=16))
+    @hyp.settings(deadline=None, max_examples=200)
+    def check(rows):
+        assert wire.decode_response(wire.encode_response(rows)) == rows
+
+    check()
+
+
+def test_request_roundtrip_property():
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    i64 = st.integers(min_value=-2**63, max_value=2**63 - 1)
+
+    @hyp.given(st.lists(i64, max_size=32), st.lists(i64, max_size=32))
+    @hyp.settings(deadline=None, max_examples=200)
+    def check(entries, ts):
+        got = wire.decode_request(wire.encode_request(entries, ts))
+        assert got == {"entries": entries, "ts_buckets": ts}
+
+    check()
+
+
+# --- codec: refusals, truncation, corruption, skew ------------------------
+
+
+def test_every_truncation_is_a_typed_refusal():
+    """EVERY proper prefix of a valid frame must raise WireFormatError
+    — no IndexError, no struct.error, no silent partial decode."""
+    buf = wire.encode_response([{"pred": 1.0}, {"pred": [1.0, 2.0]},
+                                {"error": "QueueFull", "message": "x"}])
+    for cut in range(len(buf)):
+        with pytest.raises(wire.WireFormatError):
+            wire.decode_response(buf[:cut])
+    req = wire.encode_request([1, 2], [3, 4], dg=[True, False])
+    for cut in range(len(req)):
+        with pytest.raises(wire.WireFormatError):
+            wire.decode_request(req[:cut])
+
+
+def test_bad_magic_and_wrong_kind_refused():
+    buf = wire.encode_request([1], [2])
+    with pytest.raises(wire.WireFormatError, match="magic"):
+        wire.decode_request(b"XX" + buf[2:])
+    # a request frame handed to the response decoder is a kind error
+    with pytest.raises(wire.WireFormatError, match="kind"):
+        wire.decode_response(buf)
+
+
+def test_version_skew_refused():
+    buf = bytearray(wire.encode_request([1], [2]))
+    buf[2] = wire.WIRE_VERSION + 1
+    with pytest.raises(wire.WireFormatError, match="version skew"):
+        wire.decode_request(bytes(buf))
+
+
+def test_trailing_garbage_refused():
+    buf = wire.encode_request([1], [2])
+    with pytest.raises(wire.WireFormatError, match="length"):
+        wire.decode_request(buf + b"\x00")
+
+
+def test_duplicate_section_refused():
+    sec = wire._section(wire._TAG_ENTRIES, wire._pack_i64s([1]))
+    ts = wire._section(wire._TAG_TS, wire._pack_i64s([2]))
+    frame = wire._frame(wire.KIND_REQUEST, [sec, ts, sec])
+    with pytest.raises(wire.WireFormatError, match="duplicate"):
+        wire.decode_request(frame)
+
+
+def test_refusal_frame_raises_wire_refusal():
+    buf = wire.encode_refusal("WireFormatError", "version skew v9")
+    with pytest.raises(wire.WireRefusal, match="version skew v9"):
+        wire.decode_response(buf)
+    with pytest.raises(wire.WireRefusal):
+        wire.decode_request(buf)
+    # and WireRefusal IS a WireFormatError: one except arm suffices
+    assert issubclass(wire.WireRefusal, wire.WireFormatError)
+
+
+# --- shm ring: SPSC protocol ----------------------------------------------
+
+
+@pytest.fixture
+def ring():
+    r = shmring.ShmRing.create(slots=4, slot_bytes=64)
+    yield r
+    r.close()
+
+
+def test_ring_wraparound_preserves_frames(ring):
+    """20 frames through a 4-slot ring — every wrap lap intact."""
+    for i in range(20):
+        payload = f"frame-{i}".encode() * 2
+        assert ring.try_push(payload)
+        assert ring.try_pop() == payload
+    assert ring.try_pop() is None
+
+
+def test_ring_full_backpressure(ring):
+    for i in range(ring.slots):
+        assert ring.try_push(f"p{i}".encode())
+    assert not ring.try_push(b"overflow")     # consumer owns the oldest
+    assert ring.try_pop() == b"p0"
+    assert ring.try_push(b"now-it-fits")
+    got = [ring.try_pop() for _ in range(ring.slots)]
+    assert got == [b"p1", b"p2", b"p3", b"now-it-fits"]
+
+
+def test_ring_oversize_frame_refused(ring):
+    with pytest.raises(shmring.RingFrameTooLarge):
+        ring.try_push(b"x" * (ring.payload_max + 1))
+    assert ring.try_pop() is None             # nothing was committed
+
+
+def test_ring_torn_write_detected(ring):
+    """A stamp from the future means the producer lapped an unconsumed
+    slot — the consumer must refuse the ring, not return garbage."""
+    assert ring.try_push(b"ok")
+    off = ring._slot_off(1)
+    ring._seq_write(off, 1 + ring.slots)      # producer lap, mid-copy
+    with pytest.raises(shmring.RingTornWrite):
+        ring.try_pop()
+
+
+def test_ring_attach_version_skew_refused():
+    r = shmring.ShmRing.create(slots=2, slot_bytes=64)
+    try:
+        name = r.name
+        struct.pack_into("<I", r._shm.buf, 4, shmring.RING_VERSION + 1)
+        with pytest.raises(shmring.RingError, match="version skew"):
+            shmring.ShmRing.attach(name)
+    finally:
+        r.close()
+
+
+def test_ring_attach_gone_segment_is_peer_death():
+    with pytest.raises(shmring.RingPeerDead):
+        shmring.ShmRing.attach("graftwire-no-such-segment")
+
+
+# --- shm ring: server/client round trips ----------------------------------
+
+
+def test_ring_client_server_roundtrip():
+    server = shmring.RingServer(lambda b: b.upper(), slots=4,
+                                slot_bytes=256)
+    client = None
+    try:
+        client = shmring.RingClient(server.advertisement())
+        for i in range(25):                   # several wrap laps
+            msg = f"frame-{i}".encode()
+            assert client.call(msg, timeout_s=5.0) == msg.upper()
+    finally:
+        if client is not None:
+            client.close()
+        server.close()
+
+
+def test_ring_call_timeout_is_bounded():
+    """A wedged handler surfaces as RingTimeout at the DEADLINE — the
+    transport maps it to the lost-worker path; nothing spins."""
+    release = threading.Event()
+
+    def slow(b):
+        release.wait(5.0)
+        return b
+
+    server = shmring.RingServer(slow, slots=2, slot_bytes=128)
+    client = None
+    try:
+        client = shmring.RingClient(server.advertisement())
+        t0 = time.monotonic()
+        with pytest.raises(shmring.RingTimeout):
+            client.call(b"x", timeout_s=0.3)
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        release.set()
+        if client is not None:
+            client.close()
+        server.close()
+
+
+def test_ring_server_death_surfaces_as_peer_dead():
+    server = shmring.RingServer(lambda b: b, slots=2, slot_bytes=128)
+    client = shmring.RingClient(server.advertisement())
+    try:
+        assert client.call(b"alive", timeout_s=5.0) == b"alive"
+        server.close()                        # the worker is SIGKILLed
+        with pytest.raises((shmring.RingPeerDead, shmring.RingTimeout)):
+            client.call(b"anyone-there", timeout_s=1.0)
+    finally:
+        client.close()

@@ -115,12 +115,19 @@ CV_PROTOCOL: dict[tuple[str, str], str] = {}
 # -- thread-lifecycle (graftsync) -----------------------------------------
 THREAD_LIFECYCLE: dict[tuple[str, str], str] = {}
 
+# -- ring-protocol (graftsync) --------------------------------------------
+# Empty BY DESIGN: the SPSC publication discipline has no safe variant
+# (see passes/ring_protocol.py) — an entry here would be a torn-frame
+# data race with a permission slip.
+RING_PROTOCOL: dict[tuple[str, str], str] = {}
+
 TABLES: dict[str, dict[tuple[str, str], str]] = {
     "timeout-totality": TIMEOUT_TOTALITY,
     "future-lifecycle": FUTURE_LIFECYCLE,
     "lock-order": LOCK_ORDER,
     "cv-protocol": CV_PROTOCOL,
     "thread-lifecycle": THREAD_LIFECYCLE,
+    "ring-protocol": RING_PROTOCOL,
 }
 
 

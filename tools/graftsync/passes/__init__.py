@@ -5,11 +5,11 @@ the protocol/lifecycle/timeout hygiene passes."""
 from __future__ import annotations
 
 from tools.graftsync.passes import (cv_protocol, future_lifecycle,
-                                    lock_order, thread_lifecycle,
-                                    timeout_totality)
+                                    lock_order, ring_protocol,
+                                    thread_lifecycle, timeout_totality)
 
 _ORDER = (lock_order, future_lifecycle, cv_protocol, thread_lifecycle,
-          timeout_totality)
+          timeout_totality, ring_protocol)
 
 # short aliases accepted on the CLI next to the canonical RULE names
 ALIASES = {
@@ -18,6 +18,7 @@ ALIASES = {
     "cv": cv_protocol,
     "threads": thread_lifecycle,
     "timeouts": timeout_totality, "timeout": timeout_totality,
+    "ring": ring_protocol, "rings": ring_protocol,
 }
 
 

@@ -29,6 +29,10 @@ from tools.graftlint.passes._ast_util import attr_chain
 _LOCK_TAILS = ("Lock", "RLock")
 _QUEUE_TAILS = {"Queue": "queue", "LifoQueue": "queue",
                 "PriorityQueue": "queue", "SimpleQueue": "simple"}
+# graftwire shared-memory transport handles (fleet/shmring.py):
+# RingClient.call blocks on the doorbell, so a ring is a first-class
+# receiver kind for the blocking-while-locked analysis
+_RING_TAILS = ("RingClient", "RingServer", "ShmRing")
 
 
 def _ctor_tail(value: ast.AST) -> str | None:
@@ -48,6 +52,7 @@ class ClassModel:
     event_attrs: set = dataclasses.field(default_factory=set)
     queue_attrs: dict = dataclasses.field(default_factory=dict)
     thread_attrs: set = dataclasses.field(default_factory=set)
+    ring_attrs: set = dataclasses.field(default_factory=set)
     # list-of-threads attrs (self._senders = [Thread(...) ...])
     thread_list_attrs: set = dataclasses.field(default_factory=set)
     # attr -> canonical lock attr (Condition(self._lock) -> "_lock")
@@ -67,6 +72,7 @@ class Unit:
     local_queues: dict = dataclasses.field(default_factory=dict)
     local_threads: set = dataclasses.field(default_factory=set)
     local_thread_lists: set = dataclasses.field(default_factory=set)
+    local_rings: set = dataclasses.field(default_factory=set)
     local_canon: dict = dataclasses.field(default_factory=dict)
 
 
@@ -83,6 +89,7 @@ class ModuleModel:
     attr_queues: dict = dataclasses.field(default_factory=dict)
     attr_threads: set = dataclasses.field(default_factory=set)
     attr_events: set = dataclasses.field(default_factory=set)
+    attr_rings: set = dataclasses.field(default_factory=set)
 
     def lock_id(self, owner: str, attr: str) -> str:
         return f"{self.rel}::{owner}.{attr}"
@@ -118,6 +125,15 @@ def _classify_assign(node, add):
         add("thread", targets, value)
     elif tail in _QUEUE_TAILS:
         add("queue:" + _QUEUE_TAILS[tail], targets, value)
+    elif tail in _RING_TAILS:
+        add("ring", targets, value)
+    elif tail in ("create", "attach"):
+        # ShmRing's alternate constructors: x = ShmRing.create(...) /
+        # ShmRing.attach(...) — the tail is the classmethod name, so
+        # peek one link up the chain
+        ch = attr_chain(value.func) or []
+        if len(ch) >= 2 and ch[-2] == "ShmRing":
+            add("ring", targets, value)
 
 
 def _build_class(node: ast.ClassDef) -> ClassModel:
@@ -152,6 +168,8 @@ def _build_class(node: ast.ClassDef) -> ClassModel:
                 cm.thread_attrs.add(attr)
             elif cat == "thread_list":
                 cm.thread_list_attrs.add(attr)
+            elif cat == "ring":
+                cm.ring_attrs.add(attr)
             elif cat.startswith("queue:"):
                 cm.queue_attrs[attr] = cat.split(":", 1)[1]
 
@@ -191,6 +209,8 @@ def _build_unit(qual: str, fn: ast.AST, cls: ClassModel | None) -> Unit:
                 u.local_threads.add(name)
             elif cat == "thread_list":
                 u.local_thread_lists.add(name)
+            elif cat == "ring":
+                u.local_rings.add(name)
             elif cat.startswith("queue:"):
                 u.local_queues[name] = cat.split(":", 1)[1]
 
@@ -244,6 +264,7 @@ def model_for(ctx, rel: str) -> ModuleModel | None:
         m.attr_queues.update(cm.queue_attrs)
         m.attr_threads |= cm.thread_attrs | cm.thread_list_attrs
         m.attr_events |= cm.event_attrs
+        m.attr_rings |= cm.ring_attrs
     cache[rel] = m
     return m
 
@@ -272,9 +293,9 @@ def receiver_kind(m: ModuleModel, u: Unit,
                   recv: list[str]) -> tuple[str, str | None] | None:
     """Classify the receiver chain of an attribute call: returns
     (kind, detail) with kind in {"lock", "cond", "event", "queue",
-    "thread"}; for "cond"/"lock" detail is the canonical lock id, for
-    "queue" the queue kind ("queue" blocking put / "simple"). None =
-    unresolvable (unknown object)."""
+    "thread", "ring"}; for "cond"/"lock" detail is the canonical lock
+    id, for "queue" the queue kind ("queue" blocking put / "simple").
+    None = unresolvable (unknown object)."""
     if not recv:
         return None
     if len(recv) == 2 and recv[0] == "self" and u.cls is not None:
@@ -291,6 +312,8 @@ def receiver_kind(m: ModuleModel, u: Unit,
             return ("queue", u.cls.queue_attrs[attr])
         if attr in (u.cls.thread_attrs | u.cls.thread_list_attrs):
             return ("thread", None)
+        if attr in u.cls.ring_attrs:
+            return ("ring", None)
     if len(recv) == 1:
         name = recv[0]
         if name in u.local_conds:
@@ -311,6 +334,8 @@ def receiver_kind(m: ModuleModel, u: Unit,
             return ("queue", m.module_queues[name])
         if name in u.local_threads:
             return ("thread", None)
+        if name in u.local_rings:
+            return ("ring", None)
     # cross-object, same-file: resolve by ATTRIBUTE name (w.sender_q)
     tail = recv[-1]
     if len(recv) >= 2:
@@ -320,6 +345,8 @@ def receiver_kind(m: ModuleModel, u: Unit,
             return ("event", None)
         if tail in m.attr_threads:
             return ("thread", None)
+        if tail in m.attr_rings:
+            return ("ring", None)
     return None
 
 

@@ -27,7 +27,9 @@ pioneered):
   the deadlock class fleet/router.py documents on ``_resolve_error``);
   unbounded ``.acquire()``; the HTTP transport
   (``post_predict`` / ``get_probe`` / ``urlopen`` / ``self._post`` /
-  ``self._probe``); and bus emission (``*.bus.counter/gauge/...`` —
+  ``self._probe``); a blocking shared-memory ring op (``<ring>.call``
+  — RingClient.call waits on the doorbell for up to the transport
+  timeout; fleet/shmring.py); and bus emission (``*.bus.counter/gauge/...`` —
   the writer takes its own non-reentrant lock and does file I/O, which
   must never serialize an admission path; pertgnn_tpu/telemetry/'s own
   internals are exempt, the bus IS telemetry). A same-file callee that
@@ -53,6 +55,8 @@ _TRANSPORT_SELF_ATTRS = {"_post", "_probe"}
 _BUS_METHODS = {"counter", "gauge", "histogram", "span", "trace_span",
                 "finish_trace", "start_trace"}
 _RESOLVE_METHODS = {"set_result", "set_exception"}
+# ring verbs that wait (try_push/try_pop are non-blocking by contract)
+_RING_BLOCKING = {"call"}
 
 
 def _blocking_desc(m, u, call: ast.Call, held: set,
@@ -78,6 +82,11 @@ def _blocking_desc(m, u, call: ast.Call, held: set,
         return f"Future.result on `{'.'.join(recv)}`"
     if attr == "join" and kind is not None and kind[0] == "thread":
         return f"Thread.join on `{'.'.join(recv)}`"
+    if attr in _RING_BLOCKING and kind is not None \
+            and kind[0] == "ring":
+        return (f"blocking ring transport op `{'.'.join(ch)}` — "
+                f"RingClient.call waits on the doorbell for the full "
+                f"transport timeout")
     if attr == "wait" and kind is not None:
         if kind[0] == "event":
             return f"Event.wait on `{'.'.join(recv)}`"

@@ -1,0 +1,123 @@
+"""graftsync pass — ring-protocol: the SPSC shared-memory ring's
+publication discipline is a proof obligation, not a comment.
+
+The graftwire ring (pertgnn_tpu/fleet/shmring.py) synchronizes producer
+and consumer with nothing but a per-slot sequence stamp: the producer
+writes the payload FIRST and publishes the stamp LAST; the consumer
+reads the stamp, copies the payload, then RE-reads the stamp — a
+mismatch means the copy raced an overwrite (a torn frame) and must be
+discarded. Both halves are ordinary lexical code, so one refactor that
+hoists the stamp write above the payload write (or drops the re-read)
+silently turns every wrap-around into corrupt frames. This pass pins
+the ordering statically, the same way lock-order pins the acquisition
+graph.
+
+Model: inside any one function, calls to the four protocol helpers —
+``_payload_write``/``_seq_write`` (producer) and ``_seq_read``/
+``_payload_read`` (consumer) — are collected in source order
+(receiver-agnostic: ``self._seq_write`` and ``ring._seq_write`` both
+count; the names are the contract, shmring.py documents them as such).
+
+- **publication-last** (producer): no ``_seq_write`` may precede a
+  later ``_payload_write`` in the same function. The stamp is the
+  commit; payload bytes written after it are visible to a concurrent
+  consumer as a committed-but-torn frame.
+- **read-validate-reread** (consumer): a function that calls
+  ``_payload_read`` must call ``_seq_read`` both BEFORE its first
+  payload read (validate: the slot is committed) and AFTER its last
+  (re-validate: the copy did not race a producer lap).
+
+Deliberate exceptions carry a justified entry in
+tools/graftsync/justify.py RING_PROTOCOL (none exist today — the
+protocol has no safe variant).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftsync import justify
+from tools.graftsync.passes import _sync_util as su
+
+RULE = "ring-protocol"
+
+_PRODUCER = ("_payload_write", "_seq_write")
+_CONSUMER = ("_seq_read", "_payload_read")
+_HELPERS = set(_PRODUCER) | set(_CONSUMER)
+
+
+def _protocol_calls(fn: ast.AST) -> list[tuple[str, int]]:
+    """(helper name, line) for every protocol-helper call inside one
+    function, in source order, closures included — a nested def that
+    touches the slot participates in the same frame's lifecycle."""
+    hits = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HELPERS):
+            hits.append((node.func.attr, node.lineno,
+                         node.col_offset))
+    hits.sort(key=lambda h: (h[1], h[2]))
+    return [(name, line) for name, line, _ in hits]
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+    for rel in ctx.files:
+        m = su.model_for(ctx, rel)
+        if m is None:
+            continue
+        for u in m.units:
+            calls = _protocol_calls(u.node)
+            if not calls:
+                continue
+            # publication-last: a _seq_write with a _payload_write
+            # after it commits a frame whose payload is still mutating
+            pw_lines = [ln for nm, ln in calls if nm == "_payload_write"]
+            if pw_lines:
+                last_pw = pw_lines[-1]
+                for nm, ln in calls:
+                    if nm == "_seq_write" and ln < last_pw:
+                        key = f"{u.qual}:publication-order"
+                        if justify.lookup(ctx, RULE, rel, key) is None:
+                            out.append(Violation(
+                                rule=RULE, path=rel, line=ln,
+                                message=(
+                                    f"{u.qual}: _seq_write at line "
+                                    f"{ln} precedes a _payload_write "
+                                    f"at line {last_pw} — the sequence "
+                                    f"stamp is the COMMIT; publishing "
+                                    f"before the payload is complete "
+                                    f"hands the consumer a torn "
+                                    f"frame"),
+                                key=key))
+                        break
+            # read-validate-reread: payload copies must be bracketed
+            # by stamp reads, or a producer lap goes undetected
+            pr_lines = [ln for nm, ln in calls if nm == "_payload_read"]
+            if pr_lines:
+                sr_lines = [ln for nm, ln in calls if nm == "_seq_read"]
+                if not sr_lines or sr_lines[0] > pr_lines[0]:
+                    key = f"{u.qual}:read-validate"
+                    if justify.lookup(ctx, RULE, rel, key) is None:
+                        out.append(Violation(
+                            rule=RULE, path=rel, line=pr_lines[0],
+                            message=(
+                                f"{u.qual}: _payload_read without a "
+                                f"preceding _seq_read — copying a slot "
+                                f"before checking its stamp reads "
+                                f"uncommitted bytes"),
+                            key=key))
+                if not sr_lines or sr_lines[-1] < pr_lines[-1]:
+                    key = f"{u.qual}:read-revalidate"
+                    if justify.lookup(ctx, RULE, rel, key) is None:
+                        out.append(Violation(
+                            rule=RULE, path=rel, line=pr_lines[-1],
+                            message=(
+                                f"{u.qual}: no _seq_read AFTER the "
+                                f"last _payload_read — without the "
+                                f"re-read, a producer lap during the "
+                                f"copy (torn frame) is undetectable"),
+                            key=key))
+    return out
