@@ -279,7 +279,7 @@ class RingServer:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("127.0.0.1", 0))
-        self._sock.listen(1)
+        self._sock.listen(4)
         self._sock.settimeout(0.25)
         self._thread = threading.Thread(target=self._serve_loop,
                                         daemon=True,
@@ -297,38 +297,66 @@ class RingServer:
                 "pid": os.getpid()}
 
     def _serve_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._sock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return  # listener closed: shutdown
-            with conn:
-                self._serve_conn(conn)
+        """select over the listener AND every live doorbell conn: a
+        stale connection nobody explicitly closed (a removed worker's
+        sender thread's thread-local client, freed only at GC) must
+        never starve a fresh attach — the new client's tokens are
+        serviced even while the old connection lingers."""
+        conns: list[socket.socket] = []
+        try:
+            while not self._stop.is_set():
+                try:
+                    ready, _, _ = select.select([self._sock, *conns],
+                                                [], [], 0.25)
+                except (OSError, ValueError):
+                    return  # listener closed: shutdown
+                for sock_ in ready:
+                    if sock_ is self._sock:
+                        try:
+                            conn, _ = self._sock.accept()
+                        except (socket.timeout, OSError):
+                            continue
+                        conn.settimeout(0.25)
+                        # bell tokens must never sit in Nagle's buffer
+                        # behind a delayed ACK — the doorbell IS the
+                        # latency path
+                        conn.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                        conns.append(conn)
+                    elif not self._recv_token(sock_):
+                        conns.remove(sock_)
+                        self._hangup(sock_)
+                # drain on every wakeup — token, fresh attach, or the
+                # bounded poll (belt over the bell: tokens coalesce);
+                # a broken drain drops every attached client (they
+                # re-probe) but keeps listening
+                if conns and not self._drain(conns):
+                    for conn in conns:
+                        self._hangup(conn)
+                    conns.clear()
+        finally:
+            for conn in conns:
+                self._hangup(conn)
 
-    def _serve_conn(self, conn) -> None:
-        """One attached router: drain the request ring on every bell
-        token (and on a bounded poll, belt over the bell), until the
-        peer hangs up or close() stops us."""
-        conn.settimeout(0.25)
-        # bell tokens must never sit in Nagle's buffer behind a
-        # delayed ACK — the doorbell IS the latency path
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        while not self._stop.is_set():
-            try:
-                token = conn.recv(64)
-            except socket.timeout:
-                token = b"?"  # poll anyway: a token can be coalesced
-            except OSError:
-                return
-            else:
-                if not token:
-                    return  # peer closed: back to accept
-            if not self._drain(conn):
-                return
+    @staticmethod
+    def _hangup(conn) -> None:
+        try:
+            conn.close()
+        except OSError:
+            pass
 
-    def _drain(self, conn) -> bool:
+    @staticmethod
+    def _recv_token(conn) -> bool:
+        """One ready doorbell read; False means the peer hung up."""
+        try:
+            token = conn.recv(64)
+        except socket.timeout:
+            return True   # raced the readiness away: still alive
+        except OSError:
+            return False
+        return bool(token)
+
+    def _drain(self, conns: list) -> bool:
         while True:
             try:
                 frame = self._req.try_pop()
@@ -346,10 +374,14 @@ class RingServer:
                 if self._stop.is_set() or time.monotonic() > deadline:
                     return False
                 time.sleep(0.0005)
-            try:
-                conn.sendall(b"!")
-            except OSError:
-                return False
+            # ring every live bell — only the current client matches
+            # the correlation id; stale conns just get a benign token
+            for conn in list(conns):
+                try:
+                    conn.sendall(b"!")
+                except OSError:
+                    conns.remove(conn)
+                    self._hangup(conn)
 
     def close(self) -> None:
         self._stop.set()
@@ -385,15 +417,19 @@ class RingClient:
             self.close()
             raise RingPeerDead(f"doorbell connect failed: "
                                f"{exc}") from exc
-        self._corr = 0
 
     def call(self, payload: bytes, timeout_s: float) -> bytes:
         """One bounded round trip. Raises RingTimeout past the
         deadline, RingPeerDead on a reset doorbell, RingTornWrite on a
         broken slot — the transport maps all of them to the
         lost-worker path, so every router Future still resolves."""
-        self._corr += 1
-        corr = _CORR.pack(self._corr)
+        # the correlation id IS the request's ring sequence number:
+        # sequences live in shared memory and only ever advance, so an
+        # id can never collide across attaches — a late response to a
+        # call an earlier (since-dropped) client abandoned in the ring
+        # always mismatches and is discarded below, never accepted as
+        # THIS call's predictions
+        corr = _CORR.pack(self._req._produced + 1)
         deadline = time.monotonic() + timeout_s
         while not self._req.try_push(corr + payload):
             self._await_bell(deadline, "request ring full")

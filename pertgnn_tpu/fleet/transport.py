@@ -585,9 +585,12 @@ class FleetTransport:
                                             dg=dg, lens=lens)
                 bus = self.bus
                 try:
+                    raw = ring.call(frame, timeout_s)
+                    # counted only after the frame actually travelled
+                    # the ring — an oversize frame falls back to HTTP
+                    # and must not be double-counted across wires
                     bus.counter("transport.bytes_out", len(frame),
                                 level=2, wire="shm")
-                    raw = ring.call(frame, timeout_s)
                     bus.counter("transport.bytes_in", len(raw),
                                 level=2, wire="shm")
                     rows = wire.decode_response(raw)

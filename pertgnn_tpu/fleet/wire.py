@@ -308,6 +308,16 @@ def decode_response(buf: bytes) -> list[dict]:
     errors = (_unpack_json(sections[_TAG_ERRORS], "errors")
               if _TAG_ERRORS in sections else [])
     vec_buf = sections.get(_TAG_VECTORS, b"")
+    n_vector = sum(1 for k in kinds if k == _ROW_VECTOR)
+    if vec_buf:
+        if len(vec_buf) < 4:
+            raise WireFormatError("vectors: truncated count")
+        (nvec,) = _U32.unpack_from(vec_buf)
+    else:
+        nvec = 0
+    if nvec != n_vector:
+        raise WireFormatError(f"vectors: section declares {nvec} "
+                              f"blocks for {n_vector} vector rows")
     vec_off = 4 if vec_buf else 0
     rows: list[dict] = []
     s_i = e_i = 0
@@ -336,6 +346,9 @@ def decode_response(buf: bytes) -> list[dict]:
             e_i += 1
         else:
             raise WireFormatError(f"unknown rowkind {k}")
+    if vec_off != len(vec_buf):
+        raise WireFormatError(f"vectors: {len(vec_buf) - vec_off} "
+                              f"trailing bytes after the last block")
     for item in (_unpack_json(sections[_TAG_ATTR], "attr")
                  if _TAG_ATTR in sections else []):
         if (not isinstance(item, list) or len(item) != 2

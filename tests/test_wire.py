@@ -163,6 +163,27 @@ def test_duplicate_section_refused():
         wire.decode_request(frame)
 
 
+def test_vector_count_mismatch_refused():
+    """The vectors section's nvec prefix must equal the number of
+    vector rowkinds, and the section must be fully consumed — a wrong
+    count or trailing garbage is a typed refusal, same strictness as
+    every other section."""
+    rowkind = wire._section(
+        wire._TAG_ROWKIND, struct.pack("<I", 1) + bytes([wire._ROW_VECTOR]))
+    block = struct.pack("<BI", 4, 1) + struct.pack("<f", 1.0)
+    bad_count = wire._frame(wire.KIND_RESPONSE, [
+        rowkind,
+        wire._section(wire._TAG_VECTORS, struct.pack("<I", 2) + block)])
+    with pytest.raises(wire.WireFormatError, match="vector"):
+        wire.decode_response(bad_count)
+    bad_tail = wire._frame(wire.KIND_RESPONSE, [
+        rowkind,
+        wire._section(wire._TAG_VECTORS,
+                      struct.pack("<I", 1) + block + b"\x00")])
+    with pytest.raises(wire.WireFormatError, match="trailing"):
+        wire.decode_response(bad_tail)
+
+
 def test_refusal_frame_raises_wire_refusal():
     buf = wire.encode_refusal("WireFormatError", "version skew v9")
     with pytest.raises(wire.WireRefusal, match="version skew v9"):
@@ -286,3 +307,65 @@ def test_ring_server_death_surfaces_as_peer_dead():
             client.call(b"anyone-there", timeout_s=1.0)
     finally:
         client.close()
+
+
+def test_reattached_client_rejects_stale_response():
+    """A response the worker pushes AFTER a timed-out client was
+    dropped must never be accepted by a re-attached client: the
+    correlation id is the request ring's shm-persistent sequence
+    number, so the stale frame always mismatches and is discarded —
+    never returned as another batch's predictions."""
+    release = threading.Event()
+    slow_once = [True]
+
+    def handle(b):
+        if slow_once[0]:
+            slow_once[0] = False
+            release.wait(5.0)                 # wedge the FIRST call
+        return b"echo:" + b
+
+    server = shmring.RingServer(handle, slots=4, slot_bytes=128)
+    first = shmring.RingClient(server.advertisement())
+    try:
+        with pytest.raises(shmring.RingTimeout):
+            first.call(b"abandoned", timeout_s=0.3)
+        first.close()                         # transport drops the ring
+        release.set()                         # …the worker answers late
+        deadline = time.monotonic() + 5.0
+        while (server._rsp._load_ctr(shmring._PRODUCED_OFF) == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.005)                 # stale frame is IN the ring
+        fresh = shmring.RingClient(server.advertisement())
+        try:
+            assert fresh.call(b"fresh", timeout_s=5.0) == b"echo:fresh"
+        finally:
+            fresh.close()
+    finally:
+        release.set()
+        server.close()
+
+
+def test_fresh_attach_serviced_past_stale_connection():
+    """A doorbell connection nobody closed (an abandoned client) must
+    not starve a newly attached client — the server selects over ALL
+    live connections, so the fresh client's calls ride the bell, not
+    the 0.25s poll fallback."""
+    server = shmring.RingServer(lambda b: b, slots=4, slot_bytes=128)
+    stale = shmring.RingClient(server.advertisement())
+    fresh = None
+    try:
+        assert stale.call(b"once", timeout_s=5.0) == b"once"
+        # stale's bell conn stays open; a second client attaches
+        fresh = shmring.RingClient(server.advertisement())
+        t0 = time.monotonic()
+        for i in range(5):
+            msg = f"m{i}".encode()
+            assert fresh.call(msg, timeout_s=5.0) == msg
+        # bell-driven round trips are sub-millisecond; the old
+        # one-connection accept loop cost ~0.25s/call via the poll
+        assert time.monotonic() - t0 < 1.0
+    finally:
+        stale.close()
+        if fresh is not None:
+            fresh.close()
+        server.close()
