@@ -117,6 +117,7 @@ def bench_variant(variant, bucket, case, ref, reps):
     reference, wall times, cost analysis, roofline attribution."""
     import jax
 
+    from pertgnn_tpu.telemetry import devmem
     from pertgnn_tpu.utils import flops as flops_util
 
     n, e = bucket
@@ -176,6 +177,9 @@ def bench_variant(variant, bucket, case, ref, reps):
             attention_impl=variant, dtype="f32",
             graphs_per_s=(1e3 / fwd_ms) if fwd_ms else None,
             flops_per_graph=f_cost, bytes_per_graph=b_cost),
+        # post-timing allocator state (ISSUE 17): peak bytes include the
+        # timed kernel's live buffers; None off-chip (no memory_stats)
+        "mem": devmem.device_memory_stats(),
     }
     return row
 
@@ -195,6 +199,8 @@ def main() -> int:
     apply_platform_env()
 
     import jax
+
+    from pertgnn_tpu.telemetry import devmem
 
     rows, failures = [], []
     for bi, bucket in enumerate(BUCKETS):
@@ -216,6 +222,8 @@ def main() -> int:
         "parity_failures": len(failures),
         "backend": jax.default_backend(),
         "backend_fallback": fallback,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "") or "",
+        "device_mem": devmem.device_memory_stats(),
         "captured_unix_time": time.time(),
     }
     print(json.dumps(summary), flush=True)

@@ -9,10 +9,18 @@
 #   0. bench.py --precompile (once per round) — populates the on-disk
 #      compile cache so the capture window's first step is execute-only
 #      (ISSUE 3); its stats row (cache hit/miss split) lands in $OUT.
-#   1. bench.py — pins benchmarks/last_good_tpu.json on success; on a
-#      mid-run wedge (the outer timeout kills it) the per-window partial
-#      file is promoted by `bench.py --finalize-partial` (host-only), so
-#      >=3 captured fit windows are never lost again.
+#   1. bench.py --capture (graftprobe, ISSUE 17) — the journaled stage
+#      machine: every completed stage persists to the capture journal,
+#      so a window that closes mid-run costs only the in-flight stage
+#      and the NEXT healthy window re-enters at the first incomplete
+#      stage instead of restarting the bench (rc=3 window closed /
+#      rc=4 wedged are resumable, not failures). Pins
+#      benchmarks/last_good_tpu.json when the stitched capture is
+#      on-chip; `bench.py --finalize-partial` (host-only) additionally
+#      folds the journal, so >=3 captured fit windows are never lost.
+#      Every probe attempt is journaled too (timestamp/outcome/latency)
+#      so adjudicate.py reports measured tunnel availability, and any
+#      journaled wedge stage is logged on the next poll.
 #   2. the adjudication configs (flagship_chip, deep_wide, deep_wide_bf16,
 #      giant_dag, pallas_crossover) — one row each into $OUT, with a
 #      .r5_done marker per config so a retry window only runs what's
@@ -31,6 +39,41 @@ UPGRADE_TRIES=${TPU_WATCH_UPGRADE_TRIES:-2}
 # relay's recovery timescale — a wedged config must not hold a recovered
 # tunnel hostage for a full hour before the next retry
 CFG_TIMEOUT=${TPU_WATCH_CFG_TIMEOUT:-1800}
+JOURNAL=${BENCH_CAPTURE_JOURNAL:-benchmarks/capture_journal.jsonl}
+
+# Journal every probe attempt (ISSUE 17): the timestamp rides the
+# record envelope; adjudicate.py turns the sequence into the round's
+# tunnel-availability statistics (healthy-window count + duration
+# histogram). A journaling failure must never kill the watcher.
+journal_probe() {  # $1 = 1|0 (ok), $2 = latency seconds
+  python - "$JOURNAL" "$1" "$2" <<'EOF' 2>/dev/null || true
+import sys
+from pertgnn_tpu.telemetry.capture import journal_probe
+journal_probe(sys.argv[1], ok=sys.argv[2] == "1",
+              latency_s=float(sys.argv[3]))
+EOF
+}
+
+last_wedges=0
+# On each poll, log any NEWLY journaled wedge stage (graftprobe's
+# watchdog / orphan diagnosis): the r5 failure mode was 12+ hours of
+# probing with zero hint of WHERE the capture died.
+wedge_check() {
+  local w n stage
+  w=$(python - "$JOURNAL" <<'EOF' 2>/dev/null
+import sys
+from pertgnn_tpu.telemetry.capture import CaptureJournal, wedged_stages
+ws = wedged_stages(CaptureJournal(sys.argv[1]).records())
+print(len(ws), ws[-1] if ws else "-")
+EOF
+) || return 0
+  n=${w%% *}; stage=${w#* }
+  if [ -n "$n" ] && [ "$n" -gt "$last_wedges" ] 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) capture wedged inside stage '$stage'" \
+         "($n wedge record(s) journaled)"
+    last_wedges=$n
+  fi
+}
 
 # A pin only suppresses the headline bench if it parses, is on-chip, and
 # is fresh (<24 h): a stale or corrupt leftover from an earlier run must
@@ -59,7 +102,7 @@ upgrades_used=0
 # hitting a concurrent index.lock just returns — retried next window.
 commit_capture() {
   local paths=() p err
-  for p in "$PIN" "$OUT"; do [ -f "$p" ] && paths+=("$p"); done
+  for p in "$PIN" "$OUT" "$JOURNAL"; do [ -f "$p" ] && paths+=("$p"); done
   [ ${#paths[@]} -eq 0 ] && return 0
   # a persistent add failure (ownership, future ignore rule) must be
   # VISIBLE in the log, or the feature can be dead all round unnoticed —
@@ -95,7 +138,10 @@ rm -f benchmarks/.precompiled_this_round
 trap 'if [ -f benchmarks/cpu_hogs.pid ]; then
         xargs -r kill -CONT -- < benchmarks/cpu_hogs.pid 2>/dev/null; fi' EXIT
 for i in $(seq 1 "$PROBES"); do
+  wedge_check
+  p0=$SECONDS
   if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    journal_probe 1 $((SECONDS - p0))
     echo "$(date -u +%FT%TZ) tunnel healthy (probe $i)"
     # single-core host: pause background CPU hogs (e.g. long test or
     # quality runs) so host-side dispatch isn't starved mid-measurement
@@ -131,18 +177,25 @@ for i in $(seq 1 "$PROBES"); do
         # one retries in the next healthy window
         [ $prc -eq 0 ] && touch benchmarks/.precompiled_this_round
       fi
-      echo "$(date -u +%FT%TZ) running bench.py"
+      echo "$(date -u +%FT%TZ) running bench.py --capture"
       ran_bench=1
       bench_out=$(mktemp)
-      BENCH_PROBE_TIMEOUT=75 BENCH_PROBE_TRIES=2 timeout 5400 python bench.py \
-        | tee "$bench_out"
+      BENCH_PROBE_TIMEOUT=75 BENCH_PROBE_TRIES=2 timeout 5400 \
+        python bench.py --capture | tee "$bench_out"
       rc=${PIPESTATUS[0]}
       echo "$(date -u +%FT%TZ) bench exited rc=$rc"
       if [ $rc -ne 0 ]; then
         bench_ok=0
+        # rc=3/4 are graftprobe's RESUMABLE exits — the journal holds
+        # every completed stage and the next healthy window re-enters
+        # at the first incomplete one (a window closed / wedged stage
+        # costs only itself, never the round)
+        [ $rc -eq 3 ] && echo "$(date -u +%FT%TZ) capture window closed (journal resumable; will re-enter)"
+        [ $rc -eq 4 ] && { echo "$(date -u +%FT%TZ) capture stage wedged (diagnosis journaled; will re-enter)"; wedge_check; }
         # promote whatever windows the dead bench flushed (host-only,
-        # cannot dial the wedged tunnel); an existing partial pin
-        # survives if this attempt produced nothing better
+        # cannot dial the wedged tunnel) — the finalizer now also folds
+        # the capture journal; an existing partial pin survives if this
+        # attempt produced nothing better
         JAX_PLATFORMS=cpu timeout 1800 python bench.py --finalize-partial
         frc=$?
         echo "$(date -u +%FT%TZ) finalize-partial rc=$frc"
@@ -227,6 +280,7 @@ EOF
         && echo "$(date -u +%FT%TZ) resumed cpu hogs"
     fi
   else
+    journal_probe 0 $((SECONDS - p0))
     echo "$(date -u +%FT%TZ) probe $i wedged"
   fi
   sleep "$SLEEP"

@@ -30,6 +30,7 @@ import time
 import jax
 
 from pertgnn_tpu import telemetry
+from pertgnn_tpu.telemetry.devmem import sample_device_memory
 from pertgnn_tpu.aot import enable_compile_cache
 from pertgnn_tpu.config import Config
 
@@ -125,4 +126,9 @@ def precompile_train(dataset, cfg: Config, *, include_packed: bool = False,
         # compiles; misses are the fresh ones this stage just persisted
         "xla_cache_hits": cache["hits"],
         "xla_cache_misses": cache["misses"],
+        # post-compile allocator state (ISSUE 17): what the primed
+        # programs cost in device memory before any capture window
+        # opens; None on backends without memory_stats (CPU)
+        "device_mem": sample_device_memory(bus, where="precompile",
+                                           device=dev),
     }

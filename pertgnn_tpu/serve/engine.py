@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pertgnn_tpu import telemetry
+from pertgnn_tpu.telemetry.devmem import sample_device_memory
 from pertgnn_tpu.batching.featurize import ResourceLookup
 from pertgnn_tpu.batching.mixture import Mixture
 from pertgnn_tpu.batching.pack import (ArenaLease, BatchBudget, PackArena,
@@ -387,6 +388,10 @@ class InferenceEngine:
                         self._compile(i, local)
         self.warmup_s = time.perf_counter() - t0
         self._warmed = True
+        # post-warmup allocator state (ISSUE 17): every rung executable
+        # + weights resident — the serve fleet's steady-state footprint.
+        # None-safe no-op on backends without memory_stats (CPU).
+        sample_device_memory(self._bus, where="serve_warmup")
         log.info("serve warmup: %d bucket executables in %.2fs "
                  "(%d compiled, %d deserialized%s; ladder %s)",
                  len(self._exe), self.warmup_s, self.compiles,

@@ -37,6 +37,8 @@ from __future__ import annotations
 
 from pertgnn_tpu.telemetry.bus import (NOOP_BUS, NULL_SPAN, NoopBus,
                                        TelemetryBus, parse_level)
+from pertgnn_tpu.telemetry.devmem import (device_memory_stats,
+                                          sample_device_memory)
 from pertgnn_tpu.telemetry.jaxmon import (install_jax_monitoring,
                                           watch_xla_cache)
 from pertgnn_tpu.telemetry.schema import (SCHEMA_VERSION, SchemaError,
@@ -50,6 +52,7 @@ __all__ = [
     "NOOP_BUS", "NULL_SPAN", "NoopBus", "TelemetryBus", "MetricsWriter",
     "SCHEMA_VERSION", "SchemaError", "validate_event", "iter_events",
     "load_events", "parse_level", "install_jax_monitoring",
+    "device_memory_stats", "sample_device_memory",
     "watch_xla_cache", "configure", "configure_from_config", "get_bus",
     "set_bus", "span", "shutdown", "TraceContext", "new_trace_id",
     "new_span_id",

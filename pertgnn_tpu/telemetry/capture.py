@@ -1,0 +1,629 @@
+"""graftprobe — the journaled sub-minute capture state machine.
+
+The axon relay grants TPU windows measured in SECONDS (BENCH r03–r05:
+every gated round fell back to CPU; the one tunnel window of round 5
+closed in under a minute with the bench wedged inside its first device
+ops). A monolithic run-or-wedge capture cannot land a measurement in
+that regime, so the capture decomposes into a PLAN of small resumable
+stages — backend probe → arena warm → precompile → cost analysis →
+torch baseline → per-window measured fit/ceiling/compact steps — and
+every completed stage persists one atomic record to an append-only
+journal. A window closing mid-stage loses only the in-flight step;
+``bench.py --capture`` re-enters at the first incomplete stage and
+never re-runs a journaled one; ``benchmarks/adjudicate.py --stitch``
+assembles a valid interleaved measurement out of the fragments.
+
+The journal rides the telemetry schema-v2 event format (one JSON
+object per line, ``validate_event``-clean, wall + monotonic stamps,
+pid): the same crash-at-line-granularity durability contract as
+telemetry/writer.py, at a FIXED path so re-entry can find it. Record
+names:
+
+- ``capture.run``   — one per process entry: commit, config
+  fingerprint, backend/device_kind (the stitch-compatibility identity).
+- ``capture.stage`` — the state machine: ``fields.stage`` +
+  ``fields.status`` in {``started``, ``done``, ``aborted``,
+  ``wedged``}; ``done`` records carry the stage's metrics (and, for
+  measured windows, a per-window roofline attribution row + a
+  ``device.mem.*`` sample).
+- ``capture.probe`` — one per watcher probe attempt (timestamp,
+  outcome, latency) so adjudicate.py can report tunnel-availability
+  statistics instead of folklore.
+
+Wedge diagnosis: each stage runs under a watchdog — SIGALRM at
+``watchdog_s`` journals the stage ``wedged`` with an all-thread
+``faulthandler`` dump and raises (the interruptible case), and a
+C-level ``faulthandler.dump_traceback_later(2x, exit=True)`` backstop
+dumps and kills the process when the main thread is stuck inside an
+uninterruptible PJRT call (the observed relay failure mode — a blocked
+C call never runs Python signal handlers). A process killed that hard
+leaves a ``started`` record with no terminal status; the next entry
+journals it ``wedged`` (``reason="orphaned_start"``) so the stage name
+survives for the watcher's log and the stage re-runs.
+
+Stage catalog order note: the ISSUE names "probe → precompile → arena
+warm", but ``aot.precompile.precompile_train`` consumes the built
+dataset, so the executable order is probe → arena_warm → precompile —
+the precompile stage compiles against the warmed arena.
+
+Pure-host module: no jax / numpy at import time (tpu_watch.sh journals
+probe attempts from a bare python one-liner between polls).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import logging
+import os
+import signal
+import sys
+import time
+
+from pertgnn_tpu.telemetry.schema import SCHEMA_VERSION, validate_event
+
+log = logging.getLogger(__name__)
+
+RUN_EVENT = "capture.run"
+STAGE_EVENT = "capture.stage"
+PROBE_EVENT = "capture.probe"
+
+STATUS_STARTED = "started"
+STATUS_DONE = "done"
+STATUS_ABORTED = "aborted"   # clean budget close — the window ended
+STATUS_WEDGED = "wedged"     # watchdog fired, or an orphaned start
+
+OUTCOME_COMPLETE = "complete"
+OUTCOME_WINDOW_CLOSED = "window_closed"
+OUTCOME_WEDGED = "wedged"
+
+# bench.py --capture exit codes: distinct from generic failure (1) so
+# tpu_watch.sh can tell "resumable, re-enter next window" from "broken"
+EXIT_WINDOW_CLOSED = 3
+EXIT_WEDGED = 4
+
+# pre-window stages, in executable order (see module docstring)
+SETUP_STAGES = ("probe", "arena_warm", "precompile", "cost", "baseline")
+_WINDOW_KINDS = ("fit", "ceiling", "compact")
+
+
+class CaptureWedged(RuntimeError):
+    """A stage's watchdog fired: the device op wedged past its deadline
+    but the wait was signal-interruptible, so the process survives to
+    journal the diagnosis and exit resumable."""
+
+
+class StitchRefused(ValueError):
+    """The journal's fragments cannot honestly form one measurement
+    (mixed commits/configs/backends, too few windows, no identity)."""
+
+
+def stage_plan(windows: int) -> list[str]:
+    """The full ordered stage list for a capture of `windows` measured
+    windows. Every entry of a resumed capture runs THIS plan and skips
+    what the journal already holds."""
+    plan = list(SETUP_STAGES)
+    for i in range(windows):
+        for kind in _WINDOW_KINDS:
+            plan.append(f"window:{i:02d}:{kind}")
+    return plan
+
+
+def window_of(stage: str) -> tuple[int, str] | None:
+    """(window id, kind) for a ``window:NN:kind`` stage, else None."""
+    parts = stage.split(":")
+    if len(parts) != 3 or parts[0] != "window":
+        return None
+    try:
+        return int(parts[1]), parts[2]
+    except ValueError:
+        return None
+
+
+class CaptureJournal:
+    """Append-only JSONL journal of schema-v2 meta events at a fixed
+    path. One ``write()`` of one full line per record (flushed — the
+    MetricsWriter durability contract: a kill loses at most the final
+    partial line), reader skips undecodable/invalid lines LOUDLY but
+    never fatally."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.skipped_lines = 0
+
+    def append(self, name: str, fields: dict) -> dict:
+        ev = {
+            "v": SCHEMA_VERSION,
+            "t": time.time(),
+            "tm": time.monotonic(),
+            "pid": os.getpid(),
+            # single-host bench machinery: the journal is written by the
+            # capture process and the watcher's helper one-liners, never
+            # by a multi-host mesh run
+            "pi": 0,
+            "kind": "meta",
+            "name": name,
+            "fields": fields,
+        }
+        validate_event(ev)
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(ev) + "\n")
+            f.flush()
+        return ev
+
+    def stage(self, stage: str, status: str, *, window: int | None = None,
+              **fields) -> dict:
+        payload: dict = {"stage": stage, "status": status}
+        if window is None:
+            win = window_of(stage)
+            if win is not None:
+                window = win[0]
+        if window is not None:
+            payload["window"] = window
+        payload.update(fields)
+        return self.append(STAGE_EVENT, payload)
+
+    def records(self) -> list[dict]:
+        """Every valid journal record, in order. Corrupt or truncated
+        lines are counted + warned about (``self.skipped_lines``) and
+        skipped — a torn final line is the expected signature of a
+        window that closed mid-write, never a reason to lose the
+        journal."""
+        try:
+            with open(self.path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return []
+        out: list[dict] = []
+        skipped = 0
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = validate_event(json.loads(line))
+            except (ValueError, TypeError) as e:
+                skipped += 1
+                log.warning("capture journal %s: skipping bad line %d "
+                            "(%s)", self.path, i + 1, e)
+                continue
+            out.append(ev)
+        self.skipped_lines = skipped
+        return out
+
+
+def stage_records(records: list[dict]) -> list[dict]:
+    return [r for r in records if r.get("name") == STAGE_EVENT]
+
+
+def completed_stages(records: list[dict]) -> dict[str, dict]:
+    """stage -> the FIELDS of its first ``done`` record. First wins:
+    the runner never re-runs a done stage, so duplicates would mean a
+    corrupted journal — the earliest record is the real measurement."""
+    done: dict[str, dict] = {}
+    for r in stage_records(records):
+        f = r.get("fields") or {}
+        if f.get("status") == STATUS_DONE and f.get("stage"):
+            done.setdefault(f["stage"], f)
+    return done
+
+
+def _last_status(records: list[dict]) -> dict[str, str]:
+    last: dict[str, str] = {}
+    for r in stage_records(records):
+        f = r.get("fields") or {}
+        if f.get("stage") and f.get("status"):
+            last[f["stage"]] = f["status"]
+    return last
+
+
+def first_incomplete(plan: list[str], records: list[dict]) -> str | None:
+    """The re-entry point: the first plan stage with no ``done``
+    record, or None when the capture is complete."""
+    done = completed_stages(records)
+    for stage in plan:
+        if stage not in done:
+            return stage
+    return None
+
+
+def orphaned_stages(records: list[dict]) -> list[str]:
+    """Stages whose LAST record is ``started`` — the process died (or
+    was killed by the faulthandler backstop / the watcher's outer
+    timeout) inside them with no chance to journal an outcome."""
+    return [s for s, st in _last_status(records).items()
+            if st == STATUS_STARTED]
+
+
+def wedged_stages(records: list[dict]) -> list[str]:
+    """Every stage ever journaled ``wedged``, in journal order (the
+    watcher logs the tail of this on its next poll)."""
+    out = []
+    for r in stage_records(records):
+        f = r.get("fields") or {}
+        if f.get("status") == STATUS_WEDGED and f.get("stage"):
+            out.append(f["stage"])
+    return out
+
+
+def journal_probe(path: str, *, ok: bool, latency_s: float,
+                  source: str = "tpu_watch") -> dict:
+    """One watcher probe attempt (timestamp rides the envelope). Called
+    by tpu_watch.sh between polls so 'the tunnel never opened' becomes
+    a measured claim."""
+    return CaptureJournal(path).append(PROBE_EVENT, {
+        "ok": bool(ok), "latency_s": float(latency_s), "source": source})
+
+
+def probe_availability(records: list[dict]) -> dict:
+    """Tunnel-availability statistics from the journaled probe
+    attempts: healthy-window count + duration histogram (consecutive
+    ``ok`` probes form one window; its duration is last-ok minus
+    first-ok wall time, so a lone healthy probe counts as a sub-minute
+    window)."""
+    probes = [(r["t"], bool((r.get("fields") or {}).get("ok")),
+               (r.get("fields") or {}).get("latency_s"))
+              for r in records if r.get("name") == PROBE_EVENT]
+    attempts = len(probes)
+    ok_n = sum(1 for _, ok, _ in probes if ok)
+    durations: list[float] = []
+    start = last = None
+    for t, ok, _ in probes:
+        if ok:
+            start = t if start is None else start
+            last = t
+        elif start is not None:
+            durations.append(last - start)
+            start = last = None
+    if start is not None:
+        durations.append(last - start)
+    hist = {"lt_60s": 0, "60_300s": 0, "300_1800s": 0, "gt_1800s": 0}
+    for d in durations:
+        if d < 60:
+            hist["lt_60s"] += 1
+        elif d < 300:
+            hist["60_300s"] += 1
+        elif d < 1800:
+            hist["300_1800s"] += 1
+        else:
+            hist["gt_1800s"] += 1
+    lats = sorted(x for _, _, x in probes if isinstance(x, (int, float)))
+    return {
+        "probe_attempts": attempts,
+        "probe_ok": ok_n,
+        "availability_pct": (round(100.0 * ok_n / attempts, 1)
+                             if attempts else None),
+        "healthy_windows": len(durations),
+        "window_durations_s": [round(d, 1) for d in durations],
+        "window_histogram": hist,
+        "median_probe_latency_s": (lats[len(lats) // 2]
+                                   if lats else None),
+    }
+
+
+class StageWatchdog:
+    """Wedge diagnosis around one capture stage (its first device op
+    included). Two layers:
+
+    - SIGALRM at ``timeout_s`` (main thread, interruptible waits):
+      dumps every thread's stack via faulthandler, journals the stage
+      ``wedged``, raises CaptureWedged — the process survives and exits
+      resumable.
+    - ``faulthandler.dump_traceback_later(2 x timeout_s, exit=True)``
+      (C-level watchdog thread): when the main thread is stuck inside
+      an uninterruptible PJRT call and the SIGALRM handler can never
+      run, this still dumps all threads and hard-exits; the orphaned
+      ``started`` record gets journaled ``wedged`` by the next entry.
+
+    Both are cancelled on clean stage completion."""
+
+    def __init__(self, journal: CaptureJournal, stage: str,
+                 timeout_s: float, dump_path: str | None = None):
+        self.journal = journal
+        self.stage_name = stage
+        self.timeout_s = timeout_s
+        self.dump_path = dump_path
+        self._dump_file = None
+        self._prev_handler = None
+        self._armed_sigalrm = False
+
+    def _sink(self):
+        return self._dump_file if self._dump_file is not None else sys.stderr
+
+    def __enter__(self):
+        if self.dump_path:
+            try:
+                os.makedirs(os.path.dirname(os.path.abspath(self.dump_path)),
+                            exist_ok=True)
+                self._dump_file = open(self.dump_path, "a")
+                self._dump_file.write(
+                    f"# stage {self.stage_name} armed at {time.time():.3f} "
+                    f"(timeout {self.timeout_s}s)\n")
+                self._dump_file.flush()
+            except OSError as e:
+                log.warning("watchdog dump file %s unavailable (%s); "
+                            "dumping to stderr", self.dump_path, e)
+                self._dump_file = None
+        try:
+            faulthandler.dump_traceback_later(
+                2 * self.timeout_s, exit=True, file=self._sink())
+        except (ValueError, OSError, RuntimeError) as e:
+            log.warning("faulthandler backstop unavailable: %s", e)
+        if hasattr(signal, "SIGALRM"):
+            try:
+                self._prev_handler = signal.signal(signal.SIGALRM,
+                                                   self._on_alarm)
+                signal.setitimer(signal.ITIMER_REAL, self.timeout_s)
+                self._armed_sigalrm = True
+            except ValueError as e:
+                # not the main thread — the faulthandler backstop still
+                # covers the hard-wedge case
+                log.warning("SIGALRM watchdog unavailable: %s", e)
+        return self
+
+    def _on_alarm(self, signum, frame):
+        try:
+            faulthandler.dump_traceback(file=self._sink(), all_threads=True)
+        except (ValueError, OSError) as e:  # sink closed under us
+            log.warning("watchdog stack dump failed: %s", e)
+        self.journal.stage(self.stage_name, STATUS_WEDGED,
+                           reason="watchdog_sigalrm",
+                           timeout_s=self.timeout_s,
+                           dump_path=self.dump_path)
+        raise CaptureWedged(
+            f"stage {self.stage_name!r} wedged past {self.timeout_s}s")
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._armed_sigalrm:
+            signal.setitimer(signal.ITIMER_REAL, 0.0)
+            signal.signal(signal.SIGALRM, self._prev_handler)
+        try:
+            faulthandler.cancel_dump_traceback_later()
+        except (ValueError, RuntimeError) as e:  # pragma: no cover
+            log.warning("cancel_dump_traceback_later failed: %s", e)
+        if self._dump_file is not None:
+            self._dump_file.close()
+            self._dump_file = None
+        return False
+
+
+class CaptureRunner:
+    """Drive the stage plan against the journal: skip every journaled
+    ``done`` stage, run the rest in order under the watchdog + window
+    budget, journal each outcome atomically.
+
+    ``runners`` maps stage name -> zero-arg callable returning the
+    stage's metrics dict (journaled on the ``done`` record). Budgets
+    model the sub-minute window: ``budget_s`` (wall seconds for this
+    entry, via the injectable ``clock``) and ``budget_stages`` (close
+    after N completed stages — the deterministic kill the tests and the
+    CI ``--simulate-windows`` dryrun use). Either budget fires AFTER
+    the next stage journals ``started``: the journal always shows
+    exactly which in-flight step the closing window cost, and resume
+    re-enters at that stage."""
+
+    def __init__(self, journal: CaptureJournal, plan: list[str],
+                 runners: dict, *, budget_stages: int | None = None,
+                 budget_s: float | None = None, clock=time.monotonic,
+                 watchdog_s: float = 0.0, dump_path: str | None = None):
+        self.journal = journal
+        self.plan = plan
+        self.runners = runners
+        self.budget_stages = budget_stages
+        self.budget_s = budget_s
+        self.clock = clock
+        self.watchdog_s = watchdog_s
+        self.dump_path = dump_path
+        self.stages_run: list[str] = []
+
+    def _bus(self):
+        from pertgnn_tpu import telemetry
+        return telemetry.get_bus()
+
+    def _diagnose_orphans(self, records: list[dict]) -> None:
+        for stage in orphaned_stages(records):
+            log.warning("previous capture entry died inside stage %r "
+                        "with no journaled outcome — marking it wedged",
+                        stage)
+            self.journal.stage(stage, STATUS_WEDGED,
+                               reason="orphaned_start")
+            self._bus().counter("capture.stage_wedged", 1, stage=stage)
+
+    def run(self) -> str:
+        records = self.journal.records()
+        self._diagnose_orphans(records)
+        done = set(completed_stages(records))
+        bus = self._bus()
+        done_this_entry = 0
+        t0 = self.clock()
+        for stage in self.plan:
+            if stage in done:
+                continue
+            self.journal.stage(stage, STATUS_STARTED,
+                               watchdog_s=self.watchdog_s or None)
+            over_stages = (self.budget_stages is not None
+                           and done_this_entry >= self.budget_stages)
+            over_wall = (self.budget_s is not None
+                         and self.clock() - t0 >= self.budget_s)
+            if over_stages or over_wall:
+                reason = "stage_budget" if over_stages else "wall_budget"
+                self.journal.stage(stage, STATUS_ABORTED, reason=reason)
+                bus.counter("capture.window_closed", 1, stage=stage)
+                log.info("capture window closed (%s) with stage %r "
+                         "in flight — journal is resumable", reason, stage)
+                return OUTCOME_WINDOW_CLOSED
+            t_stage = self.clock()
+            try:
+                if self.watchdog_s > 0:
+                    with StageWatchdog(self.journal, stage, self.watchdog_s,
+                                       dump_path=self.dump_path):
+                        fields = self.runners[stage]() or {}
+                else:
+                    fields = self.runners[stage]() or {}
+            except CaptureWedged:
+                # the watchdog already journaled the wedge record
+                bus.counter("capture.stage_wedged", 1, stage=stage)
+                return OUTCOME_WEDGED
+            self.stages_run.append(stage)
+            dt = self.clock() - t_stage
+            self.journal.stage(stage, STATUS_DONE, seconds=round(dt, 3),
+                               **fields)
+            bus.counter("capture.stage_done", 1, stage=stage)
+            bus.gauge("capture.stage_seconds", dt, stage=stage)
+            done_this_entry += 1
+        return OUTCOME_COMPLETE
+
+
+def run_fingerprint(records: list[dict]) -> tuple | None:
+    """(commit, canonical-config-json) of the journal's LAST run
+    record, or None for a virgin journal — what bench.py --capture
+    compares against to decide resume vs rotate."""
+    fp = None
+    for r in records:
+        if r.get("name") == RUN_EVENT:
+            f = r.get("fields") or {}
+            fp = (f.get("commit"),
+                  json.dumps(f.get("config") or {}, sort_keys=True),
+                  f.get("backend"))
+    return fp
+
+
+def stitch_windows(records: list[dict], *,
+                   min_fit_windows: int | None = None,
+                   max_staleness_s: float = 48 * 3600.0) -> dict:
+    """Assemble one interleaved fit/ceiling measurement out of the
+    journal's window fragments.
+
+    Refusals (StitchRefused): no run-identity record, fragments
+    spanning >1 (commit, config) identity, windows spanning >1 backend,
+    no baseline stage, fewer than ``min_fit_windows`` fit windows after
+    the staleness bound. Windows older than ``max_staleness_s`` behind
+    the newest are DROPPED loudly (counted in the result), not fatal —
+    the spread is computed over the kept union by the caller.
+
+    Pure over decoded records (no jax): adjudicate.py calls this from
+    a host-only context."""
+    runs = [r.get("fields") or {} for r in records
+            if r.get("name") == RUN_EVENT]
+    if not runs:
+        raise StitchRefused("journal has no capture.run identity record")
+    idents = {(f.get("commit"),
+               json.dumps(f.get("config") or {}, sort_keys=True))
+              for f in runs}
+    if len(idents) > 1:
+        raise StitchRefused(
+            f"fragments span {len(idents)} incompatible commit/config "
+            f"identities — a stitched number must come from ONE tree: "
+            f"{sorted(str(i) for i in idents)}")
+    run0 = runs[0]
+    cfg = run0.get("config") or {}
+    planned = int(cfg.get("windows") or 0)
+    if min_fit_windows is None:
+        min_fit_windows = max(1, min(3, planned or 3))
+
+    done_env: dict[str, dict] = {}
+    for r in stage_records(records):
+        f = r.get("fields") or {}
+        if f.get("status") == STATUS_DONE and f.get("stage"):
+            done_env.setdefault(f["stage"], r)
+
+    wins: dict[int, dict[str, dict]] = {}
+    for stage, env in done_env.items():
+        win = window_of(stage)
+        if win is not None:
+            wins.setdefault(win[0], {})[win[1]] = env
+    if not wins:
+        raise StitchRefused("no completed capture windows in journal")
+
+    newest = max(env["t"] for parts in wins.values()
+                 for env in parts.values())
+    stale = [i for i, parts in wins.items()
+             if max(env["t"] for env in parts.values())
+             < newest - max_staleness_s]
+    for i in stale:
+        log.warning("stitch: dropping window %02d — %.1fh older than the "
+                    "newest fragment (staleness bound %.1fh)", i,
+                    (newest - max(env["t"]
+                                  for env in wins[i].values())) / 3600,
+                    max_staleness_s / 3600)
+    kept = sorted(i for i in wins if i not in stale)
+
+    backends = {(env.get("fields") or {}).get("backend")
+                for i in kept for env in wins[i].values()
+                if (env.get("fields") or {}).get("backend")}
+    if len(backends) > 1:
+        raise StitchRefused(
+            f"windows span multiple backends {sorted(backends)} — "
+            f"fragments from different chips cannot form one number")
+
+    baseline_f = completed_stages(records).get("baseline")
+    if not baseline_f or baseline_f.get(
+            "baseline_torch_cpu_graphs_per_s") is None:
+        raise StitchRefused("no journaled baseline stage — vs_baseline "
+                            "would be unfounded")
+
+    def _series(kind: str) -> list[float]:
+        out = []
+        for i in kept:
+            env = wins[i].get(kind)
+            if env is not None:
+                g = (env.get("fields") or {}).get("graphs_per_s")
+                if g is not None:
+                    out.append(g)
+        return out
+
+    fit_w = _series("fit")
+    if len(fit_w) < min_fit_windows:
+        raise StitchRefused(f"only {len(fit_w)} stitched fit windows "
+                            f"(< {min_fit_windows})")
+
+    provenance = []
+    attribution = []
+    for i in kept:
+        for kind in _WINDOW_KINDS:
+            env = wins[i].get(kind)
+            if env is None:
+                continue
+            f = env.get("fields") or {}
+            provenance.append({
+                "window": i, "stage": kind, "t": round(env["t"], 3),
+                "pid": env["pid"],
+                "graphs_per_s": f.get("graphs_per_s"),
+            })
+            if kind == "fit" and f.get("roofline") is not None:
+                attribution.append({"window": i, **f["roofline"]})
+
+    arena = completed_stages(records).get("arena_warm") or {}
+    cost = completed_stages(records).get("cost") or {}
+    complete = (planned > 0 and len(kept) == planned and not stale
+                and all(k in wins[i] for i in kept for k in _WINDOW_KINDS))
+    return {
+        "fit_w": fit_w,
+        "ceil_w": _series("ceiling"),
+        "cceil_w": _series("compact"),
+        "baseline": baseline_f["baseline_torch_cpu_graphs_per_s"],
+        "flops_per_graph": cost.get("flops_per_graph"),
+        "bytes_per_graph": cost.get("bytes_per_graph"),
+        "peak_flops": cost.get("peak_flops_per_chip"),
+        "peak_bw": cost.get("peak_hbm_bytes_per_s"),
+        "device_kind": (cost.get("device_kind")
+                        or arena.get("device_kind")
+                        or run0.get("device_kind")),
+        "backend": (backends.pop() if backends
+                    else run0.get("backend", "unknown")),
+        "fallback": bool(run0.get("backend_fallback")),
+        "attention_impl": arena.get("attention_impl",
+                                    cfg.get("attention_impl", "segment")),
+        "serve_dtype": arena.get("serve_dtype", "f32"),
+        "train_graphs": arena.get("train_graphs_per_epoch"),
+        "commit": run0.get("commit"),
+        "dirty": run0.get("dirty_worktree"),
+        "provenance": provenance,
+        "window_attribution": attribution,
+        "stale_windows_dropped": len(stale),
+        "n_entries": len(runs),
+        "complete": complete,
+        "wedged_stages": wedged_stages(records),
+    }

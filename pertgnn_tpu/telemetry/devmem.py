@@ -1,0 +1,74 @@
+"""Device memory gauges from ``Device.memory_stats()``.
+
+PJRT backends that track allocator state (TPU, GPU) expose
+``memory_stats()`` on each device; CPU typically returns None or lacks
+the method entirely. These helpers normalize that into an
+all-or-nothing sample — a dict of the three canonical fields when the
+backend publishes them, None otherwise — and optionally publish the
+sample as ``device.mem.*`` gauges on the telemetry bus. Every caller
+(engine warmup, per fit epoch, per capture window, precompile,
+kernel_bench) goes through here so None-safety lives in one place.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+# PJRT stat key -> canonical gauge suffix (TPU publishes
+# peak_bytes_in_use; keep our name stable across backends)
+_STAT_KEYS = (
+    ("bytes_in_use", "bytes_in_use"),
+    ("peak_bytes_in_use", "peak_bytes"),
+    ("bytes_limit", "bytes_limit"),
+)
+
+
+def device_memory_stats(device=None) -> dict | None:
+    """The canonical memory sample for `device` (default: first local
+    jax device), or None when the backend doesn't publish stats. Never
+    raises — a missing/broken stats surface is the CPU norm, not an
+    error."""
+    if device is None:
+        try:
+            import jax
+            device = jax.local_devices()[0]
+        except Exception as e:  # pragma: no cover - no backend at all
+            log.debug("no jax device for memory stats: %s", e)
+            return None
+    stats_fn = getattr(device, "memory_stats", None)
+    if stats_fn is None:
+        return None
+    try:
+        raw = stats_fn()
+    except Exception as e:  # some PJRT clients raise instead of None
+        log.debug("memory_stats() unavailable on %r: %s", device, e)
+        return None
+    if not raw:
+        return None
+    out = {}
+    for src, dst in _STAT_KEYS:
+        v = raw.get(src)
+        if isinstance(v, (int, float)):
+            out[dst] = int(v)
+    return out or None
+
+
+def sample_device_memory(bus=None, device=None, **tags) -> dict | None:
+    """Sample `device` memory and publish ``device.mem.*`` gauges on
+    `bus` (default: the process bus). Returns the sample dict, or None
+    (with nothing emitted) when the backend publishes no stats."""
+    stats = device_memory_stats(device)
+    if not stats:
+        return None
+    if bus is None:
+        from pertgnn_tpu import telemetry
+        bus = telemetry.get_bus()
+    if "bytes_in_use" in stats:
+        bus.gauge("device.mem.bytes_in_use", stats["bytes_in_use"], **tags)
+    if "peak_bytes" in stats:
+        bus.gauge("device.mem.peak_bytes", stats["peak_bytes"], **tags)
+    if "bytes_limit" in stats:
+        bus.gauge("device.mem.bytes_limit", stats["bytes_limit"], **tags)
+    return stats

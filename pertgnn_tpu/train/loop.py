@@ -27,6 +27,7 @@ import optax
 from flax import struct
 
 from pertgnn_tpu import telemetry
+from pertgnn_tpu.telemetry.devmem import sample_device_memory
 from pertgnn_tpu.batching.dataset import Dataset
 from pertgnn_tpu.batching.arena import zero_masked_compact
 from pertgnn_tpu.batching.materialize import (
@@ -1151,6 +1152,10 @@ def _fit_epochs(dataset, cfg, epochs, checkpoint_manager, profile_hook,
         bus.gauge("train.epoch_graphs_per_s", row["graphs_per_s"],
                   epoch=epoch)
         bus.gauge("train.epoch_qloss", row["train_qloss"], epoch=epoch)
+        # allocator state per epoch (ISSUE 17): None-safe no-op on
+        # backends without memory_stats (CPU); on-chip it turns "did the
+        # arena + donation discipline hold" into a per-epoch curve
+        sample_device_memory(bus, where="fit_epoch", epoch=epoch)
         bus.counter("train.graphs", sums["count"], epoch=epoch)
         # every train_step/chunk dispatch donates its input state buffers
         # (make_train_* jit with donate_argnums=0) — the reuse count was

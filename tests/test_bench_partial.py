@@ -24,6 +24,9 @@ def paths(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "_PARTIAL", str(partial))
     monkeypatch.setattr(bench, "_ORPHAN", str(orphan))
     monkeypatch.setattr(bench, "_PIN", str(pin))
+    # isolate the graftprobe journal too: the finalizer folds it in as a
+    # salvage candidate (ISSUE 17) and must not see a real repo journal
+    monkeypatch.setattr(bench, "_JOURNAL", str(tmp_path / "journal.jsonl"))
     return partial, orphan, pin
 
 
@@ -127,6 +130,45 @@ def test_finalize_prefers_tpu_salvage_over_more_cpu_windows(paths):
     assert bench.finalize_partial() == 0
     pinned = json.loads(pin.read_text())
     assert pinned["commit"] == "chip" and pinned["n_fit_windows"] == 4
+
+
+def test_finalize_folds_journal_stitch_outranking_partial(paths, capsys):
+    """--finalize-partial folds into journal replay (ISSUE 17): a
+    stitchable TPU capture journal outranks a CPU partial file and
+    promotes with full stitch provenance."""
+    from tests.test_capture import _fake_journal
+
+    partial, _, pin = paths
+    partial.write_text(json.dumps(
+        _partial_payload(6, backend="cpu", commit="fallback")))
+    with open(bench._JOURNAL, "w") as f:
+        for r in _fake_journal(3, backend="tpu", commit="chipchip"):
+            f.write(json.dumps(r) + "\n")
+    assert bench.finalize_partial() == 0
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert result["stitched"] is True
+    assert result["backend"] == "tpu"
+    assert len(result["windows_provenance"]) == 9
+    pinned = json.loads(pin.read_text())
+    assert pinned["commit"] == "chipchip"  # stitch identity, not HEAD
+    assert not partial.exists()  # consumed
+
+
+def test_finalize_skips_unstitchable_journal_loudly(paths, capsys):
+    """A journal whose fragments refuse to stitch never blocks the
+    file-based salvage path — the refusal is printed, not silent."""
+    from tests.test_capture import _fake_journal
+
+    partial, _, pin = paths
+    partial.write_text(json.dumps(_partial_payload(5)))
+    recs = (_fake_journal(2, commit="aaa") + _fake_journal(2, commit="bbb"))
+    with open(bench._JOURNAL, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    assert bench.finalize_partial() == 0
+    out = capsys.readouterr().out
+    assert "not stitchable" in out
+    assert json.loads(pin.read_text())["n_fit_windows"] == 5
 
 
 def test_discard_keeps_promotable_tpu_salvage_on_cpu_fallback(paths):

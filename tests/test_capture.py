@@ -1,0 +1,556 @@
+"""graftprobe capture journal laws: resume, wedge diagnosis, stitching.
+
+The axon relay grants sub-minute windows, so bench.py --capture
+decomposes into journaled stages (telemetry/capture.py) and re-enters
+at the first incomplete one. These tests pin the contract on CPU with
+fake runners and an injected stage budget as the deterministic
+mid-stage kill: a budget-killed capture resumes with ZERO re-run
+journaled stages, the stitched result passes the same schema as a
+single-window capture, corrupt journal lines are skipped loudly, and
+the stitcher refuses fragments spanning incompatible commits/configs/
+backends.
+"""
+
+import importlib.util
+import json
+import logging
+import os
+import time
+
+import pytest
+
+import bench
+from pertgnn_tpu.telemetry import capture as cap
+from pertgnn_tpu.telemetry import devmem
+from pertgnn_tpu.telemetry.schema import load_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ROOFLINE_ROW = {
+    "attention_impl": "segment", "dtype": "f32",
+    "flops_per_graph": 1.0e6, "bytes_per_graph": 2.0e5,
+    "mfu_pct": None, "mbu_pct": None, "roofline_graphs_per_s": None,
+}
+
+_CONFIG_FP = {"traces_per_entry": 48, "windows": 2,
+              "attention_impl": "segment", "simulate": True}
+
+
+def _append_run(journal, commit="cafe01", backend="cpu", config=None):
+    journal.append(cap.RUN_EVENT, {
+        "commit": commit, "dirty_worktree": False,
+        "config": dict(config if config is not None else _CONFIG_FP),
+        "backend": backend, "device_kind": "", "backend_fallback": False,
+        "simulate": True})
+
+
+def _make_runners(windows, counts):
+    """Stage runners that count invocations and return the minimal
+    fields the stitcher needs — the zero-re-run assertion reads
+    `counts` across entries."""
+    def bump(stage, fields):
+        def run():
+            counts[stage] = counts.get(stage, 0) + 1
+            return dict(fields)
+        return run
+
+    runners = {
+        "probe": bump("probe", {"backend": "cpu", "device_kind": ""}),
+        "arena_warm": bump("arena_warm", {
+            "train_graphs_per_epoch": 64, "traces_per_entry": 48,
+            "backend": "cpu", "device_kind": "",
+            "attention_impl": "segment", "serve_dtype": "f32"}),
+        "precompile": bump("precompile", {"programs": 3}),
+        "cost": bump("cost", {
+            "flops_per_graph": 1.0e6, "bytes_per_graph": 2.0e5,
+            "peak_flops_per_chip": None, "peak_hbm_bytes_per_s": None,
+            "device_kind": "", "backend": "cpu"}),
+        "baseline": bump("baseline",
+                         {"baseline_torch_cpu_graphs_per_s": 100.0}),
+    }
+    for i in range(windows):
+        runners[f"window:{i:02d}:fit"] = bump(
+            f"window:{i:02d}:fit",
+            {"graphs_per_s": 200.0 + i, "backend": "cpu",
+             "roofline": dict(_ROOFLINE_ROW)})
+        runners[f"window:{i:02d}:ceiling"] = bump(
+            f"window:{i:02d}:ceiling",
+            {"graphs_per_s": 400.0 + i, "backend": "cpu",
+             "roofline": dict(_ROOFLINE_ROW)})
+        runners[f"window:{i:02d}:compact"] = bump(
+            f"window:{i:02d}:compact",
+            {"graphs_per_s": 300.0 + i, "backend": "cpu"})
+    return runners
+
+
+# ---------------------------------------------------------------- resume
+
+
+def test_stage_plan_and_window_parsing():
+    plan = cap.stage_plan(2)
+    assert plan[:5] == list(cap.SETUP_STAGES)
+    assert plan[5:] == ["window:00:fit", "window:00:ceiling",
+                        "window:00:compact", "window:01:fit",
+                        "window:01:ceiling", "window:01:compact"]
+    assert cap.window_of("window:07:fit") == (7, "fit")
+    assert cap.window_of("probe") is None
+    assert cap.window_of("window:xx:fit") is None
+    # exit codes are a wire contract with tpu_watch.sh + CI
+    assert cap.EXIT_WINDOW_CLOSED == 3 and cap.EXIT_WEDGED == 4
+
+
+def test_budget_killed_twice_resumes_with_zero_reruns(tmp_path):
+    """The acceptance drill: two budget-killed entries + one clean one
+    complete the capture; every stage ran EXACTLY once and each entry
+    re-entered at the first incomplete stage."""
+    journal = cap.CaptureJournal(str(tmp_path / "journal.jsonl"))
+    plan = cap.stage_plan(2)
+    counts: dict = {}
+
+    _append_run(journal)
+    r1 = cap.CaptureRunner(journal, plan, _make_runners(2, counts),
+                           budget_stages=3)
+    assert r1.run() == cap.OUTCOME_WINDOW_CLOSED
+    assert r1.stages_run == ["probe", "arena_warm", "precompile"]
+    # the in-flight stage is journaled started -> aborted, and resume
+    # re-enters exactly there
+    assert cap.first_incomplete(plan, journal.records()) == "cost"
+
+    _append_run(journal)
+    r2 = cap.CaptureRunner(journal, plan, _make_runners(2, counts),
+                           budget_stages=3)
+    assert r2.run() == cap.OUTCOME_WINDOW_CLOSED
+    assert r2.stages_run == ["cost", "baseline", "window:00:fit"]
+    assert (cap.first_incomplete(plan, journal.records())
+            == "window:00:ceiling")
+
+    _append_run(journal)
+    r3 = cap.CaptureRunner(journal, plan, _make_runners(2, counts))
+    assert r3.run() == cap.OUTCOME_COMPLETE
+    assert cap.first_incomplete(plan, journal.records()) is None
+
+    # zero re-runs: every stage's runner fired exactly once across all
+    # three entries, and the journal holds exactly one done record each
+    assert counts == {s: 1 for s in plan}
+    done_counts: dict = {}
+    for r in cap.stage_records(journal.records()):
+        f = r["fields"]
+        if f["status"] == cap.STATUS_DONE:
+            done_counts[f["stage"]] = done_counts.get(f["stage"], 0) + 1
+    assert done_counts == {s: 1 for s in plan}
+
+    # every journal line is a strict schema-v2 event
+    assert len(load_events(journal.path, strict=True)) > 0
+
+    # the stitched result passes the same schema checks as a live
+    # single-window capture (assembled by the same function)
+    st = cap.stitch_windows(journal.records())
+    assert st["complete"] is True
+    assert st["fit_w"] == [200.0, 201.0]
+    result = bench._assemble_from_stitch(st)
+    assert result["stitched"] is True
+    assert result["value"] == 200.5  # median of the stitched fit windows
+    assert result["vs_baseline"] == pytest.approx(2.0, abs=0.02)
+    assert len(result["windows_provenance"]) == 6
+    assert result["capture_entries"] == 3
+
+
+def test_aborted_stage_journal_shows_in_flight_step(tmp_path):
+    journal = cap.CaptureJournal(str(tmp_path / "journal.jsonl"))
+    _append_run(journal)
+    counts: dict = {}
+    runner = cap.CaptureRunner(journal, cap.stage_plan(1),
+                               _make_runners(1, counts), budget_stages=1)
+    assert runner.run() == cap.OUTCOME_WINDOW_CLOSED
+    statuses = [(r["fields"]["stage"], r["fields"]["status"])
+                for r in cap.stage_records(journal.records())]
+    # the window closed with arena_warm in flight: started then aborted
+    assert statuses[-2:] == [("arena_warm", cap.STATUS_STARTED),
+                             ("arena_warm", cap.STATUS_ABORTED)]
+    assert counts == {"probe": 1}
+
+
+def test_wall_budget_closes_window(tmp_path):
+    journal = cap.CaptureJournal(str(tmp_path / "journal.jsonl"))
+    _append_run(journal)
+    clock = {"t": 0.0}
+
+    def fake_clock():
+        return clock["t"]
+
+    counts: dict = {}
+    runners = _make_runners(1, counts)
+    orig = runners["probe"]
+
+    def slow_probe():
+        clock["t"] += 99.0  # the stage eats the whole window
+        return orig()
+    runners["probe"] = slow_probe
+
+    runner = cap.CaptureRunner(journal, cap.stage_plan(1), runners,
+                               budget_s=60.0, clock=fake_clock)
+    assert runner.run() == cap.OUTCOME_WINDOW_CLOSED
+    last = cap.stage_records(journal.records())[-1]["fields"]
+    assert last == {"stage": "arena_warm", "status": cap.STATUS_ABORTED,
+                    "reason": "wall_budget"}
+
+
+# ------------------------------------------------------ journal reading
+
+
+def test_corrupt_lines_skipped_loudly(tmp_path, caplog):
+    path = tmp_path / "journal.jsonl"
+    journal = cap.CaptureJournal(str(path))
+    _append_run(journal)
+    journal.stage("probe", cap.STATUS_DONE, seconds=0.1)
+    with open(path, "a") as f:
+        f.write('{"not": "an event"}\n')       # decodes, fails schema
+        f.write('{"v": 2, "t": 1.0, "tm"\n')   # torn mid-write
+    journal.stage("arena_warm", cap.STATUS_DONE, seconds=0.2)
+    with caplog.at_level(logging.WARNING,
+                         logger="pertgnn_tpu.telemetry.capture"):
+        records = journal.records()
+    assert len(records) == 3
+    assert journal.skipped_lines == 2
+    assert sum("skipping bad line" in r.message
+               for r in caplog.records) == 2
+    # a torn tail never loses the good prefix
+    assert set(cap.completed_stages(records)) == {"probe", "arena_warm"}
+
+
+def test_missing_journal_reads_empty(tmp_path):
+    journal = cap.CaptureJournal(str(tmp_path / "nope.jsonl"))
+    assert journal.records() == []
+    assert cap.first_incomplete(cap.stage_plan(1), []) == "probe"
+
+
+# ------------------------------------------------------ wedge diagnosis
+
+
+def test_orphaned_start_marked_wedged_and_rerun(tmp_path):
+    """A hard-killed entry leaves a `started` record with no outcome;
+    the next entry journals it wedged (stage name survives for the
+    watcher) and the stage re-runs."""
+    journal = cap.CaptureJournal(str(tmp_path / "journal.jsonl"))
+    _append_run(journal)
+    journal.stage("probe", cap.STATUS_STARTED)  # the killed entry
+
+    assert cap.orphaned_stages(journal.records()) == ["probe"]
+    counts: dict = {}
+    runner = cap.CaptureRunner(journal, ["probe"],
+                               _make_runners(1, counts))
+    assert runner.run() == cap.OUTCOME_COMPLETE
+    records = journal.records()
+    assert cap.wedged_stages(records) == ["probe"]
+    wedge = [r["fields"] for r in cap.stage_records(records)
+             if r["fields"]["status"] == cap.STATUS_WEDGED]
+    assert wedge[0]["reason"] == "orphaned_start"
+    assert counts == {"probe": 1}  # orphan diagnosis does not skip it
+    assert cap.orphaned_stages(records) == []
+
+
+def test_watchdog_sigalrm_journals_wedge_and_dumps(tmp_path):
+    """A stage sleeping past the watchdog is journaled `wedged` with an
+    all-thread stack dump; the runner exits resumable (OUTCOME_WEDGED)
+    and the faulthandler backstop is cancelled before it can kill the
+    test process."""
+    journal = cap.CaptureJournal(str(tmp_path / "journal.jsonl"))
+    dump = tmp_path / "wedge.txt"
+
+    def sleeper():
+        time.sleep(30)  # interruptible wait, like a polling device op
+
+    runner = cap.CaptureRunner(journal, ["probe"], {"probe": sleeper},
+                               watchdog_s=0.2, dump_path=str(dump))
+    t0 = time.monotonic()
+    assert runner.run() == cap.OUTCOME_WEDGED
+    assert time.monotonic() - t0 < 10  # the alarm, not the sleep
+    wedge = [r["fields"] for r in cap.stage_records(journal.records())
+             if r["fields"]["status"] == cap.STATUS_WEDGED]
+    assert wedge and wedge[0]["reason"] == "watchdog_sigalrm"
+    assert wedge[0]["stage"] == "probe"
+    # the dump file holds the armed marker + the thread stacks
+    text = dump.read_text()
+    assert "stage probe armed" in text
+    assert "Thread" in text or "File" in text
+    # the stage stays incomplete: resume re-enters it
+    assert cap.first_incomplete(["probe"], journal.records()) == "probe"
+    # give the cancelled 2x backstop's window a beat — if cancellation
+    # failed this test run would die here, loudly
+    time.sleep(0.5)
+
+
+# ------------------------------------------------------------- stitching
+
+
+def _ev(name, fields, t=1_000_000.0, pid=41):
+    return {"v": 2, "t": t, "tm": t, "pid": pid, "pi": 0,
+            "kind": "meta", "name": name, "fields": fields}
+
+
+def _stage_ev(stage, t=1_000_000.0, pid=41, **fields):
+    payload = {"stage": stage, "status": cap.STATUS_DONE}
+    win = cap.window_of(stage)
+    if win is not None:
+        payload["window"] = win[0]
+    payload.update(fields)
+    return _ev(cap.STAGE_EVENT, payload, t=t, pid=pid)
+
+
+def _fake_journal(windows=2, backend="cpu", commit="cafe01",
+                  t0=1_000_000.0):
+    cfg = dict(_CONFIG_FP, windows=windows)
+    recs = [_ev(cap.RUN_EVENT, {
+        "commit": commit, "dirty_worktree": False, "config": cfg,
+        "backend": backend, "device_kind": "", "backend_fallback": False,
+        "simulate": True}, t=t0)]
+    recs += [
+        _stage_ev("probe", t=t0 + 1, backend=backend),
+        _stage_ev("arena_warm", t=t0 + 2, backend=backend,
+                  train_graphs_per_epoch=64, attention_impl="segment",
+                  serve_dtype="f32", device_kind=""),
+        _stage_ev("precompile", t=t0 + 3),
+        _stage_ev("cost", t=t0 + 4, flops_per_graph=1.0e6,
+                  bytes_per_graph=2.0e5, peak_flops_per_chip=None,
+                  peak_hbm_bytes_per_s=None, device_kind="",
+                  backend=backend),
+        _stage_ev("baseline", t=t0 + 5,
+                  baseline_torch_cpu_graphs_per_s=100.0),
+    ]
+    for i in range(windows):
+        tw = t0 + 10 + 10 * i
+        recs.append(_stage_ev(f"window:{i:02d}:fit", t=tw, pid=41 + i,
+                              graphs_per_s=200.0 + i, backend=backend,
+                              roofline=dict(_ROOFLINE_ROW)))
+        recs.append(_stage_ev(f"window:{i:02d}:ceiling", t=tw + 1,
+                              pid=41 + i, graphs_per_s=400.0 + i,
+                              backend=backend))
+        recs.append(_stage_ev(f"window:{i:02d}:compact", t=tw + 2,
+                              pid=41 + i, graphs_per_s=300.0 + i,
+                              backend=backend))
+    return recs
+
+
+def test_stitch_assembles_provenance_and_attribution():
+    st = cap.stitch_windows(_fake_journal(3), min_fit_windows=3)
+    assert st["complete"] is True
+    assert st["fit_w"] == [200.0, 201.0, 202.0]
+    assert st["ceil_w"] == [400.0, 401.0, 402.0]
+    assert st["baseline"] == 100.0
+    # per-window provenance: window id, stage, wall time, capturing pid
+    assert [(p["window"], p["stage"]) for p in st["provenance"]] == [
+        (i, k) for i in range(3) for k in ("fit", "ceiling", "compact")]
+    assert {p["pid"] for p in st["provenance"]} == {41, 42, 43}
+    # one roofline attribution row per fit window, flops/bytes non-null
+    # (mfu/mbu honestly null off-chip)
+    assert [a["window"] for a in st["window_attribution"]] == [0, 1, 2]
+    for a in st["window_attribution"]:
+        assert a["flops_per_graph"] is not None
+        assert a["bytes_per_graph"] is not None
+        assert a["mfu_pct"] is None
+
+    result = bench._assemble_from_stitch(st)
+    # same schema as a live capture: every _assemble_result field rides
+    live = bench._assemble_result(
+        fit_w=[1.0, 2.0, 3.0], ceil_w=[], cceil_w=[], unstaged_w=[],
+        flops_per_graph=None, bytes_per_graph=None, baseline=1.0,
+        backend="cpu", fallback=False, train_graphs=1)
+    assert set(live) <= set(result)
+    assert result["stitched"] is True
+    assert result["value"] == 201.0
+    assert "partial_capture" not in result  # complete stitch
+
+
+def test_stitch_refuses_mixed_commits():
+    recs = _fake_journal(2)
+    recs += _fake_journal(2, commit="deadbeef")
+    with pytest.raises(cap.StitchRefused, match="incompatible"):
+        cap.stitch_windows(recs)
+
+
+def test_stitch_refuses_mixed_configs():
+    recs = _fake_journal(2)
+    other = dict(_CONFIG_FP, windows=2, traces_per_entry=999)
+    recs.append(_ev(cap.RUN_EVENT, {
+        "commit": "cafe01", "config": other, "backend": "cpu"}))
+    with pytest.raises(cap.StitchRefused, match="incompatible"):
+        cap.stitch_windows(recs)
+
+
+def test_stitch_refuses_mixed_window_backends():
+    # window 00 captured on cpu, window 01 on tpu: fragments from
+    # different chips must never form one number
+    recs = [r for r in _fake_journal(2)
+            if not (r["name"] == cap.STAGE_EVENT
+                    and r["fields"].get("window") == 1)]
+    recs.append(_stage_ev("window:01:fit", t=1_000_500.0,
+                          graphs_per_s=999.0, backend="tpu"))
+    with pytest.raises(cap.StitchRefused, match="backends"):
+        cap.stitch_windows(recs, min_fit_windows=1)
+
+
+def test_stitch_refuses_missing_baseline_and_identity():
+    recs = [r for r in _fake_journal(2)
+            if r["fields"].get("stage") != "baseline"]
+    with pytest.raises(cap.StitchRefused, match="baseline"):
+        cap.stitch_windows(recs)
+    no_run = [r for r in _fake_journal(2) if r["name"] != cap.RUN_EVENT]
+    with pytest.raises(cap.StitchRefused, match="identity"):
+        cap.stitch_windows(no_run)
+
+
+def test_stitch_refuses_too_few_windows():
+    recs = _fake_journal(1)
+    with pytest.raises(cap.StitchRefused, match="fit windows"):
+        cap.stitch_windows(recs, min_fit_windows=3)
+
+
+def test_stitch_drops_stale_windows_loudly():
+    """A window >48h older than the newest fragment is dropped (and
+    counted) rather than silently averaged into the number."""
+    recs = _fake_journal(2)
+    # push window 01 far into the future: window 00 becomes stale
+    for r in recs:
+        if (r["name"] == cap.STAGE_EVENT
+                and r["fields"].get("window") == 1):
+            r["t"] += 50 * 3600.0
+    st = cap.stitch_windows(recs, min_fit_windows=1)
+    assert st["stale_windows_dropped"] == 1
+    assert st["fit_w"] == [201.0]  # only the fresh window
+    assert st["complete"] is False
+    assert bench._assemble_from_stitch(st)["partial_capture"] is True
+
+
+def test_run_fingerprint_tracks_last_run():
+    recs = _fake_journal(2)
+    fp1 = cap.run_fingerprint(recs)
+    assert fp1 is not None and fp1[0] == "cafe01" and fp1[2] == "cpu"
+    recs.append(_ev(cap.RUN_EVENT, {"commit": "deadbeef",
+                                    "config": _CONFIG_FP,
+                                    "backend": "tpu"}))
+    fp2 = cap.run_fingerprint(recs)
+    assert fp2[0] == "deadbeef" and fp2[2] == "tpu"
+    assert cap.run_fingerprint([]) is None
+
+
+# ------------------------------------------------- probe availability
+
+
+def test_probe_journal_and_availability_stats(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    for ok, lat in ((True, 0.2), (True, 0.3), (False, 5.0), (True, 0.1)):
+        cap.journal_probe(path, ok=ok, latency_s=lat)
+    records = cap.CaptureJournal(path).records()
+    stats = cap.probe_availability(records)
+    assert stats["probe_attempts"] == 4
+    assert stats["probe_ok"] == 3
+    assert stats["availability_pct"] == 75.0
+    # consecutive ok probes form windows: (ok, ok), (ok)
+    assert stats["healthy_windows"] == 2
+    assert stats["window_histogram"]["lt_60s"] == 2
+    assert stats["median_probe_latency_s"] == 0.3
+    # an empty journal yields zeroed stats, not a crash
+    empty = cap.probe_availability([])
+    assert empty["probe_attempts"] == 0
+    assert empty["availability_pct"] is None
+
+
+# --------------------------------------------------------- devmem gauges
+
+
+class _FakeDevice:
+    def __init__(self, raw):
+        self._raw = raw
+
+    def memory_stats(self):
+        if isinstance(self._raw, Exception):
+            raise self._raw
+        return self._raw
+
+
+class _FakeBus:
+    def __init__(self):
+        self.gauges = []
+
+    def gauge(self, name, value, **tags):
+        self.gauges.append((name, value, tags))
+
+
+def test_devmem_maps_pjrt_keys_and_emits_gauges():
+    dev = _FakeDevice({"bytes_in_use": 10, "peak_bytes_in_use": 20,
+                       "bytes_limit": 30, "num_allocs": 7})
+    assert devmem.device_memory_stats(dev) == {
+        "bytes_in_use": 10, "peak_bytes": 20, "bytes_limit": 30}
+    bus = _FakeBus()
+    stats = devmem.sample_device_memory(bus, dev, where="test")
+    assert stats["peak_bytes"] == 20
+    assert [(n, v) for n, v, _ in bus.gauges] == [
+        ("device.mem.bytes_in_use", 10), ("device.mem.peak_bytes", 20),
+        ("device.mem.bytes_limit", 30)]
+    assert all(t == {"where": "test"} for _, _, t in bus.gauges)
+
+
+def test_devmem_none_safe_on_cpu_like_devices():
+    bus = _FakeBus()
+    # raises -> None (some PJRT clients raise instead of returning None)
+    assert devmem.device_memory_stats(
+        _FakeDevice(RuntimeError("unimplemented"))) is None
+    # returns None / empty -> None
+    assert devmem.device_memory_stats(_FakeDevice(None)) is None
+    assert devmem.device_memory_stats(_FakeDevice({})) is None
+    # no memory_stats attribute at all -> None
+    assert devmem.device_memory_stats(object()) is None
+    # nothing emitted in any of those cases
+    assert devmem.sample_device_memory(
+        bus, _FakeDevice(None), where="t") is None
+    assert bus.gauges == []
+
+
+# -------------------------------------------------- adjudicate --stitch
+
+
+@pytest.fixture
+def adjudicate():
+    spec = importlib.util.spec_from_file_location(
+        "adjudicate_under_test",
+        os.path.join(REPO, "benchmarks", "adjudicate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_journal(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_adjudicate_stitch_assembles_valid_journal(adjudicate, tmp_path,
+                                                   capsys):
+    path = str(tmp_path / "journal.jsonl")
+    _write_journal(path, _fake_journal(3))
+    assert adjudicate.stitch_main(["--stitch", "--journal", path]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["stitched"] is True
+    assert out["value"] == 201.0
+    assert len(out["windows_provenance"]) == 9
+    assert [a["window"] for a in out["window_attribution"]] == [0, 1, 2]
+
+
+def test_adjudicate_stitch_refuses_incompatible_fragments(adjudicate,
+                                                          tmp_path,
+                                                          capsys):
+    path = str(tmp_path / "journal.jsonl")
+    _write_journal(path, _fake_journal(2)
+                   + _fake_journal(2, commit="deadbeef"))
+    assert adjudicate.stitch_main(["--stitch", "--journal", path]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["stitched"] is False
+    assert "incompatible" in out["refused"]
+
+
+def test_adjudicate_stitch_missing_journal(adjudicate, tmp_path, capsys):
+    path = str(tmp_path / "absent.jsonl")
+    assert adjudicate.stitch_main(["--stitch", "--journal", path]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["stitched"] is False and "no capture journal" in out["refused"]
