@@ -1,0 +1,308 @@
+"""Giant-corpus scale-out benchmark: sharded merge + SAR accumulation.
+
+Exit-code-asserts the ISSUE-18 invariants in ONE run (wall-clock numbers
+ride the JSON, the verdict lives in the return code — the
+stream_bench/fleet_bench split):
+
+- **sharded merge** — ``parallel/scale.sharded_merge`` over a 2-device
+  CPU mesh must produce a Dataset BIT-IDENTICAL (every batch, every
+  field, every split) to the single-host ``stream/merge.merge_shards``
+  oracle, for EVERY tested delta permutation and host count 1..3, and
+  the content-derived shard assignment must fingerprint-agree across
+  simulated hosts.
+- **SAR gradients** — the rematerialized accumulated gradient
+  (``sar_grads_fn(remat=True)``) must equal the monolithic
+  all-residuals-live twin BITWISE (tolerance 0, f32) at every tested
+  bucket capacity, and the gradient must be nonzero (the assert is not
+  vacuous).
+- **zero fresh compiles** — one jitted SAR step serves EVERY live
+  bucket count up to capacity: after stepping the full mixture, a
+  2-bucket tail, and a 1-bucket tail, the jit cache holds exactly ONE
+  executable.  Capacity is the only compiled dimension.
+- **bounded memory** — the remat step's compiled temp-buffer bytes
+  (XLA ``memory_analysis``: residual storage for the backward pass)
+  must be STRICTLY below the monolithic twin's at >= 2 buckets — the
+  headroom that lets the accumulated step scale the corpus without
+  scaling peak HBM.
+
+CPU by default (the mesh is 2 forced host-platform devices). One JSON
+line on stdout.
+
+    python benchmarks/scale_bench.py [--dryrun]
+
+``--dryrun`` is the CI smoke (tiny corpus, 4 permutations, 2
+capacities); the full run widens the corpus and sweeps every delta
+permutation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
+
+import numpy as np  # noqa: E402
+
+
+class Check:
+    def __init__(self):
+        self.failures: list[str] = []
+
+    def expect(self, cond: bool, what: str):
+        if not cond:
+            self.failures.append(what)
+            print(f"SCALE FAIL: {what}", file=sys.stderr)
+
+
+def corpus_spec(dryrun: bool) -> dict:
+    span = 9 * 60 * 1000
+    return {"num_microservices": 14, "num_entries": 3,
+            "patterns_per_entry": 3,
+            "traces_per_entry": 30 if dryrun else 90,
+            "seed": 11, "time_span_ms": span,
+            "missing_resource_frac": 0.0,
+            "ensure_pattern_coverage_before_ms": span // 4,
+            "bounds": [span // 4, span // 2, 3 * span // 4]}
+
+
+def make_corpus(spec: dict, cfg):
+    """(base, deltas): the raw corpus sliced into base + 3 time-window
+    delta shards, ingested in-process (the store is exercised by
+    stream_bench; this bench isolates the merge/accumulate math)."""
+    from pertgnn_tpu.ingest import synthetic
+    from pertgnn_tpu.ingest.assemble import assemble
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.stream import (base_shard, ingest_delta,
+                                    shard_frames_by_window)
+
+    gen_spec = {k: v for k, v in spec.items() if k != "bounds"}
+    synth = synthetic.generate(synthetic.SyntheticSpec(**gen_spec))
+    shards = shard_frames_by_window(synth.spans, synth.resources,
+                                    spec["bounds"])
+    pre0 = preprocess(shards[0][0], shards[0][1], cfg.ingest)
+    table0 = assemble(pre0, cfg.ingest)
+    base = base_shard(pre0, table0, cfg.graph_type, cfg.ingest)
+    deltas = [ingest_delta(s, r, base, cfg.graph_type, cfg.ingest)
+              for s, r in shards[1:]]
+    return base, deltas
+
+
+def make_cfg():
+    from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                    ModelConfig, TrainConfig)
+
+    return Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(max_traces=100_000, batch_size=4),
+        model=ModelConfig(hidden_channels=16, num_layers=2),
+        train=TrainConfig(label_scale=1000.0, scan_chunk=1,
+                          device_materialize=False, epochs=2),
+        graph_type="pert",
+    )
+
+
+def datasets_equal(a, b, tag: str, check: Check) -> bool:
+    ok = True
+    if set(a.splits) != set(b.splits):
+        check.expect(False, f"{tag}: splits {set(a.splits)} != "
+                            f"{set(b.splits)}")
+        return False
+    for name in a.splits:
+        ba, bb = list(a.batches(name)), list(b.batches(name))
+        if len(ba) != len(bb):
+            check.expect(False, f"{tag}: {name} {len(ba)} vs {len(bb)} "
+                                f"batches")
+            ok = False
+            continue
+        for i, (x, y) in enumerate(zip(ba, bb)):
+            for f in x._fields:
+                if not np.array_equal(np.asarray(getattr(x, f)),
+                                      np.asarray(getattr(y, f))):
+                    check.expect(False, f"{tag}: {name} batch {i} "
+                                        f"field {f} differs")
+                    ok = False
+                    break
+    return ok
+
+
+# -- phase: sharded merge vs oracle ---------------------------------------
+
+def check_sharded_merge(check: Check, cfg, base, deltas,
+                        dryrun: bool) -> dict:
+    import jax
+
+    from pertgnn_tpu.parallel import scale
+    from pertgnn_tpu.parallel.mesh import make_mesh
+    from pertgnn_tpu.stream import merge_shards
+
+    t0 = time.perf_counter()
+    oracle_ds, oracle_info = merge_shards(base, list(deltas), cfg)
+    oracle_s = time.perf_counter() - t0
+
+    mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    perms = list(itertools.permutations(range(len(deltas))))
+    if dryrun:
+        perms = perms[::max(1, len(perms) // 4)][:4]
+
+    merge_s: dict[int, list[float]] = {}
+    for hosts in (1, 2, 3):
+        fp = scale.assignment_fingerprint(deltas, hosts)
+        check.expect(
+            all(scale.assignment_fingerprint(
+                [deltas[i] for i in p], hosts) == fp for p in perms),
+            f"assignment fingerprint order-dependent at hosts={hosts}")
+        merge_s[hosts] = []
+        for perm in perms:
+            t0 = time.perf_counter()
+            ds, info = scale.sharded_merge(
+                base, [deltas[i] for i in perm], cfg, mesh,
+                num_hosts=hosts)
+            merge_s[hosts].append(time.perf_counter() - t0)
+            datasets_equal(ds, oracle_ds,
+                           f"merge hosts={hosts} perm={perm}", check)
+            check.expect(
+                info.shards == oracle_info.shards
+                and info.new_entries == oracle_info.new_entries
+                and info.new_topologies == oracle_info.new_topologies
+                and info.dropped_coverage == oracle_info.dropped_coverage
+                and (info.dropped_occurrence
+                     == oracle_info.dropped_occurrence),
+                f"MergeInfo drifts at hosts={hosts} perm={perm}")
+    return {"oracle_merge_s": round(oracle_s, 4),
+            "permutations": len(perms),
+            "sharded_merge_s": {h: round(float(np.mean(v)), 4)
+                                for h, v in merge_s.items()}}
+
+
+# -- phase: SAR gradients + compiles + memory -----------------------------
+
+def check_sar(check: Check, cfg, dataset, dryrun: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from pertgnn_tpu.models.pert_model import make_model
+    from pertgnn_tpu.parallel import scale
+    from pertgnn_tpu.train.loop import create_train_state, make_tx
+
+    model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
+                       dataset.num_interfaces, dataset.num_rpctypes)
+    tx = make_tx(cfg)
+    batches = list(dataset.batches("train"))
+    state = create_train_state(model, tx, batches[0], cfg.train.seed)
+    n = len(batches)
+    check.expect(n >= 2, f"corpus too small for >=2 buckets (n={n})")
+
+    # gradient bit-equivalence, tolerance 0, at every tested capacity
+    caps = [n, n + 2] if dryrun else [n, n + 1, n + 4]
+    grad_equal = {}
+    for cap in caps:
+        buckets = jax.tree.map(jnp.asarray,
+                               scale.bucket_batches(batches, cap))
+        g_r = jax.jit(scale.sar_grads_fn(model, cfg, remat=True))(
+            state.params, state.batch_stats, buckets)
+        g_m = jax.jit(scale.sar_grads_fn(model, cfg, remat=False))(
+            state.params, state.batch_stats, buckets)
+        leaves_r = jax.tree.leaves(g_r)
+        leaves_m = jax.tree.leaves(g_m)
+        mismatched = [i for i, (a, b) in enumerate(zip(leaves_r, leaves_m))
+                      if not np.array_equal(np.asarray(a), np.asarray(b))]
+        check.expect(not mismatched,
+                     f"cap={cap}: {len(mismatched)} gradient leaves "
+                     f"differ remat vs monolithic")
+        l1 = sum(float(np.abs(np.asarray(a)).sum()) for a in leaves_r)
+        check.expect(l1 > 0, f"cap={cap}: gradient is identically zero "
+                             f"(vacuous equality)")
+        grad_equal[cap] = {"bitwise_equal": not mismatched,
+                           "grad_l1": round(l1, 3)}
+
+    # zero fresh compiles across live bucket counts at fixed capacity
+    step = scale.make_sar_train_step(model, cfg, tx, remat=True)
+    cap = n + 2
+    st = jax.tree.map(jnp.array, state)  # the step donates its state
+    for live in [n, min(2, n), 1]:
+        buckets = jax.tree.map(jnp.asarray,
+                               scale.bucket_batches(batches[:live], cap))
+        st, metrics = step(st, buckets)
+    compiles = step._cache_size()
+    check.expect(compiles == 1,
+                 f"live-count changes compiled fresh ({compiles} "
+                 f"executables for one capacity)")
+    check.expect(float(metrics["count"]) > 0,
+                 "SAR step metrics empty at live=1")
+
+    # remat temp bytes strictly below monolithic at >= 2 buckets
+    abs_of = lambda t: jax.tree.map(  # noqa: E731
+        lambda a: jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                       np.asarray(a).dtype), t)
+    abs_s, abs_b = abs_of(state), abs_of(scale.bucket_batches(batches,
+                                                              cap))
+    remat_tmp = scale.step_temp_bytes(
+        scale.make_sar_train_step(model, cfg, tx, remat=True),
+        abs_s, abs_b)
+    mono_tmp = scale.step_temp_bytes(
+        scale.make_sar_train_step(model, cfg, tx, remat=False),
+        abs_s, abs_b)
+    if remat_tmp is None or mono_tmp is None:
+        check.expect(False, "backend offers no memory_analysis — cannot "
+                            "certify the remat memory bound")
+    else:
+        check.expect(remat_tmp < mono_tmp,
+                     f"remat temp bytes not below monolithic "
+                     f"({remat_tmp} >= {mono_tmp}) at {cap} buckets")
+    return {"train_batches": n, "grad_equal": grad_equal,
+            "sar_executables": compiles,
+            "remat_temp_bytes": remat_tmp, "mono_temp_bytes": mono_tmp,
+            "temp_headroom": (round(1 - remat_tmp / mono_tmp, 4)
+                              if remat_tmp and mono_tmp else None)}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dryrun", action="store_true",
+                   help="CI smoke: tiny corpus, sampled permutations, "
+                        "2 capacities")
+    args = p.parse_args(argv)
+
+    import jax
+
+    check = Check()
+    t0 = time.perf_counter()
+    check.expect(len(jax.devices()) >= 2,
+                 f"need a 2-device mesh, have {len(jax.devices())}")
+
+    cfg = make_cfg()
+    spec = corpus_spec(args.dryrun)
+    base, deltas = make_corpus(spec, cfg)
+
+    merge_report = check_sharded_merge(check, cfg, base, deltas,
+                                       args.dryrun)
+
+    from pertgnn_tpu.stream import merge_shards
+
+    dataset, _info = merge_shards(base, list(deltas), cfg)
+    sar_report = check_sar(check, cfg, dataset, args.dryrun)
+
+    print(json.dumps({
+        "bench": "scale", "dryrun": args.dryrun,
+        "ok": not check.failures, "failures": check.failures,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "merge": merge_report, "sar": sar_report,
+    }))
+    return 1 if check.failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,8 +14,9 @@ import os
 from pertgnn_tpu.config import (ATTENTION_IMPLS, SERVE_DTYPES,
                                 CompileCacheConfig, Config, DataConfig,
                                 FleetConfig, IngestConfig, LensConfig,
-                                ModelConfig, ParallelConfig, ServeConfig,
-                                StreamConfig, TelemetryConfig, TrainConfig)
+                                ModelConfig, ParallelConfig, ScaleConfig,
+                                ServeConfig, StreamConfig, TelemetryConfig,
+                                TrainConfig)
 
 
 def apply_platform_env() -> None:
@@ -730,6 +731,36 @@ def stream_config_from_args(args: argparse.Namespace) -> StreamConfig:
                                 StreamConfig.finetune_epochs))
 
 
+def add_scale_flags(p: argparse.ArgumentParser) -> None:
+    """Giant-corpus scale-out knobs (ScaleConfig,
+    pertgnn_tpu/parallel/scale.py) — train_main's scale surface."""
+    p.add_argument("--scale_hosts", type=int,
+                   default=ScaleConfig.scale_hosts,
+                   help="partition the delta shard set over this many "
+                        "logical hosts (content-key-ordered assignment; "
+                        "each host mmaps only its slice and the merge "
+                        "statistics ride mesh collectives). 1 = the "
+                        "single-host merge path")
+    p.add_argument("--accum_buckets", type=int,
+                   default=ScaleConfig.accum_buckets,
+                   help="topology-bucket capacity of the SAR "
+                        "rematerialized train step (one optimizer "
+                        "update per scan over this many bucket slots; "
+                        "gradients bit-identical to the "
+                        "aggregation-held step at bounded peak HBM). "
+                        "<= 1 = the monolithic per-batch step")
+
+
+def scale_config_from_args(args: argparse.Namespace) -> ScaleConfig:
+    """The ONE flags -> ScaleConfig mapping (same pattern as
+    stream_config_from_args)."""
+    return ScaleConfig(
+        scale_hosts=getattr(args, "scale_hosts",
+                            ScaleConfig.scale_hosts),
+        accum_buckets=getattr(args, "accum_buckets",
+                              ScaleConfig.accum_buckets))
+
+
 def config_from_args(args: argparse.Namespace) -> Config:
     # staging tri-state: --staged_epochs {auto,on,off}; the legacy
     # --no_stage_epoch_recipes alias forces off
@@ -816,6 +847,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
                                 ServeConfig.serve_dtype)),
         fleet=fleet_config_from_args(args),
         stream=stream_config_from_args(args),
+        scale=scale_config_from_args(args),
         lens=lens_config_from_args(args),
         telemetry=telemetry_config_from_args(args),
         aot=aot_config_from_args(args),

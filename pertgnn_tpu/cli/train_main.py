@@ -18,7 +18,7 @@ import sys
 
 from pertgnn_tpu.cli.common import (add_aot_flags, add_ingest_flags,
                                     add_model_train_flags,
-                                    add_stream_flags,
+                                    add_scale_flags, add_stream_flags,
                                     add_telemetry_flags, apply_platform_env,
                                     build_dataset_cached, config_from_args,
                                     setup_compile_cache, setup_telemetry)
@@ -51,6 +51,7 @@ def main(argv=None) -> None:
     add_ingest_flags(p)
     add_model_train_flags(p)
     add_stream_flags(p)
+    add_scale_flags(p)
     add_telemetry_flags(p)
     add_aot_flags(p)
     p.add_argument("--supervise", type=int, default=0, metavar="N",

@@ -575,6 +575,40 @@ class StreamConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ScaleConfig:
+    """Giant-corpus scale-out knobs (pertgnn_tpu/parallel/scale.py).
+
+    Two independent axes for the regime where one host's arena or one
+    device's HBM no longer holds the corpus (ROADMAP item 2):
+
+    - per-host SHARDED delta arenas: delta shards are assigned to hosts
+      deterministically in content-key order, each host mmaps only its
+      slice of the stream store (stream/store.py ``open_shards``), and
+      the mixture/vocab statistics merge via collectives over the
+      existing mesh — bit-identical to the single-host
+      stream/merge.py oracle (benchmarks/scale_bench.py asserts it);
+    - SAR-style REMATERIALIZED training (arXiv:2111.06483): one
+      optimizer step sequentially aggregates over topology buckets with
+      per-bucket rematerialization, so mixtures larger than one
+      device's memory train at bounded peak HBM with gradients
+      bit-identical to the aggregation-held (monolithic) step."""
+
+    # Number of logical hosts the delta shard set is partitioned over.
+    # 1 (default) = the single-host merge path, byte-for-byte the
+    # pre-scale behavior. Must not exceed the mesh's data-axis size
+    # when the collective merge runs.
+    scale_hosts: int = 1
+    # Topology-bucket CAPACITY of the SAR accumulated train step: one
+    # compiled program scans over this many bucket slots (short
+    # mixtures ride zero-masked slots skipped under lax.cond, so the
+    # live bucket count varies with ZERO fresh compiles). <= 1 = the
+    # monolithic per-batch step, exactly as before. A mixture needing
+    # more buckets than this refuses loudly (scale.accum_overflow)
+    # instead of silently truncating.
+    accum_buckets: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class LensConfig:
     """Distributional / explainable what-if serving knobs
     (pertgnn_tpu/lens/ — docs/GUIDE.md §13).
@@ -700,6 +734,7 @@ class Config:
     serve: ServeConfig = ServeConfig()
     fleet: FleetConfig = FleetConfig()
     stream: StreamConfig = StreamConfig()
+    scale: ScaleConfig = ScaleConfig()
     lens: LensConfig = LensConfig()
     telemetry: TelemetryConfig = TelemetryConfig()
     aot: CompileCacheConfig = CompileCacheConfig()

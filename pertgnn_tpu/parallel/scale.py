@@ -1,0 +1,669 @@
+"""Giant-corpus scale-out: per-host sharded delta arenas + SAR training.
+
+Two halves, one regime (ISSUE 18 — the corpus no longer fits one host):
+
+**Per-host sharded delta arenas.**  Delta shards are assigned to hosts
+deterministically — sorted by the SAME content key the single-host merge
+uses to canonically order them (``stream.merge.canonical_key``), then
+round-robin — so every host derives the identical assignment from shard
+content alone, with no coordinator.  Each host opens ONLY its slice of
+the stream store (``DeltaArenaStore.open_shards``), computes per-shard
+partial statistics, and the corpus-global statistics merge via REAL
+collectives over the existing mesh's ``data`` axis (psum for occurrence
+/ coverage counts, pmin for first-appearance trace ids) instead of a
+single-host rebuild.  The merged dataset is pinned BIT-IDENTICAL to the
+single-host ``stream/merge.py`` oracle (tests/test_scale.py,
+benchmarks/scale_bench.py): both paths run the same factored phases
+(``entry_union``, ``pattern_union``, guard checks, assembly tail) on the
+same summaries; only the numeric reductions travel a different route,
+and integer psum/pmin are order-exact.
+
+**SAR-style rematerialized training** (after SAR, arXiv:2111.06483):
+entry mixtures larger than one device's memory train as a sequential
+aggregation over TOPOLOGY BUCKETS — the epoch's packed mixture batches,
+grouped into a fixed-capacity leading-stacked pytree — with gradient
+accumulation expressed as ``jax.grad`` of a ``lax.scan`` over the
+buckets.  The scan carries the pinball numerator and mask count per
+term (``quantile_loss_sums``) and divides ONCE after the scan, so the
+accumulated gradient is the gradient of the same scalar loss whether or
+not the per-bucket body is rematerialized.  With ``remat=True`` the
+bucket body runs under ``jax.checkpoint``: XLA stores O(1 bucket) of
+residuals and recomputes per-bucket activations on the backward pass —
+peak memory is bounded by ONE bucket instead of the whole mixture
+(asserted via ``device.mem.peak_bytes`` on chips and the compiled
+program's temp-buffer analysis in CI).  The checkpoint policy is NOT
+``nothing_saveable``: recomputed values are only bit-identical to the
+stored forward when every op whose result depends on evaluation detail
+— transcendental approximations (fusion-context-dependent codegen) and
+multi-element reductions/scatters (accumulation order) — is SAVED
+rather than replayed (:data:`BIT_STABLE_SAVE`,
+:func:`bit_stable_policy`).  Everything else (gathers, adds, muls,
+selects, broadcasts — the bulk of the residual footprint) recomputes
+exactly.  ``remat=False`` is the aggregation-held monolithic twin: the
+SAME arithmetic, residuals for all buckets held live — its gradients
+are the tolerance-0 reference (benchmarks/scale_bench.py asserts
+bit-equivalence in f32).  Dead
+(all-masked padding) buckets skip under ``lax.cond``, so the bucket
+CAPACITY is a compile-time constant while the LIVE count varies freely
+— zero fresh compiles across bucket counts, and donation
+(``donate_argnums=0``) is preserved because the accumulated step is one
+jitted ``(state, buckets) -> (state, metrics)`` program like every
+other train step.
+
+Refusals (docs/RELIABILITY.md): hosts whose derived assignments
+disagree raise :class:`HostAssignmentMismatch` (counter
+``scale.host_assignment_mismatch``) before any partial statistics are
+computed — a half-sharded merge would be silently wrong; a mixture that
+needs more buckets than the configured capacity raises
+:class:`AccumulationOverflow` (counter ``scale.accum_overflow``)
+instead of truncating the epoch; and every situation the single-host
+merge refuses (``StreamRebuildRequired``) refuses identically here —
+the guards are the same code.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import logging
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # 0.4.x keeps it in jax.experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.batching.pack import PackedBatch, zero_masked
+from pertgnn_tpu.config import Config
+from pertgnn_tpu.models.pert_model import PertGNN
+from pertgnn_tpu.parallel.mesh import DATA_AXIS
+from pertgnn_tpu.stream.delta import ShardDelta, vocab_hash
+from pertgnn_tpu.stream.merge import (MergeInfo, StreamRebuildRequired,
+                                      canonical_key, check_coverage_drift,
+                                      check_ordering, check_trace_disjoint,
+                                      coverage_mask, entry_union,
+                                      finalize_dataset, pattern_union)
+from pertgnn_tpu.train.loop import (TrainState, _METRIC_KEYS,
+                                    _resolved_taus)
+from pertgnn_tpu.train.metrics import (masked_metric_sums,
+                                       quantile_loss_sums)
+
+log = logging.getLogger(__name__)
+
+# pmin identity for global trace ids (int32 — a corpus would need >2.1B
+# traces to overflow, far past this repo's regime)
+_INT_INF = np.iinfo(np.int32).max
+
+
+class HostAssignmentMismatch(RuntimeError):
+    """Two hosts derived different shard-to-host assignments — their
+    views of the delta store disagree (stale listing, partial sync).
+    Merging would double- or zero-count shards; refuse before any
+    statistics are computed.  Counter: ``scale.host_assignment_mismatch``."""
+
+
+class AccumulationOverflow(RuntimeError):
+    """The mixture needs more topology buckets than the configured
+    capacity (``ScaleConfig.accum_buckets``) — truncating would silently
+    train on a subset.  Raise the flag or shrink the batch budget.
+    Counter: ``scale.accum_overflow``."""
+
+
+# -- shard-to-host assignment --------------------------------------------
+
+def assign_shards(deltas: list[ShardDelta], num_hosts: int
+                  ) -> list[list[int]]:
+    """host -> indices into `deltas` (the CALLER's order), derived from
+    shard content alone: canonical-key sort, then round-robin.  A pure
+    function of the shard SET — permutation-invariant in the input
+    order, so every host computes the identical assignment without
+    coordination (hypothesis-pinned in tests/test_scale.py)."""
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    order = sorted(range(len(deltas)), key=lambda i: canonical_key(deltas[i]))
+    out: list[list[int]] = [[] for _ in range(num_hosts)]
+    for rank, i in enumerate(order):
+        out[rank % num_hosts].append(i)
+    return out
+
+
+def assignment_fingerprint(deltas: list[ShardDelta],
+                           num_hosts: int) -> str:
+    """Content hash of the full assignment as THIS host derives it.
+    Hosts exchange fingerprints and cross-check (verify_assignment)
+    before computing partials — the cheap proof their store views
+    agree."""
+    h = hashlib.sha256()
+    h.update(str(num_hosts).encode())
+    for host_slice in assign_shards(deltas, num_hosts):
+        h.update(b"|host|")
+        for i in host_slice:
+            h.update(repr(canonical_key(deltas[i])).encode())
+    return h.hexdigest()[:16]
+
+
+def verify_assignment(fingerprints: list[str], bus=None) -> None:
+    """Refuse (HostAssignmentMismatch) unless every host's assignment
+    fingerprint agrees."""
+    distinct = sorted(set(fingerprints))
+    if len(distinct) > 1:
+        bus = bus if bus is not None else telemetry.get_bus()
+        bus.counter("scale.host_assignment_mismatch",
+                    hosts=len(fingerprints), distinct=len(distinct))
+        raise HostAssignmentMismatch(
+            f"{len(fingerprints)} host(s) derived {len(distinct)} "
+            f"different shard assignments ({distinct}) — store views "
+            f"disagree; re-sync the delta store before merging")
+
+
+# -- collective statistics rounds ----------------------------------------
+
+def allreduce_fn(mesh: Mesh, op: str) -> Callable:
+    """One statistics round as a shard_map'd collective kernel over the
+    mesh's ``data`` axis: input is a (slots, K) stack of per-slot
+    partials sharded on dim 0; each device folds its local slot then
+    psum ("sum") or pmin ("min") completes the global (K,) statistic,
+    replicated.  Exposed standalone so graftaudit traces exactly the
+    program the merge runs (collective-audit: the only axis name used
+    is a mesh axis)."""
+    if op not in ("sum", "min"):
+        raise ValueError(f"op must be 'sum' or 'min', got {op!r}")
+
+    def f(x):
+        local = x.sum(0) if op == "sum" else x.min(0)
+        red = jax.lax.psum if op == "sum" else jax.lax.pmin
+        return red(local, DATA_AXIS)
+
+    return _shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P())
+
+
+def mesh_allreduce(parts: list[np.ndarray], mesh: Mesh,
+                   op: str) -> np.ndarray:
+    """Merge per-host 1-D integer partials into the global statistic
+    with a REAL collective.  Hosts fold into the mesh's data-axis slots
+    (host h -> slot h % D, identity-padded) so any host count runs on
+    any mesh; integer psum/pmin are order-exact, which is what keeps
+    the collective route bit-identical to the single-host loop."""
+    ndev = mesh.shape[DATA_AXIS]
+    ident = 0 if op == "sum" else _INT_INF
+    slots = np.full((ndev,) + parts[0].shape, ident, np.int32)
+    for h, p in enumerate(parts):
+        if op == "sum":
+            slots[h % ndev] += np.asarray(p, np.int32)
+        else:
+            slots[h % ndev] = np.minimum(slots[h % ndev],
+                                         np.asarray(p, np.int32))
+    out = jax.jit(allreduce_fn(mesh, op))(jnp.asarray(slots))
+    return np.asarray(jax.device_get(out))
+
+
+# -- the sharded merge ----------------------------------------------------
+
+def sharded_merge(base: ShardDelta, deltas: list[ShardDelta], cfg: Config,
+                  mesh: Mesh, num_hosts: int | None = None, bus=None):
+    """(Dataset, MergeInfo) for base + deltas with the statistics merged
+    over `mesh` — BIT-IDENTICAL to ``merge_shards(base, deltas, cfg)``
+    for any delta order and any host count.
+
+    The base shard is replicated (every host holds it — it defines the
+    vocabulary and is a single mmap); deltas are per-host.  Cheap
+    summaries (spans, trace-id sets, entry vocab lists + counts,
+    pattern key bytes, unique resource-ms codes) are exchanged
+    host-side and walked identically on every host through the factored
+    merge phases; the per-trace numeric statistics (coverage universe,
+    occurrence counts, first-appearance trace ids, drop counts) merge
+    via psum/pmin rounds over the mesh.  Multiple small rounds are
+    inherent: coverage feeds occurrence feeds admission feeds
+    first-appearance — the same dependency chain the single-host loop
+    walks in order.
+    """
+    bus = bus if bus is not None else telemetry.get_bus()
+    t0 = time.perf_counter()
+    if base.kind != "base" or base.vocabs is None:
+        raise ValueError("sharded_merge needs the BASE shard first")
+    # host count: explicit argument > --scale_hosts config > mesh data axis
+    if num_hosts is not None:
+        hosts = int(num_hosts)
+    elif cfg.scale.scale_hosts > 1:
+        hosts = cfg.scale.scale_hosts
+    else:
+        hosts = mesh.shape[DATA_AXIS]
+    assignment = assign_shards(deltas, hosts)
+    # every host derives the assignment from ITS store view; fingerprints
+    # cross-check before any partials are computed (simulated hosts share
+    # one view in-process — multi-process wiring exchanges the strings)
+    verify_assignment([assignment_fingerprint(deltas, hosts)
+                       for _ in range(hosts)], bus)
+
+    base_hash = vocab_hash(base.vocabs)
+    ordered_idx = sorted(range(len(deltas)),
+                         key=lambda i: canonical_key(deltas[i]))
+    ordered = [base] + [deltas[i] for i in ordered_idx]
+    # delta position in canonical order -> owning host (round-robin over
+    # the SAME sort assign_shards used, so rank r lives on host r % H)
+    owner_of_pos = {pos + 1: pos % hosts
+                    for pos in range(len(ordered_idx))}
+    try:
+        for d in deltas:
+            if d.base_vocab_hash != base_hash:
+                raise StreamRebuildRequired(
+                    "base_changed",
+                    f"delta coded against base {d.base_vocab_hash}, "
+                    f"merging against {base_hash}")
+        check_ordering([(s.span_ts_min, s.span_ts_max) for s in ordered])
+        check_trace_disjoint([set(np.asarray(s.traceid_strings).tolist())
+                              for s in ordered])
+    except StreamRebuildRequired as e:
+        bus.counter("stream.rebuild", reason=e.reason)
+        raise
+
+    offsets = np.concatenate(
+        [[0], np.cumsum([s.n_traces_total for s in ordered])[:-1]])
+    ends = offsets + np.asarray([s.n_traces_total for s in ordered])
+    thr = cfg.ingest.min_traces_per_entry
+
+    # -- exchanged summaries: identical walk on every host --------------
+    entry_code, entry_maps, new_entries, _ = entry_union(
+        base,
+        [s.entry_vocab for s in ordered[1:]],
+        [np.bincount(s.entry_local, minlength=len(s.entry_vocab))
+         for s in ordered[1:]], thr, bus)
+    _, shard_uidx, shard_pid_by_uidx, new_topologies = pattern_union(
+        [[s.pattern_key(pid) for pid in range(s.num_patterns)]
+         for s in ordered])
+    check_coverage_drift(base, [s.res_ms for s in ordered[1:]], bus)
+
+    def host_positions(h: int) -> list[int]:
+        """Canonical-order positions (>= 1) of host h's deltas."""
+        return [pos for pos, hh in owner_of_pos.items() if hh == h]
+
+    # -- round 1 (psum): coverage universe -------------------------------
+    num_ms = len(base.vocabs["ms"])
+    cov_parts = []
+    for h in range(hosts):
+        part = np.zeros(num_ms, np.int32)
+        for pos in host_positions(h):
+            ms = np.unique(np.asarray(ordered[pos].res_ms))
+            part[ms] = 1
+        cov_parts.append(part)
+    cov_global = mesh_allreduce(cov_parts, mesh, "sum")
+    base_bitmap = np.zeros(num_ms, np.int32)
+    base_bitmap[np.unique(np.asarray(base.res_ms))] = 1
+    covered_ms = np.flatnonzero((cov_global > 0) | (base_bitmap > 0))
+
+    cov_masks: dict[int, np.ndarray] = {}
+    for pos in range(1, len(ordered)):
+        cov_masks[pos] = coverage_mask(ordered[pos], covered_ms,
+                                       cfg.ingest.min_resource_coverage)
+
+    # -- round 2 (psum): occurrence counts over coverage-admitted rows --
+    occ_parts = []
+    for h in range(hosts):
+        part = np.zeros(len(entry_code), np.int32)
+        for pos in host_positions(h):
+            s = ordered[pos]
+            rows = cov_masks[pos][s.traceid]
+            np.add.at(part, entry_maps[pos - 1][s.entry_local[rows]], 1)
+        occ_parts.append(part)
+    occ = mesh_allreduce(occ_parts, mesh, "sum").astype(np.int64)
+    occ += np.bincount(base.entry_local,
+                       minlength=len(entry_code)).astype(np.int64)
+    entry_ok = occ > thr
+
+    def admitted_mask(pos: int) -> np.ndarray:
+        s = ordered[pos]
+        if pos == 0:
+            return np.ones(len(s.traceid), dtype=bool)
+        ent = entry_maps[pos - 1][s.entry_local]
+        return cov_masks[pos][s.traceid] & entry_ok[ent]
+
+    # -- round 3 (pmin + psum): first-appearance tids and drop counts ---
+    num_uidx = max((int(u.max(initial=-1)) for u in shard_uidx),
+                   default=-1) + 1
+    tid_parts, drop_parts = [], []
+    for h in range(hosts):
+        part = np.full(num_uidx, _INT_INF, np.int32)
+        drops = np.zeros(2, np.int32)  # [coverage, occurrence]
+        for pos in host_positions(h):
+            s = ordered[pos]
+            ent = entry_maps[pos - 1][s.entry_local]
+            cov_ok = cov_masks[pos][s.traceid]
+            occ_ok = entry_ok[ent]
+            ok = cov_ok & occ_ok
+            tid = (s.traceid + offsets[pos]).astype(np.int32)
+            u = shard_uidx[pos][s.runtime_local]
+            np.minimum.at(part, u[ok], tid[ok])
+            drops[0] += int((~cov_ok).sum())
+            drops[1] += int((cov_ok & ~occ_ok).sum())
+        tid_parts.append(part)
+        drop_parts.append(drops)
+    min_tid = mesh_allreduce(tid_parts, mesh, "min")
+    dropped_cov, dropped_occ = (int(x) for x in
+                                mesh_allreduce(drop_parts, mesh, "sum"))
+    base_part = np.full(num_uidx, _INT_INF, np.int32)
+    np.minimum.at(base_part, shard_uidx[0][base.runtime_local],
+                  base.traceid.astype(np.int32))
+    min_tid = np.minimum(min_tid, base_part)
+
+    # final runtime codes: rank of first-appearance tid among live
+    # patterns — pd.factorize over the tid-sorted admitted rows assigns
+    # codes in exactly this order (each tid belongs to one trace of one
+    # pattern, so the minima are distinct)
+    live = np.flatnonzero(min_tid < _INT_INF)
+    runtime_of_uidx = np.full(num_uidx, -1, np.int64)
+    runtime_of_uidx[live[np.argsort(min_tid[live], kind="stable")]] = (
+        np.arange(len(live)))
+
+    # -- representatives + graphs (owner-host checked) -------------------
+    graphs: dict = {}
+    for u in live:
+        rep_tid = int(min_tid[u])
+        si = int(np.searchsorted(ends, rep_tid, side="right"))
+        s = ordered[si]
+        local = rep_tid - int(offsets[si])
+        pid = shard_pid_by_uidx[si].get(int(u))
+        if pid is None or int(s.pat_rep_trace[pid]) != local:
+            bus.counter("stream.rebuild", reason="representative_drift")
+            raise StreamRebuildRequired(
+                "representative_drift",
+                f"runtime pattern {int(runtime_of_uidx[u])}: first "
+                f"surviving trace {rep_tid} is not the trace its shard "
+                f"built the graph from (filters moved the "
+                f"representative)")
+        graphs[int(runtime_of_uidx[u])] = s.graphs[pid]
+
+    # -- per-shard meta rows, concatenated in canonical order ------------
+    tids, entries, runtimes, tsbs, ys = [], [], [], [], []
+    info_shards = []
+    for pos, s in enumerate(ordered):
+        ok = admitted_mask(pos)
+        ent = (s.entry_local if pos == 0
+               else entry_maps[pos - 1][s.entry_local])
+        tids.append((s.traceid + offsets[pos])[ok])
+        entries.append(ent[ok])
+        runtimes.append(runtime_of_uidx[shard_uidx[pos][s.runtime_local]][ok])
+        tsbs.append(s.ts_bucket[ok])
+        ys.append(s.y[ok])
+        info_shards.append((s.kind, int(offsets[pos]), s.n_traces_total,
+                            int(ok.sum())))
+
+    dataset, table = finalize_dataset(
+        np.concatenate(tids), np.concatenate(entries),
+        np.concatenate(runtimes), np.concatenate(tsbs),
+        np.concatenate(ys), graphs,
+        np.concatenate([s.res_ts for s in ordered]),
+        np.concatenate([s.res_ms for s in ordered]),
+        np.concatenate([s.res_values for s in ordered]), cfg, bus)
+
+    dt = time.perf_counter() - t0
+    bus.histogram("scale.merge_seconds", dt, hosts=hosts)
+    bus.gauge("scale.merge_hosts", hosts)
+    log.info(
+        "sharded merge: %d shard(s) over %d host(s), %d traces "
+        "(%d dropped by filters), %d entries, %d patterns in %.2fs",
+        len(ordered), hosts, len(table.meta), dropped_cov + dropped_occ,
+        len(entry_code), len(live), dt)
+    info = MergeInfo(shards=info_shards, new_entries=new_entries,
+                     new_topologies=new_topologies,
+                     dropped_coverage=dropped_cov,
+                     dropped_occurrence=dropped_occ,
+                     meta=table.meta.iloc[:cfg.data.max_traces])
+    return dataset, info
+
+
+# -- SAR-style rematerialized training -----------------------------------
+
+#: Primitives whose recomputation is NOT guaranteed bit-identical to the
+#: stored forward value, so the remat policy saves them instead of
+#: replaying them on the backward pass.  Two families:
+#:
+#: - transcendentals: XLA emits polynomial/Newton approximations whose
+#:   exact bits depend on the fusion context they are compiled into
+#:   (observed on XLA:CPU — a rematerialized ``exp``/``rsqrt`` chain can
+#:   differ by 1 ulp from the forward program's, which is enough to break
+#:   the tolerance-0 gradient assert);
+#: - multi-element reductions and scatters: accumulation ORDER is a
+#:   scheduling choice, stable within one program but not across the
+#:   remat/no-remat pair (and genuinely nondeterministic for scatters on
+#:   some accelerator backends).
+#:
+#: Everything else — gathers, element-wise arithmetic, selects,
+#: broadcasts, which dominate the residual footprint of the attention
+#: bucket body — replays bit-exactly, so rematerializing it keeps the
+#: accumulated gradient bitwise equal to the monolithic one while still
+#: dropping the bulk of the stored residuals (~60% of temp bytes on the
+#: CI model; ``benchmarks/scale_bench.py`` prints the measured pair).
+BIT_STABLE_SAVE = frozenset({
+    # transcendental / approximated element-wise
+    "exp", "exp2", "expm1", "log", "log1p", "tanh", "logistic", "erf",
+    "erfc", "erf_inv", "rsqrt", "sqrt", "cbrt", "div", "sin", "cos",
+    "tan", "asin", "acos", "atan", "atan2", "sinh", "cosh", "asinh",
+    "acosh", "atanh", "pow", "integer_pow", "digamma", "lgamma",
+    # order-sensitive reductions / scatters (dot_general excluded: its
+    # blocking is shape-deterministic, and its outputs are the largest
+    # residuals — saving them would forfeit most of the memory win)
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "argmax", "argmin", "cumsum", "cumprod",
+    "cummax", "cummin", "cumlogsumexp", "scatter", "scatter-add",
+    "scatter-mul", "scatter-min", "scatter-max", "sort", "top_k",
+})
+
+
+def bit_stable_policy(prim, *_, **__) -> bool:
+    """``jax.checkpoint`` policy: save exactly the primitives whose
+    replay is not bit-stable (:data:`BIT_STABLE_SAVE`), rematerialize
+    the rest.  This is what pins grad(remat) == grad(monolithic) at
+    tolerance 0 — see the module docstring."""
+    return getattr(prim, "name", None) in BIT_STABLE_SAVE
+
+
+def bucket_batches(batches: list[PackedBatch], capacity: int,
+                   bus=None) -> PackedBatch:
+    """Leading-stack `batches` into the fixed bucket capacity, padded
+    with inert zero-mask clones.  The CAPACITY is the compile-time
+    constant; live counts up to it reuse one program.  A mixture that
+    needs MORE buckets than capacity refuses loudly
+    (AccumulationOverflow + ``scale.accum_overflow``) — truncation
+    would silently train on a subset of the corpus."""
+    if not batches:
+        raise ValueError("bucket_batches needs at least one batch")
+    if len(batches) > capacity:
+        bus = bus if bus is not None else telemetry.get_bus()
+        bus.counter("scale.accum_overflow", need=len(batches),
+                    capacity=capacity)
+        raise AccumulationOverflow(
+            f"mixture needs {len(batches)} topology bucket(s) but "
+            f"accum_buckets={capacity}; raise --accum_buckets (or "
+            f"shrink the batch budget so fewer buckets cover an epoch)")
+    group = list(batches) + [zero_masked(batches[-1])] * (capacity
+                                                          - len(batches))
+    return jax.tree.map(lambda *xs: np.stack(xs), *group)
+
+
+def sar_bucket_terms_fn(model: PertGNN, cfg: Config) -> Callable:
+    """``(params, batch_stats, batch, dropout_key) -> (pinball_num,
+    graph_cnt, local_num, local_cnt, new_batch_stats, metric_sums)`` —
+    ONE bucket's additive contribution to the accumulated step, exactly
+    as :func:`_sar_loss` scans it (this IS the scanned body, factored
+    out so graftaudit traces the real program: every sum here is masked,
+    which is what lets a zero-masked padding bucket ride a scan slot
+    without touching the gradients).  ``dropout_key`` may be None when
+    ``cfg.model.dropout == 0``."""
+    taus, pi = _resolved_taus(cfg)
+    scale = cfg.train.label_scale
+    lw = cfg.model.local_loss_weight
+
+    def terms(params, batch_stats, b, dropout_key):
+        variables = {"params": params, "batch_stats": batch_stats}
+        rngs = ({"dropout": dropout_key} if dropout_key is not None
+                else {})
+        (global_pred, local_pred), updates = model.apply(
+            variables, b, training=True, mutable=["batch_stats"],
+            rngs=rngs)
+        y_scaled = b.y / scale
+        if len(taus) == 1:
+            pnum, gcnt = quantile_loss_sums(y_scaled, global_pred,
+                                            taus[0], b.graph_mask)
+            primary = global_pred
+        else:
+            tau_terms = [quantile_loss_sums(y_scaled, global_pred[:, j],
+                                            t, b.graph_mask)
+                         for j, t in enumerate(taus)]
+            # the mask count is identical across taus, so summing the
+            # numerators and dividing once equals the sum of per-tau
+            # means
+            pnum = sum(t[0] for t in tau_terms)
+            gcnt = tau_terms[pi][1]
+            primary = global_pred[:, pi]
+        lnum = lcnt = jnp.zeros((), jnp.float32)
+        if lw > 0:
+            lnum, lcnt = quantile_loss_sums(y_scaled[b.node_graph],
+                                            local_pred, taus[pi],
+                                            b.node_mask)
+        metrics = masked_metric_sums(b.y, primary * scale, taus[pi],
+                                     b.graph_mask)
+        return (pnum, gcnt, lnum, lcnt, updates["batch_stats"], metrics)
+
+    return terms
+
+
+def _sar_loss(model: PertGNN, cfg: Config, params, batch_stats, buckets,
+              rng, *, remat: bool):
+    """Scalar loss of the bucket-scanned epoch slice, carrying the
+    pinball numerator/count pairs through the scan and dividing ONCE at
+    the end — half of what makes grad(scan-with-remat) equal
+    grad(scan-without-remat) BITWISE: both differentiate the identical
+    arithmetic.  The other half is :func:`bit_stable_policy` — remat
+    must only replay ops whose recomputation is bit-exact.
+    batch_stats thread sequentially bucket-to-bucket
+    (training-mode BatchNorm normalizes each bucket with ITS batch
+    statistics, so the gradients are unaffected; the running-stats
+    bookkeeping is sequential by construction — GUIDE §15)."""
+    lw = cfg.model.local_loss_weight
+    terms = sar_bucket_terms_fn(model, cfg)
+
+    def bucket_terms(stats, b, i):
+        key = (jax.random.fold_in(rng, i) if cfg.model.dropout > 0
+               else None)
+        return terms(params, stats, b, key)
+
+    if remat:
+        bucket_terms = jax.checkpoint(bucket_terms,
+                                      policy=bit_stable_policy)
+
+    def body(carry, xb):
+        b, i = xb
+        pnum, gcnt, lnum, lcnt, stats = carry
+
+        def run(stats):
+            pn, gc, ln, lc, new_stats, m = bucket_terms(stats, b, i)
+            return (pnum + pn, gcnt + gc, lnum + ln, lcnt + lc,
+                    new_stats), m
+
+        def skip(stats):
+            return (pnum, gcnt, lnum, lcnt, stats), {
+                k: jnp.zeros((), jnp.float32) for k in _METRIC_KEYS}
+
+        return jax.lax.cond(jnp.any(b.graph_mask), run, skip, stats)
+
+    num_buckets = jax.tree.leaves(buckets)[0].shape[0]
+    zero = jnp.zeros((), jnp.float32)
+    (pnum, gcnt, lnum, lcnt, stats), ms = jax.lax.scan(
+        body, (zero, zero, zero, zero, batch_stats),
+        (buckets, jnp.arange(num_buckets)))
+    loss = pnum / jnp.maximum(gcnt, 1.0)
+    if lw > 0:
+        loss = loss + lw * (lnum / jnp.maximum(lcnt, 1.0))
+    metrics = jax.tree.map(lambda a: a.sum(0), ms)
+    return loss, (stats, metrics)
+
+
+def sar_step_fn(model: PertGNN, cfg: Config,
+                tx: optax.GradientTransformation, *,
+                remat: bool = True) -> Callable:
+    """UNJITTED accumulated step: ``(state, buckets) -> (state,
+    metrics)`` with ONE optimizer update for the whole bucket stack —
+    the SAR counterpart of ``train_step_fn``.  ``remat=False`` is the
+    aggregation-held monolithic twin (same arithmetic, all residuals
+    live) used as the tolerance-0 gradient reference."""
+
+    def step(state: TrainState, buckets: PackedBatch):
+        rng = jax.random.fold_in(jax.random.PRNGKey(cfg.train.seed),
+                                 state.step)
+        grad_fn = jax.value_and_grad(
+            lambda p: _sar_loss(model, cfg, p, state.batch_stats,
+                                buckets, rng, remat=remat),
+            has_aux=True)
+        (_, (new_stats, metrics)), grads = grad_fn(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return state.replace(params=new_params, batch_stats=new_stats,
+                             opt_state=new_opt,
+                             step=state.step + 1), metrics
+
+    return step
+
+
+def make_sar_train_step(model: PertGNN, cfg: Config,
+                        tx: optax.GradientTransformation, *,
+                        remat: bool = True) -> Callable:
+    """Jitted accumulated step, state buffers donated like every other
+    train step (the replaced state dies with the dispatch)."""
+    return jax.jit(sar_step_fn(model, cfg, tx, remat=remat),
+                   donate_argnums=0)
+
+
+def sar_grads_fn(model: PertGNN, cfg: Config, *,
+                 remat: bool = True) -> Callable:
+    """``(params, batch_stats, buckets) -> grads`` with a fixed rng —
+    the comparable gradient surface for the bit-equivalence asserts
+    (tests/test_scale.py, benchmarks/scale_bench.py compare
+    ``remat=True`` against ``remat=False`` at tolerance 0, f32)."""
+    rng = jax.random.PRNGKey(cfg.train.seed)
+
+    def grads(params, batch_stats, buckets):
+        return jax.grad(
+            lambda p: _sar_loss(model, cfg, p, batch_stats, buckets,
+                                rng, remat=remat)[0])(params)
+
+    return grads
+
+
+# -- memory accounting ----------------------------------------------------
+
+def step_temp_bytes(jit_fn: Callable, *abs_args) -> int | None:
+    """Compiled temp-buffer bytes of `jit_fn` at the given abstract
+    signature — the backend-portable peak proxy (XLA's
+    ``memory_analysis``; live on CPU where ``device.mem.peak_bytes``
+    gauges are not).  Residual storage for the backward pass lands in
+    temp buffers, which is exactly what rematerialization bounds — the
+    remat-vs-monolithic headroom the bench exit-asserts.  None when the
+    backend offers no analysis."""
+    try:
+        analysis = jit_fn.lower(*abs_args).compile().memory_analysis()
+    except Exception as e:  # backend without the analysis surface
+        log.debug("memory_analysis unavailable: %s", e)
+        return None
+    if analysis is None:
+        return None
+    v = getattr(analysis, "temp_size_in_bytes", None)
+    return int(v) if v is not None else None
+
+
+def sample_bucket_memory(bus, *, buckets: int, where: str = "sar_step",
+                         device=None) -> dict | None:
+    """Per-bucket-count allocator sample: ``device.mem.*`` gauges
+    tagged with the bucket capacity (None-safe no-op on CPU — the
+    bench then leans on :func:`step_temp_bytes`)."""
+    from pertgnn_tpu.telemetry.devmem import sample_device_memory
+
+    return sample_device_memory(bus, device=device, where=where,
+                                buckets=buckets)

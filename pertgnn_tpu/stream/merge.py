@@ -107,26 +107,47 @@ class MergeInfo:
                      ys=m["y"].to_numpy(np.float32))
 
 
+# -- reusable phases -----------------------------------------------------
+# The functions below are the merge's statistics phases, factored so the
+# scale-out path (parallel/scale.py) computes the SAME quantities from
+# per-host partials + exchanged summaries.  Where the single-host merge
+# holds every ShardDelta, a host holds only its assignment — so each
+# phase takes plain summaries (spans, id sets, vocab lists, key bytes),
+# never the shard objects, and both paths call the identical code.  Any
+# behavior change here moves BOTH the oracle and the sharded twin, which
+# is what keeps the bit-identity contract between them testable.
+
+def canonical_key(s: ShardDelta) -> tuple:
+    """The content key that totally orders delta shards: raw time span
+    first, first trace-id string as the tiebreak.  Shard-to-host
+    assignment (parallel/scale.py) sorts by this SAME key, which is what
+    makes the assignment a pure function of shard content — invariant
+    under arrival order."""
+    return (s.span_ts_min, s.span_ts_max,
+            str(s.traceid_strings[0]) if len(s.traceid_strings) else "")
+
+
 def _canonical_order(deltas: list[ShardDelta]) -> list[ShardDelta]:
-    return sorted(deltas, key=lambda s: (s.span_ts_min, s.span_ts_max,
-                                         str(s.traceid_strings[0])
-                                         if len(s.traceid_strings) else ""))
+    return sorted(deltas, key=canonical_key)
 
 
-def _check_ordering(shards: list[ShardDelta]) -> None:
-    for prev, nxt in zip(shards, shards[1:]):
-        if nxt.span_ts_min < prev.span_ts_max:
+def check_ordering(spans: list[tuple[int, int]]) -> None:
+    """``shard_overlap`` guard over (span_ts_min, span_ts_max) pairs in
+    canonical order — summaries, so hosts can run it after exchanging
+    spans without shipping shard bodies."""
+    for prev, nxt in zip(spans, spans[1:]):
+        if nxt[0] < prev[1]:
             raise StreamRebuildRequired(
                 "shard_overlap",
-                f"shard [{nxt.span_ts_min}, {nxt.span_ts_max}] interleaves "
-                f"[{prev.span_ts_min}, {prev.span_ts_max}] — trace codes "
+                f"shard [{nxt[0]}, {nxt[1]}] interleaves "
+                f"[{prev[0]}, {prev[1]}] — trace codes "
                 f"are assigned in global timestamp order")
 
 
-def _check_trace_disjoint(shards: list[ShardDelta]) -> None:
+def check_trace_disjoint(id_sets: list[set]) -> None:
+    """``trace_overlap`` guard over per-shard trace-id string sets."""
     seen: set = set()
-    for i, s in enumerate(shards):
-        ids = set(np.asarray(s.traceid_strings).tolist())
+    for i, ids in enumerate(id_sets):
         dup = seen & ids
         if dup:
             raise StreamRebuildRequired(
@@ -136,73 +157,34 @@ def _check_trace_disjoint(shards: list[ShardDelta]) -> None:
         seen |= ids
 
 
-def _coverage_mask(s: ShardDelta, covered_ms: np.ndarray,
-                   threshold: float) -> np.ndarray:
-    """Per-local-trace coverage verdict for one delta shard, from its
-    stored (trace, ms) incidence — the same >= threshold rule as
-    ingest.preprocess.filter_by_resource_coverage, against the UNION
-    resource table's microservice set."""
-    ok = np.zeros(s.n_traces_total, dtype=bool)
-    if len(s.inc_trace) == 0:
-        return ok
-    cov = np.isin(s.inc_ms, covered_ms)
-    uniq_tr, start = np.unique(s.inc_trace, return_index=True)
-    n_pairs = np.diff(np.concatenate([start, [len(s.inc_trace)]]))
-    n_cov = np.add.reduceat(cov.astype(np.int64), start)
-    ok[uniq_tr] = (n_cov / n_pairs) >= threshold
-    return ok
+def entry_union(base: ShardDelta, shard_vocabs: list,
+                shard_counts: list, thr: int, bus) -> tuple:
+    """Append-only global entry vocabulary over the base + delta shards
+    (canonical order), with the occurrence filter-drift guards.
 
-
-def merge_shards(base: ShardDelta, deltas: list[ShardDelta],
-                 cfg: Config, bus=None):
-    """(Dataset, MergeInfo) for base + deltas, in any delta order."""
-    bus = bus if bus is not None else telemetry.get_bus()
-    t0 = time.perf_counter()
-    if base.kind != "base" or base.vocabs is None:
-        raise ValueError("merge_shards needs the BASE shard first")
-    base_hash = vocab_hash(base.vocabs)
-    try:
-        for d in deltas:
-            if d.base_vocab_hash != base_hash:
-                raise StreamRebuildRequired(
-                    "base_changed",
-                    f"delta coded against base {d.base_vocab_hash}, "
-                    f"merging against {base_hash}")
-        shards = [base, *_canonical_order(deltas)]
-        _check_ordering(shards)
-        _check_trace_disjoint(shards)
-    except StreamRebuildRequired as e:
-        # every refusal reason rides the SAME counter — the rebuild
-        # signal operators alarm on (docs/OBSERVABILITY.md)
-        bus.counter("stream.rebuild", reason=e.reason)
-        raise
-
-    # global trace-code offsets (the union build factorizes trace ids
-    # over the time-sorted concatenation, so shard k's codes are its
-    # local codes plus the earlier shards' PRE-FILTER trace counts)
-    offsets = np.concatenate(
-        [[0], np.cumsum([s.n_traces_total for s in shards])[:-1]])
-
-    # -- global entry vocabulary (append-only) --------------------------
+    ``shard_vocabs[k]`` is delta k's entry-vocab string list;
+    ``shard_counts[k]`` its per-local-entry trace counts (bincount of
+    ``entry_local`` — exchanged as summaries in the sharded path).
+    Returns ``(entry_code, entry_maps, new_entries,
+    delta_count_by_string)``.
+    """
     entry_code: dict[str, int] = {s: i
                                   for i, s in enumerate(base.entry_vocab)}
     entry_maps: list[np.ndarray] = []
     new_entries = [0]
     occ_prefilter = base.entry_occ_prefilter or {}
-    thr = cfg.ingest.min_traces_per_entry
     delta_count_by_string: dict[str, int] = {}
-    for s in shards[1:]:
-        remap = np.empty(len(s.entry_vocab), np.int64)
+    for vocab, loc in zip(shard_vocabs, shard_counts):
+        remap = np.empty(len(vocab), np.int64)
         fresh = 0
-        for j, name in enumerate(s.entry_vocab):
+        for j, name in enumerate(vocab):
             if name not in entry_code:
                 entry_code[name] = len(entry_code)
                 fresh += 1
             remap[j] = entry_code[name]
         entry_maps.append(remap)
         new_entries.append(fresh)
-        loc = np.bincount(s.entry_local, minlength=len(s.entry_vocab))
-        for j, name in enumerate(s.entry_vocab):
+        for j, name in enumerate(vocab):
             delta_count_by_string[name] = (
                 delta_count_by_string.get(name, 0) + int(loc[j]))
 
@@ -239,18 +221,23 @@ def merge_shards(base: ShardDelta, deltas: list[ShardDelta],
                 f"filter ({n_base} <= {thr}) but base+delta "
                 f"({n_base}+{n_delta}) now passes — a batch rebuild "
                 f"would resurrect base traces the stream dropped")
+    return entry_code, entry_maps, new_entries, delta_count_by_string
 
-    # -- universal pattern identity -------------------------------------
+
+def pattern_union(shard_keys: list) -> tuple:
+    """Universal pattern identity over per-shard pattern-key byte lists
+    (base first, deltas in canonical order).  Returns ``(pat_uidx,
+    shard_uidx, shard_pid_by_uidx, new_topologies)`` — uidx assignment
+    is first-appearance in shard order, exactly the single-host walk."""
     pat_uidx: dict[bytes, int] = {}
     shard_uidx: list[np.ndarray] = []       # local pattern id -> uidx
     shard_pid_by_uidx: list[dict] = []      # uidx -> local pattern id
     new_topologies = []
-    for s in shards:
-        u = np.empty(s.num_patterns, np.int64)
+    for keys in shard_keys:
+        u = np.empty(len(keys), np.int64)
         fresh = 0
         inv: dict[int, int] = {}
-        for pid in range(s.num_patterns):
-            key = s.pattern_key(pid)
+        for pid, key in enumerate(keys):
             if key not in pat_uidx:
                 pat_uidx[key] = len(pat_uidx)
                 fresh += 1
@@ -259,18 +246,23 @@ def merge_shards(base: ShardDelta, deltas: list[ShardDelta],
         shard_uidx.append(u)
         shard_pid_by_uidx.append(inv)
         new_topologies.append(fresh)
-    new_topologies[0] = 0  # the base defines the universe, it isn't "new"
+    if new_topologies:
+        new_topologies[0] = 0  # the base defines the universe
+    return pat_uidx, shard_uidx, shard_pid_by_uidx, new_topologies
 
-    # coverage-drift guard, the resource-side twin of the occurrence
-    # guard above: a delta carrying the FIRST resource rows for an ms
-    # the base never resourced changes base traces' coverage verdicts
-    # in a from-scratch rebuild (ms-with-resources is corpus-global).
-    # Safe exactly when the base's coverage filter dropped nothing —
-    # otherwise the batch rebuild could resurrect base traces the
-    # stream no longer has, so refuse loudly.
+
+def check_coverage_drift(base: ShardDelta, shard_res_ms: list,
+                         bus) -> None:
+    """Coverage-drift guard, the resource-side twin of the occurrence
+    guard in :func:`entry_union`: a delta carrying the FIRST resource
+    rows for an ms the base never resourced changes base traces'
+    coverage verdicts in a from-scratch rebuild (ms-with-resources is
+    corpus-global).  Safe exactly when the base's coverage filter
+    dropped nothing — otherwise refuse loudly.  ``shard_res_ms[k]`` is
+    delta k's unique resource-ms codes (a summary, exchangeable)."""
     base_res_ms = np.unique(base.res_ms)
-    for i, s in enumerate(shards[1:], 1):
-        fresh_ms = np.setdiff1d(np.unique(s.res_ms), base_res_ms)
+    for i, ms in enumerate(shard_res_ms, 1):
+        fresh_ms = np.setdiff1d(np.unique(ms), base_res_ms)
         if len(fresh_ms) and (base.coverage_dropped is None
                               or base.coverage_dropped > 0):
             bus.counter("stream.rebuild", reason="filter_drift")
@@ -283,10 +275,104 @@ def merge_shards(base: ShardDelta, deltas: list[ShardDelta],
                 f"{base.coverage_dropped if base.coverage_dropped is not None else 'an unknown number of'} "
                 f"trace(s) — a batch rebuild could resurrect them")
 
+
+def finalize_dataset(tid_a, ent_a, runtime, tsb_a, y_a, graphs,
+                     res_ts, res_ms, res_values, cfg: Config, bus):
+    """The merge's assembly tail: resource-overlap guard, merged
+    resource lookup, mixture build, dataset tail.  Takes the ADMITTED
+    meta columns with final runtime codes — everything after this point
+    is identical whether the stats came from one host or a mesh.
+    Returns ``(dataset, table)``."""
+    dup = pd.MultiIndex.from_arrays([res_ts, res_ms]).duplicated()
+    if dup.any():
+        bus.counter("stream.rebuild", reason="resource_overlap")
+        raise StreamRebuildRequired(
+            "resource_overlap",
+            f"{int(dup.sum())} (ts_bucket, ms) resource group(s) appear "
+            f"in more than one shard — the batch path would aggregate "
+            f"the union's raw rows")
+    lookup = ResourceLookup.from_arrays(
+        res_ts, res_ms, res_values,
+        missing_indicator_is_one=cfg.model.missing_indicator_is_one)
+
+    meta = pd.DataFrame({"traceid": tid_a, "entry_id": ent_a,
+                         "runtime_id": runtime, "ts_bucket": tsb_a,
+                         "y": y_a})
+    table = table_from_meta(meta)
+    mixtures = build_mixtures(
+        graphs, table.entry2runtimes,
+        feature_all_stage_copies=cfg.model.feature_all_stage_copies)
+    dataset = dataset_from_parts(mixtures, lookup, table.meta, cfg)
+    return dataset, table
+
+
+def coverage_mask(s: ShardDelta, covered_ms: np.ndarray,
+                   threshold: float) -> np.ndarray:
+    """Per-local-trace coverage verdict for one delta shard, from its
+    stored (trace, ms) incidence — the same >= threshold rule as
+    ingest.preprocess.filter_by_resource_coverage, against the UNION
+    resource table's microservice set."""
+    ok = np.zeros(s.n_traces_total, dtype=bool)
+    if len(s.inc_trace) == 0:
+        return ok
+    cov = np.isin(s.inc_ms, covered_ms)
+    uniq_tr, start = np.unique(s.inc_trace, return_index=True)
+    n_pairs = np.diff(np.concatenate([start, [len(s.inc_trace)]]))
+    n_cov = np.add.reduceat(cov.astype(np.int64), start)
+    ok[uniq_tr] = (n_cov / n_pairs) >= threshold
+    return ok
+
+
+def merge_shards(base: ShardDelta, deltas: list[ShardDelta],
+                 cfg: Config, bus=None):
+    """(Dataset, MergeInfo) for base + deltas, in any delta order."""
+    bus = bus if bus is not None else telemetry.get_bus()
+    t0 = time.perf_counter()
+    if base.kind != "base" or base.vocabs is None:
+        raise ValueError("merge_shards needs the BASE shard first")
+    base_hash = vocab_hash(base.vocabs)
+    try:
+        for d in deltas:
+            if d.base_vocab_hash != base_hash:
+                raise StreamRebuildRequired(
+                    "base_changed",
+                    f"delta coded against base {d.base_vocab_hash}, "
+                    f"merging against {base_hash}")
+        shards = [base, *_canonical_order(deltas)]
+        check_ordering([(s.span_ts_min, s.span_ts_max) for s in shards])
+        check_trace_disjoint([set(np.asarray(s.traceid_strings).tolist())
+                              for s in shards])
+    except StreamRebuildRequired as e:
+        # every refusal reason rides the SAME counter — the rebuild
+        # signal operators alarm on (docs/OBSERVABILITY.md)
+        bus.counter("stream.rebuild", reason=e.reason)
+        raise
+
+    # global trace-code offsets (the union build factorizes trace ids
+    # over the time-sorted concatenation, so shard k's codes are its
+    # local codes plus the earlier shards' PRE-FILTER trace counts)
+    offsets = np.concatenate(
+        [[0], np.cumsum([s.n_traces_total for s in shards])[:-1]])
+
+    # -- global entry vocabulary (append-only) --------------------------
+    thr = cfg.ingest.min_traces_per_entry
+    entry_code, entry_maps, new_entries, _ = entry_union(
+        base,
+        [s.entry_vocab for s in shards[1:]],
+        [np.bincount(s.entry_local, minlength=len(s.entry_vocab))
+         for s in shards[1:]], thr, bus)
+
+    # -- universal pattern identity -------------------------------------
+    _, shard_uidx, shard_pid_by_uidx, new_topologies = pattern_union(
+        [[s.pattern_key(pid) for pid in range(s.num_patterns)]
+         for s in shards])
+
+    check_coverage_drift(base, [s.res_ms for s in shards[1:]], bus)
+
     # -- deferred corpus-global filters (delta rows only) ---------------
     covered_ms = np.unique(np.concatenate([s.res_ms for s in shards]))
     cov_masks = [None] + [
-        _coverage_mask(s, covered_ms, cfg.ingest.min_resource_coverage)
+        coverage_mask(s, covered_ms, cfg.ingest.min_resource_coverage)
         for s in shards[1:]]
     occ = np.zeros(len(entry_code), np.int64)
     np.add.at(occ, base.entry_local, 1)
@@ -364,30 +450,13 @@ def merge_shards(base: ShardDelta, deltas: list[ShardDelta],
                 f"(filters moved the representative)")
         graphs[rid] = s.graphs[pid]
 
-    # -- merged resource lookup -----------------------------------------
-    res_ts = np.concatenate([s.res_ts for s in shards])
-    res_ms = np.concatenate([s.res_ms for s in shards])
-    res_values = np.concatenate([s.res_values for s in shards])
-    dup = pd.MultiIndex.from_arrays([res_ts, res_ms]).duplicated()
-    if dup.any():
-        bus.counter("stream.rebuild", reason="resource_overlap")
-        raise StreamRebuildRequired(
-            "resource_overlap",
-            f"{int(dup.sum())} (ts_bucket, ms) resource group(s) appear "
-            f"in more than one shard — the batch path would aggregate "
-            f"the union's raw rows")
-    lookup = ResourceLookup.from_arrays(
-        res_ts, res_ms, res_values,
-        missing_indicator_is_one=cfg.model.missing_indicator_is_one)
-
-    meta = pd.DataFrame({"traceid": tid_a, "entry_id": ent_a,
-                         "runtime_id": runtime, "ts_bucket": tsb_a,
-                         "y": y_a})
-    table = table_from_meta(meta)
-    mixtures = build_mixtures(
-        graphs, table.entry2runtimes,
-        feature_all_stage_copies=cfg.model.feature_all_stage_copies)
-    dataset = dataset_from_parts(mixtures, lookup, table.meta, cfg)
+    # -- merged resource lookup + assembly tail -------------------------
+    dataset, table = finalize_dataset(
+        tid_a, ent_a, runtime, tsb_a, y_a, graphs,
+        np.concatenate([s.res_ts for s in shards]),
+        np.concatenate([s.res_ms for s in shards]),
+        np.concatenate([s.res_values for s in shards]), cfg, bus)
+    meta = table.meta
 
     dt = time.perf_counter() - t0
     bus.histogram("stream.merge_seconds", dt)

@@ -145,6 +145,45 @@ class DeltaArenaStore:
         self._save(key, components, shard)
         return shard
 
+    def open_shards(self, keys) -> dict[str, ShardDelta]:
+        """Per-host slice open: reconstruct ONLY the listed entries.
+
+        The scale-out path (parallel/scale.py) assigns each delta shard
+        to exactly one host; that host calls ``open_shards`` with its
+        slice of the assignment so it never mmaps (or copies) entries
+        outside it — on a giant corpus the difference between opening
+        1/N of the store and all of it IS the scaling win.  Emits
+        ``stream.shard_mmap_bytes`` (gauge, per host) — the on-disk
+        bytes of every ``.npy`` this call actually opened — so the
+        per-host footprint is observable (docs/OBSERVABILITY.md).
+
+        Unlike the load-or-ingest entry points there is no fallback:
+        a missing or corrupt entry raises ``KeyError`` — the caller
+        owns the assignment and must route to a rebuild, because a
+        silently re-ingested shard on one host would diverge from the
+        fingerprint the other hosts agreed on.
+        """
+        bus = self._bus
+        shards: dict[str, ShardDelta] = {}
+        mmap_bytes = 0
+        for key in keys:
+            shard = self._load(key)
+            if shard is None:
+                raise KeyError(
+                    f"delta-store entry {key!r} absent or corrupt — "
+                    "sharded open has no re-ingest fallback; rebuild "
+                    "the assignment")
+            shards[key] = shard
+            d = self._entry_dir(key)
+            for name in os.listdir(d):
+                if name.endswith(".npy"):
+                    mmap_bytes += os.path.getsize(os.path.join(d, name))
+        bus.gauge("stream.shard_mmap_bytes", mmap_bytes)
+        log.info("delta store: sharded open of %d/%d entries (%d mmap "
+                 "bytes)", len(shards), len(os.listdir(self.root)),
+                 mmap_bytes)
+        return shards
+
     # -- load ------------------------------------------------------------
 
     def _load(self, key: str) -> ShardDelta | None:

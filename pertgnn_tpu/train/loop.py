@@ -557,6 +557,17 @@ def _resolve_device_materialize(dataset: Dataset, cfg: Config) -> bool:
     unique (entry, ts_bucket) pairs x mixture width — VERDICT r2 weak #3);
     rather than OOM the chip, oversized arenas fall back to host-packed
     streaming with a logged warning."""
+    if cfg.scale.accum_buckets > 1:
+        # the SAR accumulated step (parallel/scale.py) scans stacked
+        # PackedBatch buckets — it engages precisely when the mixture is
+        # too big for residency, so the two modes are mutually exclusive
+        # everywhere that resolves this flag (fit, precompile, continual,
+        # graftaudit)
+        if cfg.train.device_materialize:
+            log.info("accum_buckets=%d > 1 forces the host-packed batch "
+                     "path (SAR bucket accumulation replaces "
+                     "device_materialize)", cfg.scale.accum_buckets)
+        return False
     if not cfg.train.device_materialize:
         return False
     nbytes = arena_nbytes(dataset.arena(), dataset.feat_arena())
@@ -610,17 +621,19 @@ def _dataset_fingerprint(dataset: Dataset) -> str:
 
 
 def _train_eval_abstract(dataset: Dataset, cfg: Config, state: TrainState,
-                         compact: bool):
+                         compact: bool, plain_step: bool = False):
     """The (state, batch) ShapeDtypeStruct signature of the train/eval
     programs fit() will run (train and eval share it: same budget, same
-    chunking, tail chunks zero-pad to shape)."""
+    chunking, tail chunks zero-pad to shape).  `plain_step` skips the
+    scan_chunk grouping — the SAR path feeds eval single batches and
+    stacks the train signature itself."""
     if compact:
         batches = dataset.compact_batches("train")
         filler = zero_masked_compact
     else:
         batches = dataset.batches("train")
         filler = zero_masked
-    if cfg.train.scan_chunk > 1:
+    if cfg.train.scan_chunk > 1 and not plain_step:
         b = next(_host_chunks(batches, cfg.train.scan_chunk, filler))
     else:
         b = next(batches)
@@ -634,7 +647,7 @@ _STORE_ARENA_LIMIT_BYTES = 256 * 2**20
 
 
 def _train_eval_key_config(dataset: Dataset, cfg: Config, *,
-                           compact: bool) -> dict:
+                           compact: bool, sar_buckets: int = 0) -> dict:
     """The Config/dataset ingredients baked into the train/eval programs
     as constants — everything the abstract signature CANNOT see."""
     # only the TrainConfig fields BAKED INTO the program as constants:
@@ -653,36 +666,63 @@ def _train_eval_key_config(dataset: Dataset, cfg: Config, *,
               "budget": dataset.budget}
     if compact:
         config["dataset_sha"] = _dataset_fingerprint(dataset)
+    if sar_buckets:
+        # the SAR step's bucket CAPACITY is its only extra compiled
+        # dimension (a live-count change reuses the program); remat
+        # rides the key because remat on/off compile different HLO for
+        # the same signature
+        config["scale"] = {"accum_buckets": sar_buckets, "remat": True}
     return config
 
 
 def _stored_train_eval(store, dataset: Dataset, cfg: Config,
                        state: TrainState, train_jit: Callable,
-                       eval_jit: Callable, *, compact: bool
+                       eval_jit: Callable, *, compact: bool,
+                       sar_buckets: int = 0
                        ) -> tuple[Callable, Callable]:
     """Resolve fit()'s train/eval programs through the AOT executable
     store (pertgnn_tpu/aot/): a hit deserializes yesterday's executable
     (zero fresh model traces/compiles), a miss compiles ONCE and
     persists. Key = (env fingerprint, model+train config, graph_type,
     batch budget, dataset arena hash for compact programs, abstract
-    signature)."""
+    signature).  With `sar_buckets` > 1 the train program is the SAR
+    accumulated step (parallel/scale.py): its batch signature is the
+    bucket-stacked PackedBatch and its key config carries the bucket
+    capacity + remat mode — a capacity change is a new program, a LIVE
+    bucket-count change is not (the capacity is the only compiled
+    dimension)."""
     from pertgnn_tpu import aot
 
-    abs_args = _train_eval_abstract(dataset, cfg, state, compact)
+    abs_args = _train_eval_abstract(dataset, cfg, state, compact,
+                                    plain_step=bool(sar_buckets))
     config = _train_eval_key_config(dataset, cfg, compact=compact)
     kind = "compact" if compact else "packed"
-    suffix = "chunk" if cfg.train.scan_chunk > 1 else "step"
-    sig = aot.abstract_signature(abs_args)
+    suffix = ("chunk" if cfg.train.scan_chunk > 1 and not sar_buckets
+              else "step")
     out = []
     for tag, jit_fn in (("train", train_jit), ("eval", eval_jit)):
-        name = f"{tag}_{suffix}_{kind}"
-        key, components = aot.cache_key(
-            fn_id=f"train.loop.{name}.v1", config=config, args_sig=sig)
-        # the train step jits with donate_argnums=0 (make_train_*);
-        # the store's stablehlo replay must mirror it or jax keeps the
-        # donated state arrays "live" over buffers XLA reuses in place
+        if tag == "train" and sar_buckets:
+            name = "sar_step_packed"
+            a = (abs_args[0],
+                 jax.tree.map(lambda s: jax.ShapeDtypeStruct(
+                     (sar_buckets,) + s.shape, s.dtype), abs_args[1]))
+            sar_config = _train_eval_key_config(
+                dataset, cfg, compact=compact, sar_buckets=sar_buckets)
+            key, components = aot.cache_key(
+                fn_id=f"train.loop.{name}.v1", config=sar_config,
+                args_sig=aot.abstract_signature(a))
+        else:
+            name = f"{tag}_{suffix}_{kind}"
+            a = abs_args
+            key, components = aot.cache_key(
+                fn_id=f"train.loop.{name}.v1", config=config,
+                args_sig=aot.abstract_signature(a))
+        # the train step jits with donate_argnums=0 (make_train_* and
+        # make_sar_train_step alike); the store's stablehlo replay must
+        # mirror it or jax keeps the donated state arrays "live" over
+        # buffers XLA reuses in place
         exe, outcome = store.load_or_build(
-            name, key, components, jit_fn, abs_args,
+            name, key, components, jit_fn, a,
             donate_argnums=(0,) if tag == "train" else ())
         log.info("AOT %s program: %s", name, outcome)
         out.append(exe)
@@ -770,8 +810,22 @@ def build_single_device_programs(dataset: Dataset, cfg: Config, *,
     if state is None:
         state = create_train_state(model, tx, sample, cfg.train.seed,
                                    jit_init=cfg.aot.enabled)
-    chunked = cfg.train.scan_chunk > 1
-    if device_materialize:
+    # SAR bucket accumulation (parallel/scale.py): one jitted step scans
+    # the whole mixture as stacked topology buckets with a rematerialized
+    # body — engages when accum_buckets > 1 (device_materialize already
+    # resolved False for it, see _resolve_device_materialize)
+    sar_buckets = cfg.scale.accum_buckets if cfg.scale.accum_buckets > 1 else 0
+    if sar_buckets and device_materialize:
+        raise ValueError(
+            "accum_buckets > 1 needs the host-packed path; "
+            "device_materialize should have resolved False")
+    chunked = cfg.train.scan_chunk > 1 and not sar_buckets
+    if sar_buckets:
+        from pertgnn_tpu.parallel.scale import make_sar_train_step
+
+        train_step = make_sar_train_step(model, cfg, tx, remat=True)
+        eval_step = make_eval_step(model, cfg)
+    elif device_materialize:
         dev = dataset.device_arenas()
         mn, me = dataset.budget.max_nodes, dataset.budget.max_edges
         if chunked:
@@ -791,7 +845,7 @@ def build_single_device_programs(dataset: Dataset, cfg: Config, *,
     if store is not None:
         train_step, eval_step = _stored_train_eval(
             store, dataset, cfg, state, train_step, eval_step,
-            compact=device_materialize)
+            compact=device_materialize, sar_buckets=sar_buckets)
     return state, train_step, eval_step
 
 
@@ -831,6 +885,15 @@ def fit(dataset: Dataset, cfg: Config,
     staging spans, checkpoint spans) reach it too; an explicitly
     configured global bus is never displaced."""
     t_fit0 = time.perf_counter()
+    if mesh is not None and cfg.scale.accum_buckets > 1:
+        # the SAR accumulated step and SPMD data parallelism both decide
+        # how a step's batches map onto memory — composing them silently
+        # would accumulate over PER-SHARD buckets with unclear semantics;
+        # pick one scale-out axis per run (GUIDE §15)
+        raise ValueError(
+            "accum_buckets > 1 is the single-device scale-out path; it "
+            "does not compose with a mesh — drop the mesh or set "
+            "accum_buckets=1")
     edge_shard = mesh is not None and cfg.parallel.shard_edges
     model = make_model(cfg.model, dataset.num_ms, dataset.num_entries,
                        dataset.num_interfaces, dataset.num_rpctypes,
@@ -994,7 +1057,33 @@ def fit(dataset: Dataset, cfg: Config,
         state, train_step, eval_step = build_single_device_programs(
             dataset, cfg, model=model, tx=tx, sample=sample,
             device_materialize=device_materialize, bus=bus)
-        if device_materialize:
+        if cfg.scale.accum_buckets > 1:
+            # SAR bucket accumulation: the whole train mixture rides ONE
+            # accumulated step per epoch as a stacked bucket pytree (the
+            # step's scan skips dead padding buckets, so short epochs
+            # reuse the same program); eval stays per-batch.  A mixture
+            # larger than the capacity refuses (AccumulationOverflow)
+            # instead of training on a silent subset.
+            from pertgnn_tpu.parallel.scale import (bucket_batches,
+                                                    sample_bucket_memory)
+            _sar_cap = cfg.scale.accum_buckets
+            _sar_step = train_step
+
+            def train_step(state, batch):  # noqa: F811
+                out = _sar_step(state, batch)
+                # per-bucket-capacity allocator curve (no-op on CPU; the
+                # bench asserts the compiled temp-bytes proxy there)
+                sample_bucket_memory(None, buckets=_sar_cap)
+                return out
+
+            def batch_stream(split, shuffle=False, seed=0):
+                batches = dataset.batches(split, shuffle=shuffle,
+                                          seed=seed)
+                if not shuffle:
+                    return _device_iter(batches)
+                stacked = bucket_batches(list(batches), _sar_cap)
+                return _device_iter(iter([stacked]))
+        elif device_materialize:
             # Chip-resident arenas + O(graphs) CompactBatch feeding: the
             # host ships only per-graph (entry, feat_start, y, mask)
             # rows; the device expands them to gather indices (cumsum +

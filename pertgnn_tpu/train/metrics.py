@@ -16,15 +16,27 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def quantile_loss_sums(y: jnp.ndarray, y_hat: jnp.ndarray, tau: float,
+                       mask: jnp.ndarray
+                       ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(masked pinball numerator, mask count) — the un-divided halves of
+    :func:`quantile_loss`, so a sequential accumulator (parallel/scale.py
+    SAR buckets) can sum partials across buckets and divide ONCE with the
+    same elementwise ops the monolithic loss uses."""
+    e = y - y_hat
+    per = jnp.maximum(tau * e, (tau - 1) * e)
+    w = mask.astype(per.dtype)
+    return (per * w).sum(), w.sum()
+
+
 def quantile_loss(y: jnp.ndarray, y_hat: jnp.ndarray, tau: float,
                   mask: jnp.ndarray | None = None) -> jnp.ndarray:
     """Masked mean pinball loss (pert_gnn.py:191-193)."""
-    e = y - y_hat
-    per = jnp.maximum(tau * e, (tau - 1) * e)
     if mask is None:
-        return per.mean()
-    w = mask.astype(per.dtype)
-    return (per * w).sum() / jnp.maximum(w.sum(), 1.0)
+        e = y - y_hat
+        return jnp.maximum(tau * e, (tau - 1) * e).mean()
+    num, cnt = quantile_loss_sums(y, y_hat, tau, mask)
+    return num / jnp.maximum(cnt, 1.0)
 
 
 def masked_metric_sums(y: jnp.ndarray, y_hat: jnp.ndarray, tau: float,

@@ -105,6 +105,16 @@ def test_repo_audits_clean_within_budget():
     # rows are pinned to -inf before any top-k can see them
     assert any(n.startswith("lens/quantile/") for n in names), names
     assert any(n.startswith("lens/local/") for n in names), names
+    # the ISSUE-18 satellite: the giant-corpus scale-out programs —
+    # the sharded-merge collectives (collective-audit proves their only
+    # axis name is a mesh axis), the accumulated SAR train step
+    # (donation + no-stray-collective), and the scan-free per-bucket
+    # body with full invar roles (padding-taint proves a zero-masked
+    # padding bucket cannot leak into the accumulated sums)
+    assert "scale/allreduce_sum" in names, names
+    assert "scale/allreduce_min" in names, names
+    assert "scale/sar_step_packed" in names, names
+    assert "scale/sar_bucket_terms" in names, names
 
 
 def test_no_baseline_file():
@@ -222,6 +232,42 @@ def test_taint_undiscarded_output_lanes_are_flagged():
     # node-pad tail off
     assert _audit([_serve_spec(step, out_discard=("node",))],
                   ["padding-taint"]).ok
+
+
+def test_taint_audits_roled_train_programs_not_just_serve():
+    """The negative pin behind the scale/sar_bucket_terms coverage: the
+    padding-taint pass audits ANY program that declares invar roles —
+    a TRAIN-tagged bucket-terms program whose loss sum drops the mask
+    MUST be flagged (pad-graph labels would flow into the accumulated
+    epoch gradient), and the masked shape proves clean. Keeps the
+    gate's move from tag-based to role-based selection non-vacuous."""
+    extra = (jax.ShapeDtypeStruct((G,), jnp.float32),
+             jax.ShapeDtypeStruct((G,), jnp.bool_))
+    roles = (Role(kind="data", cls="graph", path="y"),
+             Role(kind="mask", cls="graph", path="graph_mask"))
+
+    def unmasked(w, x, mask, node_graph, y, graph_mask):
+        v = (x * w).sum(-1)
+        pooled = jax.ops.segment_sum(
+            v * mask.astype(jnp.float32), node_graph, num_segments=G)
+        return jnp.abs(y - pooled).sum()  # pad-graph LABELS in the loss
+
+    def masked(w, x, mask, node_graph, y, graph_mask):
+        v = (x * w).sum(-1)
+        pooled = jax.ops.segment_sum(
+            v * mask.astype(jnp.float32), node_graph, num_segments=G)
+        e = jnp.abs(y - pooled) * graph_mask.astype(jnp.float32)
+        return e.sum()
+
+    res = _audit([_serve_spec(unmasked, name="scale/bucket_unmasked",
+                              tags=("train", "scale"), out_discard=(),
+                              extra_avals=extra, extra_roles=roles)],
+                 ["padding-taint"])
+    assert not res.ok and any("graph" in v.key for v in res.new)
+    clean = _serve_spec(
+        masked, name="scale/bucket_masked", tags=("train", "scale"),
+        out_discard=(), extra_avals=extra, extra_roles=roles)
+    assert _audit([clean], ["padding-taint"]).ok
 
 
 def test_taint_gather_route_then_mask_proves_clean():
@@ -423,6 +469,32 @@ def test_collective_axis_names_checked():
     res = _audit([_psum_spec(("x",))], ["collective-audit"])
     assert not res.ok
     assert any("data" in v.message for v in res.new)
+
+
+def test_collective_merge_allreduce_wrong_mesh_flagged():
+    """The negative pin behind the scale/allreduce coverage: the REAL
+    sharded-merge statistics round, declared against a mesh that lacks
+    its axis, MUST be flagged — and inside a program with no declared
+    mesh at all (the single-host SAR step's contract) it must be
+    flagged as a smuggled collective."""
+    from pertgnn_tpu.parallel.mesh import make_mesh
+    from pertgnn_tpu.parallel.scale import allreduce_fn
+
+    mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    traced = jax.jit(allreduce_fn(mesh, "sum")).trace(
+        jax.ShapeDtypeStruct((2, 16), jnp.int32))
+    wrong = ProgramSpec(name="scale/allreduce_sum",
+                        tags=frozenset({"sharded", "scale"}),
+                        jaxpr=traced.jaxpr, mesh_axes=("rows",))
+    res = _audit([wrong], ["collective-audit"])
+    assert not res.ok
+    smuggled = ProgramSpec(name="scale/sar_step_packed",
+                           tags=frozenset({"train", "scale"}),
+                           jaxpr=traced.jaxpr, mesh_axes=None)
+    res = _audit([smuggled], ["collective-audit"])
+    assert not res.ok
+    assert any("no declared mesh" in v.message
+               or "single-device" in v.message for v in res.new)
 
 
 def test_collective_in_single_device_program_flagged():

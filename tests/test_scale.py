@@ -1,0 +1,408 @@
+"""Giant-corpus scale-out (pertgnn_tpu/parallel/scale.py, ISSUE 18).
+
+The load-bearing guarantees:
+
+- shard-to-host assignment is a pure function of the shard SET —
+  permutation-invariant in the caller's order (hypothesis-pinned), so
+  every host derives it without coordination, and disagreeing
+  fingerprints REFUSE (HostAssignmentMismatch) before any statistics;
+- the collective sharded merge is BIT-IDENTICAL to the single-host
+  ``merge_shards`` oracle for any delta order and any host count, and
+  refuses exactly where the oracle refuses (same guard code);
+- SAR bucket accumulation: grad(remat scan) == grad(monolithic scan)
+  BITWISE (tolerance 0, f32) at any capacity — the bit-stable
+  checkpoint policy plus sum-then-divide-once arithmetic;
+- the bucket CAPACITY is the only compiled dimension (live-count
+  changes reuse one program; overflow refuses loudly), the remat step's
+  compiled temp footprint is strictly below the monolithic twin's, and
+  the per-bucket ``device.mem.peak_bytes`` gauges ride the bucket tag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                ModelConfig, ScaleConfig, TrainConfig)
+from pertgnn_tpu.ingest import synthetic
+from pertgnn_tpu.ingest.assemble import assemble
+from pertgnn_tpu.ingest.preprocess import preprocess
+from pertgnn_tpu.models.pert_model import make_model
+from pertgnn_tpu.parallel import scale
+from pertgnn_tpu.parallel.mesh import make_mesh
+from pertgnn_tpu.stream import (StreamRebuildRequired, base_shard,
+                                ingest_delta, merge_shards,
+                                shard_frames_by_window)
+from pertgnn_tpu.stream.merge import canonical_key
+from pertgnn_tpu.train.loop import create_train_state, fit, make_tx
+
+SPAN_MS = 6 * 60 * 1000
+BOUNDS = [SPAN_MS // 4, SPAN_MS // 2, 3 * SPAN_MS // 4]
+
+
+class Capture:
+    def __init__(self):
+        self.counters, self.gauges, self.hists = [], [], []
+
+    def counter(self, name, value=1, **tags):
+        self.counters.append((name, value, tags))
+
+    def gauge(self, name, value, **tags):
+        self.gauges.append((name, value, tags))
+
+    def histogram(self, name, value, **tags):
+        self.hists.append((name, value, tags))
+
+
+def _cfg(**kw) -> Config:
+    base = dict(ingest=IngestConfig(min_traces_per_entry=5),
+                data=DataConfig(max_traces=200, batch_size=4),
+                model=ModelConfig(hidden_channels=16, num_layers=2),
+                train=TrainConfig(label_scale=1000.0, scan_chunk=1,
+                                  device_materialize=False, epochs=2),
+                graph_type="pert")
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """(cfg, base, deltas, oracle_ds, oracle_info): one synthetic corpus
+    sliced into base + 3 windows, plus the single-host merge oracle."""
+    cfg = _cfg()
+    synth = synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=12, num_entries=2, patterns_per_entry=2,
+        traces_per_entry=24, seed=7, time_span_ms=SPAN_MS,
+        missing_resource_frac=0.0,
+        ensure_pattern_coverage_before_ms=BOUNDS[0]))
+    shards = shard_frames_by_window(synth.spans, synth.resources, BOUNDS)
+    pre0 = preprocess(shards[0][0], shards[0][1], cfg.ingest)
+    table0 = assemble(pre0, cfg.ingest)
+    base = base_shard(pre0, table0, cfg.graph_type, cfg.ingest)
+    deltas = [ingest_delta(s, r, base, cfg.graph_type, cfg.ingest)
+              for s, r in shards[1:]]
+    oracle_ds, oracle_info = merge_shards(base, list(deltas), cfg)
+    return cfg, base, deltas, oracle_ds, oracle_info
+
+
+@pytest.fixture(scope="module")
+def trained(corpus):
+    """(model, tx, batches, state) on the merged toy corpus."""
+    cfg, _base, _deltas, ds, _info = corpus
+    model = make_model(cfg.model, ds.num_ms, ds.num_entries,
+                       ds.num_interfaces, ds.num_rpctypes)
+    tx = make_tx(cfg)
+    batches = list(ds.batches("train"))
+    state = create_train_state(model, tx, batches[0], cfg.train.seed)
+    return cfg, model, tx, batches, state
+
+
+# -- shard-to-host assignment ---------------------------------------------
+
+
+def test_assign_shards_partitions_exactly_once(corpus):
+    _cfg_, _base, deltas, _ds, _info = corpus
+    for hosts in (1, 2, 3, 5):
+        slices = scale.assign_shards(deltas, hosts)
+        assert len(slices) == hosts
+        flat = sorted(i for s in slices for i in s)
+        assert flat == list(range(len(deltas)))
+
+
+def test_assign_shards_permutation_invariant_reversed(corpus):
+    """Deterministic fallback for environments without hypothesis."""
+    _cfg_, _base, deltas, _ds, _info = corpus
+    fwd = scale.assign_shards(deltas, 2)
+    rev = scale.assign_shards(list(reversed(deltas)), 2)
+    n = len(deltas)
+    keyed_fwd = [sorted(canonical_key(deltas[i]) for i in s) for s in fwd]
+    keyed_rev = [sorted(canonical_key(deltas[n - 1 - i]) for i in s)
+                 for s in rev]
+    assert keyed_fwd == keyed_rev
+    assert (scale.assignment_fingerprint(deltas, 2)
+            == scale.assignment_fingerprint(list(reversed(deltas)), 2))
+
+
+def test_assign_shards_permutation_invariant_hypothesis(corpus):
+    pytest.importorskip("hypothesis",
+                        reason="property tests need the hypothesis "
+                               "dev extra")
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    _cfg_, _base, deltas, _ds, _info = corpus
+    n = len(deltas)
+
+    @settings(max_examples=60, deadline=None)
+    @given(perm=st.permutations(range(n)),
+           hosts=st.integers(min_value=1, max_value=4))
+    def prop(perm, hosts):
+        shuffled = [deltas[i] for i in perm]
+        ref = [sorted(canonical_key(deltas[i]) for i in s)
+               for s in scale.assign_shards(deltas, hosts)]
+        got = [sorted(canonical_key(shuffled[i]) for i in s)
+               for s in scale.assign_shards(shuffled, hosts)]
+        assert got == ref
+        assert (scale.assignment_fingerprint(shuffled, hosts)
+                == scale.assignment_fingerprint(deltas, hosts))
+
+    prop()
+
+
+def test_verify_assignment_refuses_mismatch(corpus):
+    _cfg_, _base, deltas, _ds, _info = corpus
+    fp = scale.assignment_fingerprint(deltas, 2)
+    scale.verify_assignment([fp, fp, fp])  # agreement passes
+    bus = Capture()
+    with pytest.raises(scale.HostAssignmentMismatch):
+        scale.verify_assignment([fp, "deadbeefdeadbeef"], bus)
+    assert any(n == "scale.host_assignment_mismatch"
+               for n, _v, _t in bus.counters)
+
+
+def test_assign_shards_rejects_zero_hosts(corpus):
+    _cfg_, _base, deltas, _ds, _info = corpus
+    with pytest.raises(ValueError):
+        scale.assign_shards(deltas, 0)
+
+
+# -- the collective sharded merge -----------------------------------------
+
+
+def _assert_same_dataset(a, b) -> None:
+    assert set(a.splits) == set(b.splits)
+    for name in a.splits:
+        ba, bb = list(a.batches(name)), list(b.batches(name))
+        assert len(ba) == len(bb), name
+        for x, y in zip(ba, bb):
+            for f in x._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(x, f)), np.asarray(getattr(y, f)),
+                    err_msg=f"{name}:{f}")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs the 2-device CPU test platform")
+def test_sharded_merge_bit_identical_to_oracle(corpus):
+    cfg, base, deltas, oracle_ds, oracle_info = corpus
+    mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    for perm in ([0, 1, 2], [2, 0, 1], [1, 2, 0], [2, 1, 0]):
+        for hosts in (1, 2, 3):
+            ds, info = scale.sharded_merge(
+                base, [deltas[i] for i in perm], cfg, mesh,
+                num_hosts=hosts)
+            _assert_same_dataset(ds, oracle_ds)
+            assert info.shards == oracle_info.shards
+            assert info.new_entries == oracle_info.new_entries
+            assert info.new_topologies == oracle_info.new_topologies
+            assert info.dropped_coverage == oracle_info.dropped_coverage
+            assert (info.dropped_occurrence
+                    == oracle_info.dropped_occurrence)
+            assert info.meta.equals(oracle_info.meta)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs the 2-device CPU test platform")
+def test_sharded_merge_emits_telemetry(corpus):
+    cfg, base, deltas, _ds, _info = corpus
+    mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    bus = Capture()
+    scale.sharded_merge(base, list(deltas), cfg, mesh, num_hosts=2,
+                        bus=bus)
+    assert any(n == "scale.merge_seconds" for n, _v, _t in bus.hists)
+    assert any(n == "scale.merge_hosts" and v == 2
+               for n, v, _t in bus.gauges)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs the 2-device CPU test platform")
+def test_sharded_merge_honors_scale_hosts_config(corpus):
+    """--scale_hosts routes through the config when the caller passes no
+    explicit host count (the mesh data axis is only the fallback)."""
+    cfg, base, deltas, oracle_ds, _info = corpus
+    mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    cfg3 = dataclasses.replace(cfg, scale=ScaleConfig(scale_hosts=3))
+    bus = Capture()
+    ds, _ = scale.sharded_merge(base, list(deltas), cfg3, mesh, bus=bus)
+    assert any(n == "scale.merge_hosts" and v == 3
+               for n, v, _t in bus.gauges)
+    _assert_same_dataset(ds, oracle_ds)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs the 2-device CPU test platform")
+def test_sharded_merge_refuses_like_single_host(corpus):
+    """Every oracle refusal refuses identically here — the guards are
+    the same code (a delta coded against a DIFFERENT base)."""
+    cfg, base, deltas, _ds, _info = corpus
+    mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    stale = dataclasses.replace(deltas[0],
+                                base_vocab_hash="0" * 16)
+    bus = Capture()
+    with pytest.raises(StreamRebuildRequired) as ei:
+        scale.sharded_merge(base, [stale] + list(deltas[1:]), cfg, mesh,
+                            bus=bus)
+    assert ei.value.reason == "base_changed"
+    with pytest.raises(StreamRebuildRequired):
+        merge_shards(base, [stale] + list(deltas[1:]), cfg)
+    assert any(n == "stream.rebuild" and t.get("reason") == "base_changed"
+               for n, _v, t in bus.counters)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs the 2-device CPU test platform")
+def test_sharded_merge_requires_base_first(corpus):
+    cfg, _base, deltas, _ds, _info = corpus
+    mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    with pytest.raises(ValueError):
+        scale.sharded_merge(deltas[0], list(deltas[1:]), cfg, mesh)
+
+
+# -- SAR bucket accumulation ----------------------------------------------
+
+
+def test_bucket_batches_pads_with_inert_clones(trained):
+    _cfg_, _model, _tx, batches, _state = trained
+    cap = len(batches) + 3
+    stacked = scale.bucket_batches(batches, cap)
+    assert jax.tree.leaves(stacked)[0].shape[0] == cap
+    pad = np.asarray(stacked.graph_mask[len(batches):])
+    assert not pad.any()
+    assert not np.asarray(stacked.node_mask[len(batches):]).any()
+
+
+def test_bucket_batches_overflow_refuses(trained):
+    _cfg_, _model, _tx, batches, _state = trained
+    bus = Capture()
+    with pytest.raises(scale.AccumulationOverflow):
+        scale.bucket_batches(batches, len(batches) - 1, bus=bus)
+    (name, _v, tags), = [c for c in bus.counters
+                         if c[0] == "scale.accum_overflow"]
+    assert tags == {"need": len(batches), "capacity": len(batches) - 1}
+    with pytest.raises(ValueError):
+        scale.bucket_batches([], 4)
+
+
+@pytest.mark.slow
+def test_sar_grads_bitwise_equal_to_monolithic(trained):
+    """THE acceptance assert: grad of the remat scan equals grad of the
+    monolithic (all-residuals-live) scan at tolerance 0 in f32, at more
+    than one capacity."""
+    cfg, model, _tx, batches, state = trained
+    for cap in (len(batches), len(batches) + 2):
+        buckets = jax.tree.map(jnp.asarray,
+                               scale.bucket_batches(batches, cap))
+        g_remat = jax.jit(scale.sar_grads_fn(model, cfg, remat=True))(
+            state.params, state.batch_stats, buckets)
+        g_mono = jax.jit(scale.sar_grads_fn(model, cfg, remat=False))(
+            state.params, state.batch_stats, buckets)
+        flat_r = jax.tree.leaves(g_remat)
+        flat_m = jax.tree.leaves(g_mono)
+        assert all(np.array_equal(np.asarray(a), np.asarray(b))
+                   for a, b in zip(flat_r, flat_m)), cap
+        assert sum(float(np.abs(np.asarray(a)).sum())
+                   for a in flat_r) > 0
+
+
+def test_sar_capacity_is_the_only_compiled_dimension(trained):
+    """Live bucket-count changes reuse ONE compiled program — only a
+    capacity change compiles fresh."""
+    cfg, model, tx, batches, state = trained
+    step = scale.make_sar_train_step(model, cfg, tx, remat=True)
+    cap = len(batches) + 2
+    # the jitted step donates its state argument — hand it a copy so the
+    # module-scoped fixture state survives for later tests
+    st = jax.tree.map(jnp.array, state)
+    for live in (len(batches), 2, 1):
+        buckets = jax.tree.map(jnp.asarray,
+                               scale.bucket_batches(batches[:live], cap))
+        st, metrics = step(st, buckets)
+    assert step._cache_size() == 1
+    assert int(st.step) == 3
+    assert float(metrics["count"]) > 0
+
+
+@pytest.mark.slow
+def test_sar_remat_temp_bytes_below_monolithic(trained):
+    cfg, model, tx, batches, state = trained
+    cap = len(batches) + 1
+    abs_of = lambda t: jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                       np.asarray(a).dtype), t)
+    abs_b = abs_of(scale.bucket_batches(batches, cap))
+    abs_s = abs_of(state)
+    remat = scale.step_temp_bytes(
+        scale.make_sar_train_step(model, cfg, tx, remat=True),
+        abs_s, abs_b)
+    mono = scale.step_temp_bytes(
+        scale.make_sar_train_step(model, cfg, tx, remat=False),
+        abs_s, abs_b)
+    assert remat is not None and mono is not None
+    assert remat < mono, (remat, mono)
+
+
+def test_sample_bucket_memory_gauges(monkeypatch):
+    """device.mem.peak_bytes rides the bucket tag (monkeypatched stats
+    — CPU publishes none); the None-safe no-op path stays silent."""
+    from pertgnn_tpu.telemetry import devmem
+
+    bus = Capture()
+    monkeypatch.setattr(devmem, "device_memory_stats",
+                        lambda device=None: {"bytes_in_use": 10,
+                                             "peak_bytes": 99,
+                                             "bytes_limit": 1000})
+    out = scale.sample_bucket_memory(bus, buckets=4)
+    assert out["peak_bytes"] == 99
+    (name, value, tags), = [g for g in bus.gauges
+                            if g[0] == "device.mem.peak_bytes"]
+    assert value == 99 and tags["buckets"] == 4
+    monkeypatch.setattr(devmem, "device_memory_stats",
+                        lambda device=None: None)
+    bus2 = Capture()
+    assert scale.sample_bucket_memory(bus2, buckets=4) is None
+    assert not bus2.gauges
+
+
+# -- fit() integration -----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fit_sar_path_trains_and_matches_metrics(corpus):
+    """fit() with accum_buckets > 1 runs one accumulated step per epoch
+    over the whole train mixture and reports finite metrics; the mesh
+    combination refuses."""
+    cfg, _base, _deltas, ds, _info = corpus
+    sar_cfg = dataclasses.replace(
+        cfg, scale=ScaleConfig(accum_buckets=len(list(
+            ds.batches("train"))) + 1))
+    state, history = fit(ds, sar_cfg)
+    assert len(history) == sar_cfg.train.epochs
+    assert int(state.step) == sar_cfg.train.epochs
+    assert np.isfinite(history[-1]["train_qloss"])
+
+
+def test_fit_refuses_mesh_with_accum_buckets(corpus):
+    cfg, _base, _deltas, ds, _info = corpus
+    sar_cfg = dataclasses.replace(cfg, scale=ScaleConfig(accum_buckets=2))
+    mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="accum_buckets"):
+        fit(ds, sar_cfg, mesh=mesh)
+
+
+def test_device_materialize_resolves_off_under_sar(corpus):
+    from pertgnn_tpu.train.loop import _resolve_device_materialize
+
+    cfg, _base, _deltas, ds, _info = corpus
+    on = dataclasses.replace(
+        cfg, scale=ScaleConfig(accum_buckets=4),
+        train=dataclasses.replace(cfg.train, device_materialize=True))
+    assert _resolve_device_materialize(ds, on) is False
+    off = dataclasses.replace(
+        on, scale=ScaleConfig(accum_buckets=1))
+    assert _resolve_device_materialize(ds, off) in (True, False)

@@ -986,9 +986,13 @@ def audit_program(spec) -> list[Violation]:
 
 
 def run(programs) -> list[Violation]:
+    # the audit subject is any program that declares invar roles — the
+    # serve/lens matrix, and the scan-free SAR bucket body (which must
+    # prove its accumulated sums clean rather than rely on a caller
+    # discarding pad lanes)
     out = []
     for spec in programs:
-        if "serve" not in spec.tags or spec.invar_roles is None:
+        if spec.invar_roles is None:
             continue
         out.extend(audit_program(spec))
     return out

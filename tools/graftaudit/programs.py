@@ -352,6 +352,107 @@ def _sharded_specs(ds, cfg, model, state, out: list,
                        f"{type(e).__name__}: {e}"))
 
 
+def _scale_specs(ds, cfg, model, state, out: list, errors: list) -> None:
+    """The giant-corpus scale-out programs (parallel/scale.py, ISSUE 18)
+    as first-class audit subjects:
+
+    - ``scale/allreduce_{sum,min}`` — the collective statistics rounds
+      the sharded merge runs (collective-audit: the only axis name used
+      is a mesh axis);
+    - ``scale/sar_step_packed`` — the full bucket-scanned accumulated
+      train step, declared UNsharded (collective-audit proves the
+      single-host SAR path traps no stray collective that would
+      deadlock on a mesh) and donation-checked like every train step;
+    - ``scale/sar_bucket_terms`` — the scan-free per-bucket body the
+      SAR step scans, with full invar roles: the padding-taint pass
+      proves a zero-masked padding bucket cannot leak into the
+      accumulated loss sums, batch statistics, or metric sums (the
+      scan itself is beyond the taint interpreter, but every scan
+      iteration IS this program — same factored function object).
+    """
+    import jax
+
+    from pertgnn_tpu.parallel.scale import (allreduce_fn,
+                                            make_sar_train_step,
+                                            sar_bucket_terms_fn)
+    from pertgnn_tpu.train.loop import _train_eval_abstract, make_tx
+
+    tx = make_tx(cfg)
+    abs_state, abs_batch = _train_eval_abstract(ds, cfg, state,
+                                                compact=False,
+                                                plain_step=True)
+    if len(jax.devices()) >= 2:
+        from pertgnn_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(data=2, model=1, devices=jax.devices()[:2])
+        axes = tuple(str(a) for a in mesh.axis_names)
+        for op in ("sum", "min"):
+            try:
+                traced = jax.jit(allreduce_fn(mesh, op)).trace(
+                    jax.ShapeDtypeStruct((2, 16), jax.numpy.int32))
+                out.append(ProgramSpec(
+                    name=f"scale/allreduce_{op}",
+                    tags=frozenset({"sharded", "scale"}),
+                    jaxpr=traced.jaxpr, mesh_axes=axes))
+            except Exception as e:  # noqa: BLE001 — see _serve_specs
+                log.exception("graftaudit: building scale/allreduce_%s "
+                              "failed", op)
+                errors.append((f"scale/allreduce_{op}",
+                               f"{type(e).__name__}: {e}"))
+    else:
+        errors.append(("scale/allreduce",
+                       "fewer than 2 devices — cannot trace the merge "
+                       "collectives (see the sharded/ error recipe)"))
+    try:
+        step = make_sar_train_step(model, cfg, tx, remat=True)
+        stacked = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct((2,) + s.shape, s.dtype),
+            abs_batch)
+        traced = step.trace(abs_state, stacked)
+        state_leaves = jax.tree_util.tree_flatten_with_path(
+            abs_state)[0]
+        out.append(ProgramSpec(
+            name="scale/sar_step_packed",
+            tags=frozenset({"train", "scale"}),
+            jaxpr=traced.jaxpr,
+            expect_donated_state=True,
+            state_flat_count=len(state_leaves),
+            state_paths=tuple(jax.tree_util.keystr(p)
+                              for p, _ in state_leaves),
+            lower=lambda t=traced: t.lower()))
+    except Exception as e:  # noqa: BLE001 — see _serve_specs
+        log.exception("graftaudit: building scale/sar_step_packed "
+                      "failed")
+        errors.append(("scale/sar_step_packed",
+                       f"{type(e).__name__}: {e}"))
+    try:
+        terms = sar_bucket_terms_fn(model, cfg)
+        params_abs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (state.params, state.batch_stats))
+
+        def bucket_terms(params, stats, b):
+            # dropout is 0 at the toy config — no rng invar to role
+            return terms(params, stats, b, None)
+
+        traced = jax.jit(bucket_terms).trace(params_abs[0],
+                                             params_abs[1], abs_batch)
+        out.append(ProgramSpec(
+            name="scale/sar_bucket_terms",
+            tags=frozenset({"train", "scale"}),
+            jaxpr=traced.jaxpr,
+            invar_roles=_serve_roles(params_abs, 0),
+            # every output (loss sums, new batch stats, metric sums)
+            # must be PROVABLY clean — nothing is discarded downstream:
+            # the scan carries all of it into the epoch gradient
+            out_discard=frozenset()))
+    except Exception as e:  # noqa: BLE001 — see _serve_specs
+        log.exception("graftaudit: building scale/sar_bucket_terms "
+                      "failed")
+        errors.append(("scale/sar_bucket_terms",
+                       f"{type(e).__name__}: {e}"))
+
+
 def _toy_window_dataset():
     """A window dataset built through the REAL stream path (base +
     delta shards, vocab-stable ingest, mixture merge, sliding window) —
@@ -507,6 +608,7 @@ def build_programs() -> tuple[list[ProgramSpec], list[tuple[str, str]]]:
     _train_specs(ds, cfg, model, state, specs, errors)
     _init_spec(ds, cfg, model, state, specs, errors)
     _sharded_specs(ds, cfg, model, state, specs, errors)
+    _scale_specs(ds, cfg, model, state, specs, errors)
     _continual_spec(specs, errors)
     _CACHE["programs"] = (specs, errors)
     return _CACHE["programs"]
