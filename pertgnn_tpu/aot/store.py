@@ -45,7 +45,6 @@ shared paths (docs/GUIDE.md "Precompile workflow").
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import pickle
@@ -54,17 +53,32 @@ import time
 import jax
 
 from pertgnn_tpu import telemetry
+from pertgnn_tpu.store import durable
+from pertgnn_tpu.store.durable import StoreCorruption, StoreLock
 from pertgnn_tpu.telemetry.jaxmon import watch_xla_cache
 
 log = logging.getLogger(__name__)
 
 # Bump to orphan every existing entry (layout/semantics change in the
 # store itself — entries are format-versioned independently of the
-# content key).
-_STORE_VERSION = 3
+# content key). v4: graftvault durable layout — immutable per-save
+# blob generations (``<key>@g<N>.bin``) committed by one checksummed
+# manifest replace (``<key>.json``), fsync'd writes, store locking.
+_STORE_VERSION = 4
 
 _pjrt_support: bool | None = None
 _export_types_registered = False
+
+
+def _blob_gen(filename: str, key: str) -> int | None:
+    """The generation of a ``<key>@g<N>.bin`` blob name, else None."""
+    prefix = f"{key}@g"
+    if not (filename.startswith(prefix) and filename.endswith(".bin")):
+        return None
+    try:
+        return int(filename[len(prefix):-len(".bin")])
+    except ValueError:
+        return None
 
 
 def pjrt_roundtrip_supported() -> bool:
@@ -128,10 +142,13 @@ def register_export_types() -> None:
 class ExecutableStore:
     """Content-addressed serialized executables under ``root``.
 
-    Layout: ``<root>/<name>/<key>.bin`` (pickled payload) +
-    ``<root>/<name>/<key>.json`` (the key's components — the diff
-    source for loud invalidation). ``name`` is a logical slot ("which
-    program"), ``key`` the content hash ("compiled against what")."""
+    Layout: ``<root>/<name>/<key>@g<N>.bin`` (the pickled payload — an
+    immutable per-save generation) + ``<root>/<name>/<key>.json`` (a
+    graftvault checksummed manifest: the blob's name + CRC32C, plus the
+    key's components — the diff source for loud invalidation). ``name``
+    is a logical slot ("which program"), ``key`` the content hash
+    ("compiled against what"). The manifest replace is the ONE commit
+    point; saves serialize under the store lock (``<root>/.lock``)."""
 
     def __init__(self, root: str, bus=None):
         self.root = root
@@ -143,9 +160,8 @@ class ExecutableStore:
         return (self._injected_bus if self._injected_bus is not None
                 else telemetry.get_bus())
 
-    def _paths(self, name: str, key: str) -> tuple[str, str]:
-        d = os.path.join(self.root, name)
-        return os.path.join(d, f"{key}.bin"), os.path.join(d, f"{key}.json")
+    def _meta_path(self, name: str, key: str) -> str:
+        return os.path.join(self.root, name, f"{key}.json")
 
     # -- the one-stop entry point ---------------------------------------
 
@@ -219,16 +235,34 @@ class ExecutableStore:
         callers compile fresh and save). ``abstract_args`` is required
         to replay ``stablehlo`` entries (the re-lowering target)."""
         bus = self._bus
-        bin_path, _ = self._paths(name, key)
-        if not os.path.exists(bin_path):
+        meta_path = self._meta_path(name, key)
+        if not os.path.exists(meta_path):
             self._log_invalidation(name, key, components)
             bus.counter("aot.cache_miss", program=name, reason="absent")
             return None
         t0 = time.perf_counter()
         try:
             with bus.span("aot.deserialize", program=name):
-                with open(bin_path, "rb") as f:
-                    entry = pickle.load(f)
+                meta = durable.read_json(meta_path, store="aot")
+                blob = str(meta.get("blob", ""))
+                if not blob.startswith(f"{key}@g"):
+                    raise StoreCorruption(
+                        f"manifest names a foreign blob {blob!r}",
+                        store="aot", path=meta_path, reason="bad_dir")
+                with open(os.path.join(self.root, name, blob),
+                          "rb") as f:
+                    data = f.read()
+                # CRC gate BEFORE unpickle: bit-rot in a pickled
+                # payload must never reach the deserializer (the trust
+                # boundary in the module docstring assumes intact
+                # writer-produced bytes)
+                if (durable.crc32c(data) != meta.get("blob_crc32c")
+                        or len(data) != meta.get("blob_bytes")):
+                    raise StoreCorruption(
+                        "blob CRC32C mismatch — refusing to unpickle",
+                        store="aot", path=meta_path,
+                        reason="crc_mismatch")
+                entry = pickle.loads(data)
                 if entry.get("store_version") != _STORE_VERSION:
                     raise ValueError(
                         f"store version {entry.get('store_version')!r} != "
@@ -346,26 +380,42 @@ class ExecutableStore:
         bus = self._bus
         t0 = time.perf_counter()
         entry["store_version"] = _STORE_VERSION
-        bin_path, meta_path = self._paths(name, key)
-        os.makedirs(os.path.dirname(bin_path), exist_ok=True)
-        # atomic pair: a kill mid-write must not leave a torn entry the
-        # next process trips over (it would fall back anyway, but noisily)
-        for path, data in (
-                (bin_path, pickle.dumps(entry)),
-                (meta_path, json.dumps(
-                    {"key": key, "format": entry["format"],
-                     "created_unix_time": time.time(), **components},
-                    indent=1, sort_keys=True, default=str).encode())):
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
+        slot = os.path.join(self.root, name)
+        os.makedirs(slot, exist_ok=True)
+        data = pickle.dumps(entry)
+        # durable commit: the blob lands as an IMMUTABLE generation
+        # first, then one checksummed-manifest replace makes it live —
+        # a kill at any instant leaves the previous (blob, manifest)
+        # pair fully intact, never a new blob under an old manifest.
+        # The store lock serializes concurrent warmers (two autoscale
+        # spares saving the same rung) instead of racing renames.
+        with StoreLock(os.path.join(self.root, ".lock"), store="aot",
+                       bus=bus):
+            gen = 1 + max(
+                (g for g in (_blob_gen(f, key)
+                             for f in os.listdir(slot)) if g is not None),
+                default=0)
+            blob = f"{key}@g{gen}.bin"
+            blob_path = os.path.join(slot, blob)
+            durable.durable_write(blob_path, data, store="aot", bus=bus)
+            durable.write_json(
+                self._meta_path(name, key),
+                {"key": key, "format": entry["format"],
+                 "created_unix_time": time.time(), "blob": blob,
+                 "blob_crc32c": durable.crc32c(data),
+                 "blob_bytes": len(data), **components},
+                store="aot", bus=bus)
+            for f in os.listdir(slot):  # GC superseded generations
+                if _blob_gen(f, key) not in (None, gen):
+                    try:
+                        os.unlink(os.path.join(slot, f))
+                    except OSError:
+                        pass
         dt = time.perf_counter() - t0
         bus.histogram("aot.serialize_seconds", dt, program=name,
                       format=entry["format"])
         log.info("AOT store: saved %s/%s (%s, %.0f KiB) in %.2fs",
-                 name, key, entry["format"],
-                 os.path.getsize(bin_path) / 1024, dt)
+                 name, key, entry["format"], len(data) / 1024, dt)
         return entry["format"]
 
     # -- invalidation diagnostics ---------------------------------------
@@ -389,9 +439,8 @@ class ExecutableStore:
         prev = None
         for f in metas:
             try:
-                with open(os.path.join(d, f)) as fh:
-                    m = json.load(fh)
-            except (OSError, ValueError):
+                m = durable.read_json(os.path.join(d, f), store="aot")
+            except (StoreCorruption, OSError, ValueError):
                 continue
             if (prev is None or m.get("created_unix_time", 0)
                     > prev.get("created_unix_time", 0)):

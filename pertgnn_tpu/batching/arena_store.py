@@ -43,7 +43,6 @@ from __future__ import annotations
 import json
 import logging
 import os
-import shutil
 import time
 
 import numpy as np
@@ -53,12 +52,16 @@ from pertgnn_tpu.batching.arena import FeatureArena, MixtureArena
 from pertgnn_tpu.batching.featurize import ResourceLookup
 from pertgnn_tpu.batching.mixture import Mixture
 from pertgnn_tpu.batching.pack import BatchBudget
+from pertgnn_tpu.store import durable
+from pertgnn_tpu.store.durable import StoreCorruption, StoreLock
 
 log = logging.getLogger(__name__)
 
 # Bump to orphan every existing entry on a layout/semantics change of
-# the store itself (it rides inside the key via fn_id).
-_STORE_VERSION = 1
+# the store itself (it rides inside the key via fn_id). v2: graftvault
+# durable layout — immutable generation dirs committed by one
+# checksummed ``<key>.manifest.json`` replace (store/durable.py).
+_STORE_VERSION = 2
 _FN_ID = f"batching.arena_store.v{_STORE_VERSION}"
 
 _ARENA_FIELDS = ("node_start", "node_count", "edge_start", "edge_count",
@@ -160,10 +163,14 @@ def mixtures_from_arena(arena: MixtureArena) -> dict[int, Mixture]:
 class ArenaStore:
     """Content-addressed dataset arenas under ``root``.
 
-    Layout: ``<root>/<key>/meta.json`` (key components + scalars +
-    array manifest) and one ``.npy`` per array, loaded with
-    ``np.load(mmap_mode="r")`` so a warm process pages in only what an
-    epoch actually gathers."""
+    Layout (graftvault, store/durable.py): an immutable generation dir
+    ``<root>/<key>@g<N>/`` holding ``meta.json`` (key components +
+    scalars) and one ``.npy`` per array, committed by ONE durable
+    replace of ``<root>/<key>.manifest.json`` (which records every
+    file's CRC32C — what ``graftvault scrub`` verifies). Arrays load
+    with ``np.load(mmap_mode="r")`` so a warm process pages in only
+    what an epoch actually gathers; writers serialize under the store
+    lock (``<root>/.lock``)."""
 
     def __init__(self, root: str, bus=None):
         self.root = root
@@ -175,8 +182,16 @@ class ArenaStore:
         return (self._injected_bus if self._injected_bus is not None
                 else telemetry.get_bus())
 
-    def _entry_dir(self, key: str) -> str:
-        return os.path.join(self.root, key)
+    def exists(self, key: str) -> bool:
+        """Whether a committed entry for ``key`` is on disk (manifest
+        presence — the warm-start evidence fleet workers probe)."""
+        return os.path.exists(durable.manifest_path(self.root, key))
+
+    def _entry_dir(self, key: str) -> str | None:
+        """The committed generation dir for ``key``, or None (absent).
+        Raises StoreCorruption on a torn manifest."""
+        resolved = durable.resolve_entry(self.root, key, store="arena")
+        return None if resolved is None else resolved[0]
 
     # -- the one-stop entry point ---------------------------------------
 
@@ -206,13 +221,18 @@ class ArenaStore:
         caller builds fresh and saves). ``slot`` scopes the miss
         diagnostics to entries of the same logical input."""
         bus = self._bus
-        d = self._entry_dir(key)
-        meta_path = os.path.join(d, "meta.json")
-        if not os.path.exists(meta_path):
+        t0 = time.perf_counter()
+        try:
+            d = self._entry_dir(key)
+        except StoreCorruption as e:
+            log.warning("corrupt arena store entry %s (%s: %s) — falling "
+                        "back to a fresh build", key, type(e).__name__, e)
+            bus.counter("arena.cache_miss", reason="corrupt")
+            return None
+        if d is None:
             self._log_invalidation(key, components, slot)
             bus.counter("arena.cache_miss", reason="absent")
             return None
-        t0 = time.perf_counter()
         try:
             with bus.span("arena.load", key=key[:12]):
                 ds, mmap_bytes = self._load_dataset(d, cfg)
@@ -282,72 +302,62 @@ class ArenaStore:
     def save(self, key: str, components: dict, dataset, *,
              slot: str | None = None) -> str | None:
         """Persist a freshly built Dataset's arenas under ``key``.
-        Atomic: arrays land in a tmp dir renamed into place, so a kill
-        mid-write never leaves a torn entry (a torn entry would only
-        cost a rebuild anyway — the load path treats it as corrupt)."""
+        Durable (store/durable.py): arrays land fsync'd in an immutable
+        generation dir and ONE checksummed-manifest replace commits the
+        entry — a kill at any instant leaves the previous entry fully
+        live (never the old double-replace window where the current
+        entry was gone while the backup pointed at the same
+        generation); concurrent writers serialize under the store
+        lock, and either one's entry is valid (content-addressed,
+        deterministic)."""
         bus = self._bus
         t0 = time.perf_counter()
-        final = self._entry_dir(key)
-        tmp = os.path.join(self.root, f".tmp.{key}.{os.getpid()}")
-        os.makedirs(tmp, exist_ok=True)
         try:
             arena = dataset.arena()
             feats = dataset.feat_arena()  # also fixes the split slices
             total = 0
+            with StoreLock(os.path.join(self.root, ".lock"),
+                           store="arena", bus=bus), \
+                    durable.EntryWriter(self.root, key, store="arena",
+                                        bus=bus) as w:
+                def put(name: str, a) -> None:
+                    nonlocal total
+                    total += w.put_array(f"{name}.npy", a)
 
-            def put(name: str, a) -> None:
-                nonlocal total
-                a = np.ascontiguousarray(np.asarray(a))
-                np.save(os.path.join(tmp, f"{name}.npy"), a)
-                total += a.nbytes
-
-            for f in _ARENA_FIELDS:
-                put(f"arena_{f}", getattr(arena, f))
-            for f in _FEAT_FIELDS:
-                put(f"feat_{f}", getattr(feats, f))
-            ts, ms, values = dataset.lookup.to_arrays()
-            put("lookup_ts", ts)
-            put("lookup_ms", ms)
-            put("lookup_values", values)
-            for name, split in dataset.splits.items():
-                for f in _SPLIT_FIELDS:
-                    put(f"split_{name}_{f}", getattr(split, f))
-            meta = {
-                "key": key, "slot": slot,
-                "store_version": _STORE_VERSION,
-                "created_unix_time": time.time(),
-                "split_names": list(dataset.splits),
-                "budget": {"max_graphs": dataset.budget.max_graphs,
-                           "max_nodes": dataset.budget.max_nodes,
-                           "max_edges": dataset.budget.max_edges},
-                "scalars": {
-                    "num_ms": dataset.num_ms,
-                    "num_entries": dataset.num_entries,
-                    "num_interfaces": dataset.num_interfaces,
-                    "num_rpctypes": dataset.num_rpctypes,
-                    "node_feature_dim": dataset.node_feature_dim,
-                },
-                **components,
-            }
-            with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump(meta, f, indent=1, sort_keys=True, default=str)
-            if os.path.isdir(final):
-                # an entry already exists: a racing writer's (entries
-                # are content-addressed and deterministic, so either
-                # copy is valid) or the corrupt one this build replaces
-                # — swap it out
-                old = f"{final}.old.{os.getpid()}"
-                os.replace(final, old)
-                os.replace(tmp, final)
-                shutil.rmtree(old, ignore_errors=True)
-            else:
-                os.replace(tmp, final)
+                for f in _ARENA_FIELDS:
+                    put(f"arena_{f}", getattr(arena, f))
+                for f in _FEAT_FIELDS:
+                    put(f"feat_{f}", getattr(feats, f))
+                ts, ms, values = dataset.lookup.to_arrays()
+                put("lookup_ts", ts)
+                put("lookup_ms", ms)
+                put("lookup_values", values)
+                for name, split in dataset.splits.items():
+                    for f in _SPLIT_FIELDS:
+                        put(f"split_{name}_{f}", getattr(split, f))
+                meta = {
+                    "key": key, "slot": slot,
+                    "store_version": _STORE_VERSION,
+                    "created_unix_time": time.time(),
+                    "split_names": list(dataset.splits),
+                    "budget": {"max_graphs": dataset.budget.max_graphs,
+                               "max_nodes": dataset.budget.max_nodes,
+                               "max_edges": dataset.budget.max_edges},
+                    "scalars": {
+                        "num_ms": dataset.num_ms,
+                        "num_entries": dataset.num_entries,
+                        "num_interfaces": dataset.num_interfaces,
+                        "num_rpctypes": dataset.num_rpctypes,
+                        "node_feature_dim": dataset.node_feature_dim,
+                    },
+                    **components,
+                }
+                final = w.commit(meta)
         except Exception as e:
             # a failed save must not fail the run the dataset was built
             # FOR — next process rebuilds
             log.warning("arena store: could not persist %s (%s: %s)",
                         key, type(e).__name__, e)
-            shutil.rmtree(tmp, ignore_errors=True)
             return None
         dt = time.perf_counter() - t0
         bus.histogram("arena.save_seconds", dt)
@@ -400,16 +410,10 @@ class ArenaStore:
         from pertgnn_tpu.aot import diff_components
 
         prev = None
-        try:
-            entries = os.listdir(self.root)
-        except OSError:
-            return
-        for name in entries:
-            meta_path = os.path.join(self.root, name, "meta.json")
+        for _k, mpath in durable.iter_manifests(self.root):
             try:
-                with open(meta_path) as f:
-                    m = json.load(f)
-            except (OSError, ValueError):
+                m = durable.read_json(mpath, store="arena").get("meta", {})
+            except (StoreCorruption, OSError, ValueError):
                 continue
             if slot is not None and m.get("slot") != slot:
                 continue

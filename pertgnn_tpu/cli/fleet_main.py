@@ -201,11 +201,11 @@ def _run_worker(args, p: argparse.ArgumentParser) -> None:
     arena_warm = False
     if cfg.data.arena_cache_dir:
         try:
-            from pertgnn_tpu.batching.arena_store import arena_cache_key
+            from pertgnn_tpu.batching.arena_store import (ArenaStore,
+                                                          arena_cache_key)
             from pertgnn_tpu.cli.common import raw_input_fingerprint
             key, _ = arena_cache_key(cfg, raw_input_fingerprint(args))
-            arena_warm = os.path.exists(os.path.join(
-                cfg.data.arena_cache_dir, key, "meta.json"))
+            arena_warm = ArenaStore(cfg.data.arena_cache_dir).exists(key)
         except Exception as exc:  # evidence, not control flow
             print(f"WARNING: arena_warm probe failed: {exc}",
                   file=sys.stderr)

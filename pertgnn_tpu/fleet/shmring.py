@@ -9,13 +9,17 @@ sender thread per worker — hedge legs ride the hedge target's own
 sender — and the worker runs exactly one ring service thread), so the
 ring needs no locks, only ordering:
 
-- every slot is ``seq u64 | len u32 | payload``; the producer writes
-  payload then length, and stamps the sequence number LAST — the
-  sequence stamp IS the commit counter, so a crashed producer can
-  never publish a half-written slot;
-- the consumer reads the stamp, copies the payload out, and RE-READS
-  the stamp: a mismatch is a torn write (:class:`RingTornWrite`) and
-  the peer is treated as gone, never trusted;
+- every slot is ``seq u64 | len u32 | crc u32 | payload``; the
+  producer writes payload + length + payload CRC32C, and stamps the
+  sequence number LAST — the sequence stamp IS the commit counter, so
+  a crashed producer can never publish a half-written slot;
+- the consumer reads the stamp, copies the payload out, RE-READS
+  the stamp, and then verifies the copied bytes against the slot's
+  CRC32C: a moved stamp or a checksum mismatch is a torn write
+  (:class:`RingTornWrite`, counter ``transport.crc_rejects`` for the
+  checksum case) and the peer is treated as gone, never trusted —
+  the CRC catches the single-word corruptions (a partial cache-line
+  flush, a stray write) the stamp discipline alone cannot see;
 - backpressure is structural: the producer may claim slot ``seq`` only
   while ``seq - consumed <= slots`` (the consumer still owns the
   oldest slot otherwise), so a dead reader fills the ring and the
@@ -49,18 +53,21 @@ import struct
 import threading
 import time
 
+from pertgnn_tpu.store.durable import crc32c
+
 log = logging.getLogger(__name__)
 
 _HDR = struct.Struct("<IIII")            # magic, version, slots, slot_bytes
 _MAGIC = 0x47575231                      # "GWR1"
-RING_VERSION = 1
+RING_VERSION = 2                         # v2: per-slot payload CRC32C
 _CTR = struct.Struct("<Q")               # produced / consumed counters
 _PRODUCED_OFF = _HDR.size                # 16
 _CONSUMED_OFF = _HDR.size + 8            # 24
 _DATA_OFF = _HDR.size + 16               # 32
 _SEQ = struct.Struct("<Q")               # per-slot commit stamp
 _LEN = struct.Struct("<I")
-_SLOT_HDR = _SEQ.size + _LEN.size        # 12
+_CRC = struct.Struct("<I")               # per-slot payload CRC32C
+_SLOT_HDR = _SEQ.size + _LEN.size + _CRC.size   # 16
 _CORR = struct.Struct("<Q")              # per-call correlation prefix
 
 
@@ -177,11 +184,16 @@ class ShmRing:
 
     def _payload_write(self, off: int, payload: bytes) -> None:
         _LEN.pack_into(self._buf, off + _SEQ.size, len(payload))
+        _CRC.pack_into(self._buf, off + _SEQ.size + _LEN.size,
+                       crc32c(payload))
         start = off + _SLOT_HDR
         self._buf[start:start + len(payload)] = payload
 
     def _len_read(self, off: int) -> int:
         return _LEN.unpack_from(self._buf, off + _SEQ.size)[0]
+
+    def _crc_read(self, off: int) -> int:
+        return _CRC.unpack_from(self._buf, off + _SEQ.size + _LEN.size)[0]
 
     def _payload_read(self, off: int, n: int) -> bytes:
         start = off + _SLOT_HDR
@@ -232,8 +244,26 @@ class ShmRing:
             raise RingTornWrite(f"slot {seq} declares {n} payload "
                                 f"bytes > {self.payload_max} capacity")
         payload = self._payload_read(off, n)
+        want = self._crc_read(off)
         if self._seq_read(off) != seq:
             raise RingTornWrite(f"slot {seq} re-stamped mid-copy")
+        got_crc = crc32c(payload)
+        if got_crc != want:
+            # the stamp discipline held but the bytes are wrong: a
+            # single-word corruption the seq re-read cannot see
+            try:
+                from pertgnn_tpu import telemetry
+                telemetry.get_bus().counter("transport.crc_rejects")
+            except Exception:  # lint: allow-silent-except
+                # a telemetry hiccup must never mask the integrity
+                # failure being reported
+                pass
+            err = RingTornWrite(
+                f"slot {seq} payload crc 0x{got_crc:08x} != stamped "
+                f"0x{want:08x} — {n}-byte frame corrupt in shared "
+                f"memory")
+            err.crc_mismatch = True
+            raise err
         self._consumed = seq
         self._store_ctr(_CONSUMED_OFF, seq)
         return payload

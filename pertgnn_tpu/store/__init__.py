@@ -1,0 +1,17 @@
+"""graftvault — crash-consistent durable state for every on-disk store.
+
+``store/durable.py`` is the ONE durable-write protocol (tmp →
+fsync(file) → rename → fsync(dir), CRC32C-checksummed manifests,
+advisory store locks, deterministic crash-injection hook sites);
+``store/scrub.py`` is the ``graftvault scrub`` CLI that verifies every
+manifest/blob checksum and quarantines exactly the corrupt entry.
+"""
+
+from pertgnn_tpu.store.durable import (EntryWriter, StoreCorruption,
+                                       StoreLock, StoreLockTimeout,
+                                       crc32c, durable_write, read_json,
+                                       write_json)
+
+__all__ = ["EntryWriter", "StoreCorruption", "StoreLock",
+           "StoreLockTimeout", "crc32c", "durable_write", "read_json",
+           "write_json"]

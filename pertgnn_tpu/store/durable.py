@@ -1,0 +1,522 @@
+"""graftvault durable-write protocol: the one way bytes reach a store.
+
+Five on-disk stores (AOT executables, arena cache, delta arenas, the
+checkpoint config sidecar, the capture journal) used to hand-roll
+persistence with bare ``os.replace``, no ``fsync``, no payload
+checksums, and no cross-process locking. A host crash mid-write, a
+torn page written whole, or two fleet workers warming from one shared
+store directory could silently corrupt the state every warm-start and
+zero-compile guarantee depends on. This module is the single
+implementation — and therefore the single proof — of the durability
+contract:
+
+- **atomic replace**: ``durable_write`` goes write-to-temp →
+  ``fsync(file)`` → ``os.replace`` → ``fsync(dir)``. A crash at any
+  instant leaves the destination bit-identical to either the old or
+  the new contents — never a third thing (tests/test_durable.py
+  SIGKILLs a real writer subprocess at every hook site and asserts
+  exactly that).
+- **checksummed manifests**: store metadata rides a CRC32C-checksummed
+  JSON envelope (``write_json``/``read_json``); blob/array payloads
+  get a per-file CRC32C recorded in the entry's manifest so bit-rot is
+  detectable (``python -m pertgnn_tpu.store.scrub``) instead of a
+  mystery mis-prediction.
+- **single-rename entries**: directory entries (arena/delta stores)
+  commit through :class:`EntryWriter` — files land in a tmp dir,
+  the dir is renamed to an immutable generation (``<key>@g<N>``), and
+  THE commit is one ``durable_write`` of the ``<key>.manifest.json``
+  pointer. This replaces the unprotected double-``os.replace`` backup
+  dance (a crash between the two replaces lost the current entry while
+  the backup pointed at the same generation).
+- **advisory locks**: :class:`StoreLock` (``flock``) serializes
+  concurrent writers — two autoscale spares warming the shared AOT
+  store, trainer vs. fleet on the delta store — instead of letting
+  them race renames.
+- **crash injection**: the protocol fires ``store.write.pre_fsync`` /
+  ``post_fsync`` / ``pre_rename`` / ``post_rename`` fault sites
+  (testing/faults.py, armed via ``$PERTGNN_FAULT_PLAN``); a ``kill``
+  fault is enacted here as ``os._exit(137)`` — the deterministic
+  stand-in for power loss the crash matrix is built on.
+
+Telemetry: ``store.fsync_seconds`` / ``store.lock_wait_ms`` histograms
+(tag ``store``), plus the scrub CLI's ``store.scrub.*`` /
+``store.quarantined`` counters (docs/OBSERVABILITY.md).
+
+Import-light by design (stdlib only; numpy is imported lazily inside
+``EntryWriter.put_array``): telemetry/capture.py — a pure-host module
+the watcher's bare-python one-liners import between polls — rides this
+module too.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import time
+
+from pertgnn_tpu.testing import faults
+
+log = logging.getLogger(__name__)
+
+try:
+    import fcntl
+except ImportError:  # non-posix: locks degrade to no-ops, loudly
+    fcntl = None
+
+# The crash-injection hook sites (testing/faults.py site table). One
+# occurrence each per durable_write/append; EntryWriter.commit adds one
+# pre/post_fsync occurrence (the tmp-dir fsync pass) and one
+# pre/post_rename occurrence (the generation-dir rename) BEFORE its
+# manifest durable_write.
+SITE_PRE_FSYNC = "store.write.pre_fsync"
+SITE_POST_FSYNC = "store.write.post_fsync"
+SITE_PRE_RENAME = "store.write.pre_rename"
+SITE_POST_RENAME = "store.write.post_rename"
+
+ENVELOPE_KEY = "graftvault"
+ENVELOPE_VERSION = 1
+
+
+class StoreCorruption(RuntimeError):
+    """A checksummed manifest or blob failed verification. Typed so
+    load paths and the scrubber can route EXACTLY the corrupt entry to
+    the store's existing single-entry rebuild path (fresh compile /
+    arena rebuild / one-shard re-ingest) — never a whole-store
+    invalidation."""
+
+    def __init__(self, message: str, *, store: str = "?",
+                 path: str | None = None, reason: str = "corrupt"):
+        super().__init__(message)
+        self.store = store
+        self.path = path
+        self.reason = reason
+
+
+class StoreLockTimeout(RuntimeError):
+    """A StoreLock wait exceeded its bound — a wedged or dead writer
+    is holding the store; failing loudly beats queuing forever."""
+
+
+# -- CRC32C (Castagnoli) -------------------------------------------------
+# google_crc32c (hardware-accelerated) when the wheel is present; a
+# pure-python table fallback otherwise. Both compute REAL CRC32C
+# (polynomial 0x1EDC6F41, reflected) — the recorded algorithm never
+# silently degrades to zlib.crc32, so checksums written by one host
+# verify on any other.
+
+try:
+    import google_crc32c as _gcrc
+except ImportError:
+    _gcrc = None
+
+_CRC_TABLE: list[int] | None = None
+
+
+def _crc_table() -> list[int]:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes, value: int = 0) -> int:
+    """CRC32C (Castagnoli) of ``data``, continuing from ``value``."""
+    if _gcrc is not None:
+        return _gcrc.extend(value, data)
+    crc = value ^ 0xFFFFFFFF
+    table = _crc_table()
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def file_crc32c(path: str, chunk: int = 1 << 20) -> tuple[int, int]:
+    """(crc32c, byte count) of a file, chunked (scrub's blob verify)."""
+    crc, n = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc, n
+            crc = crc32c(block, crc)
+            n += len(block)
+
+
+# -- checksummed JSON envelope ------------------------------------------
+
+def canonical_body_bytes(body) -> bytes:
+    """The bytes the envelope CRC covers: a canonical (sorted, compact)
+    dump, reproducible from the parsed body at read time."""
+    return json.dumps(body, sort_keys=True, separators=(",", ":"),
+                      default=str).encode("utf-8")
+
+
+def checksummed_dumps(body: dict) -> bytes:
+    env = {ENVELOPE_KEY: ENVELOPE_VERSION,
+           "crc32c": crc32c(canonical_body_bytes(body)),
+           "body": body}
+    return json.dumps(env, indent=1, sort_keys=True,
+                      default=str).encode("utf-8")
+
+
+def checksummed_loads(data: bytes, *, store: str = "?",
+                      path: str | None = None) -> dict:
+    """The verified body of a checksummed envelope, or StoreCorruption
+    (undecodable, not an envelope, or CRC mismatch)."""
+    try:
+        env = json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise StoreCorruption(f"manifest is not valid JSON ({e})",
+                              store=store, path=path,
+                              reason="undecodable") from e
+    if not isinstance(env, dict) or ENVELOPE_KEY not in env:
+        raise StoreCorruption("manifest is not a graftvault envelope",
+                              store=store, path=path,
+                              reason="not_envelope")
+    body = env.get("body")
+    want = env.get("crc32c")
+    got = crc32c(canonical_body_bytes(body))
+    if got != want:
+        raise StoreCorruption(
+            f"manifest CRC32C mismatch (recorded {want!r}, computed "
+            f"{got})", store=store, path=path, reason="crc_mismatch")
+    return body
+
+
+# -- the protocol --------------------------------------------------------
+
+def _bus(bus=None):
+    if bus is not None:
+        return bus
+    from pertgnn_tpu import telemetry
+    return telemetry.get_bus()
+
+
+def _fire(site: str) -> None:
+    """One crash-injection hook. A ``kill`` fault is enacted HERE
+    (``os._exit(137)`` — no atexit, no flush: the closest a test can
+    get to power loss); ``error`` raises inside ``plan.fire``."""
+    plan = faults.active()
+    if plan is None:
+        return
+    if plan.fire(site) == "kill":
+        os._exit(137)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename into it survives power loss (the
+    rename itself is atomic; its durability is the dir's)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def durable_write(path: str, data: bytes, *, store: str,
+                  bus=None) -> None:
+    """THE atomic write: tmp → fsync(file) → os.replace → fsync(dir).
+
+    A crash at any point leaves ``path`` bit-identical to its old or
+    new contents. The tmp name is pid-suffixed so concurrent writers
+    (already serialized by StoreLock, but belt over braces) never share
+    a tmp; a failed write removes its tmp and re-raises."""
+    t0 = time.perf_counter()
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:  # graftlint: allow-durable-write
+            f.write(data)
+            _fire(SITE_PRE_FSYNC)
+            f.flush()
+            os.fsync(f.fileno())
+        _fire(SITE_POST_FSYNC)
+        _fire(SITE_PRE_RENAME)
+        os.replace(tmp, path)  # graftlint: allow-durable-write
+        _fire(SITE_POST_RENAME)
+        fsync_dir(parent)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _bus(bus).histogram("store.fsync_seconds",
+                        time.perf_counter() - t0, store=store)
+
+
+def write_json(path: str, body: dict, *, store: str, bus=None) -> None:
+    """Durably replace ``path`` with a checksummed envelope of
+    ``body``."""
+    durable_write(path, checksummed_dumps(body), store=store, bus=bus)
+
+
+def read_json(path: str, *, store: str) -> dict:
+    """The verified body at ``path``. FileNotFoundError propagates
+    (absent is the caller's cache-miss path, not corruption);
+    StoreCorruption on a torn or tampered envelope."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return checksummed_loads(data, store=store, path=path)
+
+
+def append_line(path: str, line: bytes, *, store: str, bus=None) -> None:
+    """Durable journal append: write one full line, fsync. No rename —
+    append-only files recover at line granularity (the reader skips a
+    torn tail), so the fsync IS the commit."""
+    t0 = time.perf_counter()
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "ab") as f:  # graftlint: allow-durable-write
+        f.write(line)
+        _fire(SITE_PRE_FSYNC)
+        f.flush()
+        os.fsync(f.fileno())
+    _fire(SITE_POST_FSYNC)
+    _bus(bus).histogram("store.fsync_seconds",
+                        time.perf_counter() - t0, store=store)
+
+
+# -- advisory store locks ------------------------------------------------
+
+class StoreLock:
+    """Advisory ``flock`` on a lock FILE (``<root>/.lock`` by
+    convention): concurrent writers serialize instead of racing
+    ``os.replace``. Readers never take it — the rename protocol makes
+    every read see a complete old or new state. Reentrant across
+    processes only in the flock sense (same fd family); emit
+    ``store.lock_wait_ms`` so contention is observable."""
+
+    def __init__(self, path: str, *, store: str,
+                 timeout_s: float = 30.0, poll_s: float = 0.005,
+                 bus=None):
+        self.path = path
+        self.store = store
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s
+        self._injected_bus = bus
+        self._f = None
+
+    def __enter__(self) -> "StoreLock":
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        t0 = time.perf_counter()
+        # the lock file itself is never replaced, only flocked — a
+        # plain append-mode open creates it without truncating anyone
+        f = open(self.path, "a")  # graftlint: allow-durable-write
+        if fcntl is None:
+            log.warning("flock unavailable on this platform — store "
+                        "lock %s is a no-op", self.path)
+            self._f = f
+            return self
+        deadline = t0 + self.timeout_s
+        while True:
+            try:
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.perf_counter() > deadline:
+                    f.close()
+                    raise StoreLockTimeout(
+                        f"could not lock {self.path} within "
+                        f"{self.timeout_s:.1f}s — is a writer wedged?")
+                time.sleep(self.poll_s)
+        self._f = f
+        _bus(self._injected_bus).histogram(
+            "store.lock_wait_ms", (time.perf_counter() - t0) * 1e3,
+            store=self.store)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._f is not None:
+            if fcntl is not None:
+                try:
+                    fcntl.flock(self._f.fileno(), fcntl.LOCK_UN)
+                except OSError:
+                    pass
+            self._f.close()
+            self._f = None
+
+
+# -- directory entries: generations + one manifest rename ---------------
+
+def manifest_path(root: str, key: str) -> str:
+    return os.path.join(root, f"{key}.manifest.json")
+
+
+def _gen_of(name: str, key: str) -> int | None:
+    """The generation number of a ``<key>@g<N>`` dir name, else None."""
+    prefix = f"{key}@g"
+    if not name.startswith(prefix):
+        return None
+    try:
+        return int(name[len(prefix):])
+    except ValueError:
+        return None
+
+
+def iter_manifests(root: str):
+    """(key, manifest path) for every entry manifest under ``root``."""
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return
+    for name in names:
+        if name.endswith(".manifest.json"):
+            yield name[:-len(".manifest.json")], os.path.join(root, name)
+
+
+def resolve_entry(root: str, key: str, *, store: str
+                  ) -> tuple[str, dict] | None:
+    """(entry dir, manifest body) for ``key``, or None when absent.
+    Raises StoreCorruption on a torn manifest or a manifest whose
+    generation dir is gone — the caller's single-entry rebuild path."""
+    mp = manifest_path(root, key)
+    if not os.path.exists(mp):
+        return None
+    body = read_json(mp, store=store)
+    name = str(body.get("dir", ""))
+    if _gen_of(name, key) is None:
+        raise StoreCorruption(
+            f"manifest for {key} names a foreign dir {name!r}",
+            store=store, path=mp, reason="bad_dir")
+    d = os.path.join(root, name)
+    if not os.path.isdir(d):
+        raise StoreCorruption(
+            f"manifest for {key} points at missing generation {name}",
+            store=store, path=mp, reason="missing_generation")
+    return d, body
+
+
+class EntryWriter:
+    """Single-rename commit for a directory entry.
+
+    Files accumulate in ``<root>/.tmp.<key>.<pid>`` with a CRC32C
+    recorded per file; ``commit(meta)`` fsyncs them, renames the dir to
+    the next immutable generation ``<key>@g<N>`` (the target never
+    pre-exists — no backup dance), then durably replaces
+    ``<key>.manifest.json`` — the ONE atomic commit point. A crash
+    before the manifest rename leaves an orphan generation nothing
+    references (the scrubber sweeps it); a crash after it leaves the
+    new entry fully committed. Older generations are garbage-collected
+    after the commit."""
+
+    def __init__(self, root: str, key: str, *, store: str, bus=None):
+        self.root = root
+        self.key = key
+        self.store = store
+        self._injected_bus = bus
+        self._tmp = os.path.join(root, f".tmp.{key}.{os.getpid()}")
+        self._files: dict[str, dict] = {}
+        if os.path.isdir(self._tmp):  # a previous crashed writer's
+            import shutil
+            shutil.rmtree(self._tmp, ignore_errors=True)
+        os.makedirs(self._tmp, exist_ok=True)
+
+    def __enter__(self) -> "EntryWriter":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if exc_type is not None:
+            self.abort()
+
+    def put_bytes(self, filename: str, data: bytes) -> None:
+        path = os.path.join(self._tmp, filename)
+        with open(path, "wb") as f:  # graftlint: allow-durable-write
+            f.write(data)
+        self._files[filename] = {"crc32c": crc32c(data),
+                                 "bytes": len(data)}
+
+    def put_array(self, filename: str, arr) -> int:
+        """np.save an array (``allow_pickle=False`` — the stores' trust
+        boundary) through the checksummed path; returns nbytes."""
+        import numpy as np
+
+        a = np.ascontiguousarray(np.asarray(arr))
+        buf = io.BytesIO()
+        # in-memory serialize, not a file write — the bytes then go
+        # through put_bytes' checksummed fsync'd path
+        np.save(buf, a, allow_pickle=False)  # graftlint: allow-durable-write
+        self.put_bytes(filename, buf.getvalue())
+        return a.nbytes
+
+    def put_text_lines(self, filename: str, lines) -> None:
+        """One JSON string per line (raw ids can contain anything a
+        hand-rolled escape would round-trip wrong)."""
+        data = "".join(json.dumps(str(v)) + "\n" for v in lines)
+        self.put_bytes(filename, data.encode("utf-8"))
+
+    def abort(self) -> None:
+        import shutil
+        shutil.rmtree(self._tmp, ignore_errors=True)
+
+    def _next_generation(self) -> int:
+        gens = [0]
+        try:
+            for name in os.listdir(self.root):
+                g = _gen_of(name, self.key)
+                if g is not None:
+                    gens.append(g)
+        except OSError:
+            pass
+        return max(gens) + 1
+
+    def commit(self, meta_body: dict) -> str:
+        """Durably commit the entry; returns the generation dir path."""
+        self.put_bytes("meta.json", json.dumps(
+            meta_body, indent=1, sort_keys=True,
+            default=str).encode("utf-8"))
+        # fsync every file, then the tmp dir, BEFORE the dir becomes
+        # reachable — a renamed-but-unsynced file is the torn entry
+        # this module exists to kill
+        _fire(SITE_PRE_FSYNC)
+        for filename in self._files:
+            fd = os.open(os.path.join(self._tmp, filename), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        fsync_dir(self._tmp)
+        _fire(SITE_POST_FSYNC)
+        gen = self._next_generation()
+        gen_dir = os.path.join(self.root, f"{self.key}@g{gen}")
+        _fire(SITE_PRE_RENAME)
+        os.replace(self._tmp, gen_dir)  # graftlint: allow-durable-write
+        _fire(SITE_POST_RENAME)
+        fsync_dir(self.root)
+        # THE commit point: one durable manifest replace
+        write_json(manifest_path(self.root, self.key),
+                   {"key": self.key, "generation": gen,
+                    "dir": os.path.basename(gen_dir),
+                    "files": self._files, "meta": meta_body},
+                   store=self.store, bus=self._injected_bus)
+        self._gc(keep_gen=gen)
+        return gen_dir
+
+    def _gc(self, keep_gen: int) -> None:
+        """Best-effort sweep of superseded generations and stale tmp
+        dirs for THIS key (a racing reader may still mmap an old
+        generation's arrays on posix — unlink keeps the pages alive
+        until it closes)."""
+        import shutil
+
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        stale_tmp = f".tmp.{self.key}."
+        for name in names:
+            g = _gen_of(name, self.key)
+            if (g is not None and g != keep_gen) or \
+                    name.startswith(stale_tmp):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)

@@ -1,0 +1,352 @@
+"""graftvault scrub — verify every store checksum, quarantine bit-rot.
+
+``python -m pertgnn_tpu.store.scrub`` (console script ``graftvault``)
+walks the on-disk stores, re-verifies every manifest envelope and every
+blob/array CRC32C recorded in it, and quarantines EXACTLY the corrupt
+entry — the manifest plus its payload move to ``<root>/.quarantine/``
+so the store's load path takes its existing single-entry rebuild route
+(fresh compile / arena rebuild / one-shard re-ingest) on the next run,
+while every healthy entry keeps warm-loading with zero rebuilds.
+Whole-store invalidation is exactly what this tool exists to avoid.
+
+Also swept (NOT corruption — the expected residue of a crashed
+writer): stale ``.tmp.*`` files/dirs and generation dirs no manifest
+references (a kill between the generation rename and the manifest
+commit). A store with only orphans scrubs CLEAN.
+
+Exit codes: 0 clean (orphans allowed), 1 corruption found (quarantined
+unless ``--dry_run``), 2 usage error.
+
+Telemetry: ``store.scrub.entries`` / ``store.scrub.corrupt`` /
+``store.scrub.orphans`` counters and ``store.quarantined`` (tag
+``store``), ``store.scrub.seconds`` histogram (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import shutil
+import sys
+import time
+
+from pertgnn_tpu.store import durable
+from pertgnn_tpu.store.durable import (StoreCorruption, StoreLock,
+                                       file_crc32c)
+
+log = logging.getLogger(__name__)
+
+
+def _bus(bus=None):
+    if bus is not None:
+        return bus
+    from pertgnn_tpu import telemetry
+    return telemetry.get_bus()
+
+
+def _quarantine(root: str, paths: list[str], *, dry_run: bool) -> None:
+    """Move an entry's files/dirs into <root>/.quarantine/ — evidence
+    preserved, load path unblocked."""
+    if dry_run:
+        return
+    qdir = os.path.join(root, ".quarantine")
+    os.makedirs(qdir, exist_ok=True)
+    stamp = int(time.time() * 1e3)
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        dest = os.path.join(qdir, f"{os.path.basename(p)}.{stamp}")
+        try:
+            os.replace(p, dest)  # graftlint: allow-durable-write
+        except OSError as e:
+            log.warning("scrub: could not quarantine %s (%s)", p, e)
+
+
+def _sweep(paths: list[str], *, dry_run: bool) -> int:
+    removed = 0
+    for p in paths:
+        removed += 1
+        if dry_run:
+            continue
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+        else:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    return removed
+
+
+def scrub_aot(root: str, *, dry_run: bool = False, bus=None) -> dict:
+    """The executable store: flat ``<name>/<key>.json`` manifests, each
+    naming an immutable ``<key>@g<N>.bin`` blob with its CRC32C."""
+    report = {"store": "aot", "root": root, "entries": 0,
+              "corrupt": [], "orphans_removed": 0}
+    if not os.path.isdir(root):
+        return report
+    with StoreLock(os.path.join(root, ".lock"), store="aot", bus=bus):
+        for slot in sorted(os.listdir(root)):
+            d = os.path.join(root, slot)
+            if not os.path.isdir(d) or slot == ".quarantine":
+                continue
+            referenced: set[str] = set()
+            orphans: list[str] = []
+            for name in sorted(os.listdir(d)):
+                path = os.path.join(d, name)
+                if ".tmp." in name:
+                    orphans.append(path)
+                    continue
+                if not name.endswith(".json"):
+                    continue
+                key = name[:-len(".json")]
+                report["entries"] += 1
+                entry = f"{slot}/{key}"
+                blob = ""
+                try:
+                    body = durable.read_json(path, store="aot")
+                    blob = str(body.get("blob", ""))
+                    if not blob.startswith(f"{key}@g"):
+                        blob = ""
+                        raise StoreCorruption(
+                            "manifest names a foreign blob",
+                            store="aot", path=path, reason="bad_dir")
+                    referenced.add(blob)
+                    crc, nbytes = file_crc32c(os.path.join(d, blob))
+                    if (crc != body.get("blob_crc32c")
+                            or nbytes != body.get("blob_bytes")):
+                        raise StoreCorruption(
+                            f"blob CRC32C mismatch (recorded "
+                            f"{body.get('blob_crc32c')!r}, computed "
+                            f"{crc})", store="aot", path=path,
+                            reason="crc_mismatch")
+                except (StoreCorruption, OSError) as e:
+                    report["corrupt"].append(
+                        {"entry": entry,
+                         "reason": getattr(e, "reason", "io_error"),
+                         "detail": str(e)})
+                    victims = [path]
+                    if blob:
+                        victims.append(os.path.join(d, blob))
+                    _quarantine(root, victims, dry_run=dry_run)
+            # blobs no manifest references: the crashed-writer residue
+            for name in sorted(os.listdir(d)):
+                if (name.endswith(".bin") and "@g" in name
+                        and name not in referenced):
+                    orphans.append(os.path.join(d, name))
+            report["orphans_removed"] += _sweep(orphans, dry_run=dry_run)
+    return report
+
+
+def scrub_dir_store(root: str, store: str, *, dry_run: bool = False,
+                    bus=None) -> dict:
+    """Arena / delta stores: ``<key>.manifest.json`` pointing at an
+    immutable ``<key>@g<N>`` dir whose per-file CRC32Cs it records."""
+    report = {"store": store, "root": root, "entries": 0,
+              "corrupt": [], "orphans_removed": 0}
+    if not os.path.isdir(root):
+        return report
+    with StoreLock(os.path.join(root, ".lock"), store=store, bus=bus):
+        referenced: set[str] = set()
+        for key, mp in durable.iter_manifests(root):
+            report["entries"] += 1
+            gen_dir = None
+            try:
+                resolved = durable.resolve_entry(root, key, store=store)
+                if resolved is None:
+                    continue
+                gen_dir, body = resolved
+                referenced.add(os.path.basename(gen_dir))
+                for filename, rec in sorted(
+                        (body.get("files") or {}).items()):
+                    crc, nbytes = file_crc32c(
+                        os.path.join(gen_dir, filename))
+                    if (crc != rec.get("crc32c")
+                            or nbytes != rec.get("bytes")):
+                        raise StoreCorruption(
+                            f"{filename}: CRC32C mismatch (recorded "
+                            f"{rec.get('crc32c')!r}, computed {crc})",
+                            store=store, path=mp,
+                            reason="crc_mismatch")
+            except (StoreCorruption, OSError) as e:
+                report["corrupt"].append(
+                    {"entry": key,
+                     "reason": getattr(e, "reason", "io_error"),
+                     "detail": str(e)})
+                victims = [mp] + ([gen_dir] if gen_dir else [])
+                _quarantine(root, victims, dry_run=dry_run)
+        orphans = []
+        for name in sorted(os.listdir(root)):
+            path = os.path.join(root, name)
+            if name.startswith(".tmp."):
+                orphans.append(path)
+            elif ("@g" in name and os.path.isdir(path)
+                    and name not in referenced):
+                orphans.append(path)
+        report["orphans_removed"] += _sweep(orphans, dry_run=dry_run)
+    return report
+
+
+def scrub_sidecar(checkpoint_dir: str, *, dry_run: bool = False,
+                  bus=None) -> dict:
+    """The train_config.json sidecar. A pre-graftvault plain-JSON
+    sidecar is LEGACY, not corruption (load_config_dict still reads
+    it); only a torn/tampered envelope quarantines."""
+    report = {"store": "checkpoint", "root": checkpoint_dir,
+              "entries": 0, "corrupt": [], "orphans_removed": 0,
+              "legacy": 0}
+    path = os.path.join(checkpoint_dir, "train_config.json")
+    if not os.path.exists(path):
+        return report
+    report["entries"] = 1
+    try:
+        durable.read_json(path, store="checkpoint")
+    except StoreCorruption as e:
+        if e.reason == "not_envelope":
+            try:
+                with open(path) as f:
+                    json.load(f)
+                report["legacy"] = 1
+                return report
+            except (OSError, ValueError):
+                pass
+        report["corrupt"].append({"entry": "train_config.json",
+                                  "reason": e.reason,
+                                  "detail": str(e)})
+        with StoreLock(os.path.join(checkpoint_dir, ".lock"),
+                       store="checkpoint", bus=bus):
+            _quarantine(checkpoint_dir, [path], dry_run=dry_run)
+    return report
+
+
+def scrub_journal(path: str, *, dry_run: bool = False,
+                  bus=None) -> dict:
+    """The capture journal: per-record CRC32C verification. A torn
+    FINAL line is the expected signature of a kill mid-append (clean);
+    an interior bad line or CRC mismatch is corruption — reported, not
+    rewritten (the reader already skips it loudly; rewriting an
+    append-only journal would forge history)."""
+    report = {"store": "journal", "root": path, "entries": 0,
+              "corrupt": [], "orphans_removed": 0, "torn_tail": 0}
+    try:
+        with open(path, "rb") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return report
+    from pertgnn_tpu.telemetry.capture import verify_record_crc
+    from pertgnn_tpu.telemetry.schema import validate_event
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        report["entries"] += 1
+        try:
+            ev = validate_event(json.loads(line.decode("utf-8")))
+            if not verify_record_crc(ev):
+                raise ValueError("record CRC32C mismatch")
+        except (ValueError, TypeError, UnicodeDecodeError) as e:
+            if i == len(lines) - 1:
+                report["torn_tail"] = 1
+            else:
+                report["corrupt"].append(
+                    {"entry": f"line {i + 1}", "reason": "bad_record",
+                     "detail": str(e)})
+    return report
+
+
+def _emit_telemetry(reports: list[dict], seconds: float, bus) -> None:
+    b = _bus(bus)
+    for r in reports:
+        tag = r["store"]
+        if r["entries"]:
+            b.counter("store.scrub.entries", r["entries"], store=tag)
+        if r["corrupt"]:
+            b.counter("store.scrub.corrupt", len(r["corrupt"]),
+                      store=tag)
+            b.counter("store.quarantined", len(r["corrupt"]), store=tag)
+        if r["orphans_removed"]:
+            b.counter("store.scrub.orphans", r["orphans_removed"],
+                      store=tag)
+    b.histogram("store.scrub.seconds", seconds)
+
+
+def scrub_all(*, aot_dir: str | None = None, arena_dir: str | None = None,
+              delta_dir: str | None = None,
+              checkpoint_dir: str | None = None,
+              journal: str | None = None, dry_run: bool = False,
+              bus=None) -> tuple[list[dict], int]:
+    """Run every requested scrub; (reports, exit code)."""
+    t0 = time.perf_counter()
+    reports: list[dict] = []
+    if aot_dir:
+        reports.append(scrub_aot(aot_dir, dry_run=dry_run, bus=bus))
+    if arena_dir:
+        reports.append(scrub_dir_store(arena_dir, "arena",
+                                       dry_run=dry_run, bus=bus))
+    if delta_dir:
+        reports.append(scrub_dir_store(delta_dir, "stream",
+                                       dry_run=dry_run, bus=bus))
+    if checkpoint_dir:
+        reports.append(scrub_sidecar(checkpoint_dir, dry_run=dry_run,
+                                     bus=bus))
+    if journal:
+        reports.append(scrub_journal(journal, dry_run=dry_run, bus=bus))
+    _emit_telemetry(reports, time.perf_counter() - t0, bus)
+    code = 1 if any(r["corrupt"] for r in reports) else 0
+    return reports, code
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftvault scrub",
+        description="Verify every store manifest/blob CRC32C; "
+                    "quarantine exactly the corrupt entries.")
+    p.add_argument("--aot_dir", default="",
+                   help="executable store root (--compile_cache_dir)")
+    p.add_argument("--arena_dir", default="",
+                   help="arena store root (--arena_cache_dir)")
+    p.add_argument("--delta_dir", default="",
+                   help="delta arena store root (--delta_cache_dir)")
+    p.add_argument("--checkpoint_dir", default="",
+                   help="checkpoint dir (verifies the config sidecar)")
+    p.add_argument("--journal", default="",
+                   help="capture journal path (per-record CRC verify)")
+    p.add_argument("--dry_run", action="store_true",
+                   help="report only: quarantine and sweep nothing")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the report as JSON on stdout")
+    args = p.parse_args(argv)
+    if not any((args.aot_dir, args.arena_dir, args.delta_dir,
+                args.checkpoint_dir, args.journal)):
+        p.error("nothing to scrub: pass at least one store location")
+    reports, code = scrub_all(
+        aot_dir=args.aot_dir or None, arena_dir=args.arena_dir or None,
+        delta_dir=args.delta_dir or None,
+        checkpoint_dir=args.checkpoint_dir or None,
+        journal=args.journal or None, dry_run=args.dry_run)
+    if args.as_json:
+        print(json.dumps({"reports": reports, "clean": code == 0},
+                         indent=1, sort_keys=True))
+    else:
+        for r in reports:
+            line = (f"{r['store']:<10} {r['root']}: "
+                    f"{r['entries']} entries, "
+                    f"{len(r['corrupt'])} corrupt, "
+                    f"{r['orphans_removed']} orphans swept")
+            if r.get("torn_tail"):
+                line += ", torn tail (expected crash residue)"
+            if r.get("legacy"):
+                line += ", legacy (pre-graftvault) sidecar"
+            print(line)
+            for c in r["corrupt"]:
+                verb = "would quarantine" if args.dry_run \
+                    else "quarantined"
+                print(f"  CORRUPT {c['entry']} ({c['reason']}): "
+                      f"{c['detail']} — {verb}")
+        print("scrub: " + ("CLEAN" if code == 0 else "CORRUPTION FOUND"))
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

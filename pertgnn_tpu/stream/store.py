@@ -9,8 +9,12 @@ touching any existing entry, and a changed shard invalidates itself
 alone.  ``stream/merge.py`` then reconstitutes the serving/training
 corpus from base + deltas without a full rebuild.
 
-Layout: ``<root>/<key>/meta.json`` plus one ``.npy`` per array and one
-``.txt`` (newline-joined UTF-8) per string list.  TRUST BOUNDARY: the
+Layout (graftvault, store/durable.py): immutable generation dirs
+``<root>/<key>@g<N>/`` holding one ``.npy`` per array and one ``.txt``
+(one JSON string per line) per string list plus ``meta.json``,
+committed by ONE durable replace of the checksummed
+``<root>/<key>.manifest.json`` (which records a CRC32C per file — what
+``graftvault scrub`` verifies).  TRUST BOUNDARY: the
 same as the arena store — entries are plain arrays, JSON, and text (no
 pickle, no code execution at load), but they ARE the training data;
 whoever can write this directory controls every later run's features
@@ -27,19 +31,21 @@ from __future__ import annotations
 import json
 import logging
 import os
-import shutil
 import time
 
 import numpy as np
 
 from pertgnn_tpu import telemetry
 from pertgnn_tpu.graphs.construct import GraphSpec
+from pertgnn_tpu.store import durable
+from pertgnn_tpu.store.durable import StoreCorruption, StoreLock
 from pertgnn_tpu.stream.delta import ShardDelta
 
 log = logging.getLogger(__name__)
 
 # Bump to orphan every entry on a layout/semantics change (rides fn_id).
-_STORE_VERSION = 1
+# v2: graftvault generation-dir layout with checksummed manifests.
+_STORE_VERSION = 2
 _FN_ID = f"stream.delta_store.v{_STORE_VERSION}"
 
 _ARRAY_FIELDS = ("traceid", "entry_local", "runtime_local", "ts_bucket",
@@ -67,15 +73,10 @@ def shard_cache_key(cfg, fingerprint: dict, *, kind: str,
                          env={})
 
 
-def _write_strings(path: str, values) -> None:
-    # one JSON string per line: raw ids can contain anything (newlines,
-    # backslash sequences a hand-rolled escape would round-trip wrong)
-    with open(path, "w", encoding="utf-8") as f:
-        for v in values:
-            f.write(json.dumps(str(v)) + "\n")
-
-
 def _read_strings(path: str) -> list[str]:
+    # one JSON string per line (EntryWriter.put_text_lines writes the
+    # same framing): raw ids can contain anything — newlines, backslash
+    # sequences a hand-rolled escape would round-trip wrong
     with open(path, encoding="utf-8") as f:
         return [json.loads(line) for line in f]
 
@@ -93,8 +94,12 @@ class DeltaArenaStore:
         return (self._injected_bus if self._injected_bus is not None
                 else telemetry.get_bus())
 
-    def _entry_dir(self, key: str) -> str:
-        return os.path.join(self.root, key)
+    def _entry_dir(self, key: str) -> str | None:
+        """The committed generation dir for ``key``, or None when the
+        entry is absent.  Raises StoreCorruption on a torn manifest or
+        a manifest whose generation dir is gone."""
+        resolved = durable.resolve_entry(self.root, key, store="delta")
+        return None if resolved is None else resolved[0]
 
     # -- entry points ----------------------------------------------------
 
@@ -179,18 +184,25 @@ class DeltaArenaStore:
                 if name.endswith(".npy"):
                     mmap_bytes += os.path.getsize(os.path.join(d, name))
         bus.gauge("stream.shard_mmap_bytes", mmap_bytes)
+        total = sum(1 for _ in durable.iter_manifests(self.root))
         log.info("delta store: sharded open of %d/%d entries (%d mmap "
-                 "bytes)", len(shards), len(os.listdir(self.root)),
-                 mmap_bytes)
+                 "bytes)", len(shards), total, mmap_bytes)
         return shards
 
     # -- load ------------------------------------------------------------
 
     def _load(self, key: str) -> ShardDelta | None:
         bus = self._bus
-        d = self._entry_dir(key)
-        meta_path = os.path.join(d, "meta.json")
-        if not os.path.exists(meta_path):
+        try:
+            d = self._entry_dir(key)
+        except StoreCorruption as e:
+            # a torn/bit-rotted manifest: never crash the stream — THIS
+            # shard re-ingests (graftvault scrub quarantines the entry)
+            log.warning("corrupt delta-store manifest for %s (%s) — "
+                        "re-ingesting this shard fresh", key, e)
+            bus.counter("stream.shard_cache_miss", reason="corrupt")
+            return None
+        if d is None:
             bus.counter("stream.shard_cache_miss", reason="absent")
             return None
         t0 = time.perf_counter()
@@ -269,93 +281,89 @@ class DeltaArenaStore:
 
     def _save(self, key: str, components: dict,
               shard: ShardDelta) -> str | None:
-        """Atomic tmp-dir + rename, like the arena store: a kill
-        mid-write costs one shard re-ingest, never a torn entry."""
+        """Durable (store/durable.py), like the arena store: arrays
+        land fsync'd in an immutable generation dir and ONE checksummed
+        manifest replace commits the entry — a kill mid-write costs one
+        shard re-ingest, never a torn entry, and never the old
+        double-replace window where the live entry was briefly gone."""
         bus = self._bus
         t0 = time.perf_counter()
-        final = self._entry_dir(key)
-        tmp = os.path.join(self.root, f".tmp.{key}.{os.getpid()}")
-        os.makedirs(tmp, exist_ok=True)
         try:
-            def put(name: str, a) -> None:
-                np.save(os.path.join(tmp, f"{name}.npy"),
-                        np.ascontiguousarray(np.asarray(a)),
-                        allow_pickle=False)
-
-            for f in _ARRAY_FIELDS:
-                put(f, getattr(shard, f))
-            for f in _STRING_FIELDS:
-                _write_strings(os.path.join(tmp, f"{f}.txt"),
-                               getattr(shard, f))
-            P = shard.num_patterns
-            noff = [0]
-            eoff = [0]
-            send, recv, attr, ms, depth, dur = [], [], [], [], [], []
-            has_dur = any(shard.graphs[p].edge_durations is not None
-                          for p in range(P))
-            for p in range(P):
-                g = shard.graphs[p]
-                noff.append(noff[-1] + g.num_nodes)
-                eoff.append(eoff[-1] + g.num_edges)
-                send.append(g.senders)
-                recv.append(g.receivers)
-                attr.append(g.edge_attr)
-                ms.append(g.ms_id)
-                depth.append(g.node_depth)
-                if has_dur:
-                    dur.append(g.edge_durations
-                               if g.edge_durations is not None
-                               else np.zeros(g.num_edges, np.float32))
-            attr_w = shard.graphs[0].edge_attr.shape[1] if P else 2
-            put("g_node_offsets", np.asarray(noff, np.int64))
-            put("g_edge_offsets", np.asarray(eoff, np.int64))
-            put("g_senders", np.concatenate(send)
-                if P else np.empty(0, np.int32))
-            put("g_receivers", np.concatenate(recv)
-                if P else np.empty(0, np.int32))
-            put("g_edge_attr", np.concatenate(attr)
-                if P else np.empty((0, attr_w), np.int32))
-            put("g_ms_id", np.concatenate(ms)
-                if P else np.empty(0, np.int32))
-            put("g_node_depth", np.concatenate(depth)
-                if P else np.empty(0, np.float32))
-            if has_dur:
-                put("g_edge_durations", np.concatenate(dur))
-            if shard.vocabs is not None:
-                for n in _VOCAB_NAMES:
-                    _write_strings(os.path.join(tmp, f"vocab_{n}.txt"),
-                                   np.asarray(shard.vocabs[n]).tolist())
-            meta = {
-                "key": key, "kind": shard.kind,
-                "store_version": _STORE_VERSION,
-                "created_unix_time": time.time(),
-                "has_edge_durations": has_dur,
-                "scalars": {"n_traces_total": shard.n_traces_total,
-                            "span_ts_min": shard.span_ts_min,
-                            "span_ts_max": shard.span_ts_max},
-                "entry_occ_prefilter": shard.entry_occ_prefilter,
-                "base_vocab_hash": shard.base_vocab_hash,
-                "coverage_dropped": shard.coverage_dropped,
-                **components,
-            }
-            with open(os.path.join(tmp, "meta.json"), "w") as f:
-                json.dump(meta, f, indent=1, sort_keys=True, default=str)
-            if os.path.isdir(final):
-                old = f"{final}.old.{os.getpid()}"
-                os.replace(final, old)
-                os.replace(tmp, final)
-                shutil.rmtree(old, ignore_errors=True)
-            else:
-                os.replace(tmp, final)
+            with StoreLock(os.path.join(self.root, ".lock"),
+                           store="delta", bus=bus), \
+                    durable.EntryWriter(self.root, key, store="delta",
+                                        bus=bus) as w:
+                final = self._save_entry(w, key, components, shard)
         except Exception as e:
             # a failed save must not fail the run the shard was built
             # FOR — next process re-ingests
             log.warning("delta store: could not persist %s (%s: %s)",
                         key, type(e).__name__, e)
-            shutil.rmtree(tmp, ignore_errors=True)
             return None
         bus.histogram("stream.shard_save_seconds",
                       time.perf_counter() - t0)
         log.info("delta store: saved %s (%s, %d traces, %d patterns)",
                  key, shard.kind, len(shard.traceid), shard.num_patterns)
         return final
+
+    def _save_entry(self, w, key: str, components: dict,
+                    shard: ShardDelta) -> str:
+        def put(name: str, a) -> None:
+            w.put_array(f"{name}.npy", a)
+
+        for f in _ARRAY_FIELDS:
+            put(f, getattr(shard, f))
+        for f in _STRING_FIELDS:
+            w.put_text_lines(f"{f}.txt", getattr(shard, f))
+        P = shard.num_patterns
+        noff = [0]
+        eoff = [0]
+        send, recv, attr, ms, depth, dur = [], [], [], [], [], []
+        has_dur = any(shard.graphs[p].edge_durations is not None
+                      for p in range(P))
+        for p in range(P):
+            g = shard.graphs[p]
+            noff.append(noff[-1] + g.num_nodes)
+            eoff.append(eoff[-1] + g.num_edges)
+            send.append(g.senders)
+            recv.append(g.receivers)
+            attr.append(g.edge_attr)
+            ms.append(g.ms_id)
+            depth.append(g.node_depth)
+            if has_dur:
+                dur.append(g.edge_durations
+                           if g.edge_durations is not None
+                           else np.zeros(g.num_edges, np.float32))
+        attr_w = shard.graphs[0].edge_attr.shape[1] if P else 2
+        put("g_node_offsets", np.asarray(noff, np.int64))
+        put("g_edge_offsets", np.asarray(eoff, np.int64))
+        put("g_senders", np.concatenate(send)
+            if P else np.empty(0, np.int32))
+        put("g_receivers", np.concatenate(recv)
+            if P else np.empty(0, np.int32))
+        put("g_edge_attr", np.concatenate(attr)
+            if P else np.empty((0, attr_w), np.int32))
+        put("g_ms_id", np.concatenate(ms)
+            if P else np.empty(0, np.int32))
+        put("g_node_depth", np.concatenate(depth)
+            if P else np.empty(0, np.float32))
+        if has_dur:
+            put("g_edge_durations", np.concatenate(dur))
+        if shard.vocabs is not None:
+            for n in _VOCAB_NAMES:
+                w.put_text_lines(f"vocab_{n}.txt",
+                                 np.asarray(shard.vocabs[n]).tolist())
+        meta = {
+            "key": key, "kind": shard.kind,
+            "store_version": _STORE_VERSION,
+            "created_unix_time": time.time(),
+            "has_edge_durations": has_dur,
+            "scalars": {"n_traces_total": shard.n_traces_total,
+                        "span_ts_min": shard.span_ts_min,
+                        "span_ts_max": shard.span_ts_max},
+            "entry_occ_prefilter": shard.entry_occ_prefilter,
+            "base_vocab_hash": shard.base_vocab_hash,
+            "coverage_dropped": shard.coverage_dropped,
+            **components,
+        }
+        return w.commit(meta)

@@ -60,9 +60,31 @@ import signal
 import sys
 import time
 
+from pertgnn_tpu.store import durable
+from pertgnn_tpu.store.durable import StoreLock
 from pertgnn_tpu.telemetry.schema import SCHEMA_VERSION, validate_event
 
 log = logging.getLogger(__name__)
+
+
+def record_crc(ev: dict) -> int:
+    """CRC32C of the canonical dump of ``ev`` minus its ``crc`` key —
+    what ``append`` stamps into each journal record and ``records()``
+    / graftvault scrub verify, so interior bit-rot (as opposed to the
+    expected torn final line) is detected instead of stitched."""
+    body = {k: v for k, v in ev.items() if k != "crc"}
+    return durable.crc32c(durable.canonical_body_bytes(body))
+
+
+def verify_record_crc(ev: dict) -> bool:
+    """True when ``ev`` carries no crc (legacy pre-graftvault record)
+    or its crc matches; False on a mismatch."""
+    if "crc" not in ev:
+        return True
+    try:
+        return int(ev["crc"]) == record_crc(ev)
+    except (TypeError, ValueError):
+        return False
 
 RUN_EVENT = "capture.run"
 STAGE_EVENT = "capture.stage"
@@ -146,11 +168,13 @@ class CaptureJournal:
             "fields": fields,
         }
         validate_event(ev)
-        parent = os.path.dirname(os.path.abspath(self.path))
-        os.makedirs(parent, exist_ok=True)
-        with open(self.path, "a") as f:
-            f.write(json.dumps(ev) + "\n")
-            f.flush()
+        ev["crc"] = record_crc(ev)
+        # durable append (store/durable.py): full line + fsync, under
+        # the journal lock so the capture process and the watcher's
+        # helper one-liners never interleave mid-line
+        line = (json.dumps(ev) + "\n").encode("utf-8")
+        with StoreLock(f"{self.path}.lock", store="journal"):
+            durable.append_line(self.path, line, store="journal")
         return ev
 
     def stage(self, stage: str, status: str, *, window: int | None = None,
@@ -188,6 +212,12 @@ class CaptureJournal:
                 skipped += 1
                 log.warning("capture journal %s: skipping bad line %d "
                             "(%s)", self.path, i + 1, e)
+                continue
+            if not verify_record_crc(ev):
+                skipped += 1
+                log.warning("capture journal %s: skipping line %d — "
+                            "record crc mismatch (bit-rot or a torn "
+                            "interior write)", self.path, i + 1)
                 continue
             out.append(ev)
         self.skipped_lines = skipped
@@ -337,7 +367,10 @@ class StageWatchdog:
             try:
                 os.makedirs(os.path.dirname(os.path.abspath(self.dump_path)),
                             exist_ok=True)
-                self._dump_file = open(self.dump_path, "a")
+                # crash-diagnostic side channel, not store state — the
+                # faulthandler C writer needs a raw fd, not the vault
+                self._dump_file = open(  # graftlint: allow-durable-write
+                    self.dump_path, "a")
                 self._dump_file.write(
                     f"# stage {self.stage_name} armed at {time.time():.3f} "
                     f"(timeout {self.timeout_s}s)\n")

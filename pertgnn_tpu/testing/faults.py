@@ -26,6 +26,15 @@ site                 where it fires
                      occurrence-addressed stand-in for SIGKILLing the
                      worker mid-traffic (benchmarks/fleet_bench.py
                      also sends the real signal)
+``store.write.*``    the graftvault durable-write protocol
+                     (store/durable.py): ``pre_fsync`` / ``post_fsync``
+                     / ``pre_rename`` / ``post_rename`` bracket the
+                     file fsync and the atomic rename of every store
+                     write; ``kill`` is enacted there as
+                     ``os._exit(137)`` — tests/test_durable.py's crash
+                     matrix arms one per (store × site) over a real
+                     writer subprocess and asserts the reopened store
+                     is bit-identical old-or-new state
 ===================  =====================================================
 
 Faults address occurrences deterministically: ``nth=(3,)`` fires on the

@@ -172,29 +172,46 @@ class CheckpointManager:
 
     def save_config(self, cfg) -> None:
         import dataclasses
-        import json
+
+        from pertgnn_tpu.store import durable
 
         # Only process 0 writes: on a shared checkpoint dir every process
         # races the same file, and two writers using one fixed tmp name
         # can interleave truncate/rename into a torn sidecar (ADVICE r5).
-        # The pid suffix keeps even same-host processes (supervisor
-        # restarts, multi-process CPU meshes) from sharing a tmp path.
+        # durable.write_json is the graftvault protocol — pid-suffixed
+        # tmp, fsync, atomic replace, dir fsync, checksummed envelope —
+        # so a kill mid-save leaves the previous sidecar intact and a
+        # bit-rotted one is detected at load instead of silently
+        # cross-checking garbage.
         if jax.process_index() != 0:
             return
         path = os.path.join(str(self._mgr.directory),
                             "train_config.json")
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(dataclasses.asdict(cfg), f, indent=1, default=str)
-        os.replace(tmp, path)
+        durable.write_json(path, dataclasses.asdict(cfg),
+                           store="checkpoint")
 
     def load_config_dict(self) -> dict | None:
         import json
 
+        from pertgnn_tpu.store import durable
+        from pertgnn_tpu.store.durable import StoreCorruption
+
+        path = os.path.join(str(self._mgr.directory),
+                            "train_config.json")
         try:
-            with open(os.path.join(str(self._mgr.directory),
-                                   "train_config.json")) as f:
-                return json.load(f)
+            return durable.read_json(path, store="checkpoint")
+        except StoreCorruption as e:
+            if e.reason == "not_envelope":
+                # legacy sidecar written before graftvault: plain JSON,
+                # no checksum — still cross-checkable
+                try:
+                    with open(path) as f:
+                        return json.load(f)
+                except (OSError, ValueError):
+                    return None
+            log.warning("checkpoint sidecar %s is corrupt (%s) — "
+                        "treating as absent", path, e)
+            return None
         except (OSError, ValueError):
             return None
 

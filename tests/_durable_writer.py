@@ -1,0 +1,199 @@
+"""Real-writer child for the graftvault crash matrix
+(tests/test_durable.py). Not named test_* on purpose: launched as a
+subprocess, never collected.
+
+Protocol (argv: MODE ROOT OUT_DIR):
+
+1. disarm fault injection (``faults.install(None)`` — an explicit
+   install also blocks later silent env adoption),
+2. write the OLD entry and dump ``<OUT_DIR>/old.json`` (the
+   normalized relpath -> sha256 snapshot of ROOT),
+3. re-arm EXPLICITLY from ``$PERTGNN_FAULT_PLAN``
+   (``faults.install(FaultPlan.from_env())`` — step 1 set
+   ``_ENV_CHECKED``, so adoption must be explicit),
+4. write the NEW entry — in a kill run, durable.py's ``_fire`` enacts
+   ``os._exit(137)`` at the armed ``store.write.*`` site, the closest
+   a test can get to power loss,
+5. (unarmed reference runs only) dump ``<OUT_DIR>/new.json``, exit 0.
+
+The parent asserts: exit 137, and the reopened ROOT's snapshot equals
+the reference run's OLD or NEW snapshot exactly — never a third thing.
+
+Determinism: ``time.time`` / ``time.monotonic`` / ``os.getpid`` are
+frozen to constants before any store import, so the bytes the
+reference run and every kill run write are identical (manifests embed
+creation times; journal records embed pid + clocks). The race mode
+(two live writers) skips the pid freeze — pid-suffixed tmp names are
+part of what it exercises.
+
+Modes: ``aot`` | ``arena`` | ``delta`` | ``sidecar`` | ``journal``
+(the five stores), ``race-aot`` (concurrent-writer drill: spin on
+``<OUT_DIR>/go`` then warm-save the shared entry once).
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FROZEN_TIME = 1_700_000_000.0
+FROZEN_PID = 4242
+
+
+def snapshot(root: str) -> dict:
+    """Normalized relpath -> sha256 of a store root: crash residue —
+    pid-stamped tmp files/dirs, the advisory lock, the quarantine dir,
+    and GENERATIONS NO MANIFEST REFERENCES — is excluded, because a
+    killed writer legitimately leaves it behind (graftvault scrub
+    sweeps it) and it is invisible to every reader."""
+    referenced: set[str] = set()
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            if fn.endswith(".manifest.json") or (
+                    fn.endswith(".json") and "@g" not in fn):
+                path = os.path.join(dirpath, fn)
+                try:
+                    with open(path, "rb") as f:
+                        env = json.loads(f.read().decode("utf-8"))
+                    body = env.get("body", env)
+                    for field in ("dir", "blob"):
+                        name = body.get(field)
+                        if isinstance(name, str) and "@g" in name:
+                            referenced.add(os.path.join(
+                                os.path.relpath(dirpath, root), name))
+                except (OSError, ValueError, AttributeError):
+                    continue
+
+    def excluded(rel: str) -> bool:
+        parts = rel.split(os.sep)
+        for i, part in enumerate(parts):
+            # durable_write tmps are SUFFIXED (foo.json.tmp.<pid>),
+            # EntryWriter tmp dirs are prefixed (.tmp.<key>.<pid>)
+            if ".tmp." in part or part == ".quarantine" \
+                    or part == ".lock":
+                return True
+            if "@g" in part:
+                gen_rel = os.path.normpath(os.path.join(*parts[:i + 1]))
+                if gen_rel not in referenced:
+                    return True
+        return False
+
+    out: dict = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            rel = os.path.normpath(os.path.relpath(
+                os.path.join(dirpath, fn), root))
+            if excluded(rel):
+                continue
+            with open(os.path.join(dirpath, fn), "rb") as f:
+                out[rel] = hashlib.sha256(f.read()).hexdigest()
+    return out
+
+
+def _dump(out_dir: str, name: str, snap: dict) -> None:
+    with open(os.path.join(out_dir, name), "w") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+
+
+def _freeze_clocks(*, pid: bool = True) -> None:
+    import time
+
+    time.time = lambda: FROZEN_TIME
+    time.monotonic = lambda: 123.0
+    if pid:
+        os.getpid = lambda: FROZEN_PID
+
+
+# -- one writer per store -----------------------------------------------
+
+def _write_aot(root: str, payload: bytes) -> None:
+    from pertgnn_tpu.aot.store import ExecutableStore
+
+    store = ExecutableStore(root)
+    store._save("prog", "cafe01", {"config": {"x": 1}},
+                {"format": "stablehlo", "payload": payload})
+
+
+def _write_entry(root: str, store_name: str, tag: bytes) -> None:
+    """The arena/delta save substrate: arrays + text lines through an
+    EntryWriter under the store lock, one manifest commit."""
+    import numpy as np
+
+    from pertgnn_tpu.store import durable
+    from pertgnn_tpu.store.durable import StoreLock
+
+    with StoreLock(os.path.join(root, ".lock"), store=store_name), \
+            durable.EntryWriter(root, "cafe01", store=store_name) as w:
+        w.put_array("arena_a.npy", np.frombuffer(tag * 64, np.uint8))
+        w.put_array("arena_b.npy", np.arange(17, dtype=np.int64))
+        w.put_text_lines("strings.txt", ["alpha", tag.decode("ascii")])
+        w.commit({"key": "cafe01", "store_version": 2,
+                  "tag": tag.decode("ascii")})
+
+
+def _write_sidecar(root: str, value: int) -> None:
+    # durable.write_json IS CheckpointManager.save_config minus the
+    # jax.process_index()-0 guard (no jax in this child)
+    from pertgnn_tpu.store import durable
+
+    durable.write_json(os.path.join(root, "train_config.json"),
+                       {"model": {"hidden_channels": value},
+                        "label_scale": 1000.0},
+                       store="checkpoint")
+
+
+def _write_journal(root: str, step: int) -> None:
+    from pertgnn_tpu.telemetry.capture import CaptureJournal
+
+    CaptureJournal(os.path.join(root, "journal.jsonl")).stage(
+        "probe", "done", step=step)
+
+
+def main() -> int:
+    mode, root, out_dir = sys.argv[1], sys.argv[2], sys.argv[3]
+    os.makedirs(root, exist_ok=True)
+    os.makedirs(out_dir, exist_ok=True)
+
+    if mode == "race-aot":
+        # concurrent-writer drill: real pids, real lock contention
+        import time as _time
+
+        from pertgnn_tpu.testing import faults
+
+        faults.install(None)
+        go = os.path.join(out_dir, "go")
+        deadline = _time.perf_counter() + 10.0
+        while not os.path.exists(go):
+            if _time.perf_counter() > deadline:
+                return 3
+            _time.sleep(0.001)
+        _write_aot(root, b"R" * 2048)
+        return 0
+
+    _freeze_clocks()
+    from pertgnn_tpu.testing import faults
+
+    writers = {
+        "aot": lambda tag: _write_aot(root, tag * 2048),
+        "arena": lambda tag: _write_entry(root, "arena", tag),
+        "delta": lambda tag: _write_entry(root, "delta", tag),
+        "sidecar": lambda tag: _write_sidecar(root, ord(tag)),
+        "journal": lambda tag: _write_journal(root, ord(tag)),
+    }
+    write = writers[mode]
+
+    faults.install(None)          # OLD write runs unarmed
+    write(b"A")
+    _dump(out_dir, "old.json", snapshot(root))
+
+    faults.install(faults.FaultPlan.from_env())  # explicit re-arm
+    write(b"B")                   # a kill run os._exit(137)s in here
+
+    _dump(out_dir, "new.json", snapshot(root))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -216,8 +216,9 @@ class TestInvalidation:
         store.load_or_build(cfg, FP,
                             lambda: build_dataset(preprocessed, cfg))
         key, _ = arena_cache_key(cfg, FP)
-        # truncate one array to garbage
-        victim = os.path.join(root, key, "arena_ms_id.npy")
+        # truncate one array (inside the committed generation dir) to
+        # garbage
+        victim = os.path.join(store._entry_dir(key), "arena_ms_id.npy")
         with open(victim, "wb") as f:
             f.write(b"\x00garbage")
         bus = _RecordingBus()
@@ -246,7 +247,10 @@ class TestInvalidation:
         store.load_or_build(cfg, FP,
                             lambda: build_dataset(preprocessed, cfg))
         key, _ = arena_cache_key(cfg, FP)
-        with open(os.path.join(root, key, "meta.json"), "w") as f:
+        # tear the MANIFEST (the commit record) — the graftvault torn
+        # read surface
+        from pertgnn_tpu.store import durable
+        with open(durable.manifest_path(root, key), "w") as f:
             f.write('{"trunc')
         built = []
         ArenaStore(root).load_or_build(

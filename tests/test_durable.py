@@ -1,0 +1,580 @@
+"""graftvault (store/durable.py + store/scrub.py): the durable-write
+protocol, store locks, scrubbing, and the crash-injection matrix.
+
+The heavyweight guarantee under test: for EVERY on-disk store, a
+writer SIGKILLed at any ``store.write.*`` fault site leaves the
+reopened store bit-identical to its old or new state — never a third
+thing — and a subsequent scrub reports CLEAN (crash residue is
+orphans, not corruption). The matrix runs a REAL writer subprocess
+(tests/_durable_writer.py) per case: fault plans only arm kills, the
+kernel delivers them.
+
+Bit-rot is the complementary axis: a flipped payload bit must be
+detected by scrub and quarantine EXACTLY the corrupt entry, while
+every healthy entry keeps warm-loading with zero rebuilds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pertgnn_tpu.store import durable
+from pertgnn_tpu.store import scrub
+from pertgnn_tpu.store.durable import (EntryWriter, StoreCorruption,
+                                       StoreLock, StoreLockTimeout)
+from pertgnn_tpu.testing import faults
+
+from _durable_writer import snapshot
+
+CHILD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "_durable_writer.py")
+
+
+class _Bus:
+    """Minimal recording bus (duck-typed: durable.py only calls
+    counter/histogram)."""
+
+    def __init__(self):
+        self.events: list[tuple[str, str, dict]] = []
+
+    def counter(self, name, value=1, *, level=1, **tags):
+        self.events.append(("counter", name, tags))
+
+    def histogram(self, name, value, *, level=1, **tags):
+        self.events.append(("histogram", name, tags))
+
+    def count(self, name: str) -> int:
+        return sum(1 for _, n, _t in self.events if n == name)
+
+
+@pytest.fixture
+def plan_guard():
+    """Restore whatever fault plan was armed before the test."""
+    prev = faults.install(None)
+    yield
+    faults.install(prev)
+
+
+# --- CRC32C ---------------------------------------------------------------
+
+
+def test_crc32c_known_answer():
+    """The RFC 3720 check value — proves this is real Castagnoli, not
+    zlib.crc32 wearing a trench coat."""
+    assert durable.crc32c(b"123456789") == 0xE3069283
+
+
+def test_crc32c_incremental_extend():
+    whole = durable.crc32c(b"123456789")
+    assert durable.crc32c(b"6789", durable.crc32c(b"12345")) == whole
+
+
+def test_crc32c_fallback_matches_accelerated(monkeypatch):
+    """The pure-python table must agree with google_crc32c byte for
+    byte — a checksum written by one implementation verifies under the
+    other."""
+    data = bytes(range(256)) * 17 + b"tail"
+    accelerated = durable.crc32c(data)
+    monkeypatch.setattr(durable, "_gcrc", None)
+    assert durable.crc32c(data) == accelerated
+    assert durable.crc32c(b"123456789") == 0xE3069283
+
+
+# --- checksummed envelope -------------------------------------------------
+
+
+def test_envelope_round_trip():
+    body = {"key": "abc", "n": 3, "files": {"a.npy": {"crc32c": 7}}}
+    assert durable.checksummed_loads(
+        durable.checksummed_dumps(body)) == body
+
+
+def test_envelope_tamper_reasons():
+    good = durable.checksummed_dumps({"x": 1})
+    with pytest.raises(StoreCorruption) as e:
+        durable.checksummed_loads(good.replace(b'"x": 1', b'"x": 2'))
+    assert e.value.reason == "crc_mismatch"
+    with pytest.raises(StoreCorruption) as e:
+        durable.checksummed_loads(b'{"plain": "json"}')
+    assert e.value.reason == "not_envelope"
+    with pytest.raises(StoreCorruption) as e:
+        durable.checksummed_loads(good[: len(good) // 2])
+    assert e.value.reason == "undecodable"
+
+
+def test_write_read_json_round_trip(tmp_path):
+    path = str(tmp_path / "m.json")
+    body = {"a": [1, 2], "b": "text"}
+    bus = _Bus()
+    durable.write_json(path, body, store="t", bus=bus)
+    assert durable.read_json(path, store="t") == body
+    assert bus.count("store.fsync_seconds") == 1
+    # absent is the caller's cache-miss path, not corruption
+    with pytest.raises(FileNotFoundError):
+        durable.read_json(str(tmp_path / "gone.json"), store="t")
+    # no tmp residue after a successful replace
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+
+def test_durable_write_failure_removes_tmp(tmp_path, plan_guard):
+    """An error mid-write must remove its tmp and leave the target's
+    previous contents untouched."""
+    path = str(tmp_path / "f.bin")
+    durable.durable_write(path, b"old", store="t", bus=_Bus())
+    faults.install(faults.FaultPlan([faults.FaultSpec(
+        site=durable.SITE_PRE_FSYNC, kind="error")]))
+    with pytest.raises(faults.InjectedFault):
+        durable.durable_write(path, b"new", store="t", bus=_Bus())
+    faults.install(None)
+    with open(path, "rb") as f:
+        assert f.read() == b"old"
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+
+# --- store locks ----------------------------------------------------------
+
+
+def test_store_lock_acquire_release_and_telemetry(tmp_path):
+    lock = str(tmp_path / ".lock")
+    bus = _Bus()
+    with StoreLock(lock, store="t", bus=bus):
+        pass
+    with StoreLock(lock, store="t", bus=bus):  # released → reacquirable
+        pass
+    assert bus.count("store.lock_wait_ms") == 2
+
+
+def test_store_lock_contention_times_out(tmp_path):
+    """flock conflicts between two open file descriptions even within
+    one process — the cheapest honest stand-in for a second writer."""
+    lock = str(tmp_path / ".lock")
+    with StoreLock(lock, store="t", bus=_Bus()):
+        with pytest.raises(StoreLockTimeout, match="wedged"):
+            with StoreLock(lock, store="t", timeout_s=0.05,
+                           poll_s=0.005, bus=_Bus()):
+                pass
+    # holder released: the next writer gets through
+    with StoreLock(lock, store="t", timeout_s=0.05, bus=_Bus()):
+        pass
+
+
+# --- EntryWriter: generation-dir commits ----------------------------------
+
+
+def test_entry_writer_commit_and_resolve(tmp_path):
+    root = str(tmp_path)
+    with EntryWriter(root, "k1", store="t", bus=_Bus()) as w:
+        w.put_bytes("blob.bin", b"payload")
+        w.put_text_lines("names.txt", ["a", "b"])
+        gen_dir = w.commit({"tag": "first"})
+    assert os.path.basename(gen_dir) == "k1@g1"
+    d, body = durable.resolve_entry(root, "k1", store="t")
+    assert d == gen_dir
+    assert body["meta"] == {"tag": "first"}
+    assert body["files"]["blob.bin"]["crc32c"] == durable.crc32c(
+        b"payload")
+    # recorded per-file CRCs verify against the committed bytes
+    for fn, rec in body["files"].items():
+        crc, n = durable.file_crc32c(os.path.join(d, fn))
+        assert (crc, n) == (rec["crc32c"], rec["bytes"]), fn
+
+
+def test_entry_writer_generation_bump_gcs_old(tmp_path):
+    root = str(tmp_path)
+    for tag in ("first", "second"):
+        with EntryWriter(root, "k1", store="t", bus=_Bus()) as w:
+            w.put_bytes("blob.bin", tag.encode())
+            w.commit({"tag": tag})
+    d, body = durable.resolve_entry(root, "k1", store="t")
+    assert body["generation"] == 2 and d.endswith("k1@g2")
+    assert not os.path.exists(os.path.join(root, "k1@g1"))
+
+
+def test_entry_writer_abort_on_exception_leaves_no_trace(tmp_path):
+    root = str(tmp_path)
+    with pytest.raises(RuntimeError, match="boom"):
+        with EntryWriter(root, "k1", store="t", bus=_Bus()) as w:
+            w.put_bytes("blob.bin", b"x")
+            raise RuntimeError("boom")
+    assert os.listdir(root) == []
+    assert durable.resolve_entry(root, "k1", store="t") is None
+
+
+def test_resolve_entry_corruption_reasons(tmp_path):
+    root = str(tmp_path)
+    durable.write_json(durable.manifest_path(root, "k1"),
+                       {"key": "k1", "dir": "elsewhere"}, store="t")
+    with pytest.raises(StoreCorruption) as e:
+        durable.resolve_entry(root, "k1", store="t")
+    assert e.value.reason == "bad_dir"
+    durable.write_json(durable.manifest_path(root, "k2"),
+                       {"key": "k2", "dir": "k2@g1"}, store="t")
+    with pytest.raises(StoreCorruption) as e:
+        durable.resolve_entry(root, "k2", store="t")
+    assert e.value.reason == "missing_generation"
+
+
+# --- the crash-injection matrix -------------------------------------------
+# (mode, site, nth, expected surviving state). Occurrences count from
+# the armed (NEW) write only — the writer re-installs a fresh plan.
+# Single durable_write (sidecar) fires each site once; a gen-dir commit
+# (arena/delta) or blob+manifest pair (aot) fires each site twice, the
+# SECOND occurrence being the manifest — the commit point. Kills before
+# the manifest rename must surface OLD; after it, NEW.
+
+KILL_CASES = [
+    ("aot", "pre_fsync", 1, "old"),       # mid blob write
+    ("aot", "post_fsync", 2, "old"),      # manifest synced, not live
+    ("aot", "pre_rename", 2, "old"),
+    ("aot", "post_rename", 2, "new"),     # manifest live, GC skipped
+    ("arena", "pre_fsync", 1, "old"),     # mid gen-dir fsync pass
+    ("arena", "post_fsync", 2, "old"),
+    ("arena", "pre_rename", 1, "old"),    # gen dir never renamed
+    ("arena", "post_rename", 2, "new"),
+    ("delta", "pre_fsync", 1, "old"),
+    ("delta", "post_fsync", 2, "old"),
+    ("delta", "pre_rename", 1, "old"),
+    ("delta", "post_rename", 2, "new"),
+    ("sidecar", "pre_fsync", 1, "old"),
+    ("sidecar", "post_fsync", 1, "old"),
+    ("sidecar", "pre_rename", 1, "old"),
+    ("sidecar", "post_rename", 1, "new"),
+    ("journal", "pre_fsync", 1, "old"),   # buffered line dies unflushed
+    ("journal", "post_fsync", 1, "new"),  # the fsync IS the commit
+]
+
+
+def _run_child(mode: str, root: str, out: str, *,
+               fault_plan: str | None = None,
+               wait: bool = True):
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop(faults.ENV_VAR, None)
+    if fault_plan is not None:
+        env[faults.ENV_VAR] = fault_plan
+    proc = subprocess.Popen(
+        [sys.executable, CHILD, mode, root, out],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    if not wait:
+        return proc
+    stdout, stderr = proc.communicate(timeout=180)
+    return proc.returncode, stdout.decode(), stderr.decode()
+
+
+def _load_snap(out: str, name: str) -> dict:
+    with open(os.path.join(out, name)) as f:
+        return json.load(f)
+
+
+def _scrub_mode(mode: str, root: str):
+    kw = {"aot": {"aot_dir": root}, "arena": {"arena_dir": root},
+          "delta": {"delta_dir": root}, "sidecar": {"checkpoint_dir": root},
+          "journal": {"journal": os.path.join(root, "journal.jsonl")}}
+    return scrub.scrub_all(bus=_Bus(), **kw[mode])
+
+
+@pytest.fixture(scope="module")
+def reference_run(tmp_path_factory):
+    """Per-mode (old, new) snapshots from one UNARMED child run. The
+    writer freezes clocks and pid, so a kill run's bytes are comparable
+    hash-for-hash."""
+    cache: dict[str, tuple[dict, dict]] = {}
+
+    def get(mode: str) -> tuple[dict, dict]:
+        if mode not in cache:
+            base = tmp_path_factory.mktemp(f"ref_{mode}")
+            root, out = str(base / "root"), str(base / "out")
+            rc, _so, se = _run_child(mode, root, out)
+            assert rc == 0, f"reference {mode} writer failed:\n{se}"
+            cache[mode] = (_load_snap(out, "old.json"),
+                           _load_snap(out, "new.json"))
+        return cache[mode]
+
+    return get
+
+
+@pytest.mark.parametrize("mode,site,nth,expect", KILL_CASES)
+def test_kill_matrix_old_or_new_never_a_third_thing(
+        mode, site, nth, expect, reference_run, tmp_path):
+    old, new = reference_run(mode)
+    assert old != new, "reference run must distinguish old from new"
+    root, out = str(tmp_path / "root"), str(tmp_path / "out")
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site=f"store.write.{site}", kind="kill", nth=(nth,))])
+    rc, _so, se = _run_child(mode, root, out, fault_plan=plan.to_json())
+    assert rc == 137, f"writer was not killed at {site}#{nth}:\n{se}"
+    survived = snapshot(root)
+    assert survived in (old, new), (
+        f"{mode} kill at {site}#{nth} left a THIRD state:\n"
+        f"{json.dumps(survived, indent=1, sort_keys=True)}")
+    assert survived == (new if expect == "new" else old)
+    # crash residue is orphans, never corruption: the reopened store
+    # scrubs CLEAN and stays bit-identical afterwards
+    reports, code = _scrub_mode(mode, root)
+    assert code == 0, reports
+    assert all(not r["corrupt"] for r in reports)
+
+
+def test_kill_leaves_loadable_sidecar_state(tmp_path):
+    """Beyond hashes: after a pre-commit kill the sidecar actually
+    LOADS as the old config (the reader-visible form of 'old')."""
+    root, out = str(tmp_path / "root"), str(tmp_path / "out")
+    plan = faults.FaultPlan([faults.FaultSpec(
+        site=durable.SITE_PRE_RENAME, kind="kill", nth=(1,))])
+    rc, _so, se = _run_child("sidecar", root, out,
+                             fault_plan=plan.to_json())
+    assert rc == 137, se
+    body = durable.read_json(os.path.join(root, "train_config.json"),
+                             store="checkpoint")
+    assert body["model"]["hidden_channels"] == ord("A")
+
+
+# --- concurrent writers ---------------------------------------------------
+
+
+def test_concurrent_aot_writers_one_winner_no_corruption(tmp_path):
+    """Two processes warm-save the same AOT entry at once: the store
+    lock serializes them, exactly one generation survives, the manifest
+    verifies, and the loser's subsequent warm load is bit-identical to
+    what it tried to save."""
+    import pickle
+
+    root, out = str(tmp_path / "root"), str(tmp_path / "out")
+    os.makedirs(out, exist_ok=True)
+    procs = [_run_child("race-aot", root, out, wait=False)
+             for _ in range(2)]
+    with open(os.path.join(out, "go"), "w") as f:
+        f.write("go")
+    for p in procs:
+        _stdout, stderr = p.communicate(timeout=180)
+        assert p.returncode == 0, stderr.decode()
+    slot = os.path.join(root, "prog")
+    blobs = [f for f in os.listdir(slot) if f.endswith(".bin")]
+    assert len(blobs) == 1, blobs  # exactly one winner generation
+    body = durable.read_json(os.path.join(slot, "cafe01.json"),
+                             store="aot")
+    assert body["blob"] == blobs[0]
+    with open(os.path.join(slot, blobs[0]), "rb") as f:
+        data = f.read()
+    assert durable.crc32c(data) == body["blob_crc32c"]
+    assert len(data) == body["blob_bytes"]
+    # both writers saved identical payloads — whoever lost the rename
+    # race warm-loads the winner's bytes and sees exactly its own
+    assert pickle.loads(data)["payload"] == b"R" * 2048
+    reports, code = scrub.scrub_all(aot_dir=root, bus=_Bus())
+    assert code == 0, reports
+
+
+# --- bit-rot: scrub detects, quarantines EXACTLY the corrupt entry --------
+
+
+def _flip_one_bit(path: str, offset: int = 100) -> None:
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0x04]))
+
+
+def test_scrub_dir_store_quarantines_exactly_the_corrupt_entry(tmp_path):
+    root = str(tmp_path)
+    for key in ("aaaa", "bbbb"):
+        with EntryWriter(root, key, store="arena", bus=_Bus()) as w:
+            w.put_bytes("arena_a.bin", key.encode() * 200)
+            w.commit({"key": key})
+    _flip_one_bit(os.path.join(root, "aaaa@g1", "arena_a.bin"))
+    reports, code = scrub.scrub_all(arena_dir=root, bus=_Bus())
+    assert code == 1
+    (r,) = reports
+    assert [c["entry"] for c in r["corrupt"]] == ["aaaa"]
+    assert r["corrupt"][0]["reason"] == "crc_mismatch"
+    # exactly the corrupt entry moved aside; evidence preserved
+    assert not os.path.exists(durable.manifest_path(root, "aaaa"))
+    assert not os.path.exists(os.path.join(root, "aaaa@g1"))
+    q = os.listdir(os.path.join(root, ".quarantine"))
+    assert any(n.startswith("aaaa.manifest.json.") for n in q)
+    assert any(n.startswith("aaaa@g1.") for n in q)
+    # the healthy entry is untouched and still verifies
+    d, body = durable.resolve_entry(root, "bbbb", store="arena")
+    crc, n = durable.file_crc32c(os.path.join(d, "arena_a.bin"))
+    assert crc == body["files"]["arena_a.bin"]["crc32c"]
+    # second scrub: the store is clean again
+    reports, code = scrub.scrub_all(arena_dir=root, bus=_Bus())
+    assert code == 0 and not reports[0]["corrupt"]
+
+
+def test_scrub_flipped_bit_other_entries_warm_load_zero_rebuilds(
+        preprocessed, tmp_path):
+    """The acceptance drill on the REAL arena store: flip one payload
+    bit in one entry; scrub quarantines exactly it; the other entry
+    keeps warm-loading with zero rebuilds."""
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.batching.arena_store import ArenaStore, arena_cache_key
+    from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                    ModelConfig)
+
+    def cfg(graph_type):
+        return Config(ingest=IngestConfig(min_traces_per_entry=10),
+                      data=DataConfig(max_traces=200, batch_size=16),
+                      model=ModelConfig(hidden_channels=8, num_layers=1),
+                      graph_type=graph_type)
+
+    fp = {"kind": "test", "seed": 7}
+    root = str(tmp_path / "arena")
+    store = ArenaStore(root)
+    for gt in ("pert", "span"):
+        store.load_or_build(cfg(gt), fp,
+                            lambda gt=gt: build_dataset(preprocessed,
+                                                        cfg(gt)))
+    victim_key, _ = arena_cache_key(cfg("pert"), fp)
+    healthy_key, _ = arena_cache_key(cfg("span"), fp)
+    _flip_one_bit(os.path.join(store._entry_dir(victim_key),
+                               "arena_ms_id.npy"))
+    bus = _Bus()
+    reports, code = scrub.scrub_all(arena_dir=root, bus=bus)
+    assert code == 1
+    assert [c["entry"] for c in reports[0]["corrupt"]] == [victim_key]
+    assert bus.count("store.quarantined") == 1
+    assert durable.resolve_entry(root, victim_key, store="arena") is None
+    # the healthy entry warm-loads — build_fn is unreachable
+
+    from pertgnn_tpu import telemetry
+
+    class _ArenaBus(telemetry.NoopBus):  # full bus surface for the store
+        def __init__(self):
+            self.events = []
+
+        def counter(self, name, value=1, *, level=1, **tags):
+            self.events.append(("counter", name, tags))
+
+        def count(self, name):
+            return sum(1 for _, n, _t in self.events if n == name)
+
+    warm_bus = _ArenaBus()
+    ds = ArenaStore(root, bus=warm_bus).load_or_build(
+        cfg("span"), fp, lambda: pytest.fail(
+            "healthy entry must warm-load with zero rebuilds"))
+    assert warm_bus.count("arena.cache_hit") == 1
+    assert warm_bus.count("arena.cache_miss") == 0
+    assert len(ds.splits["train"]) > 0
+    assert durable.resolve_entry(root, healthy_key,
+                                 store="arena") is not None
+
+
+def test_scrub_aot_quarantines_exactly_the_corrupt_blob(tmp_path):
+    """AOT layout built from the same primitives _save uses: a flipped
+    blob bit is caught by the manifest CRC before any unpickle."""
+    root = str(tmp_path)
+    slot = os.path.join(root, "prog")
+    for key, payload in (("aaaa", b"A" * 4096), ("bbbb", b"B" * 4096)):
+        blob = f"{key}@g1.bin"
+        durable.durable_write(os.path.join(slot, blob), payload,
+                              store="aot", bus=_Bus())
+        durable.write_json(
+            os.path.join(slot, f"{key}.json"),
+            {"key": key, "format": "stablehlo", "blob": blob,
+             "blob_crc32c": durable.crc32c(payload),
+             "blob_bytes": len(payload)}, store="aot", bus=_Bus())
+    _flip_one_bit(os.path.join(slot, "aaaa@g1.bin"), offset=2048)
+    reports, code = scrub.scrub_all(aot_dir=root, bus=_Bus())
+    assert code == 1
+    (r,) = reports
+    assert [c["entry"] for c in r["corrupt"]] == ["prog/aaaa"]
+    assert r["corrupt"][0]["reason"] == "crc_mismatch"
+    assert not os.path.exists(os.path.join(slot, "aaaa.json"))
+    assert os.path.exists(os.path.join(slot, "bbbb.json"))
+    # healthy blob still verifies; rescrub is clean
+    body = durable.read_json(os.path.join(slot, "bbbb.json"),
+                             store="aot")
+    crc, n = durable.file_crc32c(os.path.join(slot, body["blob"]))
+    assert (crc, n) == (body["blob_crc32c"], body["blob_bytes"])
+    reports, code = scrub.scrub_all(aot_dir=root, bus=_Bus())
+    assert code == 0
+
+
+def test_scrub_sweeps_orphan_generations_as_clean(tmp_path):
+    """A crashed writer's unreferenced generation and stale tmp dir are
+    residue: swept, counted, CLEAN — never 'corruption'."""
+    root = str(tmp_path)
+    with EntryWriter(root, "k1", store="arena", bus=_Bus()) as w:
+        w.put_bytes("a.bin", b"live")
+        w.commit({"key": "k1"})
+    os.makedirs(os.path.join(root, "k1@g7"))  # unreferenced generation
+    os.makedirs(os.path.join(root, ".tmp.k1.999"))
+    bus = _Bus()
+    reports, code = scrub.scrub_all(arena_dir=root, bus=bus)
+    assert code == 0
+    assert reports[0]["orphans_removed"] == 2
+    assert bus.count("store.scrub.orphans") == 1
+    assert not os.path.exists(os.path.join(root, "k1@g7"))
+    assert durable.resolve_entry(root, "k1", store="arena") is not None
+
+
+# --- journal record CRCs --------------------------------------------------
+
+
+def test_journal_interior_bit_rot_skipped_loudly(tmp_path, caplog):
+    from pertgnn_tpu.telemetry.capture import CaptureJournal
+
+    path = str(tmp_path / "journal.jsonl")
+    j = CaptureJournal(path)
+    for step in (1, 2, 3):
+        j.stage("probe", "done", step=step)
+    with open(path) as f:
+        lines = f.read().splitlines()
+    # tamper a FIELD VALUE of the middle record: still valid JSON and
+    # schema, only the record CRC can catch it
+    assert '"step": 2' in lines[1]
+    lines[1] = lines[1].replace('"step": 2', '"step": 20')
+    with open(path, "w") as f:  # graftlint: allow-durable-write
+        f.write("\n".join(lines) + "\n")
+    import logging
+    logging.getLogger("pertgnn_tpu").propagate = True
+    with caplog.at_level(logging.WARNING, logger="pertgnn_tpu"):
+        recs = CaptureJournal(path).records()
+    assert [r["fields"]["step"] for r in recs] == [1, 3]
+    assert any("crc mismatch" in r.message for r in caplog.records)
+    report = scrub.scrub_journal(path)
+    assert [c["entry"] for c in report["corrupt"]] == ["line 2"]
+
+
+def test_journal_torn_tail_is_clean_crash_residue(tmp_path):
+    from pertgnn_tpu.telemetry.capture import CaptureJournal
+
+    path = str(tmp_path / "journal.jsonl")
+    j = CaptureJournal(path)
+    j.stage("probe", "done", step=1)
+    with open(path, "ab") as f:  # graftlint: allow-durable-write
+        f.write(b'{"v": 2, "t": 1.0, "torn half of a rec')
+    assert len(CaptureJournal(path).records()) == 1
+    report = scrub.scrub_journal(path)
+    assert report["torn_tail"] == 1 and not report["corrupt"]
+    reports, code = scrub.scrub_all(journal=path, bus=_Bus())
+    assert code == 0
+
+
+# --- scrub CLI ------------------------------------------------------------
+
+
+def test_scrub_cli_exit_codes_and_report(tmp_path, capsys):
+    root = str(tmp_path)
+    with EntryWriter(root, "k1", store="arena", bus=_Bus()) as w:
+        w.put_bytes("a.bin", b"payload" * 100)
+        w.commit({"key": "k1"})
+    assert scrub.main(["--arena_dir", root]) == 0
+    assert "CLEAN" in capsys.readouterr().out
+    _flip_one_bit(os.path.join(root, "k1@g1", "a.bin"))
+    assert scrub.main(["--arena_dir", root, "--dry_run"]) == 1
+    out = capsys.readouterr().out
+    assert "would quarantine" in out and "CORRUPTION FOUND" in out
+    # dry run touched nothing
+    assert os.path.exists(durable.manifest_path(root, "k1"))
+    assert scrub.main(["--arena_dir", root, "--json"]) == 1
+    assert json.loads(capsys.readouterr().out)["clean"] is False
+    with pytest.raises(SystemExit):  # nothing to scrub = usage error
+        scrub.main([])

@@ -179,7 +179,11 @@ def test_predict_warns_not_walls_on_sidecar_unknown_field(tmp_path,
               "--checkpoint_dir", str(ckpt)]
     train_main.main([*common, "--epochs", "1"])
     sidecar = ckpt / "train_config.json"
-    d = json.loads(sidecar.read_text())
+    # unwrap the graftvault envelope, drop the field, write back as
+    # PLAIN json — simulating an older (pre-graftvault, pre-field)
+    # sidecar, which also exercises the legacy-format load fallback
+    from pertgnn_tpu.store import durable
+    d = durable.read_json(str(sidecar), store="checkpoint")
     del d["model"]["hidden_channels"]  # simulate an older sidecar
     sidecar.write_text(json.dumps(d))
     logging.getLogger("pertgnn_tpu").propagate = True

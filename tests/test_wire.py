@@ -239,6 +239,36 @@ def test_ring_torn_write_detected(ring):
         ring.try_pop()
 
 
+def test_ring_payload_bit_rot_detected(ring):
+    """A flipped payload BIT in shared memory (bad DIMM, a stray write
+    from a buggy peer) passes every stamp check — only the per-frame
+    CRC32C can catch it. Consume must reject the frame, not deliver
+    silently corrupt bytes to the batcher."""
+    from pertgnn_tpu import telemetry
+
+    class _CountingBus(telemetry.NoopBus):
+        def __init__(self):
+            self.counts: dict[str, int] = {}
+
+        def counter(self, name, value=1, *, level=1, **tags):
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    assert ring.try_push(b"payload-under-test")
+    # first frame is seq 1; its payload starts after the slot header
+    payload_off = ring._slot_off(1) + shmring._SLOT_HDR
+    ring._shm.buf[payload_off + 3] ^= 0x10
+    bus = _CountingBus()
+    prev = telemetry.set_bus(bus)
+    try:
+        with pytest.raises(shmring.RingTornWrite) as ei:
+            ring.try_pop()
+    finally:
+        telemetry.set_bus(prev)
+    assert getattr(ei.value, "crc_mismatch", False)
+    assert "crc" in str(ei.value)
+    assert bus.counts.get("transport.crc_rejects") == 1
+
+
 def test_ring_attach_version_skew_refused():
     r = shmring.ShmRing.create(slots=2, slot_bytes=64)
     try:

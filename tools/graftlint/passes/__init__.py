@@ -3,12 +3,12 @@ first (pass 0, the historical lint), then the five PR-8 passes."""
 
 from __future__ import annotations
 
-from tools.graftlint.passes import (aot_keys, excepts, flag_config,
-                                    lock_discipline, telemetry_drift,
-                                    trace_hazard)
+from tools.graftlint.passes import (aot_keys, durable_write, excepts,
+                                    flag_config, lock_discipline,
+                                    telemetry_drift, trace_hazard)
 
 _ORDER = (excepts, aot_keys, trace_hazard, telemetry_drift,
-          lock_discipline, flag_config)
+          lock_discipline, flag_config, durable_write)
 
 # short aliases accepted on the CLI next to the canonical RULE names
 ALIASES = {
@@ -17,6 +17,7 @@ ALIASES = {
     "telemetry": telemetry_drift,
     "locks": lock_discipline, "lock": lock_discipline,
     "flags": flag_config, "flag": flag_config,
+    "durable": durable_write, "vault": durable_write,
 }
 
 

@@ -1,0 +1,109 @@
+"""graftlint pass — durable-write: store modules must write through
+the graftvault protocol (pertgnn_tpu/store/durable.py), never raw.
+Bug-class provenance: ISSUE 19's audit found every store hand-rolling
+its own atomicity — the arena/delta stores' double-``os.replace``
+backup dance had a crash window where the live entry was GONE, the
+AOT store's meta/blob pair could commit half, and nothing anywhere
+fsync'd, so "atomic" rename could still surface empty files after a
+power cut. The durable helper is the one place that sequence is
+right (tmp → fsync → replace → dir fsync, checksummed manifest);
+this pass keeps raw write primitives from creeping back in.
+
+Static model (per file, lexical):
+
+- in the store modules (SCOPE below), these calls are violations:
+  ``os.replace``/``os.rename`` (a rename outside the protocol is an
+  unfsync'd commit), ``np.save``/``numpy.save`` (bypasses the CRC
+  manifest — use ``EntryWriter.put_array``), and ``open(...)`` with a
+  writing mode (``w``/``a``/``x``, str-constant positional or
+  ``mode=`` kwarg — use ``durable_write``/``write_json``/
+  ``append_line``);
+- reads (``open`` with no mode or an ``r``-only mode, ``np.load``)
+  are untouched: the protocol makes every read see a complete old or
+  new state without locks;
+- the protocol's own primitives (durable.py), the scrub tool's
+  quarantine rename, and the watchdog's crash-dump side channel are
+  exactly the reviewed exceptions — each carries a line pragma
+  ``# graftlint: allow-durable-write`` stating why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.driver import Violation
+from tools.graftlint.passes._ast_util import attr_chain
+
+RULE = "durable-write"
+# per-file findings: sound on any file subset (--changed-only)
+PASS_SCOPE = "file"
+
+# every module that writes store/journal state — the durable protocol's
+# home included (its raw primitives are the pragma'd exceptions)
+SCOPE = ("pertgnn_tpu/store/",
+         "pertgnn_tpu/aot/store.py",
+         "pertgnn_tpu/batching/arena_store.py",
+         "pertgnn_tpu/stream/store.py",
+         "pertgnn_tpu/train/checkpoint.py",
+         "pertgnn_tpu/telemetry/capture.py")
+
+_RENAMES = {"replace", "rename"}
+_WRITE_MODE_CHARS = set("wax+")
+
+
+def _open_write_mode(call: ast.Call) -> str | None:
+    """The mode string when this ``open`` call writes, else None.
+    A non-constant mode counts as writing: the pass cannot prove it
+    reads, and a dynamic mode in a store module deserves a look."""
+    mode: ast.expr | None = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return None  # bare open() reads
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value if set(mode.value) & _WRITE_MODE_CHARS else None
+    return "<dynamic>"
+
+
+def run(ctx) -> list[Violation]:
+    out: list[Violation] = []
+    for rel in ctx.files_under(*SCOPE):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ch = attr_chain(node.func) or []
+            if len(ch) == 2 and ch[0] == "os" and ch[1] in _RENAMES:
+                out.append(Violation(
+                    rule=RULE, path=rel, line=node.lineno,
+                    message=(f"raw os.{ch[1]} in a store module — a "
+                             f"rename outside store/durable.py is an "
+                             f"unfsync'd commit with no checksum; use "
+                             f"durable_write/write_json/EntryWriter, "
+                             f"or pragma the reviewed exception"),
+                    key=f"os.{ch[1]}"))
+            elif (len(ch) == 2 and ch[0] in ("np", "numpy")
+                    and ch[1] == "save"):
+                out.append(Violation(
+                    rule=RULE, path=rel, line=node.lineno,
+                    message=("raw np.save in a store module bypasses "
+                             "the CRC manifest — use "
+                             "EntryWriter.put_array"),
+                    key="np.save"))
+            elif ch == ["open"]:
+                mode = _open_write_mode(node)
+                if mode is not None:
+                    out.append(Violation(
+                        rule=RULE, path=rel, line=node.lineno,
+                        message=(f"raw open(..., {mode!r}) in a store "
+                                 f"module — writes go through "
+                                 f"durable_write/append_line (tmp → "
+                                 f"fsync → replace → dir fsync), or "
+                                 f"pragma the reviewed exception"),
+                        key=f"open:{mode}"))
+    return out
