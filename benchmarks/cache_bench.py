@@ -1,0 +1,556 @@
+"""graftmemo benchmark: the read-mostly path, gated end to end.
+
+ONE run exit-code-asserts every ISSUE-20 acceptance criterion
+(pertgnn_tpu/fleet/memo.py, lens/canon.py, fleet/search.py; docs/GUIDE
+§17); CI runs --dryrun on every push:
+
+1. **Hit ratio + bit-identity** — a Zipf replay (the real loadgen
+   arrival law) over a small hot population through a memo'd
+   FleetRouter on the BINARY transport, with aggressive hedging, lands
+   a cache hit ratio >= 0.5; EVERY served prediction — misses, hits,
+   hedge winners, and what-if variants (including a pair of equivalent
+   edit scripts that must share one cache entry via the canonical
+   form) — is bit-identical to the uncached single-process engine
+   reference.
+2. **Zero stale reads across a LIVE blue/green rollout** — traffic
+   keeps flowing while a RolloutController swaps the fleet from the v1
+   to the v2 checkpoint: the memo's hit counter is FROZEN from the
+   retire (drain start) until after the new generation installs (old-
+   generation hits drop to zero at the flip, by construction), every
+   answer served at any point is bit-identical to v1 or v2 (never a
+   blend), answers resolved after the install match v2 only, and the
+   post-flip warm cache serves v2 bits.
+3. **Cached-hit p50 < uncached binary-transport p50** — the same
+   requests through the same router, hit vs miss pass.
+4. **Counterfactual search** — fleet/search.py over the hot entry
+   returns the argmin of everything it evaluated, with ZERO fresh
+   engine compiles across the whole search and memo misses bounded by
+   the unique-canonical-request count (the search dedups by the memo's
+   own key).
+
+Run off-TPU it auto-falls back to CPU like the sibling benches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# The quantile head the whole bench serves: the search minimizes the
+# LAST column, so 0.99 makes "minimize predicted p99" literal.
+MEMO_TAUS = (0.5, 0.99)
+HIT_RATIO_FLOOR = 0.5
+
+
+def build_corpus(traces_per_entry: int, seed: int = 42):
+    from pertgnn_tpu.ingest import synthetic
+
+    return synthetic.generate(synthetic.SyntheticSpec(
+        num_microservices=60, num_entries=12, patterns_per_entry=3,
+        pattern_size_range=(3, 24), traces_per_entry=traces_per_entry,
+        seed=seed))
+
+
+def memo_config(epochs: int):
+    from pertgnn_tpu.config import (Config, DataConfig, IngestConfig,
+                                    ModelConfig, ServeConfig,
+                                    TrainConfig)
+
+    return Config(
+        ingest=IngestConfig(min_traces_per_entry=5),
+        data=DataConfig(max_traces=100_000, batch_size=64),
+        model=ModelConfig(hidden_channels=32, num_layers=2,
+                          quantile_taus=MEMO_TAUS),
+        train=TrainConfig(label_scale=1000.0, epochs=epochs, lr=1e-3),
+        serve=ServeConfig(bucket_growth=2.0, max_graphs_per_batch=8),
+        graph_type="pert",
+    )
+
+
+def _population(ds, n: int):
+    """The hot request population: the first `n` DISTINCT
+    (entry, ts_bucket) pairs of the test split."""
+    s = ds.splits["test"]
+    seen, pop = set(), []
+    for e, t in zip(s.entry_ids, s.ts_buckets):
+        key = (int(e), int(t))
+        if key not in seen:
+            seen.add(key)
+            pop.append(key)
+        if len(pop) >= n:
+            break
+    return pop
+
+
+def _ckey(edits):
+    """The hashable reference-map key for an edit script — the memo's
+    own canonical lens key, so equivalent scripts share one row."""
+    from pertgnn_tpu.lens.canon import canonical_lens_key
+
+    if not edits:
+        return None
+    return canonical_lens_key({"edits": [dict(e) for e in edits]})
+
+
+def _reference(queue, pop, whatif_rows) -> dict:
+    """The uncached engine answers through the single-process front
+    door (proven bit-identical to direct engine dispatch by
+    lens_bench), keyed by (entry, bucket, canonical-edits-or-None)."""
+    from pertgnn_tpu.lens.request import LensRequest
+
+    ref = {}
+    for eid, tsb in pop:
+        ref[(eid, tsb, None)] = np.asarray(
+            queue.submit(eid, tsb).result(300), np.float32)
+    for eid, tsb, edits in whatif_rows:
+        ref[(eid, tsb, _ckey(edits))] = np.asarray(
+            queue.submit(eid, tsb,
+                         lens=LensRequest(edits=edits)).result(300),
+            np.float32)
+    return ref
+
+
+def _equiv_scripts(mix):
+    """Two syntactically different, canonically EQUAL edit scripts
+    (both drop original edges {0, 1}) — they must share one memo
+    entry."""
+    a = ({"op": "drop_edge", "edge": 0}, {"op": "drop_edge", "edge": 0})
+    b = ({"op": "drop_edge", "edge": 1}, {"op": "drop_edge", "edge": 0})
+    return (a, b) if mix.num_edges >= 2 else (None, None)
+
+
+def gate_read_mostly(ds, cfg, engine, args) -> dict:
+    """Criteria 1 + 3 + 4: hit ratio, bit-identity (hedge winners and
+    what-if variants included), hit-vs-miss p50, and the counterfactual
+    search — all against ONE memo'd two-worker binary fleet."""
+    from pertgnn_tpu.config import FleetConfig
+    from pertgnn_tpu.fleet import loadgen
+    from pertgnn_tpu.fleet.router import FleetRouter
+    from pertgnn_tpu.fleet.search import CounterfactualSearch, SearchSpec
+    from pertgnn_tpu.fleet.transport import WorkerServer
+    from pertgnn_tpu.lens.request import LensRequest
+    from pertgnn_tpu.serve.buckets import make_bucket_ladder
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+    pop = _population(ds, 12 if args.dryrun else 24)
+    whatif_rows = []
+    equiv_pairs = []
+    for eid, tsb in pop:
+        a, b = _equiv_scripts(ds.mixtures[eid])
+        if a is not None:
+            # the reference is keyed by script A; script B must HIT A's
+            # cache entry (canonical equality) and match A's bits
+            whatif_rows.append((eid, tsb, a))
+            equiv_pairs.append((eid, tsb, a, b))
+    top = make_bucket_ladder(ds.budget, cfg.serve)[-1]
+
+    def size(eid):
+        m = ds.mixtures[int(eid)]
+        return m.num_nodes, m.num_edges
+
+    record: dict = {}
+    queue = MicrobatchQueue(engine)
+    servers = []
+    try:
+        ref = _reference(queue, pop, whatif_rows)
+        # two wire surfaces over ONE queue/engine (the test_fleet
+        # hedging pattern): hedged legs go to distinct workers, answers
+        # are identical by determinism — the hedge-winner bits are
+        # checked against the same reference as everything else
+        servers = [WorkerServer(engine, queue), WorkerServer(engine, queue)]
+        urls = {f"w{i}": f"http://127.0.0.1:{s.port}"
+                for i, s in enumerate(servers)}
+        fleet_cfg = FleetConfig(
+            transport="binary", memo_capacity_bytes=1 << 20,
+            hedge_quantile_ms=0.05, health_poll_interval_s=0.2)
+        with FleetRouter(urls, size,
+                         (top.max_graphs, top.max_nodes, top.max_edges),
+                         cfg=fleet_cfg) as router:
+            memo = router.memo
+            memo.set_generation(checkpoint_epoch=0,
+                                arena_fingerprint="bench-v1",
+                                taus=MEMO_TAUS)
+
+            def ask(eid, tsb, edits=None):
+                lens = (LensRequest(edits=edits) if edits else None)
+                t0 = time.perf_counter()
+                got = np.asarray(
+                    router.submit(eid, tsb, lens=lens).result(300),
+                    np.float32)
+                dt = time.perf_counter() - t0
+                want = ref[(eid, tsb, _ckey(edits))]
+                if not np.array_equal(got, want):
+                    raise AssertionError(
+                        f"served bits diverged from the uncached "
+                        f"reference for entry {eid} bucket {tsb} "
+                        f"edits {edits}: {got} != {want}")
+                return dt
+
+            # -- criterion 3 setup: miss pass, then hit pass ------------
+            miss_lat = [ask(e, t) for e, t in pop]
+            miss_lat += [ask(e, t, a) for e, t, a, _b in equiv_pairs]
+            hits0 = memo.hits
+            hit_lat = [ask(e, t) for e, t in pop]
+            # the EQUIVALENT script B must hit A's entry, bit-identical
+            # to A's reference
+            hit_lat += [ask(e, t, b) for e, t, _a, b in equiv_pairs]
+            n_hit_pass = len(pop) + len(equiv_pairs)
+            if memo.hits - hits0 != n_hit_pass:
+                raise AssertionError(
+                    f"hit pass expected {n_hit_pass} hits, got "
+                    f"{memo.hits - hits0} — keying or canon broke")
+            p50_miss = float(np.percentile(miss_lat, 50) * 1e3)
+            p50_hit = float(np.percentile(hit_lat, 50) * 1e3)
+            if p50_hit >= p50_miss:
+                raise AssertionError(
+                    f"cached-hit p50 {p50_hit:.3f}ms is not under the "
+                    f"uncached binary-transport p50 {p50_miss:.3f}ms")
+
+            # -- criterion 1: open-loop Zipf replay over the warm cache -
+            entries = np.asarray([e for e, _t in pop], np.int64)
+            buckets = np.asarray([t for _e, t in pop], np.int64)
+            spec = loadgen.LoadSpec(
+                duration_s=1.0 if args.dryrun else 3.0,
+                base_rps=150.0, zipf_s=1.1, seed=7)
+            schedule = loadgen.generate_schedule(spec, entries, buckets)
+            result = loadgen.replay(router.submit, schedule,
+                                    vector_width=len(MEMO_TAUS))
+            served = result.served_mask()
+            if result.lost_futures() or not served.all():
+                raise AssertionError(
+                    f"replay lost futures={result.lost_futures()} "
+                    f"unserved={int((~served).sum())} "
+                    f"errors={result.error_counts()}")
+            for i in range(len(schedule)):
+                want = ref[(int(schedule.entry_ids[i]),
+                            int(schedule.ts_buckets[i]), None)]
+                if not np.array_equal(result.preds[i], want):
+                    raise AssertionError(
+                        f"replay row {i} diverged from the uncached "
+                        f"reference: {result.preds[i]} != {want}")
+            hit_ratio = memo.hits / max(memo.hits + memo.misses, 1)
+            if hit_ratio < HIT_RATIO_FLOOR:
+                raise AssertionError(
+                    f"hit ratio {hit_ratio:.3f} under the "
+                    f"{HIT_RATIO_FLOOR} floor "
+                    f"({memo.hits} hits / {memo.misses} misses)")
+
+            # -- criterion 4: counterfactual search ---------------------
+            hot_eid, hot_tsb = pop[0]
+            mix = ds.mixtures[hot_eid]
+            compiles0 = engine.compiles
+            misses0 = memo.misses
+            search = CounterfactualSearch(router.submit, SearchSpec(
+                entry_id=hot_eid, ts_bucket=hot_tsb,
+                num_nodes=int(mix.num_nodes),
+                num_edges=int(mix.num_edges),
+                beam_width=3, max_depth=2,
+                budget=48 if args.dryrun else 96,
+                sub_ms_ids=tuple(int(m) for m in
+                                 np.unique(np.asarray(mix.ms_id))[:3]),
+                max_drop_candidates=6, max_sub_nodes=2))
+            sres = search.run()
+            if engine.compiles != compiles0:
+                raise AssertionError(
+                    f"search compiled: {compiles0} -> "
+                    f"{engine.compiles} — the zero-fresh-compile "
+                    f"construction broke")
+            best_seen = min(o for _e, o in sres.evaluated)
+            if sres.best_objective != best_seen:
+                raise AssertionError(
+                    f"search best {sres.best_objective} is not the "
+                    f"argmin of its evaluated set ({best_seen})")
+            search_misses = memo.misses - misses0
+            # the search dedups by the memo's own canonical key, so its
+            # submissions ARE its unique-canonical-request count
+            if search_misses > sres.requests:
+                raise AssertionError(
+                    f"search drove {search_misses} memo misses for "
+                    f"{sres.requests} unique canonical requests")
+            router_stats = router.stats_dict()
+            if router_stats["hedge_fired"] == 0:
+                raise AssertionError(
+                    "no hedge ever fired — the hedge-winner "
+                    "bit-identity claim would be vacuous")
+            record.update({
+                "population": len(pop),
+                "whatif_variants": len(equiv_pairs) * 2,
+                "hit_ratio": round(float(hit_ratio), 4),
+                "replay_arrivals": int(len(schedule)),
+                "p50_uncached_ms": round(p50_miss, 3),
+                "p50_cached_ms": round(p50_hit, 3),
+                "hedge_fired": router_stats["hedge_fired"],
+                "hedge_won": router_stats["hedge_won"],
+                "memo": memo.stats_dict(),
+                "search": sres.to_dict(),
+                "search_memo_misses": int(search_misses),
+            })
+    finally:
+        queue.close()
+        for s in servers:
+            s.close()
+    return record
+
+
+def gate_live_rollout(ds, cfg, engine_v1, engine_v2, v2_epoch,
+                      args) -> dict:
+    """Criterion 2: live traffic across a real blue/green rollout —
+    the memo's hits freeze at the retire and stay frozen until the new
+    generation installs; no served byte is ever stale."""
+    from pertgnn_tpu.config import FleetConfig
+    from pertgnn_tpu.fleet.rollout import RolloutController, RolloutWorker
+    from pertgnn_tpu.fleet.router import FleetRouter
+    from pertgnn_tpu.fleet.transport import WorkerServer
+    from pertgnn_tpu.serve.buckets import make_bucket_ladder
+    from pertgnn_tpu.serve.errors import ServeError
+    from pertgnn_tpu.serve.queue import MicrobatchQueue
+
+    pop = _population(ds, 8)
+    # uncached references for BOTH checkpoint versions, computed before
+    # any fleet exists (the queues close again immediately)
+    with MicrobatchQueue(engine_v1) as q:
+        ref1 = _reference(q, pop, [])
+    with MicrobatchQueue(engine_v2) as q:
+        ref2 = _reference(q, pop, [])
+    differs = [k for k in ref1 if not np.array_equal(ref1[k], ref2[k])]
+    if not differs:
+        raise AssertionError(
+            "v1 and v2 answer identically on every population row — "
+            "the stale-read gate cannot distinguish the versions")
+
+    top = make_bucket_ladder(ds.budget, cfg.serve)[-1]
+
+    def size(eid):
+        m = ds.mixtures[int(eid)]
+        return m.num_nodes, m.num_edges
+
+    slot = {"queue": MicrobatchQueue(engine_v1)}
+    slot["server"] = WorkerServer(
+        engine_v1, slot["queue"],
+        extra_fn=lambda: {"checkpoint_epoch": 0})
+    port = slot["server"].port
+    url = f"http://127.0.0.1:{port}"
+    marks: dict = {}
+
+    def stop_worker(_w):
+        # drain: retire already ran (the controller flips first) — pin
+        # the hit counter HERE; it must not move again until install
+        marks["hits_at_drain"] = memo.hits
+        slot["server"].close()
+        slot["queue"].close()
+
+    def _spawn(engine, epoch):
+        slot["queue"] = MicrobatchQueue(engine)
+        slot["server"] = WorkerServer(
+            engine, slot["queue"], port=port,
+            extra_fn=lambda: {"checkpoint_epoch": epoch})
+        return slot["server"]
+
+    fleet_cfg = FleetConfig(transport="binary",
+                            memo_capacity_bytes=1 << 20,
+                            health_poll_interval_s=0.1)
+    record: dict = {}
+    outcomes: list = []          # (t_resolved, key, pred or None)
+    stop = threading.Event()
+    try:
+        with FleetRouter({"w1": url}, size,
+                         (top.max_graphs, top.max_nodes, top.max_edges),
+                         cfg=fleet_cfg) as router:
+            memo = router.memo
+            memo.set_generation(checkpoint_epoch=0,
+                                arena_fingerprint="bench-arena",
+                                taus=MEMO_TAUS)
+            # warm: every row cached and bit-identical to v1
+            for eid, tsb in pop:
+                router.submit(eid, tsb).result(300)
+            for eid, tsb in pop:
+                got = np.asarray(router.submit(eid, tsb).result(300),
+                                 np.float32)
+                if not np.array_equal(got, ref1[(eid, tsb, None)]):
+                    raise AssertionError(
+                        f"pre-rollout cached answer diverged from v1 "
+                        f"for {(eid, tsb)}")
+            if memo.hits < len(pop):
+                raise AssertionError("warm cache never hit")
+
+            def traffic():
+                i = 0
+                while not stop.is_set():
+                    eid, tsb = pop[i % len(pop)]
+                    i += 1
+                    try:
+                        fut = router.submit(eid, tsb)
+                        pred = np.asarray(fut.result(60), np.float32)
+                    except ServeError:
+                        # availability wobble mid-swap is allowed; only
+                        # WRONG BYTES fail this gate
+                        time.sleep(0.02)
+                        continue
+                    except Exception as exc:
+                        # anything NOT a typed serve error mid-swap is
+                        # unexpected: tolerated for availability (the
+                        # gate is about bytes), but never silent
+                        print(f"cache_bench: live-traffic stray error: "
+                              f"{type(exc).__name__}: {exc}")
+                        time.sleep(0.02)
+                        continue
+                    outcomes.append((time.perf_counter(),
+                                     (eid, tsb, None), pred))
+                    time.sleep(0.01)
+
+            th = threading.Thread(target=traffic, name="live-traffic")
+            th.start()
+            controller = RolloutController(
+                [RolloutWorker("w1", url, handle=slot["server"])],
+                stop_worker=stop_worker,
+                spawn_new=lambda w: _spawn(engine_v2, v2_epoch),
+                spawn_old=lambda w: _spawn(engine_v1, 0),
+                verify=lambda body: (
+                    None if body.get("checkpoint_epoch") == v2_epoch
+                    else f"checkpoint_epoch {body.get('checkpoint_epoch')}"
+                         f", wanted {v2_epoch}"),
+                ready_timeout_s=120.0, poll_interval_s=0.1,
+                memo=memo,
+                new_generation=dict(checkpoint_epoch=v2_epoch,
+                                    arena_fingerprint="bench-arena",
+                                    taus=MEMO_TAUS))
+            summary = controller.run()
+            t_install = time.perf_counter()
+            hits_at_install = memo.hits
+            # let post-flip traffic flow, then stop the injector
+            time.sleep(0.5)
+            stop.set()
+            th.join(timeout=60)
+
+            # the flip froze the hit counter for the WHOLE window
+            if hits_at_install != marks["hits_at_drain"]:
+                raise AssertionError(
+                    f"{hits_at_install - marks['hits_at_drain']} cache "
+                    f"hits were served mid-rollout — stale reads")
+            # every live answer is bit-identical to v1 or v2; answers
+            # resolved after the install are v2 only
+            n_v1 = n_v2 = 0
+            for t_res, key, pred in outcomes:
+                is1 = np.array_equal(pred, ref1[key])
+                is2 = np.array_equal(pred, ref2[key])
+                if not (is1 or is2):
+                    raise AssertionError(
+                        f"live answer for {key} matches NEITHER "
+                        f"checkpoint version: {pred}")
+                if t_res > t_install and not is2:
+                    raise AssertionError(
+                        f"answer for {key} resolved after the "
+                        f"generation install but carries v1 bits")
+                n_v1 += is1 and not is2
+                n_v2 += is2
+            # post-flip: the cache re-warms with v2 bits
+            hits0 = memo.hits
+            for eid, tsb in pop:
+                router.submit(eid, tsb).result(300)
+            for eid, tsb in pop:
+                got = np.asarray(router.submit(eid, tsb).result(300),
+                                 np.float32)
+                if not np.array_equal(got, ref2[(eid, tsb, None)]):
+                    raise AssertionError(
+                        f"post-rollout cached answer diverged from v2 "
+                        f"for {(eid, tsb)}")
+            if memo.hits <= hits0:
+                raise AssertionError("post-flip cache never re-warmed")
+            gen = memo.stats_dict()["generation"]
+            if gen is None or gen["checkpoint_epoch"] != v2_epoch:
+                raise AssertionError(
+                    f"post-rollout generation is {gen}, wanted epoch "
+                    f"{v2_epoch}")
+            record.update({
+                "rollout": summary,
+                "live_answers": len(outcomes),
+                "live_v1_answers": int(n_v1),
+                "live_v2_answers": int(n_v2),
+                "rows_where_versions_differ": len(differs),
+                "hits_frozen_through_window": True,
+                "rollout_memo": memo.stats_dict(),
+            })
+    finally:
+        stop.set()
+        import contextlib
+        with contextlib.suppress(Exception):
+            slot["server"].close()
+        with contextlib.suppress(Exception):
+            slot["queue"].close()
+    return record
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dryrun", action="store_true",
+                    help="CI scale: small corpus, short fine-tune")
+    ap.add_argument("--traces_per_entry", type=int, default=0,
+                    help="0 = per-mode default")
+    ap.add_argument("--epochs", type=int, default=0,
+                    help="0 = per-mode default")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON record here")
+    args = ap.parse_args()
+
+    from pertgnn_tpu.cli.common import (apply_platform_env,
+                                        probe_backend_or_fallback)
+    fallback = probe_backend_or_fallback()
+    apply_platform_env()
+
+    import jax
+
+    from pertgnn_tpu.batching import build_dataset
+    from pertgnn_tpu.ingest.preprocess import preprocess
+    from pertgnn_tpu.serve.engine import InferenceEngine
+    from pertgnn_tpu.train.loop import fit, restore_target_state
+
+    traces = args.traces_per_entry or (60 if args.dryrun else 300)
+    epochs = args.epochs or (3 if args.dryrun else 10)
+
+    t0 = time.perf_counter()
+    corpus = build_corpus(traces)
+    cfg = memo_config(epochs)
+    pre = preprocess(corpus.spans, corpus.resources, cfg.ingest)
+    ds = build_dataset(pre, cfg)
+    # v1 = the fresh-init checkpoint, v2 = the trained one: two real,
+    # distinct, deterministic engines for the rollout gate
+    _model, state_v1 = restore_target_state(ds, cfg)
+    state_v2, _history = fit(ds, cfg)
+    engine_v1 = InferenceEngine.from_dataset(ds, cfg, state_v1).warmup()
+    engine_v2 = InferenceEngine.from_dataset(ds, cfg, state_v2).warmup()
+
+    record = {
+        "metric": "pert_memo_gates",
+        "value": 1.0,
+        "unit": "pass",
+        "taus": list(MEMO_TAUS),
+        "dryrun": bool(args.dryrun),
+    }
+    record.update(gate_read_mostly(ds, cfg, engine_v1, args))
+    record.update(gate_live_rollout(ds, cfg, engine_v1, engine_v2,
+                                    epochs, args))
+
+    record["backend"] = jax.default_backend()
+    record["backend_fallback"] = fallback
+    record["total_s"] = time.perf_counter() - t0
+    record["captured_unix_time"] = time.time()
+    out = json.dumps(record)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -505,6 +505,12 @@ def add_fleet_flags(p: argparse.ArgumentParser) -> None:
                    help="payload budget per ring slot; an oversize "
                         "frame falls back to HTTP for that call "
                         "(counter transport.fallback)")
+    p.add_argument("--memo_capacity_bytes", type=int,
+                   default=FleetConfig.memo_capacity_bytes,
+                   help="router prediction-memo byte budget "
+                        "(fleet/memo.py: content-keyed LRU over "
+                        "wire-encoded rows, retired atomically at a "
+                        "rollout flip; counters memo.*); 0 = memo off")
 
 
 def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
@@ -558,7 +564,9 @@ def fleet_config_from_args(args: argparse.Namespace) -> FleetConfig:
         shm_ring_slots=getattr(args, "shm_ring_slots",
                                FleetConfig.shm_ring_slots),
         shm_slot_bytes=getattr(args, "shm_slot_bytes",
-                               FleetConfig.shm_slot_bytes))
+                               FleetConfig.shm_slot_bytes),
+        memo_capacity_bytes=getattr(args, "memo_capacity_bytes",
+                                    FleetConfig.memo_capacity_bytes))
 
 
 def add_aot_flags(p: argparse.ArgumentParser) -> None:

@@ -160,6 +160,29 @@ def _parser() -> argparse.ArgumentParser:
                    default="critical:0.1,standard:0.3,best_effort:0.6",
                    help="SLO class mix as class:weight[,class:weight...]"
                         " (fleet/shield.py class names)")
+    # batch counterfactual search (fleet/search.py): rides the served
+    # request stream's hottest (entry, ts_bucket) after traffic ends,
+    # through the same submit()/hedge/shed/memo machinery
+    p.add_argument("--search", action="store_true",
+                   help="after the request stream, beam-search the "
+                        "hottest entry's drop/sub edit neighborhood "
+                        "for the edit minimizing the predicted tail "
+                        "quantile (fleet/search.py; stats JSON gains a "
+                        "'search' record; zero fresh compiles by "
+                        "construction)")
+    p.add_argument("--search_beam", type=int, default=4,
+                   help="beam width (states kept per depth)")
+    p.add_argument("--search_depth", type=int, default=2,
+                   help="max edits per candidate script")
+    p.add_argument("--search_budget", type=int, default=96,
+                   help="total submission budget, baseline included; "
+                        "exhaustion truncates loudly "
+                        "(search.budget_exhausted, "
+                        "docs/RELIABILITY.md)")
+    p.add_argument("--search_subs", type=int, default=4,
+                   help="max distinct ms_ids offered as sub_node "
+                        "candidates (drawn from the hot entry's own "
+                        "mixture; 0 = drop_edge only)")
     p.add_argument("--from_split", default="test",
                    choices=("train", "valid", "test"))
     p.add_argument("--num_requests", type=int, default=0,
@@ -491,14 +514,6 @@ def _run_launcher(args, p: argparse.ArgumentParser,
         failures: list[tuple[int, BaseException]] = []
         schedule = None
         if args.loadgen:
-            from pertgnn_tpu.config import resolve_quantile_taus as _rqt
-            if len(_rqt(cfg.model, cfg.train.tau)) > 1:
-                # the replay's per-request result slots are scalar;
-                # refuse loudly rather than truncate quantile vectors
-                raise SystemExit(
-                    "--loadgen does not support a multi-quantile head "
-                    "yet (scalar result slots); drop --quantile_taus "
-                    "or run without --loadgen")
             # open-loop: the request stream is the POPULATION the
             # arrival schedule draws from (Zipf popularity, SLO mix),
             # deterministic per --seed (fleet/loadgen.py)
@@ -553,6 +568,7 @@ def _run_launcher(args, p: argparse.ArgumentParser,
         spare_procs: list = []
         spare_bodies: dict = {}
         loadgen_stats = None
+        search_stats = None
         t_serve0 = time.perf_counter()
         try:
             with FleetRouter(
@@ -560,6 +576,28 @@ def _run_launcher(args, p: argparse.ArgumentParser,
                     request_size,
                     (top.max_graphs, top.max_nodes, top.max_edges),
                     cfg=cfg.fleet, bus=bus) as router:
+                if router.memo is not None:
+                    # arm the memo's generation with exactly what the
+                    # predictions depend on: the fleet's checkpoint
+                    # epoch (uniform across ready probes — _await_ready
+                    # gates on all workers), the arena input
+                    # fingerprint, and the quantile head layout.  A
+                    # rollout (fleet/rollout.py) retires this at drain
+                    # start and installs the successor only after full
+                    # fleet verification.
+                    import hashlib
+                    from pertgnn_tpu.cli.common import (
+                        raw_input_fingerprint)
+                    epoch = max(
+                        int(body.get("checkpoint_epoch", -1))
+                        for body in ready.values())
+                    fp = hashlib.sha256(json.dumps(
+                        raw_input_fingerprint(args), sort_keys=True,
+                        default=str).encode()).hexdigest()[:16]
+                    router.memo.set_generation(
+                        checkpoint_epoch=epoch,
+                        arena_fingerprint=fp,
+                        taus=tuple(float(t) for t in taus))
                 if cfg.fleet.autoscale_max_spares > 0:
                     scaler = _make_autoscaler(args, argv, cfg.fleet,
                                               router, bus, spare_procs,
@@ -567,10 +605,15 @@ def _run_launcher(args, p: argparse.ArgumentParser,
                 try:
                     if args.loadgen:
                         from pertgnn_tpu.fleet import loadgen
+                        # vector result slots under a multi-quantile
+                        # head (one column per tau — the PR-15 scalar
+                        # refusal is lifted; loadgen.replay sizes the
+                        # slots off the checkpoint's head width)
                         result = loadgen.replay(router.submit, schedule,
-                                                bus=bus)
+                                                bus=bus,
+                                                vector_width=len(taus))
                         preds = result.preds
-                        served = np.isfinite(preds)
+                        served = result.served_mask()
                         out_errors = result.errors
                         request_errors.update(result.error_counts())
                         loadgen_stats = {
@@ -583,7 +626,17 @@ def _run_launcher(args, p: argparse.ArgumentParser,
                             "latency_by_class":
                                 result.latency_summary_by_class(
                                     schedule),
+                            "taus": [float(t) for t in taus],
                         }
+                        if preds.ndim == 2:
+                            # per-tau columns in the stats JSON: the
+                            # served mean per quantile level (NaN-free
+                            # by the served mask)
+                            loadgen_stats["served_mean_by_tau"] = {
+                                f"q{t:g}": (float(
+                                    preds[served, i].mean())
+                                    if served.any() else None)
+                                for i, t in enumerate(taus)}
                     else:
                         threads = [threading.Thread(
                             target=client,
@@ -601,7 +654,44 @@ def _run_launcher(args, p: argparse.ArgumentParser,
                 finally:
                     if scaler is not None:
                         scaler.close()
+                if args.search:
+                    # counterfactual search around the hottest served
+                    # request (fleet/search.py): every candidate rides
+                    # router.submit unchanged, so hedging, shedding,
+                    # tracing, and the memo all apply
+                    from pertgnn_tpu.fleet.search import (
+                        CounterfactualSearch, SearchSpec)
+                    hot = collections.Counter(
+                        (int(e), int(b))
+                        for e, b, ok in zip(out_entries, out_buckets,
+                                            served) if ok)
+                    if not hot:
+                        raise SystemExit(
+                            "--search: no request was served, nothing "
+                            "to search around")
+                    (hot_entry, hot_bucket), _n = hot.most_common(1)[0]
+                    mix = dataset.mixtures[hot_entry]
+                    subs = tuple(
+                        int(m) for m in
+                        np.unique(np.asarray(mix.ms_id))
+                        [:max(0, args.search_subs)])
+                    sresult = CounterfactualSearch(
+                        router.submit,
+                        SearchSpec(
+                            entry_id=hot_entry, ts_bucket=hot_bucket,
+                            num_nodes=int(mix.num_nodes),
+                            num_edges=int(mix.num_edges),
+                            beam_width=args.search_beam,
+                            max_depth=args.search_depth,
+                            budget=args.search_budget,
+                            sub_ms_ids=subs),
+                        bus=bus).run()
+                    search_stats = sresult.to_dict()
+                    search_stats["entry_id"] = hot_entry
+                    search_stats["ts_bucket"] = hot_bucket
                 router_stats = router.stats_dict()
+                memo_stats = (router.memo.stats_dict()
+                              if router.memo is not None else None)
                 autoscale_stats = (scaler.stats_dict()
                                    if scaler is not None else None)
             serve_wall_s = time.perf_counter() - t_serve0
@@ -651,6 +741,10 @@ def _run_launcher(args, p: argparse.ArgumentParser,
     if autoscale_stats is not None:
         stats["autoscale"] = autoscale_stats
         stats["autoscale_workers"] = spare_bodies
+    if memo_stats is not None:
+        stats["memo"] = memo_stats
+    if search_stats is not None:
+        stats["search"] = search_stats
     bus.flush()
     print(f"wrote {len(out_entries)} predictions ({int(served.sum())} "
           f"served by {args.num_workers} worker(s)) to {args.out}",

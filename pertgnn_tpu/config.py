@@ -545,6 +545,13 @@ class FleetConfig:
     # request frames are ~16B/request, response frames ~8B/request
     # plus lens attribution JSON.
     shm_slot_bytes: int = 65536
+    # --- graftmemo read-mostly path (fleet/memo.py) ---
+    # Byte budget for the router's content-keyed prediction cache —
+    # LRU over wire-encoded rows, generation-tagged so a blue/green
+    # rollout retires every cached byte atomically (docs/GUIDE.md §17).
+    # 0 (the default) disables the memo entirely: every submit rides
+    # the wire, byte-identical to the pre-memo router.
+    memo_capacity_bytes: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -177,7 +177,10 @@ class ReplayResult:
     (``errors[i]``) — a row with neither is a LOST FUTURE, the thing
     benchmarks/tail_bench.py exit-code-asserts never happens."""
 
-    preds: np.ndarray            # float32, NaN where no prediction
+    # float32, NaN where no prediction.  Shape (n,) for a single-tau
+    # head, (n, T) under a multi-quantile head (replay's vector_width)
+    # — per-tau columns, same order as the checkpoint's taus
+    preds: np.ndarray
     errors: list                 # per-row typed error name or None
     latency_ms: np.ndarray       # submit -> resolution, NaN where shed
     lag_ms: np.ndarray           # actual submit - scheduled time
@@ -185,11 +188,20 @@ class ReplayResult:
     submitted: int = 0
     unresolved: int = 0          # futures still pending at wait timeout
 
+    def served_mask(self) -> np.ndarray:
+        """(n,) bool — rows that resolved to a prediction.  Row-wise
+        over the tau columns in vector mode (a served quantile vector
+        is all-finite by construction; a NaN-struck row is a finding
+        the engine's own non-finite guard would have typed)."""
+        finite = np.isfinite(self.preds)
+        return finite.all(axis=1) if finite.ndim == 2 else finite
+
     def lost_futures(self) -> int:
         """Rows with neither a prediction nor a typed error — must be
         zero (the ALWAYS-resolves contract, measured end to end)."""
-        return int(sum(1 for p, e in zip(self.preds, self.errors)
-                       if not np.isfinite(p) and e is None))
+        served = self.served_mask()
+        return int(sum(1 for p, e in zip(served, self.errors)
+                       if not p and e is None))
 
     def error_counts(self) -> dict:
         out: dict[str, int] = {}
@@ -219,7 +231,8 @@ class ReplayResult:
 
 
 def replay(submit, schedule: Schedule, *, bus=None,
-           wait_timeout_s: float = 300.0) -> ReplayResult:
+           wait_timeout_s: float = 300.0,
+           vector_width: int = 0) -> ReplayResult:
     """Drive one open-loop replay against a router-shaped front door.
 
     ``submit(entry_id, ts_bucket, slo=<class name>) -> Future`` is the
@@ -230,10 +243,16 @@ def replay(submit, schedule: Schedule, *, bus=None,
     done-callback, and moves on — it NEVER waits on a result
     mid-schedule (open loop). After the last arrival it waits out the
     in-flight tail (bounded by `wait_timeout_s`; stragglers are
-    counted `unresolved`, and an unresolved future is a finding)."""
+    counted `unresolved`, and an unresolved future is a finding).
+
+    ``vector_width`` is the checkpoint's quantile-head width: > 1
+    preallocates (n, T) result slots so multi-quantile fleets replay
+    without truncation (the per-tau columns land in the stats JSON);
+    <= 1 keeps the historical scalar slots."""
     bus = bus if bus is not None else telemetry.get_bus()
     n = len(schedule)
-    preds = np.full(n, np.nan, np.float32)
+    preds = np.full((n, vector_width) if vector_width > 1 else n,
+                    np.nan, np.float32)
     errors: list = [None] * n
     latency_ms = np.full(n, np.nan, np.float64)
     lag_ms = np.zeros(n, np.float64)
@@ -246,9 +265,9 @@ def replay(submit, schedule: Schedule, *, bus=None,
         try:
             exc = fut.exception()
             if exc is None:
-                # scalar slots: loadgen drives PLAIN traffic (no lens
-                # variants; fleet_main refuses --loadgen with a
-                # multi-quantile head rather than truncate vectors)
+                # plain traffic only (no lens variants): the result is
+                # a scalar, or a (T,)-vector filling this row's per-tau
+                # columns when the replay was sized with vector_width
                 preds[i] = fut.result()
                 latency_ms[i] = (t_now - t_submit) * 1e3
             else:

@@ -1,0 +1,283 @@
+"""graftmemo — the router's content-keyed semantic prediction cache.
+
+Predictions are PURE functions of (checkpoint epoch, arena fingerprint,
+entry, ts bucket, lens/what-if variant) and bit-deterministic — the
+property every prior layer fought for (PARITY.md; hedging and requeue
+are safe because of it).  Loadgen's Zipf popularity model says real
+traffic re-asks the same hot requests constantly, so the fastest
+inference is the one never run: the router consults this memo at
+``submit`` and resolves a hit's Future immediately, skipping admission,
+dispatch, the wire, and the engine entirely (ROADMAP item 4).
+
+Design rules, in the order they matter:
+
+- **keyed on content, not time.**  A key is (generation, entry,
+  ts_bucket, canonical lens payload), where the GENERATION pins the
+  semantic version of the answer: (checkpoint_epoch,
+  arena_fingerprint, quantile taus) — everything a served bit depends
+  on besides the request itself.  The lens payload is canonicalized
+  (lens/canon.py) so equivalent counterfactual scripts share one
+  entry.
+- **invalidated by construction, not by TTL.**  The store holds ONE
+  generation.  A blue/green rollout (fleet/rollout.py) calls
+  ``retire_generation`` the moment the first worker drains — every old
+  entry becomes unreachable atomically — and installs the new
+  generation only after the whole fleet verified on the new
+  checkpoint.  Mid-rollout the fleet serves two checkpoint versions,
+  so mid-rollout the memo serves NOTHING and refuses inserts: lookups
+  stamp the generation they saw, and ``insert`` drops any value whose
+  stamp is no longer current (counter ``memo.stale_insert``).  A stale
+  read is thereby impossible by construction — there is no window
+  where an old-generation byte can be returned or stored.
+- **bounded memory, wire-encoded values.**  Values are stored as
+  single-row graftwire response frames (fleet/wire.py) with the
+  ``cache_hit`` flag already set: byte-exact accounting for the LRU
+  bound (``capacity_bytes``), decode on hit through the same
+  ``decode_response`` path the binary transport uses (bit-identity is
+  the codec's round-trip property, pinned in tests/test_wire.py), and
+  a frame that could be forwarded to a binary/shm peer without
+  re-serialization.  Eviction is LRU; a frame larger than the whole
+  capacity is refused outright (``memo.oversize``) rather than
+  thrashing the store.
+
+Thread protocol (graftsync-clean by construction, not by allowlist):
+one plain ``threading.Lock`` guards the store; nothing blocking — no
+bus emission, no Future resolution, no I/O — ever runs under it.  The
+``fleet.memo.lookup`` / ``fleet.memo.insert`` / ``fleet.memo.flip``
+sync points (testing/schedules.py) sit BEFORE each lock acquisition so
+tests/test_memo.py can script the rollout-flip vs in-flight race in
+both orders.
+
+Telemetry (docs/OBSERVABILITY.md): counters ``memo.hit`` /
+``memo.miss`` / ``memo.insert`` / ``memo.evict`` / ``memo.retired`` /
+``memo.stale_insert`` / ``memo.oversize``, gauges ``memo.bytes`` /
+``memo.generation``; the router emits ``transport.cache_bytes_saved``
+per hit for the wire bytes that never moved.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.fleet import wire
+from pertgnn_tpu.lens.canon import canonical_lens_key
+from pertgnn_tpu.testing import schedules
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoGeneration:
+    """The semantic version a cached answer is valid for.  ``seq`` is a
+    monotonically increasing install counter — two installs of the same
+    (epoch, arena, taus) are still distinct generations, so a
+    retire/reinstall cycle can never resurrect a stale stamp."""
+
+    seq: int
+    checkpoint_epoch: int
+    arena_fingerprint: str
+    taus: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoToken:
+    """A miss's insert permit: the generation the lookup ran under and
+    the key it computed.  ``insert`` honors the token only while that
+    generation is still current."""
+
+    gen_seq: int
+    key: tuple
+
+
+class PredictionMemo:
+    """Bounded content-keyed LRU over wire-encoded prediction rows."""
+
+    def __init__(self, capacity_bytes: int, bus=None):
+        if capacity_bytes <= 0:
+            raise ValueError("PredictionMemo needs capacity_bytes > 0")
+        self._capacity = int(capacity_bytes)
+        self._injected_bus = bus
+        self._lock = threading.Lock()
+        self._gen: MemoGeneration | None = None
+        self._gen_seq = 0
+        self._store: OrderedDict[tuple, bytes] = OrderedDict()
+        self._bytes = 0
+        # counters mirrored to the bus (memo.* names)
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.evictions = 0
+        self.retired = 0
+        self.stale_inserts = 0
+        self.oversize = 0
+
+    @property
+    def bus(self):
+        if self._injected_bus is not None:
+            return self._injected_bus
+        return telemetry.get_bus()
+
+    @property
+    def capacity_bytes(self) -> int:
+        return self._capacity
+
+    @property
+    def generation(self) -> MemoGeneration | None:
+        with self._lock:
+            return self._gen
+
+    # -- generations -----------------------------------------------------
+
+    def set_generation(self, checkpoint_epoch: int,
+                       arena_fingerprint: str,
+                       taus) -> MemoGeneration:
+        """Install the active generation, retiring whatever was there.
+        This IS the rollout flip's second half: the controller retires
+        at drain start and the operator/launcher installs here once the
+        fleet verified on the new checkpoint."""
+        schedules.sync_point("fleet.memo.flip")
+        taus = tuple(float(t) for t in taus)
+        with self._lock:
+            n_retired, freed = len(self._store), self._bytes
+            self._gen_seq += 1
+            gen = MemoGeneration(seq=self._gen_seq,
+                                 checkpoint_epoch=int(checkpoint_epoch),
+                                 arena_fingerprint=str(arena_fingerprint),
+                                 taus=taus)
+            self._gen = gen
+            self._store = OrderedDict()
+            self._bytes = 0
+            self.retired += n_retired
+        bus = self.bus
+        if n_retired:
+            bus.counter("memo.retired", n_retired, reason="flip",
+                        bytes=freed)
+        bus.gauge("memo.generation", gen.seq,
+                  checkpoint_epoch=gen.checkpoint_epoch,
+                  arena=gen.arena_fingerprint)
+        bus.gauge("memo.bytes", 0)
+        return gen
+
+    def retire_generation(self, reason: str = "rollout") -> int:
+        """Atomically drop the active generation and every entry —
+        the memo serves nothing and refuses inserts until the next
+        ``set_generation``.  Returns the number of entries retired."""
+        schedules.sync_point("fleet.memo.flip")
+        with self._lock:
+            n_retired, freed = len(self._store), self._bytes
+            self._gen = None
+            self._store = OrderedDict()
+            self._bytes = 0
+            self.retired += n_retired
+        bus = self.bus
+        bus.counter("memo.retired", n_retired, reason=reason,
+                    bytes=freed)
+        bus.gauge("memo.generation", 0, active=False)
+        bus.gauge("memo.bytes", 0)
+        return n_retired
+
+    # -- the read-mostly path --------------------------------------------
+
+    @staticmethod
+    def _key(entry_id: int, ts_bucket: int, lens_wire: dict | None):
+        return (int(entry_id), int(ts_bucket),
+                canonical_lens_key(lens_wire))
+
+    def lookup(self, entry_id: int, ts_bucket: int,
+               lens_wire: dict | None = None
+               ) -> tuple[dict | None, MemoToken | None, int]:
+        """(row, token, frame_bytes): a hit decodes the stored frame
+        back into its wire row (``cache_hit: True`` travels with it) —
+        (row, None, len(frame)); a miss returns (None, token, 0) with
+        the insert permit (token None when no generation is active)."""
+        key = self._key(entry_id, ts_bucket, lens_wire)
+        schedules.sync_point("fleet.memo.lookup")
+        with self._lock:
+            gen = self._gen
+            frame = self._store.get(key) if gen is not None else None
+            if frame is not None:
+                self._store.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+        if frame is None:
+            self.bus.counter("memo.miss", level=2, entry_id=entry_id)
+            token = (MemoToken(gen_seq=gen.seq, key=key)
+                     if gen is not None else None)
+            return None, token, 0
+        row = wire.decode_response(frame)[0]
+        self.bus.counter("memo.hit", level=2, entry_id=entry_id)
+        return row, None, len(frame)
+
+    def insert(self, token: MemoToken | None, row: dict) -> bool:
+        """Store one served wire row under a miss's token.  Dropped
+        (returning False) when the token is absent, the row is not a
+        prediction, the generation moved on (``memo.stale_insert`` —
+        the in-flight-across-a-rollout race), or the frame alone
+        exceeds the capacity (``memo.oversize``)."""
+        if token is None or "pred" not in row or "error" in row:
+            return False
+        clean = {k: v for k, v in row.items() if k != "cache_hit"}
+        frame = wire.encode_response([{**clean, "cache_hit": True}])
+        if len(frame) > self._capacity:
+            with self._lock:
+                self.oversize += 1
+            self.bus.counter("memo.oversize", level=2,
+                             bytes=len(frame))
+            return False
+        schedules.sync_point("fleet.memo.insert")
+        evicted = 0
+        freed = 0
+        with self._lock:
+            if self._gen is None or self._gen.seq != token.gen_seq:
+                self.stale_inserts += 1
+                stored = False
+            else:
+                old = self._store.pop(token.key, None)
+                if old is not None:
+                    self._bytes -= len(old)
+                self._store[token.key] = frame
+                self._bytes += len(frame)
+                while self._bytes > self._capacity:
+                    _k, v = self._store.popitem(last=False)
+                    self._bytes -= len(v)
+                    evicted += 1
+                    freed += len(v)
+                self.inserts += 1
+                self.evictions += evicted
+                stored = True
+            nbytes = self._bytes
+        if not stored:
+            self.bus.counter("memo.stale_insert", level=2)
+            return False
+        self.bus.counter("memo.insert", level=2, bytes=len(frame))
+        if evicted:
+            self.bus.counter("memo.evict", evicted, level=2,
+                             bytes=freed)
+        self.bus.gauge("memo.bytes", nbytes, level=2)
+        return True
+
+    # -- introspection ---------------------------------------------------
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            gen = self._gen
+            return {
+                "generation": (None if gen is None else {
+                    "seq": gen.seq,
+                    "checkpoint_epoch": gen.checkpoint_epoch,
+                    "arena_fingerprint": gen.arena_fingerprint,
+                    "taus": list(gen.taus),
+                }),
+                "entries": len(self._store),
+                "bytes": self._bytes,
+                "capacity_bytes": self._capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "inserts": self.inserts,
+                "evictions": self.evictions,
+                "retired": self.retired,
+                "stale_inserts": self.stale_inserts,
+                "oversize": self.oversize,
+            }

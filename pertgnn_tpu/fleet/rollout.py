@@ -99,7 +99,8 @@ class RolloutController:
                  probe: Callable[..., tuple[int, dict]] = get_probe,
                  ready_timeout_s: float = 300.0,
                  poll_interval_s: float = 0.25,
-                 bus=None):
+                 bus=None, memo=None,
+                 new_generation: dict | None = None):
         if not workers:
             raise ValueError("rollout needs at least one worker")
         self._workers = list(workers)
@@ -111,6 +112,17 @@ class RolloutController:
         self._ready_timeout_s = ready_timeout_s
         self._poll_interval_s = poll_interval_s
         self._injected_bus = bus
+        # the prediction memo's generation flip (fleet/memo.py): the
+        # old generation is retired BEFORE the first worker drains —
+        # mid-rollout the fleet serves two checkpoint versions, so
+        # mid-rollout the cache serves nothing — and `new_generation`
+        # (checkpoint_epoch / arena_fingerprint / taus kwargs for
+        # memo.set_generation) is installed only after EVERY worker
+        # verified on the new checkpoint.  An aborted rollout leaves
+        # the memo cold, never stale: whichever version the fleet ended
+        # up on, no cached byte predates the flip
+        self._memo = memo
+        self._new_generation = new_generation
 
     @property
     def bus(self):
@@ -154,6 +166,11 @@ class RolloutController:
         the new checkpoint (after attempting rollback to the old)."""
         bus = self.bus
         bus.counter("rollout.started", workers=len(self._workers))
+        if self._memo is not None:
+            # atomic retirement of the old cache generation: from this
+            # moment no pre-rollout prediction can be read or inserted
+            # (docs/RELIABILITY.md "stale cache generation")
+            self._memo.retire_generation(reason="rollout")
         swapped: list[str] = []
         for w in self._workers:
             t0 = time.perf_counter()
@@ -181,6 +198,8 @@ class RolloutController:
             swapped.append(w.worker_id)
             log.info("rollout: worker %s swapped in %.1fs", w.worker_id,
                      dt)
+        if self._memo is not None and self._new_generation:
+            self._memo.set_generation(**self._new_generation)
         bus.counter("rollout.completed", workers=len(swapped))
         return {"swapped": swapped, "workers": len(self._workers)}
 

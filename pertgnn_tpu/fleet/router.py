@@ -102,6 +102,7 @@ from concurrent.futures import Future
 from pertgnn_tpu import telemetry
 from pertgnn_tpu.config import FleetConfig
 from pertgnn_tpu.fleet import policy, shield
+from pertgnn_tpu.fleet.memo import PredictionMemo
 from pertgnn_tpu.testing import schedules
 from pertgnn_tpu.telemetry.tracing import new_span_id
 from pertgnn_tpu.fleet.transport import (FleetTransport,
@@ -142,6 +143,10 @@ class _Request:
     # refused edit comes back as a typed per-request row, and BOTH legs
     # of a hedged dispatch carry the identical variant by construction.
     lens: dict | None = None
+    # the prediction memo's insert permit (fleet/memo.py MemoToken),
+    # stamped by the miss that admitted this request — None when the
+    # memo is off, had no active generation, or the row is uncacheable
+    memo_token: object = None
     requeues: int = 0
     # workers this request already FAILED on (transport loss): the
     # retry excludes them so a flapping worker cannot eat the same
@@ -224,9 +229,19 @@ class FleetRouter:
     def __init__(self, workers: dict[str, str], request_size,
                  capacity: tuple[int, int, int],
                  cfg: FleetConfig | None = None, bus=None,
-                 transport_post=None, transport_probe=get_probe):
+                 transport_post=None, transport_probe=get_probe,
+                 memo: PredictionMemo | None = None):
         self._cfg = cfg = cfg or FleetConfig()
         self._injected_bus = bus
+        # the read-mostly path (fleet/memo.py): an injected memo wins;
+        # else cfg.memo_capacity_bytes > 0 builds one.  It serves
+        # nothing until the launcher installs a generation
+        # (memo.set_generation) — the router never invents one, because
+        # only the launcher knows the checkpoint epoch and arena
+        # fingerprint the cached bits depend on
+        if memo is None and cfg.memo_capacity_bytes > 0:
+            memo = PredictionMemo(cfg.memo_capacity_bytes, bus=bus)
+        self.memo = memo
         # the data plane: None (the default) builds the graftwire
         # FleetTransport for cfg.transport — json mode reproduces the
         # legacy wire bytes over pooled connections; tests that inject
@@ -283,6 +298,7 @@ class FleetRouter:
         self.hedge_fired = 0
         self.hedge_won = 0
         self.served = 0
+        self.memo_hits = 0
         self.failed = 0
         self.shed_by_class: collections.Counter = collections.Counter()
         self._senders = [
@@ -340,7 +356,28 @@ class FleetRouter:
         # size it NOW so an unknown entry fails the caller, not the
         # dispatcher (same placement as the single-process queue)
         self._request_size(eid)
-        fut: Future = Future()
+        # the read-mostly path: a memo hit resolves the Future right
+        # here — no admission, no queue, no wire, no engine.  The key
+        # is slo-independent by construction (predictions do not depend
+        # on the request's class, only shedding does), and the decoded
+        # row rides the same result_from_row rehydration a wire answer
+        # would, so hits are bit-identical to the uncached path
+        memo_token = None
+        if self.memo is not None:
+            row, memo_token, nbytes = self.memo.lookup(
+                eid, int(ts_bucket), lens_wire)
+            if row is not None:
+                fut = Future()
+                fut.set_result(result_from_row(row))
+                with self._lock:
+                    self.served += 1
+                    self.memo_hits += 1
+                # the wire bytes a hit never moved (the stored frame is
+                # exactly what the binary transport would have carried)
+                self.bus.counter("transport.cache_bytes_saved", nbytes,
+                                 level=2)
+                return fut
+        fut = Future()
         # head-sampling decision at the fleet's front door, BEFORE the
         # lock (dice roll + urandom must not serialize admission); a
         # rejected submit discards the context unemitted — no orphans
@@ -382,7 +419,8 @@ class FleetRouter:
                     self.shed_by_class[evicted.slo] += 1
                     self._admit_locked(eid, ts_bucket, fut, ctx,
                                        tm_submit, slo_cls,
-                                       lens=lens_wire)
+                                       lens=lens_wire,
+                                       memo_token=memo_token)
             else:
                 now = time.perf_counter()
                 deadline = (now + self._deadline_s
@@ -400,7 +438,8 @@ class FleetRouter:
                     self._admit_locked(eid, ts_bucket, fut, ctx,
                                        tm_submit, slo_cls,
                                        deadline=deadline, now=now,
-                                       lens=lens_wire)
+                                       lens=lens_wire,
+                                       memo_token=memo_token)
         if evicted is not None:
             self.bus.counter("router.shed", entry_id=evicted.entry_id)
             self.bus.counter("router.shed_by_class", slo=evicted.slo,
@@ -426,7 +465,8 @@ class FleetRouter:
                       tm_submit: float, slo_cls: str,
                       deadline: float | None = None,
                       now: float | None = None,
-                      lens: dict | None = None) -> None:
+                      lens: dict | None = None,
+                      memo_token=None) -> None:
         if now is None:
             now = time.perf_counter()
         if deadline is None:
@@ -435,8 +475,8 @@ class FleetRouter:
         self._pending.append(_Request(
             seq=self._seq, entry_id=eid, ts_bucket=int(ts_bucket),
             arrival=now, deadline_abs=deadline, future=fut, slo=slo_cls,
-            lens=lens, trace=ctx, tm_submit=tm_submit,
-            tm_queue_start=tm_submit))
+            lens=lens, memo_token=memo_token, trace=ctx,
+            tm_submit=tm_submit, tm_queue_start=tm_submit))
         self._seq += 1
         self._wake.notify_all()
 
@@ -488,6 +528,7 @@ class FleetRouter:
                 "hedge_won": self.hedge_won,
                 "brownout_active": self._brownout,
                 "served": self.served,
+                "memo_hits": self.memo_hits,
                 "failed": self.failed,
                 "pending": len(self._pending),
             }
@@ -1065,6 +1106,13 @@ class FleetRouter:
                 n_served += 1
                 self.bus.histogram("router.request_total_ms",
                                    (t_done - r.arrival) * 1e3, level=2)
+                # populate the memo under winner custody only (the
+                # settled latch above): the losing hedge leg never
+                # inserts, and a stale token (a rollout flipped the
+                # generation while this flight was in the air) is
+                # refused inside insert — never stored
+                if self.memo is not None and r.memo_token is not None:
+                    self.memo.insert(r.memo_token, row)
                 r.future.set_result(result_from_row(row))
                 if r.trace is not None:
                     tm_settle = time.monotonic()

@@ -1,0 +1,288 @@
+"""Batch counterfactual search: the fleet's first self-driving workload.
+
+The lens answers "what if this call were not there / ran elsewhere?"
+one query at a time (lens/whatif.py); this service asks the question
+SYSTEMATICALLY: beam search over the drop/substitute edit neighborhood
+of a hot entry's topology, minimizing the predicted tail latency — the
+highest-tau quantile column under a multi-quantile head (the predicted
+p99 when 0.99 is among the taus), the scalar prediction otherwise.
+
+It deliberately owns no machinery: every candidate rides the router's
+ordinary ``submit(entry, ts_bucket, lens=LensRequest(edits=...))``
+front door, so hedging, shedding, tracing, and the prediction memo
+(fleet/memo.py) all apply unchanged.  Three structural properties make
+the search cheap:
+
+- **zero fresh compiles, provably**: edits never grow a graph and the
+  ladder rungs key on shape (lens/whatif.py module docstring), so no
+  candidate can trigger a compile — benchmarks/cache_bench.py
+  exit-code-asserts ``compiles == 0`` across a whole search.
+- **canonical dedup**: candidates are deduplicated by their canonical
+  edit key (lens/canon.py) before submission — the same key the memo
+  uses — so the engine evaluates each distinct counterfactual at most
+  once and the memo's misses are bounded by the unique-canonical
+  count.
+- **typed refusals prune, never crash**: a candidate the edit algebra
+  refuses (WhatIfRefused — e.g. dropping a pattern's last node) is
+  counted and discarded like any other dead branch.
+
+Budget discipline (docs/RELIABILITY.md "search budget exhaustion"):
+``budget`` caps total submissions.  A budget too small to evaluate the
+baseline plus one candidate raises the typed
+:class:`SearchBudgetExhausted` — there is no argmin to report.  A
+budget that runs out mid-exploration truncates the search and flags
+the result ``budget_exhausted=True``: the reported best is the argmin
+of what was ACTUALLY evaluated, never silently presented as the argmin
+of the full neighborhood (counter ``search.budget_exhausted`` either
+way).
+
+Telemetry (docs/OBSERVABILITY.md): counters ``search.requests`` /
+``search.refused`` / ``search.errors`` / ``search.budget_exhausted``,
+gauges ``search.rounds`` / ``search.best_objective``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import numpy as np
+
+from pertgnn_tpu import telemetry
+from pertgnn_tpu.lens.canon import canonical_edits, canonical_lens_key
+from pertgnn_tpu.lens.request import LensRequest, LensResult
+from pertgnn_tpu.serve.errors import ServeError, WhatIfRefused
+from pertgnn_tpu.lens.whatif import MAX_EDITS
+
+log = logging.getLogger(__name__)
+
+
+class SearchBudgetExhausted(RuntimeError):
+    """The submission budget cannot cover even the baseline plus one
+    candidate — the search has no evaluated neighborhood to take an
+    argmin over, so it refuses loudly instead of reporting the
+    unedited topology as a 'finding'."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchSpec:
+    """One search problem: the hot request plus the exploration knobs.
+    ``num_nodes`` / ``num_edges`` are the entry's BASE topology sizes
+    (the launcher reads them off the dataset's mixtures — the router
+    itself holds no mixtures); candidate indices beyond what an edited
+    graph still has are refused by the worker and pruned, not
+    special-cased here."""
+
+    entry_id: int
+    ts_bucket: int
+    num_nodes: int
+    num_edges: int
+    # beam search shape
+    beam_width: int = 4
+    max_depth: int = 2
+    # total submission cap, baseline included
+    budget: int = 96
+    # ops explored; drop_edge shrinks the graph, sub_node re-routes a
+    # stage (drop_node is deliberately absent from the default: its
+    # incident-edge removal makes later edge indices mixture-dependent,
+    # which buys little beyond drop_edge at much worse dedup)
+    ops: tuple = ("drop_edge", "sub_node")
+    # substitute candidates for sub_node (e.g. the entry's own ms ids)
+    sub_ms_ids: tuple = ()
+    # branching caps, so a big topology cannot explode a round
+    max_drop_candidates: int = 16
+    max_sub_nodes: int = 4
+    # SLO class the candidates ride under (best-effort by default: the
+    # search is background traffic and should shed first)
+    slo: str | None = None
+    timeout_s: float = 60.0
+
+
+@dataclasses.dataclass
+class SearchResult:
+    """The argmin over everything evaluated, with its audit trail."""
+
+    baseline: float
+    best_objective: float
+    best_edits: tuple
+    # every evaluated candidate: (edits, objective), evaluation order
+    evaluated: list
+    requests: int = 0
+    refused: int = 0
+    errors: int = 0
+    rounds: int = 0
+    budget_exhausted: bool = False
+
+    @property
+    def improvement(self) -> float:
+        return self.baseline - self.best_objective
+
+    def to_dict(self) -> dict:
+        return {
+            "baseline": self.baseline,
+            "best_objective": self.best_objective,
+            "best_edits": [dict(e) for e in self.best_edits],
+            "improvement": self.improvement,
+            "evaluated": len(self.evaluated),
+            "requests": self.requests,
+            "refused": self.refused,
+            "errors": self.errors,
+            "rounds": self.rounds,
+            "budget_exhausted": self.budget_exhausted,
+        }
+
+
+def objective_of(pred) -> float:
+    """The scalar the search minimizes: the LAST tau's prediction of a
+    quantile vector (taus are sorted ascending — config.py — so the
+    last column is the tail), the prediction itself otherwise."""
+    if isinstance(pred, LensResult):
+        pred = pred.pred
+    arr = np.asarray(pred, np.float64)
+    return float(arr.reshape(-1)[-1])
+
+
+class CounterfactualSearch:
+    """Beam search over an entry's edit neighborhood through a
+    router-shaped ``submit`` front door."""
+
+    def __init__(self, submit, spec: SearchSpec, bus=None):
+        self._submit = submit
+        self._spec = spec
+        self._injected_bus = bus
+
+    @property
+    def bus(self):
+        if self._injected_bus is not None:
+            return self._injected_bus
+        return telemetry.get_bus()
+
+    # -- candidate generation -------------------------------------------
+
+    def _neighbors(self, edits: tuple) -> list[tuple]:
+        """Single-op extensions of one beam state, deterministic order.
+        Edge indices are enumerated against the state's REMAINING edge
+        count (each drop_edge shrinks it by exactly one), so within
+        this op vocabulary no candidate is trivially out of range."""
+        s = self._spec
+        if len(edits) >= min(s.max_depth, MAX_EDITS):
+            return []
+        out: list[tuple] = []
+        if "drop_edge" in s.ops:
+            remaining = s.num_edges - sum(
+                1 for e in edits if e["op"] == "drop_edge")
+            for j in range(min(remaining, s.max_drop_candidates)):
+                out.append(edits + ({"op": "drop_edge", "edge": j},))
+        if "sub_node" in s.ops and s.sub_ms_ids:
+            for i in range(min(s.num_nodes, s.max_sub_nodes)):
+                for m in s.sub_ms_ids:
+                    out.append(edits + (
+                        {"op": "sub_node", "node": int(i),
+                         "ms_id": int(m)},))
+        return out
+
+    # -- evaluation ------------------------------------------------------
+
+    def _evaluate(self, batch: list[tuple], counts: dict) -> list:
+        """Submit one round's candidates as a BATCH (the router
+        coalesces them into microbatches) and collect objectives."""
+        s = self._spec
+        flights = []
+        for edits in batch:
+            lens = (LensRequest(edits=edits) if edits else None)
+            try:
+                fut = self._submit(s.entry_id, s.ts_bucket, slo=s.slo,
+                                   lens=lens)
+            except ServeError as exc:
+                counts["errors"] += 1
+                log.debug("search: candidate rejected at admission: %s",
+                          exc)
+                continue
+            counts["requests"] += 1
+            flights.append((edits, fut))
+        scored = []
+        for edits, fut in flights:
+            try:
+                scored.append((edits, objective_of(
+                    fut.result(timeout=s.timeout_s))))
+            except WhatIfRefused:
+                counts["refused"] += 1
+            except Exception as exc:
+                counts["errors"] += 1
+                log.debug("search: candidate failed: %s: %s",
+                          type(exc).__name__, exc)
+        return scored
+
+    def run(self) -> SearchResult:
+        """The full beam search; raises SearchBudgetExhausted only when
+        the budget cannot buy a single comparison."""
+        s = self._spec
+        bus = self.bus
+        if s.budget < 2:
+            bus.counter("search.budget_exhausted",
+                        entry_id=s.entry_id, evaluated=0)
+            raise SearchBudgetExhausted(
+                f"budget {s.budget} cannot cover the baseline plus one "
+                f"candidate for entry {s.entry_id}")
+        counts = {"requests": 0, "refused": 0, "errors": 0}
+        base = self._evaluate([()], counts)
+        if not base:
+            raise ServeError(
+                f"counterfactual search: the baseline request for "
+                f"entry {s.entry_id} did not serve — nothing to "
+                f"compare against")
+        baseline = base[0][1]
+        evaluated: list = [base[0]]
+        seen = {canonical_lens_key(LensRequest(edits=()).to_wire())}
+        best_edits, best_obj = (), baseline
+        frontier: list[tuple] = [()]
+        rounds = 0
+        exhausted = False
+        for _depth in range(s.max_depth):
+            batch: list[tuple] = []
+            for edits in frontier:
+                for cand in self._neighbors(edits):
+                    key = canonical_lens_key(
+                        LensRequest(edits=cand).to_wire())
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    batch.append(cand)
+            if not batch:
+                break
+            room = s.budget - counts["requests"]
+            if room <= 0:
+                exhausted = True
+                break
+            if len(batch) > room:
+                batch = batch[:room]
+                exhausted = True
+            rounds += 1
+            scored = self._evaluate(batch, counts)
+            bus.counter("search.requests", len(batch),
+                        entry_id=s.entry_id, depth=rounds)
+            evaluated.extend(scored)
+            scored.sort(key=lambda x: x[1])
+            for edits, obj in scored[:1]:
+                if obj < best_obj:
+                    best_edits, best_obj = edits, obj
+            frontier = [e for e, _o in scored[:s.beam_width]]
+        if counts["refused"]:
+            bus.counter("search.refused", counts["refused"],
+                        entry_id=s.entry_id)
+        if counts["errors"]:
+            bus.counter("search.errors", counts["errors"],
+                        entry_id=s.entry_id)
+        if exhausted:
+            bus.counter("search.budget_exhausted",
+                        entry_id=s.entry_id,
+                        evaluated=len(evaluated))
+        bus.gauge("search.rounds", rounds, entry_id=s.entry_id)
+        bus.gauge("search.best_objective", best_obj,
+                  entry_id=s.entry_id, baseline=baseline)
+        return SearchResult(
+            baseline=baseline, best_objective=best_obj,
+            best_edits=canonical_edits(best_edits),
+            evaluated=evaluated, requests=counts["requests"],
+            refused=counts["refused"], errors=counts["errors"],
+            rounds=rounds, budget_exhausted=exhausted)

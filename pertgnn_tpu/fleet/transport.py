@@ -131,6 +131,15 @@ class WorkerServer:
         self._engine = engine
         self._queue = queue
         self._extra_fn = extra_fn
+        # closed-server latch: shutdown() stops NEW connections, but a
+        # keep-alive handler thread already parked on an open pooled
+        # connection (FleetTransport) would keep serving this worker's
+        # CLOSED queue forever — the in-process twin of a drained
+        # subprocess whose sockets the OS would have torn down.  The
+        # latch makes such a thread answer 503 and drop its connection,
+        # so the router sees the standard lost-worker signature
+        # (WorkerTransportError -> reconnect) instead of a split-brain.
+        self._closing = False
         self._ring = None
         if transport == "shm":
             self._ring = shmring.RingServer(self._handle_frame,
@@ -149,6 +158,11 @@ class WorkerServer:
             disable_nagle_algorithm = True
 
             def do_GET(self):
+                if outer._closing:
+                    self.close_connection = True
+                    self._reply(503, {"error": "WorkerClosing",
+                                      "message": "worker shut down"})
+                    return
                 ready, body = probe_payload(
                     outer._engine, outer._queue,
                     outer._extra_fn() if outer._extra_fn else None)
@@ -160,6 +174,11 @@ class WorkerServer:
                 self._reply(200 if ready else 503, body)
 
             def do_POST(self):
+                if outer._closing:
+                    self.close_connection = True
+                    self._reply(503, {"error": "WorkerClosing",
+                                      "message": "worker shut down"})
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length)
                 ctype = self.headers.get("Content-Type", "")
@@ -311,6 +330,7 @@ class WorkerServer:
         return rows
 
     def close(self) -> None:
+        self._closing = True
         if self._ring is not None:
             self._ring.close()
         self._server.shutdown()

@@ -64,6 +64,7 @@ _TAG_SCALARS = 0x11                      # raw f64 per scalar row
 _TAG_VECTORS = 0x12                      # per vector: u8 width, u32 T, raw
 _TAG_ERRORS = 0x13                       # UTF-8 JSON ([{error, message}])
 _TAG_ATTR = 0x14                         # UTF-8 JSON ([[row, rows], ...])
+_TAG_CACHE = 0x15                        # u32 count + LSB-first bitmask
 # refusal section
 _TAG_REFUSAL = 0x20                      # UTF-8 JSON ({error, message})
 
@@ -280,6 +281,16 @@ def encode_response(rows: list[dict]) -> bytes:
         sections.append(_section(_TAG_ERRORS, _pack_json(errors)))
     if attr:
         sections.append(_section(_TAG_ATTR, _pack_json(attr)))
+    # cache_hit flags as a dg-style bitmask, omitted when no row was
+    # served from the prediction memo (fleet/memo.py) — all-miss (and
+    # all pre-memo) traffic pays zero extra wire bytes
+    if any(row.get("cache_hit") for row in rows):
+        bits = bytearray((len(rows) + 7) // 8)
+        for i, row in enumerate(rows):
+            if row.get("cache_hit"):
+                bits[i // 8] |= 1 << (i % 8)
+        sections.append(_section(
+            _TAG_CACHE, _U32.pack(len(rows)) + bytes(bits)))
     return _frame(KIND_RESPONSE, sections)
 
 
@@ -357,6 +368,21 @@ def decode_response(buf: bytes) -> list[dict]:
                 or "pred" not in rows[item[0]]):
             raise WireFormatError("attr: row reference out of range")
         rows[item[0]]["attr"] = item[1]
+    if _TAG_CACHE in sections:
+        raw = sections[_TAG_CACHE]
+        if len(raw) < 4:
+            raise WireFormatError("cache_hit: truncated count")
+        (nc,) = _U32.unpack_from(raw)
+        if nc != len(rows):
+            raise WireFormatError(f"cache_hit: flag count {nc} for "
+                                  f"{len(rows)} rows")
+        bits = raw[4:]
+        if len(bits) != (nc + 7) // 8:
+            raise WireFormatError(f"cache_hit: {len(bits)} mask bytes "
+                                  f"for {nc} flags")
+        for i in range(nc):
+            if bits[i // 8] >> (i % 8) & 1:
+                rows[i]["cache_hit"] = True
     return rows
 
 

@@ -1,0 +1,193 @@
+"""Canonical normal form for what-if edit scripts (lens/whatif.py).
+
+Two edit scripts that describe the SAME counterfactual should share one
+cache entry in the fleet's prediction memo (fleet/memo.py) — the memo
+keys on the request, and ``apply_whatif`` is a pure bit-deterministic
+function, so equivalence of scripts is a real, checkable property.
+This module computes a normal form such that
+
+    apply_whatif(m, edits) == apply_whatif(m, canonical_edits(edits))
+
+bit-identically for EVERY mixture ``m`` (or both refuse), which
+tests/test_memo.py proves against the whatif oracle under hypothesis
+permutations.  The transformations are deliberately only the ones
+provable WITHOUT the mixture — the router holds no mixtures, so the
+normal form must be sound on arrays it never sees:
+
+- **runs of substitutions** (``sub_node`` / ``sub_edge``): writes to
+  distinct targets commute; an edit identical to the last write on the
+  same (target, field) is a no-op and is dropped; the run is then
+  stable-sorted by (op, index) so every order of commuting edits keys
+  identically.  Writes to the SAME target keep their relative order
+  (last-write-wins is order-sensitive, so the sort key ties and the
+  stable sort preserves it).
+- **runs of drops** of one kind (``drop_edge`` xor ``drop_node``):
+  consecutive drops shift each other's index space, but the run is
+  equivalent to dropping a SET of original-space indices; the run is
+  translated to that set and re-emitted in descending original order
+  (descending drops do not shift each other).  ``[drop_edge 0,
+  drop_edge 0]`` and ``[drop_edge 1, drop_edge 0]`` both become
+  ``[drop_edge 1, drop_edge 0]``.  Out-of-range raw indices translate
+  to out-of-range original indices, so refusals are preserved;
+  drop_node's last-node-of-pattern refusal depends only on the dropped
+  SET, not the order (each drop shrinks its pattern by exactly one).
+- **across run boundaries nothing moves**: a ``drop_node`` removes
+  incident edges the router cannot enumerate, so edge indices after it
+  are not translatable without the mixture.  Runs stay in sequence.
+
+Anything not obviously canonicalizable — an unknown op, a non-int or
+negative index, a ``sub_edge`` with neither field, an over-cap script —
+is returned UNCHANGED (soundness by identity: apply_whatif refuses raw
+and canonical alike).  The normal form is idempotent:
+``canonical_edits(canonical_edits(e)) == canonical_edits(e)``.
+
+``canonical_lens_key`` wraps the normal form into the hashable tuple
+the memo keys on (None for a default/absent lens payload).
+"""
+
+from __future__ import annotations
+
+import json
+
+from pertgnn_tpu.lens.whatif import MAX_EDITS
+
+# fields each op carries beyond "op"; anything else is ignored by
+# apply_whatif and therefore dropped from the normal form
+_OP_FIELDS = {
+    "drop_edge": ("edge",),
+    "drop_node": ("node",),
+    "sub_node": ("node", "ms_id"),
+    "sub_edge": ("edge", "iface", "rpctype"),
+}
+_INDEX_FIELD = {"drop_edge": "edge", "drop_node": "node",
+                "sub_node": "node", "sub_edge": "edge"}
+
+
+class _Uncanonical(Exception):
+    """Internal: the script left the provable fragment — emit it raw."""
+
+
+def _as_nonneg_int(value) -> int:
+    try:
+        v = int(value)
+    except (TypeError, ValueError):
+        raise _Uncanonical(f"non-int field {value!r}")
+    if v < 0:
+        raise _Uncanonical(f"negative field {v}")
+    return v
+
+
+def _parse(edit) -> dict:
+    """One edit into its normalized dict (known fields only, int
+    values) — or _Uncanonical when it is outside the provable
+    fragment."""
+    if not isinstance(edit, dict):
+        raise _Uncanonical(f"edit is {type(edit).__name__}, not dict")
+    op = edit.get("op")
+    if op not in _OP_FIELDS:
+        raise _Uncanonical(f"unknown op {op!r}")
+    out = {"op": op}
+    for f in _OP_FIELDS[op]:
+        if f in edit:
+            out[f] = _as_nonneg_int(edit[f])
+    if _INDEX_FIELD[op] not in out:
+        raise _Uncanonical(f"{op} without its index field")
+    if op == "sub_node" and "ms_id" not in out:
+        raise _Uncanonical("sub_node without ms_id")
+    if op == "sub_edge" and "iface" not in out and "rpctype" not in out:
+        raise _Uncanonical("sub_edge with neither iface nor rpctype")
+    return out
+
+
+def _seg_kind(e: dict) -> str:
+    op = e["op"]
+    return op if op in ("drop_edge", "drop_node") else "sub"
+
+
+def _canon_sub_run(run: list[dict]) -> list[dict]:
+    """Dedup no-op writes, then stable-sort the commuting writes.
+
+    A write is a no-op iff every (target, field) it sets equals the
+    LAST value written to that slot earlier in the run — dropping it
+    never changes the arrays, and never changes refusal behavior (the
+    identical earlier write refuses first if the value is invalid)."""
+    kept: list[dict] = []
+    last_write: dict[tuple, int] = {}
+    for e in run:
+        slots = [(e["op"], e[_INDEX_FIELD[e["op"]]], f, e[f])
+                 for f in _OP_FIELDS[e["op"]][1:] if f in e]
+        if slots and all(last_write.get(s[:3]) == s[3] for s in slots):
+            continue
+        for op, idx, f, v in slots:
+            last_write[(op, idx, f)] = v
+        kept.append(e)
+    # sub_edge before sub_node (they touch disjoint arrays and always
+    # commute); equal keys keep their order — same-target writes are
+    # order-sensitive and must not be permuted
+    kept.sort(key=lambda e: (e["op"] != "sub_edge",
+                             e[_INDEX_FIELD[e["op"]]]))
+    return kept
+
+
+def _canon_drop_run(run: list[dict], op: str) -> list[dict]:
+    """A run of same-kind drops as descending original-space drops."""
+    field = _INDEX_FIELD[op]
+    dropped: list[int] = []
+    for e in run:
+        orig = e[field]
+        for d in sorted(dropped):
+            if d <= orig:
+                orig += 1
+        dropped.append(orig)
+    return [{"op": op, field: d}
+            for d in sorted(dropped, reverse=True)]
+
+
+def canonical_edits(edits) -> tuple:
+    """The normal form of an edit script, as a tuple of edit dicts.
+
+    Pure and mixture-free; returns the input (tuple-ified) whenever any
+    edit falls outside the provable fragment, so the bit-identity
+    oracle holds unconditionally."""
+    edits = list(edits)
+    if len(edits) > MAX_EDITS:
+        # apply_whatif refuses over-cap scripts before reading them; a
+        # normal form that shrank one under the cap would turn a
+        # refusal into an answer
+        return tuple(edits)
+    try:
+        parsed = [_parse(e) for e in edits]
+    except _Uncanonical:
+        return tuple(edits)
+    out: list[dict] = []
+    i = 0
+    while i < len(parsed):
+        kind = _seg_kind(parsed[i])
+        j = i
+        while j < len(parsed) and _seg_kind(parsed[j]) == kind:
+            j += 1
+        run = parsed[i:j]
+        out.extend(_canon_sub_run(run) if kind == "sub"
+                   else _canon_drop_run(run, kind))
+        i = j
+    return tuple(out)
+
+
+def canonical_lens_key(lens_wire: dict | None):
+    """The hashable cache-key component for a lens wire payload
+    (LensRequest.to_wire form) — None for plain/default traffic, else a
+    tuple over (attribute_k, canonical edit script)."""
+    if not lens_wire:
+        return None
+    try:
+        k = int(lens_wire.get("k", 0))
+    except (TypeError, ValueError):
+        k = -1
+    edits = canonical_edits(lens_wire.get("edits", ()))
+    try:
+        ekey = tuple(tuple(sorted(e.items())) for e in edits)
+    except TypeError:
+        # unhashable values inside a raw passthrough — key on a
+        # deterministic serialization instead
+        ekey = json.dumps(list(edits), sort_keys=True, default=repr)
+    return (k, ekey)

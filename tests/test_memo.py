@@ -1,0 +1,646 @@
+"""graftmemo (ISSUE 20): the content-keyed prediction cache and the
+edit canonicalizer it keys on.
+
+Layered cheapest-first, like the sibling suites:
+
+1. pure canon algebra — normal-form examples for every transformation
+   (drop-run set equivalence, commuting-sub sort, no-op dedup), the
+   pass-through fragment (over-cap, unknown op), idempotency, and the
+   cache-key wrapper;
+2. the canon ORACLE under hypothesis: for random edit scripts over a
+   real built mixture, ``apply_whatif(m, edits)`` and
+   ``apply_whatif(m, canonical_edits(edits))`` are array-identical or
+   both refuse — the soundness property the memo's key dedup rests on;
+3. memo mechanics — miss/insert/hit round-trip through the wire codec,
+   keying sensitivity per key component, per-generation-component
+   invalidation, LRU byte bound under churn, oversize/non-pred/error
+   refusals;
+4. the rollout-flip races, BOTH orders each, under the scripted
+   scheduler (testing/schedules.py): flip-vs-in-flight-insert and
+   flip-vs-lookup — in every explored order a post-flip lookup can
+   never return an old-generation byte (stale reads impossible by
+   construction, the ISSUE 20 acceptance property);
+5. loadgen vector result slots (the lifted PR-15 refusal): (n, T)
+   preds under ``vector_width``, row-wise served mask, admission
+   errors recorded without losing futures;
+6. counterfactual search through a router-shaped fake submit —
+   canonical dedup, argmin honesty, typed budget refusal vs honest
+   truncation, WhatIfRefused pruning.
+"""
+
+import dataclasses
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from pertgnn_tpu.batching.mixture import build_mixtures
+from pertgnn_tpu.fleet import loadgen, wire
+from pertgnn_tpu.fleet.memo import PredictionMemo
+from pertgnn_tpu.fleet.search import (
+    CounterfactualSearch,
+    SearchBudgetExhausted,
+    SearchSpec,
+)
+from pertgnn_tpu.graphs.construct import GraphSpec
+from pertgnn_tpu.lens.canon import canonical_edits, canonical_lens_key
+from pertgnn_tpu.lens.whatif import MAX_EDITS, apply_whatif
+from pertgnn_tpu.serve.errors import Shed, WhatIfRefused
+from pertgnn_tpu.telemetry.bus import NoopBus
+from pertgnn_tpu.testing import schedules
+from pertgnn_tpu.testing.schedules import ScriptedScheduler
+
+
+# --- 1. the canonical normal form -----------------------------------------
+
+
+def test_drop_run_is_set_equivalent():
+    # both scripts drop original edges {0, 1}; the normal form is the
+    # descending original-space emission
+    a = canonical_edits([{"op": "drop_edge", "edge": 0},
+                         {"op": "drop_edge", "edge": 0}])
+    b = canonical_edits([{"op": "drop_edge", "edge": 1},
+                         {"op": "drop_edge", "edge": 0}])
+    assert a == b == ({"op": "drop_edge", "edge": 1},
+                      {"op": "drop_edge", "edge": 0})
+
+
+def test_commuting_subs_sort_to_one_form():
+    e1 = {"op": "sub_node", "node": 2, "ms_id": 5}
+    e2 = {"op": "sub_node", "node": 0, "ms_id": 7}
+    e3 = {"op": "sub_edge", "edge": 1, "iface": 3}
+    assert (canonical_edits([e1, e2, e3])
+            == canonical_edits([e3, e2, e1])
+            == canonical_edits([e2, e3, e1])
+            == (e3, e2, e1))  # sub_edge first, then by index
+
+
+def test_noop_dedup_respects_intervening_conflicts():
+    a = {"op": "sub_node", "node": 0, "ms_id": 4}
+    b = {"op": "sub_node", "node": 0, "ms_id": 6}
+    # exact repeat of the LAST write to the slot is dropped...
+    assert canonical_edits([a, dict(a)]) == (a,)
+    # ...but a repeat separated by a conflicting write is LOAD-BEARING
+    # (last-write-wins) and must survive, in order
+    assert canonical_edits([a, b, dict(a)]) == (a, b, a)
+
+
+def test_runs_do_not_cross_a_drop_node_barrier():
+    # edge indices after a drop_node are not translatable without the
+    # mixture (incident-edge removal) — the segments stay in sequence
+    s = [{"op": "drop_edge", "edge": 2},
+         {"op": "drop_node", "node": 1},
+         {"op": "drop_edge", "edge": 0}]
+    assert canonical_edits(s) == tuple(s)
+
+
+def test_unprovable_fragments_pass_through_unchanged():
+    for raw in (
+            [{"op": "warp", "edge": 1}],               # unknown op
+            [{"op": "drop_edge", "edge": -1}],         # negative index
+            [{"op": "drop_edge", "edge": "x"}],        # non-int index
+            [{"op": "sub_node", "node": 1}],           # missing ms_id
+            [{"op": "sub_edge", "edge": 1}],           # neither field
+            ["drop_edge"],                             # not a dict
+    ):
+        assert canonical_edits(raw) == tuple(raw)
+    over = [{"op": "drop_edge", "edge": 0}] * (MAX_EDITS + 1)
+    # shrinking an over-cap script under the cap would turn a refusal
+    # into an answer — it must pass through untouched
+    assert canonical_edits(over) == tuple(over)
+
+
+def test_canonical_edits_is_idempotent():
+    scripts = [
+        [{"op": "drop_edge", "edge": 1}, {"op": "drop_edge", "edge": 0}],
+        [{"op": "sub_node", "node": 2, "ms_id": 5},
+         {"op": "sub_edge", "edge": 0, "rpctype": 1},
+         {"op": "sub_node", "node": 2, "ms_id": 5}],
+        [{"op": "warp"}],
+    ]
+    for s in scripts:
+        once = canonical_edits(s)
+        assert canonical_edits(once) == once
+
+
+def test_canonical_lens_key_shapes():
+    assert canonical_lens_key(None) is None
+    assert canonical_lens_key({}) is None
+    base = {"edits": [{"op": "drop_edge", "edge": 0},
+                      {"op": "drop_edge", "edge": 0}]}
+    same = {"edits": [{"op": "drop_edge", "edge": 1},
+                      {"op": "drop_edge", "edge": 0}]}
+    other = {"edits": [{"op": "drop_edge", "edge": 2},
+                       {"op": "drop_edge", "edge": 0}]}
+    assert canonical_lens_key(base) == canonical_lens_key(same)
+    assert canonical_lens_key(base) != canonical_lens_key(other)
+    # attribution k is part of the key; keys are hashable
+    assert canonical_lens_key({"k": 3}) != canonical_lens_key({"k": 4})
+    assert hash(canonical_lens_key(base)) is not None
+
+
+# --- 2. the canon oracle --------------------------------------------------
+
+
+def _spec(nn, edges, ms, depth=None):
+    s = np.array([e[0] for e in edges], np.int32)
+    r = np.array([e[1] for e in edges], np.int32)
+    ea = np.array([[e[2], e[3]] for e in edges],
+                  np.int32).reshape(-1, 2)
+    return GraphSpec(
+        senders=s, receivers=r, edge_attr=ea,
+        ms_id=np.array(ms, np.int32),
+        node_depth=np.asarray(depth if depth is not None
+                              else np.zeros(nn), np.float32),
+        num_nodes=nn, edge_durations=None)
+
+
+@pytest.fixture()
+def oracle_mixture():
+    """Two patterns (a 3-node chain, a 2-node pair) built through the
+    real mixture builder — 5 nodes, 3 edges."""
+    g0 = _spec(3, [(0, 1, 5, 0), (1, 2, 6, 1)], [10, 11, 10],
+               [0, .5, 1])
+    g1 = _spec(2, [(0, 1, 7, 0)], [12, 10], [0, 1])
+    e2r = {0: (np.array([0, 1]), np.array([0.7, 0.3], np.float32))}
+    return build_mixtures({0: g0, 1: g1}, e2r)[0]
+
+
+def _mixtures_equal(a, b) -> None:
+    for f in dataclasses.fields(a):
+        assert np.array_equal(getattr(a, f.name), getattr(b, f.name)), \
+            f.name
+
+
+def _apply(mix, edits):
+    """(outcome kind, payload) — refusals compare by message so the
+    oracle also pins that canon never CHANGES a refusal."""
+    try:
+        return "ok", apply_whatif(mix, edits, num_ms=13,
+                                  num_interfaces=10, num_rpctypes=5)
+    except WhatIfRefused as exc:
+        return "refused", str(exc)
+
+
+def test_canon_matches_whatif_oracle_under_hypothesis(oracle_mixture):
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    edit = st.one_of(
+        st.builds(lambda i: {"op": "drop_edge", "edge": i},
+                  st.integers(0, 4)),
+        st.builds(lambda i: {"op": "drop_node", "node": i},
+                  st.integers(0, 5)),
+        st.builds(lambda i, m: {"op": "sub_node", "node": i,
+                                "ms_id": m},
+                  st.integers(0, 5), st.integers(0, 14)),
+        st.builds(lambda i, f: {"op": "sub_edge", "edge": i,
+                                "iface": f},
+                  st.integers(0, 4), st.integers(0, 9)),
+        st.builds(lambda i, r: {"op": "sub_edge", "edge": i,
+                                "rpctype": r},
+                  st.integers(0, 4), st.integers(0, 4)),
+        st.builds(lambda i, f, r: {"op": "sub_edge", "edge": i,
+                                   "iface": f, "rpctype": r},
+                  st.integers(0, 4), st.integers(0, 9),
+                  st.integers(0, 4)))
+
+    @hyp.given(st.lists(edit, max_size=6))
+    @hyp.settings(deadline=None, max_examples=150)
+    def check(script):
+        canon = canonical_edits(script)
+        # idempotent normal form
+        assert canonical_edits(canon) == canon
+        raw_kind, raw_out = _apply(oracle_mixture, script)
+        can_kind, can_out = _apply(oracle_mixture, canon)
+        assert raw_kind == can_kind, (script, canon, raw_out, can_out)
+        if raw_kind == "ok":
+            _mixtures_equal(raw_out, can_out)
+
+    check()
+
+
+def test_canon_matches_whatif_oracle_seeded(oracle_mixture):
+    """The same oracle property without hypothesis: 300 seeded random
+    scripts (mixed ops, in/out-of-range indices) — always runs, so the
+    container without hypothesis still pins soundness."""
+    rng = np.random.default_rng(20)
+
+    def rand_edit():
+        k = rng.integers(0, 6)
+        if k == 0:
+            return {"op": "drop_edge", "edge": int(rng.integers(0, 5))}
+        if k == 1:
+            return {"op": "drop_node", "node": int(rng.integers(0, 6))}
+        if k == 2:
+            return {"op": "sub_node", "node": int(rng.integers(0, 6)),
+                    "ms_id": int(rng.integers(0, 15))}
+        if k == 3:
+            return {"op": "sub_edge", "edge": int(rng.integers(0, 5)),
+                    "iface": int(rng.integers(0, 10))}
+        if k == 4:
+            return {"op": "sub_edge", "edge": int(rng.integers(0, 5)),
+                    "rpctype": int(rng.integers(0, 5))}
+        return {"op": "sub_edge", "edge": int(rng.integers(0, 5)),
+                "iface": int(rng.integers(0, 10)),
+                "rpctype": int(rng.integers(0, 5))}
+
+    for _ in range(300):
+        script = [rand_edit() for _ in range(int(rng.integers(0, 7)))]
+        canon = canonical_edits(script)
+        assert canonical_edits(canon) == canon
+        raw_kind, raw_out = _apply(oracle_mixture, script)
+        can_kind, can_out = _apply(oracle_mixture, canon)
+        assert raw_kind == can_kind, (script, canon, raw_out, can_out)
+        if raw_kind == "ok":
+            _mixtures_equal(raw_out, can_out)
+
+
+def test_canon_key_is_order_insensitive_for_commuting_subs(
+        oracle_mixture):
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    # distinct-target substitutions commute: every permutation must
+    # share ONE memo key AND one oracle outcome
+    subs = [{"op": "sub_node", "node": 0, "ms_id": 3},
+            {"op": "sub_node", "node": 2, "ms_id": 7},
+            {"op": "sub_edge", "edge": 1, "iface": 2},
+            {"op": "sub_edge", "edge": 0, "rpctype": 1}]
+    base_key = canonical_lens_key({"edits": subs})
+    base = _apply(oracle_mixture, subs)
+
+    @hyp.given(st.permutations(subs))
+    @hyp.settings(deadline=None, max_examples=24)
+    def check(perm):
+        assert canonical_lens_key({"edits": perm}) == base_key
+        kind, out = _apply(oracle_mixture, perm)
+        assert kind == base[0]
+        if kind == "ok":
+            _mixtures_equal(out, base[1])
+
+    check()
+
+
+# --- 3. memo mechanics ----------------------------------------------------
+
+
+def _memo(capacity=1 << 16) -> PredictionMemo:
+    m = PredictionMemo(capacity, bus=NoopBus())
+    m.set_generation(3, "arena-a", (0.5, 0.99))
+    return m
+
+
+def _frame_bytes(row) -> int:
+    return len(wire.encode_response([{**row, "cache_hit": True}]))
+
+
+def test_miss_insert_hit_roundtrip():
+    memo = _memo()
+    row0, token, nbytes = memo.lookup(7, 3)
+    assert row0 is None and nbytes == 0
+    assert token is not None and token.gen_seq == 1
+    assert memo.insert(token, {"pred": [0.25, 0.5]})
+    row1, tok1, nbytes1 = memo.lookup(7, 3)
+    # the hit decodes the stored wire frame: bit-identical pred plus
+    # the travelling cache_hit flag, no insert permit
+    assert row1 == {"pred": [0.25, 0.5], "cache_hit": True}
+    assert tok1 is None
+    assert nbytes1 == _frame_bytes({"pred": [0.25, 0.5]})
+    s = memo.stats_dict()
+    assert (s["hits"], s["misses"], s["inserts"]) == (1, 1, 1)
+    assert s["entries"] == 1 and s["bytes"] == nbytes1
+
+
+def test_keying_sensitivity_per_component():
+    memo = _memo()
+    lens = {"edits": [{"op": "drop_edge", "edge": 0},
+                      {"op": "drop_edge", "edge": 0}]}
+    for args in ((7, 3, None), (7, 3, lens)):
+        _r, tok, _n = memo.lookup(*args)
+        assert memo.insert(tok, {"pred": float(hash(str(args)) % 97)})
+    # every single-component change misses
+    assert memo.lookup(8, 3)[0] is None          # entry
+    assert memo.lookup(7, 4)[0] is None          # ts bucket
+    assert memo.lookup(7, 3, {"edits": [
+        {"op": "drop_edge", "edge": 2},
+        {"op": "drop_edge", "edge": 0}]})[0] is None   # different edits
+    assert memo.lookup(7, 3, {"k": 2})[0] is None      # attribution k
+    # the plain and the lens rows are distinct entries...
+    assert memo.lookup(7, 3)[0] is not None
+    # ...and an EQUIVALENT edit script (same drop set, other order)
+    # hits the same entry
+    hit, _t, _n = memo.lookup(7, 3, {"edits": [
+        {"op": "drop_edge", "edge": 1},
+        {"op": "drop_edge", "edge": 0}]})
+    assert hit is not None and hit["cache_hit"] is True
+
+
+@pytest.mark.parametrize("flip", [
+    dict(checkpoint_epoch=4, arena_fingerprint="arena-a",
+         taus=(0.5, 0.99)),                        # epoch moved
+    dict(checkpoint_epoch=3, arena_fingerprint="arena-b",
+         taus=(0.5, 0.99)),                        # arena moved
+    dict(checkpoint_epoch=3, arena_fingerprint="arena-a",
+         taus=(0.5, 0.9, 0.99)),                   # head layout moved
+])
+def test_every_generation_component_invalidates(flip):
+    memo = _memo()
+    _r, token, _n = memo.lookup(7, 3)
+    assert memo.insert(token, {"pred": 1.5})
+    _r, stale_token, _n = memo.lookup(9, 9)   # miss under gen 1
+    memo.set_generation(**flip)
+    # the store is empty the instant the generation moves...
+    assert memo.lookup(7, 3)[0] is None
+    assert memo.retired == 1
+    # ...and the in-flight permit from gen 1 is refused
+    assert not memo.insert(stale_token, {"pred": 2.5})
+    assert memo.stale_inserts == 1
+    assert memo.stats_dict()["entries"] == 0
+
+
+def test_uncacheable_rows_and_tokens_are_refused():
+    memo = _memo()
+    _r, token, _n = memo.lookup(1, 1)
+    assert not memo.insert(None, {"pred": 1.0})            # no permit
+    assert not memo.insert(token, {"error": "Shed",
+                                   "message": "x"})        # error row
+    assert not memo.insert(token, {"rows": 3})             # not a pred
+    assert memo.stats_dict()["entries"] == 0
+
+
+def test_no_generation_means_no_permits_and_no_storage():
+    memo = PredictionMemo(1 << 16, bus=NoopBus())
+    row, token, _n = memo.lookup(1, 1)
+    assert row is None and token is None
+    with pytest.raises(ValueError):
+        PredictionMemo(0)
+
+
+def test_oversize_frame_is_refused_not_thrashed():
+    row = {"pred": [float(i) for i in range(64)]}
+    memo = PredictionMemo(_frame_bytes(row) - 1, bus=NoopBus())
+    memo.set_generation(1, "a", (0.5,))
+    _r, token, _n = memo.lookup(1, 1)
+    assert not memo.insert(token, row)
+    assert memo.oversize == 1 and memo.stats_dict()["entries"] == 0
+
+
+def test_lru_byte_bound_under_churn():
+    row = {"pred": [0.25, 0.5, 0.75]}
+    per = _frame_bytes(row)
+    memo = PredictionMemo(3 * per, bus=NoopBus())
+    memo.set_generation(1, "a", (0.5,))
+    for eid in range(8):
+        _r, tok, _n = memo.lookup(eid, 0)
+        assert memo.insert(tok, row)
+        assert memo.stats_dict()["bytes"] <= memo.capacity_bytes
+        # keep entry 0 hot so recency, not insertion order, decides
+        if eid >= 1:
+            memo.lookup(0, 0)
+    s = memo.stats_dict()
+    assert s["entries"] == 3 and s["evictions"] == 5
+    # the hot entry survived the churn; the cold middle did not
+    assert memo.lookup(0, 0)[0] is not None
+    assert memo.lookup(7, 0)[0] is not None
+    assert memo.lookup(3, 0)[0] is None
+
+
+def test_retire_generation_empties_and_disables():
+    memo = _memo()
+    _r, tok, _n = memo.lookup(5, 5)
+    assert memo.insert(tok, {"pred": 2.0})
+    assert memo.retire_generation(reason="rollout") == 1
+    row, token, _n = memo.lookup(5, 5)
+    assert row is None and token is None
+    assert memo.retired == 1
+
+
+# --- 4. the rollout-flip races, both orders each --------------------------
+
+
+def _run_scripted(script, *thunks):
+    sched = ScriptedScheduler(list(script), timeout_s=15.0)
+    with sched:
+        ts = [threading.Thread(target=t, name=f"memo-race-{i}")
+              for i, t in enumerate(thunks)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15.0)
+    assert sched.finished(), (sched.trace, sched.script)
+    return sched
+
+
+def _flip_vs_insert_trial(flip_first: bool):
+    memo = PredictionMemo(1 << 16, bus=NoopBus())
+    memo.set_generation(1, "a", (0.5,))
+    _r, token, _n = memo.lookup(7, 3)
+    assert token is not None
+    out: dict = {}
+
+    def insert():
+        out["stored"] = memo.insert(token, {"pred": 1.5})
+        schedules.sync_point("test.insert.done")
+
+    def flip():
+        out["retired"] = memo.retire_generation(reason="rollout")
+        schedules.sync_point("test.flip.done")
+
+    script = (["fleet.memo.flip", "test.flip.done", "fleet.memo.insert"]
+              if flip_first else
+              ["fleet.memo.insert", "test.insert.done",
+               "fleet.memo.flip"])
+    _run_scripted(script, insert, flip)
+    return memo, out
+
+
+def test_flip_before_inflight_insert_refuses_the_stale_value():
+    memo, out = _flip_vs_insert_trial(flip_first=True)
+    assert out["stored"] is False
+    assert out["retired"] == 0          # nothing was stored yet
+    assert memo.stale_inserts == 1
+    assert memo.stats_dict()["entries"] == 0
+    # post-flip: no hit, no permit — the old byte is unreachable
+    row, token, _n = memo.lookup(7, 3)
+    assert row is None and token is None
+
+
+def test_inflight_insert_before_flip_is_retired_exactly_once():
+    memo, out = _flip_vs_insert_trial(flip_first=False)
+    assert out["stored"] is True
+    assert out["retired"] == 1          # the one stored entry, once
+    assert memo.retired == 1 and memo.stale_inserts == 0
+    assert memo.stats_dict()["entries"] == 0
+    row, token, _n = memo.lookup(7, 3)
+    assert row is None and token is None
+
+
+def _flip_vs_lookup_trial(flip_first: bool):
+    memo = PredictionMemo(1 << 16, bus=NoopBus())
+    memo.set_generation(1, "a", (0.5,))
+    _r, tok, _n = memo.lookup(7, 3)
+    assert memo.insert(tok, {"pred": 1.5})
+    out: dict = {}
+
+    def lookup():
+        out["row"], out["token"], _ = memo.lookup(7, 3)
+        schedules.sync_point("test.lookup.done")
+
+    def flip():
+        memo.retire_generation(reason="rollout")
+        schedules.sync_point("test.flip.done")
+
+    script = (["fleet.memo.flip", "test.flip.done",
+               "fleet.memo.lookup"]
+              if flip_first else
+              ["fleet.memo.lookup", "test.lookup.done",
+               "fleet.memo.flip"])
+    _run_scripted(script, lookup, flip)
+    return memo, out
+
+
+def test_flip_before_lookup_serves_nothing():
+    memo, out = _flip_vs_lookup_trial(flip_first=True)
+    # after the flip there is no generation: no hit AND no permit
+    assert out["row"] is None and out["token"] is None
+    assert memo.stats_dict()["entries"] == 0
+
+
+def test_lookup_before_flip_serves_the_then_current_value():
+    memo, out = _flip_vs_lookup_trial(flip_first=False)
+    # the lookup COMPLETED before the flip — the fleet was still
+    # uniformly on the old version, so the answer was current
+    assert out["row"] == {"pred": 1.5, "cache_hit": True}
+    # and the flip still emptied the store afterwards
+    assert memo.stats_dict()["entries"] == 0
+    assert memo.lookup(7, 3)[0] is None
+
+
+# --- 5. loadgen vector result slots ---------------------------------------
+
+
+def _tiny_schedule(n_entries=4):
+    spec = loadgen.LoadSpec(duration_s=0.2, base_rps=200.0,
+                            zipf_s=0.0, seed=1)
+    entries = np.arange(n_entries, dtype=np.int64)
+    buckets = np.zeros(n_entries, dtype=np.int64)
+    return loadgen.generate_schedule(spec, entries, buckets)
+
+
+def test_replay_vector_slots_round_trip():
+    schedule = _tiny_schedule()
+
+    def submit(eid, tsb, slo=None):
+        fut: Future = Future()
+        fut.set_result([0.25, 0.5, 0.75])
+        return fut
+
+    result = loadgen.replay(submit, schedule, bus=NoopBus(),
+                            vector_width=3)
+    assert result.preds.shape == (len(schedule), 3)
+    assert result.served_mask().all()
+    assert result.served_mask().shape == (len(schedule),)
+    assert result.lost_futures() == 0
+    assert np.array_equal(result.preds[0], [0.25, 0.5, 0.75])
+
+
+def test_replay_vector_slots_record_errors_without_losing_futures():
+    schedule = _tiny_schedule()
+    calls = [0]
+
+    def submit(eid, tsb, slo=None):
+        calls[0] += 1
+        if calls[0] % 2 == 0:
+            raise Shed("every other arrival shed", slo=slo)
+        fut: Future = Future()
+        fut.set_result([1.0, 2.0])
+        return fut
+
+    result = loadgen.replay(submit, schedule, bus=NoopBus(),
+                            vector_width=2)
+    served = result.served_mask()
+    assert served.sum() == (len(schedule) + 1) // 2
+    assert result.error_counts() == {"Shed": len(schedule) // 2}
+    # a shed row is all-NaN across its tau columns, and NOT lost
+    assert result.lost_futures() == 0
+    assert np.isnan(result.preds[~served]).all()
+
+
+# ---------------------------------------------------------------------------
+# 6. counterfactual search over a fake router front door
+# ---------------------------------------------------------------------------
+
+
+def _search_submit(objective_by_key, *, refuse_keys=()):
+    """A router-shaped submit whose answer is a pure function of the
+    CANONICAL edit key — the same determinism contract the real engine
+    gives the search (bit-identical bits per canonical request)."""
+
+    keys_seen = []
+
+    def submit(eid, tsb, slo=None, lens=None):
+        edits = () if lens is None else tuple(lens.edits)
+        key = canonical_lens_key({"edits": [dict(e) for e in edits]})
+        keys_seen.append(key)
+        fut: Future = Future()
+        if key in refuse_keys:
+            fut.set_exception(WhatIfRefused("pruned by the oracle"))
+        else:
+            fut.set_result([0.1, objective_by_key(key)])
+        return fut
+
+    return submit, keys_seen
+
+
+def _obj_from_key(key):
+    # deterministic, spread-out objectives over the canonical key
+    return 50.0 + (hash(key) % 97)
+
+
+def test_search_budget_too_small_refuses_typed():
+    submit, _ = _search_submit(_obj_from_key)
+    spec = SearchSpec(entry_id=0, ts_bucket=0, num_nodes=4,
+                      num_edges=4, budget=1)
+    with pytest.raises(SearchBudgetExhausted):
+        CounterfactualSearch(submit, spec, bus=NoopBus()).run()
+
+
+def test_search_argmin_dedup_and_refusal_pruning():
+    refused_key = canonical_lens_key(
+        {"edits": [{"op": "drop_edge", "edge": 0}]})
+    submit, keys_seen = _search_submit(
+        _obj_from_key, refuse_keys={refused_key})
+    spec = SearchSpec(entry_id=0, ts_bucket=0, num_nodes=3,
+                      num_edges=3, beam_width=2, max_depth=2,
+                      budget=96, sub_ms_ids=(1, 2),
+                      max_drop_candidates=3, max_sub_nodes=2)
+    res = CounterfactualSearch(submit, spec, bus=NoopBus()).run()
+    # dedup: every submitted candidate had a DISTINCT canonical key
+    assert len(keys_seen) == len(set(keys_seen))
+    # the reported best is the argmin over everything evaluated
+    assert res.best_objective == min(o for _e, o in res.evaluated)
+    assert res.best_objective <= res.baseline
+    # the refused candidate was pruned, counted, and did not crash
+    assert res.refused == 1
+    assert refused_key not in {
+        canonical_lens_key({"edits": [dict(e) for e in edits]})
+        for edits, _o in res.evaluated}
+    assert not res.budget_exhausted
+    assert res.requests <= spec.budget
+
+
+def test_search_truncates_honestly_when_budget_runs_dry():
+    submit, _ = _search_submit(_obj_from_key)
+    # budget covers the baseline plus a couple of candidates only;
+    # the first round alone proposes more than that
+    spec = SearchSpec(entry_id=0, ts_bucket=0, num_nodes=4,
+                      num_edges=8, beam_width=4, max_depth=3,
+                      budget=4, sub_ms_ids=(1,),
+                      max_drop_candidates=8, max_sub_nodes=4)
+    res = CounterfactualSearch(submit, spec, bus=NoopBus()).run()
+    assert res.budget_exhausted
+    assert res.requests <= spec.budget
+    # the argmin is over what WAS evaluated — still internally honest
+    assert res.best_objective == min(o for _e, o in res.evaluated)

@@ -122,7 +122,8 @@ def test_request_roundtrip_property():
 def test_every_truncation_is_a_typed_refusal():
     """EVERY proper prefix of a valid frame must raise WireFormatError
     — no IndexError, no struct.error, no silent partial decode."""
-    buf = wire.encode_response([{"pred": 1.0}, {"pred": [1.0, 2.0]},
+    buf = wire.encode_response([{"pred": 1.0, "cache_hit": True},
+                                {"pred": [1.0, 2.0]},
                                 {"error": "QueueFull", "message": "x"}])
     for cut in range(len(buf)):
         with pytest.raises(wire.WireFormatError):
@@ -182,6 +183,56 @@ def test_vector_count_mismatch_refused():
                       struct.pack("<I", 1) + block + b"\x00")])
     with pytest.raises(wire.WireFormatError, match="trailing"):
         wire.decode_response(bad_tail)
+
+
+def test_cache_hit_flags_roundtrip_and_omit_when_default():
+    """The 0x15 cache_hit bitmask (fleet/memo.py hits): flags survive
+    the round trip on any row kind, and an all-miss frame carries no
+    section at all — pre-memo peers and cold traffic pay zero bytes."""
+    rows = [
+        {"pred": 1.5, "cache_hit": True},
+        {"pred": [0.25, 0.5, 0.75]},
+        {"error": "Shed", "message": "x"},
+        {"pred": [0.1, 0.2], "attr": [{"rank": 1, "score": 0.5}],
+         "cache_hit": True},
+    ]
+    assert wire.decode_response(wire.encode_response(rows)) == rows
+    plain = [{"pred": 1.5}, {"error": "Shed", "message": "x"}]
+    buf = wire.encode_response(plain)
+    assert wire.decode_response(buf) == plain
+    flagged = wire.encode_response(
+        [{**plain[0], "cache_hit": True}, plain[1]])
+    assert len(buf) < len(flagged)      # the section was truly absent
+
+
+def test_cache_hit_count_mismatch_refused():
+    rowkind = wire._section(
+        wire._TAG_ROWKIND,
+        struct.pack("<I", 1) + bytes([wire._ROW_SCALAR]))
+    scalars = wire._section(wire._TAG_SCALARS,
+                            struct.pack("<d", 1.0))
+    bad = wire._frame(wire.KIND_RESPONSE, [
+        rowkind, scalars,
+        wire._section(wire._TAG_CACHE,
+                      struct.pack("<I", 2) + b"\x03")])
+    with pytest.raises(wire.WireFormatError, match="flag count"):
+        wire.decode_response(bad)
+
+
+def test_cache_hit_mask_length_mismatch_refused():
+    rowkind = wire._section(
+        wire._TAG_ROWKIND,
+        struct.pack("<I", 1) + bytes([wire._ROW_SCALAR]))
+    scalars = wire._section(wire._TAG_SCALARS,
+                            struct.pack("<d", 1.0))
+    for mask in (b"", b"\x01\x00"):      # short and long
+        bad = wire._frame(wire.KIND_RESPONSE, [
+            rowkind, scalars,
+            wire._section(wire._TAG_CACHE,
+                          struct.pack("<I", 1) + mask)])
+        with pytest.raises(wire.WireFormatError,
+                           match="mask bytes|truncated"):
+            wire.decode_response(bad)
 
 
 def test_refusal_frame_raises_wire_refusal():

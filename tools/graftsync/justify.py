@@ -53,6 +53,15 @@ SINGLE_WRITER: dict[tuple[str, str], str] = {
     ("AutoscaleController", "_under_since"):
         "hysteresis bookkeeping; step() is control-thread-only by "
         "design",
+    # fleet/router.py FleetRouter — the prediction memo handle.
+    ("FleetRouter", "memo"):
+        "bound once in __init__ and never rebound; .insert()/.lookup() "
+        "mutate the PredictionMemo's OWN state under the memo's OWN "
+        "lock (fleet/memo.py — graftsync-verified: bus emission and "
+        "wire codec work stay outside it). Calling it under the router "
+        "lock would NEST router-lock -> memo-lock and put the memo's "
+        "bus counters under a lock — the exact lock-order hazard "
+        "graftsync forbids — so the unlocked call IS the protocol",
 }
 
 # -- timeout-totality (graftsync) -----------------------------------------
